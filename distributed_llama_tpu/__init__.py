@@ -1,0 +1,22 @@
+"""distributed_llama_tpu — a TPU-native tensor-parallel Llama inference framework.
+
+A ground-up JAX/XLA/Pallas re-design with the capabilities of distributed-llama
+(reference: /root/reference, b4rtaz/distributed-llama): Q40-quantized weights,
+Q80-quantized activation exchange, 2^n-way tensor parallelism, llama2.c tokenizer,
+and the reference's logit-level numerics — expressed as sharded, jitted step
+functions over a `jax.sharding.Mesh` instead of hand-scheduled task tables over
+TCP sockets.
+
+Layer map (ours ⇄ reference):
+  ops.quants      ⇄ src/quants.cpp        (block codecs)
+  ops             ⇄ src/funcs.cpp         (kernels: XLA/Pallas instead of NEON)
+  models          ⇄ src/transformer.cpp   (spec, weights, buffers)
+  parallel        ⇄ src/socket.cpp + transformer-tasks.cpp sync* (ICI collectives
+                                           instead of star-topology TCP)
+  runtime         ⇄ src/transformer-tasks.cpp + tokenizer.cpp generate()
+  frontend.cli    ⇄ src/main.cpp
+  convert         ⇄ converter/converter.py
+  csrc/           ⇄ the reference's native (C++) host role
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,1 @@
+from .loader import Q40Weight, load_model, read_spec, write_model  # noqa: F401

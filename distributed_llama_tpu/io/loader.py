@@ -1,0 +1,169 @@
+""".bin model file reader/writer (reference format parity).
+
+Reader walks the exact tensor order of reference src/transformer.cpp:298-352
+(see models/spec.py docstring for the layout) and returns a numpy parameter
+pytree with per-layer weights stacked along a leading layer axis — the shape a
+`lax.scan` over layers consumes. Quantized (Q40) matmul weights come back as
+`Q40Weight(qs, d16)` planar pairs; F16 as float16 arrays; F32 as float32.
+
+Writer emits the same byte layout (used by our converter and by tests to
+synthesize models); the legacy freq_cis gap is written as zeros, matching what
+``seek`` past EOF produces in the reference converter (converter.py:124-127).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import numpy as np
+
+from ..models.spec import HEADER_BYTES, TransformerSpec
+from ..ops.quants import (
+    FloatType,
+    pack_q40_bytes,
+    quantize_q40,
+    unpack_q40_bytes,
+)
+
+
+class Q40Weight(NamedTuple):
+    """Planar Q40 tensor: qs uint8 (..., d, n/32, 16), d16 float16 (..., d, n/32).
+
+    NamedTuple => automatically a jax pytree; usable directly under jit/scan.
+    """
+
+    qs: np.ndarray
+    d16: np.ndarray
+
+    @property
+    def logical_shape(self) -> tuple[int, ...]:
+        return (*self.qs.shape[:-2], self.qs.shape[-2] * 32)
+
+
+def read_spec(path: str, weights_float_type=FloatType.F32,
+              buffer_float_type=FloatType.F32) -> TransformerSpec:
+    with open(path, "rb") as f:
+        raw = f.read(HEADER_BYTES)
+    return TransformerSpec.from_header(raw, weights_float_type, buffer_float_type)
+
+
+class _Walker:
+    def __init__(self, mm: np.ndarray, offset: int):
+        self.mm = mm
+        self.off = offset
+
+    def take(self, nbytes: int) -> np.ndarray:
+        chunk = self.mm[self.off:self.off + nbytes]
+        if chunk.nbytes != nbytes:
+            raise ValueError(
+                f"file truncated: wanted {nbytes} bytes at {self.off}, "
+                f"got {chunk.nbytes}")
+        self.off += nbytes
+        return chunk
+
+    def f32(self, shape: tuple[int, ...]) -> np.ndarray:
+        n = int(np.prod(shape))
+        return self.take(n * 4).view(np.float32).reshape(shape).copy()
+
+    def matmul(self, spec: TransformerSpec, shape: tuple[int, int]):
+        ft = spec.weights_float_type
+        raw = self.take(spec.matmul_bytes(shape))
+        if ft == FloatType.F32:
+            return raw.view(np.float32).reshape(shape).copy()
+        if ft == FloatType.F16:
+            return raw.view(np.float16).reshape(shape).copy()
+        if ft == FloatType.Q40:
+            qs, d16 = unpack_q40_bytes(raw, shape)  # unpack always copies
+            return Q40Weight(qs, d16)
+        raise ValueError(f"unsupported weights float type {ft}")
+
+
+def load_model(path: str, spec: TransformerSpec | None = None,
+               weights_float_type=FloatType.F32,
+               buffer_float_type=FloatType.F32) -> tuple[TransformerSpec, dict]:
+    """Load a .bin file into a stacked-layer numpy param tree.
+
+    Size accounting is byte-exact, like the reference's missedBytes check
+    (transformer.cpp:344-348).
+    """
+    if spec is None:
+        spec = read_spec(path, weights_float_type, buffer_float_type)
+    mm = np.memmap(path, dtype=np.uint8, mode="r")
+    expected = spec.file_size()
+    if mm.nbytes != expected:
+        raise ValueError(
+            f"file size mismatch: {path} has {mm.nbytes} bytes, "
+            f"spec requires {expected}")
+    w = _Walker(mm, HEADER_BYTES)
+
+    params: dict = {}
+    params["tok_embedding"] = w.f32((spec.vocab_size, spec.dim))
+
+    per_layer: dict[str, list] = {name: [] for name in
+                                  ("rms_att", "rms_ffn", "wq", "wk", "wv",
+                                   "wo", "w1", "w2", "w3")}
+    shapes = spec.layer_matmul_shapes()
+    for _ in range(spec.n_layers):
+        per_layer["rms_att"].append(w.f32((spec.dim,)))
+        per_layer["rms_ffn"].append(w.f32((spec.dim,)))
+        for name, shape in shapes:
+            per_layer[name].append(w.matmul(spec, shape))
+
+    for name, vals in per_layer.items():
+        if isinstance(vals[0], Q40Weight):
+            params[name] = Q40Weight(np.stack([v.qs for v in vals]),
+                                     np.stack([v.d16 for v in vals]))
+        else:
+            params[name] = np.stack(vals)
+
+    params["rms_final"] = w.f32((spec.dim,))
+    w.take(spec.rope_gap_bytes)  # legacy freq_cis region, skipped
+    params["wcls"] = w.matmul(spec, (spec.vocab_size, spec.dim))
+
+    if w.off != expected:
+        raise ValueError(f"missed {expected - w.off} bytes")  # parity check
+    return spec, params
+
+
+# ---------------------------------------------------------------------------
+# Writer
+# ---------------------------------------------------------------------------
+
+def _write_matmul(f, spec: TransformerSpec, x: np.ndarray) -> None:
+    ft = spec.weights_float_type
+    if ft == FloatType.F32:
+        f.write(np.ascontiguousarray(x, dtype=np.float32).tobytes())
+    elif ft == FloatType.F16:
+        f.write(np.ascontiguousarray(x, dtype=np.float32)
+                .astype(np.float16).tobytes())
+    elif ft == FloatType.Q40:
+        qs, d16 = quantize_q40(np.ascontiguousarray(x, dtype=np.float32))
+        f.write(pack_q40_bytes(qs, d16))
+    else:
+        raise ValueError(f"unsupported weights float type {ft}")
+
+
+def write_model(path: str, spec: TransformerSpec, tensors: dict) -> None:
+    """Write a reference-format .bin from f32 logical tensors.
+
+    ``tensors`` keys match load_model's output (stacked layer axis), values f32.
+    """
+    with open(path, "wb") as f:
+        f.write(spec.header())
+        f.write(np.ascontiguousarray(
+            tensors["tok_embedding"], dtype=np.float32).tobytes())
+        for layer in range(spec.n_layers):
+            f.write(np.ascontiguousarray(
+                tensors["rms_att"][layer], dtype=np.float32).tobytes())
+            f.write(np.ascontiguousarray(
+                tensors["rms_ffn"][layer], dtype=np.float32).tobytes())
+            for name, _ in spec.layer_matmul_shapes():
+                _write_matmul(f, spec, tensors[name][layer])
+        f.write(np.ascontiguousarray(
+            tensors["rms_final"], dtype=np.float32).tobytes())
+        f.write(b"\x00" * spec.rope_gap_bytes)
+        _write_matmul(f, spec, tensors["wcls"])
+    # byte-exact invariant
+    import os
+
+    assert os.path.getsize(path) == spec.file_size()

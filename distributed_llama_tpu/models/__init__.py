@@ -1,0 +1,1 @@
+from .spec import TransformerSpec  # noqa: F401

@@ -1,0 +1,210 @@
+"""Q40 / Q80 block quantization codecs.
+
+Format parity with the reference (same wire/file bytes, same decoded values):
+
+* Q40 (reference src/quants.hpp:16-19, converter/converter.py:13-43): blocks of
+  32 values -> one float16 delta + 16 bytes of packed 4-bit codes. Byte ``i``
+  holds code ``i`` in its low nibble and code ``i+16`` in its high nibble.
+  Decode is ``(code - 8) * delta`` (src/quants.cpp:133-180). Encode picks
+  ``delta = signed-max-magnitude / -8``, scales by ``1/delta`` (computed in f32
+  *before* the f16 rounding of delta), offsets by +8.5, clamps to 15 and
+  truncates — exactly converter.py:22-28.
+
+* Q80 (src/quants.hpp:21-24, src/quants.cpp:182-262): blocks of 32 values ->
+  one float16 delta + 32 int8. ``delta = amax/127``; codes round to nearest
+  with ties-to-even (the reference's NEON ``vcvtnq_s32_f32``; its scalar
+  fallback uses roundf — we follow the NEON semantics the published numbers
+  were measured with). Decode is ``code * delta``.
+
+float16<->float32 conversion uses IEEE semantics via numpy, which matches the
+reference's 65536-entry LUT (src/quants.cpp:49-92) on all values.
+
+Two array layouts are provided:
+* "planar" — ``(qs, d)`` pairs of ndarrays, the layout device code wants
+  (scales and codes in separate, densely-typed arrays);
+* "wire"   — the reference's interleaved block bytes for file/network parity.
+"""
+
+from __future__ import annotations
+
+import enum
+
+import numpy as np
+
+QK = 32  # block size for both Q40 and Q80 (reference src/quants.hpp:13-14)
+
+
+class FloatType(enum.IntEnum):
+    """Weight/buffer dtypes, same codes as reference src/quants.hpp:6-11."""
+
+    F32 = 0
+    F16 = 1
+    Q40 = 2
+    Q80 = 3
+
+
+_BLOCK_BYTES = {
+    FloatType.F32: (1, 4),     # (values per batch, bytes per batch)
+    FloatType.F16: (1, 2),
+    FloatType.Q40: (QK, 18),   # f16 delta + 16 nibble bytes
+    FloatType.Q80: (QK, 34),   # f16 delta + 32 int8
+}
+
+
+def numbers_per_batch(ftype: FloatType) -> int:
+    """Reference ``getNumbersPerBatch`` (src/quants.cpp:17-28)."""
+    return _BLOCK_BYTES[FloatType(ftype)][0]
+
+
+def batch_bytes(ftype: FloatType, n: int, d: int = 1) -> int:
+    """Reference ``getBatchBytes`` (src/quants.cpp:30-47): bytes of an n*d tensor.
+
+    Validates per-row divisibility (n % 32), like the reference: quant blocks
+    never span rows.
+    """
+    per, nbytes = _BLOCK_BYTES[FloatType(ftype)]
+    if n % per != 0:
+        raise ValueError(f"row length {n} not divisible by block size {per}")
+    return (n // per) * d * nbytes
+
+
+# ---------------------------------------------------------------------------
+# Q40
+# ---------------------------------------------------------------------------
+
+def quantize_q40(x: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Encode f32 -> (qs uint8 [..., n/32, 16], delta float16 [..., n/32]).
+
+    Matches converter.py:13-43 bit-for-bit (including the f32-reciprocal-of-
+    unrounded-delta detail and the +8.5/clamp-15/truncate code mapping).
+    """
+    x = np.asarray(x, dtype=np.float32)
+    n = x.shape[-1]
+    if n % QK != 0:
+        raise ValueError(f"last dim {n} not divisible by {QK}")
+    g = x.reshape(*x.shape[:-1], n // QK, QK)
+    gmax = g.max(axis=-1)
+    gmin = g.min(axis=-1)
+    deltas = np.where(-gmin > gmax, gmin, gmax) / np.float32(-8.0)
+    deltas16 = deltas.astype(np.float16)
+    with np.errstate(divide="ignore"):  # zero blocks take the where-branch
+        ids = np.where(deltas != 0, np.float32(1.0) / deltas, np.float32(0.0))
+    q = g * ids[..., None] + np.float32(8.5)
+    # np.where (not minimum): converter.py:27 semantics, NaN clamps to 15
+    q = np.where(q < np.float32(15.0), q, np.float32(15.0))
+    q = q.astype(np.int32)  # truncation toward zero, like int() in the converter
+    lo = q[..., :QK // 2] & 0xF
+    hi = q[..., QK // 2:] & 0xF
+    qs = (lo | (hi << 4)).astype(np.uint8)
+    return qs, deltas16
+
+
+def dequantize_q40(qs: np.ndarray, d: np.ndarray) -> np.ndarray:
+    """Decode (qs uint8 [..., nb, 16], d f16 [..., nb]) -> f32 [..., nb*32]."""
+    lo = (qs & 0xF).astype(np.int8) - np.int8(8)
+    hi = (qs >> 4).astype(np.int8) - np.int8(8)
+    codes = np.concatenate([lo, hi], axis=-1).astype(np.float32)  # [..., nb, 32]
+    y = codes * d.astype(np.float32)[..., None]
+    return y.reshape(*qs.shape[:-2], qs.shape[-2] * QK)
+
+
+def pack_q40_bytes(qs: np.ndarray, d: np.ndarray) -> bytes:
+    """Planar -> reference wire bytes (f16 delta || 16 qs bytes per block)."""
+    nb = int(np.prod(qs.shape[:-1]))
+    out = np.empty((nb, 18), dtype=np.uint8)
+    out[:, :2] = d.reshape(nb, 1).view(np.uint8)
+    out[:, 2:] = qs.reshape(nb, 16)
+    return out.tobytes()
+
+
+def unpack_q40_bytes(buf: np.ndarray | bytes, shape: tuple[int, ...]) -> tuple[np.ndarray, np.ndarray]:
+    """Reference wire bytes -> planar (qs [..., nb, 16], d [..., nb]).
+
+    ``shape`` is the logical f32 shape, last dim divisible by 32.
+    """
+    n = shape[-1]
+    nb = n // QK
+    lead = tuple(shape[:-1])
+    raw = np.frombuffer(buf, dtype=np.uint8).reshape(*lead, nb, 18)
+    # always materialize fresh writable arrays (never alias the input buffer)
+    d = raw[..., :2].copy().view(np.float16)[..., 0]
+    qs = raw[..., 2:].copy()
+    return qs, d
+
+
+# ---------------------------------------------------------------------------
+# Q80
+# ---------------------------------------------------------------------------
+
+def quantize_q80(x: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Encode f32 -> (qs int8 [..., nb, 32], delta float16 [..., nb])."""
+    x = np.asarray(x, dtype=np.float32)
+    n = x.shape[-1]
+    if n % QK != 0:
+        raise ValueError(f"last dim {n} not divisible by {QK}")
+    g = x.reshape(*x.shape[:-1], n // QK, QK)
+    amax = np.abs(g).max(axis=-1)
+    d = amax / np.float32(127.0)
+    with np.errstate(divide="ignore"):  # zero blocks take the where-branch
+        id_ = np.where(d != 0, np.float32(1.0) / d, np.float32(0.0))
+    qs = np.rint(g * id_[..., None]).astype(np.int8)  # ties-to-even, NEON vcvtnq
+    return qs, d.astype(np.float16)
+
+
+def dequantize_q80(qs: np.ndarray, d: np.ndarray) -> np.ndarray:
+    y = qs.astype(np.float32) * d.astype(np.float32)[..., None]
+    return y.reshape(*qs.shape[:-2], qs.shape[-2] * QK)
+
+
+def pack_q80_bytes(qs: np.ndarray, d: np.ndarray) -> bytes:
+    nb = int(np.prod(qs.shape[:-1]))
+    out = np.empty((nb, 34), dtype=np.uint8)
+    out[:, :2] = d.reshape(nb, 1).view(np.uint8)
+    out[:, 2:] = qs.reshape(nb, 32).view(np.uint8)
+    return out.tobytes()
+
+
+def unpack_q80_bytes(buf: np.ndarray | bytes, shape: tuple[int, ...]) -> tuple[np.ndarray, np.ndarray]:
+    n = shape[-1]
+    nb = n // QK
+    lead = tuple(shape[:-1])
+    raw = np.frombuffer(buf, dtype=np.uint8).reshape(*lead, nb, 34)
+    d = raw[..., :2].copy().view(np.float16)[..., 0]
+    qs = raw[..., 2:].copy().view(np.int8)
+    return qs, d
+
+
+# ---------------------------------------------------------------------------
+# JAX (on-device) variants
+# ---------------------------------------------------------------------------
+# Imported lazily so pure-IO users (the converter) never pay for jax import.
+
+def dequantize_q40_jax(qs, d):
+    """jnp decode of planar Q40 -> f32 [..., nb*32]. Same value map as numpy."""
+    import jax.numpy as jnp
+
+    lo = (qs & 0xF).astype(jnp.int8) - jnp.int8(8)
+    hi = (qs >> 4).astype(jnp.int8) - jnp.int8(8)
+    codes = jnp.concatenate([lo, hi], axis=-1).astype(jnp.float32)
+    y = codes * d.astype(jnp.float32)[..., None]
+    return y.reshape(*qs.shape[:-2], qs.shape[-2] * QK)
+
+
+def quantize_q80_jax(x):
+    """jnp encode f32 -> (qs int8, d f16); jnp.rint is ties-to-even like NEON."""
+    import jax.numpy as jnp
+
+    n = x.shape[-1]
+    g = x.reshape(*x.shape[:-1], n // QK, QK)
+    amax = jnp.abs(g).max(axis=-1)
+    d = amax / jnp.float32(127.0)
+    id_ = jnp.where(d != 0, jnp.float32(1.0) / d, jnp.float32(0.0))
+    qs = jnp.rint(g * id_[..., None]).astype(jnp.int8)
+    return qs, d.astype(jnp.float16)
+
+
+def dequantize_q80_jax(qs, d):
+    import jax.numpy as jnp
+
+    y = qs.astype(jnp.float32) * d.astype(jnp.float32)[..., None]
+    return y.reshape(*qs.shape[:-2], qs.shape[-2] * QK)

@@ -1,0 +1,1 @@
+from .rng import Xorshift64, random_f32, random_u32  # noqa: F401

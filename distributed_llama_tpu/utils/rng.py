@@ -1,0 +1,59 @@
+"""xorshift64* RNG with bit-exact parity to the reference.
+
+The reference seeds all stochastic behavior (sampling coins, test inputs) from a
+xorshift64* generator (reference src/utils.cpp:27-38: randomU32/randomF32). The
+golden-vector forward test and sampler parity both require reproducing its exact
+integer sequence, so this module is the single source of that sequence.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_MASK64 = (1 << 64) - 1
+_MULT = 0x2545F4914F6CDD1D
+
+
+def random_u32(state: int) -> tuple[int, int]:
+    """One xorshift64* step. Returns (new_state, u32 sample)."""
+    s = state & _MASK64
+    s ^= s >> 12
+    s ^= (s << 25) & _MASK64
+    s ^= s >> 27
+    return s, ((s * _MULT) & _MASK64) >> 32
+
+
+def random_f32(state: int) -> tuple[int, float]:
+    """float32 in [0, 1): (randomU32 >> 8) / 2^24."""
+    s, u = random_u32(state)
+    return s, np.float32(u >> 8) / np.float32(16777216.0)
+
+
+class Xorshift64:
+    """Stateful wrapper used by the sampler and by test-input generation."""
+
+    def __init__(self, seed: int):
+        self.state = seed & _MASK64
+
+    def u32(self) -> int:
+        self.state, u = random_u32(self.state)
+        return u
+
+    def f32(self) -> float:
+        self.state, f = random_f32(self.state)
+        return f
+
+    def f32_array(self, n: int) -> np.ndarray:
+        """Vectorized stream of n f32 samples (same sequence as n f32() calls).
+
+        The xorshift update only permutes bits of the 64-bit state, so we run the
+        scalar recurrence for the states (cheap in python ints) but do the
+        float conversion vectorized.
+        """
+        out = np.empty(n, dtype=np.uint32)
+        s = self.state
+        for i in range(n):
+            s, u = random_u32(s)
+            out[i] = u
+        self.state = s
+        return ((out >> np.uint32(8)).astype(np.float32) / np.float32(16777216.0))

@@ -1,0 +1,14 @@
+"""Test env: force an 8-device virtual CPU mesh before jax is imported.
+
+Multi-chip sharding tests run on 8 virtual CPU devices (the TPU pod stand-in);
+real-TPU runs go through bench.py / the CLI, which do not import this.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")

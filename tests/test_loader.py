@@ -1,0 +1,100 @@
+"""Round-trip tests for the .bin format reader/writer."""
+
+import numpy as np
+import pytest
+
+from distributed_llama_tpu.io.loader import Q40Weight, load_model, read_spec, write_model
+from distributed_llama_tpu.models.spec import TransformerSpec
+from distributed_llama_tpu.ops.quants import FloatType, dequantize_q40
+from distributed_llama_tpu.utils.rng import Xorshift64
+
+TINY = TransformerSpec(dim=64, hidden_dim=160, n_layers=2, n_heads=4,
+                       n_kv_heads=2, vocab_size=96, seq_len=32)
+
+
+def _synth_tensors(spec, seed=12345):
+    rng = np.random.default_rng(seed)
+
+    def t(*shape):
+        return rng.standard_normal(shape).astype(np.float32)
+
+    layers = {name: t(spec.n_layers, *shape)
+              for name, shape in spec.layer_matmul_shapes()}
+    return {
+        "tok_embedding": t(spec.vocab_size, spec.dim),
+        "rms_att": t(spec.n_layers, spec.dim),
+        "rms_ffn": t(spec.n_layers, spec.dim),
+        "rms_final": t(spec.dim),
+        "wcls": t(spec.vocab_size, spec.dim),
+        **layers,
+    }
+
+
+@pytest.mark.parametrize("ftype", [FloatType.F32, FloatType.F16, FloatType.Q40])
+def test_write_read_roundtrip(tmp_path, ftype):
+    spec = TransformerSpec(**{**TINY.__dict__, "weights_float_type": ftype})
+    tensors = _synth_tensors(spec)
+    path = str(tmp_path / "model.bin")
+    write_model(path, spec, tensors)
+
+    spec2 = read_spec(path, ftype)
+    assert spec2.dim == spec.dim and spec2.hidden_dim == spec.hidden_dim
+    assert spec2.kv_dim == spec.dim * spec.n_kv_heads // spec.n_heads
+
+    _, params = load_model(path, spec2)
+    np.testing.assert_array_equal(params["tok_embedding"],
+                                  tensors["tok_embedding"])
+    np.testing.assert_array_equal(params["rms_final"], tensors["rms_final"])
+
+    wq = params["wq"]
+    if ftype == FloatType.F32:
+        np.testing.assert_array_equal(wq, tensors["wq"])
+    elif ftype == FloatType.F16:
+        np.testing.assert_array_equal(
+            wq, tensors["wq"].astype(np.float16))
+    else:
+        assert isinstance(wq, Q40Weight)
+        assert wq.qs.shape == (spec.n_layers, spec.dim, spec.dim // 32, 16)
+        deq = dequantize_q40(wq.qs, wq.d16)
+        # Q40 is lossy: delta = amax/8, error <= ~delta/2 (+ f16 rounding)
+        amax = np.abs(tensors["wq"]).reshape(
+            spec.n_layers, spec.dim, -1, 32).max(axis=-1)
+        # the +8.5/clamp-15 code map clamps the -amax extreme to code 15,
+        # losing up to a full delta there (delta = amax/8)
+        tol = (amax / 8 * 1.02 + 1e-3)[..., None]
+        err = np.abs(deq.reshape(spec.n_layers, spec.dim, -1, 32)
+                     - tensors["wq"].reshape(spec.n_layers, spec.dim, -1, 32))
+        assert np.all(err <= tol)
+
+
+def test_file_size_accounting(tmp_path):
+    """Byte-exact size math vs the known 7B test constants from the reference
+    integration test (transformer-tasks-test.cpp:544-548): blockBytes must be
+    809533440 for the 1-layer 7B F32 shape."""
+    spec7b = TransformerSpec(dim=4096, hidden_dim=11008, n_layers=1, n_heads=32,
+                             n_kv_heads=32, vocab_size=32000, seq_len=2048)
+    assert spec7b.block_bytes() == 809533440
+    assert spec7b.vocab_size * spec7b.dim * 4 == 524288000  # beforeBlockBytes
+    after = spec7b.dim * 4 + spec7b.rope_gap_bytes + spec7b.matmul_bytes(
+        (spec7b.vocab_size, spec7b.dim))
+    assert after == 525352960  # afterBlockBytes
+    assert spec7b.file_size() == 524288000 + 809533440 + 525352960 + 28
+
+
+def test_truncated_file_rejected(tmp_path):
+    spec = TINY
+    tensors = _synth_tensors(spec)
+    path = str(tmp_path / "model.bin")
+    write_model(path, spec, tensors)
+    with open(path, "r+b") as f:
+        f.truncate(spec.file_size() - 100)
+    with pytest.raises(ValueError, match="size mismatch"):
+        load_model(path, spec)
+
+
+def test_xorshift_stream_vectorized_matches_scalar():
+    a = Xorshift64(800000010)
+    b = Xorshift64(800000010)
+    xs = a.f32_array(1000)
+    ys = np.array([b.f32() for _ in range(1000)], dtype=np.float32)
+    np.testing.assert_array_equal(xs, ys)

@@ -1,0 +1,116 @@
+"""Quantization codec tests.
+
+Mirrors the reference's quants-test.cpp: seeded xorshift input (seed 800000010),
+Q80 round-trip tolerance 0.0043 per element across lengths {1024, 768, 2752}
+(reference src/quants-test.cpp:7-51), plus Q40 round-trip, wire-format
+pack/unpack identity, and an independent struct-level cross-check of the Q40
+encoder against the converter algorithm.
+"""
+
+import struct
+
+import numpy as np
+import pytest
+
+from distributed_llama_tpu.ops import quants as q
+from distributed_llama_tpu.utils.rng import Xorshift64
+
+LENGTHS = [1024, 768, 2752]
+
+
+def _seeded(n, seed=800000010):
+    return Xorshift64(seed).f32_array(n)
+
+
+@pytest.mark.parametrize("n", LENGTHS)
+def test_q80_roundtrip_tolerance(n):
+    x = _seeded(n)
+    qs, d = q.quantize_q80(x)
+    y = q.dequantize_q80(qs, d)
+    assert np.max(np.abs(x - y)) <= 0.0043  # reference quants-test.cpp:26
+
+
+@pytest.mark.parametrize("n", LENGTHS)
+def test_q40_roundtrip_tolerance(n):
+    x = _seeded(n) - 0.5  # exercise signed values
+    qs, d = q.quantize_q40(x)
+    y = q.dequantize_q40(qs, d)
+    # worst case: delta = amax/8 <= 0.0625; the clamp-15 end loses a full delta
+    assert np.max(np.abs(x - y)) <= 0.5 / 8 * 1.02 + 1e-3
+
+
+def test_q40_wire_roundtrip():
+    x = _seeded(64 * 32).reshape(64, 32 * 1) - 0.25
+    x = x.reshape(8, 256)
+    qs, d = q.quantize_q40(x)
+    buf = q.pack_q40_bytes(qs, d)
+    assert len(buf) == q.batch_bytes(q.FloatType.Q40, 256, 8)
+    qs2, d2 = q.unpack_q40_bytes(buf, (8, 256))
+    assert np.array_equal(qs, qs2)
+    assert np.array_equal(d.view(np.uint16), d2.view(np.uint16))
+
+
+def test_q80_wire_roundtrip():
+    x = _seeded(4 * 320).reshape(4, 320) - 0.5
+    qs, d = q.quantize_q80(x)
+    buf = q.pack_q80_bytes(qs, d)
+    assert len(buf) == q.batch_bytes(q.FloatType.Q80, 320, 4)
+    qs2, d2 = q.unpack_q80_bytes(buf, (4, 320))
+    assert np.array_equal(qs, qs2)
+    assert np.array_equal(d.view(np.uint16), d2.view(np.uint16))
+
+
+def test_q40_encoder_matches_scalar_algorithm():
+    """Cross-check vectorized encoder vs a direct scalar transcription of the
+    converter algorithm (converter.py:13-43 semantics, written independently)."""
+    x = (_seeded(3 * 32) - 0.5).astype(np.float32)
+    qs, d16 = q.quantize_q40(x)
+    groups = x.reshape(-1, 32)
+    out = b""
+    for g in groups:
+        gmax, gmin = g.max(), g.min()
+        delta = np.float32((gmin if -gmin > gmax else gmax) / np.float32(-8.0))
+        d = np.float16(delta)
+        inv = np.float32(0.0) if delta == 0 else np.float32(1.0) / delta
+        codes = [min(int(v * inv + np.float32(8.5)), 15) for v in g]
+        packed = bytes((codes[i] & 0xF) | ((codes[i + 16] & 0xF) << 4)
+                       for i in range(16))
+        out += struct.pack("<e", d) + packed
+    assert q.pack_q40_bytes(qs, d16) == out
+
+
+def test_q40_decode_value_map():
+    """Nibble j low -> value j, high -> value j+16; (code-8)*delta."""
+    d16 = np.array([[np.float16(2.0)]], dtype=np.float16)  # (1 row, 1 block)
+    qs = np.zeros((1, 1, 16), dtype=np.uint8)
+    qs[0, 0, 0] = 0x0F | (0x00 << 4)  # value0 code=15, value16 code=0
+    y = q.dequantize_q40(qs, d16)
+    assert y.shape == (1, 32)
+    assert y[0, 0] == (15 - 8) * 2.0
+    assert y[0, 16] == (0 - 8) * 2.0
+    assert y[0, 1] == (0 - 8) * 2.0
+
+
+def test_batch_bytes_parity():
+    # sizes from the reference's getBatchBytes for known models
+    assert q.batch_bytes(q.FloatType.F32, 4096, 4096) == 4096 * 4096 * 4
+    assert q.batch_bytes(q.FloatType.Q40, 4096, 4096) == 4096 * 4096 // 32 * 18
+    assert q.batch_bytes(q.FloatType.Q80, 4096) == 4096 // 32 * 34
+
+
+def test_jax_codecs_match_numpy():
+    import jax.numpy as jnp
+
+    x = (_seeded(2 * 128).reshape(2, 128) - 0.5).astype(np.float32)
+    qs, d = q.quantize_q80(x)
+    qsj, dj = q.quantize_q80_jax(jnp.asarray(x))
+    assert np.array_equal(np.asarray(qsj), qs)
+    assert np.array_equal(np.asarray(dj).view(np.uint16), d.view(np.uint16))
+    y = q.dequantize_q80(qs, d)
+    yj = q.dequantize_q80_jax(jnp.asarray(qs), jnp.asarray(d))
+    np.testing.assert_allclose(np.asarray(yj), y, rtol=0, atol=0)
+
+    qs4, d4 = q.quantize_q40(x)
+    y4 = q.dequantize_q40(qs4, d4)
+    y4j = q.dequantize_q40_jax(jnp.asarray(qs4), jnp.asarray(d4))
+    np.testing.assert_allclose(np.asarray(y4j), y4, rtol=0, atol=0)
