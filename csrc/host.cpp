@@ -1,0 +1,166 @@
+// Native host runtime for distributed_llama_tpu.
+//
+// The reference implements its entire host layer in C++ (loader, quant codecs,
+// RNG, tokenizer — src/utils.cpp, src/quants.cpp, src/tokenizer.cpp). This
+// library is our native equivalent for the host-side hot paths: the TPU compute
+// path is XLA/Pallas, but bulk byte-wrangling (streaming GB-scale weight files,
+// quant pack/unpack, seeded stream generation) runs here, exposed to Python via
+// ctypes (see distributed_llama_tpu/utils/native.py).
+//
+// Build: make -C csrc   (g++ -O3 -march=native -shared -fPIC)
+
+#include <cstdint>
+#include <cstring>
+#include <cmath>
+
+extern "C" {
+
+// xorshift64* stream (reference src/utils.cpp:27-38 semantics): fills out[n]
+// with (float)( ((u32 >> 8) / 2^24) / divisor ), the division done in double
+// like the reference test's `randomF32(&state) / 120.0` idiom. Returns the
+// advanced state.
+uint64_t xorshift_fill_f32(uint64_t state, float* out, int64_t n, double divisor) {
+    for (int64_t i = 0; i < n; i++) {
+        state ^= state >> 12;
+        state ^= state << 25;
+        state ^= state >> 27;
+        uint32_t u = (uint32_t)((state * 0x2545F4914F6CDD1Dull) >> 32);
+        float f = (float)(u >> 8) / 16777216.0f;
+        out[i] = (float)((double)f / divisor);
+    }
+    return state;
+}
+
+// ---- f16 <-> f32 (IEEE, round-to-nearest-even on encode) -------------------
+
+static inline float f16_to_f32(uint16_t h) {
+    uint32_t s = (uint32_t)(h & 0x8000) << 16;
+    uint32_t e = (h >> 10) & 0x1F;
+    uint32_t m = h & 0x3FF;
+    uint32_t bits;
+    if (e == 0) {
+        if (m == 0) {
+            bits = s;
+        } else {  // subnormal
+            int shift = 0;
+            while (!(m & 0x400)) { m <<= 1; shift++; }
+            m &= 0x3FF;
+            bits = s | ((127 - 15 - shift) << 23) | (m << 13);
+        }
+    } else if (e == 31) {
+        bits = s | 0x7F800000 | (m << 13);
+    } else {
+        bits = s | ((e - 15 + 127) << 23) | (m << 13);
+    }
+    float f;
+    std::memcpy(&f, &bits, 4);
+    return f;
+}
+
+static inline uint16_t f32_to_f16(float f) {
+    uint32_t x;
+    std::memcpy(&x, &f, 4);
+    uint32_t s = (x >> 16) & 0x8000;
+    int32_t e = ((x >> 23) & 0xFF) - 127 + 15;
+    uint32_t m = x & 0x7FFFFF;
+    if (((x >> 23) & 0xFF) == 0xFF) return (uint16_t)(s | 0x7C00 | (m ? 0x200 : 0));
+    if (e >= 31) return (uint16_t)(s | 0x7C00);  // overflow -> inf
+    if (e <= 0) {  // subnormal or zero
+        if (e < -10) return (uint16_t)s;
+        m |= 0x800000;
+        uint32_t shift = 14 - e;
+        uint32_t half = m >> shift;
+        uint32_t rem = m & ((1u << shift) - 1);
+        uint32_t halfway = 1u << (shift - 1);
+        if (rem > halfway || (rem == halfway && (half & 1))) half++;
+        return (uint16_t)(s | half);
+    }
+    uint32_t half = m >> 13;
+    uint32_t rem = m & 0x1FFF;
+    if (rem > 0x1000 || (rem == 0x1000 && (half & 1))) {
+        half++;
+        if (half == 0x400) { half = 0; e++; if (e >= 31) return (uint16_t)(s | 0x7C00); }
+    }
+    return (uint16_t)(s | (e << 10) | half);
+}
+
+// ---- Q40 codec (wire layout: f16 delta || 16 nibble bytes per 32 values) ---
+
+// Decode nb blocks of wire-format Q40 into f32 (reference quants.cpp:133-180
+// value map: (nibble - 8) * delta; low nibbles are values 0..15, high 16..31).
+void q40_decode(const uint8_t* in, float* out, int64_t nb) {
+    for (int64_t b = 0; b < nb; b++) {
+        const uint8_t* blk = in + b * 18;
+        uint16_t d16;
+        std::memcpy(&d16, blk, 2);
+        float d = f16_to_f32(d16);
+        float* y = out + b * 32;
+        for (int j = 0; j < 16; j++) {
+            uint8_t q = blk[2 + j];
+            y[j] = (float)((int)(q & 0x0F) - 8) * d;
+            y[j + 16] = (float)((int)(q >> 4) - 8) * d;
+        }
+    }
+}
+
+// Encode f32 -> wire Q40, converter.py:13-43 semantics (delta from signed
+// max-magnitude / -8, reciprocal of the unrounded f32 delta, +8.5 offset,
+// clamp 15, truncate).
+void q40_encode(const float* in, uint8_t* out, int64_t nb) {
+    for (int64_t b = 0; b < nb; b++) {
+        const float* x = in + b * 32;
+        float gmax = x[0], gmin = x[0];
+        for (int j = 1; j < 32; j++) {
+            if (x[j] > gmax) gmax = x[j];
+            if (x[j] < gmin) gmin = x[j];
+        }
+        float delta = (-gmin > gmax ? gmin : gmax) / -8.0f;
+        float id = delta != 0.0f ? 1.0f / delta : 0.0f;
+        uint8_t* blk = out + b * 18;
+        uint16_t d16 = f32_to_f16(delta);
+        std::memcpy(blk, &d16, 2);
+        int codes[32];
+        for (int j = 0; j < 32; j++) {
+            float q = x[j] * id + 8.5f;
+            if (!(q < 15.0f)) q = 15.0f;  // NaN clamps to 15, like np.where
+            codes[j] = (int)q;
+        }
+        for (int j = 0; j < 16; j++)
+            blk[2 + j] = (uint8_t)((codes[j] & 0xF) | ((codes[j + 16] & 0xF) << 4));
+    }
+}
+
+// ---- Q80 codec (f16 delta || 32 int8 per 32 values) ------------------------
+
+void q80_decode(const uint8_t* in, float* out, int64_t nb) {
+    for (int64_t b = 0; b < nb; b++) {
+        const uint8_t* blk = in + b * 34;
+        uint16_t d16;
+        std::memcpy(&d16, blk, 2);
+        float d = f16_to_f32(d16);
+        const int8_t* qs = (const int8_t*)(blk + 2);
+        float* y = out + b * 32;
+        for (int j = 0; j < 32; j++) y[j] = (float)qs[j] * d;
+    }
+}
+
+void q80_encode(const float* in, uint8_t* out, int64_t nb) {
+    for (int64_t b = 0; b < nb; b++) {
+        const float* x = in + b * 32;
+        float amax = 0.0f;
+        for (int j = 0; j < 32; j++) {
+            float v = std::fabs(x[j]);
+            if (v > amax) amax = v;
+        }
+        float d = amax / 127.0f;
+        float id = d != 0.0f ? 1.0f / d : 0.0f;
+        uint8_t* blk = out + b * 34;
+        uint16_t d16 = f32_to_f16(d);
+        std::memcpy(blk, &d16, 2);
+        int8_t* qs = (int8_t*)(blk + 2);
+        for (int j = 0; j < 32; j++)
+            qs[j] = (int8_t)std::nearbyintf(x[j] * id);  // ties-to-even, NEON parity
+    }
+}
+
+}  // extern "C"

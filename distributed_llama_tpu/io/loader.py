@@ -99,22 +99,31 @@ def load_model(path: str, spec: TransformerSpec | None = None,
     params: dict = {}
     params["tok_embedding"] = w.f32((spec.vocab_size, spec.dim))
 
-    per_layer: dict[str, list] = {name: [] for name in
-                                  ("rms_att", "rms_ffn", "wq", "wk", "wv",
-                                   "wo", "w1", "w2", "w3")}
+    # preallocate the stacked arrays and stream each layer straight into its
+    # slot (avoids transiently holding list-of-layers + np.stack copies of
+    # multi-GB tensors)
     shapes = spec.layer_matmul_shapes()
-    for _ in range(spec.n_layers):
-        per_layer["rms_att"].append(w.f32((spec.dim,)))
-        per_layer["rms_ffn"].append(w.f32((spec.dim,)))
-        for name, shape in shapes:
-            per_layer[name].append(w.matmul(spec, shape))
-
-    for name, vals in per_layer.items():
-        if isinstance(vals[0], Q40Weight):
-            params[name] = Q40Weight(np.stack([v.qs for v in vals]),
-                                     np.stack([v.d16 for v in vals]))
+    L = spec.n_layers
+    ft = spec.weights_float_type
+    params["rms_att"] = np.empty((L, spec.dim), np.float32)
+    params["rms_ffn"] = np.empty((L, spec.dim), np.float32)
+    for name, (dd, nn) in shapes:
+        if ft == FloatType.Q40:
+            params[name] = Q40Weight(np.empty((L, dd, nn // 32, 16), np.uint8),
+                                     np.empty((L, dd, nn // 32), np.float16))
         else:
-            params[name] = np.stack(vals)
+            dtype = np.float32 if ft == FloatType.F32 else np.float16
+            params[name] = np.empty((L, dd, nn), dtype)
+    for layer in range(L):
+        params["rms_att"][layer] = w.f32((spec.dim,))
+        params["rms_ffn"][layer] = w.f32((spec.dim,))
+        for name, shape in shapes:
+            val = w.matmul(spec, shape)
+            if isinstance(val, Q40Weight):
+                params[name].qs[layer] = val.qs
+                params[name].d16[layer] = val.d16
+            else:
+                params[name][layer] = val
 
     params["rms_final"] = w.f32((spec.dim,))
     w.take(spec.rope_gap_bytes)  # legacy freq_cis region, skipped

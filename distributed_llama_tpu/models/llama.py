@@ -1,0 +1,179 @@
+"""Functional JAX Llama-2 forward pass (7B/13B/70B incl. GQA).
+
+The single-chip "program" that replaces the reference's 32-step root task table
+(src/transformer-tasks.cpp:485-518): one traced function, `lax.scan` over
+stacked layer weights, static shapes throughout. Numerics follow the parity
+contract in SURVEY.md §5:
+
+* RoPE: interleaved (i, i+1) pairs, freq = 10000^-( (i mod headSize)/headSize ),
+  q rotated over the full dim, k over kvDim (transformer-tasks.cpp:228-242).
+* Attention: score = q.k/sqrt(headSize); GQA maps query head h to kv head
+  h // kvMul (transformer-tasks.cpp:214,254,268). KV cache copies kvDim floats
+  (the reference's dim-float memcpy at transformer-tasks.cpp:224-225 is the
+  documented over-read bug; we implement the spec, not the bug).
+* SwiGLU: silu(w1 x) * (w3 x), silu(x) = x/(1+e^-x).
+* rmsnorm with eps=1e-5 added after the mean.
+* When buffer_float_type == Q80, matmul inputs pass through Q80
+  quantize->dequantize at the points the reference feeds quantized buffers to
+  its kernels (the quantize* tasks).
+
+The forward consumes T tokens at positions pos..pos+T-1 against a seq_len-sized
+KV cache — T=1 is single-token decode (the reference's only mode), T>1 is
+chunked prefill (a capability the reference lacks; it replays the decode path
+per prompt token, tokenizer.cpp:352-366).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.linear import fake_quant_q80, matmul, rmsnorm, silu
+from ..ops.quants import FloatType
+from .spec import TransformerSpec
+
+
+class KVCache(NamedTuple):
+    k: jax.Array  # (n_layers, seq_len, n_kv_heads, head_size) f32
+    v: jax.Array
+
+
+def init_cache(spec: TransformerSpec, dtype=jnp.float32) -> KVCache:
+    shape = (spec.n_layers, spec.seq_len, spec.n_kv_heads, spec.head_size)
+    return KVCache(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+
+
+def rope_rotate(x: jax.Array, positions: jax.Array, head_size: int) -> jax.Array:
+    """Interleaved-pair RoPE over the leading ``x.shape[-1]`` features.
+
+    x: (T, n), positions: (T,). Pair p = features (2p, 2p+1); the angle uses
+    head_dim = (2p) mod head_size, matching the reference's per-element loop.
+    """
+    n = x.shape[-1]
+    pairs = x.reshape(*x.shape[:-1], n // 2, 2)
+    i = jnp.arange(0, n, 2, dtype=jnp.float32)  # feature index of each pair
+    head_dim = jnp.mod(i, head_size)
+    freq = 1.0 / jnp.power(jnp.float32(10000.0), head_dim / head_size)
+    val = positions[:, None].astype(jnp.float32) * freq[None, :]  # (T, n/2)
+    fcr, fci = jnp.cos(val), jnp.sin(val)
+    v0, v1 = pairs[..., 0], pairs[..., 1]
+    return jnp.stack([v0 * fcr - v1 * fci, v0 * fci + v1 * fcr],
+                     axis=-1).reshape(x.shape)
+
+
+def _maybe_q80(spec: TransformerSpec, x: jax.Array) -> jax.Array:
+    if spec.buffer_float_type == FloatType.Q80:
+        return fake_quant_q80(x)
+    return x
+
+
+def attention(spec: TransformerSpec, q: jax.Array, k_cache: jax.Array,
+              v_cache: jax.Array, pos: jax.Array, t_len: int) -> jax.Array:
+    """Causal attention of t_len new queries against the full cache.
+
+    q: (T, n_heads, head_size); caches: (seq_len, n_kv_heads, head_size).
+    Returns (T, dim). Masking keeps static shapes: scores at key positions
+    beyond each query's absolute position get -inf before the softmax, which
+    reproduces the reference's 0..pos loop bounds exactly.
+    """
+    # grouped einsum against the unexpanded cache: query head h = g*kv_mul + m
+    # attends kv head g = h // kv_mul (transformer-tasks.cpp:214), with no
+    # materialized kv_mul-fold repeat of the cache
+    qg = q.reshape(t_len, spec.n_kv_heads, spec.kv_mul, spec.head_size)
+    scale = 1.0 / jnp.sqrt(jnp.float32(spec.head_size))
+    scores = jnp.einsum("tgmd,sgd->gmts", qg, k_cache,
+                        preferred_element_type=jnp.float32,
+                        precision=jax.lax.Precision.HIGHEST) * scale
+    q_pos = pos + jnp.arange(t_len)  # absolute position of each query row
+    s_pos = jnp.arange(spec.seq_len)
+    mask = s_pos[None, :] <= q_pos[:, None]  # (T, S)
+    scores = jnp.where(mask[None, None, :, :], scores, -jnp.inf)
+    att = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("gmts,sgd->tgmd", att, v_cache,
+                     preferred_element_type=jnp.float32,
+                     precision=jax.lax.Precision.HIGHEST)
+    return out.reshape(t_len, spec.dim)
+
+
+def _layer(spec: TransformerSpec, x: jax.Array, lw: dict[str, Any],
+           k_cache: jax.Array, v_cache: jax.Array, pos: jax.Array,
+           positions: jax.Array) -> tuple[jax.Array, jax.Array, jax.Array]:
+    t_len = x.shape[0]
+
+    # attention sub-block
+    xb = rmsnorm(x, lw["rms_att"])
+    xb = _maybe_q80(spec, xb)
+    q = matmul(lw["wq"], xb)                      # (T, dim)
+    k = matmul(lw["wk"], xb)                      # (T, kv_dim)
+    v = matmul(lw["wv"], xb)
+    q = rope_rotate(q, positions, spec.head_size)
+    k = rope_rotate(k, positions, spec.head_size)
+    k_cache = jax.lax.dynamic_update_slice(
+        k_cache, k.reshape(t_len, spec.n_kv_heads, spec.head_size),
+        (pos, 0, 0))
+    v_cache = jax.lax.dynamic_update_slice(
+        v_cache, v.reshape(t_len, spec.n_kv_heads, spec.head_size),
+        (pos, 0, 0))
+    xb = attention(spec, q.reshape(t_len, spec.n_heads, spec.head_size),
+                   k_cache, v_cache, pos, t_len)
+    xb = _maybe_q80(spec, xb)
+    x = x + matmul(lw["wo"], xb)
+
+    # ffn sub-block
+    xb = rmsnorm(x, lw["rms_ffn"])
+    xb = _maybe_q80(spec, xb)
+    hb = silu(matmul(lw["w1"], xb)) * matmul(lw["w3"], xb)
+    hb = _maybe_q80(spec, hb)
+    x = x + matmul(lw["w2"], hb)
+    return x, k_cache, v_cache
+
+
+LAYER_KEYS = ("rms_att", "rms_ffn", "wq", "wk", "wv", "wo", "w1", "w2", "w3")
+
+
+def forward(spec: TransformerSpec, params: dict[str, Any], cache: KVCache,
+            tokens: jax.Array, pos: jax.Array) -> tuple[jax.Array, KVCache]:
+    """Run T tokens (at absolute positions pos..pos+T-1) through the model.
+
+    Returns (logits (T, vocab) f32, updated cache). jit with spec static.
+    """
+    t_len = tokens.shape[0]
+    positions = pos + jnp.arange(t_len)
+    x = params["tok_embedding"][tokens].astype(jnp.float32)  # (T, dim)
+
+    layer_weights = {k: params[k] for k in LAYER_KEYS}
+
+    def scan_body(x, per_layer):
+        lw, k_cache, v_cache = per_layer
+        x, k_cache, v_cache = _layer(spec, x, lw, k_cache, v_cache, pos,
+                                     positions)
+        return x, (k_cache, v_cache)
+
+    x, (k_new, v_new) = jax.lax.scan(scan_body, x,
+                                     (layer_weights, cache.k, cache.v))
+
+    x = rmsnorm(x, params["rms_final"])
+    logits = matmul(params["wcls"], x)
+    return logits, KVCache(k_new, v_new)
+
+
+@functools.partial(jax.jit, static_argnums=0, donate_argnums=2)
+def decode_step(spec: TransformerSpec, params: dict[str, Any], cache: KVCache,
+                token: jax.Array, pos: jax.Array) -> tuple[jax.Array, KVCache]:
+    """Single-token step: the hot per-token function (T=1)."""
+    logits, cache = forward(spec, params, cache, token[None], pos)
+    return logits[0], cache
+
+
+def params_to_device(params: dict[str, Any], dtype=None) -> dict[str, Any]:
+    """Move a numpy param tree onto the default device as jax arrays."""
+    def conv(a):
+        x = jnp.asarray(a)
+        if dtype is not None and x.dtype in (jnp.float32, jnp.float16):
+            x = x.astype(dtype)
+        return x
+
+    return jax.tree_util.tree_map(conv, params)

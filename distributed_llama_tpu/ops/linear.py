@@ -1,0 +1,82 @@
+"""Matmul dispatch over weight dtypes + the norm/activation kernels.
+
+This is the XLA-side equivalent of reference src/funcs.cpp: the dtype-dispatched
+``matmul`` (funcs.cpp:269-299), ``rms``/``rmsnorm`` (funcs.cpp:43-90),
+``softmax`` (funcs.cpp:12-41) and SwiGLU glue (transformer-tasks.cpp:369-379).
+Kernels are written for XLA fusion (elementwise chains fuse into the matmuls);
+the Pallas fast path for Q40 weights lives in ops/pallas_q40.py and is picked
+by ``matmul`` when enabled.
+
+Semantics contract (BASELINE.md logit parity):
+* matmul: weight w of shape (d, n), out[i] = sum_j w[i,j] * x[..., j], f32
+  accumulation.
+* rms: 1/sqrt(sum(x^2)/size + 1e-5) — eps added AFTER the mean
+  (funcs.cpp:60-62).
+* rmsnorm(out, x, rms, w): out = x * rms * w.
+* silu(x) = x / (1 + e^-x).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..io.loader import Q40Weight
+from .quants import dequantize_q40_jax, dequantize_q80_jax, quantize_q80_jax
+
+RMS_EPS = 1e-5
+
+
+def rms_inv(x: jax.Array) -> jax.Array:
+    """The reference's ``rms()``: inverse RMS with eps added after the mean."""
+    ss = jnp.sum(x.astype(jnp.float32) ** 2, axis=-1, keepdims=True)
+    ss = ss / x.shape[-1] + RMS_EPS
+    return jax.lax.rsqrt(ss)
+
+
+def rmsnorm(x: jax.Array, weight: jax.Array) -> jax.Array:
+    return (x * rms_inv(x)) * weight
+
+
+def silu(x: jax.Array) -> jax.Array:
+    return x / (1.0 + jnp.exp(-x))
+
+
+def dequantize_weight(w) -> jax.Array:
+    """Materialize any weight representation as f32 (d, n)."""
+    if isinstance(w, Q40Weight):
+        return dequantize_q40_jax(w.qs, w.d16)
+    return jnp.asarray(w).astype(jnp.float32)
+
+
+def matmul(w, x: jax.Array, *, prefer_pallas: bool = False) -> jax.Array:
+    """out[..., d] = w(d, n) @ x[..., n] with f32 accumulation.
+
+    ``w`` may be a dense array (f32/f16/bf16) or a planar ``Q40Weight``. The
+    dense path lets XLA drive the MXU directly; the Q40 path either dequantizes
+    inline (XLA fuses the int4 unpack into the matmul epilogue-free) or calls
+    the Pallas fused-dequant kernel.
+    """
+    if isinstance(w, Q40Weight) and prefer_pallas:
+        from .pallas_q40 import q40_matmul  # lazy: only on TPU paths
+
+        return q40_matmul(w, x)
+    wf = dequantize_weight(w)
+    # HIGHEST: true f32 MXU accumulation — required for the 1e-5 logit-parity
+    # contract on TPU (default TPU precision is bf16-input). The quantized
+    # fast path (Pallas) has its own precision story.
+    return jnp.einsum("dn,...n->...d", wf, x.astype(jnp.float32),
+                      preferred_element_type=jnp.float32,
+                      precision=jax.lax.Precision.HIGHEST)
+
+
+def fake_quant_q80(x: jax.Array) -> jax.Array:
+    """Quantize->dequantize through Q80, used when buffer_float_type == Q80.
+
+    The reference quantizes activations at every sync point (and feeds the
+    quantized form to the matmuls even single-node: transformer-tasks.cpp
+    quantize* tasks run regardless of socket count). This reproduces the value
+    rounding of that path within the documented 0.0043 tolerance.
+    """
+    qs, d = quantize_q80_jax(x)
+    return dequantize_q80_jax(qs, d)

@@ -1,0 +1,79 @@
+"""Analytic per-token communication accounting.
+
+The reference's benchmark metric includes sent/received kB per token measured
+by atomic socket counters (src/socket.cpp:114-123, printed at
+tokenizer.cpp:381). On an ICI mesh the collectives are compiler-issued, so we
+account analytically — both for OUR all_gather scheme (what actually crosses
+ICI per chip) and for the REFERENCE's star topology (root-side S/R, which the
+README tables publish) so runs can print comparable numbers.
+
+Validated against the published tables (README.md:58-69) in
+tests/test_comm_stats.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from ..models.spec import TransformerSpec
+from ..ops.quants import FloatType, batch_bytes
+
+
+def _vb(ftype: FloatType, n: int) -> int:
+    """Wire bytes of an n-value vector in the buffer float type."""
+    return batch_bytes(ftype, n)
+
+
+@dataclasses.dataclass(frozen=True)
+class CommStats:
+    sent_bytes: int
+    recv_bytes: int
+
+    @property
+    def total_kib(self) -> float:
+        return (self.sent_bytes + self.recv_bytes) / 1024.0
+
+
+def ici_all_gather_bytes(spec: TransformerSpec, n_slices: int) -> CommStats:
+    """Per-chip bytes/token of our scheme: 4 all_gathers per layer + logits.
+
+    An S-way all_gather of a vector with per-shard size b moves (S-1)*b out of
+    and into every chip (ring: S-1 hops of one shard each).
+    """
+    if n_slices <= 1:
+        return CommStats(0, 0)
+    ft = spec.buffer_float_type
+    s = n_slices
+    per_layer = (
+        _vb(ft, spec.dim // s)      # att heads out
+        + _vb(ft, spec.dim // s)    # wo out
+        + _vb(ft, spec.hidden_dim // s)  # hb before w2
+        + _vb(ft, spec.dim // s)    # w2 out
+    )
+    total = spec.n_layers * per_layer + _vb(FloatType.F32,
+                                            spec.vocab_size // s)
+    moved = (s - 1) * total
+    return CommStats(moved, moved)
+
+
+def reference_star_bytes(spec: TransformerSpec, n_slices: int) -> CommStats:
+    """Root-side S/R bytes/token of the reference's socket scheme.
+
+    Per layer (transformer-tasks.cpp task table):
+      send: 3 unit-buffer broadcasts of dim to each worker (syncRmsAtt,
+            syncMultiheadAtt, syncRmfFfn) + the O(S^2) star all-gather of hb
+            (syncFfnB: each worker receives the S-1 slices it lacks).
+      recv: per worker slices of q,k,v (dim/S, kvDim/S, kvDim/S), wo out
+            (dim/S), hb (hidden/S), w2 out (dim/S).
+    """
+    if n_slices <= 1:
+        return CommStats(0, 0)
+    ft = spec.buffer_float_type
+    s = n_slices
+    w = s - 1  # workers
+    send_layer = (3 * w * _vb(ft, spec.dim)
+                  + w * (s - 1) * _vb(ft, spec.hidden_dim // s))
+    recv_layer = w * (_vb(ft, spec.dim // s) + 2 * _vb(ft, spec.kv_dim // s)
+                      + _vb(ft, spec.dim // s) + _vb(ft, spec.hidden_dim // s)
+                      + _vb(ft, spec.dim // s))
+    return CommStats(spec.n_layers * send_layer, spec.n_layers * recv_layer)

@@ -1,0 +1,36 @@
+"""Device mesh construction.
+
+The reference's topology is a star of 2^n socket-connected CPU nodes
+(src/socket.cpp), with the slice index as the only parallel axis. Here the
+parallel axes are named mesh dimensions over TPU chips:
+
+  dp — data parallel (batch; the reference has none, batch=1)
+  sp — sequence/context parallel (ring attention axis; reference has none)
+  tp — tensor parallel (the reference's 2^n slice axis, MatmulSlice semantics)
+
+A single-pod mesh lays tp innermost so its collectives ride ICI neighbors;
+multi-host meshes (jax.distributed) put dp outermost across DCN.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+AXES = ("dp", "sp", "tp")
+
+
+def make_mesh(tp: int | None = None, dp: int = 1, sp: int = 1,
+              devices=None) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if tp is None:
+        if n % (dp * sp) != 0:
+            raise ValueError(f"{n} devices not divisible by dp*sp={dp * sp}")
+        tp = n // (dp * sp)
+    need = dp * sp * tp
+    if need > n:
+        raise ValueError(f"mesh {dp}x{sp}x{tp} needs {need} devices, have {n}")
+    grid = np.array(devices[:need]).reshape(dp, sp, tp)
+    return Mesh(grid, AXES)

@@ -1,0 +1,207 @@
+"""Tensor-parallel forward: the reference's TP scheme as one shard_map program.
+
+Slicing layout = MatmulSlice (reference src/transformer.cpp:14-50): every one
+of the 7 per-layer matmuls is sharded along its OUTPUT dim into contiguous
+row bands, one band per tp-mesh coordinate. Because bands are contiguous and
+band size is a multiple of head_size, the q/k/v bands are whole (kv-)heads, so
+attention runs fully head-parallel with the KV cache sharded over kv heads —
+the idiomatic upgrade over the reference's root-only attention
+(transformer-tasks.cpp:206-278), with identical math.
+
+Collective map (ours ⇄ reference transformer-tasks.cpp):
+  all_gather(att out)   ⇄ quantizeMultiheadAtt+syncMultiheadAtt broadcast (:280-290)
+  all_gather(wo out)    ⇄ syncAtt gather + next broadcast      (:303-315)
+  all_gather(ffn hb)    ⇄ syncFfnA gather + syncFfnB star all-gather (:389-399,
+                           O(S^2) on the wire there; one ICI all_gather here)
+  all_gather(w2 out)    ⇄ syncFfn2 gather (:417-427)
+  all_gather(logits)    ⇄ (none: reference wcls is root-only, :474-483; we
+                           shard the vocab dim too)
+The reference's syncRmsAtt broadcast (:161) disappears: x is replicated, every
+device computes the (cheap) rmsnorm itself.
+
+With buffer_float_type == Q80 the tensor crossing each all_gather goes through
+the Q80 codec first — the wire-quantization the reference applies in its
+quantize*/sync* task pairs, reproduced exactly at the same cut points.
+
+Requirements: tp divides n_heads, n_kv_heads, hidden_dim, vocab_size (the
+reference's analogous constraint is `assert(d % nSlices == 0)`,
+transformer.cpp:15).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..io.loader import Q40Weight
+from ..models.llama import KVCache, rope_rotate
+from ..models.spec import TransformerSpec
+from ..ops.linear import fake_quant_q80, matmul, rmsnorm, silu
+from ..ops.quants import FloatType
+
+# params tree -> PartitionSpec for the stacked arrays (layer axis leading).
+# Output-dim sharding = axis 1 for per-layer matmuls, axis 0 for wcls.
+_MATMUL_SPECS = {
+    "wq": P(None, "tp", None), "wk": P(None, "tp", None),
+    "wv": P(None, "tp", None), "wo": P(None, "tp", None),
+    "w1": P(None, "tp", None), "w2": P(None, "tp", None),
+    "w3": P(None, "tp", None),
+    "wcls": P("tp", None),
+}
+_REPL_SPECS = {
+    "tok_embedding": P(), "rms_att": P(), "rms_ffn": P(), "rms_final": P(),
+}
+
+
+def param_specs(params: dict[str, Any]) -> dict[str, Any]:
+    specs: dict[str, Any] = {}
+    for name, val in params.items():
+        spec = _MATMUL_SPECS.get(name) or _REPL_SPECS.get(name)
+        if spec is None:
+            raise KeyError(f"unknown param {name}")
+        if isinstance(val, Q40Weight):
+            # qs (L, d, nb, 16) and d16 (L, d, nb) shard the same d axis
+            extra = len(val.qs.shape) - len(spec)
+            qs_spec = P(*spec, *([None] * extra))
+            d_spec = P(*spec, *([None] * (len(val.d16.shape) - len(spec))))
+            specs[name] = Q40Weight(qs_spec, d_spec)
+        else:
+            specs[name] = spec
+    return specs
+
+
+CACHE_SPEC = KVCache(P(None, None, "tp", None), P(None, None, "tp", None))
+
+
+def shard_params(params: dict[str, Any], mesh: Mesh) -> dict[str, Any]:
+    """device_put the param tree with MatmulSlice-equivalent shardings."""
+    specs = param_specs(params)
+    return jax.tree_util.tree_map(
+        lambda a, s: jax.device_put(jnp.asarray(a), NamedSharding(mesh, s)),
+        params, specs)
+
+
+def shard_cache(cache: KVCache, mesh: Mesh) -> KVCache:
+    return jax.tree_util.tree_map(
+        lambda a, s: jax.device_put(a, NamedSharding(mesh, s)),
+        cache, CACHE_SPEC)
+
+
+def _wire(spec: TransformerSpec, x: jax.Array) -> jax.Array:
+    """Quantize a tensor about to cross the tp 'wire' (all_gather input)."""
+    if spec.buffer_float_type == FloatType.Q80:
+        return fake_quant_q80(x)
+    return x
+
+
+def _gather(x: jax.Array) -> jax.Array:
+    """Concatenate the tp bands along the feature axis (device-order bands =
+    MatmulSlice's contiguous row bands)."""
+    return jax.lax.all_gather(x, "tp", axis=-1, tiled=True)
+
+
+def _local_layer(spec: TransformerSpec, n_slices: int, x, lw, k_cache, v_cache,
+                 pos, positions):
+    """Per-device layer body. x replicated (T, dim); lw holds local bands."""
+    t_len = x.shape[0]
+    heads_loc = spec.n_heads // n_slices
+    kv_heads_loc = spec.n_kv_heads // n_slices
+
+    xb = rmsnorm(x, lw["rms_att"])
+    xb = _wire(spec, xb)  # reference quantizes xb before qkv (quantizeRmsAtt)
+    q = matmul(lw["wq"], xb)                       # (T, dim/S)
+    k = matmul(lw["wk"], xb)                       # (T, kvDim/S)
+    v = matmul(lw["wv"], xb)
+    # contiguous-band slicing => local features start at a head boundary, and
+    # RoPE's angle depends only on (feature index mod head_size): local == global
+    q = rope_rotate(q, positions, spec.head_size)
+    k = rope_rotate(k, positions, spec.head_size)
+    k_cache = jax.lax.dynamic_update_slice(
+        k_cache, k.reshape(t_len, kv_heads_loc, spec.head_size), (pos, 0, 0))
+    v_cache = jax.lax.dynamic_update_slice(
+        v_cache, v.reshape(t_len, kv_heads_loc, spec.head_size), (pos, 0, 0))
+
+    # local-head attention (math of transformer-tasks.cpp:206-278 per head);
+    # contiguous bands keep the h -> h//kvMul mapping purely local, and the
+    # grouped einsum avoids materializing a kv_mul-fold cache repeat
+    qg = q.reshape(t_len, kv_heads_loc, spec.kv_mul, spec.head_size)
+    scale = 1.0 / jnp.sqrt(jnp.float32(spec.head_size))
+    scores = jnp.einsum("tgmd,sgd->gmts", qg, k_cache,
+                        preferred_element_type=jnp.float32,
+                        precision=jax.lax.Precision.HIGHEST) * scale
+    q_pos = pos + jnp.arange(t_len)
+    mask = jnp.arange(spec.seq_len)[None, :] <= q_pos[:, None]
+    scores = jnp.where(mask[None, None, :, :], scores, -jnp.inf)
+    att = jax.nn.softmax(scores, axis=-1)
+    ao = jnp.einsum("gmts,sgd->tgmd", att, v_cache,
+                    preferred_element_type=jnp.float32,
+                    precision=jax.lax.Precision.HIGHEST)
+    ao = ao.reshape(t_len, heads_loc * spec.head_size)
+
+    xb = _gather(_wire(spec, ao))                  # ⇄ syncMultiheadAtt
+    xb2 = matmul(lw["wo"], xb)                     # (T, dim/S)
+    x = x + _gather(_wire(spec, xb2))              # ⇄ syncAtt + residual
+
+    xb = rmsnorm(x, lw["rms_ffn"])
+    xb = _wire(spec, xb)                           # ⇄ quantizeRmfFfn
+    hb = silu(matmul(lw["w1"], xb)) * matmul(lw["w3"], xb)  # (T, hidden/S)
+    hb = _gather(_wire(spec, hb))                  # ⇄ syncFfnA+syncFfnB
+    xb2 = matmul(lw["w2"], hb)                     # (T, dim/S)
+    x = x + _gather(_wire(spec, xb2))              # ⇄ syncFfn2 + residual
+    return x, k_cache, v_cache
+
+
+LAYER_KEYS = ("rms_att", "rms_ffn", "wq", "wk", "wv", "wo", "w1", "w2", "w3")
+
+
+def make_sharded_forward(spec: TransformerSpec, mesh: Mesh):
+    """Build the jitted tensor-parallel forward for this mesh.
+
+    Returns fn(params, cache, tokens (T,), pos) -> (logits (T, vocab), cache).
+    Works for any tp size on the mesh, including tp=1 (then it reduces to the
+    single-chip program; parity across tp sizes is the stage-4 gate of
+    SURVEY.md §7).
+    """
+    n_slices = mesh.shape["tp"]
+    for req, name in ((spec.n_kv_heads, "n_kv_heads"),
+                      (spec.hidden_dim, "hidden_dim"),
+                      (spec.vocab_size, "vocab_size")):
+        if req % n_slices != 0:
+            raise ValueError(f"{name}={req} not divisible by tp={n_slices}")
+    if spec.buffer_float_type == FloatType.Q80:
+        for req, name in ((spec.dim, "dim"), (spec.hidden_dim, "hidden_dim")):
+            if (req // n_slices) % 32 != 0:
+                raise ValueError(
+                    f"Q80 buffer needs {name}/tp divisible by 32, got "
+                    f"{req}/{n_slices}")
+
+    def local_step(params, cache, tokens, pos):
+        t_len = tokens.shape[0]
+        positions = pos + jnp.arange(t_len)
+        x = params["tok_embedding"][tokens].astype(jnp.float32)
+
+        lw_tree = {k: params[k] for k in LAYER_KEYS}
+
+        def body(x, per_layer):
+            lw, k_c, v_c = per_layer
+            x, k_c, v_c = _local_layer(spec, n_slices, x, lw, k_c, v_c, pos,
+                                       positions)
+            return x, (k_c, v_c)
+
+        x, (k_new, v_new) = jax.lax.scan(body, x, (lw_tree, cache.k, cache.v))
+        x = rmsnorm(x, params["rms_final"])
+        logits = _gather(matmul(params["wcls"], x))  # vocab bands -> full
+        return logits, KVCache(k_new, v_new)
+
+    def wrap(params, cache, tokens, pos):
+        in_specs = (param_specs(params), CACHE_SPEC, P(), P())
+        out_specs = (P(), CACHE_SPEC)
+        fn = jax.shard_map(local_step, mesh=mesh, in_specs=in_specs,
+                           out_specs=out_specs, check_vma=False)
+        return fn(params, cache, tokens, pos)
+
+    return jax.jit(wrap, donate_argnums=1)

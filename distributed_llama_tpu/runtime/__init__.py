@@ -1,0 +1,2 @@
+from .sampling import Sampler  # noqa: F401
+from .generate import Engine, generate  # noqa: F401

@@ -1,0 +1,151 @@
+"""Generation loop + engine (reference generate(), tokenizer.cpp:321-394).
+
+Engine wraps the jitted forward (single-chip or tensor-parallel) behind the
+reference's `Inference::infer(token, pos) -> logits` shape
+(transformer-tasks.cpp:535-547), and the loop reproduces the reference's
+observable behavior: prompt tokens forced one at a time, sampling after the
+prompt, stop on BOS, per-token stats line and final averages.
+
+Stats: the reference splits per-token time into I (inference) and T (transfer)
+via task-type timing (utils.cpp:104-106) and counts socket bytes. Under XLA
+the collectives are fused into the step, so we report:
+  I = device step time (jitted forward, block_until_ready)
+  T = host-side time (logits transfer + sampling + loop overhead)
+  S/R = analytic per-token collective bytes (parallel/comm_stats.py)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable
+
+import numpy as np
+
+from ..io.tokenizer import BOS, Tokenizer
+from ..models.llama import KVCache, forward, init_cache
+from ..models.spec import TransformerSpec
+from ..parallel.comm_stats import CommStats, ici_all_gather_bytes
+from .sampling import Sampler
+
+
+class Engine:
+    """Owns params + cache + the jitted step; exposes infer(token, pos)."""
+
+    def __init__(self, spec: TransformerSpec, params: dict[str, Any],
+                 mesh=None):
+        import functools
+
+        import jax
+        import jax.numpy as jnp
+
+        self.spec = spec
+        self.jnp = jnp
+        self.mesh = mesh
+        if mesh is not None and mesh.shape["tp"] > 1:
+            from ..parallel import (make_sharded_forward, shard_cache,
+                                    shard_params)
+
+            self.n_slices = mesh.shape["tp"]
+            self.params = shard_params(params, mesh)
+            self.cache = shard_cache(init_cache(spec), mesh)
+            self._fwd = make_sharded_forward(spec, mesh)
+        else:
+            from ..models.llama import params_to_device
+
+            self.n_slices = 1
+            self.params = params_to_device(params)
+            self.cache = init_cache(spec)
+            self._fwd = jax.jit(
+                functools.partial(forward, spec), donate_argnums=1)
+
+    def infer(self, token: int, pos: int) -> np.ndarray:
+        """One decode step; returns f32 logits (vocab,). Blocks on device."""
+        tok = self.jnp.asarray([token], dtype=self.jnp.int32)
+        logits, self.cache = self._fwd(self.params, self.cache, tok,
+                                       self.jnp.int32(pos))
+        return np.asarray(logits[0])
+
+    def reset(self):
+        self.cache = init_cache(self.spec)
+        if self.n_slices > 1:
+            from ..parallel import shard_cache
+
+            self.cache = shard_cache(self.cache, self.mesh)
+
+    def comm_stats(self) -> CommStats:
+        return ici_all_gather_bytes(self.spec, self.n_slices)
+
+
+@dataclasses.dataclass
+class GenStats:
+    tokens: int = 0
+    total_ms: float = 0.0
+    infer_ms: float = 0.0
+    host_ms: float = 0.0
+
+    @property
+    def avg(self) -> tuple[float, float, float]:
+        n = max(self.tokens, 1)
+        return self.total_ms / n, self.infer_ms / n, self.host_ms / n
+
+
+def generate(engine: Engine, tokenizer: Tokenizer, sampler: Sampler,
+             prompt: str, steps: int,
+             emit: Callable[[str], None] | None = None,
+             quiet: bool = False) -> tuple[list[int], GenStats]:
+    """Reference generation loop (tokenizer.cpp:321-394).
+
+    Encodes the prompt with BOS (no EOS), forces prompt tokens, samples after,
+    stops early on BOS, prints the per-token stats line and final averages.
+    """
+    spec = engine.spec
+    steps = min(steps, spec.seq_len)
+    prompt_tokens = tokenizer.encode(prompt or "", bos=True, eos=False)
+    if not prompt_tokens:
+        raise ValueError("something is wrong, expected at least 1 prompt token")
+
+    comm = engine.comm_stats()
+    stats = GenStats()
+    out_tokens: list[int] = []
+    token = prompt_tokens[0]
+    pos = 0
+    while pos < steps:
+        t0 = time.perf_counter()
+        logits = engine.infer(token, pos)
+        t1 = time.perf_counter()
+
+        if pos + 1 < len(prompt_tokens):
+            next_token = prompt_tokens[pos + 1]
+        else:
+            next_token = sampler.sample(logits)
+        t2 = time.perf_counter()
+
+        gen_ms = (t2 - t0) * 1000
+        stats.tokens += 1
+        stats.total_ms += gen_ms
+        stats.infer_ms += (t1 - t0) * 1000
+        stats.host_ms += (t2 - t1) * 1000
+
+        pos += 1
+        if next_token == BOS:
+            break  # reference stops on BOS before decoding it (tokenizer.cpp:376)
+        out_tokens.append(next_token)
+        piece = tokenizer.decode_piece(token, next_token)
+        if emit is not None:
+            emit(piece.decode("utf-8", errors="replace"))
+        if not quiet:
+            print(f"🔶 G {gen_ms:7.2f} ms I {(t1 - t0) * 1000:7.2f} ms "
+                  f"T {(t2 - t1) * 1000:7.2f} ms "
+                  f"S {comm.sent_bytes / 1024:7.0f} kB "
+                  f"R {comm.recv_bytes / 1024:7.0f} kB "
+                  f"{piece.decode('utf-8', errors='replace')!r}")
+        token = next_token
+
+    if not quiet and stats.tokens:
+        g, i, t = stats.avg
+        print(f"Generated tokens:    {stats.tokens}")
+        print(f"Avg generation time: {g:.2f} ms")
+        print(f"Avg inference time:  {i:.2f} ms")
+        print(f"Avg transfer time:   {t:.2f} ms")
+    return out_tokens, stats

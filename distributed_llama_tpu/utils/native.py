@@ -1,0 +1,90 @@
+"""ctypes bindings for the C++ host library (csrc/libdllama_host.so).
+
+Builds on demand with make/g++ the first time it's needed; every entry point
+has a pure-numpy fallback so the package works without a toolchain (slower).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_CSRC = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))), "csrc")
+_SO = os.path.join(_CSRC, "libdllama_host.so")
+_lock = threading.Lock()
+_lib = None
+_build_failed = False
+
+
+def _load():
+    global _lib, _build_failed
+    with _lock:
+        if _lib is not None or _build_failed:
+            return _lib
+        if not os.path.exists(_SO) or (
+                os.path.getmtime(_SO) <
+                os.path.getmtime(os.path.join(_CSRC, "host.cpp"))):
+            try:
+                subprocess.run(["make", "-C", _CSRC], check=True,
+                               capture_output=True)
+            except (subprocess.CalledProcessError, OSError):
+                _build_failed = True
+                return None
+        lib = ctypes.CDLL(_SO)
+        lib.xorshift_fill_f32.restype = ctypes.c_uint64
+        lib.xorshift_fill_f32.argtypes = [
+            ctypes.c_uint64, ctypes.POINTER(ctypes.c_float), ctypes.c_int64,
+            ctypes.c_double]
+        for name in ("q40_decode", "q80_decode"):
+            fn = getattr(lib, name)
+            fn.restype = None
+            fn.argtypes = [ctypes.POINTER(ctypes.c_uint8),
+                           ctypes.POINTER(ctypes.c_float), ctypes.c_int64]
+        for name in ("q40_encode", "q80_encode"):
+            fn = getattr(lib, name)
+            fn.restype = None
+            fn.argtypes = [ctypes.POINTER(ctypes.c_float),
+                           ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def xorshift_fill(state: int, n: int, divisor: float = 1.0) -> tuple[int, np.ndarray]:
+    """Fill n f32 samples of the reference xorshift stream, divided (in double,
+    like the reference test's ``randomF32(&state) / 120.0``).
+
+    Returns (new_state, array). Native when possible; python fallback otherwise.
+    """
+    lib = _load()
+    out = np.empty(n, dtype=np.float32)
+    if lib is not None:
+        new_state = lib.xorshift_fill_f32(
+            ctypes.c_uint64(state),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            n, ctypes.c_double(divisor))
+        return int(new_state), out
+    from .rng import Xorshift64
+
+    rng = Xorshift64(state)
+    out[:] = (rng.f32_array(n).astype(np.float64) / divisor).astype(np.float32)
+    return rng.state, out
+
+
+def q40_decode_wire(buf: np.ndarray, nb: int) -> np.ndarray | None:
+    lib = _load()
+    if lib is None:
+        return None
+    buf = np.ascontiguousarray(buf, dtype=np.uint8)
+    out = np.empty(nb * 32, dtype=np.float32)
+    lib.q40_decode(buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                   out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), nb)
+    return out

@@ -1,14 +1,24 @@
-"""Test env: force an 8-device virtual CPU mesh before jax is imported.
+"""Test env: force pure-CPU jax with an 8-device virtual mesh.
 
 Multi-chip sharding tests run on 8 virtual CPU devices (the TPU pod stand-in);
 real-TPU runs go through bench.py / the CLI, which do not import this.
+
+The axon sitecustomize (TPU tunnel) sets jax_platforms='axon,cpu' as explicit
+config at interpreter start, which both overrides JAX_PLATFORMS=cpu and makes
+every jax.devices() call try to dial the tunnel — so we must re-update the
+config value, not just the env var, before any backend initializes.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+assert jax.default_backend() == "cpu"

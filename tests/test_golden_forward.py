@@ -1,0 +1,62 @@
+"""Golden single-block forward parity test.
+
+Port of the reference integration test (src/transformer-tasks-test.cpp): a
+7B-shaped 1-layer F32 model whose block weights and input x are drawn from
+xorshift seed 800000010 scaled by 1/120, run one block at pos=0, and compare x
+against the reference's hard-coded 4096-float expected output (extracted to
+tests/fixtures/golden_block_7b_f32.npy by tools/extract_golden_fixture.py).
+Tolerance 1e-5 per element, same as the reference (:582).
+
+The weight stream order is the .bin block layout the reference test fills:
+rmsAtt, rmsFfn, wq, wk, wv, wo, w1, w2, w3 (each row-major (d, n)), then x.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from distributed_llama_tpu.models.spec import TransformerSpec
+from distributed_llama_tpu.utils.native import xorshift_fill
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures",
+                       "golden_block_7b_f32.npy")
+
+SPEC = TransformerSpec(dim=4096, hidden_dim=11008, n_layers=1, n_heads=32,
+                       n_kv_heads=32, vocab_size=32000, seq_len=2048)
+
+
+@pytest.fixture(scope="module")
+def golden_setup():
+    state = 800000010
+    dim, hid = SPEC.dim, SPEC.hidden_dim
+    sizes = [("rms_att", (dim,)), ("rms_ffn", (dim,)),
+             ("wq", (dim, dim)), ("wk", (dim, dim)), ("wv", (dim, dim)),
+             ("wo", (dim, dim)), ("w1", (hid, dim)), ("w2", (dim, hid)),
+             ("w3", (hid, dim))]
+    lw = {}
+    for name, shape in sizes:
+        state, arr = xorshift_fill(state, int(np.prod(shape)), 120.0)
+        lw[name] = arr.reshape(shape)
+    state, x = xorshift_fill(state, dim, 120.0)
+    expected = np.load(FIXTURE)
+    return lw, x, expected
+
+
+def test_golden_block_forward(golden_setup):
+    import jax.numpy as jnp
+
+    from distributed_llama_tpu.models.llama import _layer
+
+    lw, x, expected = golden_setup
+    lwj = {k: jnp.asarray(v) for k, v in lw.items()}
+    k_cache = jnp.zeros((SPEC.seq_len, SPEC.n_kv_heads, SPEC.head_size),
+                        jnp.float32)
+    v_cache = jnp.zeros_like(k_cache)
+    out, _, _ = _layer(SPEC, jnp.asarray(x)[None, :], lwj, k_cache, v_cache,
+                       jnp.int32(0), jnp.arange(1, dtype=jnp.int32))
+    got = np.asarray(out[0])
+    err = np.abs(got - expected)
+    assert err.max() <= 1e-5, (
+        f"max err {err.max():.3e} at {err.argmax()}: "
+        f"{got[err.argmax()]!r} != {expected[err.argmax()]!r}")

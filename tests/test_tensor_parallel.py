@@ -1,0 +1,126 @@
+"""Tensor-parallel parity: nSlices ∈ {1,2,4,8} must match the single-device
+forward (the stage-4 gate of SURVEY.md §7; the reference could only validate
+this on 8 physical Raspberry Pis — on a device mesh it's a unit test)."""
+
+import numpy as np
+import pytest
+
+from distributed_llama_tpu.models.spec import TransformerSpec
+from distributed_llama_tpu.ops.quants import FloatType
+
+TINY = TransformerSpec(dim=64, hidden_dim=160, n_layers=2, n_heads=8,
+                       n_kv_heads=8, vocab_size=96, seq_len=16)
+# GQA variant: 8 q heads over 4 kv heads (kv_mul=2), shardable up to tp=4
+GQA = TransformerSpec(dim=64, hidden_dim=160, n_layers=2, n_heads=8,
+                      n_kv_heads=4, vocab_size=96, seq_len=16)
+
+
+def _params(spec, seed=11, scale=0.1):
+    rng = np.random.default_rng(seed)
+
+    def t(*shape):
+        return (rng.standard_normal(shape) * scale).astype(np.float32)
+
+    p = {"tok_embedding": t(spec.vocab_size, spec.dim),
+         "rms_final": 1 + t(spec.dim), "wcls": t(spec.vocab_size, spec.dim),
+         "rms_att": 1 + t(spec.n_layers, spec.dim),
+         "rms_ffn": 1 + t(spec.n_layers, spec.dim)}
+    for name, shape in spec.layer_matmul_shapes():
+        p[name] = t(spec.n_layers, *shape)
+    return p
+
+
+def _reference_logits(spec, p, tokens):
+    import jax.numpy as jnp
+
+    from distributed_llama_tpu.models.llama import forward, init_cache
+
+    pj = {k: jnp.asarray(v) for k, v in p.items()}
+    logits, _ = forward(spec, pj, init_cache(spec), jnp.asarray(tokens),
+                        jnp.int32(0))
+    return np.asarray(logits)
+
+
+@pytest.mark.parametrize("tp", [1, 2, 4, 8])
+def test_tp_parity(tp):
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_llama_tpu.models.llama import init_cache
+    from distributed_llama_tpu.parallel import (make_mesh, make_sharded_forward,
+                                                shard_cache, shard_params)
+
+    if len(jax.devices()) < tp:
+        pytest.skip("not enough devices")
+    spec = TINY
+    p = _params(spec)
+    tokens = np.array([1, 5, 9, 2], dtype=np.int32)
+    want = _reference_logits(spec, p, tokens)
+
+    mesh = make_mesh(tp=tp)
+    params = shard_params(p, mesh)
+    cache = shard_cache(init_cache(spec), mesh)
+    fwd = make_sharded_forward(spec, mesh)
+    got, cache2 = fwd(params, cache, jnp.asarray(tokens), jnp.int32(0))
+    np.testing.assert_allclose(np.asarray(got), want, rtol=0, atol=2e-5)
+
+    # decode continues from the prefilled cache
+    got2, _ = fwd(params, cache2, jnp.asarray([3], dtype=np.int32),
+                  jnp.int32(4))
+    assert np.isfinite(np.asarray(got2)).all()
+
+
+@pytest.mark.parametrize("tp", [2, 4])
+def test_tp_parity_gqa(tp):
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_llama_tpu.models.llama import init_cache
+    from distributed_llama_tpu.parallel import (make_mesh, make_sharded_forward,
+                                                shard_cache, shard_params)
+
+    spec = GQA
+    p = _params(spec, seed=23)
+    tokens = np.array([7, 3], dtype=np.int32)
+    want = _reference_logits(spec, p, tokens)
+
+    mesh = make_mesh(tp=tp)
+    fwd = make_sharded_forward(spec, mesh)
+    got, _ = fwd(shard_params(p, mesh), shard_cache(init_cache(spec), mesh),
+                 jnp.asarray(tokens), jnp.int32(0))
+    np.testing.assert_allclose(np.asarray(got), want, rtol=0, atol=2e-5)
+
+
+def test_tp_q80_buffer_wire_quantization():
+    """Q80 wire mode on tp=4 stays within quant tolerance of the f32 run."""
+    import jax.numpy as jnp
+
+    from distributed_llama_tpu.models.llama import init_cache
+    from distributed_llama_tpu.parallel import (make_mesh, make_sharded_forward,
+                                                shard_cache, shard_params)
+
+    base = TransformerSpec(dim=128, hidden_dim=256, n_layers=2, n_heads=4,
+                           n_kv_heads=4, vocab_size=96, seq_len=16)
+    spec80 = TransformerSpec(**{**base.__dict__,
+                                "buffer_float_type": FloatType.Q80})
+    p = _params(base)
+    tokens = np.array([4, 8], dtype=np.int32)
+    want = _reference_logits(base, p, tokens)
+
+    mesh = make_mesh(tp=4)
+    fwd = make_sharded_forward(spec80, mesh)
+    got, _ = fwd(shard_params(p, mesh),
+                 shard_cache(init_cache(spec80), mesh),
+                 jnp.asarray(tokens), jnp.int32(0))
+    diff = np.abs(np.asarray(got) - want).max()
+    assert 0 < diff < 0.15  # Q80 rounding compounds over layers/sync points
+
+
+def test_tp_rejects_indivisible():
+    from distributed_llama_tpu.parallel import make_mesh, make_sharded_forward
+
+    bad = TransformerSpec(dim=64, hidden_dim=150, n_layers=1, n_heads=8,
+                          n_kv_heads=8, vocab_size=96, seq_len=16)
+    mesh = make_mesh(tp=4)
+    with pytest.raises(ValueError, match="hidden_dim"):
+        make_sharded_forward(bad, mesh)

@@ -1,0 +1,34 @@
+"""Regenerate tests/fixtures/golden_block_7b_f32.npy.
+
+The fixture is the 4096-float expected output of the reference's golden
+single-block forward test (reference src/transformer-tasks-test.cpp:10-523,
+`expectedOutput`): x after one 7B-shaped F32 transformer block at pos=0, with
+weights and input drawn from xorshift seed 800000010 scaled by 1/120. SURVEY.md
+§4 designates this vector as the logit-parity baseline to port. This script
+extracts the numeric test DATA (not code) from the reference file.
+
+Usage: python tools/extract_golden_fixture.py
+"""
+
+import re
+
+import numpy as np
+
+SRC = "/root/reference/src/transformer-tasks-test.cpp"
+DST = "tests/fixtures/golden_block_7b_f32.npy"
+
+
+def main():
+    with open(SRC) as f:
+        text = f.read()
+    m = re.search(r"expectedOutput\[4096\] = \{(.*?)\};", text, re.S)
+    assert m, "expectedOutput array not found"
+    vals = [np.float32(v) for v in re.findall(r"[-0-9.e+]+", m.group(1))]
+    assert len(vals) == 4096, len(vals)
+    arr = np.array(vals, dtype=np.float32)
+    np.save(DST, arr)
+    print(f"wrote {DST}: {arr.shape} first={arr[0]!r} last={arr[-1]!r}")
+
+
+if __name__ == "__main__":
+    main()
