@@ -1,0 +1,120 @@
+"""Benchmark: Llama-2-7B-shaped Q40 single-token decode, reference protocol.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Protocol mirrors the reference benchmark (README.md:40-50): Q40 weights,
+single-token generation, 16 samples, average ms/token. Baseline for
+vs_baseline is the reference's BEST published Llama-2-7B figure: 494.00
+ms/token on 4x Raspberry Pi 4B (BASELINE.md; the single-device figure is
+1312.50). vs_baseline = baseline_ms / our_ms (higher = faster than reference).
+
+Weights are synthetic (timing is value-independent); the structure — Q40
+planar blocks resident in device memory, dequant-fused matmuls, scan over
+layers, static KV cache — is the real 7B decode program.
+
+Usage: python bench.py [--small] [--samples N] [--model PATH]
+  --small: tiny config for CI/CPU smoke runs.
+  --model: bench a real .bin instead of synthetic weights.
+"""
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def _bench(spec, params, samples: int, prefix: int = 4) -> float:
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_llama_tpu.models.llama import (forward, init_cache,
+                                                    params_to_device)
+
+    params = params_to_device(params)
+    cache = init_cache(spec)
+    step = jax.jit(functools.partial(forward, spec), donate_argnums=1)
+
+    tok = jnp.asarray([7], dtype=jnp.int32)
+    t_compile = time.perf_counter()
+    logits, cache = step(params, cache, tok, jnp.int32(0))
+    logits.block_until_ready()
+    print(f"compile+first step: {time.perf_counter() - t_compile:.1f}s",
+          file=sys.stderr)
+
+    pos = 1
+    for _ in range(prefix):  # warmup steps at growing pos
+        logits, cache = step(params, cache, tok, jnp.int32(pos))
+        pos += 1
+    logits.block_until_ready()
+
+    times = []
+    for _ in range(samples):
+        t0 = time.perf_counter()
+        logits, cache = step(params, cache, tok, jnp.int32(pos))
+        logits.block_until_ready()
+        times.append((time.perf_counter() - t0) * 1000)
+        pos += 1
+    ms = float(np.mean(times))
+    print(f"per-token ms: mean {ms:.2f}  min {min(times):.2f}  "
+          f"max {max(times):.2f}", file=sys.stderr)
+    return ms
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--small", action="store_true")
+    ap.add_argument("--samples", type=int, default=16)
+    ap.add_argument("--model", default=None,
+                    help="bench a real .bin (Q40) instead of synthetic weights")
+    args = ap.parse_args()
+
+    import jax
+
+    print(f"backend: {jax.devices()[0].platform} x{len(jax.devices())}",
+          file=sys.stderr)
+
+    from distributed_llama_tpu.models.spec import TransformerSpec
+    from distributed_llama_tpu.ops.quants import FloatType
+
+    if args.model:
+        from distributed_llama_tpu.io.loader import load_model
+
+        spec, params = load_model(args.model,
+                                  weights_float_type=FloatType.Q40)
+    else:
+        from __graft_entry__ import _synth_params
+
+        if args.small:
+            spec = TransformerSpec(dim=256, hidden_dim=704, n_layers=4,
+                                   n_heads=4, n_kv_heads=4, vocab_size=1024,
+                                   seq_len=256,
+                                   weights_float_type=FloatType.Q40)
+        else:
+            # Llama-2-7B shape (converter header values), Q40, seq 2048
+            spec = TransformerSpec(dim=4096, hidden_dim=11008, n_layers=32,
+                                   n_heads=32, n_kv_heads=32,
+                                   vocab_size=32000, seq_len=2048,
+                                   weights_float_type=FloatType.Q40)
+        t0 = time.perf_counter()
+        params = _synth_params(spec, q40=True)
+        print(f"synth weights: {time.perf_counter() - t0:.1f}s",
+              file=sys.stderr)
+
+    ms = _bench(spec, params, args.samples)
+    baseline = 494.00  # best published 7B figure (4x RasPi), BASELINE.md
+    result = {
+        "metric": "llama2-7b-q40 single-token decode"
+                  + (" (small)" if args.small else ""),
+        "value": round(ms, 3),
+        "unit": "ms/token",
+        "vs_baseline": round(baseline / ms, 2),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,160 @@
+"""CLI entrypoints: ``inference`` and ``worker`` modes (reference src/main.cpp).
+
+Flag surface parity (main.cpp:94-160): --model, --tokenizer, --prompt,
+--weights-float-type, --buffer-float-type, --workers, --port, --nthreads,
+--steps, --temperature, --topp; defaults port=9990, temperature=0.8, topp=0.9,
+steps=64 (nthreads is accepted for compatibility; XLA owns intra-chip
+threading).
+
+Role mapping on TPU: the reference's 2^n socket-connected worker processes
+become the chips of a tp mesh driven by ONE process — ``--tp N`` (default: all
+local devices). ``worker`` mode exists for multi-HOST meshes and follows JAX's
+multi-controller SPMD model (the DCN analog of the reference's socket star):
+every host executes the SAME program over the global mesh, so ``worker`` takes
+the same --model/--tokenizer/... flags as ``inference`` plus
+``--coordinator host:port --num-hosts H --host-id i``, joins via
+jax.distributed, runs the identical generation loop (identical --seed makes
+every host sample the same token chain), and suppresses output — only the
+root host (``inference`` with --host-id 0) prints. Unlike the reference,
+where workers receive their weight slices over the wire (transformer.cpp:
+354-380), each host reads its shards straight from the model file — the
+scatter is the sharded device_put.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+from ..ops.quants import FloatType
+
+_FT = {"f32": FloatType.F32, "f16": FloatType.F16, "q40": FloatType.Q40,
+       "q80": FloatType.Q80}
+
+
+def _add_common(ap: argparse.ArgumentParser) -> None:
+    ap.add_argument("--nthreads", type=int, default=4,
+                    help="accepted for reference-CLI compatibility; XLA "
+                         "manages device threading")
+    ap.add_argument("--coordinator", default=None,
+                    help="host:port of the jax.distributed coordinator "
+                         "(multi-host only)")
+    ap.add_argument("--num-hosts", type=int, default=1)
+    ap.add_argument("--host-id", type=int, default=None)
+
+
+def _maybe_distributed(args) -> None:
+    if args.coordinator:
+        import jax
+
+        jax.distributed.initialize(
+            coordinator_address=args.coordinator,
+            num_processes=args.num_hosts,
+            process_id=args.host_id if args.host_id is not None else 0)
+
+
+def cmd_inference(argv: list[str], quiet: bool = False) -> int:
+    ap = argparse.ArgumentParser(prog="dllama-tpu inference")
+    ap.add_argument("--model", required=True)
+    ap.add_argument("--tokenizer", required=True)
+    ap.add_argument("--prompt", default=None)
+    ap.add_argument("--weights-float-type", default="q40", choices=sorted(_FT))
+    ap.add_argument("--buffer-float-type", default="f32", choices=sorted(_FT))
+    ap.add_argument("--steps", type=int, default=64)
+    ap.add_argument("--temperature", type=float, default=0.8)
+    ap.add_argument("--topp", type=float, default=0.9)
+    ap.add_argument("--seed", type=int, default=None)
+    ap.add_argument("--tp", type=int, default=None,
+                    help="tensor-parallel ways (default: all local devices)")
+    ap.add_argument("--workers", nargs="*", default=None,
+                    help="accepted for reference-CLI compatibility; on TPU "
+                         "the workers are the chips of the mesh (see module "
+                         "docstring for multi-host)")
+    _add_common(ap)
+    args = ap.parse_args(argv)
+    _maybe_distributed(args)
+    if args.host_id:  # non-root hosts run silently in SPMD lockstep
+        quiet = True
+        if args.seed is None:
+            print("multi-host runs need an explicit --seed so every host "
+                  "samples the same chain", file=sys.stderr)
+            return 2
+
+    import jax
+
+    from ..io.loader import load_model
+    from ..io.tokenizer import Tokenizer
+    from ..parallel import make_mesh
+    from ..runtime.generate import Engine, generate
+    from ..runtime.sampling import Sampler
+
+    wft = _FT[args.weights_float_type]
+    bft = _FT[args.buffer_float_type]
+    t0 = time.time()
+    spec, params = load_model(args.model, weights_float_type=wft,
+                              buffer_float_type=bft)
+    print(f"💡 dim: {spec.dim}\n💡 hiddenDim: {spec.hidden_dim}\n"
+          f"💡 nLayers: {spec.n_layers}\n💡 nHeads: {spec.n_heads}\n"
+          f"💡 nKvHeads: {spec.n_kv_heads}\n💡 vocabSize: {spec.vocab_size}\n"
+          f"💡 seqLen: {spec.seq_len}")
+    n_dev = len(jax.devices())
+    tp = args.tp or n_dev
+    print(f"💡 nSlices: {tp} ({n_dev} devices, "
+          f"{jax.devices()[0].platform})")
+    mesh = make_mesh(tp=tp) if tp > 1 else None
+    engine = Engine(spec, params, mesh=mesh)
+    print(f"⏩ Loaded model in {time.time() - t0:.1f}s")
+
+    tokenizer = Tokenizer(args.tokenizer, spec.vocab_size)
+    seed = args.seed if args.seed is not None else int(time.time())
+    sampler = Sampler(spec.vocab_size, args.temperature, args.topp, seed)
+    # pieces print inside the per-token stats lines (reference behavior:
+    # tokenizer.cpp prints each piece once, at the end of the 🔶 line)
+    generate(engine, tokenizer, sampler, args.prompt or "", args.steps,
+             quiet=quiet)
+    return 0
+
+
+def cmd_worker(argv: list[str]) -> int:
+    """Multi-host worker = the same SPMD program as inference, silenced.
+
+    JAX's multi-controller model requires every process to execute the jitted
+    computations itself (there is no passive participant); ``worker`` exists
+    so launch scripts keep the reference's root/worker vocabulary.
+    """
+    if "--port" in argv:  # accepted for reference-CLI compatibility
+        i = argv.index("--port")
+        argv = argv[:i] + argv[i + 2:]
+    if "--coordinator" not in argv:
+        print("💡 On TPU, single-host workers are chips of the mesh — run "
+              "'inference --tp N' instead. For multi-host, pass the same "
+              "flags as inference plus --coordinator host:port "
+              "--num-hosts H --host-id I (I >= 1).", file=sys.stderr)
+        return 2
+    return cmd_inference(argv, quiet=True)
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] in ("-h", "--help"):
+        print("usage: dllama-tpu {inference|worker|convert} [options]\n"
+              f"{__doc__}")
+        return 0 if argv else 1
+    mode, rest = argv[0], argv[1:]
+    if mode == "inference":
+        return cmd_inference(rest)
+    if mode == "worker":
+        return cmd_worker(rest)
+    if mode == "convert":
+        from ..convert import main as convert_main
+
+        convert_main(rest)
+        return 0
+    print(f"unknown mode {mode!r} (expected inference|worker|convert)",
+          file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -70,32 +70,50 @@ def _maybe_q80(spec: TransformerSpec, x: jax.Array) -> jax.Array:
     return x
 
 
+def attention_core(head_size: int, kv_mul: int, q: jax.Array, k: jax.Array,
+                   v: jax.Array, mask: jax.Array) -> jax.Array:
+    """Grouped-GQA causal attention — THE attention math, shared by the
+    single-chip, sequence (training), and tensor-parallel paths.
+
+    q: (..., T, n_q, hs) reshaped to kv groups; k/v: (..., S, n_kv, hs);
+    mask: (T, S) True where key position is visible. Query head h = g*kv_mul+m
+    attends kv head g = h//kv_mul (transformer-tasks.cpp:214), via einsum
+    against the unexpanded cache (no materialized kv_mul-fold repeat).
+    Masking with -inf before the max-subtracted softmax reproduces the
+    reference's 0..pos loop bounds exactly. f32 accumulation at HIGHEST
+    precision (the logit-parity contract).
+    """
+    *lead, t_len, n_q, _ = q.shape
+    n_kv = k.shape[-2]
+    qg = q.reshape(*lead, t_len, n_kv, kv_mul, head_size)
+    scale = 1.0 / jnp.sqrt(jnp.float32(head_size))
+    scores = jnp.einsum("...tgmd,...sgd->...gmts", qg, k,
+                        preferred_element_type=jnp.float32,
+                        precision=jax.lax.Precision.HIGHEST) * scale
+    scores = jnp.where(mask[..., None, None, :, :], scores, -jnp.inf)
+    att = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("...gmts,...sgd->...tgmd", att, v,
+                     preferred_element_type=jnp.float32,
+                     precision=jax.lax.Precision.HIGHEST)
+    return out.reshape(*lead, t_len, n_q * head_size)
+
+
+def causal_cache_mask(seq_len: int, pos: jax.Array, t_len: int) -> jax.Array:
+    """(T, S) visibility of cache slots for queries at pos..pos+T-1."""
+    q_pos = pos + jnp.arange(t_len)
+    return jnp.arange(seq_len)[None, :] <= q_pos[:, None]
+
+
 def attention(spec: TransformerSpec, q: jax.Array, k_cache: jax.Array,
               v_cache: jax.Array, pos: jax.Array, t_len: int) -> jax.Array:
     """Causal attention of t_len new queries against the full cache.
 
     q: (T, n_heads, head_size); caches: (seq_len, n_kv_heads, head_size).
-    Returns (T, dim). Masking keeps static shapes: scores at key positions
-    beyond each query's absolute position get -inf before the softmax, which
-    reproduces the reference's 0..pos loop bounds exactly.
+    Returns (T, dim).
     """
-    # grouped einsum against the unexpanded cache: query head h = g*kv_mul + m
-    # attends kv head g = h // kv_mul (transformer-tasks.cpp:214), with no
-    # materialized kv_mul-fold repeat of the cache
-    qg = q.reshape(t_len, spec.n_kv_heads, spec.kv_mul, spec.head_size)
-    scale = 1.0 / jnp.sqrt(jnp.float32(spec.head_size))
-    scores = jnp.einsum("tgmd,sgd->gmts", qg, k_cache,
-                        preferred_element_type=jnp.float32,
-                        precision=jax.lax.Precision.HIGHEST) * scale
-    q_pos = pos + jnp.arange(t_len)  # absolute position of each query row
-    s_pos = jnp.arange(spec.seq_len)
-    mask = s_pos[None, :] <= q_pos[:, None]  # (T, S)
-    scores = jnp.where(mask[None, None, :, :], scores, -jnp.inf)
-    att = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("gmts,sgd->tgmd", att, v_cache,
-                     preferred_element_type=jnp.float32,
-                     precision=jax.lax.Precision.HIGHEST)
-    return out.reshape(t_len, spec.dim)
+    mask = causal_cache_mask(spec.seq_len, pos, t_len)
+    return attention_core(spec.head_size, spec.kv_mul, q, k_cache, v_cache,
+                          mask)
 
 
 def _layer(spec: TransformerSpec, x: jax.Array, lw: dict[str, Any],
@@ -158,6 +176,49 @@ def forward(spec: TransformerSpec, params: dict[str, Any], cache: KVCache,
     x = rmsnorm(x, params["rms_final"])
     logits = matmul(params["wcls"], x)
     return logits, KVCache(k_new, v_new)
+
+
+def forward_seq(spec: TransformerSpec, params: dict[str, Any],
+                tokens: jax.Array) -> jax.Array:
+    """Batched full-sequence forward without a KV cache: (B, T) -> (B, T, vocab).
+
+    The training/evaluation path (the reference is inference-only; training is
+    a capability extension). Causal attention inside the T window, same
+    numerics as the cached forward — shared attention_core, same precision,
+    same Q80 wire-quantization cut points.
+    """
+    B, T = tokens.shape
+    x = params["tok_embedding"][tokens].astype(jnp.float32)  # (B, T, D)
+    positions = jnp.arange(T)
+    mask = positions[None, :] <= positions[:, None]  # (T, T) causal
+
+    layer_weights = {k: params[k] for k in LAYER_KEYS}
+
+    def body(x, lw):
+        xb = rmsnorm(x, lw["rms_att"])
+        xb = _maybe_q80(spec, xb)
+        q = matmul(lw["wq"], xb)                    # (B, T, dim)
+        k = matmul(lw["wk"], xb)                    # (B, T, kv_dim)
+        v = matmul(lw["wv"], xb)
+        q = jax.vmap(lambda a: rope_rotate(a, positions, spec.head_size))(q)
+        k = jax.vmap(lambda a: rope_rotate(a, positions, spec.head_size))(k)
+        ao = attention_core(
+            spec.head_size, spec.kv_mul,
+            q.reshape(B, T, spec.n_heads, spec.head_size),
+            k.reshape(B, T, spec.n_kv_heads, spec.head_size),
+            v.reshape(B, T, spec.n_kv_heads, spec.head_size), mask)
+        ao = _maybe_q80(spec, ao)
+        x = x + matmul(lw["wo"], ao)
+        xb = rmsnorm(x, lw["rms_ffn"])
+        xb = _maybe_q80(spec, xb)
+        hb = silu(matmul(lw["w1"], xb)) * matmul(lw["w3"], xb)
+        hb = _maybe_q80(spec, hb)
+        x = x + matmul(lw["w2"], hb)
+        return x, None
+
+    x, _ = jax.lax.scan(body, x, layer_weights)
+    x = rmsnorm(x, params["rms_final"])
+    return matmul(params["wcls"], x)
 
 
 @functools.partial(jax.jit, static_argnums=0, donate_argnums=2)

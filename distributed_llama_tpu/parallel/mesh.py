@@ -32,5 +32,10 @@ def make_mesh(tp: int | None = None, dp: int = 1, sp: int = 1,
     need = dp * sp * tp
     if need > n:
         raise ValueError(f"mesh {dp}x{sp}x{tp} needs {need} devices, have {n}")
+    if need < n:
+        import warnings
+
+        warnings.warn(f"mesh {dp}x{sp}x{tp} uses {need} of {n} devices; "
+                      f"{n - need} devices idle", stacklevel=2)
     grid = np.array(devices[:need]).reshape(dp, sp, tp)
     return Mesh(grid, AXES)

@@ -38,7 +38,8 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..io.loader import Q40Weight
-from ..models.llama import KVCache, rope_rotate
+from ..models.llama import (KVCache, attention_core, causal_cache_mask,
+                            rope_rotate)
 from ..models.spec import TransformerSpec
 from ..ops.linear import fake_quant_q80, matmul, rmsnorm, silu
 from ..ops.quants import FloatType
@@ -126,21 +127,11 @@ def _local_layer(spec: TransformerSpec, n_slices: int, x, lw, k_cache, v_cache,
         v_cache, v.reshape(t_len, kv_heads_loc, spec.head_size), (pos, 0, 0))
 
     # local-head attention (math of transformer-tasks.cpp:206-278 per head);
-    # contiguous bands keep the h -> h//kvMul mapping purely local, and the
-    # grouped einsum avoids materializing a kv_mul-fold cache repeat
-    qg = q.reshape(t_len, kv_heads_loc, spec.kv_mul, spec.head_size)
-    scale = 1.0 / jnp.sqrt(jnp.float32(spec.head_size))
-    scores = jnp.einsum("tgmd,sgd->gmts", qg, k_cache,
-                        preferred_element_type=jnp.float32,
-                        precision=jax.lax.Precision.HIGHEST) * scale
-    q_pos = pos + jnp.arange(t_len)
-    mask = jnp.arange(spec.seq_len)[None, :] <= q_pos[:, None]
-    scores = jnp.where(mask[None, None, :, :], scores, -jnp.inf)
-    att = jax.nn.softmax(scores, axis=-1)
-    ao = jnp.einsum("gmts,sgd->tgmd", att, v_cache,
-                    preferred_element_type=jnp.float32,
-                    precision=jax.lax.Precision.HIGHEST)
-    ao = ao.reshape(t_len, heads_loc * spec.head_size)
+    # contiguous bands keep the h -> h//kvMul mapping purely local
+    ao = attention_core(
+        spec.head_size, spec.kv_mul,
+        q.reshape(t_len, heads_loc, spec.head_size), k_cache, v_cache,
+        causal_cache_mask(spec.seq_len, pos, t_len))
 
     xb = _gather(_wire(spec, ao))                  # ⇄ syncMultiheadAtt
     xb2 = matmul(lw["wo"], xb)                     # (T, dim/S)

@@ -1,0 +1,73 @@
+"""Distributed training step over a dp x tp mesh (capability extension).
+
+The reference is inference-only (README.md:21); this module extends the
+framework with next-token cross-entropy training using the same weight layout
+and sharding scheme as inference: parameters tp-sharded exactly like the
+MatmulSlice bands (parallel/tp.py), batch dp-sharded, XLA inserting the
+collectives (psum of grads over dp, all_gathers over tp) from the sharding
+annotations — the pjit/GSPMD idiom rather than hand-written collectives.
+
+Pipeline (pp) and expert (ep) axes are intentionally absent: the Llama dense
+stack has no experts, and the reference's design rejects layer-pipelining
+(report.tex:31-39); sequence parallelism lives in parallel/ring.py.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.llama import forward_seq
+from ..models.spec import TransformerSpec
+from .tp import param_specs
+
+
+def _sharding_tree(params: dict[str, Any], mesh: Mesh):
+    return jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), param_specs(params),
+        is_leaf=lambda x: isinstance(x, P))
+
+
+def make_train_step(spec: TransformerSpec, mesh: Mesh,
+                    optimizer: optax.GradientTransformation | None = None,
+                    learning_rate: float = 1e-4):
+    """Build (init_fn, step_fn) for sharded training.
+
+    init_fn(params) -> (sharded_params, opt_state)
+    step_fn(params, opt_state, tokens (B, T+1)) -> (params, opt_state, loss)
+
+    tokens are dp-sharded along batch; loss is the mean next-token CE.
+    """
+    optimizer = optimizer or optax.adamw(learning_rate)
+
+    def loss_fn(params, tokens):
+        inputs, targets = tokens[:, :-1], tokens[:, 1:]
+        logits = forward_seq(spec, params, inputs)
+        ce = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
+        return ce.mean()
+
+    def step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    def init_fn(params):
+        shardings = _sharding_tree(params, mesh)
+        params = jax.tree_util.tree_map(
+            lambda a, s: jax.device_put(jnp.asarray(a), s), params, shardings)
+        # jit so optimizer state inherits the params' shardings via GSPMD
+        opt_state = jax.jit(optimizer.init)(params)
+        return params, opt_state
+
+    batch_sharding = NamedSharding(mesh, P("dp", None))
+
+    def wrapped_step(params, opt_state, tokens):
+        tokens = jax.lax.with_sharding_constraint(tokens, batch_sharding)
+        return step(params, opt_state, tokens)
+
+    return init_fn, jax.jit(wrapped_step, donate_argnums=(0, 1))

@@ -26,32 +26,37 @@ def _load():
     with _lock:
         if _lib is not None or _build_failed:
             return _lib
-        if not os.path.exists(_SO) or (
-                os.path.getmtime(_SO) <
-                os.path.getmtime(os.path.join(_CSRC, "host.cpp"))):
-            try:
-                subprocess.run(["make", "-C", _CSRC], check=True,
-                               capture_output=True)
-            except (subprocess.CalledProcessError, OSError):
-                _build_failed = True
-                return None
-        lib = ctypes.CDLL(_SO)
-        lib.xorshift_fill_f32.restype = ctypes.c_uint64
-        lib.xorshift_fill_f32.argtypes = [
-            ctypes.c_uint64, ctypes.POINTER(ctypes.c_float), ctypes.c_int64,
-            ctypes.c_double]
-        for name in ("q40_decode", "q80_decode"):
-            fn = getattr(lib, name)
-            fn.restype = None
-            fn.argtypes = [ctypes.POINTER(ctypes.c_uint8),
-                           ctypes.POINTER(ctypes.c_float), ctypes.c_int64]
-        for name in ("q40_encode", "q80_encode"):
-            fn = getattr(lib, name)
-            fn.restype = None
-            fn.argtypes = [ctypes.POINTER(ctypes.c_float),
-                           ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64]
-        _lib = lib
-        return _lib
+        try:
+            return _load_locked()
+        except Exception:
+            _build_failed = True  # any build/load problem -> numpy fallback
+            return None
+
+
+def _load_locked():
+    global _lib
+    src = os.path.join(_CSRC, "host.cpp")
+    stale = (os.path.exists(src) and os.path.exists(_SO)
+             and os.path.getmtime(_SO) < os.path.getmtime(src))
+    if not os.path.exists(_SO) or stale:
+        subprocess.run(["make", "-C", _CSRC], check=True, capture_output=True)
+    lib = ctypes.CDLL(_SO)
+    lib.xorshift_fill_f32.restype = ctypes.c_uint64
+    lib.xorshift_fill_f32.argtypes = [
+        ctypes.c_uint64, ctypes.POINTER(ctypes.c_float), ctypes.c_int64,
+        ctypes.c_double]
+    for name in ("q40_decode", "q80_decode"):
+        fn = getattr(lib, name)
+        fn.restype = None
+        fn.argtypes = [ctypes.POINTER(ctypes.c_uint8),
+                       ctypes.POINTER(ctypes.c_float), ctypes.c_int64]
+    for name in ("q40_encode", "q80_encode"):
+        fn = getattr(lib, name)
+        fn.restype = None
+        fn.argtypes = [ctypes.POINTER(ctypes.c_float),
+                       ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64]
+    _lib = lib
+    return _lib
 
 
 def available() -> bool:

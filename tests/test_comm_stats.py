@@ -1,0 +1,66 @@
+"""Comm accounting vs the reference's published transfer tables (README.md:58-69).
+
+The star-topology accounting must reproduce the reference's measured root-side
+S/R bytes per token — a strong check that we understand its collective
+structure (and therefore that our all_gather mapping covers the same data)."""
+
+import pytest
+
+from distributed_llama_tpu.models.spec import TransformerSpec
+from distributed_llama_tpu.ops.quants import FloatType
+from distributed_llama_tpu.parallel.comm_stats import (ici_all_gather_bytes,
+                                                       reference_star_bytes)
+
+L7B = dict(dim=4096, hidden_dim=11008, n_layers=32, n_heads=32, n_kv_heads=32,
+           vocab_size=32000, seq_len=2048)
+L13B = dict(dim=5120, hidden_dim=13824, n_layers=40, n_heads=40, n_kv_heads=40,
+            vocab_size=32000, seq_len=2048)
+L70B = dict(dim=8192, hidden_dim=28672, n_layers=80, n_heads=64, n_kv_heads=8,
+            vocab_size=32000, seq_len=2048)
+
+
+def _spec(cfg, bft):
+    return TransformerSpec(**cfg, buffer_float_type=bft)
+
+
+@pytest.mark.parametrize("cfg,n,s_kb,r_kb", [
+    (L7B, 2, 2224, 1968),    # README.md:58
+    (L13B, 2, 3480, 3080),   # README.md:59
+])
+def test_star_f32_published(cfg, n, s_kb, r_kb):
+    st = reference_star_bytes(_spec(cfg, FloatType.F32), n)
+    assert abs(st.sent_bytes / 1024 - s_kb) / s_kb < 0.01
+    assert abs(st.recv_bytes / 1024 - r_kb) / r_kb < 0.01
+
+
+@pytest.mark.parametrize("cfg,n,total_kb", [
+    (L7B, 2, 1112), (L7B, 4, 2830), (L7B, 8, 6008),     # README.md:67
+    (L13B, 2, 1742), (L13B, 4, 4430), (L13B, 8, 9407),  # README.md:68
+])
+def test_star_q80_published(cfg, n, total_kb):
+    st = reference_star_bytes(_spec(cfg, FloatType.Q80), n)
+    total = (st.sent_bytes + st.recv_bytes) / 1024
+    assert abs(total - total_kb) / total_kb < 0.02
+
+
+def test_star_q80_70b_published():
+    st = reference_star_bytes(_spec(L70B, FloatType.Q80), 8)
+    # README.md:69: S 28857 / R 4016 kB
+    assert abs(st.sent_bytes / 1024 - 28857) / 28857 < 0.02
+    assert abs(st.recv_bytes / 1024 - 4016) / 4016 < 0.02
+
+
+def test_ici_scheme_moves_less_than_star():
+    """Our all_gather scheme must beat the reference's star wire volume."""
+    for cfg in (L7B, L13B, L70B):
+        for n in (2, 4, 8):
+            spec = _spec(cfg, FloatType.Q80)
+            ours = ici_all_gather_bytes(spec, n)
+            star = reference_star_bytes(spec, n)
+            assert (ours.sent_bytes + ours.recv_bytes) < (
+                star.sent_bytes + star.recv_bytes)
+
+
+def test_single_slice_no_comm():
+    st = ici_all_gather_bytes(_spec(L7B, FloatType.F32), 1)
+    assert st.sent_bytes == 0 and st.recv_bytes == 0

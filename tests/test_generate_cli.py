@@ -1,0 +1,108 @@
+"""End-to-end generation loop + CLI tests on a tiny synthetic model."""
+
+import contextlib
+import io
+import os
+
+import numpy as np
+import pytest
+
+from distributed_llama_tpu.io.loader import write_model
+from distributed_llama_tpu.io.tokenizer import Tokenizer, write_tokenizer
+from distributed_llama_tpu.models.spec import TransformerSpec
+from distributed_llama_tpu.ops.quants import FloatType
+
+SPEC = TransformerSpec(dim=64, hidden_dim=160, n_layers=2, n_heads=4,
+                       n_kv_heads=2, vocab_size=300, seq_len=32,
+                       weights_float_type=FloatType.Q40)
+
+
+@pytest.fixture(scope="module")
+def model_files(tmp_path_factory):
+    d = tmp_path_factory.mktemp("m")
+    rng = np.random.default_rng(5)
+
+    def t(*shape):
+        return (rng.standard_normal(shape) * 0.1).astype(np.float32)
+
+    tensors = {"tok_embedding": t(SPEC.vocab_size, SPEC.dim),
+               "rms_att": 1 + t(SPEC.n_layers, SPEC.dim),
+               "rms_ffn": 1 + t(SPEC.n_layers, SPEC.dim),
+               "rms_final": 1 + t(SPEC.dim),
+               "wcls": t(SPEC.vocab_size, SPEC.dim)}
+    for name, shape in SPEC.layer_matmul_shapes():
+        tensors[name] = t(SPEC.n_layers, *shape)
+    model = str(d / "model.bin")
+    write_model(model, SPEC, tensors)
+
+    pieces = [b"<unk>", b"<s>", b"</s>"]
+    pieces += [f"<0x{i:02X}>".encode() for i in range(256)]
+    pieces += [b" ", b"h", b"i", b"hi", b" hi"]  # up to vocab 300: pad
+    while len(pieces) < SPEC.vocab_size:
+        pieces.append(f"tok{len(pieces)}".encode())
+    scores = [0.0] * len(pieces)
+    scores[pieces.index(b"hi")] = -0.5
+    scores[pieces.index(b" hi")] = -0.4
+    tok = str(d / "tok.bin")
+    write_tokenizer(tok, pieces, scores)
+    return model, tok
+
+
+def test_generate_greedy(model_files):
+    from distributed_llama_tpu.io.loader import load_model
+    from distributed_llama_tpu.runtime.generate import Engine, generate
+    from distributed_llama_tpu.runtime.sampling import Sampler
+
+    model, tokp = model_files
+    spec, params = load_model(model, weights_float_type=FloatType.Q40)
+    engine = Engine(spec, params)
+    tok = Tokenizer(tokp, spec.vocab_size)
+    sampler = Sampler(spec.vocab_size, temperature=0.0, topp=0.9, seed=1)
+    out1, stats = generate(engine, tok, sampler, "hi", steps=8, quiet=True)
+    assert stats.tokens == 8
+    assert stats.total_ms > 0 and stats.infer_ms > 0
+
+    # deterministic: same prompt, fresh engine -> same tokens
+    engine.reset()
+    out2, _ = generate(engine, tok, sampler, "hi", steps=8, quiet=True)
+    assert out1 == out2
+
+
+def test_generate_respects_seq_len(model_files):
+    from distributed_llama_tpu.io.loader import load_model
+    from distributed_llama_tpu.runtime.generate import Engine, generate
+    from distributed_llama_tpu.runtime.sampling import Sampler
+
+    model, tokp = model_files
+    spec, params = load_model(model, weights_float_type=FloatType.Q40)
+    engine = Engine(spec, params)
+    tok = Tokenizer(tokp, spec.vocab_size)
+    sampler = Sampler(spec.vocab_size, 0.0, 0.9, seed=1)
+    out, stats = generate(engine, tok, sampler, "hi", steps=10_000, quiet=True)
+    assert stats.tokens <= spec.seq_len
+
+
+def test_cli_inference_smoke(model_files, capsys):
+    from distributed_llama_tpu.frontend.cli import main
+
+    model, tokp = model_files
+    rc = main(["inference", "--model", model, "--tokenizer", tokp,
+               "--prompt", "hi", "--steps", "4", "--temperature", "0",
+               "--weights-float-type", "q40", "--tp", "1"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "💡 dim: 64" in out
+    assert "🔶" in out  # per-token stats lines
+    assert "Avg generation time" in out
+
+
+def test_cli_worker_requires_coordinator(capsys):
+    from distributed_llama_tpu.frontend.cli import main
+
+    assert main(["worker", "--port", "9998"]) == 2
+
+
+def test_cli_unknown_mode():
+    from distributed_llama_tpu.frontend.cli import main
+
+    assert main(["frobnicate"]) == 1

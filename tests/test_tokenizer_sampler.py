@@ -1,0 +1,105 @@
+"""Tokenizer + sampler behavior tests (reference src/tokenizer.cpp)."""
+
+import numpy as np
+import pytest
+
+from distributed_llama_tpu.io.tokenizer import BOS, EOS, Tokenizer, write_tokenizer
+from distributed_llama_tpu.runtime.sampling import (Sampler, sample_mult,
+                                                    sample_topp, softmax_f32)
+
+
+@pytest.fixture()
+def tok(tmp_path):
+    # vocab: 0..2 specials, 3..258 byte tokens, then text pieces
+    pieces = [b"<unk>", b"<s>", b"</s>"]
+    pieces += [f"<0x{i:02X}>".encode() for i in range(256)]
+    extra = [(b" ", -1.0), (b"a", -2.0), (b"b", -3.0), (b"ab", -0.5),
+             (b" a", -0.6), (b"c", -4.0), (b"abc", -0.1)]
+    scores = [0.0] * len(pieces) + [s for _, s in extra]
+    pieces += [p for p, _ in extra]
+    path = str(tmp_path / "tok.bin")
+    write_tokenizer(path, pieces, scores)
+    return Tokenizer(path, len(pieces))
+
+
+def test_encode_merges_best_pair_first(tok):
+    # "abc": a+b -> "ab" (score -0.5) ... then ab+c -> "abc" (score -0.1)
+    ids = tok.encode("abc", bos=True, eos=False)
+    assert ids[0] == BOS
+    assert tok.vocab[ids[1]] == b" a" or tok.vocab[ids[1]] == b" "
+    # final sequence decodes back to " abc" minus the BOS-stripped space
+    assert tok.decode(ids[1:]) in (b" abc", b"abc")
+
+
+def test_encode_dummy_prefix_and_empty(tok):
+    assert tok.encode("", bos=True, eos=False) == [BOS]
+    ids = tok.encode("a", bos=True, eos=True)
+    assert ids[0] == BOS and ids[-1] == EOS
+    # dummy prefix " " merges with "a" into " a" (score -0.6 beats others)
+    assert tok.vocab[ids[1]] == b" a"
+
+
+def test_byte_fallback(tok):
+    # "z" is not in vocab -> byte token z+3
+    ids = tok.encode("z", bos=False, eos=False)
+    assert ids[-1] == ord("z") + 3
+    assert tok.decode_piece(0, ids[-1]) == b"z"
+
+
+def test_utf8_multibyte_fallback(tok):
+    text = "é"  # 2 bytes, not in vocab -> two byte tokens
+    ids = tok.encode(text, bos=False, eos=False)
+    bs = text.encode("utf-8")
+    assert ids[-2:] == [bs[0] + 3, bs[1] + 3]
+    assert tok.decode(ids)[-2:] == bs
+
+
+def test_decode_strips_space_after_bos(tok):
+    sp = tok.vocab.index(b" a")
+    assert tok.decode_piece(BOS, sp) == b"a"
+    assert tok.decode_piece(5, sp) == b" a"
+
+
+def test_sampler_argmax():
+    s = Sampler(8, temperature=0.0, topp=0.9, seed=1)
+    logits = np.array([0.1, 3.0, -1, 0, 0, 0, 0, 2.9], np.float32)
+    assert s.sample(logits) == 1
+
+
+def test_sampler_deterministic_seed():
+    logits = np.random.default_rng(0).standard_normal(64).astype(np.float32)
+    a = [Sampler(64, 0.8, 0.9, seed=42).sample(logits) for _ in range(3)]
+    b = [Sampler(64, 0.8, 0.9, seed=42).sample(logits) for _ in range(3)]
+    assert a == b
+    # different seeds eventually differ
+    outs = {Sampler(64, 0.8, 0.9, seed=s).sample(logits) for s in range(20)}
+    assert len(outs) > 1
+
+
+def test_sample_mult_cdf_walk():
+    probs = np.array([0.1, 0.2, 0.3, 0.4], np.float32)
+    assert sample_mult(probs, 0.05) == 0
+    assert sample_mult(probs, 0.25) == 1
+    assert sample_mult(probs, 0.999) == 3
+    assert sample_mult(probs, 1.5) == 3  # rounding-error guard
+
+
+def test_sample_topp_truncates_tail():
+    # p = [0.5, 0.3, 0.1, 0.1], topp=0.7 -> nucleus {0, 1}
+    probs = np.array([0.5, 0.3, 0.1, 0.1], np.float32)
+    picks = {sample_topp(probs, 0.7, coin) for coin in
+             (0.01, 0.3, 0.6, 0.95)}
+    assert picks <= {0, 1}
+
+
+def test_sampler_temperature_sharpens():
+    logits = np.array([1.0, 1.1, 0.9, 5.0], np.float32)
+    picks = [Sampler(4, 0.01, 0.0, seed=s).sample(logits.copy())
+             for s in range(10)]
+    assert all(p == 3 for p in picks)
+
+
+def test_softmax_f32_matches_reference_shape():
+    x = np.array([1.0, 2.0, 3.0], np.float32)
+    p = softmax_f32(x)
+    assert abs(p.sum() - 1.0) < 1e-6 and p.argmax() == 2
