@@ -81,13 +81,22 @@ class MetaCheckpoint:
 
     def spec(self, target: FloatType, seq_len: int) -> TransformerSpec:
         p = self.params
+        vocab = p["vocab_size"]
+        if vocab < 1:
+            # Meta ships vocab_size=-1 as a sentinel; derive the real count
+            # from the embedding table (the reference refuses outright,
+            # converter.py:76-77 'Invalid vocab size')
+            # tok_embeddings shards along dim=1, so shape[0] is the full vocab
+            vocab = self.shards[0]["tok_embeddings.weight"].shape[0]
+            if vocab < 1:
+                raise ValueError("Invalid vocab size")
         w1 = self.shards[0]["layers.0.feed_forward.w1.weight"]
         hidden = w1.shape[0] * len(self.shards)
         return TransformerSpec(
             dim=p["dim"], hidden_dim=hidden, n_layers=p["n_layers"],
             n_heads=p["n_heads"],
             n_kv_heads=p.get("n_kv_heads") or p["n_heads"],
-            vocab_size=abs(p["vocab_size"]), seq_len=seq_len,
+            vocab_size=vocab, seq_len=seq_len,
             weights_float_type=target)
 
     def keys(self):

@@ -67,19 +67,25 @@ def cmd_inference(argv: list[str], quiet: bool = False) -> int:
     ap.add_argument("--seed", type=int, default=None)
     ap.add_argument("--tp", type=int, default=None,
                     help="tensor-parallel ways (default: all local devices)")
+    ap.add_argument("--sp", type=int, default=1,
+                    help="sequence-parallel ways (sp-sharded KV cache + "
+                         "distributed flash attention; reference has none)")
     ap.add_argument("--workers", nargs="*", default=None,
                     help="accepted for reference-CLI compatibility; on TPU "
                          "the workers are the chips of the mesh (see module "
                          "docstring for multi-host)")
     _add_common(ap)
     args = ap.parse_args(argv)
+    if args.coordinator and args.seed is None:
+        # every host (root included) must sample the same chain, or hosts
+        # hit the BOS early-stop at different steps and deadlock in the
+        # collectives — refuse BEFORE joining the distributed barrier
+        print("multi-host runs need an explicit --seed so every host "
+              "samples the same chain", file=sys.stderr)
+        return 2
     _maybe_distributed(args)
     if args.host_id:  # non-root hosts run silently in SPMD lockstep
         quiet = True
-        if args.seed is None:
-            print("multi-host runs need an explicit --seed so every host "
-                  "samples the same chain", file=sys.stderr)
-            return 2
 
     import jax
 
@@ -94,17 +100,21 @@ def cmd_inference(argv: list[str], quiet: bool = False) -> int:
     t0 = time.time()
     spec, params = load_model(args.model, weights_float_type=wft,
                               buffer_float_type=bft)
-    print(f"💡 dim: {spec.dim}\n💡 hiddenDim: {spec.hidden_dim}\n"
-          f"💡 nLayers: {spec.n_layers}\n💡 nHeads: {spec.n_heads}\n"
-          f"💡 nKvHeads: {spec.n_kv_heads}\n💡 vocabSize: {spec.vocab_size}\n"
-          f"💡 seqLen: {spec.seq_len}")
+    if not quiet:
+        print(f"💡 dim: {spec.dim}\n💡 hiddenDim: {spec.hidden_dim}\n"
+              f"💡 nLayers: {spec.n_layers}\n💡 nHeads: {spec.n_heads}\n"
+              f"💡 nKvHeads: {spec.n_kv_heads}\n"
+              f"💡 vocabSize: {spec.vocab_size}\n💡 seqLen: {spec.seq_len}")
     n_dev = len(jax.devices())
-    tp = args.tp or n_dev
-    print(f"💡 nSlices: {tp} ({n_dev} devices, "
-          f"{jax.devices()[0].platform})")
-    mesh = make_mesh(tp=tp) if tp > 1 else None
+    tp = args.tp or max(1, n_dev // args.sp)
+    if not quiet:
+        print(f"💡 nSlices: {tp} sp: {args.sp} ({n_dev} devices, "
+              f"{jax.devices()[0].platform})")
+    mesh = (make_mesh(sp=args.sp, tp=tp)
+            if tp > 1 or args.sp > 1 else None)
     engine = Engine(spec, params, mesh=mesh)
-    print(f"⏩ Loaded model in {time.time() - t0:.1f}s")
+    if not quiet:
+        print(f"⏩ Loaded model in {time.time() - t0:.1f}s")
 
     tokenizer = Tokenizer(args.tokenizer, spec.vocab_size)
     seed = args.seed if args.seed is not None else int(time.time())

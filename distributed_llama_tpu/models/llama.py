@@ -116,36 +116,54 @@ def attention(spec: TransformerSpec, q: jax.Array, k_cache: jax.Array,
                           mask)
 
 
+def _qkv_proj(spec: TransformerSpec, lw: dict[str, Any], x: jax.Array,
+              positions: jax.Array):
+    """Shared attention input path: norm -> (q80) -> q/k/v matmuls -> RoPE.
+
+    Works on (T, dim) or batched (B, T, dim) activations.
+    """
+    xb = rmsnorm(x, lw["rms_att"])
+    xb = _maybe_q80(spec, xb)
+    q = matmul(lw["wq"], xb)
+    k = matmul(lw["wk"], xb)
+    v = matmul(lw["wv"], xb)
+
+    def rot(a):
+        return rope_rotate(a, positions, spec.head_size)
+
+    if x.ndim == 3:
+        rot_fn = jax.vmap(rot)
+    else:
+        rot_fn = rot
+    return rot_fn(q), rot_fn(k), v
+
+
+def _post_attention(spec: TransformerSpec, lw: dict[str, Any], x: jax.Array,
+                    ao: jax.Array) -> jax.Array:
+    """Shared layer tail: wo + residual, then the SwiGLU ffn sub-block."""
+    ao = _maybe_q80(spec, ao)
+    x = x + matmul(lw["wo"], ao)
+    xb = rmsnorm(x, lw["rms_ffn"])
+    xb = _maybe_q80(spec, xb)
+    hb = silu(matmul(lw["w1"], xb)) * matmul(lw["w3"], xb)
+    hb = _maybe_q80(spec, hb)
+    return x + matmul(lw["w2"], hb)
+
+
 def _layer(spec: TransformerSpec, x: jax.Array, lw: dict[str, Any],
            k_cache: jax.Array, v_cache: jax.Array, pos: jax.Array,
            positions: jax.Array) -> tuple[jax.Array, jax.Array, jax.Array]:
     t_len = x.shape[0]
-
-    # attention sub-block
-    xb = rmsnorm(x, lw["rms_att"])
-    xb = _maybe_q80(spec, xb)
-    q = matmul(lw["wq"], xb)                      # (T, dim)
-    k = matmul(lw["wk"], xb)                      # (T, kv_dim)
-    v = matmul(lw["wv"], xb)
-    q = rope_rotate(q, positions, spec.head_size)
-    k = rope_rotate(k, positions, spec.head_size)
+    q, k, v = _qkv_proj(spec, lw, x, positions)
     k_cache = jax.lax.dynamic_update_slice(
         k_cache, k.reshape(t_len, spec.n_kv_heads, spec.head_size),
         (pos, 0, 0))
     v_cache = jax.lax.dynamic_update_slice(
         v_cache, v.reshape(t_len, spec.n_kv_heads, spec.head_size),
         (pos, 0, 0))
-    xb = attention(spec, q.reshape(t_len, spec.n_heads, spec.head_size),
+    ao = attention(spec, q.reshape(t_len, spec.n_heads, spec.head_size),
                    k_cache, v_cache, pos, t_len)
-    xb = _maybe_q80(spec, xb)
-    x = x + matmul(lw["wo"], xb)
-
-    # ffn sub-block
-    xb = rmsnorm(x, lw["rms_ffn"])
-    xb = _maybe_q80(spec, xb)
-    hb = silu(matmul(lw["w1"], xb)) * matmul(lw["w3"], xb)
-    hb = _maybe_q80(spec, hb)
-    x = x + matmul(lw["w2"], hb)
+    x = _post_attention(spec, lw, x, ao)
     return x, k_cache, v_cache
 
 
@@ -195,25 +213,13 @@ def forward_seq(spec: TransformerSpec, params: dict[str, Any],
     layer_weights = {k: params[k] for k in LAYER_KEYS}
 
     def body(x, lw):
-        xb = rmsnorm(x, lw["rms_att"])
-        xb = _maybe_q80(spec, xb)
-        q = matmul(lw["wq"], xb)                    # (B, T, dim)
-        k = matmul(lw["wk"], xb)                    # (B, T, kv_dim)
-        v = matmul(lw["wv"], xb)
-        q = jax.vmap(lambda a: rope_rotate(a, positions, spec.head_size))(q)
-        k = jax.vmap(lambda a: rope_rotate(a, positions, spec.head_size))(k)
+        q, k, v = _qkv_proj(spec, lw, x, positions)
         ao = attention_core(
             spec.head_size, spec.kv_mul,
             q.reshape(B, T, spec.n_heads, spec.head_size),
             k.reshape(B, T, spec.n_kv_heads, spec.head_size),
             v.reshape(B, T, spec.n_kv_heads, spec.head_size), mask)
-        ao = _maybe_q80(spec, ao)
-        x = x + matmul(lw["wo"], ao)
-        xb = rmsnorm(x, lw["rms_ffn"])
-        xb = _maybe_q80(spec, xb)
-        hb = silu(matmul(lw["w1"], xb)) * matmul(lw["w3"], xb)
-        hb = _maybe_q80(spec, hb)
-        x = x + matmul(lw["w2"], hb)
+        x = _post_attention(spec, lw, x, ao)
         return x, None
 
     x, _ = jax.lax.scan(body, x, layer_weights)

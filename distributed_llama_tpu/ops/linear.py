@@ -18,6 +18,8 @@ Semantics contract (BASELINE.md logit parity):
 
 from __future__ import annotations
 
+import os
+
 import jax
 import jax.numpy as jnp
 
@@ -49,16 +51,30 @@ def dequantize_weight(w) -> jax.Array:
     return jnp.asarray(w).astype(jnp.float32)
 
 
+def q40_kernel_mode() -> str:
+    """'pallas' (fused HBM-packed kernel) or 'xla' (dequantize-then-dot).
+
+    DLLAMA_Q40_KERNEL=pallas|xla|auto overrides; auto = pallas on TPU, xla
+    elsewhere (the kernel still runs in interpret mode off-TPU when forced,
+    which is what the parity tests do).
+    """
+    env = os.environ.get("DLLAMA_Q40_KERNEL", "auto")
+    if env == "auto":
+        return "pallas" if jax.default_backend() == "tpu" else "xla"
+    return env
+
+
 def matmul(w, x: jax.Array, *, prefer_pallas: bool = False) -> jax.Array:
     """out[..., d] = w(d, n) @ x[..., n] with f32 accumulation.
 
     ``w`` may be a dense array (f32/f16/bf16) or a planar ``Q40Weight``. The
-    dense path lets XLA drive the MXU directly; the Q40 path either dequantizes
-    inline (XLA fuses the int4 unpack into the matmul epilogue-free) or calls
-    the Pallas fused-dequant kernel.
+    dense path lets XLA drive the MXU directly; the Q40 path either calls the
+    Pallas fused-dequant kernel (HBM traffic = packed bytes; the default on
+    TPU) or dequantizes inline and dots (the XLA fallback).
     """
-    if isinstance(w, Q40Weight) and prefer_pallas:
-        from .pallas_q40 import q40_matmul  # lazy: only on TPU paths
+    if isinstance(w, Q40Weight) and (prefer_pallas
+                                     or q40_kernel_mode() == "pallas"):
+        from .pallas_q40 import q40_matmul  # lazy: only on Q40 paths
 
         return q40_matmul(w, x)
     wf = dequantize_weight(w)

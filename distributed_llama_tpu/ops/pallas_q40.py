@@ -1,0 +1,118 @@
+"""Pallas TPU kernel: fused Q40 dequant + matmul.
+
+The TPU analog of the reference's hot NEON kernel ``matmulQ40vQ80``
+(src/funcs.cpp:185-260): weights stay packed in HBM (0.5625 bytes/value) and
+the nibble-unpack + f16-delta scale happens in VMEM on the way into the dot —
+HBM traffic per token is the packed bytes, not dequantized f32. This is what
+makes single-token decode HBM-bound at the Q40 size instead of the f32 size
+(the dequantize-then-dot XLA fallback in ops/linear.py materializes f32 tiles).
+
+Layout in the kernel (see ops/quants.py for the wire format):
+  qs2d (d, nb*16) uint8 — column c = b*16+j holds codes for values b*32+j
+                           (low nibble) and b*32+j+16 (high nibble)
+  d16  (d, nb) float16  — per-block deltas
+  x is pre-split OUTSIDE the kernel into xlo/xhi (T, nb*16) matching the
+  column order, so the kernel is: out[t, r] = sum_c (lo[r,c]-8)*s[r,c/16]*xlo[t,c]
+                                            + (hi[r,c]-8)*s[r,c/16]*xhi[t,c]
+  computed as two MXU dots against the unpacked row band.
+
+Grid: one step per ``block_rows`` output rows; Pallas double-buffers the HBM
+loads across steps automatically. Non-TPU backends run in interpret mode
+(tests); the numerics are the exact Q40 value map, so parity with the XLA
+path is bit-tight at f32.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..io.loader import Q40Weight
+
+QK = 32
+
+
+def _kernel(qs_ref, d16_ref, xlo_ref, xhi_ref, out_ref, *, block_rows, nb):
+    q = qs_ref[...]                                   # (Rb, nb*16) uint8
+    scales = d16_ref[...].astype(jnp.float32)         # (Rb, nb)
+    lo = (q & 0xF).astype(jnp.int32) - 8
+    hi = (q >> 4).astype(jnp.int32) - 8
+    sc = jnp.broadcast_to(scales[:, :, None],
+                          (block_rows, nb, 16)).reshape(block_rows, nb * 16)
+    wlo = lo.astype(jnp.float32) * sc
+    whi = hi.astype(jnp.float32) * sc
+    acc = jnp.dot(xlo_ref[...], wlo.T, preferred_element_type=jnp.float32)
+    acc += jnp.dot(xhi_ref[...], whi.T, preferred_element_type=jnp.float32)
+    out_ref[...] = acc                                # (T, Rb)
+
+
+def _split_x(x: jax.Array, nb: int) -> tuple[jax.Array, jax.Array]:
+    """(T, n) f32 -> xlo/xhi (T, nb*16) in kernel column order."""
+    t = x.shape[0]
+    xb = x.reshape(t, nb, QK)
+    return (xb[:, :, :16].reshape(t, nb * 16),
+            xb[:, :, 16:].reshape(t, nb * 16))
+
+
+@functools.partial(jax.jit, static_argnames=("block_rows", "interpret"))
+def _q40_matmul_2d(qs2d, d16, x, *, block_rows, interpret):
+    d, ncols = qs2d.shape
+    nb = ncols // 16
+    t = x.shape[0]
+    xlo, xhi = _split_x(x.astype(jnp.float32), nb)
+    grid = (d // block_rows,)
+    out = pl.pallas_call(
+        functools.partial(_kernel, block_rows=block_rows, nb=nb),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_rows, ncols), lambda i: (i, 0)),
+            pl.BlockSpec((block_rows, nb), lambda i: (i, 0)),
+            pl.BlockSpec((t, ncols), lambda i: (0, 0)),
+            pl.BlockSpec((t, ncols), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((t, block_rows), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((t, d), jnp.float32),
+        interpret=interpret,
+    )(qs2d, d16, xlo, xhi)
+    return out
+
+
+def _pick_block_rows(d: int) -> int:
+    for cand in (512, 256, 128):
+        if d % cand == 0:
+            return cand
+    # largest multiple-of-8 divisor (TPU sublane alignment)
+    top = (min(d, 1024) // 8) * 8
+    for cand in range(top, 0, -8):
+        if d % cand == 0:
+            return cand
+    raise ValueError(
+        f"q40_matmul needs an output dim with a multiple-of-8 divisor, "
+        f"got d={d}")
+
+
+def q40_matmul(w: Q40Weight, x: jax.Array,
+               block_rows: int | None = None,
+               interpret: bool | None = None) -> jax.Array:
+    """out[..., d] = dequant(w)(d, n) @ x[..., n], packed weights end to end.
+
+    x may be (n,) or (..., n); leading dims are flattened into T for the
+    kernel and restored after.
+    """
+    qs, d16 = w.qs, w.d16
+    d, nb = qs.shape[-3], qs.shape[-2]
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if block_rows is None:
+        block_rows = _pick_block_rows(d)
+    lead = x.shape[:-1]
+    n = x.shape[-1]
+    x2 = x.reshape(-1, n)
+    qs2d = qs.reshape(d, nb * 16)
+    out = _q40_matmul_2d(qs2d, d16, x2, block_rows=block_rows,
+                         interpret=interpret)
+    return out.reshape(*lead, d)

@@ -56,6 +56,23 @@ def ici_all_gather_bytes(spec: TransformerSpec, n_slices: int) -> CommStats:
     return CommStats(moved, moved)
 
 
+def sp_lse_bytes(spec: TransformerSpec, n_sp: int, n_tp: int = 1,
+                 t_len: int = 1) -> CommStats:
+    """Per-chip bytes/token of the sp flash-partial combine (ring.py).
+
+    Per layer each chip all-reduces m and l ((T, heads_loc, 1) each) and o
+    ((T, heads_loc, head_size)) across sp — a ring all-reduce moves
+    ~2*(S-1)/S of the payload out of and into every chip.
+    """
+    if n_sp <= 1:
+        return CommStats(0, 0)
+    heads_loc = spec.n_heads // n_tp
+    per_layer_vals = t_len * heads_loc * (2 + spec.head_size)
+    payload = per_layer_vals * 4 * spec.n_layers
+    moved = int(2 * payload * (n_sp - 1) / n_sp)
+    return CommStats(moved, moved)
+
+
 def reference_star_bytes(spec: TransformerSpec, n_slices: int) -> CommStats:
     """Root-side S/R bytes/token of the reference's socket scheme.
 

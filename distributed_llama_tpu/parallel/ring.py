@@ -1,0 +1,149 @@
+"""Sequence/context parallelism: sp-sharded KV cache + ring attention.
+
+The reference has NO long-context story: its KV cache is a dense root-only
+array and attention is a serial per-position loop on the root
+(transformer-tasks.cpp:206-278, SURVEY.md §5). Here sequence is a first-class
+mesh axis ("sp"):
+
+* Decode / chunked prefill (sp_cache_attention): the KV cache is sharded over
+  sp in contiguous position chunks. Every device scores its local chunk with
+  flash-style running statistics, then the partials combine across sp with a
+  log-sum-exp reduction (pmax of maxes, psum of rescaled sums) — per token the
+  wire carries only (m, l, o) per head, not KV. Mathematically identical to
+  softmax over the full cache (same masking contract as attention_core).
+
+* Training / full-sequence (ring_attention): queries stay put; K/V chunks
+  rotate around the sp ring via ppermute, with blockwise causal masking by
+  absolute position and the same running-LSE accumulation — O(T_local * T)
+  compute, O(T_local) memory per device, KV moves once around the ring per
+  layer (the Ring Attention construction).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _lse_combine_partials(m, l, o, axis: str):
+    """Combine flash partials across a mesh axis.
+
+    m: (..., 1) running max of scores; l: (..., 1) sum of exp(score - m);
+    o: (..., hs) sum of exp(score - m) * V. Returns the exact softmax-weighted
+    value sum over the union of all shards' keys.
+    """
+    g_m = jax.lax.pmax(m, axis)
+    corr = jnp.exp(m - g_m)          # rescale each shard to the global max
+    g_l = jax.lax.psum(l * corr, axis)
+    g_o = jax.lax.psum(o * corr, axis)
+    return g_o / jnp.maximum(g_l, 1e-38)
+
+
+def _partial_attention(head_size: int, kv_mul: int, q, k, v, valid):
+    """Flash-style partials of q against one key chunk.
+
+    q: (T, n_q, hs); k/v: (C, n_kv, hs); valid: (T, C) True where the key is
+    visible. Returns m (T, n_q, 1), l (T, n_q, 1), o (T, n_q, hs) in f32.
+    """
+    t_len, n_q, _ = q.shape
+    n_kv = k.shape[1]
+    qg = q.reshape(t_len, n_kv, kv_mul, head_size)
+    scale = 1.0 / jnp.sqrt(jnp.float32(head_size))
+    s = jnp.einsum("tgmd,cgd->gmtc", qg, k,
+                   preferred_element_type=jnp.float32,
+                   precision=jax.lax.Precision.HIGHEST) * scale
+    s = jnp.where(valid[None, None, :, :], s, -jnp.inf)
+    m = jnp.max(s, axis=-1, keepdims=True)            # (g, m, T, 1)
+    m_safe = jnp.where(jnp.isfinite(m), m, 0.0)       # all-masked chunk -> 0
+    p = jnp.where(jnp.isfinite(m), jnp.exp(s - m_safe), 0.0)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    o = jnp.einsum("gmtc,cgd->gmtd", p, v,
+                   preferred_element_type=jnp.float32,
+                   precision=jax.lax.Precision.HIGHEST)
+    # -> (T, n_q, ...) layout
+    perm = (2, 0, 1, 3)
+    return (m.transpose(perm).reshape(t_len, n_q, 1),
+            l.transpose(perm).reshape(t_len, n_q, 1),
+            o.transpose(perm).reshape(t_len, n_q, head_size))
+
+
+def sp_cache_attention(head_size: int, kv_mul: int, seq_chunk: int,
+                       sp_index, q, k_chunk, v_chunk, pos, axis: str = "sp"):
+    """Decode attention over an sp-sharded cache (inside shard_map).
+
+    q: (T, n_q, hs) replicated over sp; k/v_chunk: (C, n_kv, hs) = this
+    device's positions [sp_index*C, (sp_index+1)*C); pos: first query's
+    absolute position. Returns (T, n_q*hs), exact softmax over the global
+    cache prefix 0..pos+T-1.
+    """
+    t_len = q.shape[0]
+    q_pos = pos + jnp.arange(t_len)                     # (T,)
+    key_pos = sp_index * seq_chunk + jnp.arange(seq_chunk)
+    valid = key_pos[None, :] <= q_pos[:, None]          # (T, C)
+    m, l, o = _partial_attention(head_size, kv_mul, q, k_chunk, v_chunk, valid)
+    out = _lse_combine_partials(m, l, o, axis)          # (T, n_q, hs)
+    return out.reshape(t_len, -1)
+
+
+def update_sp_cache(cache_chunk, new_vals, pos, sp_index, seq_chunk: int):
+    """Write T new kv rows at absolute positions pos.. into the local chunk.
+
+    cache_chunk: (C, n_kv, hs); new_vals: (T, n_kv, hs) (every sp rank computes
+    the same k/v since x is replicated); rows outside this rank's range are
+    dropped. T must not straddle more than it can: handled by writing at the
+    clamped offset and masking rows that don't belong here.
+    """
+    t_len = new_vals.shape[0]
+    local_start = sp_index * seq_chunk
+    first = pos - local_start        # local row of new_vals[0] (may be <0 or >C)
+    row = jnp.arange(seq_chunk)
+    belongs = (row >= first) & (row < first + t_len)           # (C,)
+    src = jnp.clip(row - first, 0, t_len - 1)                  # (C,)
+    candidate = new_vals[src]                                  # (C, n_kv, hs)
+    return jnp.where(belongs[:, None, None], candidate, cache_chunk)
+
+
+def ring_attention(head_size: int, kv_mul: int, q, k, v, q_start, chunk: int,
+                   axis: str = "sp", axis_size: int | None = None):
+    """Causal ring attention for full sequences (training path, in shard_map).
+
+    q/k/v: (T_local, n_heads|n_kv, hs) — this rank's sequence chunk, which
+    starts at absolute position q_start. K/V rotate around the ring
+    (ppermute), each rank accumulating flash partials with blockwise causal
+    masks; after axis_size steps every query has seen every visible key.
+    Returns (T_local, n_q * hs).
+    """
+    axis_size = axis_size or jax.lax.axis_size(axis)
+    my = jax.lax.axis_index(axis)
+    t_len, n_q, _ = q.shape
+
+    q_pos = q_start + jnp.arange(t_len)
+
+    def step(i, carry):
+        m, l, o, k_rot, v_rot, src = carry
+        key_start = src * chunk
+        key_pos = key_start + jnp.arange(chunk)
+        valid = key_pos[None, :] <= q_pos[:, None]
+        pm, plv, po = _partial_attention(head_size, kv_mul, q, k_rot, v_rot,
+                                         valid)
+        # running LSE merge of (m,l,o) with the new partial
+        nm = jnp.maximum(m, pm)
+        nm_safe = jnp.where(jnp.isfinite(nm), nm, 0.0)
+        c_old = jnp.where(jnp.isfinite(m), jnp.exp(m - nm_safe), 0.0)
+        c_new = jnp.where(jnp.isfinite(pm), jnp.exp(pm - nm_safe), 0.0)
+        l2 = l * c_old + plv * c_new
+        o2 = o * c_old + po * c_new
+        # rotate KV to the next rank (ring: receive from rank+1's chunk)
+        perm = [(j, (j - 1) % axis_size) for j in range(axis_size)]
+        k_next = jax.lax.ppermute(k_rot, axis, perm)
+        v_next = jax.lax.ppermute(v_rot, axis, perm)
+        src_next = jnp.mod(src + 1, axis_size)
+        return nm, l2, o2, k_next, v_next, src_next
+
+    m0 = jnp.full((t_len, n_q, 1), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((t_len, n_q, 1), jnp.float32)
+    o0 = jnp.zeros((t_len, n_q, head_size), jnp.float32)
+    m, l, o, _, _, _ = jax.lax.fori_loop(
+        0, axis_size, step, (m0, l0, o0, k, v, my))
+    out = o / jnp.maximum(l, 1e-38)
+    return out.reshape(t_len, n_q * head_size)

@@ -75,7 +75,8 @@ def param_specs(params: dict[str, Any]) -> dict[str, Any]:
     return specs
 
 
-CACHE_SPEC = KVCache(P(None, None, "tp", None), P(None, None, "tp", None))
+# cache (L, S, n_kv, hs): sequence chunks over sp, kv heads over tp
+CACHE_SPEC = KVCache(P(None, "sp", "tp", None), P(None, "sp", "tp", None))
 
 
 def shard_params(params: dict[str, Any], mesh: Mesh) -> dict[str, Any]:
@@ -105,12 +106,14 @@ def _gather(x: jax.Array) -> jax.Array:
     return jax.lax.all_gather(x, "tp", axis=-1, tiled=True)
 
 
-def _local_layer(spec: TransformerSpec, n_slices: int, x, lw, k_cache, v_cache,
-                 pos, positions):
-    """Per-device layer body. x replicated (T, dim); lw holds local bands."""
+def _local_layer(spec: TransformerSpec, n_slices: int, n_sp: int, x, lw,
+                 k_cache, v_cache, pos, positions):
+    """Per-device layer body. x replicated (T, dim); lw holds local tp bands;
+    k/v_cache hold this device's (sp-chunk, tp-kv-heads) shard."""
     t_len = x.shape[0]
     heads_loc = spec.n_heads // n_slices
     kv_heads_loc = spec.n_kv_heads // n_slices
+    seq_chunk = spec.seq_len // n_sp
 
     xb = rmsnorm(x, lw["rms_att"])
     xb = _wire(spec, xb)  # reference quantizes xb before qkv (quantizeRmsAtt)
@@ -121,17 +124,25 @@ def _local_layer(spec: TransformerSpec, n_slices: int, x, lw, k_cache, v_cache,
     # RoPE's angle depends only on (feature index mod head_size): local == global
     q = rope_rotate(q, positions, spec.head_size)
     k = rope_rotate(k, positions, spec.head_size)
-    k_cache = jax.lax.dynamic_update_slice(
-        k_cache, k.reshape(t_len, kv_heads_loc, spec.head_size), (pos, 0, 0))
-    v_cache = jax.lax.dynamic_update_slice(
-        v_cache, v.reshape(t_len, kv_heads_loc, spec.head_size), (pos, 0, 0))
+    k_new = k.reshape(t_len, kv_heads_loc, spec.head_size)
+    v_new = v.reshape(t_len, kv_heads_loc, spec.head_size)
+    qh = q.reshape(t_len, heads_loc, spec.head_size)
 
-    # local-head attention (math of transformer-tasks.cpp:206-278 per head);
-    # contiguous bands keep the h -> h//kvMul mapping purely local
-    ao = attention_core(
-        spec.head_size, spec.kv_mul,
-        q.reshape(t_len, heads_loc, spec.head_size), k_cache, v_cache,
-        causal_cache_mask(spec.seq_len, pos, t_len))
+    if n_sp == 1:
+        k_cache = jax.lax.dynamic_update_slice(k_cache, k_new, (pos, 0, 0))
+        v_cache = jax.lax.dynamic_update_slice(v_cache, v_new, (pos, 0, 0))
+        # local-head attention (math of transformer-tasks.cpp:206-278 per
+        # head); contiguous bands keep h -> h//kvMul purely local
+        ao = attention_core(spec.head_size, spec.kv_mul, qh, k_cache, v_cache,
+                            causal_cache_mask(spec.seq_len, pos, t_len))
+    else:
+        from .ring import sp_cache_attention, update_sp_cache
+
+        sp_index = jax.lax.axis_index("sp")
+        k_cache = update_sp_cache(k_cache, k_new, pos, sp_index, seq_chunk)
+        v_cache = update_sp_cache(v_cache, v_new, pos, sp_index, seq_chunk)
+        ao = sp_cache_attention(spec.head_size, spec.kv_mul, seq_chunk,
+                                sp_index, qh, k_cache, v_cache, pos)
 
     xb = _gather(_wire(spec, ao))                  # ⇄ syncMultiheadAtt
     xb2 = matmul(lw["wo"], xb)                     # (T, dim/S)
@@ -158,11 +169,14 @@ def make_sharded_forward(spec: TransformerSpec, mesh: Mesh):
     SURVEY.md §7).
     """
     n_slices = mesh.shape["tp"]
+    n_sp = mesh.shape.get("sp", 1)
     for req, name in ((spec.n_kv_heads, "n_kv_heads"),
                       (spec.hidden_dim, "hidden_dim"),
                       (spec.vocab_size, "vocab_size")):
         if req % n_slices != 0:
             raise ValueError(f"{name}={req} not divisible by tp={n_slices}")
+    if spec.seq_len % n_sp != 0:
+        raise ValueError(f"seq_len={spec.seq_len} not divisible by sp={n_sp}")
     if spec.buffer_float_type == FloatType.Q80:
         for req, name in ((spec.dim, "dim"), (spec.hidden_dim, "hidden_dim")):
             if (req // n_slices) % 32 != 0:
@@ -179,8 +193,8 @@ def make_sharded_forward(spec: TransformerSpec, mesh: Mesh):
 
         def body(x, per_layer):
             lw, k_c, v_c = per_layer
-            x, k_c, v_c = _local_layer(spec, n_slices, x, lw, k_c, v_c, pos,
-                                       positions)
+            x, k_c, v_c = _local_layer(spec, n_slices, n_sp, x, lw, k_c, v_c,
+                                       pos, positions)
             return x, (k_c, v_c)
 
         x, (k_new, v_new) = jax.lax.scan(body, x, (lw_tree, cache.k, cache.v))

@@ -25,7 +25,8 @@ import numpy as np
 from ..io.tokenizer import BOS, Tokenizer
 from ..models.llama import KVCache, forward, init_cache
 from ..models.spec import TransformerSpec
-from ..parallel.comm_stats import CommStats, ici_all_gather_bytes
+from ..parallel.comm_stats import (CommStats, ici_all_gather_bytes,
+                                   sp_lse_bytes)
 from .sampling import Sampler
 
 
@@ -42,18 +43,19 @@ class Engine:
         self.spec = spec
         self.jnp = jnp
         self.mesh = mesh
-        if mesh is not None and mesh.shape["tp"] > 1:
+        self.tp = mesh.shape["tp"] if mesh is not None else 1
+        self.sp = mesh.shape.get("sp", 1) if mesh is not None else 1
+        self.sharded = self.tp > 1 or self.sp > 1
+        if self.sharded:
             from ..parallel import (make_sharded_forward, shard_cache,
                                     shard_params)
 
-            self.n_slices = mesh.shape["tp"]
             self.params = shard_params(params, mesh)
             self.cache = shard_cache(init_cache(spec), mesh)
             self._fwd = make_sharded_forward(spec, mesh)
         else:
             from ..models.llama import params_to_device
 
-            self.n_slices = 1
             self.params = params_to_device(params)
             self.cache = init_cache(spec)
             self._fwd = jax.jit(
@@ -68,13 +70,16 @@ class Engine:
 
     def reset(self):
         self.cache = init_cache(self.spec)
-        if self.n_slices > 1:
+        if self.sharded:
             from ..parallel import shard_cache
 
             self.cache = shard_cache(self.cache, self.mesh)
 
     def comm_stats(self) -> CommStats:
-        return ici_all_gather_bytes(self.spec, self.n_slices)
+        tp_st = ici_all_gather_bytes(self.spec, self.tp)
+        sp_st = sp_lse_bytes(self.spec, self.sp, self.tp)
+        return CommStats(tp_st.sent_bytes + sp_st.sent_bytes,
+                         tp_st.recv_bytes + sp_st.recv_bytes)
 
 
 @dataclasses.dataclass
