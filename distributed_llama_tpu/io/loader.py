@@ -29,6 +29,10 @@ from ..ops.quants import (
 class Q40Weight(NamedTuple):
     """Planar Q40 tensor: qs uint8 (..., d, n/32, 16), d16 float16 (..., d, n/32).
 
+    This is the codec-canonical layout (it mirrors the wire format's 16
+    nibble-bytes per block, reference src/quants.hpp:16-19). The TPU matmul
+    kernel wants ``Q40Kernel`` instead — see ``to_kernel_layout``.
+
     NamedTuple => automatically a jax pytree; usable directly under jit/scan.
     """
 
@@ -38,6 +42,49 @@ class Q40Weight(NamedTuple):
     @property
     def logical_shape(self) -> tuple[int, ...]:
         return (*self.qs.shape[:-2], self.qs.shape[-2] * 32)
+
+
+class Q40Kernel(NamedTuple):
+    """Kernel-tiled planar Q40: qs_t uint8 (..., 16, d, n/32), scale f32
+    (..., d, n/32).
+
+    The nibble-position axis leads so the Pallas kernel (ops/pallas_q40.py)
+    streams plain 2D (rows, blocks) tiles whose minor dim is the block index:
+    the per-block scale then lines up with the codes elementwise and the
+    kernel needs no minor-dim reshape/interleave (which Mosaic does not
+    support). Scales are f32 because Mosaic has no f16 vectors — f16->f32 is
+    exact, so the value map is unchanged. Produced once at load time by
+    ``to_kernel_layout`` — never re-tile inside a jitted per-token step.
+    """
+
+    qs_t: np.ndarray
+    scale: np.ndarray
+
+    @property
+    def logical_shape(self) -> tuple[int, ...]:
+        return (*self.scale.shape[:-1], self.scale.shape[-1] * 32)
+
+
+def to_kernel_layout(w: Q40Weight) -> Q40Kernel:
+    """(..., d, nb, 16) -> (..., 16, d, nb), one-time load-side re-tiling."""
+    qs = w.qs
+    nd = qs.ndim
+    perm = tuple(range(nd - 3)) + (nd - 1, nd - 3, nd - 2)
+    qs_t = qs.transpose(perm)
+    if isinstance(qs_t, np.ndarray):
+        qs_t = np.ascontiguousarray(qs_t)
+    return Q40Kernel(qs_t, w.d16.astype(np.float32))
+
+
+def from_kernel_layout(w: Q40Kernel) -> Q40Weight:
+    qs_t = w.qs_t
+    nd = qs_t.ndim
+    perm = tuple(range(nd - 3)) + (nd - 2, nd - 1, nd - 3)
+    qs = qs_t.transpose(perm)
+    if isinstance(qs, np.ndarray):
+        qs = np.ascontiguousarray(qs)
+    # scales were exactly upconverted f16->f32; the downcast is lossless
+    return Q40Weight(qs, w.scale.astype(np.float16))
 
 
 def read_spec(path: str, weights_float_type=FloatType.F32,

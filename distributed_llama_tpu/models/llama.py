@@ -236,11 +236,28 @@ def decode_step(spec: TransformerSpec, params: dict[str, Any], cache: KVCache,
 
 
 def params_to_device(params: dict[str, Any], dtype=None) -> dict[str, Any]:
-    """Move a numpy param tree onto the default device as jax arrays."""
+    """Move a numpy param tree onto the default device as jax arrays.
+
+    Q40 weights are re-tiled to the Pallas kernel layout here (once, host
+    side) when the Q40 fast path is active — see ops/linear.pack_q40_params.
+    """
+    from ..io.loader import Q40Kernel, Q40Weight
+    from ..ops.linear import pack_q40_params
+
+    params = pack_q40_params(params)
+
     def conv(a):
         x = jnp.asarray(a)
         if dtype is not None and x.dtype in (jnp.float32, jnp.float16):
             x = x.astype(dtype)
         return x
 
-    return jax.tree_util.tree_map(conv, params)
+    out = {}
+    for k, v in params.items():
+        if isinstance(v, (Q40Weight, Q40Kernel)):
+            # quantized leaves keep their exact codec/kernel dtypes — the
+            # dtype knob is for dense weights only (scales must stay f32/f16)
+            out[k] = jax.tree_util.tree_map(jnp.asarray, v)
+        else:
+            out[k] = conv(v)
+    return out

@@ -1,0 +1,39 @@
+"""Seeded synthetic parameter trees for tests, dryruns, and benches.
+
+Mirrors the shape/layout contract of io.loader.load_model: per-layer matmul
+weights stacked along a leading layer axis, Q40 weights as codec-layout
+Q40Weight pairs (kernel re-tiling happens downstream in params_to_device /
+shard_params, like for file-loaded weights).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..io.loader import Q40Weight
+from ..ops.quants import quantize_q40
+from .spec import TransformerSpec
+
+
+def synth_params(spec: TransformerSpec, q40: bool, seed: int = 0,
+                 scale: float = 0.05) -> dict:
+    rng = np.random.default_rng(seed)
+
+    def t(*shape):
+        return (rng.standard_normal(shape) * scale).astype(np.float32)
+
+    def mm(*shape):
+        x = t(*shape)
+        if not q40:
+            return x
+        qs, d16 = quantize_q40(x)
+        return Q40Weight(qs, d16)
+
+    p = {"tok_embedding": t(spec.vocab_size, spec.dim),
+         "rms_final": 1 + t(spec.dim),
+         "rms_att": 1 + t(spec.n_layers, spec.dim),
+         "rms_ffn": 1 + t(spec.n_layers, spec.dim),
+         "wcls": mm(spec.vocab_size, spec.dim)}
+    for name, shape in spec.layer_matmul_shapes():
+        p[name] = mm(spec.n_layers, *shape)
+    return p

@@ -23,7 +23,7 @@ import os
 import jax
 import jax.numpy as jnp
 
-from ..io.loader import Q40Weight
+from ..io.loader import Q40Kernel, Q40Weight, from_kernel_layout, to_kernel_layout
 from .quants import dequantize_q40_jax, dequantize_q80_jax, quantize_q80_jax
 
 RMS_EPS = 1e-5
@@ -46,6 +46,8 @@ def silu(x: jax.Array) -> jax.Array:
 
 def dequantize_weight(w) -> jax.Array:
     """Materialize any weight representation as f32 (d, n)."""
+    if isinstance(w, Q40Kernel):
+        w = from_kernel_layout(w)
     if isinstance(w, Q40Weight):
         return dequantize_q40_jax(w.qs, w.d16)
     return jnp.asarray(w).astype(jnp.float32)
@@ -72,11 +74,14 @@ def matmul(w, x: jax.Array, *, prefer_pallas: bool = False) -> jax.Array:
     Pallas fused-dequant kernel (HBM traffic = packed bytes; the default on
     TPU) or dequantizes inline and dots (the XLA fallback).
     """
-    if isinstance(w, Q40Weight) and (prefer_pallas
-                                     or q40_kernel_mode() == "pallas"):
-        from .pallas_q40 import q40_matmul  # lazy: only on Q40 paths
+    if isinstance(w, (Q40Weight, Q40Kernel)) and (
+            prefer_pallas or q40_kernel_mode() == "pallas"):
+        from .pallas_q40 import kernel_supports, q40_matmul  # lazy
 
-        return q40_matmul(w, x)
+        if kernel_supports(w.logical_shape[-2]):
+            return q40_matmul(w, x)
+        # fall through: odd output dims (no multiple-of-8 divisor) take the
+        # dequantize-then-dot path below
     wf = dequantize_weight(w)
     # HIGHEST: true f32 MXU accumulation — required for the 1e-5 logit-parity
     # contract on TPU (default TPU precision is bf16-input). The quantized
@@ -84,6 +89,34 @@ def matmul(w, x: jax.Array, *, prefer_pallas: bool = False) -> jax.Array:
     return jnp.einsum("dn,...n->...d", wf, x.astype(jnp.float32),
                       preferred_element_type=jnp.float32,
                       precision=jax.lax.Precision.HIGHEST)
+
+
+def pack_q40_params(params: dict, enable: bool | None = None,
+                    tp: int = 1) -> dict:
+    """Re-tile every Q40Weight in a param tree to the kernel layout, once.
+
+    ``enable=None`` means "iff the Pallas kernel will be used" — so CPU/test
+    runs keep the codec layout and the golden-parity paths are untouched.
+    ``tp`` is the tensor-parallel degree the weights will be sharded to:
+    kernel support is decided on the shard-LOCAL output dim (d/tp), since
+    that is what the kernel tiles inside shard_map.
+    Call this at load time, before device_put; never inside a jitted step.
+    """
+    if enable is None:
+        enable = q40_kernel_mode() == "pallas"
+    if not enable:
+        return params
+    from .pallas_q40 import kernel_supports
+
+    # weights the kernel can't tile stay codec-layout: they take the XLA
+    # fallback in matmul(), which would otherwise pay a full re-transpose
+    # inside the jitted step on every call
+    return {k: to_kernel_layout(v)
+            if isinstance(v, Q40Weight)
+            and v.logical_shape[-2] % tp == 0
+            and kernel_supports(v.logical_shape[-2] // tp)
+            else v
+            for k, v in params.items()}
 
 
 def fake_quant_q80(x: jax.Array) -> jax.Array:

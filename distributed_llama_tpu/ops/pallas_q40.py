@@ -7,19 +7,30 @@ HBM traffic per token is the packed bytes, not dequantized f32. This is what
 makes single-token decode HBM-bound at the Q40 size instead of the f32 size
 (the dequantize-then-dot XLA fallback in ops/linear.py materializes f32 tiles).
 
-Layout in the kernel (see ops/quants.py for the wire format):
-  qs2d (d, nb*16) uint8 — column c = b*16+j holds codes for values b*32+j
-                           (low nibble) and b*32+j+16 (high nibble)
-  d16  (d, nb) float16  — per-block deltas
-  x is pre-split OUTSIDE the kernel into xlo/xhi (T, nb*16) matching the
-  column order, so the kernel is: out[t, r] = sum_c (lo[r,c]-8)*s[r,c/16]*xlo[t,c]
-                                            + (hi[r,c]-8)*s[r,c/16]*xhi[t,c]
-  computed as two MXU dots against the unpacked row band.
+Mosaic constraint that shapes this kernel: there is no supported way to
+expand per-block scales (R, nb) to per-value (R, nb*16) inside the kernel
+(minor-dim broadcast+reshape is an "unsupported shape cast"). So instead of
+one wide dot over all 32 values per block, the grid carries the nibble
+position j = 0..15 as its innermost axis and every step is pure 2D:
 
-Grid: one step per ``block_rows`` output rows; Pallas double-buffers the HBM
-loads across steps automatically. Non-TPU backends run in interpret mode
-(tests); the numerics are the exact Q40 value map, so parity with the XLA
-path is bit-tight at f32.
+  qs_t   (16, d, nb) uint8  — qs_t[j, r, b] packs values x[b*32+j] (low
+                               nibble) and x[b*32+j+16] (high nibble)
+  scale  (d, nb) float32    — per-block deltas (f32: Mosaic has no f16
+                               vectors; the f16->f32 upconvert is exact)
+  xlo/xhi (16, t, nb) f32   — xlo[j, t, b] = x[t, b*32+j], xhi: +16
+
+  step (ti, i, j):  out[ti, i] += xlo[j] @ ((lo(qs_t[j]) - 8) * scale).T
+                               +  xhi[j] @ ((hi(qs_t[j]) - 8) * scale).T
+
+The (16, d, nb) weight tiling is prepared ONCE at load time
+(io.loader.to_kernel_layout); feeding a codec-layout Q40Weight works but
+re-tiles on every call — fine under test, wrong for the per-token hot loop.
+
+Grid: (t tiles, d tiles, 16); j innermost so the output tile stays resident
+in VMEM across its 16 accumulation steps; Pallas double-buffers the packed
+HBM loads across steps. Non-TPU backends run in interpret mode (tests); the
+numerics are the exact Q40 value map, so parity with the XLA path is
+bit-tight at f32.
 """
 
 from __future__ import annotations
@@ -29,59 +40,72 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
 
-from ..io.loader import Q40Weight
+from ..io.loader import Q40Kernel, Q40Weight, to_kernel_layout
 
 QK = 32
+NJ = 16  # nibble positions per block byte-plane
 
 
-def _kernel(qs_ref, d16_ref, xlo_ref, xhi_ref, out_ref, *, block_rows, nb):
-    q = qs_ref[...]                                   # (Rb, nb*16) uint8
-    scales = d16_ref[...].astype(jnp.float32)         # (Rb, nb)
-    lo = (q & 0xF).astype(jnp.int32) - 8
-    hi = (q >> 4).astype(jnp.int32) - 8
-    sc = jnp.broadcast_to(scales[:, :, None],
-                          (block_rows, nb, 16)).reshape(block_rows, nb * 16)
-    wlo = lo.astype(jnp.float32) * sc
-    whi = hi.astype(jnp.float32) * sc
-    acc = jnp.dot(xlo_ref[...], wlo.T, preferred_element_type=jnp.float32)
-    acc += jnp.dot(xhi_ref[...], whi.T, preferred_element_type=jnp.float32)
-    out_ref[...] = acc                                # (T, Rb)
+def _kernel(qs_ref, scale_ref, xlo_ref, xhi_ref, out_ref):
+    j = pl.program_id(2)
+    q = qs_ref[0].astype(jnp.int32)              # (R, nb)
+    s = scale_ref[...]                           # (R, nb) f32
+    wlo = ((q & 0xF) - 8).astype(jnp.float32) * s
+    whi = ((q >> 4) - 8).astype(jnp.float32) * s
+    dn = (((1,), (1,)), ((), ()))                # contract both minor dims
+    # HIGHEST: true f32 MXU passes — the parity contract; decode is HBM-bound
+    # on the packed weights, so the extra passes don't move the bottleneck
+    acc = jax.lax.dot_general(xlo_ref[0], wlo, dn,
+                              preferred_element_type=jnp.float32,
+                              precision=jax.lax.Precision.HIGHEST)
+    acc = acc + jax.lax.dot_general(xhi_ref[0], whi, dn,
+                                    preferred_element_type=jnp.float32,
+                                    precision=jax.lax.Precision.HIGHEST)
+
+    @pl.when(j == 0)
+    def _init():
+        out_ref[...] = acc
+
+    @pl.when(j > 0)
+    def _accumulate():
+        out_ref[...] += acc
 
 
 def _split_x(x: jax.Array, nb: int) -> tuple[jax.Array, jax.Array]:
-    """(T, n) f32 -> xlo/xhi (T, nb*16) in kernel column order."""
+    """(T, n) f32 -> xlo/xhi (16, T, nb) in kernel plane order."""
     t = x.shape[0]
-    xb = x.reshape(t, nb, QK)
-    return (xb[:, :, :16].reshape(t, nb * 16),
-            xb[:, :, 16:].reshape(t, nb * 16))
+    x3 = x.reshape(t, nb, QK)
+    xlo = jnp.transpose(x3[:, :, :NJ], (2, 0, 1))
+    xhi = jnp.transpose(x3[:, :, NJ:], (2, 0, 1))
+    return xlo, xhi
 
 
-@functools.partial(jax.jit, static_argnames=("block_rows", "interpret"))
-def _q40_matmul_2d(qs2d, d16, x, *, block_rows, interpret):
-    d, ncols = qs2d.shape
-    nb = ncols // 16
+@functools.partial(jax.jit,
+                   static_argnames=("block_rows", "block_t", "interpret"))
+def _q40_matmul_2d(qs_t, scale, x, *, block_rows, block_t, interpret):
+    _, d, nb = qs_t.shape
     t = x.shape[0]
     xlo, xhi = _split_x(x.astype(jnp.float32), nb)
-    grid = (d // block_rows,)
+    grid = (t // block_t, d // block_rows, NJ)
     out = pl.pallas_call(
-        functools.partial(_kernel, block_rows=block_rows, nb=nb),
+        _kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((block_rows, ncols), lambda i: (i, 0)),
-            pl.BlockSpec((block_rows, nb), lambda i: (i, 0)),
-            pl.BlockSpec((t, ncols), lambda i: (0, 0)),
-            pl.BlockSpec((t, ncols), lambda i: (0, 0)),
+            pl.BlockSpec((1, block_rows, nb), lambda ti, i, j: (j, i, 0)),
+            pl.BlockSpec((block_rows, nb), lambda ti, i, j: (i, 0)),
+            pl.BlockSpec((1, block_t, nb), lambda ti, i, j: (j, ti, 0)),
+            pl.BlockSpec((1, block_t, nb), lambda ti, i, j: (j, ti, 0)),
         ],
-        out_specs=pl.BlockSpec((t, block_rows), lambda i: (0, i)),
+        out_specs=pl.BlockSpec((block_t, block_rows),
+                               lambda ti, i, j: (ti, i)),
         out_shape=jax.ShapeDtypeStruct((t, d), jnp.float32),
         interpret=interpret,
-    )(qs2d, d16, xlo, xhi)
+    )(qs_t, scale, xlo, xhi)
     return out
 
 
-def _pick_block_rows(d: int) -> int:
+def _pick_block_rows(d: int) -> int | None:
     for cand in (512, 256, 128):
         if d % cand == 0:
             return cand
@@ -90,29 +114,49 @@ def _pick_block_rows(d: int) -> int:
     for cand in range(top, 0, -8):
         if d % cand == 0:
             return cand
-    raise ValueError(
-        f"q40_matmul needs an output dim with a multiple-of-8 divisor, "
-        f"got d={d}")
+    return None
 
 
-def q40_matmul(w: Q40Weight, x: jax.Array,
+def kernel_supports(d: int) -> bool:
+    """Whether the fused kernel can tile this output dim (callers fall back
+    to the XLA dequantize-then-dot path when not — see ops/linear.matmul)."""
+    return _pick_block_rows(d) is not None
+
+
+def _pick_block_t(t: int) -> int:
+    if t <= 256:
+        return t
+    for cand in (256, 128, 64, 32, 16, 8):
+        if t % cand == 0:
+            return cand
+    return t
+
+
+def q40_matmul(w: Q40Kernel | Q40Weight, x: jax.Array,
                block_rows: int | None = None,
                interpret: bool | None = None) -> jax.Array:
     """out[..., d] = dequant(w)(d, n) @ x[..., n], packed weights end to end.
 
     x may be (n,) or (..., n); leading dims are flattened into T for the
-    kernel and restored after.
+    kernel and restored after. ``w`` should be a pre-tiled Q40Kernel on the
+    hot path; a Q40Weight is accepted and re-tiled per call (tests only).
     """
-    qs, d16 = w.qs, w.d16
-    d, nb = qs.shape[-3], qs.shape[-2]
+    if isinstance(w, Q40Weight):
+        w = to_kernel_layout(w)
+    qs_t, scale = w.qs_t, w.scale
+    _, d, nb = qs_t.shape
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     if block_rows is None:
         block_rows = _pick_block_rows(d)
+        if block_rows is None:
+            raise ValueError(
+                f"q40_matmul needs an output dim with a multiple-of-8 "
+                f"divisor, got d={d}")
     lead = x.shape[:-1]
     n = x.shape[-1]
     x2 = x.reshape(-1, n)
-    qs2d = qs.reshape(d, nb * 16)
-    out = _q40_matmul_2d(qs2d, d16, x2, block_rows=block_rows,
-                         interpret=interpret)
+    block_t = _pick_block_t(x2.shape[0])
+    out = _q40_matmul_2d(qs_t, scale, x2, block_rows=block_rows,
+                         block_t=block_t, interpret=interpret)
     return out.reshape(*lead, d)

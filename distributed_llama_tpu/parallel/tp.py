@@ -37,7 +37,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..io.loader import Q40Weight
+from ..io.loader import Q40Kernel, Q40Weight
 from ..models.llama import (KVCache, attention_core, causal_cache_mask,
                             rope_rotate)
 from ..models.spec import TransformerSpec
@@ -70,6 +70,14 @@ def param_specs(params: dict[str, Any]) -> dict[str, Any]:
             qs_spec = P(*spec, *([None] * extra))
             d_spec = P(*spec, *([None] * (len(val.d16.shape) - len(spec))))
             specs[name] = Q40Weight(qs_spec, d_spec)
+        elif isinstance(val, Q40Kernel):
+            # qs_t (..., 16, d, nb): the sharded d axis moves to -2, with the
+            # nibble-plane axis inserted before it; scale (..., d, nb) keeps
+            # the logical spec shape
+            base = tuple(spec)
+            qs_spec = P(*base[:-2], None, *base[-2:])
+            d_spec = P(*base, *([None] * (len(val.scale.shape) - len(base))))
+            specs[name] = Q40Kernel(qs_spec, d_spec)
         else:
             specs[name] = spec
     return specs
@@ -80,7 +88,14 @@ CACHE_SPEC = KVCache(P(None, "sp", "tp", None), P(None, "sp", "tp", None))
 
 
 def shard_params(params: dict[str, Any], mesh: Mesh) -> dict[str, Any]:
-    """device_put the param tree with MatmulSlice-equivalent shardings."""
+    """device_put the param tree with MatmulSlice-equivalent shardings.
+
+    Q40 weights are re-tiled to the Pallas kernel layout first (host side,
+    once) when the Q40 fast path is active.
+    """
+    from ..ops.linear import pack_q40_params
+
+    params = pack_q40_params(params, tp=mesh.shape["tp"])
     specs = param_specs(params)
     return jax.tree_util.tree_map(
         lambda a, s: jax.device_put(jnp.asarray(a), NamedSharding(mesh, s)),
