@@ -74,6 +74,10 @@ def cmd_inference(argv: list[str], quiet: bool = False) -> int:
                     help="accepted for reference-CLI compatibility; on TPU "
                          "the workers are the chips of the mesh (see module "
                          "docstring for multi-host)")
+    ap.add_argument("--fast", action="store_true",
+                    help="fused on-device generation loop (one device "
+                         "program for the whole chain; no per-token stats "
+                         "lines)")
     _add_common(ap)
     args = ap.parse_args(argv)
     if args.coordinator and args.seed is None:
@@ -92,7 +96,7 @@ def cmd_inference(argv: list[str], quiet: bool = False) -> int:
     from ..io.loader import load_model
     from ..io.tokenizer import Tokenizer
     from ..parallel import make_mesh
-    from ..runtime.generate import Engine, generate
+    from ..runtime.generate import Engine, generate, generate_fast
     from ..runtime.sampling import Sampler
 
     wft = _FT[args.weights_float_type]
@@ -121,8 +125,9 @@ def cmd_inference(argv: list[str], quiet: bool = False) -> int:
     sampler = Sampler(spec.vocab_size, args.temperature, args.topp, seed)
     # pieces print inside the per-token stats lines (reference behavior:
     # tokenizer.cpp prints each piece once, at the end of the 🔶 line)
-    generate(engine, tokenizer, sampler, args.prompt or "", args.steps,
-             quiet=quiet)
+    gen = generate_fast if args.fast else generate
+    gen(engine, tokenizer, sampler, args.prompt or "", args.steps,
+        quiet=quiet)
     return 0
 
 

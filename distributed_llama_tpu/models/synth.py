@@ -15,6 +15,42 @@ from ..ops.quants import quantize_q40
 from .spec import TransformerSpec
 
 
+def _build_tree(spec: TransformerSpec, t, mm) -> dict:
+    """Assemble the param tree from a dense builder ``t`` and a matmul-weight
+    builder ``mm`` — the one place that knows the tree's key set."""
+    p = {"tok_embedding": t(spec.vocab_size, spec.dim),
+         "rms_final": 1 + t(spec.dim),
+         "rms_att": 1 + t(spec.n_layers, spec.dim),
+         "rms_ffn": 1 + t(spec.n_layers, spec.dim),
+         "wcls": mm(spec.vocab_size, spec.dim)}
+    for name, shape in spec.layer_matmul_shapes():
+        p[name] = mm(spec.n_layers, *shape)
+    return p
+
+
+def synth_q40_fast(spec: TransformerSpec, seed: int = 0) -> dict:
+    """Random Q40 params built directly as packed bytes — for benchmarks.
+
+    Skips the float-generate + quantize pass (minutes for 7B in numpy):
+    decode TIMING is value-independent, so random nibble codes + small
+    positive f16 deltas give the exact memory layout and dataflow of real
+    weights at negligible synthesis cost. Not for numerics tests.
+    """
+    rng = np.random.default_rng(seed)
+
+    def t(*shape):
+        return (rng.standard_normal(shape) * 0.05).astype(np.float32)
+
+    def mm(*shape):
+        *lead, d, n = shape
+        qs = rng.integers(0, 256, (*lead, d, n // 32, 16), dtype=np.uint8)
+        d16 = (rng.random((*lead, d, n // 32), dtype=np.float32)
+               * 0.01 + 1e-4).astype(np.float16)
+        return Q40Weight(qs, d16)
+
+    return _build_tree(spec, t, mm)
+
+
 def synth_params(spec: TransformerSpec, q40: bool, seed: int = 0,
                  scale: float = 0.05) -> dict:
     rng = np.random.default_rng(seed)
@@ -29,11 +65,4 @@ def synth_params(spec: TransformerSpec, q40: bool, seed: int = 0,
         qs, d16 = quantize_q40(x)
         return Q40Weight(qs, d16)
 
-    p = {"tok_embedding": t(spec.vocab_size, spec.dim),
-         "rms_final": 1 + t(spec.dim),
-         "rms_att": 1 + t(spec.n_layers, spec.dim),
-         "rms_ffn": 1 + t(spec.n_layers, spec.dim),
-         "wcls": mm(spec.vocab_size, spec.dim)}
-    for name, shape in spec.layer_matmul_shapes():
-        p[name] = mm(spec.n_layers, *shape)
-    return p
+    return _build_tree(spec, t, mm)

@@ -1,0 +1,95 @@
+"""On-device generation loop: the whole token loop as ONE jitted program.
+
+The reference's generation loop (tokenizer.cpp:321-394) calls infer() once per
+token from the host. On TPU that per-token host round-trip costs more than the
+7B forward pass itself (dispatch + transfer latency, especially over a remote
+runtime), so the TPU-native hot path moves the loop on device: a ``lax.scan``
+over decode steps where each step runs the forward pass AND picks the next
+token, with no host involvement until the whole chain is done.
+
+Sampling runs on device with the reference's semantics (tokenizer.cpp:206-319):
+argmax at temperature 0, otherwise softmax(logits/temp) + nucleus top-p with
+the (1-p)/(n-1) cutoff pre-filter, or a plain multinomial CDF walk when topp
+is outside (0,1). The per-step random coins are the ONE thing precomputed on
+the host: the reference draws them from a stateful xorshift64* stream
+(utils.cpp:27-38), and the stream is data-independent, so the host pre-draws
+``coins[i]`` for every post-prompt step and the device consumes them in order
+— bit-identical coin sequence, no uint64 emulation on device.
+
+Early stop: the reference breaks on BOS before decoding it. A fixed-length
+scan cannot break, so the device runs all ``steps`` and the HOST truncates at
+the first BOS — identical output tokens, some wasted compute only when the
+chain terminates early (a latency trade the reference never faces because its
+per-token dispatch is free on CPU).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+# step_fn(params, cache, tokens (1,), pos) -> (logits (1, V), cache)
+StepFn = Callable[..., tuple[jax.Array, Any]]
+
+
+def sample_device(logits: jax.Array, coin: jax.Array, temperature: float,
+                  topp: float) -> jax.Array:
+    """Reference Sampler::sample on device. logits (V,) f32; coin scalar f32.
+
+    temperature/topp are static (fixed per generation run), so the strategy
+    branch resolves at trace time.
+    """
+    if temperature == 0.0:
+        return jnp.argmax(logits).astype(jnp.int32)
+    probs = jax.nn.softmax(logits.astype(jnp.float32) / temperature)
+    v = logits.shape[-1]
+    if topp <= 0 or topp >= 1:
+        # multinomial CDF walk (tokenizer.cpp:226-238)
+        cdf = jnp.cumsum(probs)
+        idx = jnp.searchsorted(cdf, coin, side="right")
+        return jnp.minimum(idx, v - 1).astype(jnp.int32)
+    # nucleus: cutoff pre-filter, descending sort, cut at cum > topp, then
+    # CDF walk over the kept prefix scaled by coin*cum (tokenizer.cpp:240-281)
+    cutoff = (1.0 - topp) / (v - 1)
+    kept = jnp.where(probs >= cutoff, probs, 0.0)
+    order = jnp.argsort(-kept)  # stable: ties keep index order
+    p_sorted = kept[order]
+    cum = jnp.cumsum(p_sorted)
+    # first index where cumulative prob exceeds topp (== last kept index)
+    last = jnp.argmax(cum > topp)
+    last = jnp.where(cum[-1] > topp, last, v - 1)
+    r = coin * cum[last]
+    idx = jnp.searchsorted(cum, r, side="right")
+    idx = jnp.minimum(idx, last)
+    return order[idx].astype(jnp.int32)
+
+
+def make_decode_loop(step_fn: StepFn, steps: int, temperature: float,
+                     topp: float):
+    """Build run(params, cache, prompt_padded, first_token, coins) ->
+    (tokens (steps,), cache): the fused generation loop.
+
+    prompt_padded: (steps+1,) int32, prompt tokens then -1 padding. Position
+    ``p`` forces prompt_padded[p+1] when >= 0, else samples — exactly the
+    forced-prompt-then-sample schedule of the reference loop
+    (tokenizer.cpp:360-366). coins: (steps,) f32, consumed at sampled steps.
+    """
+
+    def run(params, cache, prompt_padded, first_token, coins):
+        def body(carry, xs):
+            token, cache = carry
+            pos, coin, forced = xs
+            logits, cache = step_fn(params, cache, token[None], pos)
+            sampled = sample_device(logits[0], coin, temperature, topp)
+            nxt = jnp.where(forced >= 0, forced, sampled)
+            return (nxt, cache), nxt
+
+        xs = (jnp.arange(steps, dtype=jnp.int32), coins, prompt_padded[1:])
+        (_, cache), toks = jax.lax.scan(body, (first_token, cache), xs)
+        return toks, cache
+
+    return jax.jit(run, donate_argnums=1)
+
+

@@ -46,6 +46,7 @@ class Engine:
         self.tp = mesh.shape["tp"] if mesh is not None else 1
         self.sp = mesh.shape.get("sp", 1) if mesh is not None else 1
         self.sharded = self.tp > 1 or self.sp > 1
+        self._loops: dict = {}  # (steps, temp, topp) -> compiled device loop
         if self.sharded:
             from ..parallel import (make_sharded_forward, shard_cache,
                                     shard_params)
@@ -53,13 +54,14 @@ class Engine:
             self.params = shard_params(params, mesh)
             self.cache = shard_cache(init_cache(spec), mesh)
             self._fwd = make_sharded_forward(spec, mesh)
+            self._step_raw = self._fwd  # shard_map wrapper; traceable in scan
         else:
             from ..models.llama import params_to_device
 
             self.params = params_to_device(params)
             self.cache = init_cache(spec)
-            self._fwd = jax.jit(
-                functools.partial(forward, spec), donate_argnums=1)
+            self._step_raw = functools.partial(forward, spec)
+            self._fwd = jax.jit(self._step_raw, donate_argnums=1)
 
     def infer(self, token: int, pos: int) -> np.ndarray:
         """One decode step; returns f32 logits (vocab,). Blocks on device."""
@@ -67,6 +69,16 @@ class Engine:
         logits, self.cache = self._fwd(self.params, self.cache, tok,
                                        self.jnp.int32(pos))
         return np.asarray(logits[0])
+
+    def decode_loop(self, steps: int, temperature: float, topp: float):
+        """Compiled on-device generation loop for this engine (cached)."""
+        from .decode import make_decode_loop
+
+        key = (steps, temperature, topp)
+        if key not in self._loops:
+            self._loops[key] = make_decode_loop(self._step_raw, steps,
+                                                temperature, topp)
+        return self._loops[key]
 
     def reset(self):
         self.cache = init_cache(self.spec)
@@ -153,4 +165,79 @@ def generate(engine: Engine, tokenizer: Tokenizer, sampler: Sampler,
         print(f"Avg generation time: {g:.2f} ms")
         print(f"Avg inference time:  {i:.2f} ms")
         print(f"Avg transfer time:   {t:.2f} ms")
+    return out_tokens, stats
+
+
+def generate_fast(engine: Engine, tokenizer: Tokenizer, sampler: Sampler,
+                  prompt: str, steps: int,
+                  quiet: bool = False) -> tuple[list[int], GenStats]:
+    """The fused-loop generation path: one device program for the whole chain.
+
+    Same observable token stream as generate() (forced prompt, reference
+    sampler semantics via runtime/decode.py, stop on BOS) but per-token
+    timing collapses into one on-device scan — the TPU-idiomatic hot path.
+    Pieces and the averaged stats line print after the device loop returns.
+    """
+    import numpy as np
+
+    spec = engine.spec
+    steps = min(steps, spec.seq_len)
+    prompt_tokens = tokenizer.encode(prompt or "", bos=True, eos=False)
+    if not prompt_tokens:
+        raise ValueError("something is wrong, expected at least 1 prompt token")
+    if len(prompt_tokens) > steps + 1:
+        prompt_tokens = prompt_tokens[:steps + 1]
+
+    run = engine.decode_loop(steps, sampler.temperature, sampler.topp)
+
+    jnp = engine.jnp
+    padded = np.full((steps + 1,), -1, dtype=np.int32)
+    padded[:len(prompt_tokens)] = prompt_tokens
+    # pre-draw the xorshift coins for every potentially-sampled step, in the
+    # order the device consumes them (positions >= len(prompt)-1); drawn on a
+    # THROWAWAY copy of the rng so the sampler's stream can be rewound to
+    # exactly what the per-step loop would have consumed (BOS early stop
+    # means later coins were never "really" drawn)
+    coins = np.zeros((steps,), dtype=np.float32)
+    n_sampled = steps - (len(prompt_tokens) - 1)
+    if n_sampled > 0 and sampler.temperature != 0.0:
+        from ..utils.rng import Xorshift64
+
+        scratch = Xorshift64(0)
+        scratch.state = sampler.rng.state
+        coins[len(prompt_tokens) - 1:] = scratch.f32_array(n_sampled)
+
+    t0 = time.perf_counter()
+    toks, engine.cache = run(engine.params, engine.cache,
+                             jnp.asarray(padded),
+                             jnp.int32(prompt_tokens[0]), jnp.asarray(coins))
+    toks = np.asarray(toks)
+    total_ms = (time.perf_counter() - t0) * 1000
+
+    out_tokens: list[int] = []
+    prev = prompt_tokens[0]
+    for t in map(int, toks):
+        if t == BOS:
+            break
+        out_tokens.append(t)
+        if not quiet:
+            piece = tokenizer.decode_piece(prev, t)
+            print(piece.decode("utf-8", errors="replace"), end="", flush=True)
+        prev = t
+    # advance the sampler's real stream by only the coins the per-step loop
+    # would have consumed: one per SAMPLED iteration, including the one that
+    # produced a terminating BOS (the loop breaks after drawing it)
+    if n_sampled > 0 and sampler.temperature != 0.0:
+        early_bos = len(out_tokens) < steps
+        last_iter = len(out_tokens) if early_bos else steps - 1
+        consumed = max(0, last_iter - (len(prompt_tokens) - 1) + 1)
+        if consumed:
+            sampler.rng.f32_array(min(consumed, n_sampled))
+    n = max(1, len(out_tokens))
+    stats = GenStats(tokens=len(out_tokens), total_ms=total_ms,
+                     infer_ms=total_ms, host_ms=0.0)
+    if not quiet:
+        print(f"\nGenerated tokens:    {stats.tokens}")
+        print(f"Avg generation time: {total_ms / n:.2f} ms "
+              f"(fused loop, {steps} device steps)")
     return out_tokens, stats

@@ -1,0 +1,162 @@
+"""On-device fused generation loop vs the per-step host loop.
+
+The fused loop (runtime/decode.py) must reproduce the host loop's observable
+behavior exactly: same sampler semantics (argmax / multinomial / top-p with
+the reference's xorshift coin stream), same forced-prompt schedule, same stop
+on BOS.
+"""
+
+import numpy as np
+import pytest
+
+from distributed_llama_tpu.models.spec import TransformerSpec
+from distributed_llama_tpu.models.synth import synth_params
+from distributed_llama_tpu.ops.quants import FloatType
+
+SPEC = TransformerSpec(dim=64, hidden_dim=160, n_layers=2, n_heads=4,
+                       n_kv_heads=2, vocab_size=256, seq_len=32,
+                       weights_float_type=FloatType.F32)
+
+
+@pytest.mark.parametrize("temperature,topp", [(0.8, 0.9), (1.0, 0.0),
+                                              (0.5, 1.5)])
+def test_sample_device_matches_host(temperature, topp):
+    import jax.numpy as jnp
+
+    from distributed_llama_tpu.runtime.decode import sample_device
+    from distributed_llama_tpu.runtime.sampling import Sampler, softmax_f32
+
+    rng = np.random.default_rng(17)
+    host = Sampler(128, temperature, topp, seed=42)
+    for i in range(20):
+        logits = (rng.standard_normal(128) * 3).astype(np.float32)
+        coin = host.rng.f32()
+        # replay the same coin through the host sampler's strategies
+        probs = softmax_f32(logits / np.float32(temperature))
+        from distributed_llama_tpu.runtime.sampling import (sample_mult,
+                                                            sample_topp)
+
+        if topp <= 0 or topp >= 1:
+            want = sample_mult(probs, coin)
+        else:
+            want = sample_topp(probs, topp, coin)
+        got = int(sample_device(jnp.asarray(logits), jnp.float32(coin),
+                                temperature, topp))
+        assert got == want, f"iter {i}: {got} != {want}"
+
+
+def test_sample_device_argmax():
+    import jax.numpy as jnp
+
+    from distributed_llama_tpu.runtime.decode import sample_device
+
+    logits = np.asarray([0.1, 2.0, -1.0, 1.9], np.float32)
+    assert int(sample_device(jnp.asarray(logits), jnp.float32(0.3),
+                             0.0, 0.9)) == 1
+
+
+@pytest.mark.parametrize("temperature", [0.0, 0.9])
+def test_fused_loop_matches_per_step_generate(temperature):
+    """generate_fast must emit the same token chain as generate()."""
+    from distributed_llama_tpu.io.tokenizer import write_tokenizer, Tokenizer
+    from distributed_llama_tpu.runtime.generate import (Engine, generate,
+                                                        generate_fast)
+    from distributed_llama_tpu.runtime.sampling import Sampler
+
+    params = synth_params(SPEC, q40=False, seed=3, scale=0.3)
+
+    import tempfile
+
+    pieces = [b"<unk>", b"<s>", b"</s>"]
+    pieces += [f"<0x{i:02X}>".encode() for i in range(256)]
+    pieces = pieces[:SPEC.vocab_size - 2] + [b" ", b"hi"]
+    scores = [0.0] * len(pieces)
+    with tempfile.NamedTemporaryFile(suffix=".bin") as f:
+        write_tokenizer(f.name, pieces, scores)
+        tok = Tokenizer(f.name, SPEC.vocab_size)
+
+    eng1 = Engine(SPEC, params)
+    out1, _ = generate(eng1, tok, Sampler(SPEC.vocab_size, temperature, 0.9,
+                                          seed=7),
+                       "hi", steps=12, quiet=True)
+    eng2 = Engine(SPEC, params)
+    out2, _ = generate_fast(eng2, tok, Sampler(SPEC.vocab_size, temperature,
+                                               0.9, seed=7),
+                            "hi", steps=12, quiet=True)
+    assert out1 == out2
+
+
+def test_fused_loop_rng_stream_rewind_on_early_bos():
+    """A BOS-terminated sampled chain must leave the sampler's xorshift
+    stream exactly where the per-step loop would have — reusing the Sampler
+    afterwards has to stay equivalent between the two paths."""
+    from distributed_llama_tpu.io.tokenizer import write_tokenizer, Tokenizer
+    from distributed_llama_tpu.runtime.generate import (Engine, generate,
+                                                        generate_fast)
+    from distributed_llama_tpu.runtime.sampling import Sampler
+
+    # all-zero model -> uniform sampling probs; BOS fires when a coin lands
+    # in its 1/vocab bucket
+    params = synth_params(SPEC, q40=False, seed=3, scale=0.0)
+    params["wcls"] = np.zeros_like(params["wcls"])
+    params["tok_embedding"] = np.zeros_like(params["tok_embedding"])
+
+    import tempfile
+
+    pieces = [b"<unk>", b"<s>", b"</s>"]
+    pieces += [f"<0x{i:02X}>".encode() for i in range(256)]
+    pieces = pieces[:SPEC.vocab_size - 2] + [b" ", b"hi"]
+    with tempfile.NamedTemporaryFile(suffix=".bin") as f:
+        write_tokenizer(f.name, pieces, [0.0] * len(pieces))
+        tok = Tokenizer(f.name, SPEC.vocab_size)
+
+    steps = 12
+    n_prompt = len(tok.encode("hi", bos=True, eos=False))
+    n_sampled = steps - (n_prompt - 1)
+    # find a seed whose uniform-multinomial chain hits BOS mid-stream:
+    # multinomial index = searchsorted(uniform cdf, coin) = floor(coin*vocab)
+    from distributed_llama_tpu.utils.rng import Xorshift64
+
+    seed = next(
+        s for s in range(1, 2000)
+        if any(int(c * SPEC.vocab_size) == 1
+               for c in Xorshift64(s).f32_array(n_sampled - 1)))
+
+    s1 = Sampler(SPEC.vocab_size, 0.7, 0.0, seed)  # topp=0 -> multinomial
+    out1, _ = generate(Engine(SPEC, params), tok, s1, "hi", steps=steps,
+                       quiet=True)
+    s2 = Sampler(SPEC.vocab_size, 0.7, 0.0, seed)
+    out2, _ = generate_fast(Engine(SPEC, params), tok, s2, "hi", steps=steps,
+                            quiet=True)
+    assert out1 == out2
+    assert len(out1) < steps  # the chain really did terminate early on BOS
+    assert s1.rng.state == s2.rng.state  # streams in lockstep for reuse
+
+
+def test_fused_loop_tensor_parallel():
+    """The fused loop must also run with the shard_map step (tp mesh)."""
+    from distributed_llama_tpu.io.tokenizer import write_tokenizer, Tokenizer
+    from distributed_llama_tpu.parallel import make_mesh
+    from distributed_llama_tpu.runtime.generate import (Engine, generate,
+                                                        generate_fast)
+    from distributed_llama_tpu.runtime.sampling import Sampler
+
+    params = synth_params(SPEC, q40=False, seed=3, scale=0.3)
+
+    import tempfile
+
+    pieces = [b"<unk>", b"<s>", b"</s>"]
+    pieces += [f"<0x{i:02X}>".encode() for i in range(256)]
+    pieces = pieces[:SPEC.vocab_size - 2] + [b" ", b"hi"]
+    with tempfile.NamedTemporaryFile(suffix=".bin") as f:
+        write_tokenizer(f.name, pieces, [0.0] * len(pieces))
+        tok = Tokenizer(f.name, SPEC.vocab_size)
+
+    ref_eng = Engine(SPEC, params)
+    want, _ = generate(ref_eng, tok, Sampler(SPEC.vocab_size, 0.0, 0.9, 1),
+                       "hi", steps=10, quiet=True)
+    mesh = make_mesh(tp=2)
+    eng = Engine(SPEC, params, mesh=mesh)
+    got, _ = generate_fast(eng, tok, Sampler(SPEC.vocab_size, 0.0, 0.9, 1),
+                           "hi", steps=10, quiet=True)
+    assert got == want
