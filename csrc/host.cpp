@@ -12,6 +12,9 @@
 #include <cstdint>
 #include <cstring>
 #include <cmath>
+#include <string>
+#include <unordered_map>
+#include <vector>
 
 extern "C" {
 
@@ -161,6 +164,84 @@ void q80_encode(const float* in, uint8_t* out, int64_t nb) {
         for (int j = 0; j < 32; j++)
             qs[j] = (int8_t)std::nearbyintf(x[j] * id);  // ties-to-even, NEON parity
     }
+}
+
+// ---- BPE tokenizer encode (reference src/tokenizer.cpp:84-204 semantics) ---
+//
+// The reference's tokenizer is C++; this is our native equivalent of its hot
+// path, `encode`: UTF-8 codepoint split with byte-fallback (+3), then greedy
+// highest-score pair merging. The vocab is handed over once as a concatenated
+// blob + offsets + scores (built by the Python Tokenizer after parsing
+// tokenizer.bin); lookups use a piece -> first-id hash map.
+
+struct TokVocab {
+    std::vector<std::string> pieces;
+    std::vector<float> scores;
+    std::unordered_map<std::string, int32_t> lookup;  // first occurrence wins
+};
+
+void* tok_create(const uint8_t* blob, const int64_t* offsets,
+                 const float* scores, int32_t n) {
+    TokVocab* v = new TokVocab();
+    v->pieces.reserve(n);
+    v->scores.assign(scores, scores + n);
+    for (int32_t i = 0; i < n; i++) {
+        v->pieces.emplace_back((const char*)(blob + offsets[i]),
+                               (size_t)(offsets[i + 1] - offsets[i]));
+        v->lookup.emplace(v->pieces.back(), i);  // keeps first id on dup
+    }
+    return v;
+}
+
+void tok_destroy(void* handle) { delete (TokVocab*)handle; }
+
+// Returns the token count (<= out_cap guaranteed: one token per input byte
+// upper bound). out receives ids; bos/dummy-space/eos handling stays in
+// Python (trivial, and the dummy-space id depends on lookup state there).
+int64_t tok_encode(void* handle, const uint8_t* text, int64_t len,
+                   int32_t* out) {
+    TokVocab* v = (TokVocab*)handle;
+    std::vector<int32_t> toks;
+    toks.reserve((size_t)len);
+
+    // UTF-8 codepoint split (max 4 bytes), byte-fallback (+3) on miss
+    int64_t i = 0;
+    while (i < len) {
+        int64_t j = i + 1;
+        while (j < len && (text[j] & 0xC0) == 0x80 && j - i < 4) j++;
+        std::string chunk((const char*)(text + i), (size_t)(j - i));
+        auto it = v->lookup.find(chunk);
+        if (it != v->lookup.end()) {
+            toks.push_back(it->second);
+        } else {
+            for (int64_t b = i; b < j; b++)
+                toks.push_back((int32_t)text[b] + 3);
+        }
+        i = j;
+    }
+
+    // greedy highest-score merges (reference tokenizer.cpp:169-194)
+    while (true) {
+        float best_score = -1e10f;
+        int32_t best_id = -1;
+        int64_t best_idx = -1;
+        for (int64_t k = 0; k + 1 < (int64_t)toks.size(); k++) {
+            std::string merged = v->pieces[(size_t)toks[(size_t)k]]
+                               + v->pieces[(size_t)toks[(size_t)k + 1]];
+            auto it = v->lookup.find(merged);
+            if (it != v->lookup.end() && v->scores[(size_t)it->second] > best_score) {
+                best_score = v->scores[(size_t)it->second];
+                best_id = it->second;
+                best_idx = k;
+            }
+        }
+        if (best_idx == -1) break;
+        toks[(size_t)best_idx] = best_id;
+        toks.erase(toks.begin() + best_idx + 1);
+    }
+
+    std::memcpy(out, toks.data(), toks.size() * sizeof(int32_t));
+    return (int64_t)toks.size();
 }
 
 }  // extern "C"

@@ -34,6 +34,11 @@ class Tokenizer:
         for i, piece in enumerate(self.vocab):
             # first occurrence wins, like bsearch over a stable-sorted table
             self._lookup.setdefault(piece, i)
+        # native C++ encoder (csrc/host.cpp tok_encode) when buildable; the
+        # Python merge loop below is the always-available fallback
+        from ..utils.native import NativeBpe
+
+        self._native = NativeBpe(self.vocab, self.scores)
 
     def encode(self, text: str | bytes, bos: bool = True,
                eos: bool = False) -> list[int]:
@@ -42,10 +47,20 @@ class Tokenizer:
         tokens: list[int] = []
         if bos:
             tokens.append(BOS)
-        if text:
-            dummy = self._lookup.get(b" ")
-            if dummy is not None:
-                tokens.append(dummy)
+        dummy = self._lookup.get(b" ") if text else None
+
+        if text and self._native.available:
+            # the dummy-prefix space participates in the merge loop; " " is a
+            # single-codepoint chunk, so prepending the byte reproduces
+            # append-dummy-then-split exactly
+            payload = (b" " + text) if dummy is not None else text
+            tokens.extend(self._native.encode(payload))
+            if eos:
+                tokens.append(EOS)
+            return tokens
+
+        if dummy is not None:
+            tokens.append(dummy)
 
         # split into UTF-8 codepoints (max 4 bytes), byte-fallback (+3) on miss
         i = 0

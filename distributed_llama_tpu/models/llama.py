@@ -31,7 +31,8 @@ from typing import Any, NamedTuple
 import jax
 import jax.numpy as jnp
 
-from ..ops.linear import fake_quant_q80, matmul, rmsnorm, silu
+from ..io.loader import Q40Kernel
+from ..ops.linear import StackedQ40, fake_quant_q80, matmul, rmsnorm, silu
 from ..ops.quants import FloatType
 from .spec import TransformerSpec
 
@@ -170,6 +171,25 @@ def _layer(spec: TransformerSpec, x: jax.Array, lw: dict[str, Any],
 LAYER_KEYS = ("rms_att", "rms_ffn", "wq", "wk", "wv", "wo", "w1", "w2", "w3")
 
 
+def split_layer_weights(params: dict[str, Any]):
+    """Partition per-layer weights for the layer scan: stacked Q40Kernel
+    weights stay OUTSIDE the scan carry (the kernel indexes the stack
+    directly via scalar prefetch — see ops/linear.StackedQ40); everything
+    else is scanned normally (sliced per step)."""
+    stacked = {k: params[k] for k in LAYER_KEYS
+               if isinstance(params[k], Q40Kernel)}
+    scanned = {k: params[k] for k in LAYER_KEYS if k not in stacked}
+    return stacked, scanned
+
+
+def layer_view(stacked: dict[str, Any], scanned_slice: dict[str, Any],
+               idx) -> dict[str, Any]:
+    lw = dict(scanned_slice)
+    for k, v in stacked.items():
+        lw[k] = StackedQ40(v, idx)
+    return lw
+
+
 def forward(spec: TransformerSpec, params: dict[str, Any], cache: KVCache,
             tokens: jax.Array, pos: jax.Array) -> tuple[jax.Array, KVCache]:
     """Run T tokens (at absolute positions pos..pos+T-1) through the model.
@@ -180,16 +200,18 @@ def forward(spec: TransformerSpec, params: dict[str, Any], cache: KVCache,
     positions = pos + jnp.arange(t_len)
     x = params["tok_embedding"][tokens].astype(jnp.float32)  # (T, dim)
 
-    layer_weights = {k: params[k] for k in LAYER_KEYS}
+    stacked, scanned = split_layer_weights(params)
 
     def scan_body(x, per_layer):
-        lw, k_cache, v_cache = per_layer
+        idx, lw_slice, k_cache, v_cache = per_layer
+        lw = layer_view(stacked, lw_slice, idx)
         x, k_cache, v_cache = _layer(spec, x, lw, k_cache, v_cache, pos,
                                      positions)
         return x, (k_cache, v_cache)
 
+    idxs = jnp.arange(spec.n_layers, dtype=jnp.int32)
     x, (k_new, v_new) = jax.lax.scan(scan_body, x,
-                                     (layer_weights, cache.k, cache.v))
+                                     (idxs, scanned, cache.k, cache.v))
 
     x = rmsnorm(x, params["rms_final"])
     logits = matmul(params["wcls"], x)
@@ -210,9 +232,11 @@ def forward_seq(spec: TransformerSpec, params: dict[str, Any],
     positions = jnp.arange(T)
     mask = positions[None, :] <= positions[:, None]  # (T, T) causal
 
-    layer_weights = {k: params[k] for k in LAYER_KEYS}
+    stacked, scanned = split_layer_weights(params)
 
-    def body(x, lw):
+    def body(x, per_layer):
+        idx, lw_slice = per_layer
+        lw = layer_view(stacked, lw_slice, idx)
         q, k, v = _qkv_proj(spec, lw, x, positions)
         ao = attention_core(
             spec.head_size, spec.kv_mul,
@@ -222,7 +246,8 @@ def forward_seq(spec: TransformerSpec, params: dict[str, Any],
         x = _post_attention(spec, lw, x, ao)
         return x, None
 
-    x, _ = jax.lax.scan(body, x, layer_weights)
+    idxs = jnp.arange(spec.n_layers, dtype=jnp.int32)
+    x, _ = jax.lax.scan(body, x, (idxs, scanned))
     x = rmsnorm(x, params["rms_final"])
     return matmul(params["wcls"], x)
 

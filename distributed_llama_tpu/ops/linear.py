@@ -19,6 +19,7 @@ Semantics contract (BASELINE.md logit parity):
 from __future__ import annotations
 
 import os
+from typing import Any, NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -27,6 +28,17 @@ from ..io.loader import Q40Kernel, Q40Weight, from_kernel_layout, to_kernel_layo
 from .quants import dequantize_q40_jax, dequantize_q80_jax, quantize_q80_jax
 
 RMS_EPS = 1e-5
+
+
+class StackedQ40(NamedTuple):
+    """A view of one layer inside a stacked Q40Kernel: the weight stays in
+    its (L, ...) stacked array and the Pallas kernel DMAs layer ``layer``
+    directly via scalar prefetch. This is how ``lax.scan`` over layers avoids
+    materializing a per-step copy of each layer's packed weights (XLA's
+    dynamic-slice before a pallas_call would triple weight HBM traffic)."""
+
+    w: Any       # stacked Q40Kernel, qs_t (L, 16, d, nb)
+    layer: Any   # traced scalar int32
 
 
 def rms_inv(x: jax.Array) -> jax.Array:
@@ -46,6 +58,8 @@ def silu(x: jax.Array) -> jax.Array:
 
 def dequantize_weight(w) -> jax.Array:
     """Materialize any weight representation as f32 (d, n)."""
+    if isinstance(w, StackedQ40):
+        w = jax.tree_util.tree_map(lambda a: a[w.layer], w.w)
     if isinstance(w, Q40Kernel):
         w = from_kernel_layout(w)
     if isinstance(w, Q40Weight):
@@ -74,6 +88,10 @@ def matmul(w, x: jax.Array, *, prefer_pallas: bool = False) -> jax.Array:
     Pallas fused-dequant kernel (HBM traffic = packed bytes; the default on
     TPU) or dequantizes inline and dots (the XLA fallback).
     """
+    if isinstance(w, StackedQ40):
+        from .pallas_q40 import q40_matmul  # packing implies kernel support
+
+        return q40_matmul(w.w, x, layer=w.layer)
     if isinstance(w, (Q40Weight, Q40Kernel)) and (
             prefer_pallas or q40_kernel_mode() == "pallas"):
         from .pallas_q40 import kernel_supports, q40_matmul  # lazy

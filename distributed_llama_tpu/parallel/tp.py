@@ -39,7 +39,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..io.loader import Q40Kernel, Q40Weight
 from ..models.llama import (KVCache, attention_core, causal_cache_mask,
-                            rope_rotate)
+                            layer_view, rope_rotate, split_layer_weights)
 from ..models.spec import TransformerSpec
 from ..ops.linear import fake_quant_q80, matmul, rmsnorm, silu
 from ..ops.quants import FloatType
@@ -204,15 +204,18 @@ def make_sharded_forward(spec: TransformerSpec, mesh: Mesh):
         positions = pos + jnp.arange(t_len)
         x = params["tok_embedding"][tokens].astype(jnp.float32)
 
-        lw_tree = {k: params[k] for k in LAYER_KEYS}
+        stacked, scanned = split_layer_weights(params)
 
         def body(x, per_layer):
-            lw, k_c, v_c = per_layer
+            idx, lw_slice, k_c, v_c = per_layer
+            lw = layer_view(stacked, lw_slice, idx)
             x, k_c, v_c = _local_layer(spec, n_slices, n_sp, x, lw, k_c, v_c,
                                        pos, positions)
             return x, (k_c, v_c)
 
-        x, (k_new, v_new) = jax.lax.scan(body, x, (lw_tree, cache.k, cache.v))
+        idxs = jnp.arange(spec.n_layers, dtype=jnp.int32)
+        x, (k_new, v_new) = jax.lax.scan(body, x,
+                                         (idxs, scanned, cache.k, cache.v))
         x = rmsnorm(x, params["rms_final"])
         logits = _gather(matmul(params["wcls"], x))  # vocab bands -> full
         return logits, KVCache(k_new, v_new)

@@ -55,6 +55,15 @@ def _load_locked():
         fn.restype = None
         fn.argtypes = [ctypes.POINTER(ctypes.c_float),
                        ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64]
+    lib.tok_create.restype = ctypes.c_void_p
+    lib.tok_create.argtypes = [ctypes.POINTER(ctypes.c_uint8),
+                               ctypes.POINTER(ctypes.c_int64),
+                               ctypes.POINTER(ctypes.c_float), ctypes.c_int32]
+    lib.tok_destroy.restype = None
+    lib.tok_destroy.argtypes = [ctypes.c_void_p]
+    lib.tok_encode.restype = ctypes.c_int64
+    lib.tok_encode.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint8),
+                               ctypes.c_int64, ctypes.POINTER(ctypes.c_int32)]
     _lib = lib
     return _lib
 
@@ -93,3 +102,42 @@ def q40_decode_wire(buf: np.ndarray, nb: int) -> np.ndarray | None:
     lib.q40_decode(buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
                    out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), nb)
     return out
+
+
+class NativeBpe:
+    """Native greedy-BPE encoder over a parsed vocab. None-able: callers use
+    the Python merge loop when the toolchain/library is unavailable."""
+
+    def __init__(self, pieces: list[bytes], scores: list[float]):
+        self._lib = _load()
+        self._handle = None
+        if self._lib is None:
+            return
+        blob = b"".join(pieces)
+        offs = np.zeros(len(pieces) + 1, dtype=np.int64)
+        np.cumsum([len(p) for p in pieces], out=offs[1:])
+        self._blob = np.frombuffer(blob, dtype=np.uint8).copy()
+        self._scores = np.asarray(scores, dtype=np.float32)
+        self._offs = offs
+        self._handle = self._lib.tok_create(
+            self._blob.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            self._scores.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            len(pieces))
+
+    @property
+    def available(self) -> bool:
+        return self._handle is not None
+
+    def encode(self, text: bytes) -> list[int]:
+        buf = np.frombuffer(text, dtype=np.uint8)
+        out = np.empty(max(len(text), 1), dtype=np.int32)
+        n = self._lib.tok_encode(
+            self._handle,
+            buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), len(text),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+        return out[:n].tolist()
+
+    def __del__(self):
+        if getattr(self, "_handle", None) is not None:
+            self._lib.tok_destroy(self._handle)

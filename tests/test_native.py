@@ -1,0 +1,113 @@
+"""C++ host library vs the pure-Python/numpy reference implementations.
+
+The native layer (csrc/host.cpp via ctypes) must be bit-identical to the
+numpy codecs and the Python tokenizer/rng — it is an accelerated twin, not a
+second implementation of the spec. Skips cleanly when no toolchain exists.
+"""
+
+import numpy as np
+import pytest
+
+from distributed_llama_tpu.utils import native
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native toolchain unavailable")
+
+
+def test_xorshift_stream_parity():
+    from distributed_llama_tpu.utils.rng import Xorshift64
+
+    state, arr = native.xorshift_fill(800000010, 64, divisor=120.0)
+    rng = Xorshift64(800000010)
+    want = (rng.f32_array(64).astype(np.float64) / 120.0).astype(np.float32)
+    np.testing.assert_array_equal(arr, want)
+    assert state == rng.state
+
+
+def test_q40_codec_roundtrip_parity():
+    from distributed_llama_tpu.ops.quants import (pack_q40_bytes,
+                                                  quantize_q40,
+                                                  unpack_q40_bytes)
+
+    x = (np.random.default_rng(3).standard_normal(4096) * 0.5).astype(
+        np.float32)
+    qs, d16 = quantize_q40(x)
+    wire = np.frombuffer(pack_q40_bytes(qs, d16), dtype=np.uint8)
+
+    dec = native.q40_decode_wire(wire, nb=4096 // 32)
+    from distributed_llama_tpu.ops.quants import dequantize_q40
+
+    np.testing.assert_array_equal(dec, dequantize_q40(qs, d16))
+
+
+def test_native_q40_encode_matches_numpy():
+    import ctypes
+
+    lib = native._load()
+    x = (np.random.default_rng(5).standard_normal(2048) * 0.7).astype(
+        np.float32)
+    out = np.empty((2048 // 32) * 18, dtype=np.uint8)
+    lib.q40_encode(x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                   out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                   2048 // 32)
+    from distributed_llama_tpu.ops.quants import pack_q40_bytes, quantize_q40
+
+    qs, d16 = quantize_q40(x)
+    np.testing.assert_array_equal(
+        out, np.frombuffer(pack_q40_bytes(qs, d16), dtype=np.uint8))
+
+
+def test_native_q80_codec_matches_numpy():
+    import ctypes
+
+    lib = native._load()
+    x = (np.random.default_rng(7).standard_normal(1024) * 2.0).astype(
+        np.float32)
+    out = np.empty((1024 // 32) * 34, dtype=np.uint8)
+    lib.q80_encode(x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                   out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                   1024 // 32)
+    from distributed_llama_tpu.ops.quants import pack_q80_bytes, quantize_q80
+
+    qs, d = quantize_q80(x)
+    np.testing.assert_array_equal(
+        out, np.frombuffer(pack_q80_bytes(qs, d), dtype=np.uint8))
+
+    dec = np.empty(1024, dtype=np.float32)
+    lib.q80_decode(out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                   dec.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                   1024 // 32)
+    from distributed_llama_tpu.ops.quants import dequantize_q80
+
+    np.testing.assert_array_equal(dec, dequantize_q80(qs, d))
+
+
+def test_native_bpe_matches_python(tmp_path):
+    from distributed_llama_tpu.io.tokenizer import Tokenizer, write_tokenizer
+
+    pieces = [b"<unk>", b"<s>", b"</s>"]
+    pieces += [f"<0x{i:02X}>".encode() for i in range(256)]
+    pieces += [b" ", b"h", b"i", b"s", b"t", b"hi", b" hi", b"is", b"this",
+               b" this", b"hist"]
+    scores = [0.0] * len(pieces)
+    for p, s in [(b"hi", -1.0), (b" hi", -0.5), (b"is", -1.2), (b"this", -0.3),
+                 (b" this", -0.2), (b"hist", -0.9)]:
+        scores[pieces.index(p)] = s
+    path = str(tmp_path / "tok.bin")
+    write_tokenizer(path, pieces, scores)
+
+    tok = Tokenizer(path, len(pieces))
+    assert tok._native.available
+
+    class _Off:
+        available = False
+
+    for text in ["hi", "this is history", "héllo ✨", "", "x" * 300]:
+        native_ids = tok.encode(text)
+        saved = tok._native
+        tok._native = _Off()  # force the Python merge loop
+        try:
+            py_ids = tok.encode(text)
+        finally:
+            tok._native = saved
+        assert native_ids == py_ids, text
