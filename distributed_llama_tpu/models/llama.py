@@ -152,20 +152,23 @@ def _post_attention(spec: TransformerSpec, lw: dict[str, Any], x: jax.Array,
 
 
 def _layer(spec: TransformerSpec, x: jax.Array, lw: dict[str, Any],
-           k_cache: jax.Array, v_cache: jax.Array, pos: jax.Array,
+           k_all: jax.Array, v_all: jax.Array, idx, pos: jax.Array,
            positions: jax.Array) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """One transformer layer against the STACKED (L, S, n_kv, hs) caches,
+    updated in place at layer ``idx``. This is the body `forward`'s layer
+    scan runs (and what the golden-parity test drives with L=1)."""
     t_len = x.shape[0]
     q, k, v = _qkv_proj(spec, lw, x, positions)
-    k_cache = jax.lax.dynamic_update_slice(
-        k_cache, k.reshape(t_len, spec.n_kv_heads, spec.head_size),
-        (pos, 0, 0))
-    v_cache = jax.lax.dynamic_update_slice(
-        v_cache, v.reshape(t_len, spec.n_kv_heads, spec.head_size),
-        (pos, 0, 0))
+    k_new = k.reshape(1, t_len, spec.n_kv_heads, spec.head_size)
+    v_new = v.reshape(1, t_len, spec.n_kv_heads, spec.head_size)
+    k_all = jax.lax.dynamic_update_slice(k_all, k_new, (idx, pos, 0, 0))
+    v_all = jax.lax.dynamic_update_slice(v_all, v_new, (idx, pos, 0, 0))
+    k_c = jax.lax.dynamic_index_in_dim(k_all, idx, 0, keepdims=False)
+    v_c = jax.lax.dynamic_index_in_dim(v_all, idx, 0, keepdims=False)
     ao = attention(spec, q.reshape(t_len, spec.n_heads, spec.head_size),
-                   k_cache, v_cache, pos, t_len)
+                   k_c, v_c, pos, t_len)
     x = _post_attention(spec, lw, x, ao)
-    return x, k_cache, v_cache
+    return x, k_all, v_all
 
 
 LAYER_KEYS = ("rms_att", "rms_ffn", "wq", "wk", "wv", "wo", "w1", "w2", "w3")
@@ -202,16 +205,23 @@ def forward(spec: TransformerSpec, params: dict[str, Any], cache: KVCache,
 
     stacked, scanned = split_layer_weights(params)
 
-    def scan_body(x, per_layer):
-        idx, lw_slice, k_cache, v_cache = per_layer
+    # The full stacked caches ride in the scan CARRY (updated in place by
+    # dynamic_update_slice at (layer, pos); the per-layer read is a
+    # dynamic-slice XLA fuses into the attention dot). Scanning them as
+    # xs/ys instead would materialize a slice copy in and a re-stack out of
+    # every layer's (seq_len, n_kv, hs) cache plane per token — measured
+    # ~11ms/token extra at 7B/2048 on v5e.
+    def scan_body(carry, per_layer):
+        x, k_all, v_all = carry
+        idx, lw_slice = per_layer
         lw = layer_view(stacked, lw_slice, idx)
-        x, k_cache, v_cache = _layer(spec, x, lw, k_cache, v_cache, pos,
-                                     positions)
-        return x, (k_cache, v_cache)
+        x, k_all, v_all = _layer(spec, x, lw, k_all, v_all, idx, pos,
+                                 positions)
+        return (x, k_all, v_all), None
 
     idxs = jnp.arange(spec.n_layers, dtype=jnp.int32)
-    x, (k_new, v_new) = jax.lax.scan(scan_body, x,
-                                     (idxs, scanned, cache.k, cache.v))
+    (x, k_new, v_new), _ = jax.lax.scan(scan_body, (x, cache.k, cache.v),
+                                        (idxs, scanned))
 
     x = rmsnorm(x, params["rms_final"])
     logits = matmul(params["wcls"], x)

@@ -122,9 +122,11 @@ def _gather(x: jax.Array) -> jax.Array:
 
 
 def _local_layer(spec: TransformerSpec, n_slices: int, n_sp: int, x, lw,
-                 k_cache, v_cache, pos, positions):
+                 k_all, v_all, idx, pos, positions):
     """Per-device layer body. x replicated (T, dim); lw holds local tp bands;
-    k/v_cache hold this device's (sp-chunk, tp-kv-heads) shard."""
+    k/v_all hold this device's STACKED (L, sp-chunk, tp-kv-heads, hs) cache
+    shard — updated in place at layer ``idx`` (see models/llama.forward on
+    why the stack rides in the carry)."""
     t_len = x.shape[0]
     heads_loc = spec.n_heads // n_slices
     kv_heads_loc = spec.n_kv_heads // n_slices
@@ -144,20 +146,28 @@ def _local_layer(spec: TransformerSpec, n_slices: int, n_sp: int, x, lw,
     qh = q.reshape(t_len, heads_loc, spec.head_size)
 
     if n_sp == 1:
-        k_cache = jax.lax.dynamic_update_slice(k_cache, k_new, (pos, 0, 0))
-        v_cache = jax.lax.dynamic_update_slice(v_cache, v_new, (pos, 0, 0))
+        k_all = jax.lax.dynamic_update_slice(k_all, k_new[None],
+                                             (idx, pos, 0, 0))
+        v_all = jax.lax.dynamic_update_slice(v_all, v_new[None],
+                                             (idx, pos, 0, 0))
+        k_c = jax.lax.dynamic_index_in_dim(k_all, idx, 0, keepdims=False)
+        v_c = jax.lax.dynamic_index_in_dim(v_all, idx, 0, keepdims=False)
         # local-head attention (math of transformer-tasks.cpp:206-278 per
         # head); contiguous bands keep h -> h//kvMul purely local
-        ao = attention_core(spec.head_size, spec.kv_mul, qh, k_cache, v_cache,
+        ao = attention_core(spec.head_size, spec.kv_mul, qh, k_c, v_c,
                             causal_cache_mask(spec.seq_len, pos, t_len))
     else:
         from .ring import sp_cache_attention, update_sp_cache
 
         sp_index = jax.lax.axis_index("sp")
-        k_cache = update_sp_cache(k_cache, k_new, pos, sp_index, seq_chunk)
-        v_cache = update_sp_cache(v_cache, v_new, pos, sp_index, seq_chunk)
+        k_c = jax.lax.dynamic_index_in_dim(k_all, idx, 0, keepdims=False)
+        v_c = jax.lax.dynamic_index_in_dim(v_all, idx, 0, keepdims=False)
+        k_c = update_sp_cache(k_c, k_new, pos, sp_index, seq_chunk)
+        v_c = update_sp_cache(v_c, v_new, pos, sp_index, seq_chunk)
+        k_all = jax.lax.dynamic_update_slice(k_all, k_c[None], (idx, 0, 0, 0))
+        v_all = jax.lax.dynamic_update_slice(v_all, v_c[None], (idx, 0, 0, 0))
         ao = sp_cache_attention(spec.head_size, spec.kv_mul, seq_chunk,
-                                sp_index, qh, k_cache, v_cache, pos)
+                                sp_index, qh, k_c, v_c, pos)
 
     xb = _gather(_wire(spec, ao))                  # ⇄ syncMultiheadAtt
     xb2 = matmul(lw["wo"], xb)                     # (T, dim/S)
@@ -169,7 +179,7 @@ def _local_layer(spec: TransformerSpec, n_slices: int, n_sp: int, x, lw,
     hb = _gather(_wire(spec, hb))                  # ⇄ syncFfnA+syncFfnB
     xb2 = matmul(lw["w2"], hb)                     # (T, dim/S)
     x = x + _gather(_wire(spec, xb2))              # ⇄ syncFfn2 + residual
-    return x, k_cache, v_cache
+    return x, k_all, v_all
 
 
 LAYER_KEYS = ("rms_att", "rms_ffn", "wq", "wk", "wv", "wo", "w1", "w2", "w3")
@@ -206,16 +216,17 @@ def make_sharded_forward(spec: TransformerSpec, mesh: Mesh):
 
         stacked, scanned = split_layer_weights(params)
 
-        def body(x, per_layer):
-            idx, lw_slice, k_c, v_c = per_layer
+        def body(carry, per_layer):
+            x, k_all, v_all = carry
+            idx, lw_slice = per_layer
             lw = layer_view(stacked, lw_slice, idx)
-            x, k_c, v_c = _local_layer(spec, n_slices, n_sp, x, lw, k_c, v_c,
-                                       pos, positions)
-            return x, (k_c, v_c)
+            x, k_all, v_all = _local_layer(spec, n_slices, n_sp, x, lw,
+                                           k_all, v_all, idx, pos, positions)
+            return (x, k_all, v_all), None
 
         idxs = jnp.arange(spec.n_layers, dtype=jnp.int32)
-        x, (k_new, v_new) = jax.lax.scan(body, x,
-                                         (idxs, scanned, cache.k, cache.v))
+        (x, k_new, v_new), _ = jax.lax.scan(body, (x, cache.k, cache.v),
+                                            (idxs, scanned))
         x = rmsnorm(x, params["rms_final"])
         logits = _gather(matmul(params["wcls"], x))  # vocab bands -> full
         return logits, KVCache(k_new, v_new)

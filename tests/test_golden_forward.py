@@ -50,11 +50,12 @@ def test_golden_block_forward(golden_setup):
 
     lw, x, expected = golden_setup
     lwj = {k: jnp.asarray(v) for k, v in lw.items()}
-    k_cache = jnp.zeros((SPEC.seq_len, SPEC.n_kv_heads, SPEC.head_size),
-                        jnp.float32)
-    v_cache = jnp.zeros_like(k_cache)
-    out, _, _ = _layer(SPEC, jnp.asarray(x)[None, :], lwj, k_cache, v_cache,
-                       jnp.int32(0), jnp.arange(1, dtype=jnp.int32))
+    k_all = jnp.zeros(
+        (1, SPEC.seq_len, SPEC.n_kv_heads, SPEC.head_size), jnp.float32)
+    v_all = jnp.zeros_like(k_all)
+    out, _, _ = _layer(SPEC, jnp.asarray(x)[None, :], lwj, k_all, v_all,
+                       jnp.int32(0), jnp.int32(0),
+                       jnp.arange(1, dtype=jnp.int32))
     got = np.asarray(out[0])
     err = np.abs(got - expected)
     assert err.max() <= 1e-5, (
