@@ -125,9 +125,16 @@ def _qkv_proj(spec: TransformerSpec, lw: dict[str, Any], x: jax.Array,
     """
     xb = rmsnorm(x, lw["rms_att"])
     xb = _maybe_q80(spec, xb)
-    q = matmul(lw["wq"], xb)
-    k = matmul(lw["wk"], xb)
-    v = matmul(lw["wv"], xb)
+    if "wqkv" in lw:  # load-time fused kernel (ops/linear.fuse_q40_layer_matmuls)
+        qkv = matmul(lw["wqkv"], xb)
+        kv_dim = spec.n_kv_heads * spec.head_size
+        q = qkv[..., :spec.dim]
+        k = qkv[..., spec.dim:spec.dim + kv_dim]
+        v = qkv[..., spec.dim + kv_dim:]
+    else:
+        q = matmul(lw["wq"], xb)
+        k = matmul(lw["wk"], xb)
+        v = matmul(lw["wv"], xb)
 
     def rot(a):
         return rope_rotate(a, positions, spec.head_size)
@@ -146,7 +153,12 @@ def _post_attention(spec: TransformerSpec, lw: dict[str, Any], x: jax.Array,
     x = x + matmul(lw["wo"], ao)
     xb = rmsnorm(x, lw["rms_ffn"])
     xb = _maybe_q80(spec, xb)
-    hb = silu(matmul(lw["w1"], xb)) * matmul(lw["w3"], xb)
+    if "w13" in lw:  # load-time fused kernel (ops/linear.fuse_q40_layer_matmuls)
+        h13 = matmul(lw["w13"], xb)
+        hid = h13.shape[-1] // 2
+        hb = silu(h13[..., :hid]) * h13[..., hid:]
+    else:
+        hb = silu(matmul(lw["w1"], xb)) * matmul(lw["w3"], xb)
     hb = _maybe_q80(spec, hb)
     return x + matmul(lw["w2"], hb)
 
@@ -163,15 +175,29 @@ def _layer(spec: TransformerSpec, x: jax.Array, lw: dict[str, Any],
     v_new = v.reshape(1, t_len, spec.n_kv_heads, spec.head_size)
     k_all = jax.lax.dynamic_update_slice(k_all, k_new, (idx, pos, 0, 0))
     v_all = jax.lax.dynamic_update_slice(v_all, v_new, (idx, pos, 0, 0))
-    k_c = jax.lax.dynamic_index_in_dim(k_all, idx, 0, keepdims=False)
-    v_c = jax.lax.dynamic_index_in_dim(v_all, idx, 0, keepdims=False)
-    ao = attention(spec, q.reshape(t_len, spec.n_heads, spec.head_size),
-                   k_c, v_c, pos, t_len)
+
+    from ..ops.pallas_attention import (attn_kernel_mode, decode_attention,
+                                        supports)
+
+    if (attn_kernel_mode() == "pallas"
+            and supports(spec.seq_len, spec.head_size, t_len,
+                         spec.n_kv_heads)):
+        # flash-decode kernel: reads only the live chunks of the stacked
+        # cache (pos-proportional HBM traffic, like the reference's 0..pos
+        # attention loop) instead of the full static plane
+        ao = decode_attention(q.reshape(spec.n_heads, spec.head_size),
+                              k_all, v_all, idx, pos, kv_mul=spec.kv_mul)
+    else:
+        k_c = jax.lax.dynamic_index_in_dim(k_all, idx, 0, keepdims=False)
+        v_c = jax.lax.dynamic_index_in_dim(v_all, idx, 0, keepdims=False)
+        ao = attention(spec, q.reshape(t_len, spec.n_heads, spec.head_size),
+                       k_c, v_c, pos, t_len)
     x = _post_attention(spec, lw, x, ao)
     return x, k_all, v_all
 
 
 LAYER_KEYS = ("rms_att", "rms_ffn", "wq", "wk", "wv", "wo", "w1", "w2", "w3")
+FUSED_KEYS = ("wqkv", "w13")  # load-time fusions (ops/linear)
 
 
 def split_layer_weights(params: dict[str, Any]):
@@ -179,9 +205,10 @@ def split_layer_weights(params: dict[str, Any]):
     weights stay OUTSIDE the scan carry (the kernel indexes the stack
     directly via scalar prefetch — see ops/linear.StackedQ40); everything
     else is scanned normally (sliced per step)."""
-    stacked = {k: params[k] for k in LAYER_KEYS
+    keys = [k for k in LAYER_KEYS + FUSED_KEYS if k in params]
+    stacked = {k: params[k] for k in keys
                if isinstance(params[k], Q40Kernel)}
-    scanned = {k: params[k] for k in LAYER_KEYS if k not in stacked}
+    scanned = {k: params[k] for k in keys if k not in stacked}
     return stacked, scanned
 
 
@@ -277,9 +304,9 @@ def params_to_device(params: dict[str, Any], dtype=None) -> dict[str, Any]:
     side) when the Q40 fast path is active — see ops/linear.pack_q40_params.
     """
     from ..io.loader import Q40Kernel, Q40Weight
-    from ..ops.linear import pack_q40_params
+    from ..ops.linear import fuse_q40_layer_matmuls, pack_q40_params
 
-    params = pack_q40_params(params)
+    params = fuse_q40_layer_matmuls(pack_q40_params(params))
 
     def conv(a):
         x = jnp.asarray(a)

@@ -23,6 +23,7 @@ from typing import Any, NamedTuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..io.loader import Q40Kernel, Q40Weight, from_kernel_layout, to_kernel_layout
 from .quants import dequantize_q40_jax, dequantize_q80_jax, quantize_q80_jax
@@ -135,6 +136,43 @@ def pack_q40_params(params: dict, enable: bool | None = None,
             and kernel_supports(v.logical_shape[-2] // tp)
             else v
             for k, v in params.items()}
+
+
+def fuse_q40_layer_matmuls(params: dict) -> dict:
+    """Concatenate the stacked Q40 qkv (and w1/w3) weights along the output
+    dim into single kernel tensors ``wqkv`` / ``w13``, host-side, at load.
+
+    The three qkv matmuls (and the two SwiGLU input matmuls) share the same
+    input vector; one wide kernel call replaces three (two) narrow ones,
+    which matters for single-token decode where the d=4096 matvec runs at
+    roughly half the bytes/s of the d>=11008 ones (grid too short to hide
+    pipeline ramp). Row-wise the math is unchanged — outputs are split back
+    by models/llama (the reference computes the same three matmuls back to
+    back, transformer-tasks.cpp:167-179).
+
+    Only fires on stacked Q40Kernel entries (i.e. after pack_q40_params on
+    the single-chip path); dense/TP trees pass through untouched.
+    """
+    from .pallas_q40 import kernel_supports
+
+    out = dict(params)
+
+    def fuse(dst, keys):
+        ws = [out.get(k) for k in keys]
+        if not all(isinstance(w, Q40Kernel) and w.qs_t.ndim == 4
+                   for w in ws):
+            return
+        qs_t = np.concatenate([np.asarray(w.qs_t) for w in ws], axis=2)
+        scale = np.concatenate([np.asarray(w.scale) for w in ws], axis=1)
+        if not kernel_supports(qs_t.shape[2]):
+            return
+        out[dst] = Q40Kernel(qs_t, scale)
+        for k in keys:
+            del out[k]
+
+    fuse("wqkv", ("wq", "wk", "wv"))
+    fuse("w13", ("w1", "w3"))
+    return out
 
 
 def fake_quant_q80(x: jax.Array) -> jax.Array:

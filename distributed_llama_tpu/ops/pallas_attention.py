@@ -1,0 +1,191 @@
+"""Pallas TPU kernel: single-token flash-decode attention over the stacked
+KV cache.
+
+The XLA attention path reads the ENTIRE static (seq_len, n_kv, hs) cache
+plane every token (static shapes force it), so decode attention costs
+seq_len-proportional HBM traffic even at pos=3. This kernel is the
+TPU-native replacement for the hot T=1 case: it DMAs only the ceil((pos+1)/C)
+LIVE chunks of K/V out of the stacked (L, S, n_kv, hs) HBM cache (layer and
+pos arrive as scalars; a lax.fori_loop with a data-dependent trip count walks
+the chunks, double-buffered), accumulating flash-style running (m, l, o)
+per head in VMEM. Attention cost becomes pos-proportional — the shape of the
+reference's own per-position attention loop (transformer-tasks.cpp:246-276),
+which scans exactly 0..pos, not 0..seqLen.
+
+Numerics: f32 throughout, max-subtracted softmax, GQA via a static python
+loop over the kv_mul query heads per kv head — same math as
+models/llama.attention_core (the parity anchor; the interpret-mode test
+checks element-level agreement).
+
+Scores/weighted sums are computed on the VPU (broadcast-multiply-reduce over
+the head dim): per-head matvecs are too thin for the MXU, and the kernel is
+DMA-bound at decode shapes anyway.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = float("-inf")
+
+
+def _kernel(layer_ref, pos_ref, q_ref, k_hbm, v_hbm, out_ref,
+            k_buf, v_buf, sems, *, chunk: int, kv_mul: int):
+    """q_ref (n_kv, kv_mul, hs) VMEM; k/v_hbm (L, S, n_kv, hs) in HBM;
+    out_ref (n_kv, kv_mul, hs); k/v_buf (2, chunk, n_kv, hs) VMEM scratch;
+    sems (2, 2) DMA semaphores (slot x {k, v})."""
+    layer = layer_ref[0]
+    pos = pos_ref[0]
+    n_kv, _, hs = q_ref.shape
+    n_chunks = pos // chunk + 1  # live chunks only
+
+    def k_dma(slot, i):
+        return pltpu.make_async_copy(
+            k_hbm.at[layer, pl.ds(i * chunk, chunk)], k_buf.at[slot],
+            sems.at[slot, 0])
+
+    def v_dma(slot, i):
+        return pltpu.make_async_copy(
+            v_hbm.at[layer, pl.ds(i * chunk, chunk)], v_buf.at[slot],
+            sems.at[slot, 1])
+
+    k_dma(0, 0).start()
+    v_dma(0, 0).start()
+
+    q = q_ref[...]                                   # (n_kv, kv_mul, hs)
+    scale = 1.0 / jnp.sqrt(jnp.float32(hs))
+
+    # flash running stats per query-head-in-group, carried as flat tuples
+    # (static kv_mul unroll; functional .at-column updates don't lower well)
+    def body(i, carry):
+        slot = jax.lax.rem(i, 2)
+
+        @pl.when(i + 1 < n_chunks)
+        def _():
+            nxt = jax.lax.rem(i + 1, 2)
+            k_dma(nxt, i + 1).start()
+            v_dma(nxt, i + 1).start()
+
+        k_dma(slot, i).wait()
+        v_dma(slot, i).wait()
+        k = k_buf[slot]                              # (chunk, n_kv, hs)
+        v = v_buf[slot]
+
+        key_pos = i * chunk + jax.lax.broadcasted_iota(
+            jnp.int32, (chunk, n_kv), 0)
+        valid = key_pos <= pos                       # (chunk, n_kv)
+
+        out = []
+        for mqi in range(kv_mul):
+            m_old, l_old, o_old = carry[mqi]         # (1,n_kv),(1,n_kv),(n_kv,hs)
+            qm = q[:, mqi, :]                        # (n_kv, hs)
+            s = jnp.sum(k * qm[None, :, :], axis=-1) * scale  # (chunk, n_kv)
+            s = jnp.where(valid, s, NEG_INF)
+            m_new = jnp.maximum(m_old, jnp.max(s, axis=0, keepdims=True))
+            p = jnp.exp(s - m_new)                   # (chunk, n_kv)
+            corr = jnp.exp(m_old - m_new)            # (1, n_kv)
+            l_new = l_old * corr + jnp.sum(p, axis=0, keepdims=True)
+            po = jnp.sum(p[:, :, None] * v, axis=0)  # (n_kv, hs)
+            o_new = o_old * jnp.transpose(corr) + po
+            out.append((m_new, l_new, o_new))
+        return tuple(out)
+
+    init = tuple((jnp.full((1, n_kv), NEG_INF, jnp.float32),
+                  jnp.zeros((1, n_kv), jnp.float32),
+                  jnp.zeros((n_kv, hs), jnp.float32))
+                 for _ in range(kv_mul))
+    final = jax.lax.fori_loop(0, n_chunks, body, init)
+    for mqi in range(kv_mul):
+        _, l_i, o_i = final[mqi]
+        out_ref[:, mqi, :] = o_i / jnp.transpose(l_i)
+
+
+def attn_kernel_mode() -> str:
+    """'pallas' (flash-decode kernel) or 'xla' (full-cache einsum).
+
+    DLLAMA_ATTN_KERNEL=pallas|xla|auto; auto = pallas on TPU, xla elsewhere.
+    """
+    import os
+
+    env = os.environ.get("DLLAMA_ATTN_KERNEL", "auto")
+    if env == "auto":
+        return "pallas" if jax.default_backend() == "tpu" else "xla"
+    return env
+
+
+_VMEM_BUDGET = 12 * 1024 * 1024  # scoped-vmem limit is 16MB; leave headroom
+
+
+def _scratch_bytes(chunk: int, n_kv: int, hs: int) -> int:
+    # 2 slots x {K,V} x (chunk, n_kv, hs) f32
+    return 2 * 2 * chunk * n_kv * hs * 4
+
+
+def _chunk(seq_len: int, n_kv: int, hs: int) -> int | None:
+    """Largest cache chunk that divides seq_len within the VMEM budget."""
+    for c in (256, 128, 64, 32, 16, 8):
+        if seq_len % c == 0 and _scratch_bytes(c, n_kv, hs) <= _VMEM_BUDGET:
+            return min(c, seq_len)
+    if seq_len <= 8 and _scratch_bytes(seq_len, n_kv, hs) <= _VMEM_BUDGET:
+        return seq_len
+    return None
+
+
+def supports(seq_len: int, head_size: int, t_len: int,
+             n_kv: int = 32) -> bool:
+    """The kernel handles T=1 decode with lane-width head_size and a cache
+    the chunking divides within the VMEM scratch budget; callers fall back
+    to the XLA path otherwise."""
+    return (t_len == 1 and head_size % 128 == 0
+            and _chunk(seq_len, n_kv, head_size) is not None)
+
+
+@functools.partial(jax.jit, static_argnames=("kv_mul", "interpret"))
+def decode_attention(q, k_all, v_all, layer, pos, *, kv_mul: int,
+                     interpret: bool | None = None):
+    """Flash-decode attention of one query token against the live prefix of
+    layer ``layer``'s cache.
+
+    q: (n_q, hs) f32 (n_q = n_kv * kv_mul, grouped so query head
+    g*kv_mul+m attends kv head g — the attention_core contract);
+    k_all/v_all: (L, S, n_kv, hs) stacked caches; pos: the query's absolute
+    position (keys 0..pos are visible). Returns (1, n_q * hs) f32.
+
+    ``interpret=None`` auto-selects interpret mode off-TPU (like q40_matmul),
+    so DLLAMA_ATTN_KERNEL=pallas works everywhere.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    L, S, n_kv, hs = k_all.shape
+    chunk = _chunk(S, n_kv, hs)
+    if chunk is None:
+        raise ValueError(
+            f"no cache chunking fits VMEM for seq_len={S}, n_kv={n_kv}, "
+            f"hs={hs} (gate with supports())")
+    qg = q.reshape(n_kv, kv_mul, hs).astype(jnp.float32)
+    out = pl.pallas_call(
+        functools.partial(_kernel, chunk=chunk, kv_mul=kv_mul),
+        grid=(),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((n_kv, kv_mul, hs), jnp.float32),
+        scratch_shapes=[
+            pltpu.VMEM((2, chunk, n_kv, hs), jnp.float32),
+            pltpu.VMEM((2, chunk, n_kv, hs), jnp.float32),
+            pltpu.SemaphoreType.DMA((2, 2)),
+        ],
+        interpret=interpret,
+    )(jnp.asarray(layer, jnp.int32).reshape(1),
+      jnp.asarray(pos, jnp.int32).reshape(1), qg, k_all, v_all)
+    return out.reshape(1, n_kv * kv_mul * hs)

@@ -48,19 +48,28 @@ QK = 32
 NJ = 16  # nibble positions per block byte-plane
 
 
-def _matvec_body(qs3, s, xlo_ref, xhi_ref, out_ref):
-    """Shared T=1 body: qs3 (NJ, R, nb) codes view, s (R, nb) f32 scales."""
+def _matvec_body(qs3, s, xlo_ref, xhi_ref, xsum_ref, out_ref):
+    """Shared T=1 body: qs3 (NJ, R, nb) codes view, s (R, nb) f32 scales,
+    xsum (1, nb) per-block input sums.
+
+    The -8 code offset is factored out of the per-plane loop:
+      sum_j (code-8)*x = sum_j code*x - 8*sum_j x
+    so the hot loop multiplies RAW codes (saves two vector subtracts per
+    byte-plane — this loop is VPU-unpack-bound, not HBM-bound, at matvec
+    shapes) and the correction lands once per block via the precomputed
+    input sum."""
     acc = None
     for j in range(NJ):
         q = qs3[j].astype(jnp.int32)             # (R, nb)
-        wlo = ((q & 0xF) - 8).astype(jnp.float32)
-        whi = ((q >> 4) - 8).astype(jnp.float32)
+        wlo = (q & 0xF).astype(jnp.float32)
+        whi = (q >> 4).astype(jnp.float32)
         a = wlo * xlo_ref[j] + whi * xhi_ref[j]  # x rows (1, nb) bcast over R
         acc = a if acc is None else acc + a
+    acc = acc - 8.0 * xsum_ref[...]              # (R, nb) - (1, nb) bcast
     out_ref[...] = jnp.sum(acc * s, axis=1, keepdims=True)  # (R, 1)
 
 
-def _kernel_matvec(qs_ref, scale_ref, xlo_ref, xhi_ref, out_ref):
+def _kernel_matvec(qs_ref, scale_ref, xlo_ref, xhi_ref, xsum_ref, out_ref):
     """T=1 specialization: pure VPU multiply-accumulate, no MXU.
 
     Thin M=1 dots waste the MXU (it processes 128-row tiles); for a matvec
@@ -69,18 +78,18 @@ def _kernel_matvec(qs_ref, scale_ref, xlo_ref, xhi_ref, out_ref):
     so it factors out), apply the scale once, lane-reduce. ~2.4x faster than
     the dot formulation on v5e at 7B shapes.
     """
-    _matvec_body(qs_ref, scale_ref[...], xlo_ref, xhi_ref, out_ref)
+    _matvec_body(qs_ref, scale_ref[...], xlo_ref, xhi_ref, xsum_ref, out_ref)
 
 
 def _kernel_matvec_stacked(layer_ref, qs_ref, scale_ref, xlo_ref, xhi_ref,
-                           out_ref):
+                           xsum_ref, out_ref):
     """Stacked-layer matvec: the layer index arrives as a prefetched scalar
     that the BlockSpec index maps use to DMA the right layer's tiles straight
     out of the stacked (L, ...) arrays — no XLA dynamic-slice copy of the
     whole layer's weights per scan step (which would triple weight HBM
     traffic: read stack + write slice + read slice)."""
     del layer_ref  # consumed by the index maps
-    _matvec_body(qs_ref[0], scale_ref[0], xlo_ref, xhi_ref, out_ref)
+    _matvec_body(qs_ref[0], scale_ref[0], xlo_ref, xhi_ref, xsum_ref, out_ref)
 
 
 def _matmul_body(qs3, s, xlo_ref, xhi_ref, out_ref):
@@ -132,6 +141,7 @@ def _q40_matmul_2d(qs_t, scale, x, *, block_rows, block_t, interpret):
     t = x.shape[0]
     xlo, xhi = _split_x(x.astype(jnp.float32), nb)
     if t == 1:
+        xsum = jnp.sum(xlo[:, 0] + xhi[:, 0], axis=0, keepdims=True)  # (1, nb)
         out = pl.pallas_call(
             _kernel_matvec,
             grid=(d // block_rows,),
@@ -140,11 +150,12 @@ def _q40_matmul_2d(qs_t, scale, x, *, block_rows, block_t, interpret):
                 pl.BlockSpec((block_rows, nb), lambda i: (i, 0)),
                 pl.BlockSpec((NJ, 1, nb), lambda i: (0, 0, 0)),
                 pl.BlockSpec((NJ, 1, nb), lambda i: (0, 0, 0)),
+                pl.BlockSpec((1, nb), lambda i: (0, 0)),
             ],
             out_specs=pl.BlockSpec((block_rows, 1), lambda i: (i, 0)),
             out_shape=jax.ShapeDtypeStruct((d, 1), jnp.float32),
             interpret=interpret,
-        )(qs_t, scale, xlo, xhi)
+        )(qs_t, scale, xlo, xhi, xsum)
         return out.reshape(1, d)
     grid = (t // block_t, d // block_rows)
     out = pl.pallas_call(
@@ -171,6 +182,7 @@ def _q40_matmul_stacked(layer, qs_t, scale, x, *, block_rows, block_t,
     t = x.shape[0]
     xlo, xhi = _split_x(x.astype(jnp.float32), nb)
     if t == 1:
+        xsum = jnp.sum(xlo[:, 0] + xhi[:, 0], axis=0, keepdims=True)  # (1, nb)
         grid_spec = pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(d // block_rows,),
@@ -180,6 +192,7 @@ def _q40_matmul_stacked(layer, qs_t, scale, x, *, block_rows, block_t,
                 pl.BlockSpec((1, block_rows, nb), lambda i, L: (L[0], i, 0)),
                 pl.BlockSpec((NJ, 1, nb), lambda i, L: (0, 0, 0)),
                 pl.BlockSpec((NJ, 1, nb), lambda i, L: (0, 0, 0)),
+                pl.BlockSpec((1, nb), lambda i, L: (0, 0)),
             ],
             out_specs=pl.BlockSpec((block_rows, 1), lambda i, L: (i, 0)),
         )
@@ -187,7 +200,7 @@ def _q40_matmul_stacked(layer, qs_t, scale, x, *, block_rows, block_t,
             _kernel_matvec_stacked, grid_spec=grid_spec,
             out_shape=jax.ShapeDtypeStruct((d, 1), jnp.float32),
             interpret=interpret,
-        )(layer, qs_t, scale, xlo, xhi)
+        )(layer, qs_t, scale, xlo, xhi, xsum)
         return out.reshape(1, d)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,

@@ -1,2 +1,3 @@
 from .mesh import make_mesh  # noqa: F401
-from .tp import make_sharded_forward, shard_params, shard_cache  # noqa: F401
+from .tp import (make_sharded_forward, shard_params, shard_cache,  # noqa: F401
+                 validate_sharding)

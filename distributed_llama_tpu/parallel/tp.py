@@ -185,17 +185,18 @@ def _local_layer(spec: TransformerSpec, n_slices: int, n_sp: int, x, lw,
 LAYER_KEYS = ("rms_att", "rms_ffn", "wq", "wk", "wv", "wo", "w1", "w2", "w3")
 
 
-def make_sharded_forward(spec: TransformerSpec, mesh: Mesh):
-    """Build the jitted tensor-parallel forward for this mesh.
+def validate_sharding(spec: TransformerSpec, mesh: Mesh) -> None:
+    """Check the spec divides onto the mesh — BEFORE any device_put, so
+    callers get one clear error instead of a sharding traceback mid-load.
 
-    Returns fn(params, cache, tokens (T,), pos) -> (logits (T, vocab), cache).
-    Works for any tp size on the mesh, including tp=1 (then it reduces to the
-    single-chip program; parity across tp sizes is the stage-4 gate of
-    SURVEY.md §7).
+    The reference's analogous constraint is `assert(d % nSlices == 0)`
+    (transformer.cpp:15) plus the implicit 2^n-nodes rule (README.md:20);
+    ours is head-granular because attention is head-sharded (tp.py docstring).
     """
     n_slices = mesh.shape["tp"]
     n_sp = mesh.shape.get("sp", 1)
-    for req, name in ((spec.n_kv_heads, "n_kv_heads"),
+    for req, name in ((spec.n_heads, "n_heads"),
+                      (spec.n_kv_heads, "n_kv_heads"),
                       (spec.hidden_dim, "hidden_dim"),
                       (spec.vocab_size, "vocab_size")):
         if req % n_slices != 0:
@@ -208,6 +209,19 @@ def make_sharded_forward(spec: TransformerSpec, mesh: Mesh):
                 raise ValueError(
                     f"Q80 buffer needs {name}/tp divisible by 32, got "
                     f"{req}/{n_slices}")
+
+
+def make_sharded_forward(spec: TransformerSpec, mesh: Mesh):
+    """Build the jitted tensor-parallel forward for this mesh.
+
+    Returns fn(params, cache, tokens (T,), pos) -> (logits (T, vocab), cache).
+    Works for any tp size on the mesh, including tp=1 (then it reduces to the
+    single-chip program; parity across tp sizes is the stage-4 gate of
+    SURVEY.md §7).
+    """
+    n_slices = mesh.shape["tp"]
+    n_sp = mesh.shape.get("sp", 1)
+    validate_sharding(spec, mesh)
 
     def local_step(params, cache, tokens, pos):
         t_len = tokens.shape[0]

@@ -49,8 +49,9 @@ class Engine:
         self._loops: dict = {}  # (steps, temp, topp) -> compiled device loop
         if self.sharded:
             from ..parallel import (make_sharded_forward, shard_cache,
-                                    shard_params)
+                                    shard_params, validate_sharding)
 
+            validate_sharding(spec, mesh)  # clear error before any device_put
             self.params = shard_params(params, mesh)
             self.cache = shard_cache(init_cache(spec), mesh)
             self._fwd = make_sharded_forward(spec, mesh)

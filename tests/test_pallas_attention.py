@@ -1,0 +1,54 @@
+"""Interpret-mode parity tests for the flash-decode attention kernel."""
+
+import numpy as np
+import pytest
+
+
+@pytest.mark.parametrize("kv_mul,pos", [(1, 0), (1, 5), (1, 31), (2, 9),
+                                        (4, 17)])
+def test_decode_attention_matches_core(kv_mul, pos):
+    import jax.numpy as jnp
+
+    from distributed_llama_tpu.models.llama import (attention_core,
+                                                    causal_cache_mask)
+    from distributed_llama_tpu.ops.pallas_attention import decode_attention
+
+    L, S, n_kv, hs = 3, 32, 4, 128
+    n_q = n_kv * kv_mul
+    layer = 1
+    rng = np.random.default_rng(pos * 7 + kv_mul)
+    k_all = jnp.asarray(rng.normal(size=(L, S, n_kv, hs)).astype(np.float32))
+    v_all = jnp.asarray(rng.normal(size=(L, S, n_kv, hs)).astype(np.float32))
+    q = jnp.asarray(rng.normal(size=(n_q, hs)).astype(np.float32))
+
+    want = attention_core(hs, kv_mul, q.reshape(1, n_q, hs),
+                          k_all[layer], v_all[layer],
+                          causal_cache_mask(S, jnp.int32(pos), 1))
+    got = decode_attention(q, k_all, v_all, layer, pos, kv_mul=kv_mul,
+                           interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_decode_attention_ignores_stale_suffix():
+    """Entries beyond pos (stale garbage from earlier generations) must not
+    affect the result — the kernel only walks live chunks and masks within
+    the last one."""
+    import jax.numpy as jnp
+
+    from distributed_llama_tpu.ops.pallas_attention import decode_attention
+
+    L, S, n_kv, hs = 1, 64, 2, 128
+    rng = np.random.default_rng(0)
+    k_all = rng.normal(size=(L, S, n_kv, hs)).astype(np.float32)
+    v_all = rng.normal(size=(L, S, n_kv, hs)).astype(np.float32)
+    q = jnp.asarray(rng.normal(size=(n_kv, hs)).astype(np.float32))
+    pos = 7
+
+    a = decode_attention(q, jnp.asarray(k_all), jnp.asarray(v_all), 0, pos,
+                         kv_mul=1, interpret=True)
+    k_all[:, pos + 1:] = 1e6  # poison the dead region
+    v_all[:, pos + 1:] = -1e6
+    b = decode_attention(q, jnp.asarray(k_all), jnp.asarray(v_all), 0, pos,
+                         kv_mul=1, interpret=True)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

@@ -99,7 +99,13 @@ def test_pack_q40_params_and_forward_parity(monkeypatch):
 
     monkeypatch.setenv("DLLAMA_Q40_KERNEL", "pallas")
     packed = params_to_device(params)
-    assert isinstance(packed["wq"], Q40Kernel)  # packing actually happened
+    # packing AND qkv/w13 fusion actually happened
+    assert isinstance(packed["wqkv"], Q40Kernel)
+    assert isinstance(packed["w13"], Q40Kernel)
+    assert "wq" not in packed and "w1" not in packed
+    assert packed["wqkv"].logical_shape == (
+        spec.n_layers, spec.dim + 2 * spec.n_kv_heads * spec.head_size,
+        spec.dim)
     got_logits, _ = forward(spec, packed, init_cache(spec), tok, jnp.int32(0))
     np.testing.assert_allclose(np.asarray(got_logits), np.asarray(ref_logits),
                                rtol=2e-5, atol=2e-5)

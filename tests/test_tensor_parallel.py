@@ -124,3 +124,17 @@ def test_tp_rejects_indivisible():
     mesh = make_mesh(tp=4)
     with pytest.raises(ValueError, match="hidden_dim"):
         make_sharded_forward(bad, mesh)
+
+
+def test_engine_rejects_indivisible_before_device_put():
+    """tp > n_kv_heads must fail with the clear divisibility error, not a
+    device_put sharding traceback mid-load (Engine validates first)."""
+    from distributed_llama_tpu.parallel import make_mesh
+    from distributed_llama_tpu.runtime.generate import Engine
+
+    spec = TransformerSpec(dim=64, hidden_dim=160, n_layers=1, n_heads=4,
+                           n_kv_heads=2, vocab_size=96, seq_len=16)
+    p = _params(spec)
+    mesh = make_mesh(tp=4)
+    with pytest.raises(ValueError, match="n_kv_heads"):
+        Engine(spec, p, mesh=mesh)

@@ -84,6 +84,7 @@ def main():
     N = args.iters
 
     shapes = [("wq/wk/wv/wo", 4096, 4096), ("w1/w3", 11008, 4096),
+              ("wqkv fused", 12288, 4096), ("w13 fused", 22016, 4096),
               ("w2", 4096, 11008), ("wcls", 32000, 4096)]
     for name, d, n in shapes:
         nb = n // 32
@@ -100,8 +101,9 @@ def main():
 
         ms = chain_ms(step, jnp.ones((n,), jnp.float32), N)
         mb = (qs_t.size + scale.size * 4) / 1e6
+        gbs = f"{mb / ms:7.1f}" if ms > 0 else "    inf"
         print(f"{name:12s} d={d:6d} n={n:6d}  {ms:7.3f} ms  "
-              f"{mb:8.1f} MB  {mb / ms:7.1f} GB/s")
+              f"{mb:8.1f} MB  {gbs} GB/s")
 
     # attention core over the full static cache (one layer, pos=2047)
     from distributed_llama_tpu.models.llama import (attention_core,
