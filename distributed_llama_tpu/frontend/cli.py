@@ -78,6 +78,17 @@ def cmd_inference(argv: list[str], quiet: bool = False) -> int:
                     help="fused on-device generation loop (one device "
                          "program for the whole chain; no per-token stats "
                          "lines)")
+    ap.add_argument("--save-state", default=None, metavar="PATH",
+                    help="write a resumable generation checkpoint (cache + "
+                         "position + RNG) after the run")
+    ap.add_argument("--resume-state", default=None, metavar="PATH",
+                    help="resume a checkpointed generation (--prompt is "
+                         "ignored; --steps more positions run)")
+    ap.add_argument("--profile", default=None, metavar="DIR",
+                    help="capture a jax.profiler device trace of the "
+                         "generation into DIR (xprof/tensorboard format — "
+                         "the TPU-native equivalent of the reference's "
+                         "per-task I/T timing split)")
     _add_common(ap)
     args = ap.parse_args(argv)
     if args.coordinator and args.seed is None:
@@ -125,9 +136,47 @@ def cmd_inference(argv: list[str], quiet: bool = False) -> int:
     sampler = Sampler(spec.vocab_size, args.temperature, args.topp, seed)
     # pieces print inside the per-token stats lines (reference behavior:
     # tokenizer.cpp prints each piece once, at the end of the 🔶 line)
-    gen = generate_fast if args.fast else generate
-    gen(engine, tokenizer, sampler, args.prompt or "", args.steps,
-        quiet=quiet)
+    resume = None
+    if args.resume_state:
+        from ..runtime.checkpoint import load_generation_state
+
+        pos0, tok0, prev0 = load_generation_state(args.resume_state, engine,
+                                                  sampler)
+        resume = (pos0, tok0)
+        if not quiet:
+            print(f"⏩ Resumed at pos {pos0} ({len(prev0)} tokens so far)")
+    import contextlib
+
+    prof = (jax.profiler.trace(args.profile) if args.profile
+            else contextlib.nullcontext())
+    prev = prev0 if args.resume_state else []
+    with prof:
+        if args.fast and resume is None:
+            out, stats = generate_fast(engine, tokenizer, sampler,
+                                       args.prompt or "", args.steps,
+                                       quiet=quiet)
+        else:
+            if args.fast and not quiet:
+                print("💡 --fast has no fused path for resumed runs; using "
+                      "the per-step loop")
+            out, stats = generate(engine, tokenizer, sampler,
+                                  args.prompt or "", args.steps, quiet=quiet,
+                                  resume=resume)
+    if args.profile and not quiet:
+        print(f"⏩ Profiler trace written to {args.profile}")
+    if args.save_state:
+        from ..io.tokenizer import BOS
+        from ..runtime.checkpoint import save_generation_state
+
+        if stats.final_pos > 0 and stats.final_token != BOS:
+            save_generation_state(args.save_state, engine, sampler,
+                                  stats.final_pos, stats.final_token,
+                                  prev + out)
+            if not quiet:
+                print(f"⏩ Saved generation state to {args.save_state}")
+        elif not quiet:
+            print("💡 Generation ended (BOS or zero steps); nothing "
+                  "resumable to save")
     return 0
 
 

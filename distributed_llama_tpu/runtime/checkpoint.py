@@ -1,0 +1,82 @@
+"""Generation checkpoint/resume.
+
+The reference has NO checkpoint subsystem (SURVEY.md §5: inference-only; its
+nearest analog is the startup weight-scatter protocol, transformer.cpp:
+250-273). Resumable generation is a capability extension: the complete decode
+state is (KV-cache prefix, next token, position, sampler RNG state), and all
+of it is exact — the xorshift64* stream is a single uint64, the cache is
+plain f32 — so a resumed run continues BIT-IDENTICALLY to the run that was
+interrupted (test_checkpoint.py proves split == unsplit token streams).
+
+Format: one .npz with a version field and the 28-byte spec header for
+compatibility checking; cache arrays are gathered to host (works for sharded
+engines — np.asarray on a sharded array is an all-gather) and re-sharded on
+load by the restoring engine's own mesh, so a checkpoint written by a tp=4
+run restores into a tp=8 run and vice versa.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..models.llama import KVCache
+from .generate import Engine
+from .sampling import Sampler
+
+FORMAT_VERSION = 1
+
+
+def save_generation_state(path: str, engine: Engine, sampler: Sampler,
+                          pos: int, token: int,
+                          tokens_out: list[int]) -> None:
+    """Snapshot a generation: resume later with load + generate(resume=...).
+
+    ``pos``/``token``: the next inference step's inputs (GenStats.final_pos /
+    final_token from the interrupted run). ``tokens_out``: tokens emitted so
+    far (stored so the caller can reconstruct the full stream).
+    """
+    # write through a file object: np.savez(str_path) would silently append
+    # '.npz', landing the file somewhere other than the path we report
+    with open(path, "wb") as f:
+        _savez(f, engine, sampler, pos, token, tokens_out)
+
+
+def _savez(f, engine, sampler, pos, token, tokens_out):
+    np.savez(
+        f,
+        version=np.int32(FORMAT_VERSION),
+        header=np.frombuffer(engine.spec.header(), dtype=np.uint8),
+        k=np.asarray(engine.cache.k),  # gathers if sharded
+        v=np.asarray(engine.cache.v),
+        pos=np.int32(pos),
+        token=np.int32(token),
+        rng_state=np.uint64(sampler.rng.state),
+        tokens_out=np.asarray(tokens_out, dtype=np.int32),
+    )
+
+
+def load_generation_state(path: str, engine: Engine,
+                          sampler: Sampler) -> tuple[int, int, list[int]]:
+    """Restore a snapshot into ``engine``/``sampler``.
+
+    Returns (pos, token, tokens_out) — pass (pos, token) to
+    generate(resume=...). Raises ValueError on format/spec mismatch.
+    """
+    import jax.numpy as jnp
+
+    z = np.load(path)
+    version = int(z["version"])
+    if version != FORMAT_VERSION:
+        raise ValueError(f"checkpoint version {version}, expected "
+                         f"{FORMAT_VERSION}")
+    if z["header"].tobytes() != engine.spec.header():
+        raise ValueError("checkpoint spec header does not match the loaded "
+                         "model")
+    cache = KVCache(jnp.asarray(z["k"]), jnp.asarray(z["v"]))
+    if engine.sharded:
+        from ..parallel import shard_cache
+
+        cache = shard_cache(cache, engine.mesh)
+    engine.cache = cache
+    sampler.rng.state = int(z["rng_state"])
+    return int(z["pos"]), int(z["token"]), z["tokens_out"].astype(int).tolist()

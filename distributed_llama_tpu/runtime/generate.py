@@ -101,6 +101,8 @@ class GenStats:
     total_ms: float = 0.0
     infer_ms: float = 0.0
     host_ms: float = 0.0
+    final_pos: int = 0    # next step's pos — checkpoint/resume anchor
+    final_token: int = 0  # next step's input token
 
     @property
     def avg(self) -> tuple[float, float, float]:
@@ -111,23 +113,35 @@ class GenStats:
 def generate(engine: Engine, tokenizer: Tokenizer, sampler: Sampler,
              prompt: str, steps: int,
              emit: Callable[[str], None] | None = None,
-             quiet: bool = False) -> tuple[list[int], GenStats]:
+             quiet: bool = False,
+             resume: tuple[int, int] | None = None) -> tuple[list[int], GenStats]:
     """Reference generation loop (tokenizer.cpp:321-394).
 
     Encodes the prompt with BOS (no EOS), forces prompt tokens, samples after,
     stops early on BOS, prints the per-token stats line and final averages.
+
+    ``resume=(pos, token)`` continues an interrupted generation instead of
+    starting one: the engine's cache and the sampler's RNG must have been
+    restored first (runtime/checkpoint.py), the prompt is ignored, and up to
+    ``steps`` more positions run.
     """
     spec = engine.spec
-    steps = min(steps, spec.seq_len)
-    prompt_tokens = tokenizer.encode(prompt or "", bos=True, eos=False)
-    if not prompt_tokens:
-        raise ValueError("something is wrong, expected at least 1 prompt token")
+    if resume is not None:
+        start_pos, token = resume
+        prompt_tokens: list[int] = []
+        steps = min(start_pos + steps, spec.seq_len)
+    else:
+        start_pos, steps = 0, min(steps, spec.seq_len)
+        prompt_tokens = tokenizer.encode(prompt or "", bos=True, eos=False)
+        if not prompt_tokens:
+            raise ValueError(
+                "something is wrong, expected at least 1 prompt token")
+        token = prompt_tokens[0]
 
     comm = engine.comm_stats()
-    stats = GenStats()
+    stats = GenStats(final_pos=start_pos, final_token=token)
     out_tokens: list[int] = []
-    token = prompt_tokens[0]
-    pos = 0
+    pos = start_pos
     while pos < steps:
         t0 = time.perf_counter()
         logits = engine.infer(token, pos)
@@ -146,6 +160,7 @@ def generate(engine: Engine, tokenizer: Tokenizer, sampler: Sampler,
         stats.host_ms += (t2 - t1) * 1000
 
         pos += 1
+        stats.final_pos, stats.final_token = pos, int(next_token)
         if next_token == BOS:
             break  # reference stops on BOS before decoding it (tokenizer.cpp:376)
         out_tokens.append(next_token)
@@ -237,6 +252,8 @@ def generate_fast(engine: Engine, tokenizer: Tokenizer, sampler: Sampler,
     n = max(1, len(out_tokens))
     stats = GenStats(tokens=len(out_tokens), total_ms=total_ms,
                      infer_ms=total_ms, host_ms=0.0)
+    if len(toks) and len(out_tokens) == len(toks):  # no early BOS: resumable
+        stats.final_pos, stats.final_token = steps, int(toks[-1])
     if not quiet:
         print(f"\nGenerated tokens:    {stats.tokens}")
         print(f"Avg generation time: {total_ms / n:.2f} ms "

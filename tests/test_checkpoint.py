@@ -1,0 +1,72 @@
+"""Checkpoint/resume: a split generation must equal the unsplit one."""
+
+import numpy as np
+import pytest
+
+from distributed_llama_tpu.models.spec import TransformerSpec
+from distributed_llama_tpu.models.synth import synth_params
+from distributed_llama_tpu.runtime.checkpoint import (load_generation_state,
+                                                      save_generation_state)
+from distributed_llama_tpu.runtime.generate import Engine, generate
+from distributed_llama_tpu.runtime.sampling import Sampler
+
+SPEC = TransformerSpec(dim=64, hidden_dim=160, n_layers=2, n_heads=4,
+                       n_kv_heads=2, vocab_size=300, seq_len=32)
+
+
+class _IdTokenizer:
+    """encode -> [BOS, ...bytes]; decode unused by these tests."""
+
+    def encode(self, text, bos=True, eos=False):
+        return [1] + [3 + b for b in text.encode()]
+
+    def decode_piece(self, prev, tok):
+        return b"?"
+
+
+@pytest.fixture(scope="module")
+def params():
+    return synth_params(SPEC, q40=False, seed=9, scale=0.3)
+
+
+def _sampler(seed=77):
+    return Sampler(SPEC.vocab_size, temperature=0.9, topp=0.9, seed=seed)
+
+
+def test_split_generation_is_bit_identical(tmp_path, params):
+    tok = _IdTokenizer()
+
+    full_engine = Engine(SPEC, params)
+    full, fstats = generate(full_engine, tok, _sampler(), "ab", steps=12,
+                            quiet=True)
+
+    eng1 = Engine(SPEC, params)
+    s1 = _sampler()
+    part1, stats1 = generate(eng1, tok, s1, "ab", steps=5, quiet=True)
+    ckpt = str(tmp_path / "gen.npz")
+    save_generation_state(ckpt, eng1, s1, stats1.final_pos,
+                          stats1.final_token, part1)
+
+    eng2 = Engine(SPEC, params)  # fresh engine: cache restored from disk
+    s2 = _sampler(seed=123)      # wrong seed: must be overwritten by load
+    pos, token, prev = load_generation_state(ckpt, eng2, s2)
+    assert prev == part1 and pos == stats1.final_pos
+    part2, _ = generate(eng2, tok, s2, "IGNORED", steps=12 - pos, quiet=True,
+                        resume=(pos, token))
+
+    assert part1 + part2 == full
+
+
+def test_load_rejects_spec_mismatch(tmp_path, params):
+    eng = Engine(SPEC, params)
+    s = _sampler()
+    ckpt = str(tmp_path / "gen.npz")
+    save_generation_state(ckpt, eng, s, 3, 7, [])
+
+    other_spec = TransformerSpec(dim=64, hidden_dim=160, n_layers=2,
+                                 n_heads=4, n_kv_heads=2, vocab_size=300,
+                                 seq_len=64)  # different seq_len
+    other = Engine(other_spec, synth_params(other_spec, q40=False, seed=9,
+                                            scale=0.3))
+    with pytest.raises(ValueError, match="header"):
+        load_generation_state(ckpt, other, s)

@@ -96,6 +96,34 @@ def test_cli_inference_smoke(model_files, capsys):
     assert "Avg generation time" in out
 
 
+def test_cli_save_resume_roundtrip(model_files, tmp_path, capsys):
+    """CLI --save-state / --resume-state: split run == unsplit run."""
+    from distributed_llama_tpu.frontend.cli import main
+
+    model, tokp = model_files
+    base = ["--model", model, "--tokenizer", tokp, "--temperature", "0.9",
+            "--topp", "0.9", "--seed", "42", "--tp", "1"]
+    assert main(["inference", *base, "--prompt", "hi", "--steps", "10"]) == 0
+    full = [ln for ln in capsys.readouterr().out.splitlines()
+            if ln.startswith("🔶")]
+
+    ckpt = str(tmp_path / "gen.ckpt")  # no .npz suffix on purpose
+    assert main(["inference", *base, "--prompt", "hi", "--steps", "4",
+                 "--save-state", ckpt]) == 0
+    part1 = [ln for ln in capsys.readouterr().out.splitlines()
+             if ln.startswith("🔶")]
+    assert main(["inference", *base, "--steps", "6",
+                 "--resume-state", ckpt]) == 0
+    out2 = capsys.readouterr().out
+    assert f"({len(part1)} tokens so far)" in out2
+    part2 = [ln for ln in out2.splitlines() if ln.startswith("🔶")]
+
+    def pieces(lines):
+        return [ln.rsplit("'", 2)[-2] for ln in lines]
+
+    assert pieces(part1) + pieces(part2) == pieces(full)
+
+
 def test_cli_worker_requires_coordinator(capsys):
     from distributed_llama_tpu.frontend.cli import main
 
