@@ -84,6 +84,11 @@ def cmd_inference(argv: list[str], quiet: bool = False) -> int:
     ap.add_argument("--resume-state", default=None, metavar="PATH",
                     help="resume a checkpointed generation (--prompt is "
                          "ignored; --steps more positions run)")
+    ap.add_argument("--kv-cache-dtype", default="f32",
+                    choices=("f32", "bf16"),
+                    help="KV cache precision: f32 = reference parity "
+                         "(transformer.cpp:198-199), bf16 halves cache "
+                         "memory and attention HBM traffic")
     ap.add_argument("--profile", default=None, metavar="DIR",
                     help="capture a jax.profiler device trace of the "
                          "generation into DIR (xprof/tensorboard format — "
@@ -127,7 +132,10 @@ def cmd_inference(argv: list[str], quiet: bool = False) -> int:
               f"{jax.devices()[0].platform})")
     mesh = (make_mesh(sp=args.sp, tp=tp)
             if tp > 1 or args.sp > 1 else None)
-    engine = Engine(spec, params, mesh=mesh)
+    import jax.numpy as jnp
+
+    cache_dtype = jnp.bfloat16 if args.kv_cache_dtype == "bf16" else None
+    engine = Engine(spec, params, mesh=mesh, cache_dtype=cache_dtype)
     if not quiet:
         print(f"⏩ Loaded model in {time.time() - t0:.1f}s")
 

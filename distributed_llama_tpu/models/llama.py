@@ -171,8 +171,9 @@ def _layer(spec: TransformerSpec, x: jax.Array, lw: dict[str, Any],
     scan runs (and what the golden-parity test drives with L=1)."""
     t_len = x.shape[0]
     q, k, v = _qkv_proj(spec, lw, x, positions)
-    k_new = k.reshape(1, t_len, spec.n_kv_heads, spec.head_size)
-    v_new = v.reshape(1, t_len, spec.n_kv_heads, spec.head_size)
+    dt = k_all.dtype  # f32 parity default; bf16 halves cache HBM/memory
+    k_new = k.reshape(1, t_len, spec.n_kv_heads, spec.head_size).astype(dt)
+    v_new = v.reshape(1, t_len, spec.n_kv_heads, spec.head_size).astype(dt)
     k_all = jax.lax.dynamic_update_slice(k_all, k_new, (idx, pos, 0, 0))
     v_all = jax.lax.dynamic_update_slice(v_all, v_new, (idx, pos, 0, 0))
 
@@ -181,7 +182,7 @@ def _layer(spec: TransformerSpec, x: jax.Array, lw: dict[str, Any],
 
     if (attn_kernel_mode() == "pallas"
             and supports(spec.seq_len, spec.head_size, t_len,
-                         spec.n_kv_heads)):
+                         spec.n_kv_heads, k_all.dtype.itemsize)):
         # flash-decode kernel: reads only the live chunks of the stacked
         # cache (pos-proportional HBM traffic, like the reference's 0..pos
         # attention loop) instead of the full static plane
@@ -253,6 +254,98 @@ def forward(spec: TransformerSpec, params: dict[str, Any], cache: KVCache,
     x = rmsnorm(x, params["rms_final"])
     logits = matmul(params["wcls"], x)
     return logits, KVCache(k_new, v_new)
+
+
+def init_cache_batch(spec: TransformerSpec, batch: int,
+                     dtype=jnp.float32) -> KVCache:
+    """Batched cache: (L, B, S, n_kv, hs) — each (b, layer) row has the same
+    (S, n_kv, hs) layout as the single-sequence cache (forward_batch carries
+    it as a rank-4 (L*B, S, n_kv, hs) view; see there for why)."""
+    shape = (spec.n_layers, batch, spec.seq_len, spec.n_kv_heads,
+             spec.head_size)
+    return KVCache(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+
+
+def forward_batch(spec: TransformerSpec, params: dict[str, Any],
+                  cache: KVCache, tokens: jax.Array,
+                  pos: jax.Array) -> tuple[jax.Array, KVCache]:
+    """Decode one token for each of B sequences at a SHARED position.
+
+    tokens (B,), pos scalar; cache is (L, B, S, n_kv, hs). Returns
+    (logits (B, vocab), cache). The reference is strictly batch=1 (one token
+    per task-table cycle, SURVEY.md §2 'no batching'); batching is the
+    natural TPU extension — B rows turn the per-layer matvecs into MXU
+    matmuls at the same weight traffic, so throughput scales ~B until the
+    MXU saturates.
+
+    The position is shared (lockstep rows; ragged prompts right-pad and
+    sample early — runtime/decode.make_batch_decode_loop) so the cache
+    update is one dynamic_update_slice, which XLA performs IN PLACE on the
+    scan carry. A per-row-position variant needs a scatter, which XLA does
+    NOT update in place — it materializes a second cache-sized buffer,
+    doubling cache HBM (measured: OOM at B=4/7B/16GB).
+
+    Numerics per row match forward(): same kernels via the T=B path, same
+    RoPE/GQA/softmax math (batched einsums over the head-major cache —
+    see init_cache_batch for why the layout differs from the B=1 path).
+    """
+    B = tokens.shape[0]
+    x = params["tok_embedding"][tokens].astype(jnp.float32)  # (B, dim)
+    positions = jnp.full((B,), pos)  # every row rotates at the shared pos
+    n_kv, hs, kv_mul = spec.n_kv_heads, spec.head_size, spec.kv_mul
+    L, S = spec.n_layers, spec.seq_len
+
+    # the scan carries a RANK-4 (L*B, S, n_kv, hs) view: with the rank-5
+    # carry, XLA's layout assignment propagates a batch-minor operand layout
+    # from the attention dot into the whole carried cache and inserts a
+    # lane-padded normalization copy (1GB cache -> 137GB allocation at B=4).
+    # The merged leading dim mirrors the rank pattern of the B=1 path, which
+    # lays out cleanly; the boundary reshapes are bitcasts. Row layer*B+b has
+    # the single-sequence (S, n_kv, hs) layout.
+    k4 = cache.k.reshape(L * B, S, n_kv, hs)
+    v4 = cache.v.reshape(L * B, S, n_kv, hs)
+
+    stacked, scanned = split_layer_weights(params)
+
+    def scan_body(carry, per_layer):
+        x, k_all, v_all = carry
+        idx, lw_slice = per_layer
+        lw = layer_view(stacked, lw_slice, idx)
+        q, k, v = _qkv_proj(spec, lw, x, positions)
+        dt = k_all.dtype
+        # (B, kv, hs) -> this layer's B rows, column pos
+        k_new = k.reshape(B, 1, n_kv, hs).astype(dt)
+        v_new = v.reshape(B, 1, n_kv, hs).astype(dt)
+        k_all = jax.lax.dynamic_update_slice(k_all, k_new,
+                                             (idx * B, pos, 0, 0))
+        v_all = jax.lax.dynamic_update_slice(v_all, v_new,
+                                             (idx * B, pos, 0, 0))
+        from ..ops.pallas_attention import (attn_kernel_mode,
+                                            decode_attention_batch, supports)
+
+        if (attn_kernel_mode() == "pallas"
+                and supports(S, hs, 1, n_kv, k_all.dtype.itemsize)):
+            # per-row flash kernel: live-chunk DMA walk, no cache slice copy
+            # (the XLA einsum path below doesn't fuse the layer slice read —
+            # measured ~10x slower per step at 7B/B=4)
+            ao = decode_attention_batch(
+                q.reshape(B, spec.n_heads, hs), k_all, v_all, idx, pos,
+                kv_mul=kv_mul)
+        else:
+            k_c = jax.lax.dynamic_slice_in_dim(k_all, idx * B, B, 0)
+            v_c = jax.lax.dynamic_slice_in_dim(v_all, idx * B, B, 0)
+            ao = attention_core(spec.head_size, kv_mul,
+                                q.reshape(B, 1, spec.n_heads, hs),
+                                k_c, v_c, causal_cache_mask(S, pos, 1))
+        x = _post_attention(spec, lw, x, ao.reshape(B, -1))
+        return (x, k_all, v_all), None
+
+    idxs = jnp.arange(L, dtype=jnp.int32)
+    (x, k4, v4), _ = jax.lax.scan(scan_body, (x, k4, v4), (idxs, scanned))
+    x = rmsnorm(x, params["rms_final"])
+    logits = matmul(params["wcls"], x)
+    return logits, KVCache(k4.reshape(L, B, S, n_kv, hs),
+                           v4.reshape(L, B, S, n_kv, hs))
 
 
 def forward_seq(spec: TransformerSpec, params: dict[str, Any],

@@ -97,10 +97,12 @@ def matmul(w, x: jax.Array, *, prefer_pallas: bool = False) -> jax.Array:
             prefer_pallas or q40_kernel_mode() == "pallas"):
         from .pallas_q40 import kernel_supports, q40_matmul  # lazy
 
-        if kernel_supports(w.logical_shape[-2]):
+        if kernel_supports(w.logical_shape[-2], w.logical_shape[-1]):
             return q40_matmul(w, x)
-        # fall through: odd output dims (no multiple-of-8 divisor) take the
-        # dequantize-then-dot path below
+        # fall through: dims the matvec tiler can't place at all (large d
+        # with no multiple-of-8 divisor) take the dequantize-then-dot path
+        # below; supported dims with awkward T combos fall back INSIDE
+        # q40_matmul instead
     wf = dequantize_weight(w)
     # HIGHEST: true f32 MXU accumulation — required for the 1e-5 logit-parity
     # contract on TPU (default TPU precision is bf16-input). The quantized
@@ -133,7 +135,8 @@ def pack_q40_params(params: dict, enable: bool | None = None,
     return {k: to_kernel_layout(v)
             if isinstance(v, Q40Weight)
             and v.logical_shape[-2] % tp == 0
-            and kernel_supports(v.logical_shape[-2] // tp)
+            and kernel_supports(v.logical_shape[-2] // tp,
+                                v.logical_shape[-1])
             else v
             for k, v in params.items()}
 
@@ -164,7 +167,7 @@ def fuse_q40_layer_matmuls(params: dict) -> dict:
             return
         qs_t = np.concatenate([np.asarray(w.qs_t) for w in ws], axis=2)
         scale = np.concatenate([np.asarray(w.scale) for w in ws], axis=1)
-        if not kernel_supports(qs_t.shape[2]):
+        if not kernel_supports(qs_t.shape[2], qs_t.shape[3] * 32):
             return
         out[dst] = Q40Kernel(qs_t, scale)
         for k in keys:

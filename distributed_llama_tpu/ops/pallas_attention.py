@@ -34,34 +34,31 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = float("-inf")
 
 
-def _kernel(layer_ref, pos_ref, q_ref, k_hbm, v_hbm, out_ref,
-            k_buf, v_buf, sems, *, chunk: int, kv_mul: int):
-    """q_ref (n_kv, kv_mul, hs) VMEM; k/v_hbm (L, S, n_kv, hs) in HBM;
-    out_ref (n_kv, kv_mul, hs); k/v_buf (2, chunk, n_kv, hs) VMEM scratch;
-    sems (2, 2) DMA semaphores (slot x {k, v})."""
-    layer = layer_ref[0]
-    pos = pos_ref[0]
-    n_kv, _, hs = q_ref.shape
+def _flash_over_row(row, pos, q, k_hbm, v_hbm, k_buf, v_buf, sems, *,
+                    chunk: int, kv_mul: int):
+    """Shared flash loop: walk the live chunks of cache row ``row`` (an index
+    into the leading dim of the (R, S, n_kv, hs) HBM caches), double-buffered
+    DMA, running (m, l, o) per query-head-in-group carried as flat tuples
+    (static kv_mul unroll; functional .at-column updates don't lower well).
+    q: (n_kv, kv_mul, hs). Returns the kv_mul final (m, l, o) tuples."""
+    n_kv = q.shape[0]
+    hs = q.shape[2]
     n_chunks = pos // chunk + 1  # live chunks only
 
     def k_dma(slot, i):
         return pltpu.make_async_copy(
-            k_hbm.at[layer, pl.ds(i * chunk, chunk)], k_buf.at[slot],
+            k_hbm.at[row, pl.ds(i * chunk, chunk)], k_buf.at[slot],
             sems.at[slot, 0])
 
     def v_dma(slot, i):
         return pltpu.make_async_copy(
-            v_hbm.at[layer, pl.ds(i * chunk, chunk)], v_buf.at[slot],
+            v_hbm.at[row, pl.ds(i * chunk, chunk)], v_buf.at[slot],
             sems.at[slot, 1])
 
     k_dma(0, 0).start()
     v_dma(0, 0).start()
-
-    q = q_ref[...]                                   # (n_kv, kv_mul, hs)
     scale = 1.0 / jnp.sqrt(jnp.float32(hs))
 
-    # flash running stats per query-head-in-group, carried as flat tuples
-    # (static kv_mul unroll; functional .at-column updates don't lower well)
     def body(i, carry):
         slot = jax.lax.rem(i, 2)
 
@@ -99,10 +96,82 @@ def _kernel(layer_ref, pos_ref, q_ref, k_hbm, v_hbm, out_ref,
                   jnp.zeros((1, n_kv), jnp.float32),
                   jnp.zeros((n_kv, hs), jnp.float32))
                  for _ in range(kv_mul))
-    final = jax.lax.fori_loop(0, n_chunks, body, init)
+    return jax.lax.fori_loop(0, n_chunks, body, init)
+
+
+def _kernel(layer_ref, pos_ref, q_ref, k_hbm, v_hbm, out_ref,
+            k_buf, v_buf, sems, *, chunk: int, kv_mul: int):
+    """q_ref (n_kv, kv_mul, hs) VMEM; k/v_hbm (L, S, n_kv, hs) in HBM;
+    out_ref (n_kv, kv_mul, hs); k/v_buf (2, chunk, n_kv, hs) VMEM scratch;
+    sems (2, 2) DMA semaphores (slot x {k, v})."""
+    final = _flash_over_row(layer_ref[0], pos_ref[0], q_ref[...], k_hbm,
+                            v_hbm, k_buf, v_buf, sems, chunk=chunk,
+                            kv_mul=kv_mul)
     for mqi in range(kv_mul):
         _, l_i, o_i = final[mqi]
         out_ref[:, mqi, :] = o_i / jnp.transpose(l_i)
+
+
+def _kernel_batch(layer_ref, pos_ref, q_ref, k_hbm, v_hbm, out_ref,
+                  k_buf, v_buf, sems, *, chunk: int, kv_mul: int,
+                  batch: int):
+    """Per-row flash decode over the rank-4 (L*B, S, n_kv, hs) batched cache.
+
+    grid=(B,): program b walks row layer*batch+b's live chunks via the same
+    shared flash loop as the single-sequence kernel (prefix-indexed DMAs).
+    q_ref/out_ref get per-b blocks (1, n_kv, kv_mul, hs).
+    """
+    b = pl.program_id(0)
+    row = layer_ref[0] * batch + b
+    final = _flash_over_row(row, pos_ref[0], q_ref[0], k_hbm, v_hbm,
+                            k_buf, v_buf, sems, chunk=chunk, kv_mul=kv_mul)
+    for mqi in range(kv_mul):
+        _, l_i, o_i = final[mqi]
+        out_ref[0, :, mqi, :] = o_i / jnp.transpose(l_i)
+
+
+@functools.partial(jax.jit, static_argnames=("kv_mul", "interpret"))
+def decode_attention_batch(q, k4, v4, layer, pos, *, kv_mul: int,
+                           interpret: bool | None = None):
+    """Batched flash-decode attention over the rank-4 (L*B, S, n_kv, hs)
+    cache carried by models/llama.forward_batch.
+
+    q: (B, n_q, hs) f32; pos: the SHARED position (lockstep batch).
+    Returns (B, n_q * hs) f32. Live-chunk walking per row, like
+    decode_attention.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    LB, S, n_kv, hs = k4.shape
+    B = q.shape[0]
+    chunk = _chunk(S, n_kv, hs, k4.dtype.itemsize)
+    if chunk is None:
+        raise ValueError(
+            f"no cache chunking fits VMEM for seq_len={S}, n_kv={n_kv}, "
+            f"hs={hs} (gate with supports())")
+    qg = q.reshape(B, n_kv, kv_mul, hs).astype(jnp.float32)
+    out = pl.pallas_call(
+        functools.partial(_kernel_batch, chunk=chunk, kv_mul=kv_mul,
+                          batch=B),
+        grid=(B,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, n_kv, kv_mul, hs), lambda b: (b, 0, 0, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec((1, n_kv, kv_mul, hs), lambda b: (b, 0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, n_kv, kv_mul, hs), jnp.float32),
+        scratch_shapes=[
+            pltpu.VMEM((2, chunk, n_kv, hs), k4.dtype),
+            pltpu.VMEM((2, chunk, n_kv, hs), k4.dtype),
+            pltpu.SemaphoreType.DMA((2, 2)),
+        ],
+        interpret=interpret,
+    )(jnp.asarray(layer, jnp.int32).reshape(1),
+      jnp.asarray(pos, jnp.int32).reshape(1), qg, k4, v4)
+    return out.reshape(B, n_kv * kv_mul * hs)
 
 
 def attn_kernel_mode() -> str:
@@ -121,28 +190,33 @@ def attn_kernel_mode() -> str:
 _VMEM_BUDGET = 12 * 1024 * 1024  # scoped-vmem limit is 16MB; leave headroom
 
 
-def _scratch_bytes(chunk: int, n_kv: int, hs: int) -> int:
-    # 2 slots x {K,V} x (chunk, n_kv, hs) f32
-    return 2 * 2 * chunk * n_kv * hs * 4
+def _scratch_bytes(chunk: int, n_kv: int, hs: int, itemsize: int) -> int:
+    # 2 slots x {K,V} x (chunk, n_kv, hs) in the cache dtype
+    return 2 * 2 * chunk * n_kv * hs * itemsize
 
 
-def _chunk(seq_len: int, n_kv: int, hs: int) -> int | None:
-    """Largest cache chunk that divides seq_len within the VMEM budget."""
-    for c in (256, 128, 64, 32, 16, 8):
-        if seq_len % c == 0 and _scratch_bytes(c, n_kv, hs) <= _VMEM_BUDGET:
+def _chunk(seq_len: int, n_kv: int, hs: int, itemsize: int = 4) -> int | None:
+    """Largest cache chunk that divides seq_len within the VMEM budget
+    (bf16 caches fit chunks twice as long as f32)."""
+    for c in (512, 256, 128, 64, 32, 16, 8):
+        if (seq_len % c == 0
+                and _scratch_bytes(c, n_kv, hs, itemsize) <= _VMEM_BUDGET):
             return min(c, seq_len)
-    if seq_len <= 8 and _scratch_bytes(seq_len, n_kv, hs) <= _VMEM_BUDGET:
+    if (seq_len <= 8
+            and _scratch_bytes(seq_len, n_kv, hs, itemsize) <= _VMEM_BUDGET):
         return seq_len
     return None
 
 
 def supports(seq_len: int, head_size: int, t_len: int,
-             n_kv: int = 32) -> bool:
+             n_kv: int = 32, itemsize: int = 2) -> bool:
     """The kernel handles T=1 decode with lane-width head_size and a cache
     the chunking divides within the VMEM scratch budget; callers fall back
-    to the XLA path otherwise."""
+    to the XLA path otherwise. ``itemsize`` defaults to the smaller (bf16)
+    cache: if the bf16 chunking fits, so does some f32 chunking and vice
+    versa for these shapes — decode_attention re-derives the real chunk."""
     return (t_len == 1 and head_size % 128 == 0
-            and _chunk(seq_len, n_kv, head_size) is not None)
+            and _chunk(seq_len, n_kv, head_size, itemsize) is not None)
 
 
 @functools.partial(jax.jit, static_argnames=("kv_mul", "interpret"))
@@ -162,7 +236,7 @@ def decode_attention(q, k_all, v_all, layer, pos, *, kv_mul: int,
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     L, S, n_kv, hs = k_all.shape
-    chunk = _chunk(S, n_kv, hs)
+    chunk = _chunk(S, n_kv, hs, k_all.dtype.itemsize)
     if chunk is None:
         raise ValueError(
             f"no cache chunking fits VMEM for seq_len={S}, n_kv={n_kv}, "
@@ -181,8 +255,10 @@ def decode_attention(q, k_all, v_all, layer, pos, *, kv_mul: int,
         out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((n_kv, kv_mul, hs), jnp.float32),
         scratch_shapes=[
-            pltpu.VMEM((2, chunk, n_kv, hs), jnp.float32),
-            pltpu.VMEM((2, chunk, n_kv, hs), jnp.float32),
+            # scratch matches the cache dtype (bf16 caches halve the DMA);
+            # score/softmax math promotes to f32 in the kernel body
+            pltpu.VMEM((2, chunk, n_kv, hs), k_all.dtype),
+            pltpu.VMEM((2, chunk, n_kv, hs), k_all.dtype),
             pltpu.SemaphoreType.DMA((2, 2)),
         ],
         interpret=interpret,

@@ -92,6 +92,42 @@ def _kernel_matvec_stacked(layer_ref, qs_ref, scale_ref, xlo_ref, xhi_ref,
     _matvec_body(qs_ref[0], scale_ref[0], xlo_ref, xhi_ref, xsum_ref, out_ref)
 
 
+def _matvec_body_multi(qs3, s, xlo_ref, xhi_ref, xsum_ref, out_ref):
+    """Small-T (2..8) body: the matvec VPU formulation with one accumulator
+    per batch row, so the nibble unpack (the VPU bottleneck) is paid ONCE
+    for all T rows instead of per row. out (R, T); xlo/xhi (NJ, T, nb);
+    xsum (T, nb). ~3x the bytes/s of the MXU body at T=4 on v5e."""
+    t = xlo_ref.shape[1]
+    accs = [None] * t
+    for j in range(NJ):
+        q = qs3[j].astype(jnp.int32)                 # (R, nb)
+        wlo = (q & 0xF).astype(jnp.float32)
+        whi = (q >> 4).astype(jnp.float32)
+        for ti in range(t):
+            a = wlo * xlo_ref[j, ti] + whi * xhi_ref[j, ti]
+            accs[ti] = a if accs[ti] is None else accs[ti] + a
+    cols = []
+    for ti in range(t):
+        acc = accs[ti] - 8.0 * xsum_ref[ti]          # (R, nb) - (nb,)
+        cols.append(jnp.sum(acc * s, axis=1, keepdims=True))
+    out_ref[...] = jnp.concatenate(cols, axis=1)     # (R, T)
+
+
+def _kernel_multi(qs_ref, scale_ref, xlo_ref, xhi_ref, xsum_ref, out_ref):
+    _matvec_body_multi(qs_ref, scale_ref[...], xlo_ref, xhi_ref, xsum_ref,
+                       out_ref)
+
+
+def _kernel_multi_stacked(layer_ref, qs_ref, scale_ref, xlo_ref, xhi_ref,
+                          xsum_ref, out_ref):
+    del layer_ref  # consumed by the index maps
+    _matvec_body_multi(qs_ref[0], scale_ref[0], xlo_ref, xhi_ref, xsum_ref,
+                       out_ref)
+
+
+MULTI_T_MAX = 8  # beyond this the per-row accumulators crowd VMEM; use MXU
+
+
 def _matmul_body(qs3, s, xlo_ref, xhi_ref, out_ref):
     """Shared T>1 MXU body: qs3 (NJ, R, nb) codes view, s (R, nb) scales."""
     dn = (((1,), (1,)), ((), ()))                # contract both minor dims
@@ -157,6 +193,23 @@ def _q40_matmul_2d(qs_t, scale, x, *, block_rows, block_t, interpret):
             interpret=interpret,
         )(qs_t, scale, xlo, xhi, xsum)
         return out.reshape(1, d)
+    if t <= MULTI_T_MAX:
+        xsum = jnp.sum(xlo + xhi, axis=0)            # (t, nb)
+        out = pl.pallas_call(
+            _kernel_multi,
+            grid=(d // block_rows,),
+            in_specs=[
+                pl.BlockSpec((NJ, block_rows, nb), lambda i: (0, i, 0)),
+                pl.BlockSpec((block_rows, nb), lambda i: (i, 0)),
+                pl.BlockSpec((NJ, t, nb), lambda i: (0, 0, 0)),
+                pl.BlockSpec((NJ, t, nb), lambda i: (0, 0, 0)),
+                pl.BlockSpec((t, nb), lambda i: (0, 0)),
+            ],
+            out_specs=pl.BlockSpec((block_rows, t), lambda i: (i, 0)),
+            out_shape=jax.ShapeDtypeStruct((d, t), jnp.float32),
+            interpret=interpret,
+        )(qs_t, scale, xlo, xhi, xsum)
+        return jnp.transpose(out)                    # (t, d)
     grid = (t // block_t, d // block_rows)
     out = pl.pallas_call(
         _kernel,
@@ -202,6 +255,27 @@ def _q40_matmul_stacked(layer, qs_t, scale, x, *, block_rows, block_t,
             interpret=interpret,
         )(layer, qs_t, scale, xlo, xhi, xsum)
         return out.reshape(1, d)
+    if t <= MULTI_T_MAX:
+        xsum = jnp.sum(xlo + xhi, axis=0)            # (t, nb)
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(d // block_rows,),
+            in_specs=[
+                pl.BlockSpec((1, NJ, block_rows, nb),
+                             lambda i, L: (L[0], 0, i, 0)),
+                pl.BlockSpec((1, block_rows, nb), lambda i, L: (L[0], i, 0)),
+                pl.BlockSpec((NJ, t, nb), lambda i, L: (0, 0, 0)),
+                pl.BlockSpec((NJ, t, nb), lambda i, L: (0, 0, 0)),
+                pl.BlockSpec((t, nb), lambda i, L: (0, 0)),
+            ],
+            out_specs=pl.BlockSpec((block_rows, t), lambda i, L: (i, 0)),
+        )
+        out = pl.pallas_call(
+            _kernel_multi_stacked, grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((d, t), jnp.float32),
+            interpret=interpret,
+        )(layer, qs_t, scale, xlo, xhi, xsum)
+        return jnp.transpose(out)                    # (t, d)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(t // block_t, d // block_rows),
@@ -222,22 +296,52 @@ def _q40_matmul_stacked(layer, qs_t, scale, x, *, block_rows, block_t,
     )(layer, qs_t, scale, xlo, xhi)
 
 
-def _pick_block_rows(d: int) -> int | None:
-    # largest multiple-of-8 divisor up to ~768 rows/tile (empirically best on
-    # v5e: big enough to amortize grid-step overhead, small enough to keep
-    # the unpack working set in registers/VMEM — e.g. 512 for 4096, 688 for
-    # 11008, 640 for a 32000 vocab)
-    top = (min(d, 768) // 8) * 8
-    for cand in range(top, 0, -8):
+# T>1 tile cap: the MXU body materializes f32 (rows, nb) wlo/whi temporaries
+# per unrolled plane on the scoped-VMEM stack; rows*nb above ~128k blows the
+# 16MB limit at 7B shapes (observed: 512x344 -> 16.9M)
+_MATMUL_ROWSXNB_CAP = 131072
+
+
+def _pick_block_rows(d: int, t: int = 1, nb: int = 128) -> int | None:
+    """Output-tile rows, up to ~768/tile (amortizes grid-step overhead while
+    keeping the unpack working set in VMEM).
+
+    Three paths, three constraints (the t > 1 rules only bite on real TPU;
+    interpret mode doesn't check):
+    * t == 1 (matvec): out block (rows, 1) — rows is second-minor, any
+      multiple-of-8 divisor works.
+    * 1 < t <= MULTI_T_MAX (small-T VPU body): out block (rows, t) with the
+      full t minor — rows again multiple-of-8, but the t per-row (rows, nb)
+      f32 accumulators cap rows*nb*t for scoped-VMEM headroom.
+    * t > MULTI_T_MAX (MXU body): out block (t_tile, rows) — rows is MINOR
+      and must be a multiple of 128 or the whole d, with its own rows*nb cap
+      for the f32 wlo/whi temporaries.
+    """
+    if t == 1:
+        step, cap = 8, d
+    elif t <= MULTI_T_MAX:
+        # the compiler keeps several unrolled-plane temporaries live next to
+        # the t accumulators; 300k f32 words of rows*nb*t keeps the whole
+        # stack under the 16MB scoped-vmem limit with double buffering
+        step, cap = 8, max(8, 300_000 // (t * nb))
+    else:
+        step, cap = 128, _MATMUL_ROWSXNB_CAP // nb
+    top = (min(d, 768, cap) // step) * step
+    for cand in range(top, 0, -step):
         if d % cand == 0:
             return cand
-    return None
+    # small odd dims: a full-d block is legal when it fits the same budget
+    return d if d <= min(768, cap) else None
 
 
-def kernel_supports(d: int) -> bool:
-    """Whether the fused kernel can tile this output dim (callers fall back
-    to the XLA dequantize-then-dot path when not — see ops/linear.matmul)."""
-    return _pick_block_rows(d) is not None
+def kernel_supports(d: int, n: int) -> bool:
+    """Whether pre-tiling a (d, n) weight to the kernel layout pays off:
+    decided by the T=1 matvec path (the per-token hot loop). Other T values
+    that the tiling rules can't handle (e.g. d=1376 = 11008/tp8 has no
+    multiple-of-128 divisor for the T>8 MXU path) fall back INSIDE
+    q40_matmul to a dequantize-then-dot on the packed weight, so prefill
+    still works on any packed shape."""
+    return _pick_block_rows(d, 1, n // QK) is not None
 
 
 def _pick_block_t(t: int, nb: int) -> int:
@@ -250,6 +354,22 @@ def _pick_block_t(t: int, nb: int) -> int:
         if cand <= cap and t % cand == 0:
             return cand
     return t
+
+
+def _dequant_matmul(w: Q40Kernel, x2: jax.Array,
+                    layer: jax.Array | None) -> jax.Array:
+    """XLA fallback on an already-packed weight: dequantize the (layer's)
+    kernel-layout blocks inline and dot. Used only for (d, t) combos the
+    tiling rules can't place (see q40_matmul)."""
+    from .quants import dequantize_q40_jax
+
+    if layer is not None:
+        w = Q40Kernel(w.qs_t[layer], w.scale[layer])
+    qs = jnp.transpose(w.qs_t, (1, 2, 0))            # (d, nb, 16)
+    wf = dequantize_q40_jax(qs, w.scale)
+    return jnp.einsum("dn,tn->td", wf, x2.astype(jnp.float32),
+                      preferred_element_type=jnp.float32,
+                      precision=jax.lax.Precision.HIGHEST)
 
 
 def q40_matmul(w: Q40Kernel | Q40Weight, x: jax.Array,
@@ -272,16 +392,19 @@ def q40_matmul(w: Q40Kernel | Q40Weight, x: jax.Array,
     d, nb = qs_t.shape[-2], qs_t.shape[-1]
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    if block_rows is None:
-        block_rows = _pick_block_rows(d)
-        if block_rows is None:
-            raise ValueError(
-                f"q40_matmul needs an output dim with a multiple-of-8 "
-                f"divisor, got d={d}")
     lead = x.shape[:-1]
     n = x.shape[-1]
     x2 = x.reshape(-1, n)
-    block_t = _pick_block_t(x2.shape[0], nb)
+    t = x2.shape[0]
+    if block_rows is None:
+        block_rows = _pick_block_rows(d, t, nb)
+        if block_rows is None:
+            # this (d, t) combo has no legal tiling (e.g. TP-shard dims with
+            # no multiple-of-128 divisor at MXU T): dequantize-then-dot on
+            # the packed weight — correctness everywhere, kernel speed on
+            # the shapes that matter
+            return _dequant_matmul(w, x2, layer).reshape(*lead, d)
+    block_t = _pick_block_t(t, nb)
     if layer is not None:
         if qs_t.ndim != 4:
             raise ValueError("layer= requires stacked (L, 16, d, nb) weights")

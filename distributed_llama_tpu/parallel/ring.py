@@ -99,7 +99,7 @@ def update_sp_cache(cache_chunk, new_vals, pos, sp_index, seq_chunk: int):
     row = jnp.arange(seq_chunk)
     belongs = (row >= first) & (row < first + t_len)           # (C,)
     src = jnp.clip(row - first, 0, t_len - 1)                  # (C,)
-    candidate = new_vals[src]                                  # (C, n_kv, hs)
+    candidate = new_vals[src].astype(cache_chunk.dtype)        # (C, n_kv, hs)
     return jnp.where(belongs[:, None, None], candidate, cache_chunk)
 
 

@@ -141,8 +141,9 @@ def _local_layer(spec: TransformerSpec, n_slices: int, n_sp: int, x, lw,
     # RoPE's angle depends only on (feature index mod head_size): local == global
     q = rope_rotate(q, positions, spec.head_size)
     k = rope_rotate(k, positions, spec.head_size)
-    k_new = k.reshape(t_len, kv_heads_loc, spec.head_size)
-    v_new = v.reshape(t_len, kv_heads_loc, spec.head_size)
+    dt = k_all.dtype  # f32 parity default; bf16 halves cache HBM/memory
+    k_new = k.reshape(t_len, kv_heads_loc, spec.head_size).astype(dt)
+    v_new = v.reshape(t_len, kv_heads_loc, spec.head_size).astype(dt)
     qh = q.reshape(t_len, heads_loc, spec.head_size)
 
     if n_sp == 1:

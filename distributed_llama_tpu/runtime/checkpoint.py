@@ -46,8 +46,11 @@ def _savez(f, engine, sampler, pos, token, tokens_out):
         f,
         version=np.int32(FORMAT_VERSION),
         header=np.frombuffer(engine.spec.header(), dtype=np.uint8),
-        k=np.asarray(engine.cache.k),  # gathers if sharded
-        v=np.asarray(engine.cache.v),
+        # stored f32 regardless of engine cache dtype (np.savez can't hold
+        # bf16; f32 is lossless for both); gathers if sharded
+        k=np.asarray(engine.cache.k).astype(np.float32),
+        v=np.asarray(engine.cache.v).astype(np.float32),
+        cache_dtype=np.array(np.dtype(engine.cache_dtype).name),
         pos=np.int32(pos),
         token=np.int32(token),
         rng_state=np.uint64(sampler.rng.state),
@@ -72,7 +75,16 @@ def load_generation_state(path: str, engine: Engine,
     if z["header"].tobytes() != engine.spec.header():
         raise ValueError("checkpoint spec header does not match the loaded "
                          "model")
-    cache = KVCache(jnp.asarray(z["k"]), jnp.asarray(z["v"]))
+    saved_dtype = str(z["cache_dtype"]) if "cache_dtype" in z else "float32"
+    if saved_dtype != np.dtype(engine.cache_dtype).name:
+        # restoring into a different cache precision would silently break
+        # the bit-identical-resume contract (module docstring)
+        raise ValueError(
+            f"checkpoint cache dtype {saved_dtype!r} does not match the "
+            f"engine's {np.dtype(engine.cache_dtype).name!r} — resume with "
+            f"the same --kv-cache-dtype")
+    cache = KVCache(jnp.asarray(z["k"], dtype=engine.cache_dtype),
+                    jnp.asarray(z["v"], dtype=engine.cache_dtype))
     if engine.sharded:
         from ..parallel import shard_cache
 

@@ -93,3 +93,43 @@ def make_decode_loop(step_fn: StepFn, steps: int, temperature: float,
     return jax.jit(run, donate_argnums=1)
 
 
+def make_batch_decode_loop(spec, steps: int, temperature: float, topp: float):
+    """Fused decode loop over B sequences in lockstep (models/llama.
+    forward_batch) — the throughput path the reference lacks (batch=1 only).
+
+    run(params, cache, prompts (B, steps+1), first_tokens (B,),
+        coins (B, steps)) -> (tokens (B, steps), cache).
+
+    All rows share the position clock (the shared-pos contract that keeps
+    the cache update an in-place dynamic_update_slice — see forward_batch).
+    Ragged prompts right-pad with -1: at position p a row forces
+    prompts[b, p+1] when >= 0, else samples with its own coin (vmapped
+    reference sampler semantics).
+    """
+    import functools
+
+    from ..models.llama import forward_batch
+
+    if steps > spec.seq_len:
+        raise ValueError(f"steps={steps} exceeds seq_len={spec.seq_len}")
+    step_fn = functools.partial(forward_batch, spec)
+
+    def run(params, cache, prompts, first_tokens, coins):
+        def body(carry, xs):
+            tokens, cache = carry
+            pos, coin_row = xs
+            logits, cache = step_fn(params, cache, tokens, pos)
+            sampled = jax.vmap(
+                lambda lg, c: sample_device(lg, c, temperature, topp)
+            )(logits, coin_row)
+            forced = prompts[:, pos + 1]
+            nxt = jnp.where(forced >= 0, forced, sampled)
+            return (nxt, cache), nxt
+
+        xs = (jnp.arange(steps, dtype=jnp.int32), coins.T)
+        (_, cache), toks = jax.lax.scan(body, (first_tokens, cache), xs)
+        return toks.T, cache  # (B, steps)
+
+    return jax.jit(run, donate_argnums=1)
+
+

@@ -34,7 +34,7 @@ class Engine:
     """Owns params + cache + the jitted step; exposes infer(token, pos)."""
 
     def __init__(self, spec: TransformerSpec, params: dict[str, Any],
-                 mesh=None):
+                 mesh=None, cache_dtype=None):
         import functools
 
         import jax
@@ -43,6 +43,9 @@ class Engine:
         self.spec = spec
         self.jnp = jnp
         self.mesh = mesh
+        # f32 = logit-parity default; bf16 halves cache memory + attention
+        # HBM traffic (the reference's cache is f32, transformer.cpp:198-199)
+        self.cache_dtype = cache_dtype or jnp.float32
         self.tp = mesh.shape["tp"] if mesh is not None else 1
         self.sp = mesh.shape.get("sp", 1) if mesh is not None else 1
         self.sharded = self.tp > 1 or self.sp > 1
@@ -53,14 +56,14 @@ class Engine:
 
             validate_sharding(spec, mesh)  # clear error before any device_put
             self.params = shard_params(params, mesh)
-            self.cache = shard_cache(init_cache(spec), mesh)
+            self.cache = shard_cache(init_cache(spec, self.cache_dtype), mesh)
             self._fwd = make_sharded_forward(spec, mesh)
             self._step_raw = self._fwd  # shard_map wrapper; traceable in scan
         else:
             from ..models.llama import params_to_device
 
             self.params = params_to_device(params)
-            self.cache = init_cache(spec)
+            self.cache = init_cache(spec, self.cache_dtype)
             self._step_raw = functools.partial(forward, spec)
             self._fwd = jax.jit(self._step_raw, donate_argnums=1)
 
@@ -82,7 +85,7 @@ class Engine:
         return self._loops[key]
 
     def reset(self):
-        self.cache = init_cache(self.spec)
+        self.cache = init_cache(self.spec, self.cache_dtype)
         if self.sharded:
             from ..parallel import shard_cache
 

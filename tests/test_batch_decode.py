@@ -1,0 +1,101 @@
+"""Batched decode: B lockstep sequences must match B single-sequence runs."""
+
+import numpy as np
+import pytest
+
+from distributed_llama_tpu.models.spec import TransformerSpec
+from distributed_llama_tpu.models.synth import synth_params
+
+SPEC = TransformerSpec(dim=64, hidden_dim=160, n_layers=2, n_heads=4,
+                       n_kv_heads=2, vocab_size=128, seq_len=16)
+
+
+@pytest.fixture(scope="module")
+def params_dev():
+    from distributed_llama_tpu.models.llama import params_to_device
+
+    return params_to_device(synth_params(SPEC, q40=False, seed=4, scale=0.3))
+
+
+def test_forward_batch_matches_singles(params_dev):
+    import jax.numpy as jnp
+
+    from distributed_llama_tpu.models.llama import (forward, forward_batch,
+                                                    init_cache,
+                                                    init_cache_batch)
+
+    B = 3
+    rows = {0: [7], 1: [17, 3], 2: [40, 88]}  # per-row token history
+    pos_hist = 2  # shared position clock: rows already ran pos 0..1
+    rows[0].append(11)  # make all histories length 2 (lockstep contract)
+
+    singles = []
+    caches = []
+    tokens_now = jnp.asarray([5, 9, 77], dtype=jnp.int32)
+    for b in range(B):
+        c = init_cache(SPEC)
+        for p, t in enumerate(rows[b]):
+            _, c = forward(SPEC, params_dev, c,
+                           jnp.asarray([t], jnp.int32), jnp.int32(p))
+        caches.append(c)
+        lg, c2 = forward(SPEC, params_dev, c, tokens_now[b][None],
+                         jnp.int32(pos_hist))
+        singles.append((np.asarray(lg[0]), c2))
+
+    cache_b = init_cache_batch(SPEC, B)
+    cache_b = cache_b._replace(
+        k=jnp.stack([c.k for c in caches], axis=1),
+        v=jnp.stack([c.v for c in caches], axis=1))
+    lg_b, cache_b2 = forward_batch(SPEC, params_dev, cache_b, tokens_now,
+                                   jnp.int32(pos_hist))
+
+    for b in range(B):
+        np.testing.assert_allclose(np.asarray(lg_b[b]), singles[b][0],
+                                   rtol=2e-5, atol=2e-5)
+        np.testing.assert_allclose(np.asarray(cache_b2.k[:, b]),
+                                   np.asarray(singles[b][1].k),
+                                   rtol=1e-5, atol=1e-5)
+
+
+def test_batch_decode_loop_matches_single_loop(params_dev):
+    import functools
+
+    import jax.numpy as jnp
+
+    from distributed_llama_tpu.models.llama import (forward, init_cache,
+                                                    init_cache_batch)
+    from distributed_llama_tpu.runtime.decode import (make_batch_decode_loop,
+                                                      make_decode_loop)
+
+    steps = 8
+    B = 2
+    prompts = [[1, 5, 9], [1, 22]]  # ragged: row 1 starts sampling earlier
+
+    single_out = []
+    step = functools.partial(forward, SPEC)
+    run1 = make_decode_loop(step, steps, temperature=0.0, topp=0.9)
+    for p in prompts:
+        padded = np.full((steps + 1,), -1, dtype=np.int32)
+        padded[:len(p)] = p
+        toks, _ = run1(params_dev, init_cache(SPEC), jnp.asarray(padded),
+                       jnp.int32(p[0]), jnp.zeros((steps,), jnp.float32))
+        single_out.append(np.asarray(toks))
+
+    runb = make_batch_decode_loop(SPEC, steps, temperature=0.0, topp=0.9)
+    padded = np.full((B, steps + 1), -1, dtype=np.int32)
+    for b, p in enumerate(prompts):
+        padded[b, :len(p)] = p
+    toks_b, _ = runb(params_dev, init_cache_batch(SPEC, B),
+                     jnp.asarray(padded),
+                     jnp.asarray([p[0] for p in prompts], jnp.int32),
+                     jnp.zeros((B, steps), jnp.float32))
+    toks_b = np.asarray(toks_b)
+    for b in range(B):
+        np.testing.assert_array_equal(toks_b[b], single_out[b])
+
+
+def test_batch_loop_rejects_steps_past_seq_len(params_dev):
+    from distributed_llama_tpu.runtime.decode import make_batch_decode_loop
+
+    with pytest.raises(ValueError, match="seq_len"):
+        make_batch_decode_loop(SPEC, SPEC.seq_len + 1, 0.0, 0.9)

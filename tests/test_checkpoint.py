@@ -70,3 +70,16 @@ def test_load_rejects_spec_mismatch(tmp_path, params):
                                             scale=0.3))
     with pytest.raises(ValueError, match="header"):
         load_generation_state(ckpt, other, s)
+
+
+def test_load_rejects_cache_dtype_mismatch(tmp_path, params):
+    import jax.numpy as jnp
+
+    eng = Engine(SPEC, params)  # f32 cache
+    s = _sampler()
+    ckpt = str(tmp_path / "gen.npz")
+    save_generation_state(ckpt, eng, s, 3, 7, [])
+
+    eng_bf16 = Engine(SPEC, params, cache_dtype=jnp.bfloat16)
+    with pytest.raises(ValueError, match="cache dtype"):
+        load_generation_state(ckpt, eng_bf16, s)

@@ -165,3 +165,28 @@ def test_rope_matches_scalar_reference():
                                  jnp.asarray([pos], dtype=jnp.int32),
                                  head_size))[0]
     np.testing.assert_allclose(got, expected, rtol=0, atol=1e-5)
+
+
+def test_bf16_kv_cache_close_to_f32(tiny_model):
+    """bf16 KV cache (memory/bandwidth mode) stays within bf16 rounding of
+    the f32 parity path across a short multi-token decode."""
+    import jax.numpy as jnp
+
+    from distributed_llama_tpu.models.llama import (forward, init_cache,
+                                                    params_to_device)
+
+    p = params_to_device(tiny_model)
+    toks = [5, 9, 2, 40]
+    lg32 = lgbf = None
+    c32 = init_cache(TINY)
+    cbf = init_cache(TINY, jnp.bfloat16)
+    assert cbf.k.dtype == jnp.bfloat16
+    for pos, t in enumerate(toks):
+        tok = jnp.asarray([t], jnp.int32)
+        lg32, c32 = forward(TINY, p, c32, tok, jnp.int32(pos))
+        lgbf, cbf = forward(TINY, p, cbf, tok, jnp.int32(pos))
+        assert cbf.k.dtype == jnp.bfloat16  # dtype survives the update
+    import numpy as np
+
+    diff = np.abs(np.asarray(lg32) - np.asarray(lgbf)).max()
+    assert diff < 0.05  # bf16 has ~3 decimal digits; logits are O(1)

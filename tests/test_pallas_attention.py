@@ -30,6 +30,34 @@ def test_decode_attention_matches_core(kv_mul, pos):
                                rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.parametrize("kv_mul,pos", [(1, 0), (1, 17), (2, 9)])
+def test_decode_attention_batch_matches_core(kv_mul, pos):
+    import jax.numpy as jnp
+
+    from distributed_llama_tpu.models.llama import (attention_core,
+                                                    causal_cache_mask)
+    from distributed_llama_tpu.ops.pallas_attention import \
+        decode_attention_batch
+
+    L, B, S, n_kv, hs = 2, 3, 32, 4, 128
+    n_q = n_kv * kv_mul
+    layer = 1
+    rng = np.random.default_rng(pos * 3 + kv_mul)
+    # rank-4 batched cache (L*B, S, n_kv, hs), row = layer*B + b
+    k4 = jnp.asarray(rng.normal(size=(L * B, S, n_kv, hs)).astype(np.float32))
+    v4 = jnp.asarray(rng.normal(size=(L * B, S, n_kv, hs)).astype(np.float32))
+    q = jnp.asarray(rng.normal(size=(B, n_q, hs)).astype(np.float32))
+
+    got = decode_attention_batch(q, k4, v4, layer, pos, kv_mul=kv_mul,
+                                 interpret=True)
+    mask = causal_cache_mask(S, jnp.int32(pos), 1)
+    for b in range(B):
+        want = attention_core(hs, kv_mul, q[b][None], k4[layer * B + b],
+                              v4[layer * B + b], mask)
+        np.testing.assert_allclose(np.asarray(got[b][None]),
+                                   np.asarray(want), rtol=1e-5, atol=1e-5)
+
+
 def test_decode_attention_ignores_stale_suffix():
     """Entries beyond pos (stale garbage from earlier generations) must not
     affect the result — the kernel only walks live chunks and masks within

@@ -160,3 +160,31 @@ def test_matmul_dispatch_prefer_pallas():
     b = matmul(w, jnp.asarray(x), prefer_pallas=True)
     np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5,
                                atol=1e-4)
+
+
+def test_tp_shard_dims_keep_matvec_kernel_and_fallback_for_big_t():
+    """d = 11008/tp8 = 1376 has no multiple-of-128 divisor: the T=1 matvec
+    path must still tile it (kernel_supports gates packing on T=1 only), and
+    big-T calls must fall back to dequantize-then-dot INSIDE q40_matmul
+    instead of raising."""
+    import jax.numpy as jnp
+
+    from distributed_llama_tpu.io.loader import Q40Weight, to_kernel_layout
+    from distributed_llama_tpu.ops.pallas_q40 import (kernel_supports,
+                                                      q40_matmul)
+    from distributed_llama_tpu.ops.quants import quantize_q40
+
+    d, n = 1376, 256
+    assert kernel_supports(d, n)
+    rng = np.random.default_rng(3)
+    wf = (rng.standard_normal((d, n)) * 0.1).astype(np.float32)
+    qs, d16 = quantize_q40(wf)
+    w = to_kernel_layout(Q40Weight(qs, d16))
+
+    from distributed_llama_tpu.ops.linear import dequantize_weight
+
+    wref = np.asarray(dequantize_weight(Q40Weight(qs, d16)))
+    for t in (1, 12):  # matvec kernel; MXU-untileable -> internal fallback
+        x = (rng.standard_normal((t, n)) * 0.5).astype(np.float32)
+        got = np.asarray(q40_matmul(w, jnp.asarray(x), interpret=True))
+        np.testing.assert_allclose(got, x @ wref.T, rtol=2e-4, atol=2e-4)
