@@ -173,6 +173,7 @@ def main():
         "value": round(ms, 3),
         "unit": "ms/token",
         "vs_baseline": round(baseline / ms, 2),
+        "samples": args.samples,  # reference protocol = 16 (--samples 16)
     }
     print(json.dumps(result))
 

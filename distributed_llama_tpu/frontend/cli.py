@@ -148,8 +148,8 @@ def cmd_inference(argv: list[str], quiet: bool = False) -> int:
     if args.resume_state:
         from ..runtime.checkpoint import load_generation_state
 
-        pos0, tok0, prev0 = load_generation_state(args.resume_state, engine,
-                                                  sampler)
+        pos0, tok0, prev0, rest0 = load_generation_state(
+            args.resume_state, engine, sampler)
         resume = (pos0, tok0)
         if not quiet:
             print(f"⏩ Resumed at pos {pos0} ({len(prev0)} tokens so far)")
@@ -169,7 +169,8 @@ def cmd_inference(argv: list[str], quiet: bool = False) -> int:
                       "the per-step loop")
             out, stats = generate(engine, tokenizer, sampler,
                                   args.prompt or "", args.steps, quiet=quiet,
-                                  resume=resume)
+                                  resume=resume,
+                                  resume_prompt=(rest0 if resume else None))
     if args.profile and not quiet:
         print(f"⏩ Profiler trace written to {args.profile}")
     if args.save_state:
@@ -179,7 +180,7 @@ def cmd_inference(argv: list[str], quiet: bool = False) -> int:
         if stats.final_pos > 0 and stats.final_token != BOS:
             save_generation_state(args.save_state, engine, sampler,
                                   stats.final_pos, stats.final_token,
-                                  prev + out)
+                                  prev + out, stats.prompt_rest)
             if not quiet:
                 print(f"⏩ Saved generation state to {args.save_state}")
         elif not quiet:

@@ -28,42 +28,53 @@ FORMAT_VERSION = 1
 
 def save_generation_state(path: str, engine: Engine, sampler: Sampler,
                           pos: int, token: int,
-                          tokens_out: list[int]) -> None:
+                          tokens_out: list[int],
+                          prompt_rest: list[int] | None = None) -> None:
     """Snapshot a generation: resume later with load + generate(resume=...).
 
     ``pos``/``token``: the next inference step's inputs (GenStats.final_pos /
     final_token from the interrupted run). ``tokens_out``: tokens emitted so
     far (stored so the caller can reconstruct the full stream).
+    ``prompt_rest``: prompt tokens the interrupted run had NOT yet consumed
+    (GenStats.prompt_rest) — without them a resumed run would sample where
+    the unsplit run forces, silently diverging.
     """
     # write through a file object: np.savez(str_path) would silently append
     # '.npz', landing the file somewhere other than the path we report
     with open(path, "wb") as f:
-        _savez(f, engine, sampler, pos, token, tokens_out)
+        _savez(f, engine, sampler, pos, token, tokens_out,
+               prompt_rest or [])
 
 
-def _savez(f, engine, sampler, pos, token, tokens_out):
+def _savez(f, engine, sampler, pos, token, tokens_out, prompt_rest):
     np.savez(
         f,
         version=np.int32(FORMAT_VERSION),
         header=np.frombuffer(engine.spec.header(), dtype=np.uint8),
         # stored f32 regardless of engine cache dtype (np.savez can't hold
-        # bf16; f32 is lossless for both); gathers if sharded
-        k=np.asarray(engine.cache.k).astype(np.float32),
-        v=np.asarray(engine.cache.v).astype(np.float32),
+        # bf16; f32 is lossless for both); gathers if sharded. Only the live
+        # prefix [0, pos) is stored — the suffix is dead (masked by every
+        # attention path) and would make each 7B/2048 checkpoint ~2.1GB
+        # regardless of progress
+        k=np.asarray(engine.cache.k[:, :pos]).astype(np.float32),
+        v=np.asarray(engine.cache.v[:, :pos]).astype(np.float32),
         cache_dtype=np.array(np.dtype(engine.cache_dtype).name),
         pos=np.int32(pos),
         token=np.int32(token),
         rng_state=np.uint64(sampler.rng.state),
         tokens_out=np.asarray(tokens_out, dtype=np.int32),
+        prompt_rest=np.asarray(prompt_rest, dtype=np.int32),
     )
 
 
-def load_generation_state(path: str, engine: Engine,
-                          sampler: Sampler) -> tuple[int, int, list[int]]:
+def load_generation_state(
+        path: str, engine: Engine,
+        sampler: Sampler) -> tuple[int, int, list[int], list[int]]:
     """Restore a snapshot into ``engine``/``sampler``.
 
-    Returns (pos, token, tokens_out) — pass (pos, token) to
-    generate(resume=...). Raises ValueError on format/spec mismatch.
+    Returns (pos, token, tokens_out, prompt_rest) — pass (pos, token) to
+    generate(resume=...) and prompt_rest to its ``resume_prompt``. Raises
+    ValueError on format/spec mismatch.
     """
     import jax.numpy as jnp
 
@@ -83,12 +94,20 @@ def load_generation_state(path: str, engine: Engine,
             f"checkpoint cache dtype {saved_dtype!r} does not match the "
             f"engine's {np.dtype(engine.cache_dtype).name!r} — resume with "
             f"the same --kv-cache-dtype")
-    cache = KVCache(jnp.asarray(z["k"], dtype=engine.cache_dtype),
-                    jnp.asarray(z["v"], dtype=engine.cache_dtype))
+    def _restore(a):  # zero-pad the dead suffix back to seq_len
+        full = np.zeros((a.shape[0], engine.spec.seq_len, *a.shape[2:]),
+                        np.float32)
+        full[:, :a.shape[1]] = a
+        return jnp.asarray(full, dtype=engine.cache_dtype)
+
+    cache = KVCache(_restore(z["k"]), _restore(z["v"]))
     if engine.sharded:
         from ..parallel import shard_cache
 
         cache = shard_cache(cache, engine.mesh)
     engine.cache = cache
     sampler.rng.state = int(z["rng_state"])
-    return int(z["pos"]), int(z["token"]), z["tokens_out"].astype(int).tolist()
+    rest = (z["prompt_rest"].astype(int).tolist()
+            if "prompt_rest" in z else [])
+    return (int(z["pos"]), int(z["token"]),
+            z["tokens_out"].astype(int).tolist(), rest)

@@ -106,6 +106,9 @@ class GenStats:
     host_ms: float = 0.0
     final_pos: int = 0    # next step's pos — checkpoint/resume anchor
     final_token: int = 0  # next step's input token
+    prompt_rest: list = dataclasses.field(default_factory=list)
+    # ^ prompt tokens NOT yet consumed when the run ended (forced-token tail
+    #   for a resumed continuation; empty once the prompt is exhausted)
 
     @property
     def avg(self) -> tuple[float, float, float]:
@@ -117,7 +120,8 @@ def generate(engine: Engine, tokenizer: Tokenizer, sampler: Sampler,
              prompt: str, steps: int,
              emit: Callable[[str], None] | None = None,
              quiet: bool = False,
-             resume: tuple[int, int] | None = None) -> tuple[list[int], GenStats]:
+             resume: tuple[int, int] | None = None,
+             resume_prompt: list[int] | None = None) -> tuple[list[int], GenStats]:
     """Reference generation loop (tokenizer.cpp:321-394).
 
     Encodes the prompt with BOS (no EOS), forces prompt tokens, samples after,
@@ -125,13 +129,17 @@ def generate(engine: Engine, tokenizer: Tokenizer, sampler: Sampler,
 
     ``resume=(pos, token)`` continues an interrupted generation instead of
     starting one: the engine's cache and the sampler's RNG must have been
-    restored first (runtime/checkpoint.py), the prompt is ignored, and up to
-    ``steps`` more positions run.
+    restored first (runtime/checkpoint.py), the prompt argument is ignored
+    (``resume_prompt`` carries any prompt tail the interrupted run had not
+    yet consumed — GenStats.prompt_rest), and up to ``steps`` more positions
+    run.
     """
     spec = engine.spec
     if resume is not None:
         start_pos, token = resume
-        prompt_tokens: list[int] = []
+        # re-anchor the unconsumed prompt tail at absolute positions: the
+        # loop forces prompt_tokens[pos + 1], so pad the consumed prefix
+        prompt_tokens = ([-1] * (start_pos + 1)) + list(resume_prompt or [])
         steps = min(start_pos + steps, spec.seq_len)
     else:
         start_pos, steps = 0, min(steps, spec.seq_len)
@@ -164,6 +172,7 @@ def generate(engine: Engine, tokenizer: Tokenizer, sampler: Sampler,
 
         pos += 1
         stats.final_pos, stats.final_token = pos, int(next_token)
+        stats.prompt_rest = [t for t in prompt_tokens[pos + 1:] if t >= 0]
         if next_token == BOS:
             break  # reference stops on BOS before decoding it (tokenizer.cpp:376)
         out_tokens.append(next_token)
@@ -204,6 +213,7 @@ def generate_fast(engine: Engine, tokenizer: Tokenizer, sampler: Sampler,
     prompt_tokens = tokenizer.encode(prompt or "", bos=True, eos=False)
     if not prompt_tokens:
         raise ValueError("something is wrong, expected at least 1 prompt token")
+    prompt_tail = prompt_tokens[steps + 1:]  # beyond this chain: resume tail
     if len(prompt_tokens) > steps + 1:
         prompt_tokens = prompt_tokens[:steps + 1]
 
@@ -257,6 +267,7 @@ def generate_fast(engine: Engine, tokenizer: Tokenizer, sampler: Sampler,
                      infer_ms=total_ms, host_ms=0.0)
     if len(toks) and len(out_tokens) == len(toks):  # no early BOS: resumable
         stats.final_pos, stats.final_token = steps, int(toks[-1])
+        stats.prompt_rest = prompt_tail
     if not quiet:
         print(f"\nGenerated tokens:    {stats.tokens}")
         print(f"Avg generation time: {total_ms / n:.2f} ms "

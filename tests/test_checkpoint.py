@@ -49,11 +49,39 @@ def test_split_generation_is_bit_identical(tmp_path, params):
 
     eng2 = Engine(SPEC, params)  # fresh engine: cache restored from disk
     s2 = _sampler(seed=123)      # wrong seed: must be overwritten by load
-    pos, token, prev = load_generation_state(ckpt, eng2, s2)
-    assert prev == part1 and pos == stats1.final_pos
+    pos, token, prev, rest = load_generation_state(ckpt, eng2, s2)
+    assert prev == part1 and pos == stats1.final_pos and rest == []
     part2, _ = generate(eng2, tok, s2, "IGNORED", steps=12 - pos, quiet=True,
                         resume=(pos, token))
 
+    assert part1 + part2 == full
+
+
+def test_split_mid_prompt_preserves_forced_tail(tmp_path, params):
+    """Checkpointing BEFORE the prompt is consumed must carry the unconsumed
+    forced tokens into the resumed run (review finding: without prompt_rest
+    the continuation samples where the unsplit run forces)."""
+    tok = _IdTokenizer()
+    long_prompt = "abcdefg"  # 8 tokens with BOS: consumed through pos 7
+
+    full_engine = Engine(SPEC, params)
+    full, _ = generate(full_engine, tok, _sampler(), long_prompt, steps=12,
+                       quiet=True)
+
+    eng1 = Engine(SPEC, params)
+    s1 = _sampler()
+    part1, stats1 = generate(eng1, tok, s1, long_prompt, steps=4, quiet=True)
+    assert stats1.prompt_rest  # split fell inside the prompt
+    ckpt = str(tmp_path / "gen.npz")
+    save_generation_state(ckpt, eng1, s1, stats1.final_pos,
+                          stats1.final_token, part1, stats1.prompt_rest)
+
+    eng2 = Engine(SPEC, params)
+    s2 = _sampler(seed=99)
+    pos, token, prev, rest = load_generation_state(ckpt, eng2, s2)
+    assert rest == stats1.prompt_rest
+    part2, _ = generate(eng2, tok, s2, "IGNORED", steps=12 - pos, quiet=True,
+                        resume=(pos, token), resume_prompt=rest)
     assert part1 + part2 == full
 
 
@@ -70,6 +98,18 @@ def test_load_rejects_spec_mismatch(tmp_path, params):
                                             scale=0.3))
     with pytest.raises(ValueError, match="header"):
         load_generation_state(ckpt, other, s)
+
+
+def test_checkpoint_stores_live_prefix_only(tmp_path, params):
+    import os
+
+    eng = Engine(SPEC, params)
+    s = _sampler()
+    p_small = str(tmp_path / "small.npz")
+    p_big = str(tmp_path / "big.npz")
+    save_generation_state(p_small, eng, s, 2, 7, [])
+    save_generation_state(p_big, eng, s, SPEC.seq_len, 7, [])
+    assert os.path.getsize(p_small) < os.path.getsize(p_big)
 
 
 def test_load_rejects_cache_dtype_mismatch(tmp_path, params):

@@ -1,0 +1,109 @@
+"""Multi-host (DCN) smoke test: two REAL processes joined via
+jax.distributed over localhost, using the reference's root/worker CLI
+vocabulary (inference --host-id 0 / worker --host-id 1), must generate the
+same token stream as a single-process run.
+
+This covers what the reference only ever validated manually on 8 Raspberry
+Pis (SURVEY.md §4: 'Multi-node testing: manual only') — here the multi-host
+path is a CI test: each process contributes one virtual CPU device, the
+global mesh is tp=2 across processes, and the collectives ride the
+jax.distributed transport.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from distributed_llama_tpu.io.loader import write_model
+from distributed_llama_tpu.io.tokenizer import write_tokenizer
+from distributed_llama_tpu.models.spec import TransformerSpec
+from distributed_llama_tpu.ops.quants import FloatType
+
+SPEC = TransformerSpec(dim=64, hidden_dim=160, n_layers=2, n_heads=4,
+                       n_kv_heads=2, vocab_size=300, seq_len=32,
+                       weights_float_type=FloatType.Q40)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _write_model_files(d):
+    rng = np.random.default_rng(5)
+
+    def t(*shape):
+        return (rng.standard_normal(shape) * 0.1).astype(np.float32)
+
+    tensors = {"tok_embedding": t(SPEC.vocab_size, SPEC.dim),
+               "rms_att": 1 + t(SPEC.n_layers, SPEC.dim),
+               "rms_ffn": 1 + t(SPEC.n_layers, SPEC.dim),
+               "rms_final": 1 + t(SPEC.dim),
+               "wcls": t(SPEC.vocab_size, SPEC.dim)}
+    for name, shape in SPEC.layer_matmul_shapes():
+        tensors[name] = t(SPEC.n_layers, *shape)
+    model = str(d / "model.bin")
+    write_model(model, SPEC, tensors)
+    pieces = [b"<unk>", b"<s>", b"</s>"]
+    pieces += [f"<0x{i:02X}>".encode() for i in range(256)]
+    while len(pieces) < SPEC.vocab_size:
+        pieces.append(f"tok{len(pieces)}".encode())
+    tok = str(d / "tok.bin")
+    write_tokenizer(tok, pieces, [0.0] * len(pieces))
+    return model, tok
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _run(mode, model, tok, host_id, coordinator, n_devices, cwd, extra=()):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (f"--xla_force_host_platform_device_count={n_devices}")
+    env["PYTHONPATH"] = REPO
+    env.pop("DLLAMA_Q40_KERNEL", None)
+    args = [sys.executable, "-m", "distributed_llama_tpu.frontend.cli", mode,
+            "--model", model, "--tokenizer", tok, "--prompt", "hi",
+            "--steps", "6", "--temperature", "0.9", "--topp", "0.9",
+            "--seed", "11", "--tp", "2", *extra]
+    if coordinator:
+        args += ["--coordinator", coordinator, "--num-hosts", "2",
+                 "--host-id", str(host_id)]
+    # cwd is OUTSIDE the repo: some environments activate a hardware-backend
+    # shim keyed on the repo directory that overrides JAX_PLATFORMS=cpu
+    return subprocess.Popen(args, cwd=cwd, env=env,
+                            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                            text=True)
+
+
+def _pieces(out):
+    return [ln.rsplit("'", 2)[-2] for ln in out.splitlines()
+            if ln.startswith("🔶")]
+
+
+def test_two_process_inference_matches_single(tmp_path):
+    model, tok = _write_model_files(tmp_path)
+
+    # single process, 2 local virtual devices, tp=2
+    cwd = str(tmp_path)
+    p = _run("inference", model, tok, None, None, 2, cwd)
+    out_single, err = p.communicate(timeout=300)
+    assert p.returncode == 0, err[-2000:]
+    want = _pieces(out_single)
+    assert want, out_single
+
+    # two processes, 1 device each, same global tp=2 mesh over DCN
+    port = _free_port()
+    coord = f"127.0.0.1:{port}"
+    root = _run("inference", model, tok, 0, coord, 1, cwd)
+    worker = _run("worker", model, tok, 1, coord, 1, cwd)
+    out_root, err_root = root.communicate(timeout=360)
+    out_worker, err_worker = worker.communicate(timeout=60)
+    assert root.returncode == 0, f"root: {err_root[-2000:]}"
+    assert worker.returncode == 0, f"worker: {err_worker[-2000:]}"
+    assert _pieces(out_root) == want, out_root
+    assert _pieces(out_worker) == []  # workers run silent
