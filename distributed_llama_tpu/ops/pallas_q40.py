@@ -345,12 +345,13 @@ def kernel_supports(d: int, n: int) -> bool:
 
 
 def _pick_block_t(t: int, nb: int) -> int:
-    # cap the T tile so the resident xlo/xhi plane-sets (2 x NJ*bt*nb f32)
-    # stay within a few MB of VMEM next to the packed weight tile
-    cap = max(8, (3 * 1024 * 1024) // (NJ * nb * 4))
-    if t <= min(cap, 256):
+    # cap the T tile so the xlo/xhi plane-sets (2 x NJ*bt*nb f32, DOUBLE
+    # buffered by the pipeline) stay within a few MB of VMEM next to the
+    # packed weight tile (observed: bt=256 at nb=128 -> 16.9M scoped OOM)
+    cap = max(8, (3 * 1024 * 1024) // (NJ * nb * 8))
+    if t <= min(cap, 128):
         return t
-    for cand in (256, 128, 64, 32, 16, 8):
+    for cand in (128, 64, 32, 16, 8):
         if cand <= cap and t % cand == 0:
             return cand
     return t
@@ -396,6 +397,15 @@ def q40_matmul(w: Q40Kernel | Q40Weight, x: jax.Array,
     n = x.shape[-1]
     x2 = x.reshape(-1, n)
     t = x2.shape[0]
+    if t > MULTI_T_MAX and t % 8 != 0:
+        # pad to a multiple of 8 so the MXU path always has an under-cap
+        # t-tile divisor (a full-t block of awkward length can exceed the
+        # scoped-VMEM plane budget); the pad rows are zeros, sliced off below
+        pad = (-t) % 8
+        out = q40_matmul(w, jnp.pad(x2, ((0, pad), (0, 0))),
+                         block_rows=block_rows, interpret=interpret,
+                         layer=layer)
+        return out[:t].reshape(*lead, d)
     if block_rows is None:
         block_rows = _pick_block_rows(d, t, nb)
         if block_rows is None:

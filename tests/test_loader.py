@@ -98,3 +98,20 @@ def test_xorshift_stream_vectorized_matches_scalar():
     xs = a.f32_array(1000)
     ys = np.array([b.f32() for _ in range(1000)], dtype=np.float32)
     np.testing.assert_array_equal(xs, ys)
+
+
+def test_13b_70b_q40_size_anchors():
+    """Q40 file sizes for the reference's published model set (README.md:
+    90-92: 7B 3.95 / 13B 7.35 / 70B 36.98 GB) — byte-exact accounting for
+    the GQA (70B) layout included."""
+    from distributed_llama_tpu.models.spec import TransformerSpec
+    from distributed_llama_tpu.ops.quants import FloatType
+
+    spec13 = TransformerSpec(dim=5120, hidden_dim=13824, n_layers=40,
+                             n_heads=40, n_kv_heads=40, vocab_size=32000,
+                             seq_len=2048, weights_float_type=FloatType.Q40)
+    assert spec13.file_size() == 7887097884  # 7.345 GiB
+    spec70 = TransformerSpec(dim=8192, hidden_dim=28672, n_layers=80,
+                             n_heads=64, n_kv_heads=8, vocab_size=32000,
+                             seq_len=2048, weights_float_type=FloatType.Q40)
+    assert spec70.file_size() == 39706066972  # 36.979 GiB

@@ -188,3 +188,21 @@ def test_tp_shard_dims_keep_matvec_kernel_and_fallback_for_big_t():
         x = (rng.standard_normal((t, n)) * 0.5).astype(np.float32)
         got = np.asarray(q40_matmul(w, jnp.asarray(x), interpret=True))
         np.testing.assert_allclose(got, x @ wref.T, rtol=2e-4, atol=2e-4)
+
+
+def test_mxu_path_pads_awkward_t():
+    """T > MULTI_T_MAX and not a multiple of 8 must pad (a full-T tile of
+    awkward length can exceed the scoped-VMEM plane budget) and still match
+    the dequant reference."""
+    import jax.numpy as jnp
+
+    from distributed_llama_tpu.ops.pallas_q40 import MULTI_T_MAX, q40_matmul
+
+    w = _mk(256, 512, seed=21)
+    t = MULTI_T_MAX + 5  # 13: not a multiple of 8
+    rng = np.random.default_rng(22)
+    x = rng.standard_normal((t, 512)).astype(np.float32)
+    want = dequantize_q40(np.asarray(w.qs), np.asarray(w.d16)) @ x.T
+    got = q40_matmul(w, jnp.asarray(x))
+    assert got.shape == (t, 256)
+    np.testing.assert_allclose(np.asarray(got), want.T, rtol=1e-5, atol=1e-4)
