@@ -13,6 +13,7 @@
 #include <cstring>
 #include <cmath>
 #include <string>
+#include <thread>
 #include <unordered_map>
 #include <vector>
 
@@ -164,6 +165,50 @@ void q80_encode(const float* in, uint8_t* out, int64_t nb) {
         for (int j = 0; j < 32; j++)
             qs[j] = (int8_t)std::nearbyintf(x[j] * id);  // ties-to-even, NEON parity
     }
+}
+
+// ---- Q40 kernel-layout re-tiling (load-time, threaded) ---------------------
+//
+// (N, d, nb, 16) codec-layout nibble planes -> (N, 16, d, nb) kernel layout
+// (ops/pallas_q40 block shape), plus the f16 -> f32 scale upconvert. This is
+// the GB-scale transpose every Q40 load pays once; numpy does it
+// single-threaded through a strided copy. Parallel over (n, j) output planes:
+// each plane write is contiguous (d*nb bytes), reads are stride-16.
+
+static void tile_planes(const uint8_t* qs, uint8_t* qs_t, int64_t n_stacked,
+                        int64_t d, int64_t nb, int64_t lo, int64_t hi) {
+    const int64_t plane = d * nb;
+    for (int64_t w = lo; w < hi; w++) {
+        const int64_t s = w / 16, j = w % 16;
+        const uint8_t* src = qs + (s * plane + 0) * 16 + j;
+        uint8_t* dst = qs_t + (s * 16 + j) * plane;
+        for (int64_t i = 0; i < plane; i++) dst[i] = src[i * 16];
+    }
+}
+
+void q40_tile_kernel_layout(const uint8_t* qs, const uint16_t* d16,
+                            uint8_t* qs_t, float* scale, int64_t n_stacked,
+                            int64_t d, int64_t nb, int32_t n_threads) {
+    const int64_t work = n_stacked * 16;
+    if (n_threads < 1) n_threads = 1;
+    if (n_threads > work) n_threads = (int32_t)work;
+    std::vector<std::thread> ts;
+    ts.reserve((size_t)n_threads);
+    for (int32_t t = 0; t < n_threads; t++) {
+        int64_t lo = work * t / n_threads, hi = work * (t + 1) / n_threads;
+        ts.emplace_back(tile_planes, qs, qs_t, n_stacked, d, nb, lo, hi);
+    }
+    for (auto& th : ts) th.join();
+    const int64_t ns = n_stacked * d * nb;  // scales: f16 -> f32, threaded
+    std::vector<std::thread> ss;
+    ss.reserve((size_t)n_threads);
+    for (int32_t t = 0; t < n_threads; t++) {
+        int64_t lo = ns * t / n_threads, hi = ns * (t + 1) / n_threads;
+        ss.emplace_back([=]() {
+            for (int64_t i = lo; i < hi; i++) scale[i] = f16_to_f32(d16[i]);
+        });
+    }
+    for (auto& th : ss) th.join();
 }
 
 // ---- BPE tokenizer encode (reference src/tokenizer.cpp:84-204 semantics) ---

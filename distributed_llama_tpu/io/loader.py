@@ -66,8 +66,20 @@ class Q40Kernel(NamedTuple):
 
 
 def to_kernel_layout(w: Q40Weight) -> Q40Kernel:
-    """(..., d, nb, 16) -> (..., 16, d, nb), one-time load-side re-tiling."""
+    """(..., d, nb, 16) -> (..., 16, d, nb), one-time load-side re-tiling.
+
+    numpy inputs go through the THREADED C++ path when the host library is
+    available (csrc/host.cpp q40_tile_kernel_layout — this is a GB-scale
+    strided transpose at 7B/70B sizes); jax arrays and fallback use the
+    numpy transpose.
+    """
     qs = w.qs
+    if isinstance(qs, np.ndarray) and isinstance(w.d16, np.ndarray):
+        from ..utils import native
+
+        tiled = native.q40_tile_kernel_layout(qs, w.d16)
+        if tiled is not None:
+            return Q40Kernel(*tiled)
     nd = qs.ndim
     perm = tuple(range(nd - 3)) + (nd - 1, nd - 3, nd - 2)
     qs_t = qs.transpose(perm)

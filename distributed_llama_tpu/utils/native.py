@@ -55,6 +55,11 @@ def _load_locked():
         fn.restype = None
         fn.argtypes = [ctypes.POINTER(ctypes.c_float),
                        ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64]
+    lib.q40_tile_kernel_layout.restype = None
+    lib.q40_tile_kernel_layout.argtypes = [
+        ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_uint16),
+        ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_float),
+        ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int32]
     lib.tok_create.restype = ctypes.c_void_p
     lib.tok_create.argtypes = [ctypes.POINTER(ctypes.c_uint8),
                                ctypes.POINTER(ctypes.c_int64),
@@ -102,6 +107,36 @@ def q40_decode_wire(buf: np.ndarray, nb: int) -> np.ndarray | None:
     lib.q40_decode(buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
                    out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), nb)
     return out
+
+
+def q40_tile_kernel_layout(qs: np.ndarray, d16: np.ndarray,
+                           n_threads: int | None = None):
+    """Threaded (..., d, nb, 16) -> (..., 16, d, nb) re-tiling + f16->f32
+    scale upconvert — the load-time transform feeding the Pallas kernel
+    layout. Returns (qs_t, scale) or None when the native library is
+    unavailable (callers fall back to numpy)."""
+    lib = _load()
+    if lib is None:
+        return None
+    if qs.dtype != np.uint8 or d16.dtype != np.float16:
+        return None
+    *lead, d, nb, sixteen = qs.shape
+    if sixteen != 16:
+        return None
+    n_stacked = int(np.prod(lead)) if lead else 1
+    qs_c = np.ascontiguousarray(qs)
+    d16_c = np.ascontiguousarray(d16)
+    qs_t = np.empty((*lead, 16, d, nb), dtype=np.uint8)
+    scale = np.empty((*lead, d, nb), dtype=np.float32)
+    if n_threads is None:
+        n_threads = min(16, os.cpu_count() or 1)
+    lib.q40_tile_kernel_layout(
+        qs_c.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        d16_c.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16)),
+        qs_t.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        scale.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        n_stacked, d, nb, n_threads)
+    return qs_t, scale
 
 
 class NativeBpe:

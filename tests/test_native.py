@@ -111,3 +111,26 @@ def test_native_bpe_matches_python(tmp_path):
         finally:
             tok._native = saved
         assert native_ids == py_ids, text
+
+
+def test_native_tile_kernel_layout_matches_numpy():
+    from distributed_llama_tpu.utils import native
+
+    if not native.available():
+        import pytest
+
+        pytest.skip("native library unavailable")
+    rng = np.random.default_rng(6)
+    qs = rng.integers(0, 256, (3, 40, 5, 16), dtype=np.uint8)
+    d16 = (rng.random((3, 40, 5)) * 0.1).astype(np.float16)
+    got = native.q40_tile_kernel_layout(qs, d16)
+    assert got is not None
+    qs_t, scale = got
+    want_qs = np.ascontiguousarray(qs.transpose(0, 3, 1, 2))
+    np.testing.assert_array_equal(qs_t, want_qs)
+    np.testing.assert_array_equal(scale, d16.astype(np.float32))
+    # unstacked rank-3 too
+    qs_t2, scale2 = native.q40_tile_kernel_layout(qs[0], d16[0])
+    np.testing.assert_array_equal(qs_t2, np.ascontiguousarray(
+        qs[0].transpose(2, 0, 1)))
+    np.testing.assert_array_equal(scale2, d16[0].astype(np.float32))
