@@ -175,7 +175,7 @@ void q80_encode(const float* in, uint8_t* out, int64_t nb) {
 // single-threaded through a strided copy. Parallel over (n, j) output planes:
 // each plane write is contiguous (d*nb bytes), reads are stride-16.
 
-static void tile_planes(const uint8_t* qs, uint8_t* qs_t, int64_t n_stacked,
+static void tile_planes(const uint8_t* qs, uint8_t* qs_t,
                         int64_t d, int64_t nb, int64_t lo, int64_t hi) {
     const int64_t plane = d * nb;
     for (int64_t w = lo; w < hi; w++) {
@@ -196,7 +196,7 @@ void q40_tile_kernel_layout(const uint8_t* qs, const uint16_t* d16,
     ts.reserve((size_t)n_threads);
     for (int32_t t = 0; t < n_threads; t++) {
         int64_t lo = work * t / n_threads, hi = work * (t + 1) / n_threads;
-        ts.emplace_back(tile_planes, qs, qs_t, n_stacked, d, nb, lo, hi);
+        ts.emplace_back(tile_planes, qs, qs_t, d, nb, lo, hi);
     }
     for (auto& th : ts) th.join();
     const int64_t ns = n_stacked * d * nb;  // scales: f16 -> f32, threaded

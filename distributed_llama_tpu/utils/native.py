@@ -123,6 +123,9 @@ def q40_tile_kernel_layout(qs: np.ndarray, d16: np.ndarray,
     *lead, d, nb, sixteen = qs.shape
     if sixteen != 16:
         return None
+    if d16.shape != qs.shape[:-1]:  # native loop trusts the sizes: check here
+        raise ValueError(
+            f"d16 shape {d16.shape} does not match qs {qs.shape[:-1]}")
     n_stacked = int(np.prod(lead)) if lead else 1
     qs_c = np.ascontiguousarray(qs)
     d16_c = np.ascontiguousarray(d16)
