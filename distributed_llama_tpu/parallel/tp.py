@@ -151,12 +151,28 @@ def _local_layer(spec: TransformerSpec, n_slices: int, n_sp: int, x, lw,
                                              (idx, pos, 0, 0))
         v_all = jax.lax.dynamic_update_slice(v_all, v_new[None],
                                              (idx, pos, 0, 0))
-        k_c = jax.lax.dynamic_index_in_dim(k_all, idx, 0, keepdims=False)
-        v_c = jax.lax.dynamic_index_in_dim(v_all, idx, 0, keepdims=False)
-        # local-head attention (math of transformer-tasks.cpp:206-278 per
-        # head); contiguous bands keep h -> h//kvMul purely local
-        ao = attention_core(spec.head_size, spec.kv_mul, qh, k_c, v_c,
-                            causal_cache_mask(spec.seq_len, pos, t_len))
+
+        from ..ops.pallas_attention import (attn_kernel_mode,
+                                            decode_attention, supports)
+
+        if (attn_kernel_mode() == "pallas"
+                and supports(spec.seq_len, spec.head_size, t_len,
+                             kv_heads_loc, k_all.dtype.itemsize)):
+            # per-shard flash-decode over the LOCAL kv heads: contiguous
+            # bands keep h -> h//kvMul local, so the kernel's grouping
+            # applies unchanged at shard scope (live-chunk reads, like the
+            # single-chip path)
+            ao = decode_attention(qh.reshape(heads_loc, spec.head_size),
+                                  k_all, v_all, idx, pos,
+                                  kv_mul=spec.kv_mul)
+        else:
+            k_c = jax.lax.dynamic_index_in_dim(k_all, idx, 0, keepdims=False)
+            v_c = jax.lax.dynamic_index_in_dim(v_all, idx, 0,
+                                               keepdims=False)
+            # local-head attention (math of transformer-tasks.cpp:206-278
+            # per head)
+            ao = attention_core(spec.head_size, spec.kv_mul, qh, k_c, v_c,
+                                causal_cache_mask(spec.seq_len, pos, t_len))
     else:
         from .ring import sp_cache_attention, update_sp_cache
 

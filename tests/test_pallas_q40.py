@@ -138,6 +138,11 @@ def test_tp_sharded_forward_with_kernel_layout(monkeypatch):
                             tok, jnp.int32(0))
 
     monkeypatch.setenv("DLLAMA_Q40_KERNEL", "pallas")
+    # forcing the attention kernel here exercises the supports() gate's
+    # unsupported-shape fallback (head_size 32 fails the %128 check, so the
+    # XLA attention path must engage); the kernel-engaged TP case is
+    # test_tp_sharded_forward_with_flash_attention below
+    monkeypatch.setenv("DLLAMA_ATTN_KERNEL", "pallas")
     mesh = make_mesh(tp=2)
     sharded = shard_params(params, mesh)
     assert isinstance(sharded["wq"], Q40Kernel)  # packed + sharded
@@ -206,3 +211,45 @@ def test_mxu_path_pads_awkward_t():
     got = q40_matmul(w, jnp.asarray(x))
     assert got.shape == (t, 256)
     np.testing.assert_allclose(np.asarray(got), want.T, rtol=1e-5, atol=1e-4)
+
+
+def test_tp_sharded_forward_with_flash_attention(monkeypatch):
+    """TP forward with the flash-decode attention kernel ACTUALLY engaged
+    (head_size 128 — the supports() gate; per-shard local kv heads)."""
+    import jax.numpy as jnp
+
+    from distributed_llama_tpu.models.llama import (forward, init_cache,
+                                                    params_to_device)
+    from distributed_llama_tpu.models.spec import TransformerSpec
+    from distributed_llama_tpu.models.synth import synth_params
+    from distributed_llama_tpu.ops.pallas_attention import supports
+    from distributed_llama_tpu.parallel import (make_mesh,
+                                                make_sharded_forward,
+                                                shard_cache, shard_params)
+
+    spec = TransformerSpec(dim=512, hidden_dim=256, n_layers=2, n_heads=4,
+                           n_kv_heads=2, vocab_size=256, seq_len=32)
+    # mirror the production gate exactly (f32 cache itemsize = 4)
+    assert supports(spec.seq_len, spec.head_size, 1, spec.n_kv_heads // 2, 4)
+    params = synth_params(spec, q40=False, seed=17, scale=0.1)
+
+    monkeypatch.setenv("DLLAMA_ATTN_KERNEL", "pallas")
+    mesh = make_mesh(tp=2)
+    fwd = make_sharded_forward(spec, mesh)
+    # decode a few positions so the kernel sees a partly-filled cache
+    cache = shard_cache(init_cache(spec), mesh)
+    sharded = shard_params(params, mesh)
+    lg = None
+    for pos, t in enumerate([3, 9, 44]):
+        lg, cache = fwd(sharded, cache, jnp.asarray([t], jnp.int32),
+                        jnp.int32(pos))
+    # reference: same chain through the single-chip XLA path
+    monkeypatch.setenv("DLLAMA_ATTN_KERNEL", "xla")
+    c2 = init_cache(spec)
+    p2 = params_to_device(params)
+    want = None
+    for pos, t in enumerate([3, 9, 44]):
+        want, c2 = forward(spec, p2, c2, jnp.asarray([t], jnp.int32),
+                           jnp.int32(pos))
+    np.testing.assert_allclose(np.asarray(lg[0]), np.asarray(want[0]),
+                               rtol=2e-5, atol=2e-5)
