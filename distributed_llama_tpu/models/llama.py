@@ -177,18 +177,17 @@ def _layer(spec: TransformerSpec, x: jax.Array, lw: dict[str, Any],
     k_all = jax.lax.dynamic_update_slice(k_all, k_new, (idx, pos, 0, 0))
     v_all = jax.lax.dynamic_update_slice(v_all, v_new, (idx, pos, 0, 0))
 
-    from ..ops.pallas_attention import (attn_kernel_mode, decode_attention,
-                                        supports)
+    from ..ops.pallas_attention import maybe_flash_decode
 
-    if (attn_kernel_mode() == "pallas"
-            and supports(spec.seq_len, spec.head_size, t_len,
-                         spec.n_kv_heads, k_all.dtype.itemsize)):
-        # flash-decode kernel: reads only the live chunks of the stacked
-        # cache (pos-proportional HBM traffic, like the reference's 0..pos
-        # attention loop) instead of the full static plane
-        ao = decode_attention(q.reshape(spec.n_heads, spec.head_size),
-                              k_all, v_all, idx, pos, kv_mul=spec.kv_mul)
-    else:
+    # flash-decode kernel: reads only the live chunks of the stacked cache
+    # (pos-proportional HBM traffic, like the reference's 0..pos attention
+    # loop) instead of the full static plane
+    ao = maybe_flash_decode(
+        q.reshape(-1, spec.head_size) if t_len == 1 else q,
+        k_all, v_all, idx, pos, seq_len=spec.seq_len,
+        head_size=spec.head_size, t_len=t_len, n_kv=spec.n_kv_heads,
+        kv_mul=spec.kv_mul)
+    if ao is None:
         k_c = jax.lax.dynamic_index_in_dim(k_all, idx, 0, keepdims=False)
         v_c = jax.lax.dynamic_index_in_dim(v_all, idx, 0, keepdims=False)
         ao = attention(spec, q.reshape(t_len, spec.n_heads, spec.head_size),
@@ -320,18 +319,16 @@ def forward_batch(spec: TransformerSpec, params: dict[str, Any],
                                              (idx * B, pos, 0, 0))
         v_all = jax.lax.dynamic_update_slice(v_all, v_new,
                                              (idx * B, pos, 0, 0))
-        from ..ops.pallas_attention import (attn_kernel_mode,
-                                            decode_attention_batch, supports)
+        from ..ops.pallas_attention import maybe_flash_decode
 
-        if (attn_kernel_mode() == "pallas"
-                and supports(S, hs, 1, n_kv, k_all.dtype.itemsize)):
-            # per-row flash kernel: live-chunk DMA walk, no cache slice copy
-            # (the XLA einsum path below doesn't fuse the layer slice read —
-            # measured ~10x slower per step at 7B/B=4)
-            ao = decode_attention_batch(
-                q.reshape(B, spec.n_heads, hs), k_all, v_all, idx, pos,
-                kv_mul=kv_mul)
-        else:
+        # per-row flash kernel: live-chunk DMA walk, no cache slice copy
+        # (the XLA einsum path below doesn't fuse the layer slice read —
+        # measured ~10x slower per step at 7B/B=4)
+        ao = maybe_flash_decode(
+            q.reshape(B, spec.n_heads, hs), k_all, v_all, idx, pos,
+            seq_len=S, head_size=hs, t_len=1, n_kv=n_kv, kv_mul=kv_mul,
+            batch=True)
+        if ao is None:
             k_c = jax.lax.dynamic_slice_in_dim(k_all, idx * B, B, 0)
             v_c = jax.lax.dynamic_slice_in_dim(v_all, idx * B, B, 0)
             ao = attention_core(spec.head_size, kv_mul,
@@ -349,18 +346,27 @@ def forward_batch(spec: TransformerSpec, params: dict[str, Any],
 
 
 def forward_seq(spec: TransformerSpec, params: dict[str, Any],
-                tokens: jax.Array) -> jax.Array:
+                tokens: jax.Array, positions: jax.Array | None = None,
+                attention_fn=None) -> jax.Array:
     """Batched full-sequence forward without a KV cache: (B, T) -> (B, T, vocab).
 
     The training/evaluation path (the reference is inference-only; training is
     a capability extension). Causal attention inside the T window, same
     numerics as the cached forward — shared attention_core, same precision,
     same Q80 wire-quantization cut points.
+
+    ``positions``/``attention_fn`` parameterize the sequence-parallel
+    training path (parallel/sp_train.py): positions are this shard's
+    absolute offsets and attention_fn(q, k, v) -> (B, T, n_q*hs) runs ring
+    attention across the sp axis — everything else (embedding, layer scan,
+    fused-weight handling, SwiGLU tail, final norm/logits) is shared, so
+    the two paths cannot drift.
     """
     B, T = tokens.shape
     x = params["tok_embedding"][tokens].astype(jnp.float32)  # (B, T, D)
-    positions = jnp.arange(T)
-    mask = positions[None, :] <= positions[:, None]  # (T, T) causal
+    if positions is None:
+        positions = jnp.arange(T)
+    mask = jnp.arange(T)[None, :] <= jnp.arange(T)[:, None]  # (T, T) causal
 
     stacked, scanned = split_layer_weights(params)
 
@@ -368,11 +374,14 @@ def forward_seq(spec: TransformerSpec, params: dict[str, Any],
         idx, lw_slice = per_layer
         lw = layer_view(stacked, lw_slice, idx)
         q, k, v = _qkv_proj(spec, lw, x, positions)
-        ao = attention_core(
-            spec.head_size, spec.kv_mul,
-            q.reshape(B, T, spec.n_heads, spec.head_size),
-            k.reshape(B, T, spec.n_kv_heads, spec.head_size),
-            v.reshape(B, T, spec.n_kv_heads, spec.head_size), mask)
+        if attention_fn is not None:
+            ao = attention_fn(q, k, v)
+        else:
+            ao = attention_core(
+                spec.head_size, spec.kv_mul,
+                q.reshape(B, T, spec.n_heads, spec.head_size),
+                k.reshape(B, T, spec.n_kv_heads, spec.head_size),
+                v.reshape(B, T, spec.n_kv_heads, spec.head_size), mask)
         x = _post_attention(spec, lw, x, ao)
         return x, None
 

@@ -174,6 +174,29 @@ def decode_attention_batch(q, k4, v4, layer, pos, *, kv_mul: int,
     return out.reshape(B, n_kv * kv_mul * hs)
 
 
+def maybe_flash_decode(q2, k_all, v_all, idx, pos, *, seq_len: int,
+                       head_size: int, t_len: int, n_kv: int, kv_mul: int,
+                       batch: bool = False):
+    """The ONE gate for routing decode attention to the flash kernel.
+
+    Returns the attention output, or None when the caller must take its XLA
+    fallback (kernel disabled or shape unsupported). All three decode paths
+    (single-chip, TP shard-local, batched) call this so the mode/shape
+    gating can never drift between them.
+
+    q2: (n_q, hs) for the single/TP paths, (B, n_q, hs) with ``batch=True``
+    (rank-4 (L*B, S, n_kv, hs) caches).
+    """
+    if (attn_kernel_mode() != "pallas"
+            or not supports(seq_len, head_size, t_len, n_kv,
+                            k_all.dtype.itemsize)):
+        return None
+    if batch:
+        return decode_attention_batch(q2, k_all, v_all, idx, pos,
+                                      kv_mul=kv_mul)
+    return decode_attention(q2, k_all, v_all, idx, pos, kv_mul=kv_mul)
+
+
 def attn_kernel_mode() -> str:
     """'pallas' (flash-decode kernel) or 'xla' (full-cache einsum).
 

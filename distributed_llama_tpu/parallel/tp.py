@@ -152,20 +152,18 @@ def _local_layer(spec: TransformerSpec, n_slices: int, n_sp: int, x, lw,
         v_all = jax.lax.dynamic_update_slice(v_all, v_new[None],
                                              (idx, pos, 0, 0))
 
-        from ..ops.pallas_attention import (attn_kernel_mode,
-                                            decode_attention, supports)
+        from ..ops.pallas_attention import maybe_flash_decode
 
-        if (attn_kernel_mode() == "pallas"
-                and supports(spec.seq_len, spec.head_size, t_len,
-                             kv_heads_loc, k_all.dtype.itemsize)):
-            # per-shard flash-decode over the LOCAL kv heads: contiguous
-            # bands keep h -> h//kvMul local, so the kernel's grouping
-            # applies unchanged at shard scope (live-chunk reads, like the
-            # single-chip path)
-            ao = decode_attention(qh.reshape(heads_loc, spec.head_size),
-                                  k_all, v_all, idx, pos,
-                                  kv_mul=spec.kv_mul)
-        else:
+        # per-shard flash-decode over the LOCAL kv heads: contiguous bands
+        # keep h -> h//kvMul local, so the kernel's grouping applies
+        # unchanged at shard scope (live-chunk reads, like the single-chip
+        # path)
+        ao = maybe_flash_decode(
+            qh.reshape(-1, spec.head_size) if t_len == 1 else qh,
+            k_all, v_all, idx, pos, seq_len=spec.seq_len,
+            head_size=spec.head_size, t_len=t_len, n_kv=kv_heads_loc,
+            kv_mul=spec.kv_mul)
+        if ao is None:
             k_c = jax.lax.dynamic_index_in_dim(k_all, idx, 0, keepdims=False)
             v_c = jax.lax.dynamic_index_in_dim(v_all, idx, 0,
                                                keepdims=False)

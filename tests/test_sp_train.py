@@ -1,0 +1,65 @@
+"""Sequence-parallel training (ring attention) vs the dense training step."""
+
+import numpy as np
+import pytest
+
+from distributed_llama_tpu.models.spec import TransformerSpec
+from distributed_llama_tpu.models.synth import synth_params
+
+SPEC = TransformerSpec(dim=64, hidden_dim=96, n_layers=2, n_heads=4,
+                       n_kv_heads=2, vocab_size=128, seq_len=64)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    import jax.numpy as jnp
+
+    from distributed_llama_tpu.parallel import make_mesh
+
+    params = synth_params(SPEC, q40=False, seed=8, scale=0.15)
+    rng = np.random.default_rng(4)
+    tokens = jnp.asarray(rng.integers(0, SPEC.vocab_size, (4, 17)),
+                         dtype=jnp.int32)  # T = 16 splits over sp=4
+    return params, tokens, make_mesh
+
+
+def test_sp_train_loss_matches_dense(setup):
+    params, tokens, make_mesh = setup
+
+    from distributed_llama_tpu.parallel.sp_train import make_sp_train_step
+    from distributed_llama_tpu.parallel.train import make_train_step
+
+    # dense reference on a dp x tp mesh (no sp)
+    mesh_ref = make_mesh(dp=2, tp=1)
+    init_ref, step_ref = make_train_step(SPEC, mesh_ref, learning_rate=1e-3)
+    p_ref, o_ref = init_ref(params)
+    p_ref, o_ref, loss_ref = step_ref(p_ref, o_ref, tokens)
+
+    mesh_sp = make_mesh(dp=2, sp=4, tp=1)
+    init_sp, step_sp = make_sp_train_step(SPEC, mesh_sp, learning_rate=1e-3)
+    p_sp, o_sp = init_sp(params)
+    p_sp, o_sp, loss_sp = step_sp(p_sp, o_sp, tokens)
+
+    np.testing.assert_allclose(float(loss_sp), float(loss_ref),
+                               rtol=1e-5, atol=1e-5)
+    # gradients flowed through the ppermute ring identically: the updated
+    # params agree with the dense step's
+    for k in ("wq", "w1", "tok_embedding"):
+        np.testing.assert_allclose(np.asarray(p_sp[k]), np.asarray(p_ref[k]),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_sp_train_loss_decreases(setup):
+    params, tokens, make_mesh = setup
+
+    from distributed_llama_tpu.parallel.sp_train import make_sp_train_step
+
+    mesh = make_mesh(dp=1, sp=2, tp=1)
+    init_fn, step_fn = make_sp_train_step(SPEC, mesh, learning_rate=5e-3)
+    p, o = init_fn(params)
+    losses = []
+    for _ in range(4):
+        p, o, loss = step_fn(p, o, tokens)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+    assert np.isfinite(losses).all()
