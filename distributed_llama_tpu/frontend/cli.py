@@ -84,6 +84,11 @@ def cmd_inference(argv: list[str], quiet: bool = False) -> int:
     ap.add_argument("--resume-state", default=None, metavar="PATH",
                     help="resume a checkpointed generation (--prompt is "
                          "ignored; --steps more positions run)")
+    ap.add_argument("--prompts-file", default=None, metavar="PATH",
+                    help="batch mode: one prompt per line, decoded in one "
+                         "fused lockstep batch (single chip; a capability "
+                         "the reference lacks). Ignores --prompt/--fast/"
+                         "checkpoint flags")
     ap.add_argument("--kv-cache-dtype", default="f32",
                     choices=("f32", "bf16"),
                     help="KV cache precision: f32 = reference parity "
@@ -115,6 +120,14 @@ def cmd_inference(argv: list[str], quiet: bool = False) -> int:
     from ..runtime.generate import Engine, generate, generate_fast
     from ..runtime.sampling import Sampler
 
+    prompts = None
+    if args.prompts_file:  # validate before the multi-GB model load
+        with open(args.prompts_file) as fh:
+            prompts = [ln.rstrip("\n") for ln in fh if ln.strip()]
+        if not prompts:
+            print("prompts file is empty", file=sys.stderr)
+            return 2
+
     wft = _FT[args.weights_float_type]
     bft = _FT[args.buffer_float_type]
     t0 = time.time()
@@ -126,7 +139,10 @@ def cmd_inference(argv: list[str], quiet: bool = False) -> int:
               f"💡 nKvHeads: {spec.n_kv_heads}\n"
               f"💡 vocabSize: {spec.vocab_size}\n💡 seqLen: {spec.seq_len}")
     n_dev = len(jax.devices())
-    tp = args.tp or max(1, n_dev // args.sp)
+    if prompts is not None:
+        tp = 1  # batch mode runs its own single-chip device path
+    else:
+        tp = args.tp or max(1, n_dev // args.sp)
     if not quiet:
         print(f"💡 nSlices: {tp} sp: {args.sp} ({n_dev} devices, "
               f"{jax.devices()[0].platform})")
@@ -135,6 +151,15 @@ def cmd_inference(argv: list[str], quiet: bool = False) -> int:
     import jax.numpy as jnp
 
     cache_dtype = jnp.bfloat16 if args.kv_cache_dtype == "bf16" else None
+    if prompts is not None:  # batch mode: no Engine (its own device path)
+        from ..runtime.generate import generate_batch
+
+        tokenizer = Tokenizer(args.tokenizer, spec.vocab_size)
+        seed = args.seed if args.seed is not None else int(time.time())
+        generate_batch(spec, params, tokenizer, prompts, args.steps,
+                       args.temperature, args.topp, seed,
+                       cache_dtype=cache_dtype, quiet=quiet)
+        return 0
     engine = Engine(spec, params, mesh=mesh, cache_dtype=cache_dtype)
     if not quiet:
         print(f"⏩ Loaded model in {time.time() - t0:.1f}s")

@@ -183,8 +183,7 @@ def _layer(spec: TransformerSpec, x: jax.Array, lw: dict[str, Any],
     # (pos-proportional HBM traffic, like the reference's 0..pos attention
     # loop) instead of the full static plane
     ao = maybe_flash_decode(
-        q.reshape(-1, spec.head_size) if t_len == 1 else q,
-        k_all, v_all, idx, pos, seq_len=spec.seq_len,
+        q, k_all, v_all, idx, pos, seq_len=spec.seq_len,
         head_size=spec.head_size, t_len=t_len, n_kv=spec.n_kv_heads,
         kv_mul=spec.kv_mul)
     if ao is None:
@@ -325,9 +324,8 @@ def forward_batch(spec: TransformerSpec, params: dict[str, Any],
         # (the XLA einsum path below doesn't fuse the layer slice read —
         # measured ~10x slower per step at 7B/B=4)
         ao = maybe_flash_decode(
-            q.reshape(B, spec.n_heads, hs), k_all, v_all, idx, pos,
-            seq_len=S, head_size=hs, t_len=1, n_kv=n_kv, kv_mul=kv_mul,
-            batch=True)
+            q, k_all, v_all, idx, pos, seq_len=S, head_size=hs, t_len=1,
+            n_kv=n_kv, kv_mul=kv_mul, batch=True)
         if ao is None:
             k_c = jax.lax.dynamic_slice_in_dim(k_all, idx * B, B, 0)
             v_c = jax.lax.dynamic_slice_in_dim(v_all, idx * B, B, 0)

@@ -184,17 +184,21 @@ def maybe_flash_decode(q2, k_all, v_all, idx, pos, *, seq_len: int,
     (single-chip, TP shard-local, batched) call this so the mode/shape
     gating can never drift between them.
 
-    q2: (n_q, hs) for the single/TP paths, (B, n_q, hs) with ``batch=True``
-    (rank-4 (L*B, S, n_kv, hs) caches).
+    q2 arrives in the caller's natural shape — (T, n_q*hs) or (T, n_q, hs)
+    for the single/TP paths, (B, n_q*hs)/(B, n_q, hs) with ``batch=True``
+    (rank-4 (L*B, S, n_kv, hs) caches) — and is reshaped here, so call
+    sites carry no per-site shape logic.
     """
     if (attn_kernel_mode() != "pallas"
             or not supports(seq_len, head_size, t_len, n_kv,
                             k_all.dtype.itemsize)):
         return None
     if batch:
+        q2 = q2.reshape(q2.shape[0], -1, head_size)
         return decode_attention_batch(q2, k_all, v_all, idx, pos,
                                       kv_mul=kv_mul)
-    return decode_attention(q2, k_all, v_all, idx, pos, kv_mul=kv_mul)
+    return decode_attention(q2.reshape(-1, head_size), k_all, v_all, idx,
+                            pos, kv_mul=kv_mul)
 
 
 def attn_kernel_mode() -> str:

@@ -159,8 +159,7 @@ def _local_layer(spec: TransformerSpec, n_slices: int, n_sp: int, x, lw,
         # unchanged at shard scope (live-chunk reads, like the single-chip
         # path)
         ao = maybe_flash_decode(
-            qh.reshape(-1, spec.head_size) if t_len == 1 else qh,
-            k_all, v_all, idx, pos, seq_len=spec.seq_len,
+            qh, k_all, v_all, idx, pos, seq_len=spec.seq_len,
             head_size=spec.head_size, t_len=t_len, n_kv=kv_heads_loc,
             kv_mul=spec.kv_mul)
         if ao is None:

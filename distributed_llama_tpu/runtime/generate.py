@@ -196,6 +196,75 @@ def generate(engine: Engine, tokenizer: Tokenizer, sampler: Sampler,
     return out_tokens, stats
 
 
+def generate_batch(spec: TransformerSpec, params: dict[str, Any],
+                   tokenizer: Tokenizer, prompts: list[str], steps: int,
+                   temperature: float, topp: float, seed: int,
+                   cache_dtype=None,
+                   quiet: bool = False) -> tuple[list[list[int]], GenStats]:
+    """Generate for B prompts in one fused lockstep batch (single chip).
+
+    A capability extension (the reference is strictly batch=1): all rows
+    decode in lockstep via models/llama.forward_batch; ragged prompts
+    right-pad and start sampling when their own prompt runs out. Each row
+    samples from its own xorshift stream seeded ``seed + row`` (batch has
+    no single-stream reference semantics to preserve). Rows stop at BOS on
+    the host, like generate().
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..models.llama import init_cache_batch, params_to_device
+    from ..utils.rng import Xorshift64
+    from .decode import make_batch_decode_loop
+
+    B = len(prompts)
+    steps = min(steps, spec.seq_len)
+    dtype = cache_dtype or jnp.float32
+    toks_per_row = [tokenizer.encode(p or "", bos=True, eos=False)
+                    for p in prompts]
+    padded = np.full((B, steps + 1), -1, dtype=np.int32)
+    coins = np.zeros((B, steps), dtype=np.float32)
+    for b, pt in enumerate(toks_per_row):
+        pt = pt[:steps + 1]
+        padded[b, :len(pt)] = pt
+        n_sampled = steps - (len(pt) - 1)
+        if n_sampled > 0 and temperature != 0.0:
+            coins[b, len(pt) - 1:] = Xorshift64(seed + b).f32_array(n_sampled)
+
+    dev_params = params_to_device(params)
+    run = make_batch_decode_loop(spec, steps, temperature, topp)
+    t0 = time.perf_counter()
+    toks, _ = run(dev_params, init_cache_batch(spec, B, dtype),
+                  jnp.asarray(padded),
+                  jnp.asarray([p[0] for p in toks_per_row], jnp.int32),
+                  jnp.asarray(coins))
+    toks = np.asarray(toks)
+    total_ms = (time.perf_counter() - t0) * 1000
+
+    outs: list[list[int]] = []
+    for b in range(B):
+        row: list[int] = []
+        for t in map(int, toks[b]):
+            if t == BOS:
+                break
+            row.append(t)
+        outs.append(row)
+        if not quiet:
+            prev = toks_per_row[b][0]
+            text = b""
+            for t in row:
+                text += tokenizer.decode_piece(prev, t)
+                prev = t
+            print(f"[{b}] {text.decode('utf-8', errors='replace')!r}")
+    n_tokens = sum(len(r) for r in outs)
+    stats = GenStats(tokens=n_tokens, total_ms=total_ms, infer_ms=total_ms)
+    if not quiet:
+        print(f"Generated tokens:    {n_tokens} across {B} rows")
+        print(f"Avg generation time: {total_ms / max(1, B * steps):.2f} "
+              f"ms/token ({B} rows x {steps} lockstep steps)")
+    return outs, stats
+
+
 def generate_fast(engine: Engine, tokenizer: Tokenizer, sampler: Sampler,
                   prompt: str, steps: int,
                   quiet: bool = False) -> tuple[list[int], GenStats]:

@@ -134,3 +134,32 @@ def test_cli_unknown_mode():
     from distributed_llama_tpu.frontend.cli import main
 
     assert main(["frobnicate"]) == 1
+
+
+def test_cli_batch_prompts_file(model_files, tmp_path, capsys):
+    """--prompts-file decodes B prompts in one lockstep batch; greedy rows
+    must equal the corresponding single-prompt runs."""
+    from distributed_llama_tpu.frontend.cli import main
+
+    model, tokp = model_files
+    base = ["--model", model, "--tokenizer", tokp, "--temperature", "0",
+            "--steps", "6", "--tp", "1"]
+
+    singles = []
+    for p in ("hi", "hi hi"):
+        assert main(["inference", *base, "--prompt", p]) == 0
+        out = capsys.readouterr().out
+        singles.append([ln.rsplit("'", 2)[-2]
+                        for ln in out.splitlines() if ln.startswith("🔶")])
+
+    pf = tmp_path / "prompts.txt"
+    pf.write_text("hi\nhi hi\n")
+    assert main(["inference", *base, "--prompts-file", str(pf)]) == 0
+    out = capsys.readouterr().out
+    rows = [ln for ln in out.splitlines() if ln.startswith("[")]
+    assert len(rows) == 2
+    for b, single in enumerate(singles):
+        assert rows[b].startswith(f"[{b}] ")
+        # the batch row's decoded text == concatenation of the single run's
+        # per-token pieces
+        assert rows[b].split(" ", 1)[1] == repr("".join(single))
