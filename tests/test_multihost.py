@@ -23,31 +23,36 @@ from distributed_llama_tpu.io.tokenizer import write_tokenizer
 from distributed_llama_tpu.models.spec import TransformerSpec
 from distributed_llama_tpu.ops.quants import FloatType
 
+# GQA (kv < heads): the 2-process DCN test must keep grouped-query coverage
 SPEC = TransformerSpec(dim=64, hidden_dim=160, n_layers=2, n_heads=4,
                        n_kv_heads=2, vocab_size=300, seq_len=32,
                        weights_float_type=FloatType.Q40)
+# MHA spec whose kv heads shard 4 ways, for the tp=4 two-hosts test
+SPEC4 = TransformerSpec(dim=64, hidden_dim=160, n_layers=2, n_heads=4,
+                        n_kv_heads=4, vocab_size=300, seq_len=32,
+                        weights_float_type=FloatType.Q40)
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _write_model_files(d):
+def _write_model_files(d, spec=SPEC):
     rng = np.random.default_rng(5)
 
     def t(*shape):
         return (rng.standard_normal(shape) * 0.1).astype(np.float32)
 
-    tensors = {"tok_embedding": t(SPEC.vocab_size, SPEC.dim),
-               "rms_att": 1 + t(SPEC.n_layers, SPEC.dim),
-               "rms_ffn": 1 + t(SPEC.n_layers, SPEC.dim),
-               "rms_final": 1 + t(SPEC.dim),
-               "wcls": t(SPEC.vocab_size, SPEC.dim)}
-    for name, shape in SPEC.layer_matmul_shapes():
-        tensors[name] = t(SPEC.n_layers, *shape)
+    tensors = {"tok_embedding": t(spec.vocab_size, spec.dim),
+               "rms_att": 1 + t(spec.n_layers, spec.dim),
+               "rms_ffn": 1 + t(spec.n_layers, spec.dim),
+               "rms_final": 1 + t(spec.dim),
+               "wcls": t(spec.vocab_size, spec.dim)}
+    for name, shape in spec.layer_matmul_shapes():
+        tensors[name] = t(spec.n_layers, *shape)
     model = str(d / "model.bin")
-    write_model(model, SPEC, tensors)
+    write_model(model, spec, tensors)
     pieces = [b"<unk>", b"<s>", b"</s>"]
     pieces += [f"<0x{i:02X}>".encode() for i in range(256)]
-    while len(pieces) < SPEC.vocab_size:
+    while len(pieces) < spec.vocab_size:
         pieces.append(f"tok{len(pieces)}".encode())
     tok = str(d / "tok.bin")
     write_tokenizer(tok, pieces, [0.0] * len(pieces))
@@ -60,7 +65,7 @@ def _free_port():
         return s.getsockname()[1]
 
 
-def _run(mode, model, tok, host_id, coordinator, n_devices, cwd, extra=()):
+def _run(mode, model, tok, host_id, coordinator, n_devices, cwd, tp=2):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = (f"--xla_force_host_platform_device_count={n_devices}")
@@ -69,7 +74,7 @@ def _run(mode, model, tok, host_id, coordinator, n_devices, cwd, extra=()):
     args = [sys.executable, "-m", "distributed_llama_tpu.frontend.cli", mode,
             "--model", model, "--tokenizer", tok, "--prompt", "hi",
             "--steps", "6", "--temperature", "0.9", "--topp", "0.9",
-            "--seed", "11", "--tp", "2", *extra]
+            "--seed", "11", "--tp", str(tp)]
     if coordinator:
         args += ["--coordinator", coordinator, "--num-hosts", "2",
                  "--host-id", str(host_id)]
@@ -107,3 +112,26 @@ def test_two_process_inference_matches_single(tmp_path):
     assert worker.returncode == 0, f"worker: {err_worker[-2000:]}"
     assert _pieces(out_root) == want, out_root
     assert _pieces(out_worker) == []  # workers run silent
+
+
+def test_two_hosts_two_devices_each(tmp_path):
+    """2 hosts x 2 local devices = a tp=4 global mesh where collectives
+    cross BOTH the intra-process boundary (the ICI analog) and the process
+    boundary (DCN) — the topology shape of a real multi-host pod slice."""
+    model, tok = _write_model_files(tmp_path, SPEC4)
+    cwd = str(tmp_path)
+
+    p = _run("inference", model, tok, None, None, 4, cwd, tp=4)
+    out_single, err = p.communicate(timeout=300)
+    assert p.returncode == 0, err[-2000:]
+    want = _pieces(out_single)
+    assert want
+
+    coord = f"127.0.0.1:{_free_port()}"
+    root = _run("inference", model, tok, 0, coord, 2, cwd, tp=4)
+    worker = _run("worker", model, tok, 1, coord, 2, cwd, tp=4)
+    out_root, err_root = root.communicate(timeout=360)
+    out_worker, err_worker = worker.communicate(timeout=60)
+    assert root.returncode == 0, f"root: {err_root[-2000:]}"
+    assert worker.returncode == 0, f"worker: {err_worker[-2000:]}"
+    assert _pieces(out_root) == want, out_root
