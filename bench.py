@@ -96,14 +96,15 @@ def _bench(spec, params, samples: int, per_step: bool = False) -> float:
           file=sys.stderr)
     # time HONESTLY-synced chains: materializing the tokens forces the whole
     # chain to have executed (block_until_ready alone can report early when a
-    # remote runtime pipelines one in-flight execution); average of 2
+    # remote runtime pipelines one in-flight execution); median of 3 damps
+    # the tunneled runtime's per-chain dispatch jitter
     times = []
-    for _ in range(2):
+    for _ in range(3):
         t0 = time.perf_counter()
         toks, _ = run(*args())
         np.asarray(toks)
         times.append((time.perf_counter() - t0) * 1000 / samples)
-    ms = float(np.mean(times))
+    ms = float(np.median(times))
     print(f"fused-loop per-token ms: {ms:.2f} ({samples} steps/chain, "
           f"trials {[round(t, 2) for t in times]})", file=sys.stderr)
     return ms
@@ -154,18 +155,34 @@ def main():
 
     import os
 
-    try:
-        ms = _bench(spec, params, args.samples, per_step=args.per_step)
-    except Exception as e:  # pallas kernel compile trouble -> XLA fallback
-        if (os.environ.get("DLLAMA_Q40_KERNEL", "auto") == "xla"
-                and os.environ.get("DLLAMA_ATTN_KERNEL", "auto") == "xla"):
-            raise
-        print(f"pallas path failed ({type(e).__name__}: {e}); "
-              f"retrying with DLLAMA_Q40_KERNEL=DLLAMA_ATTN_KERNEL=xla",
-              file=sys.stderr)
-        os.environ["DLLAMA_Q40_KERNEL"] = "xla"
-        os.environ["DLLAMA_ATTN_KERNEL"] = "xla"
-        ms = _bench(spec, params, args.samples, per_step=args.per_step)
+    # attempt schedule: (1) as configured; (2) same settings again — the
+    # tunneled runtime's remote_compile occasionally drops a connection
+    # (transient), and falling straight back to XLA would record a number
+    # ~3x worse than the machine's real capability; (3) XLA fallback for
+    # persistent pallas compile trouble. A flat loop (not nested excepts):
+    # a live exception traceback would pin the failed attempt's device
+    # copies of the 7B weights/cache and could OOM the later attempts.
+    ms = None
+    for attempt in range(3):
+        if attempt == 2:
+            if (os.environ.get("DLLAMA_Q40_KERNEL", "auto") == "xla"
+                    and os.environ.get("DLLAMA_ATTN_KERNEL", "auto") == "xla"):
+                raise SystemExit("bench failed twice on the XLA path")
+            print("pallas path failed twice; retrying with "
+                  "DLLAMA_Q40_KERNEL=DLLAMA_ATTN_KERNEL=xla",
+                  file=sys.stderr)
+            os.environ["DLLAMA_Q40_KERNEL"] = "xla"
+            os.environ["DLLAMA_ATTN_KERNEL"] = "xla"
+        try:
+            ms = _bench(spec, params, args.samples, per_step=args.per_step)
+            break
+        except Exception as e:
+            if attempt == 2:
+                raise
+            print(f"bench attempt {attempt + 1} failed "
+                  f"({type(e).__name__}: {e}); retrying", file=sys.stderr)
+            e = None  # drop the traceback: it pins device buffers
+    assert ms is not None
     baseline = 494.00  # best published 7B figure (4x RasPi), BASELINE.md
     result = {
         "metric": "llama2-7b-q40 single-token decode"
