@@ -179,9 +179,11 @@ def main():
         except Exception as e:
             if attempt == 2:
                 raise
+            import traceback
+
+            traceback.print_exc(file=sys.stderr)
             print(f"bench attempt {attempt + 1} failed "
                   f"({type(e).__name__}: {e}); retrying", file=sys.stderr)
-            e = None  # drop the traceback: it pins device buffers
     assert ms is not None
     baseline = 494.00  # best published 7B figure (4x RasPi), BASELINE.md
     result = {

@@ -122,6 +122,11 @@ def cmd_inference(argv: list[str], quiet: bool = False) -> int:
 
     prompts = None
     if args.prompts_file:  # validate before the multi-GB model load
+        if args.sp > 1:
+            # batch decode composes with tp (sharded step) but not sp
+            print("batch mode (--prompts-file) does not compose with --sp",
+                  file=sys.stderr)
+            return 2
         with open(args.prompts_file) as fh:
             prompts = [ln.rstrip("\n") for ln in fh if ln.strip()]
         if not prompts:
@@ -140,7 +145,7 @@ def cmd_inference(argv: list[str], quiet: bool = False) -> int:
               f"💡 vocabSize: {spec.vocab_size}\n💡 seqLen: {spec.seq_len}")
     n_dev = len(jax.devices())
     if prompts is not None:
-        tp = 1  # batch mode runs its own single-chip device path
+        tp = args.tp or 1  # batch mode: single-chip unless --tp asks for slices
     else:
         tp = args.tp or max(1, n_dev // args.sp)
     if not quiet:
@@ -158,7 +163,7 @@ def cmd_inference(argv: list[str], quiet: bool = False) -> int:
         seed = args.seed if args.seed is not None else int(time.time())
         generate_batch(spec, params, tokenizer, prompts, args.steps,
                        args.temperature, args.topp, seed,
-                       cache_dtype=cache_dtype, quiet=quiet)
+                       cache_dtype=cache_dtype, mesh=mesh, quiet=quiet)
         return 0
     engine = Engine(spec, params, mesh=mesh, cache_dtype=cache_dtype)
     if not quiet:

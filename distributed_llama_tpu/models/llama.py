@@ -254,6 +254,44 @@ def forward(spec: TransformerSpec, params: dict[str, Any], cache: KVCache,
     return logits, KVCache(k_new, v_new)
 
 
+def batch_decode_attention(head_size: int, kv_mul: int, seq_len: int,
+                           q: jax.Array, k: jax.Array, v: jax.Array,
+                           k_all: jax.Array, v_all: jax.Array, idx,
+                           pos: jax.Array):
+    """Shared batch-decode attention sub-block: append k/v at (layer ``idx``,
+    column ``pos``) of the rank-4 (L*B, S, n_kv, hs) cache carry, then attend
+    via the flash kernel (XLA einsum fallback). q (B, n_q*hs); k/v
+    (B, n_kv*hs). Returns (ao (B, n_q*hs), k_all, v_all).
+
+    Both batch paths — single-chip (forward_batch) and tp-shard-local
+    (parallel/tp.make_sharded_forward_batch, with local head counts) — run
+    THIS function, so cache indexing/attention semantics cannot drift."""
+    B = q.shape[0]
+    n_kv = k_all.shape[-2]
+    n_q = q.shape[-1] // head_size
+    dt = k_all.dtype
+    k_new = k.reshape(B, 1, n_kv, head_size).astype(dt)
+    v_new = v.reshape(B, 1, n_kv, head_size).astype(dt)
+    k_all = jax.lax.dynamic_update_slice(k_all, k_new, (idx * B, pos, 0, 0))
+    v_all = jax.lax.dynamic_update_slice(v_all, v_new, (idx * B, pos, 0, 0))
+
+    from ..ops.pallas_attention import maybe_flash_decode
+
+    # per-row flash kernel: live-chunk DMA walk, no cache slice copy (the
+    # XLA einsum path below doesn't fuse the layer slice read — measured
+    # ~10x slower per step at 7B/B=4)
+    ao = maybe_flash_decode(
+        q, k_all, v_all, idx, pos, seq_len=seq_len, head_size=head_size,
+        t_len=1, n_kv=n_kv, kv_mul=kv_mul, batch=True)
+    if ao is None:
+        k_c = jax.lax.dynamic_slice_in_dim(k_all, idx * B, B, 0)
+        v_c = jax.lax.dynamic_slice_in_dim(v_all, idx * B, B, 0)
+        ao = attention_core(head_size, kv_mul,
+                            q.reshape(B, 1, n_q, head_size), k_c, v_c,
+                            causal_cache_mask(seq_len, pos, 1))
+    return ao.reshape(B, -1), k_all, v_all
+
+
 def init_cache_batch(spec: TransformerSpec, batch: int,
                      dtype=jnp.float32) -> KVCache:
     """Batched cache: (L, B, S, n_kv, hs) — each (b, layer) row has the same
@@ -310,29 +348,9 @@ def forward_batch(spec: TransformerSpec, params: dict[str, Any],
         idx, lw_slice = per_layer
         lw = layer_view(stacked, lw_slice, idx)
         q, k, v = _qkv_proj(spec, lw, x, positions)
-        dt = k_all.dtype
-        # (B, kv, hs) -> this layer's B rows, column pos
-        k_new = k.reshape(B, 1, n_kv, hs).astype(dt)
-        v_new = v.reshape(B, 1, n_kv, hs).astype(dt)
-        k_all = jax.lax.dynamic_update_slice(k_all, k_new,
-                                             (idx * B, pos, 0, 0))
-        v_all = jax.lax.dynamic_update_slice(v_all, v_new,
-                                             (idx * B, pos, 0, 0))
-        from ..ops.pallas_attention import maybe_flash_decode
-
-        # per-row flash kernel: live-chunk DMA walk, no cache slice copy
-        # (the XLA einsum path below doesn't fuse the layer slice read —
-        # measured ~10x slower per step at 7B/B=4)
-        ao = maybe_flash_decode(
-            q, k_all, v_all, idx, pos, seq_len=S, head_size=hs, t_len=1,
-            n_kv=n_kv, kv_mul=kv_mul, batch=True)
-        if ao is None:
-            k_c = jax.lax.dynamic_slice_in_dim(k_all, idx * B, B, 0)
-            v_c = jax.lax.dynamic_slice_in_dim(v_all, idx * B, B, 0)
-            ao = attention_core(spec.head_size, kv_mul,
-                                q.reshape(B, 1, spec.n_heads, hs),
-                                k_c, v_c, causal_cache_mask(S, pos, 1))
-        x = _post_attention(spec, lw, x, ao.reshape(B, -1))
+        ao, k_all, v_all = batch_decode_attention(hs, kv_mul, S, q, k, v,
+                                                  k_all, v_all, idx, pos)
+        x = _post_attention(spec, lw, x, ao)
         return (x, k_all, v_all), None
 
     idxs = jnp.arange(L, dtype=jnp.int32)

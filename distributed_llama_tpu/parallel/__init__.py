@@ -1,3 +1,4 @@
 from .mesh import make_mesh  # noqa: F401
-from .tp import (make_sharded_forward, shard_params, shard_cache,  # noqa: F401
+from .tp import (make_sharded_forward, make_sharded_forward_batch,  # noqa: F401
+                 shard_params, shard_cache, shard_cache_batch,
                  validate_sharding)

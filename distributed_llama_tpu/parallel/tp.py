@@ -38,8 +38,9 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..io.loader import Q40Kernel, Q40Weight
-from ..models.llama import (KVCache, attention_core, causal_cache_mask,
-                            layer_view, rope_rotate, split_layer_weights)
+from ..models.llama import (KVCache, attention_core, batch_decode_attention,
+                            causal_cache_mask, layer_view, rope_rotate,
+                            split_layer_weights)
 from ..models.spec import TransformerSpec
 from ..ops.linear import fake_quant_q80, matmul, rmsnorm, silu
 from ..ops.quants import FloatType
@@ -121,6 +122,40 @@ def _gather(x: jax.Array) -> jax.Array:
     return jax.lax.all_gather(x, "tp", axis=-1, tiled=True)
 
 
+def _tp_qkv(spec: TransformerSpec, lw, x, positions):
+    """Shard-local attention input path: norm -> (q80 wire) -> local q/k/v
+    bands -> RoPE. x is the replicated activations, (T, dim) or (B, dim).
+
+    Contiguous-band slicing => local features start at a head boundary, and
+    RoPE's angle depends only on (feature index mod head_size): local == global.
+    """
+    xb = rmsnorm(x, lw["rms_att"])
+    xb = _wire(spec, xb)  # reference quantizes xb before qkv (quantizeRmsAtt)
+    q = matmul(lw["wq"], xb)                       # (T, dim/S)
+    k = matmul(lw["wk"], xb)                       # (T, kvDim/S)
+    v = matmul(lw["wv"], xb)
+    q = rope_rotate(q, positions, spec.head_size)
+    k = rope_rotate(k, positions, spec.head_size)
+    return q, k, v
+
+
+def _tp_tail(spec: TransformerSpec, x, lw, ao):
+    """Shard-local layer tail: attention output -> wo -> residual -> ffn.
+
+    The four all_gathers here are THE per-layer tp collectives (see module
+    docstring for the reference sync-task mapping)."""
+    xb = _gather(_wire(spec, ao))                  # ⇄ syncMultiheadAtt
+    xb2 = matmul(lw["wo"], xb)                     # (T, dim/S)
+    x = x + _gather(_wire(spec, xb2))              # ⇄ syncAtt + residual
+
+    xb = rmsnorm(x, lw["rms_ffn"])
+    xb = _wire(spec, xb)                           # ⇄ quantizeRmfFfn
+    hb = silu(matmul(lw["w1"], xb)) * matmul(lw["w3"], xb)  # (T, hidden/S)
+    hb = _gather(_wire(spec, hb))                  # ⇄ syncFfnA+syncFfnB
+    xb2 = matmul(lw["w2"], hb)                     # (T, dim/S)
+    return x + _gather(_wire(spec, xb2))           # ⇄ syncFfn2 + residual
+
+
 def _local_layer(spec: TransformerSpec, n_slices: int, n_sp: int, x, lw,
                  k_all, v_all, idx, pos, positions):
     """Per-device layer body. x replicated (T, dim); lw holds local tp bands;
@@ -132,15 +167,7 @@ def _local_layer(spec: TransformerSpec, n_slices: int, n_sp: int, x, lw,
     kv_heads_loc = spec.n_kv_heads // n_slices
     seq_chunk = spec.seq_len // n_sp
 
-    xb = rmsnorm(x, lw["rms_att"])
-    xb = _wire(spec, xb)  # reference quantizes xb before qkv (quantizeRmsAtt)
-    q = matmul(lw["wq"], xb)                       # (T, dim/S)
-    k = matmul(lw["wk"], xb)                       # (T, kvDim/S)
-    v = matmul(lw["wv"], xb)
-    # contiguous-band slicing => local features start at a head boundary, and
-    # RoPE's angle depends only on (feature index mod head_size): local == global
-    q = rope_rotate(q, positions, spec.head_size)
-    k = rope_rotate(k, positions, spec.head_size)
+    q, k, v = _tp_qkv(spec, lw, x, positions)
     dt = k_all.dtype  # f32 parity default; bf16 halves cache HBM/memory
     k_new = k.reshape(t_len, kv_heads_loc, spec.head_size).astype(dt)
     v_new = v.reshape(t_len, kv_heads_loc, spec.head_size).astype(dt)
@@ -183,16 +210,7 @@ def _local_layer(spec: TransformerSpec, n_slices: int, n_sp: int, x, lw,
         ao = sp_cache_attention(spec.head_size, spec.kv_mul, seq_chunk,
                                 sp_index, qh, k_c, v_c, pos)
 
-    xb = _gather(_wire(spec, ao))                  # ⇄ syncMultiheadAtt
-    xb2 = matmul(lw["wo"], xb)                     # (T, dim/S)
-    x = x + _gather(_wire(spec, xb2))              # ⇄ syncAtt + residual
-
-    xb = rmsnorm(x, lw["rms_ffn"])
-    xb = _wire(spec, xb)                           # ⇄ quantizeRmfFfn
-    hb = silu(matmul(lw["w1"], xb)) * matmul(lw["w3"], xb)  # (T, hidden/S)
-    hb = _gather(_wire(spec, hb))                  # ⇄ syncFfnA+syncFfnB
-    xb2 = matmul(lw["w2"], hb)                     # (T, dim/S)
-    x = x + _gather(_wire(spec, xb2))              # ⇄ syncFfn2 + residual
+    x = _tp_tail(spec, x, lw, ao)
     return x, k_all, v_all
 
 
@@ -262,6 +280,76 @@ def make_sharded_forward(spec: TransformerSpec, mesh: Mesh):
     def wrap(params, cache, tokens, pos):
         in_specs = (param_specs(params), CACHE_SPEC, P(), P())
         out_specs = (P(), CACHE_SPEC)
+        fn = jax.shard_map(local_step, mesh=mesh, in_specs=in_specs,
+                           out_specs=out_specs, check_vma=False)
+        return fn(params, cache, tokens, pos)
+
+    return jax.jit(wrap, donate_argnums=1)
+
+
+# batched cache (L, B, S, n_kv, hs): kv heads over tp; batch lockstep decode
+# has no sp composition (the shared-pos cache update and the sp ring combine
+# are orthogonal carries — future work, PARITY.md)
+CACHE_SPEC_BATCH = KVCache(P(None, None, None, "tp", None),
+                           P(None, None, None, "tp", None))
+
+
+def shard_cache_batch(cache: KVCache, mesh: Mesh) -> KVCache:
+    return jax.tree_util.tree_map(
+        lambda a, s: jax.device_put(a, NamedSharding(mesh, s)),
+        cache, CACHE_SPEC_BATCH)
+
+
+def make_sharded_forward_batch(spec: TransformerSpec, mesh: Mesh):
+    """Tensor-parallel lockstep batch decode step (forward_batch over tp).
+
+    Returns fn(params, cache, tokens (B,), pos) -> (logits (B, vocab), cache)
+    with cache (L, B, S, n_kv, hs) kv-head-sharded over tp. Per-row math ==
+    models/llama.forward_batch (same kernels, same shared-position contract);
+    per-layer collectives == make_sharded_forward's (the four all_gathers now
+    carry B rows each). Gate: tp ∈ {2, 4} logits/tokens match the
+    single-chip batch path (tests/test_batch_tp.py).
+    """
+    n_slices = mesh.shape["tp"]
+    if mesh.shape.get("sp", 1) != 1:
+        raise ValueError("batch decode does not compose with sp (PARITY.md)")
+    validate_sharding(spec, mesh)
+    kv_loc = spec.n_kv_heads // n_slices
+    L, S, hs = spec.n_layers, spec.seq_len, spec.head_size
+
+    def local_step(params, cache, tokens, pos):
+        B = tokens.shape[0]
+        x = params["tok_embedding"][tokens].astype(jnp.float32)  # (B, dim)
+        positions = jnp.full((B,), pos)
+        # rank-4 (L*B, S, kv_loc, hs) carry view — same layout rationale as
+        # forward_batch (row layer*B+b is a single-sequence cache plane)
+        k4 = cache.k.reshape(L * B, S, kv_loc, hs)
+        v4 = cache.v.reshape(L * B, S, kv_loc, hs)
+        stacked, scanned = split_layer_weights(params)
+
+        def body(carry, per_layer):
+            x, k_all, v_all = carry
+            idx, lw_slice = per_layer
+            lw = layer_view(stacked, lw_slice, idx)
+            q, k, v = _tp_qkv(spec, lw, x, positions)
+            # shared with the single-chip batch path; the shard's cache holds
+            # kv_loc heads, which batch_decode_attention reads off the carry
+            ao, k_all, v_all = batch_decode_attention(hs, spec.kv_mul, S,
+                                                      q, k, v, k_all, v_all,
+                                                      idx, pos)
+            x = _tp_tail(spec, x, lw, ao)
+            return (x, k_all, v_all), None
+
+        idxs = jnp.arange(L, dtype=jnp.int32)
+        (x, k4, v4), _ = jax.lax.scan(body, (x, k4, v4), (idxs, scanned))
+        x = rmsnorm(x, params["rms_final"])
+        logits = _gather(matmul(params["wcls"], x))
+        return logits, KVCache(k4.reshape(L, B, S, kv_loc, hs),
+                               v4.reshape(L, B, S, kv_loc, hs))
+
+    def wrap(params, cache, tokens, pos):
+        in_specs = (param_specs(params), CACHE_SPEC_BATCH, P(), P())
+        out_specs = (P(), CACHE_SPEC_BATCH)
         fn = jax.shard_map(local_step, mesh=mesh, in_specs=in_specs,
                            out_specs=out_specs, check_vma=False)
         return fn(params, cache, tokens, pos)

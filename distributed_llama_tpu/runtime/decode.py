@@ -93,7 +93,8 @@ def make_decode_loop(step_fn: StepFn, steps: int, temperature: float,
     return jax.jit(run, donate_argnums=1)
 
 
-def make_batch_decode_loop(spec, steps: int, temperature: float, topp: float):
+def make_batch_decode_loop(spec, steps: int, temperature: float, topp: float,
+                           step_fn: StepFn | None = None):
     """Fused decode loop over B sequences in lockstep (models/llama.
     forward_batch) — the throughput path the reference lacks (batch=1 only).
 
@@ -105,6 +106,10 @@ def make_batch_decode_loop(spec, steps: int, temperature: float, topp: float):
     Ragged prompts right-pad with -1: at position p a row forces
     prompts[b, p+1] when >= 0, else samples with its own coin (vmapped
     reference sampler semantics).
+
+    ``step_fn`` overrides the single-chip forward_batch with another
+    (params, cache, tokens (B,), pos) -> (logits (B, V), cache) step — the
+    tensor-parallel composition passes parallel/tp.make_sharded_forward_batch.
     """
     import functools
 
@@ -112,7 +117,8 @@ def make_batch_decode_loop(spec, steps: int, temperature: float, topp: float):
 
     if steps > spec.seq_len:
         raise ValueError(f"steps={steps} exceeds seq_len={spec.seq_len}")
-    step_fn = functools.partial(forward_batch, spec)
+    if step_fn is None:
+        step_fn = functools.partial(forward_batch, spec)
 
     def run(params, cache, prompts, first_tokens, coins):
         def body(carry, xs):

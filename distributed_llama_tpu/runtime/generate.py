@@ -199,9 +199,9 @@ def generate(engine: Engine, tokenizer: Tokenizer, sampler: Sampler,
 def generate_batch(spec: TransformerSpec, params: dict[str, Any],
                    tokenizer: Tokenizer, prompts: list[str], steps: int,
                    temperature: float, topp: float, seed: int,
-                   cache_dtype=None,
+                   cache_dtype=None, mesh=None,
                    quiet: bool = False) -> tuple[list[list[int]], GenStats]:
-    """Generate for B prompts in one fused lockstep batch (single chip).
+    """Generate for B prompts in one fused lockstep batch.
 
     A capability extension (the reference is strictly batch=1): all rows
     decode in lockstep via models/llama.forward_batch; ragged prompts
@@ -209,6 +209,10 @@ def generate_batch(spec: TransformerSpec, params: dict[str, Any],
     samples from its own xorshift stream seeded ``seed + row`` (batch has
     no single-stream reference semantics to preserve). Rows stop at BOS on
     the host, like generate().
+
+    With a ``mesh`` (tp > 1) the step runs tensor-parallel: weights in
+    MatmulSlice bands, batched cache kv-head-sharded, same per-layer
+    collectives as the B=1 sharded path (parallel/tp.py).
     """
     import jax
     import jax.numpy as jnp
@@ -231,10 +235,23 @@ def generate_batch(spec: TransformerSpec, params: dict[str, Any],
         if n_sampled > 0 and temperature != 0.0:
             coins[b, len(pt) - 1:] = Xorshift64(seed + b).f32_array(n_sampled)
 
-    dev_params = params_to_device(params)
-    run = make_batch_decode_loop(spec, steps, temperature, topp)
+    if mesh is not None and (mesh.shape["tp"] > 1
+                             or mesh.shape.get("sp", 1) > 1):
+        from ..parallel import (make_sharded_forward_batch, shard_cache_batch,
+                                shard_params, validate_sharding)
+
+        validate_sharding(spec, mesh)
+        dev_params = shard_params(params, mesh)
+        cache0 = shard_cache_batch(init_cache_batch(spec, B, dtype), mesh)
+        step_fn = make_sharded_forward_batch(spec, mesh)
+        run = make_batch_decode_loop(spec, steps, temperature, topp,
+                                     step_fn=step_fn)
+    else:
+        dev_params = params_to_device(params)
+        cache0 = init_cache_batch(spec, B, dtype)
+        run = make_batch_decode_loop(spec, steps, temperature, topp)
     t0 = time.perf_counter()
-    toks, _ = run(dev_params, init_cache_batch(spec, B, dtype),
+    toks, _ = run(dev_params, cache0,
                   jnp.asarray(padded),
                   jnp.asarray([p[0] for p in toks_per_row], jnp.int32),
                   jnp.asarray(coins))

@@ -1,0 +1,102 @@
+"""Batch + tensor parallelism: the tp-sharded lockstep batch decode step must
+match the single-chip batch path (tokens exactly at temp=0, logits to fp
+tolerance) — the stage-4 parity gate of SURVEY.md §7 extended to batch."""
+
+import numpy as np
+import pytest
+
+from distributed_llama_tpu.models.spec import TransformerSpec
+from distributed_llama_tpu.models.synth import synth_params
+from distributed_llama_tpu.parallel import make_mesh
+
+# GQA (kv_mul=2) with 4 kv heads so tp=4 genuinely shards and runs
+SPEC = TransformerSpec(dim=64, hidden_dim=160, n_layers=2, n_heads=8,
+                       n_kv_heads=4, vocab_size=128, seq_len=16)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return synth_params(SPEC, q40=False, seed=4, scale=0.3)
+
+
+@pytest.mark.parametrize("tp", [2, 4])
+def test_batch_tp_step_matches_single_chip(params, tp):
+    import jax.numpy as jnp
+
+    from distributed_llama_tpu.models.llama import (forward_batch,
+                                                    init_cache_batch,
+                                                    params_to_device)
+    from distributed_llama_tpu.parallel import (make_sharded_forward_batch,
+                                                shard_cache_batch,
+                                                shard_params)
+
+    B = 3
+    tokens0 = jnp.asarray([7, 17, 40], dtype=jnp.int32)
+    tokens1 = jnp.asarray([5, 9, 77], dtype=jnp.int32)
+
+    dev = params_to_device(params)
+    lg_ref = []
+    c = init_cache_batch(SPEC, B)
+    for pos, toks in enumerate((tokens0, tokens1)):
+        lg, c = forward_batch(SPEC, dev, c, toks, jnp.int32(pos))
+        lg_ref.append(np.asarray(lg))
+    cache_ref = c
+
+    mesh = make_mesh(tp=tp)
+    sharded = shard_params(params, mesh)
+    c = shard_cache_batch(init_cache_batch(SPEC, B), mesh)
+    step = make_sharded_forward_batch(SPEC, mesh)
+    for pos, toks in enumerate((tokens0, tokens1)):
+        lg, c = step(sharded, c, toks, jnp.int32(pos))
+        np.testing.assert_allclose(np.asarray(lg), lg_ref[pos],
+                                   rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(c.k), np.asarray(cache_ref.k),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_batch_tp_decode_loop_matches_single_chip(params):
+    import jax.numpy as jnp
+
+    from distributed_llama_tpu.models.llama import (init_cache_batch,
+                                                    params_to_device)
+    from distributed_llama_tpu.parallel import (make_sharded_forward_batch,
+                                                shard_cache_batch,
+                                                shard_params)
+    from distributed_llama_tpu.runtime.decode import make_batch_decode_loop
+
+    steps, B = 8, 2
+    prompts = [[1, 5, 9], [1, 22]]  # ragged: row 1 samples earlier
+    padded = np.full((B, steps + 1), -1, dtype=np.int32)
+    for b, p in enumerate(prompts):
+        padded[b, :len(p)] = p
+    first = jnp.asarray([p[0] for p in prompts], jnp.int32)
+    coins = jnp.zeros((B, steps), jnp.float32)
+
+    dev = params_to_device(params)
+    run1 = make_batch_decode_loop(SPEC, steps, temperature=0.0, topp=0.9)
+    toks_ref, _ = run1(dev, init_cache_batch(SPEC, B), jnp.asarray(padded),
+                       first, coins)
+
+    mesh = make_mesh(tp=2)
+    sharded = shard_params(params, mesh)
+    step = make_sharded_forward_batch(SPEC, mesh)
+    run2 = make_batch_decode_loop(SPEC, steps, temperature=0.0, topp=0.9,
+                                  step_fn=step)
+    toks_tp, _ = run2(sharded, shard_cache_batch(init_cache_batch(SPEC, B),
+                                                 mesh),
+                      jnp.asarray(padded), first, coins)
+    np.testing.assert_array_equal(np.asarray(toks_tp), np.asarray(toks_ref))
+
+
+def test_batch_tp_rejects_sp(params):
+    from distributed_llama_tpu.parallel import make_sharded_forward_batch
+
+    with pytest.raises(ValueError, match="sp"):
+        make_sharded_forward_batch(SPEC, make_mesh(sp=2, tp=2))
+
+
+def test_batch_tp_rejects_indivisible(params):
+    from distributed_llama_tpu.parallel import make_sharded_forward_batch
+
+    with pytest.raises(ValueError, match="n_kv_heads"):
+        make_sharded_forward_batch(SPEC, make_mesh(tp=8))

@@ -163,3 +163,14 @@ def test_cli_batch_prompts_file(model_files, tmp_path, capsys):
         # the batch row's decoded text == concatenation of the single run's
         # per-token pieces
         assert rows[b].split(" ", 1)[1] == repr("".join(single))
+
+    # same batch over a tp=2 mesh (sharded lockstep step): identical rows
+    assert main(["inference", *base[:-2], "--tp", "2",
+                 "--prompts-file", str(pf)]) == 0
+    out = capsys.readouterr().out
+    rows_tp = [ln for ln in out.splitlines() if ln.startswith("[")]
+    assert rows_tp == rows
+
+    # batch mode refuses sp (no composition; clear error, exit 2)
+    assert main(["inference", *base[:-2], "--tp", "1", "--sp", "2",
+                 "--prompts-file", str(pf)]) == 2
