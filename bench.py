@@ -89,7 +89,7 @@ def _bench(spec, params, samples: int, per_step: bool = False) -> float:
     padded[0] = 7
     coins = jnp.zeros((samples,), dtype=jnp.float32)
     args = lambda: (params, init_cache(spec), jnp.asarray(padded),
-                    jnp.int32(7), coins)
+                    jnp.int32(7), coins, jnp.int32(0))
     t_compile = time.perf_counter()
     np.asarray(run(*args())[0])  # materialize: full sync, also on remote runtimes
     print(f"compile+first chain: {time.perf_counter() - t_compile:.1f}s",

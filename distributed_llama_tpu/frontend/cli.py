@@ -189,14 +189,13 @@ def cmd_inference(argv: list[str], quiet: bool = False) -> int:
             else contextlib.nullcontext())
     prev = prev0 if args.resume_state else []
     with prof:
-        if args.fast and resume is None:
+        if args.fast:
             out, stats = generate_fast(engine, tokenizer, sampler,
                                        args.prompt or "", args.steps,
-                                       quiet=quiet)
+                                       quiet=quiet, resume=resume,
+                                       resume_prompt=(rest0 if resume
+                                                      else None))
         else:
-            if args.fast and not quiet:
-                print("💡 --fast has no fused path for resumed runs; using "
-                      "the per-step loop")
             out, stats = generate(engine, tokenizer, sampler,
                                   args.prompt or "", args.steps, quiet=quiet,
                                   resume=resume,

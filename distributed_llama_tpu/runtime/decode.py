@@ -68,16 +68,22 @@ def sample_device(logits: jax.Array, coin: jax.Array, temperature: float,
 
 def make_decode_loop(step_fn: StepFn, steps: int, temperature: float,
                      topp: float):
-    """Build run(params, cache, prompt_padded, first_token, coins) ->
-    (tokens (steps,), cache): the fused generation loop.
+    """Build run(params, cache, prompt_padded, first_token, coins,
+    start_pos) -> (tokens (steps,), cache): the fused generation loop.
 
-    prompt_padded: (steps+1,) int32, prompt tokens then -1 padding. Position
-    ``p`` forces prompt_padded[p+1] when >= 0, else samples — exactly the
-    forced-prompt-then-sample schedule of the reference loop
-    (tokenizer.cpp:360-366). coins: (steps,) f32, consumed at sampled steps.
+    prompt_padded: (steps+1,) int32, prompt tokens then -1 padding. Step
+    ``i`` (absolute position start_pos + i) forces prompt_padded[i+1] when
+    >= 0, else samples — exactly the forced-prompt-then-sample schedule of
+    the reference loop (tokenizer.cpp:360-366). coins: (steps,) f32,
+    consumed at sampled steps. start_pos: 0 for a fresh generation, the
+    checkpointed position for a resumed one.
     """
 
-    def run(params, cache, prompt_padded, first_token, coins):
+    def run(params, cache, prompt_padded, first_token, coins, start_pos):
+        """start_pos: absolute position of the first step — 0 for a fresh
+        generation, the checkpointed position for a resumed one (the cache
+        must already hold positions 0..start_pos-1; runtime/checkpoint.py).
+        """
         def body(carry, xs):
             token, cache = carry
             pos, coin, forced = xs
@@ -86,7 +92,8 @@ def make_decode_loop(step_fn: StepFn, steps: int, temperature: float,
             nxt = jnp.where(forced >= 0, forced, sampled)
             return (nxt, cache), nxt
 
-        xs = (jnp.arange(steps, dtype=jnp.int32), coins, prompt_padded[1:])
+        xs = (start_pos + jnp.arange(steps, dtype=jnp.int32), coins,
+              prompt_padded[1:])
         (_, cache), toks = jax.lax.scan(body, (first_token, cache), xs)
         return toks, cache
 

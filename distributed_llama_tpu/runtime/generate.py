@@ -284,21 +284,36 @@ def generate_batch(spec: TransformerSpec, params: dict[str, Any],
 
 def generate_fast(engine: Engine, tokenizer: Tokenizer, sampler: Sampler,
                   prompt: str, steps: int,
-                  quiet: bool = False) -> tuple[list[int], GenStats]:
+                  quiet: bool = False,
+                  resume: tuple[int, int] | None = None,
+                  resume_prompt: list[int] | None = None
+                  ) -> tuple[list[int], GenStats]:
     """The fused-loop generation path: one device program for the whole chain.
 
     Same observable token stream as generate() (forced prompt, reference
     sampler semantics via runtime/decode.py, stop on BOS) but per-token
     timing collapses into one on-device scan — the TPU-idiomatic hot path.
     Pieces and the averaged stats line print after the device loop returns.
-    """
-    import numpy as np
 
+    ``resume=(pos, token)`` continues an interrupted generation (same
+    contract as generate(): cache + sampler RNG restored first via
+    runtime/checkpoint.py, ``resume_prompt`` is the unconsumed prompt tail,
+    up to ``steps`` more positions run) — the scan simply starts its
+    position clock at ``pos``.
+    """
     spec = engine.spec
-    steps = min(steps, spec.seq_len)
-    prompt_tokens = tokenizer.encode(prompt or "", bos=True, eos=False)
-    if not prompt_tokens:
-        raise ValueError("something is wrong, expected at least 1 prompt token")
+    if resume is not None:
+        start_pos, first = resume
+        # the loop's forced stream is relative to the chain: [first] + tail
+        prompt_tokens = [first] + list(resume_prompt or [])
+        steps = min(steps, spec.seq_len - start_pos)
+    else:
+        start_pos = 0
+        steps = min(steps, spec.seq_len)
+        prompt_tokens = tokenizer.encode(prompt or "", bos=True, eos=False)
+        if not prompt_tokens:
+            raise ValueError(
+                "something is wrong, expected at least 1 prompt token")
     prompt_tail = prompt_tokens[steps + 1:]  # beyond this chain: resume tail
     if len(prompt_tokens) > steps + 1:
         prompt_tokens = prompt_tokens[:steps + 1]
@@ -325,7 +340,8 @@ def generate_fast(engine: Engine, tokenizer: Tokenizer, sampler: Sampler,
     t0 = time.perf_counter()
     toks, engine.cache = run(engine.params, engine.cache,
                              jnp.asarray(padded),
-                             jnp.int32(prompt_tokens[0]), jnp.asarray(coins))
+                             jnp.int32(prompt_tokens[0]), jnp.asarray(coins),
+                             jnp.int32(start_pos))
     toks = np.asarray(toks)
     total_ms = (time.perf_counter() - t0) * 1000
 
@@ -352,7 +368,7 @@ def generate_fast(engine: Engine, tokenizer: Tokenizer, sampler: Sampler,
     stats = GenStats(tokens=len(out_tokens), total_ms=total_ms,
                      infer_ms=total_ms, host_ms=0.0)
     if len(toks) and len(out_tokens) == len(toks):  # no early BOS: resumable
-        stats.final_pos, stats.final_token = steps, int(toks[-1])
+        stats.final_pos, stats.final_token = start_pos + steps, int(toks[-1])
         stats.prompt_rest = prompt_tail
     if not quiet:
         print(f"\nGenerated tokens:    {stats.tokens}")

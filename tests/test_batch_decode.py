@@ -78,7 +78,8 @@ def test_batch_decode_loop_matches_single_loop(params_dev):
         padded = np.full((steps + 1,), -1, dtype=np.int32)
         padded[:len(p)] = p
         toks, _ = run1(params_dev, init_cache(SPEC), jnp.asarray(padded),
-                       jnp.int32(p[0]), jnp.zeros((steps,), jnp.float32))
+                       jnp.int32(p[0]), jnp.zeros((steps,), jnp.float32),
+                       jnp.int32(0))
         single_out.append(np.asarray(toks))
 
     runb = make_batch_decode_loop(SPEC, steps, temperature=0.0, topp=0.9)

@@ -85,6 +85,107 @@ def test_split_mid_prompt_preserves_forced_tail(tmp_path, params):
     assert part1 + part2 == full
 
 
+def test_fast_resume_matches_unsplit_fast(tmp_path, params):
+    """The fused on-device loop must resume from a checkpoint: fast(5) +
+    save + load + fast(7) == fast(12) token-for-token (PARITY.md round-1
+    limitation removed)."""
+    from distributed_llama_tpu.runtime.generate import generate_fast
+
+    tok = _IdTokenizer()
+
+    full_engine = Engine(SPEC, params)
+    full, _ = generate_fast(full_engine, tok, _sampler(), "ab", steps=12,
+                            quiet=True)
+
+    eng1 = Engine(SPEC, params)
+    s1 = _sampler()
+    part1, stats1 = generate_fast(eng1, tok, s1, "ab", steps=5, quiet=True)
+    assert stats1.final_pos == 5  # resumable: no early BOS
+    ckpt = str(tmp_path / "gen.npz")
+    save_generation_state(ckpt, eng1, s1, stats1.final_pos,
+                          stats1.final_token, part1, stats1.prompt_rest)
+
+    eng2 = Engine(SPEC, params)
+    s2 = _sampler(seed=123)  # wrong seed: must be overwritten by load
+    pos, token, prev, rest = load_generation_state(ckpt, eng2, s2)
+    part2, _ = generate_fast(eng2, tok, s2, "IGNORED", steps=12 - pos,
+                             quiet=True, resume=(pos, token),
+                             resume_prompt=rest)
+    assert part1 + part2 == full
+
+
+def test_fast_resume_mid_prompt(tmp_path, params):
+    """A fused resume that lands inside the prompt must keep forcing the
+    unconsumed prompt tail."""
+    from distributed_llama_tpu.runtime.generate import generate_fast
+
+    tok = _IdTokenizer()
+    long_prompt = "abcdefg"
+
+    full_engine = Engine(SPEC, params)
+    full, _ = generate_fast(full_engine, tok, _sampler(), long_prompt,
+                            steps=12, quiet=True)
+
+    eng1 = Engine(SPEC, params)
+    s1 = _sampler()
+    part1, stats1 = generate_fast(eng1, tok, s1, long_prompt, steps=4,
+                                  quiet=True)
+    assert stats1.prompt_rest  # split fell inside the prompt
+    ckpt = str(tmp_path / "gen.npz")
+    save_generation_state(ckpt, eng1, s1, stats1.final_pos,
+                          stats1.final_token, part1, stats1.prompt_rest)
+
+    eng2 = Engine(SPEC, params)
+    s2 = _sampler(seed=99)
+    pos, token, prev, rest = load_generation_state(ckpt, eng2, s2)
+    assert rest == stats1.prompt_rest
+    part2, _ = generate_fast(eng2, tok, s2, "IGNORED", steps=12 - pos,
+                             quiet=True, resume=(pos, token),
+                             resume_prompt=rest)
+    assert part1 + part2 == full
+
+
+def test_fast_resume_crosses_loops(tmp_path, params):
+    """Per-step save -> fused resume and fused save -> per-step resume both
+    reproduce the unsplit stream (the two loops share one checkpoint
+    format and position/RNG contract)."""
+    from distributed_llama_tpu.runtime.generate import generate_fast
+
+    tok = _IdTokenizer()
+    full_engine = Engine(SPEC, params)
+    full, _ = generate(full_engine, tok, _sampler(), "ab", steps=12,
+                       quiet=True)
+
+    # per-step first half, fused second half
+    eng1 = Engine(SPEC, params)
+    s1 = _sampler()
+    part1, st1 = generate(eng1, tok, s1, "ab", steps=5, quiet=True)
+    ckpt = str(tmp_path / "a.npz")
+    save_generation_state(ckpt, eng1, s1, st1.final_pos, st1.final_token,
+                          part1, st1.prompt_rest)
+    eng2 = Engine(SPEC, params)
+    s2 = _sampler(seed=5)
+    pos, token, prev, rest = load_generation_state(ckpt, eng2, s2)
+    part2, _ = generate_fast(eng2, tok, s2, "IGNORED", steps=12 - pos,
+                             quiet=True, resume=(pos, token),
+                             resume_prompt=rest)
+    assert part1 + part2 == full
+
+    # fused first half, per-step second half
+    eng3 = Engine(SPEC, params)
+    s3 = _sampler()
+    part3, st3 = generate_fast(eng3, tok, s3, "ab", steps=5, quiet=True)
+    ckpt2 = str(tmp_path / "b.npz")
+    save_generation_state(ckpt2, eng3, s3, st3.final_pos, st3.final_token,
+                          part3, st3.prompt_rest)
+    eng4 = Engine(SPEC, params)
+    s4 = _sampler(seed=6)
+    pos, token, prev, rest = load_generation_state(ckpt2, eng4, s4)
+    part4, _ = generate(eng4, tok, s4, "IGNORED", steps=12 - pos, quiet=True,
+                        resume=(pos, token), resume_prompt=rest)
+    assert part3 + part4 == full
+
+
 def test_load_rejects_spec_mismatch(tmp_path, params):
     eng = Engine(SPEC, params)
     s = _sampler()
