@@ -86,9 +86,17 @@ def cmd_inference(argv: list[str], quiet: bool = False) -> int:
                          "ignored; --steps more positions run)")
     ap.add_argument("--prompts-file", default=None, metavar="PATH",
                     help="batch mode: one prompt per line, decoded in one "
-                         "fused lockstep batch (single chip; a capability "
-                         "the reference lacks). Ignores --prompt/--fast/"
-                         "checkpoint flags")
+                         "fused lockstep batch (composes with --tp; a "
+                         "capability the reference lacks). Ignores "
+                         "--prompt/--fast/checkpoint flags")
+    ap.add_argument("--continuous", action="store_true",
+                    help="with --prompts-file: continuous batching — a pool "
+                         "of --slots cache slots with per-slot position "
+                         "clocks; finished rows are replaced mid-flight by "
+                         "queued prompts (single chip)")
+    ap.add_argument("--slots", type=int, default=0,
+                    help="continuous-batching slot count (default: "
+                         "min(#prompts, 8))")
     ap.add_argument("--kv-cache-dtype", default="f32",
                     choices=("f32", "bf16"),
                     help="KV cache precision: f32 = reference parity "
@@ -121,6 +129,13 @@ def cmd_inference(argv: list[str], quiet: bool = False) -> int:
     from ..runtime.sampling import Sampler
 
     prompts = None
+    if (args.continuous or args.slots) and not args.prompts_file:
+        print("--continuous/--slots need --prompts-file (the request "
+              "queue)", file=sys.stderr)
+        return 2
+    if args.slots < 0:
+        print(f"--slots must be positive, got {args.slots}", file=sys.stderr)
+        return 2
     if args.prompts_file:  # validate before the multi-GB model load
         if args.sp > 1:
             # batch decode composes with tp (sharded step) but not sp
@@ -157,10 +172,22 @@ def cmd_inference(argv: list[str], quiet: bool = False) -> int:
 
     cache_dtype = jnp.bfloat16 if args.kv_cache_dtype == "bf16" else None
     if prompts is not None:  # batch mode: no Engine (its own device path)
-        from ..runtime.generate import generate_batch
-
         tokenizer = Tokenizer(args.tokenizer, spec.vocab_size)
         seed = args.seed if args.seed is not None else int(time.time())
+        if args.continuous:
+            if mesh is not None:
+                print("--continuous is single-chip (no --tp composition "
+                      "yet)", file=sys.stderr)
+                return 2
+            from ..runtime.continuous import generate_continuous
+
+            generate_continuous(spec, params, tokenizer, prompts, args.steps,
+                                args.temperature, args.topp, seed,
+                                slots=args.slots, cache_dtype=cache_dtype,
+                                quiet=quiet)
+            return 0
+        from ..runtime.generate import generate_batch
+
         generate_batch(spec, params, tokenizer, prompts, args.steps,
                        args.temperature, args.topp, seed,
                        cache_dtype=cache_dtype, mesh=mesh, quiet=quiet)

@@ -263,17 +263,33 @@ def batch_decode_attention(head_size: int, kv_mul: int, seq_len: int,
     via the flash kernel (XLA einsum fallback). q (B, n_q*hs); k/v
     (B, n_kv*hs). Returns (ao (B, n_q*hs), k_all, v_all).
 
-    Both batch paths — single-chip (forward_batch) and tp-shard-local
-    (parallel/tp.make_sharded_forward_batch, with local head counts) — run
-    THIS function, so cache indexing/attention semantics cannot drift."""
+    ``pos`` is a scalar (lockstep batch: one shared clock, one cache write
+    covering all B rows) or a (B,) vector (continuous batching: per-row
+    clocks, one write per row). All batch paths — single-chip lockstep
+    (forward_batch), tp-shard-local (parallel/tp.make_sharded_forward_batch,
+    with local head counts), and ragged (forward_batch_ragged) — run THIS
+    function, so cache indexing/attention semantics cannot drift."""
     B = q.shape[0]
     n_kv = k_all.shape[-2]
     n_q = q.shape[-1] // head_size
     dt = k_all.dtype
     k_new = k.reshape(B, 1, n_kv, head_size).astype(dt)
     v_new = v.reshape(B, 1, n_kv, head_size).astype(dt)
-    k_all = jax.lax.dynamic_update_slice(k_all, k_new, (idx * B, pos, 0, 0))
-    v_all = jax.lax.dynamic_update_slice(v_all, v_new, (idx * B, pos, 0, 0))
+    ragged = jnp.ndim(pos) == 1
+    if ragged:
+        # per-row columns: B updates, each in place on the carry (a scatter
+        # would materialize a second cache-sized buffer — forward_batch
+        # docstring)
+        for b in range(B):
+            k_all = jax.lax.dynamic_update_slice(
+                k_all, k_new[b:b + 1], (idx * B + b, pos[b], 0, 0))
+            v_all = jax.lax.dynamic_update_slice(
+                v_all, v_new[b:b + 1], (idx * B + b, pos[b], 0, 0))
+    else:
+        k_all = jax.lax.dynamic_update_slice(k_all, k_new,
+                                             (idx * B, pos, 0, 0))
+        v_all = jax.lax.dynamic_update_slice(v_all, v_new,
+                                             (idx * B, pos, 0, 0))
 
     from ..ops.pallas_attention import maybe_flash_decode
 
@@ -286,9 +302,14 @@ def batch_decode_attention(head_size: int, kv_mul: int, seq_len: int,
     if ao is None:
         k_c = jax.lax.dynamic_slice_in_dim(k_all, idx * B, B, 0)
         v_c = jax.lax.dynamic_slice_in_dim(v_all, idx * B, B, 0)
+        if ragged:
+            # (B, 1, S): row b sees cache slots 0..pos[b]
+            mask = jnp.arange(seq_len)[None, None, :] <= pos[:, None, None]
+        else:
+            mask = causal_cache_mask(seq_len, pos, 1)
         ao = attention_core(head_size, kv_mul,
                             q.reshape(B, 1, n_q, head_size), k_c, v_c,
-                            causal_cache_mask(seq_len, pos, 1))
+                            mask)
     return ao.reshape(B, -1), k_all, v_all
 
 
@@ -305,21 +326,23 @@ def init_cache_batch(spec: TransformerSpec, batch: int,
 def forward_batch(spec: TransformerSpec, params: dict[str, Any],
                   cache: KVCache, tokens: jax.Array,
                   pos: jax.Array) -> tuple[jax.Array, KVCache]:
-    """Decode one token for each of B sequences at a SHARED position.
+    """Decode one token for each of B sequences.
 
-    tokens (B,), pos scalar; cache is (L, B, S, n_kv, hs). Returns
-    (logits (B, vocab), cache). The reference is strictly batch=1 (one token
-    per task-table cycle, SURVEY.md §2 'no batching'); batching is the
-    natural TPU extension — B rows turn the per-layer matvecs into MXU
-    matmuls at the same weight traffic, so throughput scales ~B until the
-    MXU saturates.
+    tokens (B,); pos scalar (lockstep: one SHARED position clock) or (B,)
+    (ragged: per-row clocks — continuous batching); cache is
+    (L, B, S, n_kv, hs). Returns (logits (B, vocab), cache). The reference
+    is strictly batch=1 (one token per task-table cycle, SURVEY.md §2 'no
+    batching'); batching is the natural TPU extension — B rows turn the
+    per-layer matvecs into MXU matmuls at the same weight traffic, so
+    throughput scales ~B until the MXU saturates.
 
-    The position is shared (lockstep rows; ragged prompts right-pad and
-    sample early — runtime/decode.make_batch_decode_loop) so the cache
-    update is one dynamic_update_slice, which XLA performs IN PLACE on the
-    scan carry. A per-row-position variant needs a scatter, which XLA does
-    NOT update in place — it materializes a second cache-sized buffer,
-    doubling cache HBM (measured: OOM at B=4/7B/16GB).
+    With the shared clock (lockstep rows; ragged prompts right-pad and
+    sample early — runtime/decode.make_batch_decode_loop) the cache update
+    is one dynamic_update_slice, which XLA performs IN PLACE on the scan
+    carry. The per-row-clock case uses B row updates instead of a scatter,
+    which XLA does NOT update in place — it materializes a second
+    cache-sized buffer, doubling cache HBM (measured: OOM at B=4/7B/16GB).
+    Both live in batch_decode_attention.
 
     Numerics per row match forward(): same kernels via the T=B path, same
     RoPE/GQA/softmax math (batched einsums over the head-major cache —
@@ -327,7 +350,8 @@ def forward_batch(spec: TransformerSpec, params: dict[str, Any],
     """
     B = tokens.shape[0]
     x = params["tok_embedding"][tokens].astype(jnp.float32)  # (B, dim)
-    positions = jnp.full((B,), pos)  # every row rotates at the shared pos
+    # each row rotates at its own clock (identical under the shared one)
+    positions = pos if jnp.ndim(pos) == 1 else jnp.full((B,), pos)
     n_kv, hs, kv_mul = spec.n_kv_heads, spec.head_size, spec.kv_mul
     L, S = spec.n_layers, spec.seq_len
 
@@ -359,6 +383,21 @@ def forward_batch(spec: TransformerSpec, params: dict[str, Any],
     logits = matmul(params["wcls"], x)
     return logits, KVCache(k4.reshape(L, B, S, n_kv, hs),
                            v4.reshape(L, B, S, n_kv, hs))
+
+
+def forward_batch_ragged(spec: TransformerSpec, params: dict[str, Any],
+                         cache: KVCache, tokens: jax.Array,
+                         pos_vec: jax.Array) -> tuple[jax.Array, KVCache]:
+    """Decode one token for each of B sequences at PER-ROW positions —
+    forward_batch with a (B,) position vector (the continuous-batching step,
+    runtime/continuous.py): rows advance on independent clocks, so a
+    finished row's slot can be re-used by a new request mid-flight.
+
+    Inactive/parked rows simply keep writing at their current position; a
+    newly admitted request starts at pos 0 and only ever attends to slots
+    0..pos, so stale cache content beyond a row's clock is invisible.
+    """
+    return forward_batch(spec, params, cache, tokens, pos_vec)
 
 
 def forward_seq(spec: TransformerSpec, params: dict[str, Any],

@@ -119,11 +119,13 @@ def _kernel_batch(layer_ref, pos_ref, q_ref, k_hbm, v_hbm, out_ref,
 
     grid=(B,): program b walks row layer*batch+b's live chunks via the same
     shared flash loop as the single-sequence kernel (prefix-indexed DMAs).
+    pos_ref is (B,) — each row has its own position clock (identical values
+    in the lockstep case; ragged for continuous batching).
     q_ref/out_ref get per-b blocks (1, n_kv, kv_mul, hs).
     """
     b = pl.program_id(0)
     row = layer_ref[0] * batch + b
-    final = _flash_over_row(row, pos_ref[0], q_ref[0], k_hbm, v_hbm,
+    final = _flash_over_row(row, pos_ref[b], q_ref[0], k_hbm, v_hbm,
                             k_buf, v_buf, sems, chunk=chunk, kv_mul=kv_mul)
     for mqi in range(kv_mul):
         _, l_i, o_i = final[mqi]
@@ -136,14 +138,15 @@ def decode_attention_batch(q, k4, v4, layer, pos, *, kv_mul: int,
     """Batched flash-decode attention over the rank-4 (L*B, S, n_kv, hs)
     cache carried by models/llama.forward_batch.
 
-    q: (B, n_q, hs) f32; pos: the SHARED position (lockstep batch).
-    Returns (B, n_q * hs) f32. Live-chunk walking per row, like
-    decode_attention.
+    q: (B, n_q, hs) f32; pos: scalar (shared clock, lockstep batch) or (B,)
+    (per-row clocks, continuous batching). Returns (B, n_q * hs) f32.
+    Live-chunk walking per row, like decode_attention.
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     LB, S, n_kv, hs = k4.shape
     B = q.shape[0]
+    pos = jnp.broadcast_to(jnp.asarray(pos, jnp.int32), (B,))
     chunk = _chunk(S, n_kv, hs, k4.dtype.itemsize)
     if chunk is None:
         raise ValueError(
@@ -169,8 +172,7 @@ def decode_attention_batch(q, k4, v4, layer, pos, *, kv_mul: int,
             pltpu.SemaphoreType.DMA((2, 2)),
         ],
         interpret=interpret,
-    )(jnp.asarray(layer, jnp.int32).reshape(1),
-      jnp.asarray(pos, jnp.int32).reshape(1), qg, k4, v4)
+    )(jnp.asarray(layer, jnp.int32).reshape(1), pos, qg, k4, v4)
     return out.reshape(B, n_kv * kv_mul * hs)
 
 

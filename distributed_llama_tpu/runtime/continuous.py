@@ -1,0 +1,193 @@
+"""Continuous batching: per-slot position clocks + mid-flight admission.
+
+The lockstep batch path (runtime/decode.make_batch_decode_loop) shares one
+position clock across rows, so the batch finishes at the pace of its slowest
+row and new work waits for the whole batch. This engine removes both limits —
+the TPU analog of vLLM-style continuous batching, far beyond the reference's
+strict batch=1 loop (tokenizer.cpp:321-394):
+
+* a fixed pool of B cache slots, each with its OWN position clock
+  (models/llama.forward_batch_ragged: per-row RoPE, per-row cache column,
+  per-row attention visibility);
+* a host-side scheduler that retires a row the moment it stops (BOS or step
+  budget) and admits the next queued request into the freed slot at pos 0
+  while the other rows keep decoding.
+
+Prompt tokens are forced through the same decode step (one per iteration,
+the reference's own prompt handling); each request samples from its own
+xorshift stream seeded ``seed + request_index`` with reference Sampler
+semantics, so a request's token stream is IDENTICAL to running it alone
+through generate() with that seed — the scheduling is invisible in the
+output (the parity gate of tests/test_continuous.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any
+
+import numpy as np
+
+from ..io.tokenizer import BOS
+from ..models.spec import TransformerSpec
+from .sampling import Sampler
+
+
+@dataclasses.dataclass
+class _Slot:
+    req: int = -1            # request index, -1 = free
+    pos: int = 0             # this row's position clock
+    token: int = 0           # next input token
+    forced: list = dataclasses.field(default_factory=list)
+    out: list = dataclasses.field(default_factory=list)
+    budget: int = 0          # max positions for this request
+    sampler: Sampler | None = None
+
+    @property
+    def free(self) -> bool:
+        return self.req < 0
+
+
+@dataclasses.dataclass
+class ContinuousStats:
+    tokens: int = 0          # generated (emitted) tokens
+    steps: int = 0           # device steps executed
+    total_ms: float = 0.0
+    max_active: int = 0
+
+    @property
+    def tokens_per_s(self) -> float:
+        return self.tokens / max(self.total_ms / 1000, 1e-9)
+
+
+class ContinuousEngine:
+    """Owns the slot cache + jitted ragged step; schedules requests.
+
+    ``slots`` bounds concurrent sequences (cache memory = slots x seq_len);
+    any number of requests stream through the pool.
+    """
+
+    def __init__(self, spec: TransformerSpec, params: dict[str, Any],
+                 slots: int, temperature: float, topp: float, seed: int,
+                 cache_dtype=None):
+        import functools
+
+        import jax
+        import jax.numpy as jnp
+
+        from ..models.llama import (forward_batch_ragged, init_cache_batch,
+                                    params_to_device)
+
+        self.spec = spec
+        self.slots = slots
+        self.temperature = temperature
+        self.topp = topp
+        self.seed = seed
+        self.jnp = jnp
+        self.params = params_to_device(params)
+        self.cache = init_cache_batch(spec, slots,
+                                      cache_dtype or jnp.float32)
+        self._step = jax.jit(functools.partial(forward_batch_ragged, spec),
+                             donate_argnums=1)
+
+    def run(self, requests: list[list[int]], steps: int,
+            quiet: bool = True) -> tuple[list[list[int]], ContinuousStats]:
+        """Decode every request (a non-empty prompt token list, BOS included)
+        to BOS or ``steps`` positions; returns outputs in request order."""
+        jnp = self.jnp
+        spec = self.spec
+        for i, r in enumerate(requests):
+            if not r:
+                raise ValueError(f"request {i} has no prompt tokens")
+        queue = list(range(len(requests)))
+        pool = [_Slot() for _ in range(self.slots)]
+        outs: list[list[int] | None] = [None] * len(requests)
+        stats = ContinuousStats()
+        t0 = time.perf_counter()
+
+        def admit():
+            for s in pool:
+                if s.free and queue:
+                    ri = queue.pop(0)
+                    prompt = requests[ri]
+                    s.req, s.pos = ri, 0
+                    s.token = prompt[0]
+                    s.forced = list(prompt[1:])
+                    s.out = []
+                    s.budget = min(steps, spec.seq_len)
+                    s.sampler = Sampler(spec.vocab_size, self.temperature,
+                                        self.topp, self.seed + ri)
+
+        def retire(s: _Slot):
+            outs[s.req] = s.out
+            if not quiet:
+                print(f"[{s.req}] done: {len(s.out)} tokens "
+                      f"(pos {s.pos}/{s.budget})")
+            s.req = -1
+            # park the freed slot at pos 0: a retired row's clock can equal
+            # seq_len, and feeding that to the flash kernel would DMA one
+            # chunk past the end of the cache row (free slots still ride
+            # through the fixed-B step; their writes at pos 0 are dead until
+            # the slot is re-admitted, which restarts at pos 0 anyway)
+            s.pos, s.token = 0, 0
+
+        admit()
+        while any(not s.free for s in pool):
+            tokens = jnp.asarray([s.token for s in pool], jnp.int32)
+            pos_vec = jnp.asarray([s.pos for s in pool], jnp.int32)
+            logits, self.cache = self._step(self.params, self.cache, tokens,
+                                            pos_vec)
+            logits = np.asarray(logits)
+            stats.steps += 1
+            stats.max_active = max(stats.max_active,
+                                   sum(not s.free for s in pool))
+            for i, s in enumerate(pool):
+                if s.free:
+                    continue
+                if s.forced:
+                    nxt = s.forced.pop(0)
+                else:
+                    nxt = int(s.sampler.sample(logits[i]))
+                s.pos += 1
+                if nxt == BOS:  # reference stop: BOS before decoding it
+                    retire(s)
+                    continue
+                s.out.append(nxt)
+                stats.tokens += 1
+                s.token = nxt
+                if s.pos >= s.budget:
+                    retire(s)
+            admit()
+
+        stats.total_ms = (time.perf_counter() - t0) * 1000
+        assert all(o is not None for o in outs)
+        return outs, stats
+
+
+def generate_continuous(spec: TransformerSpec, params: dict[str, Any],
+                        tokenizer, prompts: list[str], steps: int,
+                        temperature: float, topp: float, seed: int,
+                        slots: int = 0, cache_dtype=None,
+                        quiet: bool = False):
+    """CLI entry: encode prompts, stream them through a slot pool, print
+    rows in the --prompts-file format ("[i] 'text'")."""
+    reqs = [tokenizer.encode(p or "", bos=True, eos=False) for p in prompts]
+    slots = slots or min(len(reqs), 8)
+    eng = ContinuousEngine(spec, params, slots, temperature, topp, seed,
+                           cache_dtype=cache_dtype)
+    outs, stats = eng.run(reqs, steps, quiet=quiet)
+    for b, (req, row) in enumerate(zip(reqs, outs)):
+        if not quiet:
+            prev, text = req[0], b""
+            for t in row:
+                text += tokenizer.decode_piece(prev, t)
+                prev = t
+            print(f"[{b}] {text.decode('utf-8', errors='replace')!r}")
+    if not quiet:
+        print(f"Generated tokens:    {stats.tokens} across {len(reqs)} "
+              f"requests ({slots} slots, {stats.steps} steps)")
+        print(f"Avg generation time: "
+              f"{stats.total_ms / max(1, stats.tokens):.2f} ms/token "
+              f"({stats.tokens_per_s:.1f} tok/s)")
+    return outs, stats

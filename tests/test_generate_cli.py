@@ -174,3 +174,22 @@ def test_cli_batch_prompts_file(model_files, tmp_path, capsys):
     # batch mode refuses sp (no composition; clear error, exit 2)
     assert main(["inference", *base[:-2], "--tp", "1", "--sp", "2",
                  "--prompts-file", str(pf)]) == 2
+
+    # continuous batching through a 1-slot pool: the two prompts stream
+    # through sequentially; greedy rows must still match
+    assert main(["inference", *base[:-2], "--tp", "1", "--continuous",
+                 "--slots", "1", "--prompts-file", str(pf)]) == 0
+    out = capsys.readouterr().out
+    rows_cont = [ln for ln in out.splitlines() if ln.startswith("[")
+                 and "] done:" not in ln]
+    assert rows_cont == rows
+
+    # --continuous has no tp composition: clear error
+    assert main(["inference", *base[:-2], "--tp", "2", "--continuous",
+                 "--prompts-file", str(pf)]) == 2
+
+    # flag misuse is rejected up front, not silently ignored
+    assert main(["inference", *base, "--continuous",
+                 "--prompt", "hi"]) == 2                   # no prompts-file
+    assert main(["inference", *base[:-2], "--tp", "1", "--continuous",
+                 "--slots", "-3", "--prompts-file", str(pf)]) == 2

@@ -58,6 +58,36 @@ def test_decode_attention_batch_matches_core(kv_mul, pos):
                                    np.asarray(want), rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.parametrize("kv_mul", [1, 2])
+def test_decode_attention_batch_ragged_pos(kv_mul):
+    """Per-row position clocks (continuous batching): each row's flash walk
+    must honor ITS pos, matching the per-row reference attention."""
+    import jax.numpy as jnp
+
+    from distributed_llama_tpu.models.llama import (attention_core,
+                                                    causal_cache_mask)
+    from distributed_llama_tpu.ops.pallas_attention import \
+        decode_attention_batch
+
+    L, B, S, n_kv, hs = 2, 3, 32, 4, 128
+    n_q = n_kv * kv_mul
+    layer = 1
+    pos_vec = jnp.asarray([0, 17, 9], jnp.int32)
+    rng = np.random.default_rng(11 + kv_mul)
+    k4 = jnp.asarray(rng.normal(size=(L * B, S, n_kv, hs)).astype(np.float32))
+    v4 = jnp.asarray(rng.normal(size=(L * B, S, n_kv, hs)).astype(np.float32))
+    q = jnp.asarray(rng.normal(size=(B, n_q, hs)).astype(np.float32))
+
+    got = decode_attention_batch(q, k4, v4, layer, pos_vec, kv_mul=kv_mul,
+                                 interpret=True)
+    for b in range(B):
+        mask = causal_cache_mask(S, pos_vec[b], 1)
+        want = attention_core(hs, kv_mul, q[b][None], k4[layer * B + b],
+                              v4[layer * B + b], mask)
+        np.testing.assert_allclose(np.asarray(got[b][None]),
+                                   np.asarray(want), rtol=1e-5, atol=1e-5)
+
+
 def test_decode_attention_ignores_stale_suffix():
     """Entries beyond pos (stale garbage from earlier generations) must not
     affect the result — the kernel only walks live chunks and masks within
