@@ -9,6 +9,7 @@
 //
 // Build: make -C csrc   (g++ -O3 -march=native -shared -fPIC)
 
+#include <algorithm>
 #include <cstdint>
 #include <cstring>
 #include <cmath>
@@ -287,6 +288,81 @@ int64_t tok_encode(void* handle, const uint8_t* text, int64_t len,
 
     std::memcpy(out, toks.data(), toks.size() * sizeof(int32_t));
     return (int64_t)toks.size();
+}
+
+// ---- Sampler (reference src/tokenizer.cpp:206-319 semantics) ---------------
+//
+// The reference's sampler is C++; this is the native host equivalent of
+// runtime/sampling.py (which stays as the no-toolchain fallback and the
+// documentation of record for the semantics): temperature == 0 -> argmax;
+// else logits/temp -> max-subtracted f32 softmax -> nucleus top-p with the
+// (1-p)/(n-1) cutoff pre-filter and stable descending sort, or the plain
+// multinomial CDF walk when topp is outside (0, 1). The xorshift coin is
+// drawn by the caller (Python owns the RNG stream / checkpoint contract).
+
+int32_t sample_logits(const float* logits, int32_t n, float temperature,
+                      float topp, float coin) {
+    if (temperature == 0.0f) {
+        int32_t best = 0;
+        for (int32_t i = 1; i < n; i++)
+            if (logits[i] > logits[best]) best = i;  // first max, like argmax
+        return best;
+    }
+    std::vector<float> probs((size_t)n);
+    float mx = logits[0] / temperature;
+    for (int32_t i = 1; i < n; i++) {
+        float v = logits[i] / temperature;
+        if (v > mx) mx = v;
+    }
+    float sum = 0.0f;
+    for (int32_t i = 0; i < n; i++) {
+        probs[(size_t)i] = std::exp(logits[i] / temperature - mx);
+        sum += probs[(size_t)i];
+    }
+    for (int32_t i = 0; i < n; i++) probs[(size_t)i] /= sum;
+
+    if (topp <= 0.0f || topp >= 1.0f) {  // multinomial CDF walk
+        float cdf = 0.0f;
+        for (int32_t i = 0; i < n; i++) {
+            cdf += probs[(size_t)i];
+            if (coin < cdf) return i;
+        }
+        return n - 1;
+    }
+
+    // nucleus: cutoff pre-filter, stable descending sort, cut at cum > topp,
+    // CDF walk over the kept prefix scaled by coin*cum
+    if (n == 1) return 0;
+    float cutoff = (1.0f - topp) / (float)(n - 1);
+    std::vector<int32_t> order;
+    order.reserve((size_t)n);
+    for (int32_t i = 0; i < n; i++)
+        if (probs[(size_t)i] >= cutoff) order.push_back(i);
+    if (order.empty()) {
+        // degenerate nucleus (topp < 1/n with near-uniform probs): the
+        // smallest keepable set is the single most-probable token
+        int32_t best = 0;
+        for (int32_t i = 1; i < n; i++)
+            if (probs[(size_t)i] > probs[(size_t)best]) best = i;
+        return best;
+    }
+    std::stable_sort(order.begin(), order.end(),
+                     [&](int32_t a, int32_t b) {
+                         return probs[(size_t)a] > probs[(size_t)b];
+                     });
+    float cum = 0.0f;
+    int64_t last = (int64_t)order.size() - 1;
+    for (int64_t i = 0; i < (int64_t)order.size(); i++) {
+        cum += probs[(size_t)order[(size_t)i]];
+        if (cum > topp) { last = i; break; }
+    }
+    float r = coin * cum;
+    float cdf = 0.0f;
+    for (int64_t i = 0; i <= last; i++) {
+        cdf += probs[(size_t)order[(size_t)i]];
+        if (r < cdf) return order[(size_t)i];
+    }
+    return order[(size_t)last];
 }
 
 }  // extern "C"

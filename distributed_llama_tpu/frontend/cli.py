@@ -175,16 +175,12 @@ def cmd_inference(argv: list[str], quiet: bool = False) -> int:
         tokenizer = Tokenizer(args.tokenizer, spec.vocab_size)
         seed = args.seed if args.seed is not None else int(time.time())
         if args.continuous:
-            if mesh is not None:
-                print("--continuous is single-chip (no --tp composition "
-                      "yet)", file=sys.stderr)
-                return 2
             from ..runtime.continuous import generate_continuous
 
             generate_continuous(spec, params, tokenizer, prompts, args.steps,
                                 args.temperature, args.topp, seed,
                                 slots=args.slots, cache_dtype=cache_dtype,
-                                quiet=quiet)
+                                mesh=mesh, quiet=quiet)
             return 0
         from ..runtime.generate import generate_batch
 
@@ -198,7 +194,12 @@ def cmd_inference(argv: list[str], quiet: bool = False) -> int:
 
     tokenizer = Tokenizer(args.tokenizer, spec.vocab_size)
     seed = args.seed if args.seed is not None else int(time.time())
-    sampler = Sampler(spec.vocab_size, args.temperature, args.topp, seed)
+    # multi-host: every host must sample the IDENTICAL chain or the SPMD
+    # collectives deadlock — pin the numpy sampler (the native one can
+    # differ by ulps across libm builds, and a host without a toolchain
+    # falls back to numpy anyway)
+    sampler = Sampler(spec.vocab_size, args.temperature, args.topp, seed,
+                      use_native=not args.coordinator)
     # pieces print inside the per-token stats lines (reference behavior:
     # tokenizer.cpp prints each piece once, at the end of the 🔶 line)
     resume = None

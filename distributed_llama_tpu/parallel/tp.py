@@ -305,10 +305,12 @@ def make_sharded_forward_batch(spec: TransformerSpec, mesh: Mesh):
 
     Returns fn(params, cache, tokens (B,), pos) -> (logits (B, vocab), cache)
     with cache (L, B, S, n_kv, hs) kv-head-sharded over tp. Per-row math ==
-    models/llama.forward_batch (same kernels, same shared-position contract);
-    per-layer collectives == make_sharded_forward's (the four all_gathers now
-    carry B rows each). Gate: tp ∈ {2, 4} logits/tokens match the
-    single-chip batch path (tests/test_batch_tp.py).
+    models/llama.forward_batch (same kernels; pos is a shared scalar clock
+    for the lockstep loop or a (B,) vector for continuous batching, exactly
+    as in forward_batch); per-layer collectives == make_sharded_forward's
+    (the four all_gathers now carry B rows each). Gates: tp ∈ {2, 4}
+    logits/tokens match the single-chip batch path (tests/test_batch_tp.py)
+    and the single-chip continuous scheduler (tests/test_continuous.py).
     """
     n_slices = mesh.shape["tp"]
     if mesh.shape.get("sp", 1) != 1:
@@ -320,7 +322,7 @@ def make_sharded_forward_batch(spec: TransformerSpec, mesh: Mesh):
     def local_step(params, cache, tokens, pos):
         B = tokens.shape[0]
         x = params["tok_embedding"][tokens].astype(jnp.float32)  # (B, dim)
-        positions = jnp.full((B,), pos)
+        positions = pos if jnp.ndim(pos) == 1 else jnp.full((B,), pos)
         # rank-4 (L*B, S, kv_loc, hs) carry view — same layout rationale as
         # forward_batch (row layer*B+b is a single-sequence cache plane)
         k4 = cache.k.reshape(L * B, S, kv_loc, hs)

@@ -70,7 +70,7 @@ class ContinuousEngine:
 
     def __init__(self, spec: TransformerSpec, params: dict[str, Any],
                  slots: int, temperature: float, topp: float, seed: int,
-                 cache_dtype=None):
+                 cache_dtype=None, mesh=None):
         import functools
 
         import jax
@@ -85,11 +85,26 @@ class ContinuousEngine:
         self.topp = topp
         self.seed = seed
         self.jnp = jnp
-        self.params = params_to_device(params)
-        self.cache = init_cache_batch(spec, slots,
-                                      cache_dtype or jnp.float32)
-        self._step = jax.jit(functools.partial(forward_batch_ragged, spec),
-                             donate_argnums=1)
+        dtype = cache_dtype or jnp.float32
+        if mesh is not None and (mesh.shape["tp"] > 1
+                                 or mesh.shape.get("sp", 1) > 1):
+            # tensor-parallel step: same sharded program as the lockstep
+            # batch path, driven with a (B,) position vector
+            from ..parallel import (make_sharded_forward_batch,
+                                    shard_cache_batch, shard_params,
+                                    validate_sharding)
+
+            validate_sharding(spec, mesh)
+            self.params = shard_params(params, mesh)
+            self.cache = shard_cache_batch(
+                init_cache_batch(spec, slots, dtype), mesh)
+            self._step = make_sharded_forward_batch(spec, mesh)
+        else:
+            self.params = params_to_device(params)
+            self.cache = init_cache_batch(spec, slots, dtype)
+            self._step = jax.jit(
+                functools.partial(forward_batch_ragged, spec),
+                donate_argnums=1)
 
     def run(self, requests: list[list[int]], steps: int,
             quiet: bool = True) -> tuple[list[list[int]], ContinuousStats]:
@@ -168,14 +183,14 @@ class ContinuousEngine:
 def generate_continuous(spec: TransformerSpec, params: dict[str, Any],
                         tokenizer, prompts: list[str], steps: int,
                         temperature: float, topp: float, seed: int,
-                        slots: int = 0, cache_dtype=None,
+                        slots: int = 0, cache_dtype=None, mesh=None,
                         quiet: bool = False):
     """CLI entry: encode prompts, stream them through a slot pool, print
     rows in the --prompts-file format ("[i] 'text'")."""
     reqs = [tokenizer.encode(p or "", bos=True, eos=False) for p in prompts]
     slots = slots or min(len(reqs), 8)
     eng = ContinuousEngine(spec, params, slots, temperature, topp, seed,
-                           cache_dtype=cache_dtype)
+                           cache_dtype=cache_dtype, mesh=mesh)
     outs, stats = eng.run(reqs, steps, quiet=quiet)
     for b, (req, row) in enumerate(zip(reqs, outs)):
         if not quiet:

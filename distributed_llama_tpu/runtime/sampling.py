@@ -31,8 +31,14 @@ def sample_mult(probs: np.ndarray, coin: float) -> int:
 
 def sample_topp(probs: np.ndarray, topp: float, coin: float) -> int:
     n = len(probs)
+    if n == 1:
+        return 0
     cutoff = np.float32(1.0 - topp) / np.float32(n - 1)
     idx = np.nonzero(probs >= cutoff)[0]
+    if len(idx) == 0:
+        # degenerate nucleus (topp < 1/n with near-uniform probs): keep the
+        # single most-probable token (native sample_logits does the same)
+        return int(np.argmax(probs))
     # descending by prob; stable so equal probs keep index order (qsort with
     # strict compare leaves equal elements in scan order)
     order = idx[np.argsort(-probs[idx], kind="stable")]
@@ -54,21 +60,38 @@ def sample_topp(probs: np.ndarray, topp: float, coin: float) -> int:
 
 
 class Sampler:
-    """Reference Sampler (tokenizer.cpp:283-319). Mutates logits like it."""
+    """Reference Sampler (tokenizer.cpp:283-319). Mutates logits like it.
+
+    The hot select runs in the native host library when available (csrc
+    sample_logits — the C++ equivalent of the reference's C++ sampler); the
+    numpy implementation above is the fallback and the semantics of record
+    (tests pin native == numpy on the same logits/coin). Caveat: the two can
+    diverge by float ulps across libm/numpy builds at CDF boundaries, so
+    flows that need bit-identical streams on EVERY machine (multi-host SPMD)
+    should pass use_native=False (cli.py does).
+    """
 
     def __init__(self, vocab_size: int, temperature: float, topp: float,
-                 seed: int):
+                 seed: int, use_native: bool = True):
         self.vocab_size = vocab_size
         self.temperature = float(temperature)
         self.topp = float(topp)
         self.rng = Xorshift64(seed)
+        self.use_native = use_native
 
     def sample(self, logits: np.ndarray) -> int:
         logits = np.asarray(logits, dtype=np.float32)[:self.vocab_size]
         if self.temperature == 0.0:
             return sample_argmax(logits)
-        probs = softmax_f32(logits / np.float32(self.temperature))
         coin = self.rng.f32()
+        if self.use_native:
+            from ..utils import native
+
+            idx = native.sample_logits(logits, self.temperature, self.topp,
+                                       coin)
+            if idx is not None:
+                return idx
+        probs = softmax_f32(logits / np.float32(self.temperature))
         if self.topp <= 0 or self.topp >= 1:
             return sample_mult(probs, coin)
         return sample_topp(probs, self.topp, coin)

@@ -69,6 +69,10 @@ def _load_locked():
     lib.tok_encode.restype = ctypes.c_int64
     lib.tok_encode.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint8),
                                ctypes.c_int64, ctypes.POINTER(ctypes.c_int32)]
+    lib.sample_logits.restype = ctypes.c_int32
+    lib.sample_logits.argtypes = [ctypes.POINTER(ctypes.c_float),
+                                  ctypes.c_int32, ctypes.c_float,
+                                  ctypes.c_float, ctypes.c_float]
     _lib = lib
     return _lib
 
@@ -140,6 +144,20 @@ def q40_tile_kernel_layout(qs: np.ndarray, d16: np.ndarray,
         scale.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
         n_stacked, d, nb, n_threads)
     return qs_t, scale
+
+
+def sample_logits(logits: np.ndarray, temperature: float, topp: float,
+                  coin: float) -> int | None:
+    """Native reference-semantics sampler (csrc sample_logits); None when the
+    library is unavailable (callers run the numpy implementation)."""
+    lib = _load()
+    if lib is None:
+        return None
+    logits = np.ascontiguousarray(logits, dtype=np.float32)
+    return int(lib.sample_logits(
+        logits.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        len(logits), ctypes.c_float(temperature), ctypes.c_float(topp),
+        ctypes.c_float(coin)))
 
 
 class NativeBpe:

@@ -102,6 +102,24 @@ def test_continuous_more_requests_than_slots(params, params_dev):
     assert steps <= stats.steps <= 5 * steps
 
 
+def test_continuous_over_tp_mesh_matches_single_chip(params):
+    """The same request stream through a tp=2 sharded ragged step must be
+    token-identical to the single-chip continuous engine."""
+    from distributed_llama_tpu.parallel import make_mesh
+    from distributed_llama_tpu.runtime.continuous import ContinuousEngine
+
+    steps = 8
+    reqs = [[1, 5, 9], [1, 22], [1, 7, 33, 2]]
+    ref_eng = ContinuousEngine(SPEC, params, slots=2, temperature=0.0,
+                               topp=0.9, seed=3)
+    ref, _ = ref_eng.run(reqs, steps)
+
+    eng = ContinuousEngine(SPEC, params, slots=2, temperature=0.0, topp=0.9,
+                           seed=3, mesh=make_mesh(tp=2))
+    outs, _ = eng.run(reqs, steps)
+    assert outs == ref
+
+
 def test_continuous_pos_never_reaches_seq_len(params):
     """A retired row's clock can hit seq_len; the freed slot must be parked
     back at pos 0 before the next device step — pos == seq_len reaching the

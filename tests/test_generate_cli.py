@@ -184,9 +184,13 @@ def test_cli_batch_prompts_file(model_files, tmp_path, capsys):
                  and "] done:" not in ln]
     assert rows_cont == rows
 
-    # --continuous has no tp composition: clear error
+    # continuous batching over a tp=2 mesh: identical rows again
     assert main(["inference", *base[:-2], "--tp", "2", "--continuous",
-                 "--prompts-file", str(pf)]) == 2
+                 "--slots", "2", "--prompts-file", str(pf)]) == 0
+    out = capsys.readouterr().out
+    rows_ctp = [ln for ln in out.splitlines() if ln.startswith("[")
+                and "] done:" not in ln]
+    assert rows_ctp == rows
 
     # flag misuse is rejected up front, not silently ignored
     assert main(["inference", *base, "--continuous",

@@ -134,3 +134,63 @@ def test_native_tile_kernel_layout_matches_numpy():
     np.testing.assert_array_equal(qs_t2, np.ascontiguousarray(
         qs[0].transpose(2, 0, 1)))
     np.testing.assert_array_equal(scale2, d16[0].astype(np.float32))
+
+
+def test_native_sampler_matches_numpy():
+    """csrc sample_logits vs the numpy Sampler path on identical
+    logits/coins, across strategies (argmax is numpy-only; multinomial and
+    nucleus exercise the native select)."""
+    from distributed_llama_tpu.runtime.sampling import (sample_mult,
+                                                        sample_topp,
+                                                        softmax_f32)
+
+    rng = np.random.default_rng(123)
+    for case in range(200):
+        n = int(rng.integers(4, 500))
+        logits = (rng.standard_normal(n) * rng.uniform(0.5, 6)).astype(
+            np.float32)
+        temperature = float(rng.uniform(0.2, 1.5))
+        coin = float(rng.uniform(0, 1))
+        # nucleus (topp in (0,1)) and multinomial (topp outside)
+        for topp in (float(rng.uniform(0.05, 0.99)), 1.0):
+            got = native.sample_logits(logits, temperature, topp, coin)
+            assert got is not None
+            probs = softmax_f32(logits / np.float32(temperature))
+            if topp <= 0 or topp >= 1:
+                want = sample_mult(probs, coin)
+            else:
+                want = sample_topp(probs, topp, coin)
+            assert got == want, (case, n, temperature, topp, coin)
+
+
+def test_sampler_class_uses_native_consistently():
+    """Sampler(use_native=True/False) must emit the same stream."""
+    from distributed_llama_tpu.runtime.sampling import Sampler
+
+    rng = np.random.default_rng(7)
+    logits_seq = [rng.standard_normal(300).astype(np.float32) * 4
+                  for _ in range(50)]
+    a = Sampler(300, temperature=0.9, topp=0.9, seed=42, use_native=True)
+    b = Sampler(300, temperature=0.9, topp=0.9, seed=42, use_native=False)
+    for lg in logits_seq:
+        assert a.sample(lg) == b.sample(lg)
+
+
+def test_native_sampler_degenerate_nucleus():
+    """topp < 1/n with near-uniform probs empties the cutoff pre-filter:
+    both implementations must return the argmax, not crash/UB."""
+    from distributed_llama_tpu.runtime.sampling import (sample_topp,
+                                                        softmax_f32)
+
+    n = 64
+    logits = np.zeros(n, dtype=np.float32)
+    logits[17] = 1e-4  # barely-top token
+    for topp in (1e-6, 0.01):
+        got = native.sample_logits(logits, 1.0, topp, 0.7)
+        probs = softmax_f32(logits)
+        want = sample_topp(probs, topp, 0.7)
+        assert got == want == 17
+    # n == 1: no (n-1) division
+    one = np.zeros(1, dtype=np.float32)
+    assert native.sample_logits(one, 1.0, 0.9, 0.3) == 0
+    assert sample_topp(softmax_f32(one), 0.9, 0.3) == 0
