@@ -265,10 +265,60 @@ def cmd_worker(argv: list[str]) -> int:
     return cmd_inference(argv, quiet=True)
 
 
+def cmd_serve(argv: list[str]) -> int:
+    """HTTP inference server over the continuous-batching engine
+    (runtime/server.py) — concurrent clients stream through the slot pool."""
+    ap = argparse.ArgumentParser(prog="dllama-tpu serve")
+    ap.add_argument("--model", required=True)
+    ap.add_argument("--tokenizer", required=True)
+    ap.add_argument("--weights-float-type", default="q40", choices=sorted(_FT))
+    ap.add_argument("--buffer-float-type", default="f32", choices=sorted(_FT))
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=9990)
+    ap.add_argument("--slots", type=int, default=8,
+                    help="concurrent sequences (cache slots)")
+    ap.add_argument("--steps", type=int, default=64,
+                    help="default max new positions per request")
+    ap.add_argument("--temperature", type=float, default=0.8)
+    ap.add_argument("--topp", type=float, default=0.9)
+    ap.add_argument("--seed", type=int, default=None)
+    ap.add_argument("--tp", type=int, default=None,
+                    help="tensor-parallel ways (default: single chip)")
+    ap.add_argument("--kv-cache-dtype", default="f32",
+                    choices=("f32", "bf16"))
+    args = ap.parse_args(argv)
+    if args.slots < 1:
+        print(f"--slots must be positive, got {args.slots}", file=sys.stderr)
+        return 2
+
+    import jax.numpy as jnp
+
+    from ..io.loader import load_model
+    from ..io.tokenizer import Tokenizer
+    from ..parallel import make_mesh
+    from ..runtime.server import InferenceServer
+
+    spec, params = load_model(args.model,
+                              weights_float_type=_FT[args.weights_float_type],
+                              buffer_float_type=_FT[args.buffer_float_type])
+    tokenizer = Tokenizer(args.tokenizer, spec.vocab_size)
+    mesh = make_mesh(tp=args.tp) if args.tp and args.tp > 1 else None
+    seed = args.seed if args.seed is not None else int(time.time())
+    cache_dtype = jnp.bfloat16 if args.kv_cache_dtype == "bf16" else None
+    server = InferenceServer(spec, params, tokenizer, args.host, args.port,
+                             args.slots, args.steps, args.temperature,
+                             args.topp, seed, cache_dtype=cache_dtype,
+                             mesh=mesh)
+    print(f"🌐 serving on http://{args.host}:{server.port} "
+          f"({args.slots} slots, POST /generate, GET /health)")
+    server.serve_forever()
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if not argv or argv[0] in ("-h", "--help"):
-        print("usage: dllama-tpu {inference|worker|convert} [options]\n"
+        print("usage: dllama-tpu {inference|worker|serve|convert} [options]\n"
               f"{__doc__}")
         return 0 if argv else 1
     mode, rest = argv[0], argv[1:]
@@ -276,12 +326,14 @@ def main(argv: list[str] | None = None) -> int:
         return cmd_inference(rest)
     if mode == "worker":
         return cmd_worker(rest)
+    if mode == "serve":
+        return cmd_serve(rest)
     if mode == "convert":
         from ..convert import main as convert_main
 
         convert_main(rest)
         return 0
-    print(f"unknown mode {mode!r} (expected inference|worker|convert)",
+    print(f"unknown mode {mode!r} (expected inference|worker|serve|convert)",
           file=sys.stderr)
     return 1
 

@@ -24,6 +24,7 @@ output (the parity gate of tests/test_continuous.py).
 from __future__ import annotations
 
 import dataclasses
+import threading
 import time
 from typing import Any
 
@@ -35,18 +36,38 @@ from .sampling import Sampler
 
 
 @dataclasses.dataclass
-class _Slot:
-    req: int = -1            # request index, -1 = free
-    pos: int = 0             # this row's position clock
-    token: int = 0           # next input token
-    forced: list = dataclasses.field(default_factory=list)
+class Request:
+    """One generation request flowing through the slot pool.
+
+    ``tokens`` is the encoded prompt (BOS included, non-empty); optional
+    per-request sampling overrides fall back to the engine defaults. The
+    engine fills ``out`` and sets ``done`` when the request retires —
+    online callers (runtime/server.py) wait on it.
+    """
+    tokens: list
+    steps: int
+    temperature: float | None = None
+    topp: float | None = None
+    seed: int | None = None
     out: list = dataclasses.field(default_factory=list)
-    budget: int = 0          # max positions for this request
+    done: threading.Event = dataclasses.field(
+        default_factory=threading.Event)
+    index: int = -1  # submission order; assigned by submit()
+    error: str | None = None  # set (before done) if the engine failed it
+
+
+@dataclasses.dataclass
+class _Slot:
+    req: Request | None = None   # None = free
+    pos: int = 0                 # this row's position clock
+    token: int = 0               # next input token
+    forced: list = dataclasses.field(default_factory=list)
+    budget: int = 0              # max positions for this request
     sampler: Sampler | None = None
 
     @property
     def free(self) -> bool:
-        return self.req < 0
+        return self.req is None
 
 
 @dataclasses.dataclass
@@ -105,79 +126,140 @@ class ContinuousEngine:
             self._step = jax.jit(
                 functools.partial(forward_batch_ragged, spec),
                 donate_argnums=1)
+        self._pool = [_Slot() for _ in range(slots)]
+        self._queue: list[Request] = []
+        self._lock = threading.Lock()
+        self._submitted = 0
+        self.stats = ContinuousStats()
+
+    def submit(self, req: Request) -> Request:
+        """Queue a request (thread-safe; HTTP handler threads call this while
+        the scheduler thread steps). ``req.done`` fires when it retires."""
+        if not req.tokens:
+            raise ValueError("request has no prompt tokens")
+        with self._lock:
+            req.index = self._submitted
+            self._submitted += 1
+            self._queue.append(req)
+        return req
+
+    def step_once(self, quiet: bool = True) -> int:
+        """Admit queued requests, run ONE device step over the pool, and
+        retire finished rows. Returns the number of active slots after the
+        step (0 = idle: nothing queued, nothing in flight). Must be called
+        from a single scheduler thread; submit() may race freely."""
+        jnp = self.jnp
+        self._admit()
+        pool = self._pool
+        if all(s.free for s in pool):
+            return 0
+        tokens = jnp.asarray([s.token for s in pool], jnp.int32)
+        pos_vec = jnp.asarray([s.pos for s in pool], jnp.int32)
+        logits, self.cache = self._step(self.params, self.cache, tokens,
+                                        pos_vec)
+        logits = np.asarray(logits)
+        self.stats.steps += 1
+        self.stats.max_active = max(self.stats.max_active,
+                                    sum(not s.free for s in pool))
+        for i, s in enumerate(pool):
+            if s.free:
+                continue
+            if s.forced:
+                nxt = s.forced.pop(0)
+            else:
+                nxt = int(s.sampler.sample(logits[i]))
+            s.pos += 1
+            if nxt == BOS:  # reference stop: BOS before decoding it
+                self._retire(s, quiet)
+                continue
+            s.req.out.append(nxt)
+            self.stats.tokens += 1
+            s.token = nxt
+            if s.pos >= s.budget:
+                self._retire(s, quiet)
+        self._admit()
+        return sum(not s.free for s in pool)
+
+    def _admit(self):
+        spec = self.spec
+        for s in self._pool:
+            if not s.free:
+                continue
+            with self._lock:
+                if not self._queue:
+                    break
+                req = self._queue.pop(0)
+            s.req, s.pos = req, 0
+            s.token = req.tokens[0]
+            s.forced = list(req.tokens[1:])
+            s.budget = min(req.steps, spec.seq_len)
+            temp = (req.temperature if req.temperature is not None
+                    else self.temperature)
+            topp = req.topp if req.topp is not None else self.topp
+            seed = req.seed if req.seed is not None else self.seed + req.index
+            s.sampler = Sampler(spec.vocab_size, temp, topp, seed)
+
+    def _retire(self, s: _Slot, quiet: bool):
+        if not quiet:
+            print(f"[{s.req.index}] done: {len(s.req.out)} tokens "
+                  f"(pos {s.pos}/{s.budget})")
+        s.req.done.set()
+        s.req = None
+        # park the freed slot at pos 0: a retired row's clock can equal
+        # seq_len, and feeding that to the flash kernel would DMA one
+        # chunk past the end of the cache row (free slots still ride
+        # through the fixed-B step; their writes at pos 0 are dead until
+        # the slot is re-admitted, which restarts at pos 0 anyway)
+        s.pos, s.token = 0, 0
+
+    def fail_all(self, message: str):
+        """Fail every queued and in-flight request (scheduler error path —
+        runtime/server.py): sets ``error`` then ``done`` so waiters wake."""
+        with self._lock:
+            pending = self._queue
+            self._queue = []
+        for req in pending:
+            req.error = message
+            req.done.set()
+        for s in self._pool:
+            if not s.free:
+                s.req.error = message
+                self._retire(s, quiet=True)
 
     def run(self, requests: list[list[int]], steps: int,
             quiet: bool = True) -> tuple[list[list[int]], ContinuousStats]:
-        """Decode every request (a non-empty prompt token list, BOS included)
-        to BOS or ``steps`` positions; returns outputs in request order."""
-        jnp = self.jnp
-        spec = self.spec
+        """Offline entry: decode every request (a non-empty prompt token
+        list, BOS included) to BOS or ``steps`` positions; returns outputs
+        in request order."""
         for i, r in enumerate(requests):
             if not r:
                 raise ValueError(f"request {i} has no prompt tokens")
-        queue = list(range(len(requests)))
-        pool = [_Slot() for _ in range(self.slots)]
-        outs: list[list[int] | None] = [None] * len(requests)
-        stats = ContinuousStats()
+        self.stats = ContinuousStats()
+        with self._lock:
+            # per-run request indices: request i samples from seed + i, so a
+            # re-used engine reproduces the same streams run after run (the
+            # solo-parity contract in the module docstring); the counter
+            # keeps advancing monotonically only in online mode (server)
+            self._submitted = 0
+        reqs = [self.submit(Request(tokens=list(r), steps=steps))
+                for r in requests]
         t0 = time.perf_counter()
+        while self.step_once(quiet=quiet):
+            pass
+        self.stats.total_ms = (time.perf_counter() - t0) * 1000
+        assert all(r.done.is_set() for r in reqs)
+        return [r.out for r in reqs], self.stats
 
-        def admit():
-            for s in pool:
-                if s.free and queue:
-                    ri = queue.pop(0)
-                    prompt = requests[ri]
-                    s.req, s.pos = ri, 0
-                    s.token = prompt[0]
-                    s.forced = list(prompt[1:])
-                    s.out = []
-                    s.budget = min(steps, spec.seq_len)
-                    s.sampler = Sampler(spec.vocab_size, self.temperature,
-                                        self.topp, self.seed + ri)
 
-        def retire(s: _Slot):
-            outs[s.req] = s.out
-            if not quiet:
-                print(f"[{s.req}] done: {len(s.out)} tokens "
-                      f"(pos {s.pos}/{s.budget})")
-            s.req = -1
-            # park the freed slot at pos 0: a retired row's clock can equal
-            # seq_len, and feeding that to the flash kernel would DMA one
-            # chunk past the end of the cache row (free slots still ride
-            # through the fixed-B step; their writes at pos 0 are dead until
-            # the slot is re-admitted, which restarts at pos 0 anyway)
-            s.pos, s.token = 0, 0
-
-        admit()
-        while any(not s.free for s in pool):
-            tokens = jnp.asarray([s.token for s in pool], jnp.int32)
-            pos_vec = jnp.asarray([s.pos for s in pool], jnp.int32)
-            logits, self.cache = self._step(self.params, self.cache, tokens,
-                                            pos_vec)
-            logits = np.asarray(logits)
-            stats.steps += 1
-            stats.max_active = max(stats.max_active,
-                                   sum(not s.free for s in pool))
-            for i, s in enumerate(pool):
-                if s.free:
-                    continue
-                if s.forced:
-                    nxt = s.forced.pop(0)
-                else:
-                    nxt = int(s.sampler.sample(logits[i]))
-                s.pos += 1
-                if nxt == BOS:  # reference stop: BOS before decoding it
-                    retire(s)
-                    continue
-                s.out.append(nxt)
-                stats.tokens += 1
-                s.token = nxt
-                if s.pos >= s.budget:
-                    retire(s)
-            admit()
-
-        stats.total_ms = (time.perf_counter() - t0) * 1000
-        assert all(o is not None for o in outs)
-        return outs, stats
+def decode_stream(tokenizer, first_token: int, tokens: list[int]) -> str:
+    """Decode a generated token stream to text, chaining decode_piece's
+    prev-token context from the prompt's first token — the ONE decode loop
+    shared by the CLI row printer and the HTTP server."""
+    prev, text = first_token, b""
+    for t in tokens:
+        text += tokenizer.decode_piece(prev, t)
+        prev = t
+    return text.decode("utf-8", errors="replace")
 
 
 def generate_continuous(spec: TransformerSpec, params: dict[str, Any],
@@ -194,11 +276,7 @@ def generate_continuous(spec: TransformerSpec, params: dict[str, Any],
     outs, stats = eng.run(reqs, steps, quiet=quiet)
     for b, (req, row) in enumerate(zip(reqs, outs)):
         if not quiet:
-            prev, text = req[0], b""
-            for t in row:
-                text += tokenizer.decode_piece(prev, t)
-                prev = t
-            print(f"[{b}] {text.decode('utf-8', errors='replace')!r}")
+            print(f"[{b}] {decode_stream(tokenizer, req[0], row)!r}")
     if not quiet:
         print(f"Generated tokens:    {stats.tokens} across {len(reqs)} "
               f"requests ({slots} slots, {stats.steps} steps)")

@@ -1,0 +1,172 @@
+"""HTTP inference server on the continuous-batching engine.
+
+A minimal stdlib (http.server) API surface over runtime/continuous.py — the
+serving layer the reference lacks entirely (its only interface is the argv
+one-shot, main.cpp:38-63). Requests from concurrent clients stream through
+the slot pool: admission happens mid-flight between device steps, so a short
+request never waits for a long one to finish.
+
+Endpoints:
+  POST /generate  {"prompt": str, "steps"?: int, "temperature"?: float,
+                   "topp"?: float, "seed"?: int}
+               -> {"text": str, "tokens": [int], "steps": int}
+  GET  /health -> {"active": int, "queued": int, "slots": int,
+                   "steps": int, "generated_tokens": int}
+
+Threading model: http.server's ThreadingHTTPServer handles each connection
+on its own thread; handlers only encode, submit (thread-safe), and wait on
+the request's done event. ONE scheduler thread owns the device loop
+(ContinuousEngine.step_once), sleeping briefly when idle — the JAX step and
+all slot state stay single-threaded.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any
+
+from ..io.tokenizer import Tokenizer
+from ..models.spec import TransformerSpec
+from .continuous import ContinuousEngine, Request
+
+_IDLE_SLEEP_S = 0.002
+
+
+class InferenceServer:
+    """Owns the engine, the HTTP listener, and the scheduler thread."""
+
+    def __init__(self, spec: TransformerSpec, params: dict[str, Any],
+                 tokenizer: Tokenizer, host: str, port: int, slots: int,
+                 steps: int, temperature: float, topp: float, seed: int,
+                 cache_dtype=None, mesh=None, quiet: bool = False):
+        self.spec = spec
+        self.tokenizer = tokenizer
+        self.default_steps = steps
+        self.quiet = quiet
+        self.engine = ContinuousEngine(spec, params, slots, temperature,
+                                       topp, seed, cache_dtype=cache_dtype,
+                                       mesh=mesh)
+        self._shutdown = threading.Event()
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # quiet the per-request noise
+                if not server.quiet:
+                    print(f"🌐 {self.address_string()} {fmt % args}")
+
+            def _json(self, code: int, payload: dict):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path != "/health":
+                    return self._json(404, {"error": "unknown path"})
+                eng = server.engine
+                with eng._lock:
+                    queued = len(eng._queue)
+                self._json(200, {
+                    "active": sum(not s.free for s in eng._pool),
+                    "queued": queued,
+                    "slots": eng.slots,
+                    "steps": eng.stats.steps,
+                    "generated_tokens": eng.stats.tokens,
+                })
+
+            def do_POST(self):
+                if self.path != "/generate":
+                    return self._json(404, {"error": "unknown path"})
+                try:
+                    n = int(self.headers.get("Content-Length", "0"))
+                    payload = json.loads(self.rfile.read(n) or b"{}")
+                    req = server.make_request(payload)
+                except (ValueError, KeyError, TypeError) as e:
+                    return self._json(400, {"error": str(e)})
+                server.engine.submit(req)
+                req.done.wait()
+                if req.error is not None:
+                    return self._json(500, {"error": req.error})
+                text = server.decode(req)
+                self._json(200, {"text": text, "tokens": req.out,
+                                 "steps": len(req.out)})
+
+        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self._threads: list[threading.Thread] = []
+
+    @property
+    def port(self) -> int:
+        return self.httpd.server_address[1]
+
+    def make_request(self, payload: dict) -> Request:
+        if not isinstance(payload, dict):
+            raise ValueError("body must be a JSON object")
+        prompt = payload.get("prompt", "")
+        if not isinstance(prompt, str):
+            raise ValueError("prompt must be a string")
+        steps = int(payload.get("steps", self.default_steps))
+        if not 0 < steps <= self.spec.seq_len:
+            raise ValueError(
+                f"steps must be in 1..{self.spec.seq_len}, got {steps}")
+        temp = payload.get("temperature")
+        topp = payload.get("topp")
+        seed = payload.get("seed")
+        tokens = self.tokenizer.encode(prompt, bos=True, eos=False)
+        return Request(tokens=tokens, steps=steps,
+                       temperature=None if temp is None else float(temp),
+                       topp=None if topp is None else float(topp),
+                       seed=None if seed is None else int(seed))
+
+    def decode(self, req: Request) -> str:
+        from .continuous import decode_stream
+
+        return decode_stream(self.tokenizer, req.tokens[0], req.out)
+
+    def _scheduler(self):
+        while not self._shutdown.is_set():
+            try:
+                active = self.engine.step_once(quiet=self.quiet)
+            except Exception as e:
+                # a dead scheduler must not leave clients blocked forever:
+                # fail everything queued/in flight (handlers answer 500) and
+                # keep the loop alive — a persistent device fault just fails
+                # each subsequent request the same way
+                import traceback
+
+                traceback.print_exc()
+                print(f"🌐 scheduler step failed: {e!r}; failing pending "
+                      f"requests")
+                self.engine.fail_all(f"{type(e).__name__}: {e}")
+                time.sleep(0.1)
+                continue
+            if active == 0:
+                time.sleep(_IDLE_SLEEP_S)
+
+    def start(self):
+        """Start the scheduler + HTTP threads and return (non-blocking)."""
+        for target in (self._scheduler, self.httpd.serve_forever):
+            t = threading.Thread(target=target, daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def serve_forever(self):
+        self.start()
+        try:
+            while True:
+                time.sleep(1)
+        except KeyboardInterrupt:
+            pass
+        finally:
+            self.stop()
+
+    def stop(self):
+        self.httpd.shutdown()
+        self._shutdown.set()
+        for t in self._threads:
+            t.join(timeout=5)
+        self.httpd.server_close()

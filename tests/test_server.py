@@ -1,0 +1,150 @@
+"""HTTP inference server: concurrent clients through the slot pool must get
+the same outputs as solo engine runs."""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from distributed_llama_tpu.models.spec import TransformerSpec
+from distributed_llama_tpu.models.synth import synth_params
+
+SPEC = TransformerSpec(dim=64, hidden_dim=160, n_layers=2, n_heads=4,
+                       n_kv_heads=2, vocab_size=128, seq_len=16)
+
+
+class _IdTokenizer:
+    def encode(self, text, bos=True, eos=False):
+        return [1] + [3 + b for b in text.encode()]
+
+    def decode_piece(self, prev, tok):
+        return b"<%d>" % tok
+
+
+@pytest.fixture(scope="module")
+def params():
+    return synth_params(SPEC, q40=False, seed=4, scale=0.3)
+
+
+@pytest.fixture()
+def server(params):
+    from distributed_llama_tpu.runtime.server import InferenceServer
+
+    srv = InferenceServer(SPEC, params, _IdTokenizer(), "127.0.0.1", 0,
+                          slots=2, steps=8, temperature=0.0, topp=0.9,
+                          seed=5, quiet=True)
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def _post(port, payload):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/generate",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=60) as r:
+        return json.loads(r.read())
+
+
+def test_server_concurrent_matches_solo(server, params):
+    from distributed_llama_tpu.runtime.continuous import ContinuousEngine
+
+    prompts = ["ab", "x", "hello", "q"]
+    tok = _IdTokenizer()
+    solo = ContinuousEngine(SPEC, params, slots=1, temperature=0.0,
+                            topp=0.9, seed=99).run(
+        [tok.encode(p) for p in prompts], steps=8)[0]
+
+    results: dict[int, dict] = {}
+
+    def client(i):
+        results[i] = _post(server.port, {"prompt": prompts[i], "steps": 8})
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(len(prompts))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    for i in range(len(prompts)):
+        assert results[i]["tokens"] == solo[i], (i, results[i])
+        assert results[i]["text"] == "".join(
+            f"<{t}>" for t in solo[i])
+
+
+def test_server_per_request_sampling_params(server, params):
+    from distributed_llama_tpu.runtime.continuous import (ContinuousEngine,
+                                                          Request)
+
+    # a sampled request with explicit seed == engine run with that seed
+    eng = ContinuousEngine(SPEC, params, slots=1, temperature=0.0, topp=0.9,
+                           seed=0)
+    req = Request(tokens=_IdTokenizer().encode("ab"), steps=8,
+                  temperature=0.9, topp=0.9, seed=1234)
+    eng.submit(req)
+    while eng.step_once():
+        pass
+    got = _post(server.port, {"prompt": "ab", "steps": 8,
+                              "temperature": 0.9, "topp": 0.9, "seed": 1234})
+    assert got["tokens"] == req.out
+
+
+def test_server_scheduler_failure_returns_500(params):
+    """A device-step exception must fail pending requests with a 500, not
+    leave clients blocked forever on done.wait()."""
+    from distributed_llama_tpu.runtime.server import InferenceServer
+
+    srv = InferenceServer(SPEC, params, _IdTokenizer(), "127.0.0.1", 0,
+                          slots=2, steps=8, temperature=0.0, topp=0.9,
+                          seed=5, quiet=True)
+
+    def boom(*a, **k):
+        raise RuntimeError("injected device fault")
+
+    srv.engine._step = boom
+    srv.start()
+    try:
+        _post(srv.port, {"prompt": "ab", "steps": 4})
+        assert False, "expected 500"
+    except urllib.error.HTTPError as e:
+        assert e.code == 500
+        assert "injected device fault" in json.loads(e.read())["error"]
+    finally:
+        srv.stop()
+
+
+def test_engine_rerun_reproduces_streams(params):
+    """run() twice on ONE engine: per-run request indices keep the
+    seed + request_index contract, so streams are identical."""
+    from distributed_llama_tpu.runtime.continuous import ContinuousEngine
+
+    tok = _IdTokenizer()
+    reqs = [tok.encode("ab"), tok.encode("x")]
+    eng = ContinuousEngine(SPEC, params, slots=2, temperature=0.9, topp=0.9,
+                           seed=21)
+    first, _ = eng.run(reqs, steps=8)
+    second, _ = eng.run(reqs, steps=8)
+    assert first == second
+
+
+def test_server_health_and_errors(server):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/health", timeout=30) as r:
+        h = json.loads(r.read())
+    assert h["slots"] == 2 and h["active"] == 0
+
+    for payload, msg in (({"steps": 0}, "steps"),
+                         ({"steps": SPEC.seq_len + 1}, "steps"),
+                         ({"prompt": 7}, "prompt"),
+                         ({"steps": [1]}, ""),          # TypeError -> 400
+                         ({"temperature": {}}, "")):
+        try:
+            _post(server.port, payload)
+            assert False, f"expected 400 for {payload}"
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+            assert msg in json.loads(e.read())["error"]
