@@ -102,6 +102,11 @@ def cmd_inference(argv: list[str], quiet: bool = False) -> int:
                     help="KV cache precision: f32 = reference parity "
                          "(transformer.cpp:198-199), bf16 halves cache "
                          "memory and attention HBM traffic")
+    ap.add_argument("--prefill-chunk", type=int, default=0, metavar="N",
+                    help="process the prompt prefix in T=N chunked forward "
+                         "passes instead of one token at a time (same "
+                         "output stream; ~20x prompt tokens/s on TPU; no "
+                         "per-prompt-token stats lines)")
     ap.add_argument("--profile", default=None, metavar="DIR",
                     help="capture a jax.profiler device trace of the "
                          "generation into DIR (xprof/tensorboard format — "
@@ -222,12 +227,14 @@ def cmd_inference(argv: list[str], quiet: bool = False) -> int:
                                        args.prompt or "", args.steps,
                                        quiet=quiet, resume=resume,
                                        resume_prompt=(rest0 if resume
-                                                      else None))
+                                                      else None),
+                                       prefill_chunk=args.prefill_chunk)
         else:
             out, stats = generate(engine, tokenizer, sampler,
                                   args.prompt or "", args.steps, quiet=quiet,
                                   resume=resume,
-                                  resume_prompt=(rest0 if resume else None))
+                                  resume_prompt=(rest0 if resume else None),
+                                  prefill_chunk=args.prefill_chunk)
     if args.profile and not quiet:
         print(f"⏩ Profiler trace written to {args.profile}")
     if args.save_state:

@@ -74,6 +74,43 @@ class Engine:
                                        self.jnp.int32(pos))
         return np.asarray(logits[0])
 
+    def prefill(self, tokens: list[int], pos0: int = 0,
+                chunk: int = 128) -> None:
+        """Fill the KV cache for ``tokens`` at positions pos0.. in T=chunk
+        forward passes — the prompt fast path (the reference replays its
+        T=1 decode per prompt token, tokenizer.cpp:352-366; chunked T>1
+        runs ~20x the tokens/s on TPU because the matmuls become MXU work).
+
+        Chunks are FIXED-size (one XLA compilation): the tail pads with
+        token 0 and simply writes junk at positions past the real prefix.
+        That junk is invisible and short-lived — decode always writes cache
+        slot p before attending 0..p, so every padded slot is overwritten
+        before anything reads it. A padded window that would cross seq_len
+        is NOT issued (dynamic_update_slice would clamp the start and shift
+        the writes back over real positions); that tail runs as T=1 steps,
+        reusing the decode compilation. Logits are discarded; callers
+        continue with the next real token through the decode path.
+        """
+        jnp = self.jnp
+        seq_len = self.spec.seq_len
+        chunk = min(chunk, seq_len)
+
+        def fwd(part, start):
+            _, self.cache = self._fwd(self.params, self.cache,
+                                      jnp.asarray(part, jnp.int32),
+                                      jnp.int32(start))
+
+        for lo in range(0, len(tokens), chunk):
+            part = tokens[lo:lo + chunk]
+            start = pos0 + lo
+            if len(part) == chunk:
+                fwd(part, start)
+            elif start + chunk <= seq_len:
+                fwd(part + [0] * (chunk - len(part)), start)
+            else:  # padded window would cross seq_len: per-token tail
+                for i, t in enumerate(part):
+                    fwd([t], start + i)
+
     def decode_loop(self, steps: int, temperature: float, topp: float):
         """Compiled on-device generation loop for this engine (cached)."""
         from .decode import make_decode_loop
@@ -116,12 +153,47 @@ class GenStats:
         return self.total_ms / n, self.infer_ms / n, self.host_ms / n
 
 
+def _prefill_prefix(engine: Engine, prompt_tokens: list[int], steps: int,
+                    chunk: int, out_tokens: list[int],
+                    emit: Callable[[str], None] | None,
+                    tokenizer) -> int | None:
+    """Shared prefill gate for both loops: fill the cache for the prompt
+    prefix in T=chunk passes and echo the forced tokens into ``out_tokens``
+    (the loops append forced prompt tokens to the output — reference
+    behavior — so the prefilled region must appear there too).
+
+    Returns the start position for the decode loop (= len(prompt) - 1), or
+    None when prefill doesn't apply (short prompt, or prompt doesn't fit in
+    ``steps`` — then the per-token path keeps the reference's forced-token
+    output semantics exactly).
+    """
+    from ..io.tokenizer import BOS as _BOS
+
+    n_pre = len(prompt_tokens) - 1
+    if chunk <= 1 or n_pre < 2 or n_pre >= steps:
+        return None
+    if _BOS in prompt_tokens[1:]:
+        # a mid-stream BOS stops the per-token loop (tokenizer.cpp:376);
+        # only that path reproduces the truncated output
+        return None
+    engine.prefill(prompt_tokens[:n_pre], 0, chunk)
+    prev = prompt_tokens[0]
+    for t in prompt_tokens[1:n_pre + 1]:
+        out_tokens.append(t)
+        if emit is not None:
+            piece = tokenizer.decode_piece(prev, t)
+            emit(piece.decode("utf-8", errors="replace"))
+        prev = t
+    return n_pre
+
+
 def generate(engine: Engine, tokenizer: Tokenizer, sampler: Sampler,
              prompt: str, steps: int,
              emit: Callable[[str], None] | None = None,
              quiet: bool = False,
              resume: tuple[int, int] | None = None,
-             resume_prompt: list[int] | None = None) -> tuple[list[int], GenStats]:
+             resume_prompt: list[int] | None = None,
+             prefill_chunk: int = 0) -> tuple[list[int], GenStats]:
     """Reference generation loop (tokenizer.cpp:321-394).
 
     Encodes the prompt with BOS (no EOS), forces prompt tokens, samples after,
@@ -133,8 +205,15 @@ def generate(engine: Engine, tokenizer: Tokenizer, sampler: Sampler,
     (``resume_prompt`` carries any prompt tail the interrupted run had not
     yet consumed — GenStats.prompt_rest), and up to ``steps`` more positions
     run.
+
+    ``prefill_chunk > 1`` fills the cache for the prompt prefix in chunked
+    T>1 passes (Engine.prefill) instead of forcing tokens through the T=1
+    decode path — the same output token stream, minus the per-prompt-token
+    stats lines (those positions never run the loop; stats cover the decode
+    phase).
     """
     spec = engine.spec
+    out_tokens: list[int] = []
     if resume is not None:
         start_pos, token = resume
         # re-anchor the unconsumed prompt tail at absolute positions: the
@@ -148,10 +227,13 @@ def generate(engine: Engine, tokenizer: Tokenizer, sampler: Sampler,
             raise ValueError(
                 "something is wrong, expected at least 1 prompt token")
         token = prompt_tokens[0]
+        pre = _prefill_prefix(engine, prompt_tokens, steps, prefill_chunk,
+                              out_tokens, emit, tokenizer)
+        if pre is not None:
+            start_pos, token = pre, prompt_tokens[pre]
 
     comm = engine.comm_stats()
     stats = GenStats(final_pos=start_pos, final_token=token)
-    out_tokens: list[int] = []
     pos = start_pos
     while pos < steps:
         t0 = time.perf_counter()
@@ -286,8 +368,8 @@ def generate_fast(engine: Engine, tokenizer: Tokenizer, sampler: Sampler,
                   prompt: str, steps: int,
                   quiet: bool = False,
                   resume: tuple[int, int] | None = None,
-                  resume_prompt: list[int] | None = None
-                  ) -> tuple[list[int], GenStats]:
+                  resume_prompt: list[int] | None = None,
+                  prefill_chunk: int = 0) -> tuple[list[int], GenStats]:
     """The fused-loop generation path: one device program for the whole chain.
 
     Same observable token stream as generate() (forced prompt, reference
@@ -300,8 +382,13 @@ def generate_fast(engine: Engine, tokenizer: Tokenizer, sampler: Sampler,
     runtime/checkpoint.py, ``resume_prompt`` is the unconsumed prompt tail,
     up to ``steps`` more positions run) — the scan simply starts its
     position clock at ``pos``.
+
+    ``prefill_chunk > 1``: the prompt prefix fills the cache in chunked
+    T>1 passes (Engine.prefill) and the fused chain starts at the last
+    prompt token — same output stream, far less time on long prompts.
     """
     spec = engine.spec
+    pre_out: list[int] = []
     if resume is not None:
         start_pos, first = resume
         # the loop's forced stream is relative to the chain: [first] + tail
@@ -314,6 +401,16 @@ def generate_fast(engine: Engine, tokenizer: Tokenizer, sampler: Sampler,
         if not prompt_tokens:
             raise ValueError(
                 "something is wrong, expected at least 1 prompt token")
+        emit_fn = None if quiet else (
+            lambda s: print(s, end="", flush=True))
+        pre = _prefill_prefix(engine, prompt_tokens, steps, prefill_chunk,
+                              pre_out, emit_fn, tokenizer)
+        if pre is not None:
+            # chain takes over at the last prompt token; its forced stream
+            # is empty (relative prompt = [prompt[-1]]), clock starts at pre
+            start_pos = pre
+            prompt_tokens = prompt_tokens[pre:]
+            steps = steps - pre
     prompt_tail = prompt_tokens[steps + 1:]  # beyond this chain: resume tail
     if len(prompt_tokens) > steps + 1:
         prompt_tokens = prompt_tokens[:steps + 1]
@@ -345,7 +442,7 @@ def generate_fast(engine: Engine, tokenizer: Tokenizer, sampler: Sampler,
     toks = np.asarray(toks)
     total_ms = (time.perf_counter() - t0) * 1000
 
-    out_tokens: list[int] = []
+    out_tokens: list[int] = list(pre_out)  # prefilled prompt echo, if any
     prev = prompt_tokens[0]
     for t in map(int, toks):
         if t == BOS:
@@ -355,19 +452,24 @@ def generate_fast(engine: Engine, tokenizer: Tokenizer, sampler: Sampler,
             piece = tokenizer.decode_piece(prev, t)
             print(piece.decode("utf-8", errors="replace"), end="", flush=True)
         prev = t
+    # all chain accounting is in CHAIN terms: out_tokens also carries the
+    # prefill-echoed prompt tokens, which the chain never produced
+    chain_generated = len(out_tokens) - len(pre_out)
     # advance the sampler's real stream by only the coins the per-step loop
     # would have consumed: one per SAMPLED iteration, including the one that
     # produced a terminating BOS (the loop breaks after drawing it)
     if n_sampled > 0 and sampler.temperature != 0.0:
-        early_bos = len(out_tokens) < steps
-        last_iter = len(out_tokens) if early_bos else steps - 1
+        early_bos = chain_generated < steps
+        last_iter = chain_generated if early_bos else steps - 1
         consumed = max(0, last_iter - (len(prompt_tokens) - 1) + 1)
         if consumed:
             sampler.rng.f32_array(min(consumed, n_sampled))
-    n = max(1, len(out_tokens))
-    stats = GenStats(tokens=len(out_tokens), total_ms=total_ms,
+    # stats cover the timed fused chain (like generate()'s loop iterations;
+    # the prefill phase is separate work and would deflate ms/token)
+    n = max(1, chain_generated)
+    stats = GenStats(tokens=chain_generated, total_ms=total_ms,
                      infer_ms=total_ms, host_ms=0.0)
-    if len(toks) and len(out_tokens) == len(toks):  # no early BOS: resumable
+    if len(toks) and chain_generated == len(toks):  # no early BOS: resumable
         stats.final_pos, stats.final_token = start_pos + steps, int(toks[-1])
         stats.prompt_rest = prompt_tail
     if not quiet:
