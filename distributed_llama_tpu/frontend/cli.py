@@ -185,7 +185,8 @@ def cmd_inference(argv: list[str], quiet: bool = False) -> int:
             generate_continuous(spec, params, tokenizer, prompts, args.steps,
                                 args.temperature, args.topp, seed,
                                 slots=args.slots, cache_dtype=cache_dtype,
-                                mesh=mesh, quiet=quiet)
+                                mesh=mesh, quiet=quiet,
+                                prefill_chunk=args.prefill_chunk)
             return 0
         from ..runtime.generate import generate_batch
 
@@ -293,6 +294,10 @@ def cmd_serve(argv: list[str]) -> int:
                     help="tensor-parallel ways (default: single chip)")
     ap.add_argument("--kv-cache-dtype", default="f32",
                     choices=("f32", "bf16"))
+    ap.add_argument("--prefill-chunk", type=int, default=128, metavar="N",
+                    help="admission prefill: fill a new request's prompt "
+                         "in T=N chunked passes (0/1 disables; single-chip "
+                         "engines only)")
     args = ap.parse_args(argv)
     if args.slots < 1:
         print(f"--slots must be positive, got {args.slots}", file=sys.stderr)
@@ -315,7 +320,7 @@ def cmd_serve(argv: list[str]) -> int:
     server = InferenceServer(spec, params, tokenizer, args.host, args.port,
                              args.slots, args.steps, args.temperature,
                              args.topp, seed, cache_dtype=cache_dtype,
-                             mesh=mesh)
+                             mesh=mesh, prefill_chunk=args.prefill_chunk)
     print(f"🌐 serving on http://{args.host}:{server.port} "
           f"({args.slots} slots, POST /generate, GET /health)")
     server.serve_forever()

@@ -91,7 +91,7 @@ class ContinuousEngine:
 
     def __init__(self, spec: TransformerSpec, params: dict[str, Any],
                  slots: int, temperature: float, topp: float, seed: int,
-                 cache_dtype=None, mesh=None):
+                 cache_dtype=None, mesh=None, prefill_chunk: int = 0):
         import functools
 
         import jax
@@ -106,7 +106,9 @@ class ContinuousEngine:
         self.topp = topp
         self.seed = seed
         self.jnp = jnp
+        self.prefill_chunk = prefill_chunk
         dtype = cache_dtype or jnp.float32
+        self._cache_dtype = dtype
         if mesh is not None and (mesh.shape["tp"] > 1
                                  or mesh.shape.get("sp", 1) > 1):
             # tensor-parallel step: same sharded program as the lockstep
@@ -121,11 +123,31 @@ class ContinuousEngine:
                 init_cache_batch(spec, slots, dtype), mesh)
             self._step = make_sharded_forward_batch(spec, mesh)
         else:
+            from ..models.llama import KVCache, forward
+
             self.params = params_to_device(params)
             self.cache = init_cache_batch(spec, slots, dtype)
             self._step = jax.jit(
                 functools.partial(forward_batch_ragged, spec),
                 donate_argnums=1)
+            if prefill_chunk > 1:
+                # admission prefill (single-chip only): single-sequence
+                # T=chunk forward into a scratch cache + plane insert
+                self._prefill_fwd = jax.jit(functools.partial(forward, spec),
+                                            donate_argnums=1)
+
+                def _insert(cache_b, c1, b):
+                    # write sequence-cache planes (L, S, kv, hs) into row b
+                    # of the batched (L, B, S, kv, hs) cache, in place
+                    return KVCache(
+                        jax.lax.dynamic_update_slice(
+                            cache_b.k, c1.k[:, None], (0, b, 0, 0, 0)),
+                        jax.lax.dynamic_update_slice(
+                            cache_b.v, c1.v[:, None], (0, b, 0, 0, 0)))
+
+                # donate only the batched cache (updated in place); the
+                # scratch sequence cache can't alias the rank-5 output
+                self._insert = jax.jit(_insert, donate_argnums=0)
         self._pool = [_Slot() for _ in range(slots)]
         self._queue: list[Request] = []
         self._lock = threading.Lock()
@@ -182,7 +204,7 @@ class ContinuousEngine:
 
     def _admit(self):
         spec = self.spec
-        for s in self._pool:
+        for slot_index, s in enumerate(self._pool):
             if not s.free:
                 continue
             with self._lock:
@@ -198,6 +220,46 @@ class ContinuousEngine:
             topp = req.topp if req.topp is not None else self.topp
             seed = req.seed if req.seed is not None else self.seed + req.index
             s.sampler = Sampler(spec.vocab_size, temp, topp, seed)
+            self._maybe_prefill_slot(slot_index, s)
+
+    def _maybe_prefill_slot(self, slot_index: int, s: _Slot):
+        """Admission prefill: fill the slot's cache rows for the prompt
+        prefix in T=chunk single-sequence passes (Engine.prefill's scheme:
+        fixed chunks, pad-safe, junk-invisible) and park the slot at the
+        last prompt token — long prompts stop crawling through per-token
+        steps. Same gates as generate._prefill_prefix: off for short
+        prompts, prompts that exceed the budget (the forced-echo output is
+        load-bearing), or a mid-stream BOS (only the step loop reproduces
+        that early stop)."""
+        chunk = self.prefill_chunk
+        tokens = s.req.tokens
+        n_pre = len(tokens) - 1
+        if (getattr(self, "_prefill_fwd", None) is None or chunk <= 1
+                or n_pre < 2 or n_pre >= s.budget or BOS in tokens[1:]):
+            return
+        from ..models.llama import init_cache
+        from .generate import run_chunked_prefill
+
+        jnp = self.jnp
+        cache_box = [init_cache(self.spec, self._cache_dtype)]
+
+        def fwd(part, start):
+            _, cache_box[0] = self._prefill_fwd(
+                self.params, cache_box[0], jnp.asarray(part, jnp.int32),
+                jnp.int32(start))
+
+        run_chunked_prefill(fwd, tokens[:n_pre], 0, chunk,
+                            self.spec.seq_len)
+        self.cache = self._insert(self.cache, cache_box[0],
+                                  jnp.int32(slot_index))
+        # echo the prefilled prompt tokens into the output AND the token
+        # count (the step loop both appends forced tokens and counts them —
+        # "Generated tokens" must not change meaning with the toggle)
+        s.req.out.extend(tokens[1:n_pre + 1])
+        self.stats.tokens += n_pre
+        s.pos = n_pre
+        s.token = tokens[n_pre]
+        s.forced = []
 
     def _retire(self, s: _Slot, quiet: bool):
         if not quiet:
@@ -266,13 +328,14 @@ def generate_continuous(spec: TransformerSpec, params: dict[str, Any],
                         tokenizer, prompts: list[str], steps: int,
                         temperature: float, topp: float, seed: int,
                         slots: int = 0, cache_dtype=None, mesh=None,
-                        quiet: bool = False):
+                        prefill_chunk: int = 0, quiet: bool = False):
     """CLI entry: encode prompts, stream them through a slot pool, print
     rows in the --prompts-file format ("[i] 'text'")."""
     reqs = [tokenizer.encode(p or "", bos=True, eos=False) for p in prompts]
     slots = slots or min(len(reqs), 8)
     eng = ContinuousEngine(spec, params, slots, temperature, topp, seed,
-                           cache_dtype=cache_dtype, mesh=mesh)
+                           cache_dtype=cache_dtype, mesh=mesh,
+                           prefill_chunk=prefill_chunk)
     outs, stats = eng.run(reqs, steps, quiet=quiet)
     for b, (req, row) in enumerate(zip(reqs, outs)):
         if not quiet:

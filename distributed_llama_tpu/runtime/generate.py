@@ -92,24 +92,13 @@ class Engine:
         continue with the next real token through the decode path.
         """
         jnp = self.jnp
-        seq_len = self.spec.seq_len
-        chunk = min(chunk, seq_len)
 
         def fwd(part, start):
             _, self.cache = self._fwd(self.params, self.cache,
                                       jnp.asarray(part, jnp.int32),
                                       jnp.int32(start))
 
-        for lo in range(0, len(tokens), chunk):
-            part = tokens[lo:lo + chunk]
-            start = pos0 + lo
-            if len(part) == chunk:
-                fwd(part, start)
-            elif start + chunk <= seq_len:
-                fwd(part + [0] * (chunk - len(part)), start)
-            else:  # padded window would cross seq_len: per-token tail
-                for i, t in enumerate(part):
-                    fwd([t], start + i)
+        run_chunked_prefill(fwd, tokens, pos0, chunk, self.spec.seq_len)
 
     def decode_loop(self, steps: int, temperature: float, topp: float):
         """Compiled on-device generation loop for this engine (cached)."""
@@ -133,6 +122,27 @@ class Engine:
         sp_st = sp_lse_bytes(self.spec, self.sp, self.tp)
         return CommStats(tp_st.sent_bytes + sp_st.sent_bytes,
                          tp_st.recv_bytes + sp_st.recv_bytes)
+
+
+def run_chunked_prefill(fwd, tokens: list[int], pos0: int, chunk: int,
+                        seq_len: int) -> None:
+    """The ONE fixed-chunk prefill schedule, shared by Engine.prefill and
+    the continuous engine's admission prefill: full T=chunk windows, a
+    zero-padded partial window when it stays inside seq_len, and a per-token
+    tail when padding would cross seq_len (dynamic_update_slice would clamp
+    the start and shift writes over real positions). ``fwd(part, start)``
+    runs one forward pass and owns the cache state."""
+    chunk = min(chunk, seq_len)
+    for lo in range(0, len(tokens), chunk):
+        part = tokens[lo:lo + chunk]
+        start = pos0 + lo
+        if len(part) == chunk:
+            fwd(part, start)
+        elif start + chunk <= seq_len:
+            fwd(part + [0] * (chunk - len(part)), start)
+        else:  # padded window would cross seq_len: per-token tail
+            for i, t in enumerate(part):
+                fwd([t], start + i)
 
 
 @dataclasses.dataclass

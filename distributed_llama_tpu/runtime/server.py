@@ -41,14 +41,16 @@ class InferenceServer:
     def __init__(self, spec: TransformerSpec, params: dict[str, Any],
                  tokenizer: Tokenizer, host: str, port: int, slots: int,
                  steps: int, temperature: float, topp: float, seed: int,
-                 cache_dtype=None, mesh=None, quiet: bool = False):
+                 cache_dtype=None, mesh=None, prefill_chunk: int = 0,
+                 quiet: bool = False):
         self.spec = spec
         self.tokenizer = tokenizer
         self.default_steps = steps
         self.quiet = quiet
         self.engine = ContinuousEngine(spec, params, slots, temperature,
                                        topp, seed, cache_dtype=cache_dtype,
-                                       mesh=mesh)
+                                       mesh=mesh,
+                                       prefill_chunk=prefill_chunk)
         self._shutdown = threading.Event()
         server = self
 
