@@ -54,6 +54,11 @@ class Request:
         default_factory=threading.Event)
     index: int = -1  # submission order; assigned by submit()
     error: str | None = None  # set (before done) if the engine failed it
+    cancelled: bool = False  # consumer gone: retire at the next step
+    # streaming hook: called from the scheduler thread with each token as it
+    # lands in ``out`` (prompt echoes included, prefill echoes in one burst);
+    # must be fast and must not raise — it runs inside the decode loop
+    on_token: Any = None
 
 
 @dataclasses.dataclass
@@ -186,6 +191,9 @@ class ContinuousEngine:
         for i, s in enumerate(pool):
             if s.free:
                 continue
+            if s.req.cancelled:  # consumer gone: free the slot now
+                self._retire(s, quiet)
+                continue
             if s.forced:
                 nxt = s.forced.pop(0)
             else:
@@ -195,6 +203,7 @@ class ContinuousEngine:
                 self._retire(s, quiet)
                 continue
             s.req.out.append(nxt)
+            self._notify(s.req, nxt)
             self.stats.tokens += 1
             s.token = nxt
             if s.pos >= s.budget:
@@ -207,10 +216,15 @@ class ContinuousEngine:
         for slot_index, s in enumerate(self._pool):
             if not s.free:
                 continue
-            with self._lock:
-                if not self._queue:
-                    break
-                req = self._queue.pop(0)
+            req = None
+            while req is None:
+                with self._lock:
+                    if not self._queue:
+                        return
+                    req = self._queue.pop(0)
+                if req.cancelled:  # consumer gone before admission
+                    req.done.set()
+                    req = None
             s.req, s.pos = req, 0
             s.token = req.tokens[0]
             s.forced = list(req.tokens[1:])
@@ -256,10 +270,22 @@ class ContinuousEngine:
         # count (the step loop both appends forced tokens and counts them —
         # "Generated tokens" must not change meaning with the toggle)
         s.req.out.extend(tokens[1:n_pre + 1])
+        for t in tokens[1:n_pre + 1]:
+            self._notify(s.req, t)
         self.stats.tokens += n_pre
         s.pos = n_pre
         s.token = tokens[n_pre]
         s.forced = []
+
+    @staticmethod
+    def _notify(req: Request, token: int):
+        """Streaming hook dispatch — exceptions must never reach the
+        scheduler loop (a broken client is that client's problem)."""
+        if req.on_token is not None:
+            try:
+                req.on_token(token)
+            except Exception:
+                req.on_token = None  # stop notifying a broken consumer
 
     def _retire(self, s: _Slot, quiet: bool):
         if not quiet:

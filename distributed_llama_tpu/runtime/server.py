@@ -8,8 +8,11 @@ request never waits for a long one to finish.
 
 Endpoints:
   POST /generate  {"prompt": str, "steps"?: int, "temperature"?: float,
-                   "topp"?: float, "seed"?: int}
+                   "topp"?: float, "seed"?: int, "stream"?: bool}
                -> {"text": str, "tokens": [int], "steps": int}
+               or, with "stream": true, chunked newline-delimited JSON:
+               one {"token": int, "piece": str} line per token as it
+               decodes, then a final {"done": true, "text": ..., "steps": N}
   GET  /health -> {"active": int, "queued": int, "slots": int,
                    "steps": int, "generated_tokens": int}
 
@@ -55,6 +58,12 @@ class InferenceServer:
         server = self
 
         class Handler(BaseHTTPRequestHandler):
+            # HTTP/1.1 is required for Transfer-Encoding: chunked — on a
+            # /1.0 status line RFC-compliant clients (curl) do not de-chunk
+            # and would see raw chunk framing; the non-streaming path is
+            # fine either way (it always sends Content-Length)
+            protocol_version = "HTTP/1.1"
+
             def log_message(self, fmt, *args):  # quiet the per-request noise
                 if not server.quiet:
                     print(f"🌐 {self.address_string()} {fmt % args}")
@@ -87,9 +96,12 @@ class InferenceServer:
                 try:
                     n = int(self.headers.get("Content-Length", "0"))
                     payload = json.loads(self.rfile.read(n) or b"{}")
+                    stream = bool(payload.get("stream", False))
                     req = server.make_request(payload)
                 except (ValueError, KeyError, TypeError) as e:
                     return self._json(400, {"error": str(e)})
+                if stream:
+                    return self._stream(req)
                 server.engine.submit(req)
                 req.done.wait()
                 if req.error is not None:
@@ -97,6 +109,60 @@ class InferenceServer:
                 text = server.decode(req)
                 self._json(200, {"text": text, "tokens": req.out,
                                  "steps": len(req.out)})
+
+            def _stream(self, req):
+                """Chunked newline-delimited JSON, one line per token.
+
+                The scheduler thread only enqueues (on_token must never
+                block the decode loop on a slow client socket); THIS
+                handler thread drains the queue and does the blocking
+                writes.
+                """
+                import queue
+
+                q: queue.Queue = queue.Queue()
+                req.on_token = q.put
+                self.send_response(200)
+                self.send_header("Content-Type", "application/x-ndjson")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+
+                def chunk(obj):
+                    body = (json.dumps(obj) + "\n").encode()
+                    self.wfile.write(f"{len(body):x}\r\n".encode() + body
+                                     + b"\r\n")
+                    self.wfile.flush()
+
+                server.engine.submit(req)
+                prev = req.tokens[0]
+                sent = 0
+                try:
+                    while True:
+                        try:
+                            tok = q.get(timeout=0.1)
+                        except queue.Empty:
+                            if req.done.is_set() and sent == len(req.out):
+                                break
+                            continue
+                        piece = server.tokenizer.decode_piece(prev, tok)
+                        prev = tok
+                        sent += 1
+                        chunk({"token": tok,
+                               "piece": piece.decode("utf-8",
+                                                     errors="replace")})
+                    if req.error is not None:
+                        chunk({"done": True, "error": req.error})
+                    else:
+                        chunk({"done": True, "text": server.decode(req),
+                               "steps": len(req.out)})
+                    self.wfile.write(b"0\r\n\r\n")
+                    self.wfile.flush()
+                except OSError:
+                    # client went away mid-stream: stop notifying and tell
+                    # the scheduler to free the slot instead of decoding
+                    # the rest of the budget for nobody
+                    req.on_token = None
+                    req.cancelled = True
 
         self.httpd = ThreadingHTTPServer((host, port), Handler)
         self._threads: list[threading.Thread] = []

@@ -93,6 +93,84 @@ def test_server_per_request_sampling_params(server, params):
     assert got["tokens"] == req.out
 
 
+def test_server_streaming_matches_solo(server, params):
+    """stream:true returns one NDJSON line per token then a done line; the
+    token sequence equals the non-streaming response."""
+    from distributed_llama_tpu.runtime.continuous import ContinuousEngine
+
+    tok = _IdTokenizer()
+    solo = ContinuousEngine(SPEC, params, slots=1, temperature=0.0,
+                            topp=0.9, seed=99).run(
+        [tok.encode("hello")], steps=8)[0][0]
+
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{server.port}/generate",
+        data=json.dumps({"prompt": "hello", "steps": 8,
+                         "stream": True}).encode())
+    with urllib.request.urlopen(req, timeout=60) as r:
+        assert r.headers["Content-Type"] == "application/x-ndjson"
+        lines = [json.loads(ln) for ln in r if ln.strip()]
+    assert lines[-1]["done"] is True
+    toks = [ln["token"] for ln in lines[:-1]]
+    assert toks == solo
+    assert lines[-1]["text"] == "".join(f"<{t}>" for t in solo)
+    assert "".join(ln["piece"] for ln in lines[:-1]) == lines[-1]["text"]
+
+
+def test_server_streaming_with_admission_prefill(params):
+    """The serve default (prefill_chunk on): the prompt-echo burst from
+    admission prefill must stream in order, pieces chained correctly."""
+    from distributed_llama_tpu.runtime.continuous import ContinuousEngine
+    from distributed_llama_tpu.runtime.server import InferenceServer
+
+    tok = _IdTokenizer()
+    solo = ContinuousEngine(SPEC, params, slots=1, temperature=0.0,
+                            topp=0.9, seed=99).run(
+        [tok.encode("hello")], steps=8)[0][0]
+
+    srv = InferenceServer(SPEC, params, tok, "127.0.0.1", 0, slots=2,
+                          steps=8, temperature=0.0, topp=0.9, seed=5,
+                          prefill_chunk=2, quiet=True)
+    srv.start()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/generate",
+            data=json.dumps({"prompt": "hello", "steps": 8,
+                             "stream": True}).encode())
+        with urllib.request.urlopen(req, timeout=60) as r:
+            lines = [json.loads(ln) for ln in r if ln.strip()]
+    finally:
+        srv.stop()
+    assert [ln["token"] for ln in lines[:-1]] == solo
+    assert "".join(ln["piece"] for ln in lines[:-1]) == lines[-1]["text"]
+
+
+def test_server_stream_disconnect_frees_slot(server):
+    """A client that vanishes mid-stream must not keep the slot decoding to
+    its full budget: the request gets cancelled and the pool drains."""
+    import http.client
+    import time
+
+    conn = http.client.HTTPConnection("127.0.0.1", server.port, timeout=30)
+    conn.request("POST", "/generate",
+                 body=json.dumps({"prompt": "hello",
+                                  "steps": SPEC.seq_len,
+                                  "stream": True}))
+    resp = conn.getresponse()
+    resp.read(1)  # first bytes arrived: the request is in a slot
+    conn.close()  # vanish mid-stream
+
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/health", timeout=30) as r:
+            h = json.loads(r.read())
+        if h["active"] == 0 and h["queued"] == 0:
+            break
+        time.sleep(0.05)
+    assert h["active"] == 0 and h["queued"] == 0, h
+
+
 def test_server_scheduler_failure_returns_500(params):
     """A device-step exception must fail pending requests with a 500, not
     leave clients blocked forever on done.wait()."""
