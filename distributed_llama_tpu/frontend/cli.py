@@ -97,6 +97,10 @@ def cmd_inference(argv: list[str], quiet: bool = False) -> int:
     ap.add_argument("--slots", type=int, default=0,
                     help="continuous-batching slot count (default: "
                          "min(#prompts, 8))")
+    ap.add_argument("--block-steps", type=int, default=1, metavar="K",
+                    help="with --continuous: fuse K decode steps into one "
+                         "device dispatch (admission/retirement at chain "
+                         "boundaries; cuts host round-trips Kx)")
     ap.add_argument("--kv-cache-dtype", default="f32",
                     choices=("f32", "bf16"),
                     help="KV cache precision: f32 = reference parity "
@@ -186,7 +190,8 @@ def cmd_inference(argv: list[str], quiet: bool = False) -> int:
                                 args.temperature, args.topp, seed,
                                 slots=args.slots, cache_dtype=cache_dtype,
                                 mesh=mesh, quiet=quiet,
-                                prefill_chunk=args.prefill_chunk)
+                                prefill_chunk=args.prefill_chunk,
+                                block_steps=args.block_steps)
             return 0
         from ..runtime.generate import generate_batch
 
@@ -298,6 +303,11 @@ def cmd_serve(argv: list[str]) -> int:
                     help="admission prefill: fill a new request's prompt "
                          "in T=N chunked passes (0/1 disables; single-chip "
                          "engines only)")
+    ap.add_argument("--block-steps", type=int, default=1, metavar="K",
+                    help="fuse K decode steps into one device dispatch "
+                         "(admission + per-token streaming at chain "
+                         "boundaries; cuts host round-trips Kx — set 8-16 "
+                         "on remote/high-latency runtimes)")
     args = ap.parse_args(argv)
     if args.slots < 1:
         print(f"--slots must be positive, got {args.slots}", file=sys.stderr)
@@ -320,7 +330,8 @@ def cmd_serve(argv: list[str]) -> int:
     server = InferenceServer(spec, params, tokenizer, args.host, args.port,
                              args.slots, args.steps, args.temperature,
                              args.topp, seed, cache_dtype=cache_dtype,
-                             mesh=mesh, prefill_chunk=args.prefill_chunk)
+                             mesh=mesh, prefill_chunk=args.prefill_chunk,
+                             block_steps=args.block_steps)
     print(f"🌐 serving on http://{args.host}:{server.port} "
           f"({args.slots} slots, POST /generate, GET /health)")
     server.serve_forever()

@@ -96,7 +96,8 @@ class ContinuousEngine:
 
     def __init__(self, spec: TransformerSpec, params: dict[str, Any],
                  slots: int, temperature: float, topp: float, seed: int,
-                 cache_dtype=None, mesh=None, prefill_chunk: int = 0):
+                 cache_dtype=None, mesh=None, prefill_chunk: int = 0,
+                 block_steps: int = 1):
         import functools
 
         import jax
@@ -112,6 +113,7 @@ class ContinuousEngine:
         self.seed = seed
         self.jnp = jnp
         self.prefill_chunk = prefill_chunk
+        self.block_steps = block_steps  # >1: fused K-step chains (step_many)
         dtype = cache_dtype or jnp.float32
         self._cache_dtype = dtype
         if mesh is not None and (mesh.shape["tp"] > 1
@@ -157,7 +159,121 @@ class ContinuousEngine:
         self._queue: list[Request] = []
         self._lock = threading.Lock()
         self._submitted = 0
+        self._chains: dict = {}  # (k, greedy_only) -> fused chain program
         self.stats = ContinuousStats()
+
+    def _chain(self, k: int, greedy_only: bool):
+        """Build (and cache) the fused K-step device program: K ragged
+        decode steps in ONE dispatch, with per-row active masks so rows
+        freeze in place the moment they hit BOS or their budget (a frozen
+        row keeps rewriting the same k/v at its frozen position — identical
+        values, harmless). Admission/retirement happen on the host BETWEEN
+        chains (admission latency <= k steps, the documented trade for
+        k fewer host round-trips)."""
+        import jax
+        import jax.numpy as jnp
+
+        key = (k, greedy_only)
+        if key in self._chains:
+            return self._chains[key]
+
+        from .decode import sample_device_dynamic
+
+        step = self._step
+
+        def chain(params, cache, tokens, pos, active, budget, forced,
+                  coins, temps, topps):
+            def body(carry, xs):
+                tokens, pos, active, cache = carry
+                forced_i, coins_i = xs                      # (B,), (B,)
+                logits, cache = step(params, cache, tokens, pos)
+                if greedy_only:
+                    sampled = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                else:
+                    sampled = jax.vmap(sample_device_dynamic)(
+                        logits, coins_i, temps, topps)
+                nxt = jnp.where(forced_i >= 0, forced_i, sampled)
+                rec_active = active
+                new_active = (active & (nxt != BOS)
+                              & (pos + 1 < budget))
+                pos = jnp.where(new_active, pos + 1, pos)
+                tokens = jnp.where(new_active, nxt, tokens)
+                return (tokens, pos, new_active, cache), (nxt, rec_active)
+
+            (_, _, _, cache), (toks, acts) = jax.lax.scan(
+                body, (tokens, pos, active, cache), (forced, coins))
+            return cache, toks, acts                       # ys: (K, B)
+
+        self._chains[key] = jax.jit(chain, donate_argnums=1)
+        return self._chains[key]
+
+    def step_many(self, k: int, quiet: bool = True) -> int:
+        """Like ``k`` step_once calls in ONE device dispatch. Per-request
+        token streams are identical to the per-step path (the parity gate);
+        only scheduling differs: a slot freed mid-chain re-admits at the
+        chain boundary. Returns active slots after the chain."""
+        if k <= 1:
+            return self.step_once(quiet=quiet)
+        jnp = self.jnp
+        self._admit()
+        pool = self._pool
+        if all(s.free for s in pool):
+            return 0
+        B = self.slots
+        active0 = [not s.free for s in pool]
+        temps = [s.sampler.temperature if not s.free else 0.0 for s in pool]
+        topps = [s.sampler.topp if not s.free else 0.9 for s in pool]
+        forced = np.full((k, B), -1, dtype=np.int32)
+        coins = np.zeros((k, B), dtype=np.float32)
+        for b, s in enumerate(pool):
+            if s.free:
+                continue
+            for i, t in enumerate(s.forced[:k]):
+                forced[i, b] = t
+            if s.sampler.temperature != 0.0:
+                # pre-draw on a THROWAWAY copy; the real stream advances
+                # during replay by exactly the coins the per-step loop
+                # would consume. Coin alignment: forced steps draw NO coin,
+                # so chain step i uses draw #(i - n_forced) — the stream
+                # position the per-step loop would be at
+                n_forced = min(len(s.forced), k)
+                if n_forced < k:
+                    coins[n_forced:, b] = s.sampler.rng.clone().f32_array(
+                        k - n_forced)
+
+        run = self._chain(k, greedy_only=all(t == 0.0 for t in temps))
+        cache, toks, acts = run(
+            self.params, self.cache,
+            jnp.asarray([s.token for s in pool], jnp.int32),
+            jnp.asarray([s.pos for s in pool], jnp.int32),
+            jnp.asarray(active0), jnp.asarray(
+                [s.budget if not s.free else 0 for s in pool], jnp.int32),
+            jnp.asarray(forced), jnp.asarray(coins),
+            jnp.asarray(temps, jnp.float32), jnp.asarray(topps, jnp.float32))
+        self.cache = cache
+        toks = np.asarray(toks)
+        acts = np.asarray(acts)
+        self.stats.steps += k
+        self.stats.max_active = max(self.stats.max_active, sum(active0))
+        # host replay: apply the recorded per-step outcomes with exactly
+        # step_once's bookkeeping (forced pops, RNG draws, BOS/budget stops)
+        for b, s in enumerate(pool):
+            if not active0[b]:
+                continue
+            if s.req.cancelled:  # consumer vanished during the chain
+                self._retire(s, quiet)
+                continue
+            for i in range(k):
+                if not acts[i, b]:
+                    break
+                if s.forced:
+                    s.forced.pop(0)
+                elif s.sampler.temperature != 0.0:
+                    s.sampler.rng.f32()  # the coin the chain consumed
+                if self._advance(s, int(toks[i, b]), quiet):
+                    break
+        self._admit()
+        return sum(not s.free for s in pool)
 
     def submit(self, req: Request) -> Request:
         """Queue a request (thread-safe; HTTP handler threads call this while
@@ -198,18 +314,27 @@ class ContinuousEngine:
                 nxt = s.forced.pop(0)
             else:
                 nxt = int(s.sampler.sample(logits[i]))
-            s.pos += 1
-            if nxt == BOS:  # reference stop: BOS before decoding it
-                self._retire(s, quiet)
-                continue
-            s.req.out.append(nxt)
-            self._notify(s.req, nxt)
-            self.stats.tokens += 1
-            s.token = nxt
-            if s.pos >= s.budget:
-                self._retire(s, quiet)
+            self._advance(s, nxt, quiet)
         self._admit()
         return sum(not s.free for s in pool)
+
+    def _advance(self, s: _Slot, nxt: int, quiet: bool) -> bool:
+        """Apply one decode outcome to a slot — the per-token bookkeeping
+        (position clock, BOS stop, output append/notify/count, budget stop)
+        shared by step_once and step_many's replay so the two paths cannot
+        drift. Returns True when the slot retired."""
+        s.pos += 1
+        if nxt == BOS:  # reference stop: BOS before decoding it
+            self._retire(s, quiet)
+            return True
+        s.req.out.append(nxt)
+        self._notify(s.req, nxt)
+        self.stats.tokens += 1
+        s.token = nxt
+        if s.pos >= s.budget:
+            self._retire(s, quiet)
+            return True
+        return False
 
     def _admit(self):
         spec = self.spec
@@ -332,7 +457,7 @@ class ContinuousEngine:
         reqs = [self.submit(Request(tokens=list(r), steps=steps))
                 for r in requests]
         t0 = time.perf_counter()
-        while self.step_once(quiet=quiet):
+        while self.step_many(self.block_steps, quiet=quiet):
             pass
         self.stats.total_ms = (time.perf_counter() - t0) * 1000
         assert all(r.done.is_set() for r in reqs)
@@ -354,14 +479,16 @@ def generate_continuous(spec: TransformerSpec, params: dict[str, Any],
                         tokenizer, prompts: list[str], steps: int,
                         temperature: float, topp: float, seed: int,
                         slots: int = 0, cache_dtype=None, mesh=None,
-                        prefill_chunk: int = 0, quiet: bool = False):
+                        prefill_chunk: int = 0, block_steps: int = 1,
+                        quiet: bool = False):
     """CLI entry: encode prompts, stream them through a slot pool, print
     rows in the --prompts-file format ("[i] 'text'")."""
     reqs = [tokenizer.encode(p or "", bos=True, eos=False) for p in prompts]
     slots = slots or min(len(reqs), 8)
     eng = ContinuousEngine(spec, params, slots, temperature, topp, seed,
                            cache_dtype=cache_dtype, mesh=mesh,
-                           prefill_chunk=prefill_chunk)
+                           prefill_chunk=prefill_chunk,
+                           block_steps=block_steps)
     outs, stats = eng.run(reqs, steps, quiet=quiet)
     for b, (req, row) in enumerate(zip(reqs, outs)):
         if not quiet:

@@ -34,6 +34,38 @@ import jax.numpy as jnp
 StepFn = Callable[..., tuple[jax.Array, Any]]
 
 
+def _mult_walk(probs: jax.Array, coin: jax.Array) -> jax.Array:
+    """Multinomial CDF walk (tokenizer.cpp:226-238)."""
+    v = probs.shape[-1]
+    cdf = jnp.cumsum(probs)
+    return jnp.minimum(jnp.searchsorted(cdf, coin, side="right"),
+                       v - 1).astype(jnp.int32)
+
+
+def _nucleus_walk(probs: jax.Array, coin: jax.Array,
+                  topp: jax.Array | float) -> jax.Array:
+    """Nucleus pick (tokenizer.cpp:240-281): cutoff pre-filter, stable
+    descending sort, cut at cum > topp, CDF walk over the kept prefix
+    scaled by coin*cum. Works with static or traced ``topp`` — the ONE
+    copy of the math shared by sample_device and sample_device_dynamic.
+    When the cutoff keeps nothing (possible for topp < 1/v) falls back to
+    the argmax, like the host Sampler."""
+    v = probs.shape[-1]
+    cutoff = (1.0 - topp) / (v - 1)
+    kept = jnp.where(probs >= cutoff, probs, 0.0)
+    order = jnp.argsort(-kept)  # stable: ties keep index order
+    p_sorted = kept[order]
+    cum = jnp.cumsum(p_sorted)
+    # first index where cumulative prob exceeds topp (== last kept index)
+    last = jnp.argmax(cum > topp)
+    last = jnp.where(cum[-1] > topp, last, v - 1)
+    r = coin * cum[last]
+    idx = jnp.minimum(jnp.searchsorted(cum, r, side="right"), last)
+    nuc = order[idx].astype(jnp.int32)
+    return jnp.where(cum[-1] > 0.0, nuc,
+                     jnp.argmax(probs).astype(jnp.int32))
+
+
 def sample_device(logits: jax.Array, coin: jax.Array, temperature: float,
                   topp: float) -> jax.Array:
     """Reference Sampler::sample on device. logits (V,) f32; coin scalar f32.
@@ -44,26 +76,28 @@ def sample_device(logits: jax.Array, coin: jax.Array, temperature: float,
     if temperature == 0.0:
         return jnp.argmax(logits).astype(jnp.int32)
     probs = jax.nn.softmax(logits.astype(jnp.float32) / temperature)
-    v = logits.shape[-1]
     if topp <= 0 or topp >= 1:
-        # multinomial CDF walk (tokenizer.cpp:226-238)
-        cdf = jnp.cumsum(probs)
-        idx = jnp.searchsorted(cdf, coin, side="right")
-        return jnp.minimum(idx, v - 1).astype(jnp.int32)
-    # nucleus: cutoff pre-filter, descending sort, cut at cum > topp, then
-    # CDF walk over the kept prefix scaled by coin*cum (tokenizer.cpp:240-281)
-    cutoff = (1.0 - topp) / (v - 1)
-    kept = jnp.where(probs >= cutoff, probs, 0.0)
-    order = jnp.argsort(-kept)  # stable: ties keep index order
-    p_sorted = kept[order]
-    cum = jnp.cumsum(p_sorted)
-    # first index where cumulative prob exceeds topp (== last kept index)
-    last = jnp.argmax(cum > topp)
-    last = jnp.where(cum[-1] > topp, last, v - 1)
-    r = coin * cum[last]
-    idx = jnp.searchsorted(cum, r, side="right")
-    idx = jnp.minimum(idx, last)
-    return order[idx].astype(jnp.int32)
+        return _mult_walk(probs, coin)
+    return _nucleus_walk(probs, coin, topp)
+
+
+def sample_device_dynamic(logits: jax.Array, coin: jax.Array,
+                          temperature: jax.Array,
+                          topp: jax.Array) -> jax.Array:
+    """Reference sampler with TRACED temperature/topp — the per-row variant
+    for the fused continuous chain (runtime/continuous.step_many), where
+    each slot carries its own request's sampling params. Computes the
+    greedy/multinomial/nucleus candidates and selects (the strategy branch
+    cannot resolve at trace time); semantics mirror sample_device and the
+    host Sampler, including the degenerate-nucleus argmax fallback.
+    """
+    greedy = jnp.argmax(logits).astype(jnp.int32)
+    safe_t = jnp.where(temperature == 0.0, 1.0, temperature)
+    probs = jax.nn.softmax(logits.astype(jnp.float32) / safe_t)
+    in01 = (topp > 0.0) & (topp < 1.0)
+    return jnp.where(temperature == 0.0, greedy,
+                     jnp.where(in01, _nucleus_walk(probs, coin, topp),
+                               _mult_walk(probs, coin)))
 
 
 def make_decode_loop(step_fn: StepFn, steps: int, temperature: float,

@@ -438,11 +438,8 @@ def generate_fast(engine: Engine, tokenizer: Tokenizer, sampler: Sampler,
     coins = np.zeros((steps,), dtype=np.float32)
     n_sampled = steps - (len(prompt_tokens) - 1)
     if n_sampled > 0 and sampler.temperature != 0.0:
-        from ..utils.rng import Xorshift64
-
-        scratch = Xorshift64(0)
-        scratch.state = sampler.rng.state
-        coins[len(prompt_tokens) - 1:] = scratch.f32_array(n_sampled)
+        coins[len(prompt_tokens) - 1:] = sampler.rng.clone().f32_array(
+            n_sampled)
 
     t0 = time.perf_counter()
     toks, engine.cache = run(engine.params, engine.cache,

@@ -45,7 +45,7 @@ class InferenceServer:
                  tokenizer: Tokenizer, host: str, port: int, slots: int,
                  steps: int, temperature: float, topp: float, seed: int,
                  cache_dtype=None, mesh=None, prefill_chunk: int = 0,
-                 quiet: bool = False):
+                 block_steps: int = 1, quiet: bool = False):
         self.spec = spec
         self.tokenizer = tokenizer
         self.default_steps = steps
@@ -53,7 +53,8 @@ class InferenceServer:
         self.engine = ContinuousEngine(spec, params, slots, temperature,
                                        topp, seed, cache_dtype=cache_dtype,
                                        mesh=mesh,
-                                       prefill_chunk=prefill_chunk)
+                                       prefill_chunk=prefill_chunk,
+                                       block_steps=block_steps)
         self._shutdown = threading.Event()
         server = self
 
@@ -198,7 +199,8 @@ class InferenceServer:
     def _scheduler(self):
         while not self._shutdown.is_set():
             try:
-                active = self.engine.step_once(quiet=self.quiet)
+                active = self.engine.step_many(self.engine.block_steps,
+                                               quiet=self.quiet)
             except Exception as e:
                 # a dead scheduler must not leave clients blocked forever:
                 # fail everything queued/in flight (handlers answer 500) and

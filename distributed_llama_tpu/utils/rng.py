@@ -35,6 +35,14 @@ class Xorshift64:
     def __init__(self, seed: int):
         self.state = seed & _MASK64
 
+    def clone(self) -> "Xorshift64":
+        """Throwaway copy at the current stream position — for pre-drawing
+        coins speculatively while the real stream advances only by what was
+        actually consumed (generate_fast, continuous.step_many)."""
+        c = Xorshift64(0)
+        c.state = self.state
+        return c
+
     def u32(self) -> int:
         self.state, u = random_u32(self.state)
         return u

@@ -120,6 +120,60 @@ def test_continuous_over_tp_mesh_matches_single_chip(params):
     assert outs == ref
 
 
+@pytest.mark.parametrize("temp,block", [(0.0, 4), (0.9, 4), (0.9, 3)])
+def test_continuous_block_steps_matches_per_step(params, temp, block):
+    """Fused K-step chains == per-step scheduling, token for token, across
+    mixed prompts (more requests than slots, ragged lengths, budget and
+    prompt retirements at non-boundary steps)."""
+    from distributed_llama_tpu.runtime.continuous import ContinuousEngine
+
+    steps = 10
+    reqs = [[1, 5, 9], [1, 22], [1, 7, 33, 2, 9, 14], [1, 60], [1, 90, 14]]
+    ref, ref_stats = ContinuousEngine(SPEC, params, slots=2,
+                                      temperature=temp, topp=0.9,
+                                      seed=3).run(reqs, steps)
+    got, _ = ContinuousEngine(SPEC, params, slots=2, temperature=temp,
+                              topp=0.9, seed=3,
+                              block_steps=block).run(reqs, steps)
+    assert got == ref
+
+
+def test_continuous_block_steps_per_request_overrides(params):
+    """Per-request temperature/topp/seed ride through the fused chain (the
+    traced-sampler path) identically to the per-step host sampler."""
+    from distributed_llama_tpu.runtime.continuous import (ContinuousEngine,
+                                                          Request)
+
+    def run_engine(block):
+        eng = ContinuousEngine(SPEC, params, slots=2, temperature=0.0,
+                               topp=0.9, seed=5, block_steps=block)
+        reqs = [Request(tokens=[1, 5, 9], steps=8, temperature=0.9,
+                        topp=0.9, seed=11),
+                Request(tokens=[1, 22], steps=8),  # greedy (engine default)
+                Request(tokens=[1, 7, 33], steps=8, temperature=0.7,
+                        topp=2.0, seed=13)]  # multinomial walk
+        for r in reqs:
+            eng.submit(r)
+        while eng.step_many(block):
+            pass
+        return [r.out for r in reqs]
+
+    assert run_engine(4) == run_engine(1)
+
+
+def test_continuous_block_steps_with_prefill(params):
+    from distributed_llama_tpu.runtime.continuous import ContinuousEngine
+
+    steps = 10
+    reqs = [[1, 5, 9, 14, 23, 40, 7, 11], [1, 22], [1, 7, 33, 2, 9]]
+    ref, _ = ContinuousEngine(SPEC, params, slots=2, temperature=0.9,
+                              topp=0.9, seed=3).run(reqs, steps)
+    got, _ = ContinuousEngine(SPEC, params, slots=2, temperature=0.9,
+                              topp=0.9, seed=3, prefill_chunk=4,
+                              block_steps=4).run(reqs, steps)
+    assert got == ref
+
+
 def test_continuous_pos_never_reaches_seq_len(params):
     """A retired row's clock can hit seq_len; the freed slot must be parked
     back at pos 0 before the next device step — pos == seq_len reaching the

@@ -55,6 +55,49 @@ def test_sample_device_argmax():
                              0.0, 0.9)) == 1
 
 
+def test_sample_device_degenerate_nucleus_matches_host():
+    """topp < 1/v keeps nothing: both device samplers and the host fall
+    back to the argmax (shared _nucleus_walk)."""
+    import jax.numpy as jnp
+
+    from distributed_llama_tpu.runtime.decode import (sample_device,
+                                                      sample_device_dynamic)
+    from distributed_llama_tpu.runtime.sampling import sample_topp, softmax_f32
+
+    logits = np.zeros(64, np.float32)
+    logits[17] = 1e-4
+    want = sample_topp(softmax_f32(logits), 1e-6, 0.7)
+    assert want == 17
+    assert int(sample_device(jnp.asarray(logits), jnp.float32(0.7),
+                             1.0, 1e-6)) == want
+    assert int(sample_device_dynamic(jnp.asarray(logits), jnp.float32(0.7),
+                                     jnp.float32(1.0),
+                                     jnp.float32(1e-6))) == want
+
+
+@pytest.mark.parametrize("temperature,topp", [(0.8, 0.9), (1.0, 0.0),
+                                              (0.5, 1.5), (0.0, 0.9)])
+def test_sample_device_dynamic_matches_static(temperature, topp):
+    """The traced-params sampler must agree with the static one on every
+    strategy (the strategies differ only in how the branch is selected)."""
+    import jax.numpy as jnp
+
+    from distributed_llama_tpu.runtime.decode import (sample_device,
+                                                      sample_device_dynamic)
+
+    rng = np.random.default_rng(23)
+    for _ in range(10):
+        logits = (rng.standard_normal(128) * 3).astype(np.float32)
+        coin = float(rng.uniform())
+        a = int(sample_device(jnp.asarray(logits), jnp.float32(coin),
+                              temperature, topp))
+        b = int(sample_device_dynamic(jnp.asarray(logits),
+                                      jnp.float32(coin),
+                                      jnp.float32(temperature),
+                                      jnp.float32(topp)))
+        assert a == b
+
+
 @pytest.mark.parametrize("temperature", [0.0, 0.9])
 def test_fused_loop_matches_per_step_generate(temperature):
     """generate_fast must emit the same token chain as generate()."""
