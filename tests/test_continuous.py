@@ -120,20 +120,24 @@ def test_continuous_over_tp_mesh_matches_single_chip(params):
     assert outs == ref
 
 
-@pytest.mark.parametrize("temp,block", [(0.0, 4), (0.9, 4), (0.9, 3)])
-def test_continuous_block_steps_matches_per_step(params, temp, block):
+@pytest.mark.parametrize("temp,block,tp", [(0.0, 4, 1), (0.9, 4, 1),
+                                           (0.9, 3, 1), (0.9, 4, 2)])
+def test_continuous_block_steps_matches_per_step(params, temp, block, tp):
     """Fused K-step chains == per-step scheduling, token for token, across
     mixed prompts (more requests than slots, ragged lengths, budget and
-    prompt retirements at non-boundary steps)."""
+    prompt retirements at non-boundary steps); the tp case runs the chain
+    over the sharded batch step (the PARITY.md composition claim)."""
+    from distributed_llama_tpu.parallel import make_mesh
     from distributed_llama_tpu.runtime.continuous import ContinuousEngine
 
     steps = 10
+    mesh = make_mesh(tp=tp) if tp > 1 else None
     reqs = [[1, 5, 9], [1, 22], [1, 7, 33, 2, 9, 14], [1, 60], [1, 90, 14]]
     ref, ref_stats = ContinuousEngine(SPEC, params, slots=2,
                                       temperature=temp, topp=0.9,
                                       seed=3).run(reqs, steps)
     got, _ = ContinuousEngine(SPEC, params, slots=2, temperature=temp,
-                              topp=0.9, seed=3,
+                              topp=0.9, seed=3, mesh=mesh,
                               block_steps=block).run(reqs, steps)
     assert got == ref
 

@@ -143,6 +143,22 @@ def test_prefill_gates_off_on_midstream_bos(params):
     assert gotf == ref
 
 
+@pytest.mark.parametrize("sp,tp", [(1, 2), (2, 1), (2, 2)])
+def test_generate_prefill_on_sharded_engine(params, sp, tp):
+    """--prefill-chunk on a sharded (sp/tp) engine: same stream as the
+    sharded per-token path (the sp cache update handles T>1 windows that
+    straddle chunk boundaries — parallel/ring.update_sp_cache)."""
+    from distributed_llama_tpu.parallel import make_mesh
+
+    tok = _IdTokenizer()
+    mesh = make_mesh(sp=sp, tp=tp)
+    ref, _ = generate(Engine(SPEC, params, mesh=mesh), tok, _sampler(),
+                      "abcde", steps=12, quiet=True)
+    got, _ = generate(Engine(SPEC, params, mesh=mesh), tok, _sampler(),
+                      "abcde", steps=12, quiet=True, prefill_chunk=4)
+    assert got == ref
+
+
 def test_prefill_gates_off_when_prompt_exceeds_steps(params):
     """Prompt longer than steps: prefill must not engage (the per-token
     path's forced-echo output semantics are load-bearing there)."""
