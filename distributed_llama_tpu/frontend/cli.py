@@ -1,4 +1,5 @@
-"""CLI entrypoints: ``inference`` and ``worker`` modes (reference src/main.cpp).
+"""CLI entrypoints: ``inference``/``worker`` (reference src/main.cpp), plus
+``serve`` (HTTP API over continuous batching) and ``convert`` modes.
 
 Flag surface parity (main.cpp:94-160): --model, --tokenizer, --prompt,
 --weights-float-type, --buffer-float-type, --workers, --port, --nthreads,
