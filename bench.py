@@ -97,16 +97,25 @@ def _bench(spec, params, samples: int, per_step: bool = False) -> float:
     # time HONESTLY-synced chains: materializing the tokens forces the whole
     # chain to have executed (block_until_ready alone can report early when a
     # remote runtime pipelines one in-flight execution); median of 3 damps
-    # the tunneled runtime's per-chain dispatch jitter
+    # the tunneled runtime's per-chain dispatch jitter. ms/token divides by
+    # the steps the chain actually RAN: the while_loop decode stops early on
+    # a produced BOS (possible with real weights; BOS fills the tail), and
+    # elapsed/samples would then understate the true per-token cost
     times = []
+    executed = samples
     for _ in range(3):
         t0 = time.perf_counter()
         toks, _ = run(*args())
-        np.asarray(toks)
-        times.append((time.perf_counter() - t0) * 1000 / samples)
+        toks = np.asarray(toks)
+        elapsed_ms = (time.perf_counter() - t0) * 1000
+        bos = np.flatnonzero(toks == 1)
+        executed = int(bos[0]) + 1 if len(bos) else samples
+        times.append(elapsed_ms / executed)
     ms = float(np.median(times))
-    print(f"fused-loop per-token ms: {ms:.2f} ({samples} steps/chain, "
-          f"trials {[round(t, 2) for t in times]})", file=sys.stderr)
+    print(f"fused-loop per-token ms: {ms:.2f} ({executed} steps/chain"
+          + ("" if executed == samples else f" — BOS-terminated early of "
+             f"{samples}")
+          + f", trials {[round(t, 2) for t in times]})", file=sys.stderr)
     return ms
 
 

@@ -16,11 +16,13 @@ the host: the reference draws them from a stateful xorshift64* stream
 ``coins[i]`` for every post-prompt step and the device consumes them in order
 — bit-identical coin sequence, no uint64 emulation on device.
 
-Early stop: the reference breaks on BOS before decoding it. A fixed-length
-scan cannot break, so the device runs all ``steps`` and the HOST truncates at
-the first BOS — identical output tokens, some wasted compute only when the
-chain terminates early (a latency trade the reference never faces because its
-per-token dispatch is free on CPU).
+Early stop: the reference breaks on BOS before decoding it. The single-
+sequence loop is a ``lax.while_loop`` that terminates on a produced BOS, so
+an early stop costs only the steps actually run; unwritten tail slots of the
+token buffer read as BOS, and the host truncates at the first BOS as always.
+The batch loop is a fixed-length scan (lockstep rows share the position
+clock), with finished rows frozen to emit the same BOS-filled tail — the two
+paths share one post-BOS output contract.
 """
 
 from __future__ import annotations
@@ -113,22 +115,39 @@ def make_decode_loop(step_fn: StepFn, steps: int, temperature: float,
     checkpointed position for a resumed one.
     """
 
+    from ..io.tokenizer import BOS
+
     def run(params, cache, prompt_padded, first_token, coins, start_pos):
         """start_pos: absolute position of the first step — 0 for a fresh
         generation, the checkpointed position for a resumed one (the cache
         must already hold positions 0..start_pos-1; runtime/checkpoint.py).
-        """
-        def body(carry, xs):
-            token, cache = carry
-            pos, coin, forced = xs
-            logits, cache = step_fn(params, cache, token[None], pos)
-            sampled = sample_device(logits[0], coin, temperature, topp)
-            nxt = jnp.where(forced >= 0, forced, sampled)
-            return (nxt, cache), nxt
 
-        xs = (start_pos + jnp.arange(steps, dtype=jnp.int32), coins,
-              prompt_padded[1:])
-        (_, cache), toks = jax.lax.scan(body, (first_token, cache), xs)
+        The loop is a lax.while_loop, not a scan: a sampled BOS ends the
+        chain EARLY on device (the reference's stop condition), so a
+        2048-step budget that terminates at step 50 costs 50 forwards, not
+        2048. The token buffer is BOS-initialized — untouched slots read as
+        the terminator, so the host-side truncation is unchanged.
+        """
+        toks0 = jnp.full((steps,), BOS, dtype=jnp.int32)
+
+        def cond(carry):
+            i, done, token, cache, toks = carry
+            return (i < steps) & ~done
+
+        def body(carry):
+            i, done, token, cache, toks = carry
+            logits, cache = step_fn(params, cache, token[None],
+                                    start_pos + i)
+            sampled = sample_device(logits[0], coins[i], temperature, topp)
+            nxt = jnp.where(prompt_padded[i + 1] >= 0, prompt_padded[i + 1],
+                            sampled)
+            # stop on a PRODUCED BOS (the input token at i=0 is legitimately
+            # BOS — every prompt starts with it)
+            return (i + 1, nxt == BOS, nxt, cache, toks.at[i].set(nxt))
+
+        _, _, _, cache, toks = jax.lax.while_loop(
+            cond, body, (jnp.int32(0), jnp.bool_(False), first_token, cache,
+                         toks0))
         return toks, cache
 
     return jax.jit(run, donate_argnums=1)
@@ -161,9 +180,11 @@ def make_batch_decode_loop(spec, steps: int, temperature: float, topp: float,
     if step_fn is None:
         step_fn = functools.partial(forward_batch, spec)
 
+    from ..io.tokenizer import BOS
+
     def run(params, cache, prompts, first_tokens, coins):
         def body(carry, xs):
-            tokens, cache = carry
+            tokens, active, cache = carry
             pos, coin_row = xs
             logits, cache = step_fn(params, cache, tokens, pos)
             sampled = jax.vmap(
@@ -171,10 +192,18 @@ def make_batch_decode_loop(spec, steps: int, temperature: float, topp: float,
             )(logits, coin_row)
             forced = prompts[:, pos + 1]
             nxt = jnp.where(forced >= 0, forced, sampled)
-            return (nxt, cache), nxt
+            # a finished row (produced BOS earlier) freezes its input token
+            # and emits BOS — the same post-BOS tail the single-sequence
+            # while_loop's untouched buffer yields
+            rec = jnp.where(active, nxt, BOS)
+            active = active & (nxt != BOS)
+            tokens = jnp.where(active, nxt, tokens)
+            return (tokens, active, cache), rec
 
+        B = first_tokens.shape[0]
         xs = (jnp.arange(steps, dtype=jnp.int32), coins.T)
-        (_, cache), toks = jax.lax.scan(body, (first_tokens, cache), xs)
+        (_, _, cache), toks = jax.lax.scan(
+            body, (first_tokens, jnp.ones((B,), bool), cache), xs)
         return toks.T, cache  # (B, steps)
 
     return jax.jit(run, donate_argnums=1)
