@@ -82,7 +82,7 @@ def _bench(spec, params, samples: int, per_step: bool = False) -> float:
         ms = float(np.mean(times))
         print(f"per-token ms: mean {ms:.2f}  min {min(times):.2f}  "
               f"max {max(times):.2f}", file=sys.stderr)
-        return ms
+        return ms, samples
 
     run = make_decode_loop(step, samples, temperature=0.0, topp=0.9)
     padded = np.full((samples + 1,), -1, dtype=np.int32)
@@ -101,6 +101,8 @@ def _bench(spec, params, samples: int, per_step: bool = False) -> float:
     # the steps the chain actually RAN: the while_loop decode stops early on
     # a produced BOS (possible with real weights; BOS fills the tail), and
     # elapsed/samples would then understate the true per-token cost
+    from distributed_llama_tpu.io.tokenizer import BOS
+
     times = []
     executed = samples
     for _ in range(3):
@@ -108,7 +110,7 @@ def _bench(spec, params, samples: int, per_step: bool = False) -> float:
         toks, _ = run(*args())
         toks = np.asarray(toks)
         elapsed_ms = (time.perf_counter() - t0) * 1000
-        bos = np.flatnonzero(toks == 1)
+        bos = np.flatnonzero(toks == BOS)
         executed = int(bos[0]) + 1 if len(bos) else samples
         times.append(elapsed_ms / executed)
     ms = float(np.median(times))
@@ -116,7 +118,7 @@ def _bench(spec, params, samples: int, per_step: bool = False) -> float:
           + ("" if executed == samples else f" — BOS-terminated early of "
              f"{samples}")
           + f", trials {[round(t, 2) for t in times]})", file=sys.stderr)
-    return ms
+    return ms, executed
 
 
 def main():
@@ -171,7 +173,7 @@ def main():
     # persistent pallas compile trouble. A flat loop (not nested excepts):
     # a live exception traceback would pin the failed attempt's device
     # copies of the 7B weights/cache and could OOM the later attempts.
-    ms = None
+    ms = executed = None
     for attempt in range(3):
         if attempt == 2:
             if (os.environ.get("DLLAMA_Q40_KERNEL", "auto") == "xla"
@@ -183,7 +185,8 @@ def main():
             os.environ["DLLAMA_Q40_KERNEL"] = "xla"
             os.environ["DLLAMA_ATTN_KERNEL"] = "xla"
         try:
-            ms = _bench(spec, params, args.samples, per_step=args.per_step)
+            ms, executed = _bench(spec, params, args.samples,
+                                  per_step=args.per_step)
             break
         except Exception as e:
             if attempt == 2:
@@ -202,6 +205,9 @@ def main():
         "unit": "ms/token",
         "vs_baseline": round(baseline / ms, 2),
         "samples": args.samples,  # reference protocol = 16 (--samples 16)
+        # the ms/token denominator: < samples when the greedy chain
+        # BOS-terminated early (possible with real weights)
+        "executed": executed,
     }
     print(json.dumps(result))
 

@@ -152,6 +152,13 @@ def cmd_inference(argv: list[str], quiet: bool = False) -> int:
             print("batch mode (--prompts-file) does not compose with --sp",
                   file=sys.stderr)
             return 2
+        if args.prefill_chunk > 1 and not args.continuous:
+            # lockstep rows share one position clock: per-row prompt
+            # prefill would desync them — only --continuous prefills
+            print("--prefill-chunk with --prompts-file needs --continuous "
+                  "(lockstep rows share the position clock)",
+                  file=sys.stderr)
+            return 2
         with open(args.prompts_file) as fh:
             prompts = [ln.rstrip("\n") for ln in fh if ln.strip()]
         if not prompts:

@@ -476,11 +476,15 @@ def generate_fast(engine: Engine, tokenizer: Tokenizer, sampler: Sampler,
     n = max(1, chain_generated)
     stats = GenStats(tokens=chain_generated, total_ms=total_ms,
                      infer_ms=total_ms, host_ms=0.0)
-    if len(toks) and chain_generated == len(toks):  # no early BOS: resumable
+    early_bos = chain_generated < steps
+    if len(toks) and not early_bos:  # no early BOS: resumable
         stats.final_pos, stats.final_token = start_pos + steps, int(toks[-1])
         stats.prompt_rest = prompt_tail
     if not quiet:
+        # the while_loop stops on a produced BOS: executed = generated
+        # tokens + the terminating step, not the whole budget
+        executed = chain_generated + 1 if early_bos else steps
         print(f"\nGenerated tokens:    {stats.tokens}")
         print(f"Avg generation time: {total_ms / n:.2f} ms "
-              f"(fused loop, {steps} device steps)")
+              f"(fused loop, {executed} device steps)")
     return out_tokens, stats

@@ -197,3 +197,6 @@ def test_cli_batch_prompts_file(model_files, tmp_path, capsys):
                  "--prompt", "hi"]) == 2                   # no prompts-file
     assert main(["inference", *base[:-2], "--tp", "1", "--continuous",
                  "--slots", "-3", "--prompts-file", str(pf)]) == 2
+    # lockstep batch can't prefill (shared position clock)
+    assert main(["inference", *base[:-2], "--tp", "1", "--prefill-chunk",
+                 "4", "--prompts-file", str(pf)]) == 2
