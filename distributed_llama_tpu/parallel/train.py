@@ -71,3 +71,91 @@ def make_train_step(spec: TransformerSpec, mesh: Mesh,
         return step(params, opt_state, tokens)
 
     return init_fn, jax.jit(wrapped_step, donate_argnums=(0, 1))
+
+
+_TRAIN_CKPT_VERSION = 1
+
+
+def save_train_state(path: str, spec: TransformerSpec, params: dict[str, Any],
+                     opt_state) -> None:
+    """Persist a training state (params + optimizer moments) to one .npz.
+
+    The reference has no training at all, so there is no format to match;
+    this is the minimal exact-resume format for make_train_step's state:
+    the flattened pytree leaves in order, plus the model header to refuse
+    mismatched loads. Sharded arrays gather to host here (GB-scale at real
+    sizes — fine for the capability tier this training step targets).
+    """
+    import numpy as np
+
+    leaves, _ = jax.tree_util.tree_flatten((params, opt_state))
+    payload = {f"leaf_{i}": np.asarray(leaf) for i, leaf in enumerate(leaves)}
+    with open(path, "wb") as fh:  # file object: savez must not append .npz
+        np.savez(fh, __version__=_TRAIN_CKPT_VERSION,
+                 __header__=np.frombuffer(spec.header(), dtype=np.int32),
+                 __n_leaves__=len(leaves), **payload)
+
+
+def load_train_state(path: str, spec: TransformerSpec, params_template,
+                     opt_state_template):
+    """Restore (params, opt_state) saved by save_train_state.
+
+    ``*_template`` supply the pytree structure and per-leaf shardings (a
+    fresh ``init_fn(params)`` result); every loaded leaf is device_put with
+    its template leaf's sharding, so resume works on any mesh shape whose
+    shardings the templates carry.
+    """
+    import numpy as np
+
+    with np.load(path) as z:
+        if int(z["__version__"]) != _TRAIN_CKPT_VERSION:
+            raise ValueError(
+                f"train checkpoint version {int(z['__version__'])} != "
+                f"{_TRAIN_CKPT_VERSION}")
+        header = z["__header__"].tobytes()
+        if header != spec.header():
+            raise ValueError(
+                "train checkpoint header does not match the model spec "
+                f"({np.frombuffer(header, np.int32).tolist()} vs "
+                f"{np.frombuffer(spec.header(), np.int32).tolist()})")
+        leaves = [z[f"leaf_{i}"] for i in range(int(z["__n_leaves__"]))]
+    template = (params_template, opt_state_template)
+    paths_and_leaves, treedef = jax.tree_util.tree_flatten_with_path(template)
+    if len(paths_and_leaves) != len(leaves):
+        raise ValueError(f"checkpoint has {len(leaves)} leaves, template "
+                         f"has {len(paths_and_leaves)}")
+    # Shardings: params leaves carry NamedShardings; optimizer-state leaves
+    # fresh out of jit(optimizer.init) are UNCOMMITTED single-device arrays
+    # (re-putting them with that sharding would commit them to one device
+    # and conflict with the mesh-committed params inside the jitted step).
+    # AdamW's mu/nu mirror the params dict, so leaves whose path names a
+    # param load with THAT param's band sharding — replicating moments
+    # would cost ~2x params of HBM per device at real sizes; everything
+    # else (scalar counts) loads mesh-replicated.
+    mesh = next(l.sharding.mesh for _, l in paths_and_leaves
+                if isinstance(l.sharding, NamedSharding))
+    p_specs = param_specs(params_template)
+    repl = NamedSharding(mesh, P())
+
+    def leaf_sharding(path, tmpl):
+        if isinstance(tmpl.sharding, NamedSharding):
+            return tmpl.sharding
+        for key in reversed(path):
+            name = getattr(key, "key", None)
+            spec = p_specs.get(name) if isinstance(name, str) else None
+            if isinstance(spec, P) and len(spec) <= tmpl.ndim:
+                return NamedSharding(mesh, spec)
+        return repl
+
+    put = []
+    for loaded, (path, tmpl) in zip(leaves, paths_and_leaves):
+        if loaded.shape != tmpl.shape:
+            raise ValueError(f"leaf shape {loaded.shape} != template "
+                             f"{tmpl.shape}")
+        if loaded.dtype != tmpl.dtype:
+            raise ValueError(
+                f"leaf dtype {loaded.dtype} != template {tmpl.dtype} — "
+                "exact resume needs matching precision")
+        put.append(jax.device_put(jnp.asarray(loaded),
+                                  leaf_sharding(path, tmpl)))
+    return jax.tree_util.tree_unflatten(treedef, put)
