@@ -346,11 +346,102 @@ def cmd_serve(argv: list[str]) -> int:
     return 0
 
 
+def cmd_train(argv: list[str]) -> int:
+    """Next-token training on a text corpus (capability extension; the
+    reference is inference-only). Weights densify to f32, the batch is
+    dp-sharded and the weights tp-sharded like inference (parallel/train.py),
+    and --save/resume-state give exact-resume checkpoints: a split run
+    reproduces the unsplit run's losses step for step (the data schedule is
+    a pure function of --seed and the step counter).
+    """
+    ap = argparse.ArgumentParser(prog="dllama-tpu train")
+    ap.add_argument("--model", required=True)
+    ap.add_argument("--tokenizer", required=True)
+    ap.add_argument("--data", required=True,
+                    help="UTF-8 text corpus; tokenized once, windows "
+                         "sampled per step")
+    ap.add_argument("--weights-float-type", default="f32", choices=sorted(_FT))
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--batch", type=int, default=4)
+    ap.add_argument("--seq", type=int, default=128,
+                    help="training window length (tokens per row)")
+    ap.add_argument("--learning-rate", type=float, default=1e-4)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--dp", type=int, default=1)
+    ap.add_argument("--tp", type=int, default=1)
+    ap.add_argument("--save-state", default=None, metavar="PATH")
+    ap.add_argument("--resume-state", default=None, metavar="PATH")
+    args = ap.parse_args(argv)
+
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from ..io.loader import densify_params, load_model, read_spec
+    from ..io.tokenizer import Tokenizer
+    from ..parallel import make_mesh
+    from ..parallel.train import (load_train_state, make_train_step,
+                                  save_train_state)
+
+    # header-only read: validate flags before streaming multi-GB weights
+    spec = read_spec(args.model,
+                     weights_float_type=_FT[args.weights_float_type])
+    if args.seq + 1 > spec.seq_len:
+        print(f"--seq must be < seq_len ({spec.seq_len}), got {args.seq}",
+              file=sys.stderr)
+        return 2
+    tokenizer = Tokenizer(args.tokenizer, spec.vocab_size)
+    with open(args.data, "rb") as fh:
+        text = fh.read().decode("utf-8", errors="replace")
+    corpus = np.asarray(tokenizer.encode(text, bos=True, eos=False),
+                        dtype=np.int32)
+    if len(corpus) < args.seq + 1:  # one (seq+1)-token window minimum
+        print(f"corpus has {len(corpus)} tokens; need >= {args.seq + 1}",
+              file=sys.stderr)
+        return 2
+    _, params = load_model(args.model, spec=spec)
+    params = densify_params(params)
+
+    mesh = make_mesh(dp=args.dp, tp=args.tp)
+    init_fn, step_fn = make_train_step(spec, mesh,
+                                       learning_rate=args.learning_rate)
+    p, o = init_fn(params)
+    start = 0
+    if args.resume_state:
+        p, o, start = load_train_state(args.resume_state, spec, p, o,
+                                       return_step=True)
+        print(f"⏩ Resumed training at step {start}")
+
+    def windows(step: int) -> np.ndarray:
+        """(batch, seq+1) token windows — a pure function of (seed, step),
+        so a resumed run continues the identical schedule. The exclusive
+        high bound len - seq keeps the LAST corpus token reachable as a
+        target (start len - seq - 1 is the final valid window)."""
+        rng = np.random.default_rng((args.seed, step))
+        starts = rng.integers(0, len(corpus) - args.seq, args.batch)
+        return np.stack([corpus[s:s + args.seq + 1] for s in starts])
+
+    import time as _time
+
+    for step in range(start, start + args.steps):
+        t0 = _time.perf_counter()
+        p, o, loss = step_fn(p, o, jnp.asarray(windows(step)))
+        loss = float(loss)
+        print(f"🔶 step {step:5d}  loss {loss:8.4f}  "
+              f"{(_time.perf_counter() - t0) * 1000:7.1f} ms")
+    if args.save_state:
+        save_train_state(args.save_state, spec, p, o,
+                         step=start + args.steps)
+        print(f"⏩ Saved training state to {args.save_state} "
+              f"(step {start + args.steps})")
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if not argv or argv[0] in ("-h", "--help"):
-        print("usage: dllama-tpu {inference|worker|serve|convert} [options]\n"
-              f"{__doc__}")
+        print("usage: dllama-tpu {inference|worker|serve|train|convert} "
+              f"[options]\n{__doc__}")
         return 0 if argv else 1
     mode, rest = argv[0], argv[1:]
     if mode == "inference":
@@ -359,13 +450,15 @@ def main(argv: list[str] | None = None) -> int:
         return cmd_worker(rest)
     if mode == "serve":
         return cmd_serve(rest)
+    if mode == "train":
+        return cmd_train(rest)
     if mode == "convert":
         from ..convert import main as convert_main
 
         convert_main(rest)
         return 0
-    print(f"unknown mode {mode!r} (expected inference|worker|serve|convert)",
-          file=sys.stderr)
+    print(f"unknown mode {mode!r} (expected "
+          f"inference|worker|serve|train|convert)", file=sys.stderr)
     return 1
 
 

@@ -235,3 +235,22 @@ def write_model(path: str, spec: TransformerSpec, tensors: dict) -> None:
     import os
 
     assert os.path.getsize(path) == spec.file_size()
+
+
+def densify_params(params: dict) -> dict:
+    """Dequantize/upcast a loaded param tree to dense float32 — the training
+    entry point (parallel/train.py optimizes dense weights; Q40/F16 files
+    are inference formats). Q40Weight leaves decode with the exact codec
+    value map; F16 upcasts exactly."""
+    from ..ops.quants import dequantize_q40
+
+    out = {}
+    for name, val in params.items():
+        if isinstance(val, Q40Weight):
+            out[name] = dequantize_q40(val.qs, val.d16)
+        elif isinstance(val, Q40Kernel):  # pre-tiled: go through the codec
+            w = from_kernel_layout(val)
+            out[name] = dequantize_q40(w.qs, w.d16)
+        else:
+            out[name] = np.asarray(val, dtype=np.float32)
+    return out

@@ -77,14 +77,16 @@ _TRAIN_CKPT_VERSION = 1
 
 
 def save_train_state(path: str, spec: TransformerSpec, params: dict[str, Any],
-                     opt_state) -> None:
+                     opt_state, step: int = 0) -> None:
     """Persist a training state (params + optimizer moments) to one .npz.
 
     The reference has no training at all, so there is no format to match;
     this is the minimal exact-resume format for make_train_step's state:
     the flattened pytree leaves in order, plus the model header to refuse
-    mismatched loads. Sharded arrays gather to host here (GB-scale at real
-    sizes — fine for the capability tier this training step targets).
+    mismatched loads and the step counter so a resumed run continues the
+    deterministic data schedule where it stopped (frontend cli ``train``).
+    Sharded arrays gather to host here (GB-scale at real sizes — fine for
+    the capability tier this training step targets).
     """
     import numpy as np
 
@@ -93,12 +95,13 @@ def save_train_state(path: str, spec: TransformerSpec, params: dict[str, Any],
     with open(path, "wb") as fh:  # file object: savez must not append .npz
         np.savez(fh, __version__=_TRAIN_CKPT_VERSION,
                  __header__=np.frombuffer(spec.header(), dtype=np.int32),
-                 __n_leaves__=len(leaves), **payload)
+                 __step__=int(step), __n_leaves__=len(leaves), **payload)
 
 
 def load_train_state(path: str, spec: TransformerSpec, params_template,
-                     opt_state_template):
-    """Restore (params, opt_state) saved by save_train_state.
+                     opt_state_template, return_step: bool = False):
+    """Restore (params, opt_state) saved by save_train_state (with
+    ``return_step`` also the saved step counter).
 
     ``*_template`` supply the pytree structure and per-leaf shardings (a
     fresh ``init_fn(params)`` result); every loaded leaf is device_put with
@@ -118,6 +121,7 @@ def load_train_state(path: str, spec: TransformerSpec, params_template,
                 "train checkpoint header does not match the model spec "
                 f"({np.frombuffer(header, np.int32).tolist()} vs "
                 f"{np.frombuffer(spec.header(), np.int32).tolist()})")
+        step = int(z["__step__"]) if "__step__" in z.files else 0
         leaves = [z[f"leaf_{i}"] for i in range(int(z["__n_leaves__"]))]
     template = (params_template, opt_state_template)
     paths_and_leaves, treedef = jax.tree_util.tree_flatten_with_path(template)
@@ -158,4 +162,5 @@ def load_train_state(path: str, spec: TransformerSpec, params_template,
                 "exact resume needs matching precision")
         put.append(jax.device_put(jnp.asarray(loaded),
                                   leaf_sharding(path, tmpl)))
-    return jax.tree_util.tree_unflatten(treedef, put)
+    state = jax.tree_util.tree_unflatten(treedef, put)
+    return (*state, step) if return_step else state

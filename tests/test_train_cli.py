@@ -1,0 +1,110 @@
+"""`train` CLI mode: loss goes down, and a checkpointed split run
+reproduces the unsplit run's losses step for step."""
+
+import re
+
+import numpy as np
+import pytest
+
+from distributed_llama_tpu.io.loader import write_model
+from distributed_llama_tpu.io.tokenizer import write_tokenizer
+from distributed_llama_tpu.models.spec import TransformerSpec
+from distributed_llama_tpu.ops.quants import FloatType
+
+SPEC = TransformerSpec(dim=64, hidden_dim=160, n_layers=2, n_heads=4,
+                       n_kv_heads=2, vocab_size=300, seq_len=32)
+
+
+@pytest.fixture(scope="module")
+def files(tmp_path_factory):
+    d = tmp_path_factory.mktemp("t")
+    rng = np.random.default_rng(5)
+
+    def t(*shape):
+        return (rng.standard_normal(shape) * 0.1).astype(np.float32)
+
+    tensors = {"tok_embedding": t(SPEC.vocab_size, SPEC.dim),
+               "rms_att": 1 + t(SPEC.n_layers, SPEC.dim),
+               "rms_ffn": 1 + t(SPEC.n_layers, SPEC.dim),
+               "rms_final": 1 + t(SPEC.dim),
+               "wcls": t(SPEC.vocab_size, SPEC.dim)}
+    for name, shape in SPEC.layer_matmul_shapes():
+        tensors[name] = t(SPEC.n_layers, *shape)
+    f32 = str(d / "m32.bin")
+    write_model(f32, SPEC, tensors)
+    q40_spec = TransformerSpec(**{**SPEC.__dict__,
+                                  "weights_float_type": FloatType.Q40})
+    q40 = str(d / "m40.bin")
+    write_model(q40, q40_spec, tensors)
+
+    pieces = [b"<unk>", b"<s>", b"</s>"]
+    pieces += [f"<0x{i:02X}>".encode() for i in range(256)]
+    while len(pieces) < SPEC.vocab_size:
+        pieces.append(f"tok{len(pieces)}".encode())
+    tok = str(d / "tok.bin")
+    write_tokenizer(tok, pieces, [0.0] * len(pieces))
+
+    data = str(d / "corpus.txt")
+    with open(data, "w") as fh:
+        fh.write("the quick brown fox jumps over the lazy dog " * 40)
+    return f32, q40, tok, data
+
+
+def _losses(out: str) -> list[float]:
+    return [float(m.group(1))
+            for m in re.finditer(r"loss\s+([0-9.]+)", out)]
+
+
+def test_train_cli_loss_decreases(files, capsys):
+    from distributed_llama_tpu.frontend.cli import main
+
+    f32, _, tok, data = files
+    assert main(["train", "--model", f32, "--tokenizer", tok,
+                 "--data", data, "--steps", "6", "--batch", "4",
+                 "--seq", "16", "--learning-rate", "3e-3",
+                 "--dp", "2", "--tp", "2"]) == 0
+    losses = _losses(capsys.readouterr().out)
+    assert len(losses) == 6
+    assert losses[-1] < losses[0]
+
+
+def test_train_cli_split_resume_matches_unsplit(files, tmp_path, capsys):
+    from distributed_llama_tpu.frontend.cli import main
+
+    f32, _, tok, data = files
+    base = ["--model", f32, "--tokenizer", tok, "--data", data,
+            "--batch", "2", "--seq", "16", "--learning-rate", "3e-3",
+            "--seed", "3", "--dp", "1", "--tp", "2"]
+    assert main(["train", *base, "--steps", "4"]) == 0
+    full = _losses(capsys.readouterr().out)
+
+    ck = str(tmp_path / "t.ckpt")
+    assert main(["train", *base, "--steps", "2", "--save-state", ck]) == 0
+    part1 = _losses(capsys.readouterr().out)
+    assert main(["train", *base, "--steps", "2",
+                 "--resume-state", ck]) == 0
+    out2 = capsys.readouterr().out
+    assert "Resumed training at step 2" in out2
+    part2 = _losses(out2)
+    np.testing.assert_allclose(part1 + part2, full, rtol=1e-6)
+
+
+def test_train_cli_densifies_q40(files, capsys):
+    """A Q40 model file trains after densification (the codec value map)."""
+    from distributed_llama_tpu.frontend.cli import main
+
+    _, q40, tok, data = files
+    assert main(["train", "--model", q40, "--tokenizer", tok,
+                 "--data", data, "--weights-float-type", "q40",
+                 "--steps", "3", "--batch", "2", "--seq", "8",
+                 "--learning-rate", "3e-3"]) == 0
+    losses = _losses(capsys.readouterr().out)
+    assert len(losses) == 3 and np.isfinite(losses).all()
+
+
+def test_train_cli_rejects_bad_seq(files, capsys):
+    from distributed_llama_tpu.frontend.cli import main
+
+    f32, _, tok, data = files
+    assert main(["train", "--model", f32, "--tokenizer", tok,
+                 "--data", data, "--seq", str(SPEC.seq_len)]) == 2
