@@ -381,7 +381,8 @@ def cmd_train(argv: list[str]) -> int:
     from ..io.tokenizer import Tokenizer
     from ..parallel import make_mesh
     from ..parallel.train import (load_train_state, make_train_step,
-                                  save_train_state)
+                                  read_train_meta, save_train_state,
+                                  template_params)
 
     # header-only read: validate flags before streaming multi-GB weights
     spec = read_spec(args.model,
@@ -399,18 +400,28 @@ def cmd_train(argv: list[str]) -> int:
         print(f"corpus has {len(corpus)} tokens; need >= {args.seq + 1}",
               file=sys.stderr)
         return 2
-    _, params = load_model(args.model, spec=spec)
-    params = densify_params(params)
-
     mesh = make_mesh(dp=args.dp, tp=args.tp)
     init_fn, step_fn = make_train_step(spec, mesh,
                                        learning_rate=args.learning_rate)
-    p, o = init_fn(params)
     start = 0
     if args.resume_state:
+        meta = read_train_meta(args.resume_state)
+        if meta.get("data_seed", args.seed) != args.seed:
+            # the data schedule is a pure function of (seed, step): a
+            # different seed silently breaks split == unsplit
+            print(f"--resume-state was trained with --seed "
+                  f"{meta['data_seed']}; pass the same seed (got "
+                  f"{args.seed})", file=sys.stderr)
+            return 2
+        # the checkpoint overwrites every value: a zero template gives the
+        # tree structure/shardings without streaming the model weights
+        p, o = init_fn(template_params(spec))
         p, o, start = load_train_state(args.resume_state, spec, p, o,
                                        return_step=True)
         print(f"⏩ Resumed training at step {start}")
+    else:
+        _, params = load_model(args.model, spec=spec)
+        p, o = init_fn(densify_params(params))
 
     def windows(step: int) -> np.ndarray:
         """(batch, seq+1) token windows — a pure function of (seed, step),
@@ -421,17 +432,15 @@ def cmd_train(argv: list[str]) -> int:
         starts = rng.integers(0, len(corpus) - args.seq, args.batch)
         return np.stack([corpus[s:s + args.seq + 1] for s in starts])
 
-    import time as _time
-
     for step in range(start, start + args.steps):
-        t0 = _time.perf_counter()
+        t0 = time.perf_counter()
         p, o, loss = step_fn(p, o, jnp.asarray(windows(step)))
         loss = float(loss)
         print(f"🔶 step {step:5d}  loss {loss:8.4f}  "
-              f"{(_time.perf_counter() - t0) * 1000:7.1f} ms")
+              f"{(time.perf_counter() - t0) * 1000:7.1f} ms")
     if args.save_state:
         save_train_state(args.save_state, spec, p, o,
-                         step=start + args.steps)
+                         step=start + args.steps, data_seed=args.seed)
         print(f"⏩ Saved training state to {args.save_state} "
               f"(step {start + args.steps})")
     return 0

@@ -76,8 +76,26 @@ def make_train_step(spec: TransformerSpec, mesh: Mesh,
 _TRAIN_CKPT_VERSION = 1
 
 
+def template_params(spec: TransformerSpec) -> dict[str, Any]:
+    """Zero-valued dense f32 params with the training tree's structure and
+    shapes — the resume-path template (structure/shardings only; values are
+    immediately overwritten by load_train_state, so streaming real weights
+    for them would waste a multi-GB read)."""
+    import numpy as np
+
+    p = {"tok_embedding": np.zeros((spec.vocab_size, spec.dim), np.float32),
+         "rms_att": np.zeros((spec.n_layers, spec.dim), np.float32),
+         "rms_ffn": np.zeros((spec.n_layers, spec.dim), np.float32),
+         "rms_final": np.zeros((spec.dim,), np.float32),
+         "wcls": np.zeros((spec.vocab_size, spec.dim), np.float32)}
+    for name, shape in spec.layer_matmul_shapes():
+        p[name] = np.zeros((spec.n_layers, *shape), np.float32)
+    return p
+
+
 def save_train_state(path: str, spec: TransformerSpec, params: dict[str, Any],
-                     opt_state, step: int = 0) -> None:
+                     opt_state, step: int = 0,
+                     data_seed: int | None = None) -> None:
     """Persist a training state (params + optimizer moments) to one .npz.
 
     The reference has no training at all, so there is no format to match;
@@ -92,10 +110,24 @@ def save_train_state(path: str, spec: TransformerSpec, params: dict[str, Any],
 
     leaves, _ = jax.tree_util.tree_flatten((params, opt_state))
     payload = {f"leaf_{i}": np.asarray(leaf) for i, leaf in enumerate(leaves)}
+    if data_seed is not None:
+        payload["__data_seed__"] = int(data_seed)
     with open(path, "wb") as fh:  # file object: savez must not append .npz
         np.savez(fh, __version__=_TRAIN_CKPT_VERSION,
                  __header__=np.frombuffer(spec.header(), dtype=np.int32),
                  __step__=int(step), __n_leaves__=len(leaves), **payload)
+
+
+def read_train_meta(path: str) -> dict[str, int]:
+    """Cheap metadata peek (step counter, data seed if stored) — lets the
+    CLI validate a resume's schedule inputs before touching any state."""
+    import numpy as np
+
+    with np.load(path) as z:
+        meta = {"step": int(z["__step__"]) if "__step__" in z.files else 0}
+        if "__data_seed__" in z.files:
+            meta["data_seed"] = int(z["__data_seed__"])
+    return meta
 
 
 def load_train_state(path: str, spec: TransformerSpec, params_template,

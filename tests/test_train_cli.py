@@ -88,6 +88,12 @@ def test_train_cli_split_resume_matches_unsplit(files, tmp_path, capsys):
     part2 = _losses(out2)
     np.testing.assert_allclose(part1 + part2, full, rtol=1e-6)
 
+    # resuming with a different --seed would silently change the data
+    # schedule: refused (the checkpoint records the seed)
+    wrong = [a if a != "3" else "4" for a in base]
+    assert main(["train", *wrong, "--steps", "2",
+                 "--resume-state", ck]) == 2
+
 
 def test_train_cli_densifies_q40(files, capsys):
     """A Q40 model file trains after densification (the codec value map)."""
