@@ -206,6 +206,26 @@ def test_continuous_randomized_workloads_agree(params, case_seed):
     assert outputs(block_steps=4, prefill_chunk=3) == ref
 
 
+def test_continuous_bf16_cache_greedy_matches_f32(params):
+    """--kv-cache-dtype bf16 through the continuous engine (per-row cache
+    writes cast, fused chains, admission prefill): greedy streams on this
+    tiny model should survive the cache rounding and match f32."""
+    import jax.numpy as jnp
+
+    from distributed_llama_tpu.runtime.continuous import ContinuousEngine
+
+    steps = 8
+    reqs = [[1, 5, 9], [1, 22], [1, 7, 33, 2]]
+    ref, _ = ContinuousEngine(SPEC, params, slots=2, temperature=0.0,
+                              topp=0.9, seed=3).run(reqs, steps)
+    got, _ = ContinuousEngine(SPEC, params, slots=2, temperature=0.0,
+                              topp=0.9, seed=3,
+                              cache_dtype=jnp.bfloat16,
+                              prefill_chunk=2, block_steps=4).run(reqs,
+                                                                  steps)
+    assert got == ref
+
+
 def test_continuous_pos_never_reaches_seq_len(params):
     """A retired row's clock can hit seq_len; the freed slot must be parked
     back at pos 0 before the next device step — pos == seq_len reaching the

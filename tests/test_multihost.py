@@ -65,7 +65,8 @@ def _free_port():
         return s.getsockname()[1]
 
 
-def _run(mode, model, tok, host_id, coordinator, n_devices, cwd, tp=2):
+def _run(mode, model, tok, host_id, coordinator, n_devices, cwd, tp=2,
+         extra=()):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = (f"--xla_force_host_platform_device_count={n_devices}")
@@ -74,7 +75,7 @@ def _run(mode, model, tok, host_id, coordinator, n_devices, cwd, tp=2):
     args = [sys.executable, "-m", "distributed_llama_tpu.frontend.cli", mode,
             "--model", model, "--tokenizer", tok, "--prompt", "hi",
             "--steps", "6", "--temperature", "0.9", "--topp", "0.9",
-            "--seed", "11", "--tp", str(tp)]
+            "--seed", "11", "--tp", str(tp), *extra]
     if coordinator:
         args += ["--coordinator", coordinator, "--num-hosts", "2",
                  "--host-id", str(host_id)]
@@ -135,3 +136,37 @@ def test_two_hosts_two_devices_each(tmp_path):
     assert root.returncode == 0, f"root: {err_root[-2000:]}"
     assert worker.returncode == 0, f"worker: {err_worker[-2000:]}"
     assert _pieces(out_root) == want, out_root
+
+
+def test_two_process_batch_prompts_file(tmp_path):
+    """The lockstep batch path (--prompts-file --tp) across two real
+    processes: the sharded batch step's collectives ride DCN, every host
+    runs the same fused loop, and the root's rows equal the single-process
+    rows."""
+    model, tok = _write_model_files(tmp_path)
+    pf = str(tmp_path / "prompts.txt")
+    with open(pf, "w") as fh:
+        fh.write("hi\nhi hi\n")
+    cwd = str(tmp_path)
+    extra = ("--prompts-file", pf)
+
+    import re
+
+    def rows(out):  # "[0] '...'" rows only (Gloo logs also start with "[")
+        return [ln for ln in out.splitlines()
+                if re.match(r"^\[\d+\] ", ln)]
+
+    p = _run("inference", model, tok, None, None, 2, cwd, extra=extra)
+    out_single, err = p.communicate(timeout=300)
+    assert p.returncode == 0, err[-2000:]
+    want = rows(out_single)
+    assert len(want) == 2, out_single
+
+    coord = f"127.0.0.1:{_free_port()}"
+    root = _run("inference", model, tok, 0, coord, 1, cwd, extra=extra)
+    worker = _run("worker", model, tok, 1, coord, 1, cwd, extra=extra)
+    out_root, err_root = root.communicate(timeout=360)
+    out_worker, err_worker = worker.communicate(timeout=60)
+    assert root.returncode == 0, f"root: {err_root[-2000:]}"
+    assert worker.returncode == 0, f"worker: {err_worker[-2000:]}"
+    assert rows(out_root) == want, out_root
