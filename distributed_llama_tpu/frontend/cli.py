@@ -87,8 +87,8 @@ def cmd_inference(argv: list[str], quiet: bool = False) -> int:
                          "ignored; --steps more positions run)")
     ap.add_argument("--prompts-file", default=None, metavar="PATH",
                     help="batch mode: one prompt per line, decoded in one "
-                         "fused lockstep batch (composes with --tp; a "
-                         "capability the reference lacks). Ignores "
+                         "fused lockstep batch (composes with --tp and "
+                         "--sp; a capability the reference lacks). Ignores "
                          "--prompt/--fast/checkpoint flags")
     ap.add_argument("--continuous", action="store_true",
                     help="with --prompts-file: continuous batching — a pool "
@@ -147,11 +147,6 @@ def cmd_inference(argv: list[str], quiet: bool = False) -> int:
         print(f"--slots must be positive, got {args.slots}", file=sys.stderr)
         return 2
     if args.prompts_file:  # validate before the multi-GB model load
-        if args.sp > 1:
-            # batch decode composes with tp (sharded step) but not sp
-            print("batch mode (--prompts-file) does not compose with --sp",
-                  file=sys.stderr)
-            return 2
         if args.prefill_chunk > 1 and not args.continuous:
             # lockstep rows share one position clock: per-row prompt
             # prefill would desync them — only --continuous prefills
@@ -177,7 +172,8 @@ def cmd_inference(argv: list[str], quiet: bool = False) -> int:
               f"💡 vocabSize: {spec.vocab_size}\n💡 seqLen: {spec.seq_len}")
     n_dev = len(jax.devices())
     if prompts is not None:
-        tp = args.tp or 1  # batch mode: single-chip unless --tp asks for slices
+        # batch mode: single-chip unless --tp/--sp ask for a sharded step
+        tp = args.tp or 1
     else:
         tp = args.tp or max(1, n_dev // args.sp)
     if not quiet:

@@ -287,11 +287,10 @@ def make_sharded_forward(spec: TransformerSpec, mesh: Mesh):
     return jax.jit(wrap, donate_argnums=1)
 
 
-# batched cache (L, B, S, n_kv, hs): kv heads over tp; batch lockstep decode
-# has no sp composition (the shared-pos cache update and the sp ring combine
-# are orthogonal carries — future work, PARITY.md)
-CACHE_SPEC_BATCH = KVCache(P(None, None, None, "tp", None),
-                           P(None, None, None, "tp", None))
+# batched cache (L, B, S, n_kv, hs): sequence chunks over sp, kv heads
+# over tp — the same axes as the single-sequence CACHE_SPEC, one batch dim in
+CACHE_SPEC_BATCH = KVCache(P(None, None, "sp", "tp", None),
+                           P(None, None, "sp", "tp", None))
 
 
 def shard_cache_batch(cache: KVCache, mesh: Mesh) -> KVCache:
@@ -300,33 +299,73 @@ def shard_cache_batch(cache: KVCache, mesh: Mesh) -> KVCache:
         cache, CACHE_SPEC_BATCH)
 
 
+def _batch_sp_attention(spec: TransformerSpec, seq_chunk: int, q, k, v,
+                        k_all, v_all, idx, pos, kv_loc: int, hs: int):
+    """Batch decode attention over the sp-sharded cache: the single-sequence
+    sp primitives (ring.update_sp_cache / sp_cache_attention — per-chunk
+    masked writes, LSE-combined partials over the sp axis) vmapped over the
+    batch rows, each with its own position clock. The pmax/psum inside the
+    LSE combine batch cleanly under vmap (per-row independent reductions).
+
+    q (B, n_q_loc*hs); k/v (B, kv_loc*hs); k/v_all (L*B, C, kv_loc, hs)
+    rank-4 carries of the sp-LOCAL chunks. Returns (ao, k_all, v_all).
+    """
+    from .ring import sp_cache_attention, update_sp_cache
+
+    B = q.shape[0]
+    sp_index = jax.lax.axis_index("sp")
+    pos_b = jnp.broadcast_to(jnp.asarray(pos, jnp.int32), (B,))
+    k_c = jax.lax.dynamic_slice_in_dim(k_all, idx * B, B, 0)
+    v_c = jax.lax.dynamic_slice_in_dim(v_all, idx * B, B, 0)
+
+    def upd(chunk, new, p):
+        return update_sp_cache(chunk, new, p, sp_index, seq_chunk)
+
+    k_c = jax.vmap(upd)(k_c, k.reshape(B, 1, kv_loc, hs).astype(k_all.dtype),
+                        pos_b)
+    v_c = jax.vmap(upd)(v_c, v.reshape(B, 1, kv_loc, hs).astype(v_all.dtype),
+                        pos_b)
+    k_all = jax.lax.dynamic_update_slice(k_all, k_c, (idx * B, 0, 0, 0))
+    v_all = jax.lax.dynamic_update_slice(v_all, v_c, (idx * B, 0, 0, 0))
+
+    def att(q1, kc, vc, p):
+        return sp_cache_attention(hs, spec.kv_mul, seq_chunk, sp_index,
+                                  q1, kc, vc, p)
+
+    ao = jax.vmap(att)(q.reshape(B, 1, -1, hs), k_c, v_c, pos_b)  # (B, 1, d)
+    return ao.reshape(B, -1), k_all, v_all
+
+
 def make_sharded_forward_batch(spec: TransformerSpec, mesh: Mesh):
-    """Tensor-parallel lockstep batch decode step (forward_batch over tp).
+    """Tensor/sequence-parallel lockstep batch decode step (forward_batch
+    over the mesh).
 
     Returns fn(params, cache, tokens (B,), pos) -> (logits (B, vocab), cache)
-    with cache (L, B, S, n_kv, hs) kv-head-sharded over tp. Per-row math ==
-    models/llama.forward_batch (same kernels; pos is a shared scalar clock
-    for the lockstep loop or a (B,) vector for continuous batching, exactly
-    as in forward_batch); per-layer collectives == make_sharded_forward's
-    (the four all_gathers now carry B rows each). Gates: tp ∈ {2, 4}
-    logits/tokens match the single-chip batch path (tests/test_batch_tp.py)
-    and the single-chip continuous scheduler (tests/test_continuous.py).
+    with cache (L, B, S, n_kv, hs) sequence-chunked over sp and
+    kv-head-sharded over tp. Per-row math == models/llama.forward_batch
+    (same kernels; pos is a shared scalar clock for the lockstep loop or a
+    (B,) vector for continuous batching, exactly as in forward_batch);
+    per-layer collectives == make_sharded_forward's (the four all_gathers
+    now carry B rows each, plus the per-row LSE combine over sp). Gates:
+    tp ∈ {2, 4} and sp ∈ {2, 4} logits/tokens match the single-chip batch
+    path (tests/test_batch_tp.py) and the single-chip continuous scheduler
+    (tests/test_continuous.py).
     """
     n_slices = mesh.shape["tp"]
-    if mesh.shape.get("sp", 1) != 1:
-        raise ValueError("batch decode does not compose with sp (PARITY.md)")
+    n_sp = mesh.shape.get("sp", 1)
     validate_sharding(spec, mesh)
     kv_loc = spec.n_kv_heads // n_slices
     L, S, hs = spec.n_layers, spec.seq_len, spec.head_size
+    C = S // n_sp  # sp-local sequence chunk
 
     def local_step(params, cache, tokens, pos):
         B = tokens.shape[0]
         x = params["tok_embedding"][tokens].astype(jnp.float32)  # (B, dim)
         positions = pos if jnp.ndim(pos) == 1 else jnp.full((B,), pos)
-        # rank-4 (L*B, S, kv_loc, hs) carry view — same layout rationale as
+        # rank-4 (L*B, C, kv_loc, hs) carry view — same layout rationale as
         # forward_batch (row layer*B+b is a single-sequence cache plane)
-        k4 = cache.k.reshape(L * B, S, kv_loc, hs)
-        v4 = cache.v.reshape(L * B, S, kv_loc, hs)
+        k4 = cache.k.reshape(L * B, C, kv_loc, hs)
+        v4 = cache.v.reshape(L * B, C, kv_loc, hs)
         stacked, scanned = split_layer_weights(params)
 
         def body(carry, per_layer):
@@ -334,11 +373,14 @@ def make_sharded_forward_batch(spec: TransformerSpec, mesh: Mesh):
             idx, lw_slice = per_layer
             lw = layer_view(stacked, lw_slice, idx)
             q, k, v = _tp_qkv(spec, lw, x, positions)
-            # shared with the single-chip batch path; the shard's cache holds
-            # kv_loc heads, which batch_decode_attention reads off the carry
-            ao, k_all, v_all = batch_decode_attention(hs, spec.kv_mul, S,
-                                                      q, k, v, k_all, v_all,
-                                                      idx, pos)
+            if n_sp == 1:
+                # shared with the single-chip batch path; the shard's cache
+                # holds kv_loc heads, read off the carry
+                ao, k_all, v_all = batch_decode_attention(
+                    hs, spec.kv_mul, S, q, k, v, k_all, v_all, idx, pos)
+            else:
+                ao, k_all, v_all = _batch_sp_attention(
+                    spec, C, q, k, v, k_all, v_all, idx, pos, kv_loc, hs)
             x = _tp_tail(spec, x, lw, ao)
             return (x, k_all, v_all), None
 
@@ -346,8 +388,8 @@ def make_sharded_forward_batch(spec: TransformerSpec, mesh: Mesh):
         (x, k4, v4), _ = jax.lax.scan(body, (x, k4, v4), (idxs, scanned))
         x = rmsnorm(x, params["rms_final"])
         logits = _gather(matmul(params["wcls"], x))
-        return logits, KVCache(k4.reshape(L, B, S, kv_loc, hs),
-                               v4.reshape(L, B, S, kv_loc, hs))
+        return logits, KVCache(k4.reshape(L, B, C, kv_loc, hs),
+                               v4.reshape(L, B, C, kv_loc, hs))
 
     def wrap(params, cache, tokens, pos):
         in_specs = (param_specs(params), CACHE_SPEC_BATCH, P(), P())

@@ -102,9 +102,11 @@ def test_continuous_more_requests_than_slots(params, params_dev):
     assert steps <= stats.steps <= 5 * steps
 
 
-def test_continuous_over_tp_mesh_matches_single_chip(params):
-    """The same request stream through a tp=2 sharded ragged step must be
-    token-identical to the single-chip continuous engine."""
+@pytest.mark.parametrize("sp,tp", [(1, 2), (2, 1), (2, 2)])
+def test_continuous_over_mesh_matches_single_chip(params, sp, tp):
+    """The same request stream through an sp/tp sharded ragged step must be
+    token-identical to the single-chip continuous engine (per-row position
+    clocks through the sequence-chunked cache)."""
     from distributed_llama_tpu.parallel import make_mesh
     from distributed_llama_tpu.runtime.continuous import ContinuousEngine
 
@@ -115,7 +117,7 @@ def test_continuous_over_tp_mesh_matches_single_chip(params):
     ref, _ = ref_eng.run(reqs, steps)
 
     eng = ContinuousEngine(SPEC, params, slots=2, temperature=0.0, topp=0.9,
-                           seed=3, mesh=make_mesh(tp=2))
+                           seed=3, mesh=make_mesh(sp=sp, tp=tp))
     outs, _ = eng.run(reqs, steps)
     assert outs == ref
 

@@ -171,9 +171,13 @@ def test_cli_batch_prompts_file(model_files, tmp_path, capsys):
     rows_tp = [ln for ln in out.splitlines() if ln.startswith("[")]
     assert rows_tp == rows
 
-    # batch mode refuses sp (no composition; clear error, exit 2)
+    # batch over an sp=2 mesh (sequence-chunked cache + per-row LSE
+    # combine): identical rows again
     assert main(["inference", *base[:-2], "--tp", "1", "--sp", "2",
-                 "--prompts-file", str(pf)]) == 2
+                 "--prompts-file", str(pf)]) == 0
+    out = capsys.readouterr().out
+    rows_sp = [ln for ln in out.splitlines() if ln.startswith("[")]
+    assert rows_sp == rows
 
     # continuous batching through a 1-slot pool: the two prompts stream
     # through sequentially; greedy rows must still match
