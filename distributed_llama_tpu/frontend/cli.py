@@ -305,8 +305,7 @@ def cmd_serve(argv: list[str]) -> int:
                     choices=("f32", "bf16"))
     ap.add_argument("--prefill-chunk", type=int, default=128, metavar="N",
                     help="admission prefill: fill a new request's prompt "
-                         "in T=N chunked passes (0/1 disables; single-chip "
-                         "engines only)")
+                         "in T=N chunked passes (0/1 disables)")
     ap.add_argument("--block-steps", type=int, default=1, metavar="K",
                     help="fuse K decode steps into one device dispatch "
                          "(admission + per-token streaming at chain "

@@ -116,11 +116,25 @@ class ContinuousEngine:
         self.block_steps = block_steps  # >1: fused K-step chains (step_many)
         dtype = cache_dtype or jnp.float32
         self._cache_dtype = dtype
+        from ..models.llama import KVCache, forward, init_cache
+
+        def _insert(cache_b, c1, b):
+            # write sequence-cache planes (L, S, kv, hs) into row b of the
+            # batched (L, B, S, kv, hs) cache, in place (the sharded case
+            # is pure per-shard work: the two caches share the S/kv-head
+            # sharding axes, and the batch axis is unsharded)
+            return KVCache(
+                jax.lax.dynamic_update_slice(
+                    cache_b.k, c1.k[:, None], (0, b, 0, 0, 0)),
+                jax.lax.dynamic_update_slice(
+                    cache_b.v, c1.v[:, None], (0, b, 0, 0, 0)))
+
         if mesh is not None and (mesh.shape["tp"] > 1
                                  or mesh.shape.get("sp", 1) > 1):
-            # tensor-parallel step: same sharded program as the lockstep
-            # batch path, driven with a (B,) position vector
-            from ..parallel import (make_sharded_forward_batch,
+            # sharded step: same program as the lockstep batch path, driven
+            # with a (B,) position vector
+            from ..parallel import (make_sharded_forward,
+                                    make_sharded_forward_batch, shard_cache,
                                     shard_cache_batch, shard_params,
                                     validate_sharding)
 
@@ -129,32 +143,28 @@ class ContinuousEngine:
             self.cache = shard_cache_batch(
                 init_cache_batch(spec, slots, dtype), mesh)
             self._step = make_sharded_forward_batch(spec, mesh)
+            if prefill_chunk > 1:
+                # admission prefill: the sharded single-sequence forward
+                # (T=chunk under sp/tp) fills a sharded scratch cache
+                self._prefill_fwd = make_sharded_forward(spec, mesh)
+                self._scratch_cache = lambda: shard_cache(
+                    init_cache(spec, dtype), mesh)
         else:
-            from ..models.llama import KVCache, forward
-
             self.params = params_to_device(params)
             self.cache = init_cache_batch(spec, slots, dtype)
             self._step = jax.jit(
                 functools.partial(forward_batch_ragged, spec),
                 donate_argnums=1)
             if prefill_chunk > 1:
-                # admission prefill (single-chip only): single-sequence
-                # T=chunk forward into a scratch cache + plane insert
+                # admission prefill: single-sequence T=chunk forward into a
+                # scratch cache + plane insert
                 self._prefill_fwd = jax.jit(functools.partial(forward, spec),
                                             donate_argnums=1)
-
-                def _insert(cache_b, c1, b):
-                    # write sequence-cache planes (L, S, kv, hs) into row b
-                    # of the batched (L, B, S, kv, hs) cache, in place
-                    return KVCache(
-                        jax.lax.dynamic_update_slice(
-                            cache_b.k, c1.k[:, None], (0, b, 0, 0, 0)),
-                        jax.lax.dynamic_update_slice(
-                            cache_b.v, c1.v[:, None], (0, b, 0, 0, 0)))
-
-                # donate only the batched cache (updated in place); the
-                # scratch sequence cache can't alias the rank-5 output
-                self._insert = jax.jit(_insert, donate_argnums=0)
+                self._scratch_cache = lambda: init_cache(spec, dtype)
+        if prefill_chunk > 1:
+            # donate only the batched cache (updated in place); the scratch
+            # sequence cache can't alias the rank-5 output
+            self._insert = jax.jit(_insert, donate_argnums=0)
         self._pool = [_Slot() for _ in range(slots)]
         self._queue: list[Request] = []
         self._lock = threading.Lock()
@@ -366,21 +376,22 @@ class ContinuousEngine:
         prefix in T=chunk single-sequence passes (Engine.prefill's scheme:
         fixed chunks, pad-safe, junk-invisible) and park the slot at the
         last prompt token — long prompts stop crawling through per-token
-        steps. Same gates as generate._prefill_prefix: off for short
-        prompts, prompts that exceed the budget (the forced-echo output is
-        load-bearing), or a mid-stream BOS (only the step loop reproduces
-        that early stop)."""
+        steps. On sharded engines the scratch cache and forward are the
+        sharded single-sequence ones (same S/kv sharding axes as the
+        batched cache, so the insert is pure per-shard work). Same gates
+        as generate._prefill_prefix: off for short prompts, prompts that
+        exceed the budget (the forced-echo output is load-bearing), or a
+        mid-stream BOS (only the step loop reproduces that early stop)."""
         chunk = self.prefill_chunk
         tokens = s.req.tokens
         n_pre = len(tokens) - 1
         if (getattr(self, "_prefill_fwd", None) is None or chunk <= 1
                 or n_pre < 2 or n_pre >= s.budget or BOS in tokens[1:]):
             return
-        from ..models.llama import init_cache
         from .generate import run_chunked_prefill
 
         jnp = self.jnp
-        cache_box = [init_cache(self.spec, self._cache_dtype)]
+        cache_box = [self._scratch_cache()]
 
         def fwd(part, start):
             _, cache_box[0] = self._prefill_fwd(

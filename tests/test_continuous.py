@@ -106,7 +106,8 @@ def test_continuous_more_requests_than_slots(params, params_dev):
 def test_continuous_over_mesh_matches_single_chip(params, sp, tp):
     """The same request stream through an sp/tp sharded ragged step must be
     token-identical to the single-chip continuous engine (per-row position
-    clocks through the sequence-chunked cache)."""
+    clocks through the sequence-chunked cache) — with and without sharded
+    admission prefill."""
     from distributed_llama_tpu.parallel import make_mesh
     from distributed_llama_tpu.runtime.continuous import ContinuousEngine
 
@@ -120,6 +121,14 @@ def test_continuous_over_mesh_matches_single_chip(params, sp, tp):
                            seed=3, mesh=make_mesh(sp=sp, tp=tp))
     outs, _ = eng.run(reqs, steps)
     assert outs == ref
+
+    eng_p = ContinuousEngine(SPEC, params, slots=2, temperature=0.0,
+                             topp=0.9, seed=3, mesh=make_mesh(sp=sp, tp=tp),
+                             prefill_chunk=2)
+    outs_p, stats_p = eng_p.run(reqs, steps)
+    assert outs_p == ref
+    # the prefilled rows skipped their prompt steps on the device
+    assert stats_p.steps < eng.stats.steps
 
 
 @pytest.mark.parametrize("temp,block,tp", [(0.0, 4, 1), (0.9, 4, 1),
