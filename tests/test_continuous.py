@@ -215,6 +215,11 @@ def test_continuous_randomized_workloads_agree(params, case_seed):
     assert outputs(block_steps=int(rng.integers(2, 6))) == ref
     assert outputs(prefill_chunk=int(rng.integers(2, 6))) == ref
     assert outputs(block_steps=4, prefill_chunk=3) == ref
+    # everything at once: sharded step + fused chains + admission prefill
+    from distributed_llama_tpu.parallel import make_mesh
+
+    assert outputs(mesh=make_mesh(sp=2, tp=2), block_steps=3,
+                   prefill_chunk=2) == ref
 
 
 def test_continuous_bf16_cache_greedy_matches_f32(params):
