@@ -1,0 +1,65 @@
+"""Deep-GQA (kv_mul=8, the Llama-2-70B head ratio: 64 q heads over 8 kv
+heads) through the model-level decode paths — the north-star config's
+grouping math at tp-sharded and fully-composed-scheduler scope. (The flash
+KERNELS' kv_mul=8 unroll is pinned where the other kv_mul cases live:
+tests/test_pallas_attention.py's parametrize lists.)"""
+
+import numpy as np
+import pytest
+
+from distributed_llama_tpu.models.spec import TransformerSpec
+from distributed_llama_tpu.models.synth import synth_params
+from distributed_llama_tpu.parallel import make_mesh
+
+# kv_mul = 16/2 = 8, and 2 kv heads still shard over tp=2
+SPEC = TransformerSpec(dim=128, hidden_dim=256, n_layers=2, n_heads=16,
+                       n_kv_heads=2, vocab_size=96, seq_len=16)
+
+assert SPEC.kv_mul == 8
+
+
+@pytest.fixture(scope="module")
+def params():
+    return synth_params(SPEC, q40=False, seed=12, scale=0.2)
+
+
+def test_deep_gqa_tp_parity(params):
+    """tp-sharded decode == single chip at kv_mul=8 (grouped heads stay
+    whole within each contiguous band)."""
+    import jax.numpy as jnp
+
+    from distributed_llama_tpu.models.llama import (forward, init_cache,
+                                                    params_to_device)
+    from distributed_llama_tpu.parallel import (make_sharded_forward,
+                                                shard_cache, shard_params)
+
+    dev = params_to_device(params)
+    c = init_cache(SPEC)
+    want = []
+    for pos, t in enumerate((7, 11, 3)):
+        lg, c = forward(SPEC, dev, c, jnp.asarray([t], jnp.int32),
+                        jnp.int32(pos))
+        want.append(np.asarray(lg))
+
+    mesh = make_mesh(tp=2)
+    fwd = make_sharded_forward(SPEC, mesh)
+    ps = shard_params(params, mesh)
+    cs = shard_cache(init_cache(SPEC), mesh)
+    for pos, t in enumerate((7, 11, 3)):
+        lg, cs = fwd(ps, cs, jnp.asarray([t], jnp.int32), jnp.int32(pos))
+        np.testing.assert_allclose(np.asarray(lg), want[pos],
+                                   rtol=2e-5, atol=2e-5)
+
+
+def test_deep_gqa_continuous_composed(params):
+    """Continuous batching with everything on (sp/tp mesh, fused chains,
+    prefill) at kv_mul=8 == the single-chip scheduler."""
+    from distributed_llama_tpu.runtime.continuous import ContinuousEngine
+
+    reqs = [[1, 5, 9], [1, 22], [1, 7, 33, 2]]
+    ref, _ = ContinuousEngine(SPEC, params, slots=2, temperature=0.9,
+                              topp=0.9, seed=3).run(reqs, 8)
+    got, _ = ContinuousEngine(SPEC, params, slots=2, temperature=0.9,
+                              topp=0.9, seed=3, mesh=make_mesh(sp=2, tp=2),
+                              block_steps=3, prefill_chunk=2).run(reqs, 8)
+    assert got == ref

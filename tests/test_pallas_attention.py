@@ -5,7 +5,7 @@ import pytest
 
 
 @pytest.mark.parametrize("kv_mul,pos", [(1, 0), (1, 5), (1, 31), (2, 9),
-                                        (4, 17)])
+                                        (4, 17), (8, 9)])
 def test_decode_attention_matches_core(kv_mul, pos):
     import jax.numpy as jnp
 
@@ -30,7 +30,7 @@ def test_decode_attention_matches_core(kv_mul, pos):
                                rtol=1e-5, atol=1e-5)
 
 
-@pytest.mark.parametrize("kv_mul,pos", [(1, 0), (1, 17), (2, 9)])
+@pytest.mark.parametrize("kv_mul,pos", [(1, 0), (1, 17), (2, 9), (8, 9)])
 def test_decode_attention_batch_matches_core(kv_mul, pos):
     import jax.numpy as jnp
 
