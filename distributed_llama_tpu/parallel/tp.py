@@ -30,7 +30,6 @@ transformer.cpp:15).
 
 from __future__ import annotations
 
-import functools
 from typing import Any
 
 import jax

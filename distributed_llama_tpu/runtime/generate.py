@@ -23,7 +23,7 @@ from typing import Any, Callable
 import numpy as np
 
 from ..io.tokenizer import BOS, Tokenizer
-from ..models.llama import KVCache, forward, init_cache
+from ..models.llama import forward, init_cache
 from ..models.spec import TransformerSpec
 from ..parallel.comm_stats import (CommStats, ici_all_gather_bytes,
                                    sp_lse_bytes)
