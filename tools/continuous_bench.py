@@ -1,0 +1,84 @@
+"""Continuous-batching throughput on the current backend.
+
+Measures the slot-pool scheduler end to end (admission, fused chains,
+retirement) at a 7B-shaped Q40 config with synthetic weights — the
+measurement behind BASELINE.md's continuous-batching rows. Runs one warm-up
+pass (compile) and times a second identical pass; stream equality between
+the two passes is asserted (the schedule is deterministic).
+
+Usage:
+  python tools/continuous_bench.py [--slots 4] [--block-steps 16]
+      [--kv-cache-dtype f32|bf16] [--requests 6] [--steps 48] [--small]
+
+On a remote/tunneled runtime, --block-steps 16 amortizes the per-dispatch
+round-trip; --block-steps 1 measures the per-step scheduling floor.
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--slots", type=int, default=4)
+    ap.add_argument("--block-steps", type=int, default=16)
+    ap.add_argument("--kv-cache-dtype", default="f32",
+                    choices=("f32", "bf16"))
+    ap.add_argument("--requests", type=int, default=6)
+    ap.add_argument("--steps", type=int, default=48)
+    ap.add_argument("--small", action="store_true",
+                    help="tiny config for CI/CPU smoke runs")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_llama_tpu.models.spec import TransformerSpec
+    from distributed_llama_tpu.models.synth import synth_q40_fast
+    from distributed_llama_tpu.ops.quants import FloatType
+    from distributed_llama_tpu.runtime.continuous import ContinuousEngine
+
+    print(f"backend: {jax.devices()[0].platform} x{len(jax.devices())}",
+          file=sys.stderr)
+    if args.small:
+        spec = TransformerSpec(dim=256, hidden_dim=704, n_layers=4,
+                               n_heads=4, n_kv_heads=4, vocab_size=1024,
+                               seq_len=256, weights_float_type=FloatType.Q40)
+    else:
+        spec = TransformerSpec(dim=4096, hidden_dim=11008, n_layers=32,
+                               n_heads=32, n_kv_heads=32, vocab_size=32000,
+                               seq_len=2048,
+                               weights_float_type=FloatType.Q40)
+    t0 = time.perf_counter()
+    params = synth_q40_fast(spec)
+    print(f"synth weights: {time.perf_counter() - t0:.1f}s", file=sys.stderr)
+
+    dtype = jnp.bfloat16 if args.kv_cache_dtype == "bf16" else None
+    reqs = [[1, 3 + i % 90, 5 + i % 80][:2 + i % 3]
+            for i in range(args.requests)]
+    t0 = time.perf_counter()
+    eng = ContinuousEngine(spec, params, slots=args.slots, temperature=0.0,
+                           topp=0.9, seed=3, block_steps=args.block_steps,
+                           cache_dtype=dtype)
+    print(f"engine up: {time.perf_counter() - t0:.1f}s", file=sys.stderr)
+
+    t0 = time.perf_counter()
+    outs, _ = eng.run(reqs, steps=args.steps)
+    print(f"warm-up (compile) pass: {time.perf_counter() - t0:.1f}s",
+          file=sys.stderr)
+    t0 = time.perf_counter()
+    outs2, st = eng.run(reqs, steps=args.steps)
+    dt = time.perf_counter() - t0
+    assert outs2 == outs, "non-deterministic schedule?!"
+    print(f"{st.tokens} tokens, {st.steps} device steps, {dt:.2f}s -> "
+          f"{st.tokens / dt:.1f} tok/s ({dt * 1000 / st.steps:.2f} ms/step, "
+          f"slots={args.slots}, block={args.block_steps}, "
+          f"cache={args.kv_cache_dtype})")
+
+
+if __name__ == "__main__":
+    main()
