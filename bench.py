@@ -137,7 +137,6 @@ def main():
     print(f"backend: {jax.devices()[0].platform} x{len(jax.devices())}",
           file=sys.stderr)
 
-    from distributed_llama_tpu.models.spec import TransformerSpec
     from distributed_llama_tpu.ops.quants import FloatType
 
     if args.model:
@@ -146,19 +145,11 @@ def main():
         spec, params = load_model(args.model,
                                   weights_float_type=FloatType.Q40)
     else:
-        from distributed_llama_tpu.models.synth import synth_q40_fast
+        from distributed_llama_tpu.models.synth import (llama2_7b_spec,
+                                                        small_bench_spec,
+                                                        synth_q40_fast)
 
-        if args.small:
-            spec = TransformerSpec(dim=256, hidden_dim=704, n_layers=4,
-                                   n_heads=4, n_kv_heads=4, vocab_size=1024,
-                                   seq_len=256,
-                                   weights_float_type=FloatType.Q40)
-        else:
-            # Llama-2-7B shape (converter header values), Q40, seq 2048
-            spec = TransformerSpec(dim=4096, hidden_dim=11008, n_layers=32,
-                                   n_heads=32, n_kv_heads=32,
-                                   vocab_size=32000, seq_len=2048,
-                                   weights_float_type=FloatType.Q40)
+        spec = small_bench_spec() if args.small else llama2_7b_spec()
         t0 = time.perf_counter()
         params = synth_q40_fast(spec)
         print(f"synth weights: {time.perf_counter() - t0:.1f}s",

@@ -66,3 +66,27 @@ def synth_params(spec: TransformerSpec, q40: bool, seed: int = 0,
         return Q40Weight(qs, d16)
 
     return _build_tree(spec, t, mm)
+
+
+def llama2_7b_spec(**overrides) -> TransformerSpec:
+    """The Llama-2-7B shape (converter header values) at Q40 — THE benchmark
+    config, shared by bench.py and the tools so a shape correction happens
+    in exactly one place."""
+    from ..ops.quants import FloatType
+
+    kw = dict(dim=4096, hidden_dim=11008, n_layers=32, n_heads=32,
+              n_kv_heads=32, vocab_size=32000, seq_len=2048,
+              weights_float_type=FloatType.Q40)
+    kw.update(overrides)
+    return TransformerSpec(**kw)
+
+
+def small_bench_spec(**overrides) -> TransformerSpec:
+    """Tiny Q40 config for CI/CPU smoke runs of the benchmarks."""
+    from ..ops.quants import FloatType
+
+    kw = dict(dim=256, hidden_dim=704, n_layers=4, n_heads=4, n_kv_heads=4,
+              vocab_size=1024, seq_len=256,
+              weights_float_type=FloatType.Q40)
+    kw.update(overrides)
+    return TransformerSpec(**kw)

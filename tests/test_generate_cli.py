@@ -136,6 +136,17 @@ def test_cli_unknown_mode():
     assert main(["frobnicate"]) == 1
 
 
+def test_cli_serve_rejects_bad_slots(model_files):
+    """serve validates --slots before loading anything or binding a port."""
+    from distributed_llama_tpu.frontend.cli import main
+
+    model, tokp = model_files
+    assert main(["serve", "--model", model, "--tokenizer", tokp,
+                 "--slots", "0"]) == 2
+    assert main(["serve", "--model", model, "--tokenizer", tokp,
+                 "--slots", "-2"]) == 2
+
+
 def test_cli_batch_prompts_file(model_files, tmp_path, capsys):
     """--prompts-file decodes B prompts in one lockstep batch; greedy rows
     must equal the corresponding single-prompt runs."""

@@ -114,3 +114,16 @@ def test_train_cli_rejects_bad_seq(files, capsys):
     f32, _, tok, data = files
     assert main(["train", "--model", f32, "--tokenizer", tok,
                  "--data", data, "--seq", str(SPEC.seq_len)]) == 2
+
+
+def test_train_cli_rejects_tiny_corpus(files, tmp_path, capsys):
+    """A corpus too short for one (seq+1)-token window is refused before any
+    weight streaming."""
+    from distributed_llama_tpu.frontend.cli import main
+
+    f32, _, tok, _ = files
+    tiny = str(tmp_path / "tiny.txt")
+    with open(tiny, "w") as fh:
+        fh.write("hi")
+    assert main(["train", "--model", f32, "--tokenizer", tok,
+                 "--data", tiny, "--seq", "16"]) == 2

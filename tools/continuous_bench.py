@@ -37,28 +37,21 @@ def main():
     import jax
     import jax.numpy as jnp
 
-    from distributed_llama_tpu.models.spec import TransformerSpec
-    from distributed_llama_tpu.models.synth import synth_q40_fast
-    from distributed_llama_tpu.ops.quants import FloatType
+    from distributed_llama_tpu.models.synth import (llama2_7b_spec,
+                                                    small_bench_spec,
+                                                    synth_q40_fast)
     from distributed_llama_tpu.runtime.continuous import ContinuousEngine
 
     print(f"backend: {jax.devices()[0].platform} x{len(jax.devices())}",
           file=sys.stderr)
-    if args.small:
-        spec = TransformerSpec(dim=256, hidden_dim=704, n_layers=4,
-                               n_heads=4, n_kv_heads=4, vocab_size=1024,
-                               seq_len=256, weights_float_type=FloatType.Q40)
-    else:
-        spec = TransformerSpec(dim=4096, hidden_dim=11008, n_layers=32,
-                               n_heads=32, n_kv_heads=32, vocab_size=32000,
-                               seq_len=2048,
-                               weights_float_type=FloatType.Q40)
+    spec = small_bench_spec() if args.small else llama2_7b_spec()
     t0 = time.perf_counter()
     params = synth_q40_fast(spec)
     print(f"synth weights: {time.perf_counter() - t0:.1f}s", file=sys.stderr)
 
     dtype = jnp.bfloat16 if args.kv_cache_dtype == "bf16" else None
-    reqs = [[1, 3 + i % 90, 5 + i % 80][:2 + i % 3]
+    # ragged prompts of length 2, 3, 4 cycling
+    reqs = [[1, 3 + i % 90, 5 + i % 80, 7 + i % 70][:2 + i % 3]
             for i in range(args.requests)]
     t0 = time.perf_counter()
     eng = ContinuousEngine(spec, params, slots=args.slots, temperature=0.0,

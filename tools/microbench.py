@@ -129,14 +129,10 @@ def main():
 
     from distributed_llama_tpu.models.llama import (forward, init_cache,
                                                     params_to_device)
-    from distributed_llama_tpu.models.spec import TransformerSpec
-    from distributed_llama_tpu.models.synth import synth_q40_fast
-    from distributed_llama_tpu.ops.quants import FloatType
+    from distributed_llama_tpu.models.synth import (llama2_7b_spec,
+                                                    synth_q40_fast)
 
-    spec = TransformerSpec(dim=4096, hidden_dim=11008, n_layers=args.layers,
-                           n_heads=32, n_kv_heads=32, vocab_size=32000,
-                           seq_len=2048,
-                           weights_float_type=FloatType.Q40)
+    spec = llama2_7b_spec(n_layers=args.layers)
     params = params_to_device(synth_q40_fast(spec))
     step = functools.partial(forward, spec)
 
