@@ -91,6 +91,17 @@ def _pieces(out):
             if ln.startswith("🔶")]
 
 
+def _rows(out, drop_done=False):
+    """Batch/continuous output rows ("[N] '...'") — Gloo connection logs
+    also start with "[", and continuous mode interleaves "[N] done:" lines."""
+    import re as _re
+
+    return [ln for ln in out.splitlines()
+            if _re.match(r"^\[\d+\] ", ln)
+            and not (drop_done and "] done:" in ln)]
+
+
+
 def test_two_process_inference_matches_single(tmp_path):
     model, tok = _write_model_files(tmp_path)
 
@@ -150,16 +161,10 @@ def test_two_process_batch_prompts_file(tmp_path):
     cwd = str(tmp_path)
     extra = ("--prompts-file", pf)
 
-    import re
-
-    def rows(out):  # "[0] '...'" rows only (Gloo logs also start with "[")
-        return [ln for ln in out.splitlines()
-                if re.match(r"^\[\d+\] ", ln)]
-
     p = _run("inference", model, tok, None, None, 2, cwd, extra=extra)
     out_single, err = p.communicate(timeout=300)
     assert p.returncode == 0, err[-2000:]
-    want = rows(out_single)
+    want = _rows(out_single)
     assert len(want) == 2, out_single
 
     coord = f"127.0.0.1:{_free_port()}"
@@ -169,4 +174,33 @@ def test_two_process_batch_prompts_file(tmp_path):
     out_worker, err_worker = worker.communicate(timeout=60)
     assert root.returncode == 0, f"root: {err_root[-2000:]}"
     assert worker.returncode == 0, f"worker: {err_worker[-2000:]}"
-    assert rows(out_root) == want, out_root
+    assert _rows(out_root) == want, out_root
+
+
+def test_two_process_continuous(tmp_path):
+    """Continuous batching across two real processes: both hosts run the
+    SAME deterministic scheduler (admission order, per-request samplers),
+    so every step's sharded collectives line up — the root's rows equal
+    the single-process rows."""
+    model, tok = _write_model_files(tmp_path)
+    pf = str(tmp_path / "prompts.txt")
+    with open(pf, "w") as fh:
+        fh.write("hi\nhi hi\nhi\n")
+    cwd = str(tmp_path)
+    extra = ("--prompts-file", pf, "--continuous", "--slots", "2",
+             "--prefill-chunk", "0")
+
+    p = _run("inference", model, tok, None, None, 2, cwd, extra=extra)
+    out_single, err = p.communicate(timeout=300)
+    assert p.returncode == 0, err[-2000:]
+    want = _rows(out_single, drop_done=True)
+    assert len(want) == 3, out_single
+
+    coord = f"127.0.0.1:{_free_port()}"
+    root = _run("inference", model, tok, 0, coord, 1, cwd, extra=extra)
+    worker = _run("worker", model, tok, 1, coord, 1, cwd, extra=extra)
+    out_root, err_root = root.communicate(timeout=360)
+    out_worker, err_worker = worker.communicate(timeout=60)
+    assert root.returncode == 0, f"root: {err_root[-2000:]}"
+    assert worker.returncode == 0, f"worker: {err_worker[-2000:]}"
+    assert _rows(out_root, drop_done=True) == want, out_root

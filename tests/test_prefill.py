@@ -159,6 +159,22 @@ def test_generate_prefill_on_sharded_engine(params, sp, tp):
     assert got == ref
 
 
+def test_prefill_q80_buffer_parity(params):
+    """Chunked prefill under the Q80 activation-wire mode: the quantize
+    cut points apply identically in T>1 windows, so prefill == stepwise."""
+    import dataclasses
+
+    from distributed_llama_tpu.ops.quants import FloatType
+
+    spec_q80 = dataclasses.replace(SPEC, buffer_float_type=FloatType.Q80)
+    tok = _IdTokenizer()
+    ref, _ = generate(Engine(spec_q80, params), tok, _sampler(), "abcde",
+                      steps=12, quiet=True)
+    got, _ = generate(Engine(spec_q80, params), tok, _sampler(), "abcde",
+                      steps=12, quiet=True, prefill_chunk=4)
+    assert got == ref
+
+
 def test_prefill_gates_off_when_prompt_exceeds_steps(params):
     """Prompt longer than steps: prefill must not engage (the per-token
     path's forced-echo output semantics are load-bearing there)."""

@@ -32,8 +32,9 @@ def files(tmp_path_factory):
         tensors[name] = t(SPEC.n_layers, *shape)
     f32 = str(d / "m32.bin")
     write_model(f32, SPEC, tensors)
-    q40_spec = TransformerSpec(**{**SPEC.__dict__,
-                                  "weights_float_type": FloatType.Q40})
+    import dataclasses
+
+    q40_spec = dataclasses.replace(SPEC, weights_float_type=FloatType.Q40)
     q40 = str(d / "m40.bin")
     write_model(q40, q40_spec, tensors)
 
