@@ -144,7 +144,8 @@ def cmd_inference(argv: list[str], quiet: bool = False) -> int:
               "queue)", file=sys.stderr)
         return 2
     if args.slots < 0:
-        print(f"--slots must be positive, got {args.slots}", file=sys.stderr)
+        print(f"--slots must be non-negative (0 = auto: min(#prompts, 8)), "
+              f"got {args.slots}", file=sys.stderr)
         return 2
     if args.prompts_file:  # validate before the multi-GB model load
         if args.prefill_chunk > 1 and not args.continuous:
@@ -195,7 +196,11 @@ def cmd_inference(argv: list[str], quiet: bool = False) -> int:
                                 slots=args.slots, cache_dtype=cache_dtype,
                                 mesh=mesh, quiet=quiet,
                                 prefill_chunk=args.prefill_chunk,
-                                block_steps=args.block_steps)
+                                block_steps=args.block_steps,
+                                # multi-host: every host must sample the
+                                # identical stream — pin the numpy sampler
+                                # (see sampling.Sampler docstring)
+                                use_native_sampler=not args.coordinator)
             return 0
         from ..runtime.generate import generate_batch
 
@@ -247,6 +252,16 @@ def cmd_inference(argv: list[str], quiet: bool = False) -> int:
                                   prefill_chunk=args.prefill_chunk)
     if args.profile and not quiet:
         print(f"⏩ Profiler trace written to {args.profile}")
+        # the reference-shaped I/T split, profiler-derived (tools/it_split
+        # has the standalone CLI; reference utils.cpp:101-109 semantics)
+        try:
+            from ..utils.it_split import parse_trace, summarize
+
+            summarize(parse_trace(args.profile),
+                      tokens=max(stats.tokens, 1))
+        except Exception as e:  # a malformed trace must not fail the run
+            print(f"💡 I/T split unavailable ({type(e).__name__}: {e}); "
+                  f"run tools/it_split.py on the trace dir", file=sys.stderr)
     if args.save_state:
         from ..io.tokenizer import BOS
         from ..runtime.checkpoint import save_generation_state
@@ -448,6 +463,14 @@ def main(argv: list[str] | None = None) -> int:
               f"[options]\n{__doc__}")
         return 0 if argv else 1
     mode, rest = argv[0], argv[1:]
+    if mode in ("inference", "worker", "serve", "train"):
+        # on-disk XLA compile cache: the first process pays the minutes-long
+        # chain compile, every later invocation deserializes it (cold-start
+        # attack — utils/compile_cache.py). Only for the jax-running modes:
+        # convert (and the error path) stays numpy-only and side-effect-free.
+        from ..utils.compile_cache import enable_persistent_cache
+
+        enable_persistent_cache()
     if mode == "inference":
         return cmd_inference(rest)
     if mode == "worker":

@@ -81,6 +81,34 @@ def llama2_7b_spec(**overrides) -> TransformerSpec:
     return TransformerSpec(**kw)
 
 
+def llama2_13b_spec(**overrides) -> TransformerSpec:
+    """Llama-2-13B shape (params.json: dim 5120, 40 layers/heads, MHA).
+    Q40 kernel-layout ~8.0 GB — fits a 16 GB v5e chip whole, so this rounds
+    out the measured ladder against the reference's 13B rows
+    (README.md:47, best 848.19 ms/token)."""
+    from ..ops.quants import FloatType
+
+    kw = dict(dim=5120, hidden_dim=13824, n_layers=40, n_heads=40,
+              n_kv_heads=40, vocab_size=32000, seq_len=2048,
+              weights_float_type=FloatType.Q40)
+    kw.update(overrides)
+    return TransformerSpec(**kw)
+
+
+def llama2_70b_spec(**overrides) -> TransformerSpec:
+    """Llama-2-70B shape (dim 8192, 80 layers, GQA 64q/8kv, hidden 28672) —
+    the north-star config (BASELINE.json). Whole-model Q40 is ~38.7 GB: runs
+    only sharded; one tp=8 rank's bands (~5 GB) fit one chip
+    (parallel/shard_sim.py)."""
+    from ..ops.quants import FloatType
+
+    kw = dict(dim=8192, hidden_dim=28672, n_layers=80, n_heads=64,
+              n_kv_heads=8, vocab_size=32000, seq_len=2048,
+              weights_float_type=FloatType.Q40)
+    kw.update(overrides)
+    return TransformerSpec(**kw)
+
+
 def small_bench_spec(**overrides) -> TransformerSpec:
     """Tiny Q40 config for CI/CPU smoke runs of the benchmarks."""
     from ..ops.quants import FloatType

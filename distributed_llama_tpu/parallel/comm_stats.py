@@ -38,7 +38,10 @@ def ici_all_gather_bytes(spec: TransformerSpec, n_slices: int) -> CommStats:
     """Per-chip bytes/token of our scheme: 4 all_gathers per layer + logits.
 
     An S-way all_gather of a vector with per-shard size b moves (S-1)*b out of
-    and into every chip (ring: S-1 hops of one shard each).
+    and into every chip (ring: S-1 hops of one shard each). Under Q80 buffer
+    mode the counted bytes are the int8-codes + f16-deltas payload that the
+    collectives ACTUALLY carry (tp._wire_gather quantizes before the gather);
+    the logits gather stays f32 in both modes.
     """
     if n_slices <= 1:
         return CommStats(0, 0)

@@ -1,0 +1,191 @@
+"""Single-chip execution of ONE tp-rank's program — the 70B measurement path.
+
+The north-star workload (Llama-2-70B Q40 on a v5e-8, vs the reference's
+4842.81 ms/token on 8 RasPis, /root/reference/README.md:48) cannot run whole
+on one chip (~38.7 GB packed), and this environment exposes exactly one real
+chip. What CAN run whole is one tp=8 rank: its weight bands are ~5 GB packed
+(wq 1024x8192 etc., 80 layers, GQA 1 kv head/rank), and its per-layer program
+is EXACTLY tp.make_local_step — the function shard_map runs on every chip of
+a real v5e-8 — with the four per-layer all_gathers swapped for a local band
+tile (``jnp.concatenate([band]*8)``): same output shapes, same post-gather
+memory writes, no ICI. Measuring this on the real chip gives the per-chip
+compute+HBM cost of the real 8-way program; the ICI side is added
+analytically (comm_stats byte counts over measured-assumption link bandwidth
++ per-collective latency) to produce the projected full-system ms/token with
+the collective budget itemized (bench.py --config 70b-tp8).
+
+What the tile does NOT reproduce: ICI serialization and any compute-
+collective overlap XLA would schedule. The projection therefore reports
+compute + collectives as a straight SUM — the conservative (no-overlap)
+estimate.
+
+Values are garbage by construction (every gathered band repeats this rank's
+values), so this path is for timing/shape work only; logit parity of the
+identical program is gated at small scale by tests/test_tensor_parallel.py
+(real collectives, tp ∈ {1,2,4,8}) and test_shard_sim.py (sim == real
+program structure, sim(tp=1) == single-chip forward exactly).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import numpy as np
+
+from ..models.spec import TransformerSpec
+from ..ops.quants import FloatType
+from .comm_stats import ici_all_gather_bytes
+
+
+def make_tile_gather(n_slices: int):
+    """A gather_fn (tp._ici_gather signature) that replicates the local band
+    n_slices times along the gather axis: full-size output tensor, full
+    post-gather write traffic, zero ICI."""
+    import jax.numpy as jnp
+
+    def tile(a, axis):
+        if n_slices == 1:
+            return a
+        return jnp.concatenate([a] * n_slices, axis=axis)
+
+    return tile
+
+
+def synth_rank_q40(spec: TransformerSpec, n_slices: int, seed: int = 0,
+                   embed_dtype=None) -> dict[str, Any]:
+    """Random Q40 params at ONE rank's band shapes (models/synth.synth_q40_fast
+    semantics: packed bytes directly — timing is value-independent).
+
+    Replicated tensors (tok_embedding, norms) come at full size, exactly what
+    every chip of the real mesh holds; matmul weights come as the rank's
+    output-dim band: wq/wo (dim/S, dim), wk/wv (kv_dim/S, dim), w1/w3
+    (hidden/S, dim), w2 (dim/S, hidden), wcls (vocab/S, dim).
+    ``embed_dtype`` (e.g. bf16) shrinks the 1 GB-at-70B replicated embedding
+    table; timing impact is negligible (one row read per token).
+    """
+    from ..io.loader import Q40Weight
+
+    if spec.n_heads % n_slices or spec.n_kv_heads % n_slices:
+        raise ValueError(f"tp={n_slices} does not divide heads "
+                         f"{spec.n_heads}/{spec.n_kv_heads}")
+    rng = np.random.default_rng(seed)
+
+    def t(*shape):
+        return (rng.standard_normal(shape) * 0.05).astype(
+            embed_dtype or np.float32)
+
+    def mm(*shape):
+        *lead, d, n = shape
+        qs = rng.integers(0, 256, (*lead, d, n // 32, 16), dtype=np.uint8)
+        d16 = (rng.random((*lead, d, n // 32), dtype=np.float32)
+               * 0.01 + 1e-4).astype(np.float16)
+        return Q40Weight(qs, d16)
+
+    S = n_slices
+    p = {"tok_embedding": t(spec.vocab_size, spec.dim),
+         "rms_final": t(spec.dim).astype(np.float32),
+         "rms_att": t(spec.n_layers, spec.dim).astype(np.float32),
+         "rms_ffn": t(spec.n_layers, spec.dim).astype(np.float32),
+         "wcls": mm(spec.vocab_size // S, spec.dim)}
+    for name, (d, n) in spec.layer_matmul_shapes():
+        p[name] = mm(spec.n_layers, d // S, n)
+    return p
+
+
+def make_rank_step(spec: TransformerSpec, n_slices: int):
+    """One rank's raw (traceable) step fn — feed this to the fused decode
+    loop (runtime/decode.make_decode_loop) so the whole chain is one device
+    program, like the flagship bench path."""
+    from .tp import make_local_step
+
+    return make_local_step(spec, n_slices, 1,
+                           gather_fn=make_tile_gather(n_slices))
+
+
+def make_rank_forward(spec: TransformerSpec, n_slices: int):
+    """Jitted fn(params, cache, tokens (T,), pos) running one rank's program
+    on the local chip (tp.make_local_step with the tile gather). The cache
+    argument is the rank-local (L, seq, n_kv/S, hs) shard."""
+    import jax
+
+    return jax.jit(make_rank_step(spec, n_slices), donate_argnums=1)
+
+
+def init_rank_cache(spec: TransformerSpec, n_slices: int, dtype=None):
+    """The rank's KV-cache shard: n_kv/S heads of the full sequence."""
+    import jax.numpy as jnp
+
+    from ..models.llama import KVCache
+
+    dtype = dtype or jnp.float32
+    shape = (spec.n_layers, spec.seq_len, spec.n_kv_heads // n_slices,
+             spec.head_size)
+    return KVCache(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+
+
+def rank_params_to_device(params: dict[str, Any]) -> dict[str, Any]:
+    """Kernel-pack + device_put the band tree (shapes are already local, so
+    pack with tp=1 — identical layout to the band a real shard_params
+    device_puts to each chip: packing is row-band-local)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops.linear import pack_q40_params
+
+    params = pack_q40_params(params, tp=1)
+    return jax.tree_util.tree_map(
+        lambda a: jax.device_put(jnp.asarray(a)), params)
+
+
+# ---- analytic ICI model ---------------------------------------------------
+
+# Per-direction ICI bandwidth per v5e chip along a ring, and a per-collective
+# launch/sync latency. 45 GB/s/link ~ public v5e figure (1600 Gbps aggregate
+# across 4 links, 2 usable along a 1-D ring axis); latency ~1 us/hop is the
+# conservative end of published ICI microbenchmarks. Both are overridable in
+# project_full_system for sensitivity bands.
+V5E_ICI_GBPS_PER_DIRECTION = 90.0  # 2 links x 45 GB/s, 1-D ring axis
+ICI_COLLECTIVE_LATENCY_US = 1.0    # per all_gather launch+sync, per hop
+
+
+@dataclasses.dataclass(frozen=True)
+class FullSystemProjection:
+    """Measured shard compute + modeled ICI = projected full-system ms/token,
+    with the per-layer collective budget itemized (VERDICT r1 #1)."""
+    shard_ms: float          # measured: one rank's program on the real chip
+    ici_bandwidth_ms: float  # modeled: bytes over ring bandwidth
+    ici_latency_ms: float    # modeled: per-collective launch/sync
+    n_slices: int
+    gather_bytes_per_chip: int
+    n_collectives: int
+
+    @property
+    def total_ms(self) -> float:
+        # conservative straight sum: no compute/collective overlap assumed
+        return self.shard_ms + self.ici_bandwidth_ms + self.ici_latency_ms
+
+
+def project_full_system(spec: TransformerSpec, n_slices: int,
+                        shard_ms: float,
+                        gbps: float = V5E_ICI_GBPS_PER_DIRECTION,
+                        latency_us: float = ICI_COLLECTIVE_LATENCY_US
+                        ) -> FullSystemProjection:
+    """Combine a measured rank time with the analytic collective budget.
+
+    Ring all_gather of per-shard size b over S chips: every chip sends and
+    receives (S-1)*b bytes in S-1 hop-steps; with full-duplex links the
+    bandwidth term is (S-1)*b / per-direction-bandwidth. Byte counts come
+    from comm_stats.ici_all_gather_bytes — the same accounting the runtime
+    prints (and, under Q80 buffers, the same int8+f16 payload the real
+    gathers carry).
+    """
+    st = ici_all_gather_bytes(spec, n_slices)
+    # 4 per-layer gathers + the logits gather; Q80 mode gathers codes and
+    # deltas separately (2 ops per cut) but the byte total is unchanged
+    per_layer = 4 * (2 if spec.buffer_float_type == FloatType.Q80 else 1)
+    n_coll = spec.n_layers * per_layer + 1
+    bw_ms = st.sent_bytes / (gbps * 1e9) * 1e3
+    lat_ms = n_coll * (n_slices - 1) * latency_us / 1e3
+    return FullSystemProjection(shard_ms, bw_ms, lat_ms, n_slices,
+                                st.sent_bytes, n_coll)

@@ -19,9 +19,12 @@ Collective map (ours ⇄ reference transformer-tasks.cpp):
 The reference's syncRmsAtt broadcast (:161) disappears: x is replicated, every
 device computes the (cheap) rmsnorm itself.
 
-With buffer_float_type == Q80 the tensor crossing each all_gather goes through
-the Q80 codec first — the wire-quantization the reference applies in its
-quantize*/sync* task pairs, reproduced exactly at the same cut points.
+With buffer_float_type == Q80 each all_gather moves the ACTUAL Q80 payload —
+int8 codes + f16 block deltas, 34 bytes per 32 values (_wire_gather) — the
+wire-quantization the reference applies in its quantize*/sync* task pairs,
+reproduced at the same cut points with the same ~4x transfer cut
+(README.md:67-69); dequantization happens after the gather, so values match
+the round-1 quantize-dequantize-then-gather scheme bit for bit.
 
 Requirements: tp divides n_heads, n_kv_heads, hidden_dim, vocab_size (the
 reference's analogous constraint is `assert(d % nSlices == 0)`,
@@ -42,7 +45,7 @@ from ..models.llama import (KVCache, attention_core, batch_decode_attention,
                             split_layer_weights)
 from ..models.spec import TransformerSpec
 from ..ops.linear import fake_quant_q80, matmul, rmsnorm, silu
-from ..ops.quants import FloatType
+from ..ops.quants import FloatType, dequantize_q80_jax, quantize_q80_jax
 
 # params tree -> PartitionSpec for the stacked arrays (layer axis leading).
 # Output-dim sharding = axis 1 for per-layer matmuls, axis 0 for wcls.
@@ -109,16 +112,48 @@ def shard_cache(cache: KVCache, mesh: Mesh) -> KVCache:
 
 
 def _wire(spec: TransformerSpec, x: jax.Array) -> jax.Array:
-    """Quantize a tensor about to cross the tp 'wire' (all_gather input)."""
+    """Quantize a tensor consumed locally in Q80 buffer mode (the reference
+    quantizes xb before the qkv matmuls even single-node, quantizeRmsAtt —
+    there is no collective at this cut, so quantize-dequantize in place)."""
     if spec.buffer_float_type == FloatType.Q80:
         return fake_quant_q80(x)
     return x
 
 
-def _gather(x: jax.Array) -> jax.Array:
+def _ici_gather(a: jax.Array, axis: int) -> jax.Array:
+    """THE tp collective: all_gather over the mesh axis, shard order = band
+    order. Layer-program builders take this as a ``gather_fn`` parameter so
+    parallel/shard_sim.py can swap in a local band-tile and run ONE rank's
+    exact program on a single chip (the 70B measurement path)."""
+    return jax.lax.all_gather(a, "tp", axis=axis, tiled=True)
+
+
+def _gather(x: jax.Array, gather_fn=_ici_gather) -> jax.Array:
     """Concatenate the tp bands along the feature axis (device-order bands =
     MatmulSlice's contiguous row bands)."""
-    return jax.lax.all_gather(x, "tp", axis=-1, tiled=True)
+    return gather_fn(x, x.ndim - 1)
+
+
+def _wire_gather(spec: TransformerSpec, x: jax.Array,
+                 gather_fn=_ici_gather) -> jax.Array:
+    """Move a shard-local band across the tp 'wire' into a full vector.
+
+    Under buffer_float_type == Q80 the collectives carry the REAL quantized
+    payload — int8 codes + one f16 delta per 32-block, 34 bytes per 32
+    values, a ~3.8x wire-byte cut vs f32 — exactly the transfer compression
+    the reference implements in its quantize*/sync* task pairs
+    (transformer-tasks.cpp:97-136; byte tables README.md:67-69). Values are
+    identical to quantize->dequantize->gather (the gather reorders nothing
+    within a block, and validate_sharding pins shard width to a 32-block
+    multiple), so tp parity gates are unchanged. comm_stats reports these
+    same byte counts — what actually crosses ICI (VERDICT r1 #4).
+    """
+    if spec.buffer_float_type == FloatType.Q80:
+        qs, d = quantize_q80_jax(x)  # (..., nb, 32) int8, (..., nb) f16
+        qs = gather_fn(qs, qs.ndim - 2)
+        d = gather_fn(d, d.ndim - 1)
+        return dequantize_q80_jax(qs, d)
+    return _gather(x, gather_fn)
 
 
 def _tp_qkv(spec: TransformerSpec, lw, x, positions):
@@ -138,25 +173,26 @@ def _tp_qkv(spec: TransformerSpec, lw, x, positions):
     return q, k, v
 
 
-def _tp_tail(spec: TransformerSpec, x, lw, ao):
+def _tp_tail(spec: TransformerSpec, x, lw, ao, gather_fn=_ici_gather):
     """Shard-local layer tail: attention output -> wo -> residual -> ffn.
 
     The four all_gathers here are THE per-layer tp collectives (see module
-    docstring for the reference sync-task mapping)."""
-    xb = _gather(_wire(spec, ao))                  # ⇄ syncMultiheadAtt
+    docstring for the reference sync-task mapping); under Q80 buffer mode
+    each moves the real int8+f16 payload (_wire_gather)."""
+    xb = _wire_gather(spec, ao, gather_fn)         # ⇄ syncMultiheadAtt
     xb2 = matmul(lw["wo"], xb)                     # (T, dim/S)
-    x = x + _gather(_wire(spec, xb2))              # ⇄ syncAtt + residual
+    x = x + _wire_gather(spec, xb2, gather_fn)     # ⇄ syncAtt + residual
 
     xb = rmsnorm(x, lw["rms_ffn"])
     xb = _wire(spec, xb)                           # ⇄ quantizeRmfFfn
     hb = silu(matmul(lw["w1"], xb)) * matmul(lw["w3"], xb)  # (T, hidden/S)
-    hb = _gather(_wire(spec, hb))                  # ⇄ syncFfnA+syncFfnB
+    hb = _wire_gather(spec, hb, gather_fn)         # ⇄ syncFfnA+syncFfnB
     xb2 = matmul(lw["w2"], hb)                     # (T, dim/S)
-    return x + _gather(_wire(spec, xb2))           # ⇄ syncFfn2 + residual
+    return x + _wire_gather(spec, xb2, gather_fn)  # ⇄ syncFfn2 + residual
 
 
 def _local_layer(spec: TransformerSpec, n_slices: int, n_sp: int, x, lw,
-                 k_all, v_all, idx, pos, positions):
+                 k_all, v_all, idx, pos, positions, gather_fn=_ici_gather):
     """Per-device layer body. x replicated (T, dim); lw holds local tp bands;
     k/v_all hold this device's STACKED (L, sp-chunk, tp-kv-heads, hs) cache
     shard — updated in place at layer ``idx`` (see models/llama.forward on
@@ -209,7 +245,7 @@ def _local_layer(spec: TransformerSpec, n_slices: int, n_sp: int, x, lw,
         ao = sp_cache_attention(spec.head_size, spec.kv_mul, seq_chunk,
                                 sp_index, qh, k_c, v_c, pos)
 
-    x = _tp_tail(spec, x, lw, ao)
+    x = _tp_tail(spec, x, lw, ao, gather_fn)
     return x, k_all, v_all
 
 
@@ -242,17 +278,13 @@ def validate_sharding(spec: TransformerSpec, mesh: Mesh) -> None:
                     f"{req}/{n_slices}")
 
 
-def make_sharded_forward(spec: TransformerSpec, mesh: Mesh):
-    """Build the jitted tensor-parallel forward for this mesh.
-
-    Returns fn(params, cache, tokens (T,), pos) -> (logits (T, vocab), cache).
-    Works for any tp size on the mesh, including tp=1 (then it reduces to the
-    single-chip program; parity across tp sizes is the stage-4 gate of
-    SURVEY.md §7).
-    """
-    n_slices = mesh.shape["tp"]
-    n_sp = mesh.shape.get("sp", 1)
-    validate_sharding(spec, mesh)
+def make_local_step(spec: TransformerSpec, n_slices: int, n_sp: int,
+                    gather_fn=_ici_gather):
+    """ONE tp-rank's single-sequence step program (embed -> scanned layers ->
+    final norm -> vocab-band logits). This is the function shard_map runs on
+    every chip (make_sharded_forward); parallel/shard_sim.py runs the same
+    function on a single chip with a tiling ``gather_fn`` to measure the
+    per-chip cost of shapes too big to run whole (70B tp=8)."""
 
     def local_step(params, cache, tokens, pos):
         t_len = tokens.shape[0]
@@ -266,15 +298,33 @@ def make_sharded_forward(spec: TransformerSpec, mesh: Mesh):
             idx, lw_slice = per_layer
             lw = layer_view(stacked, lw_slice, idx)
             x, k_all, v_all = _local_layer(spec, n_slices, n_sp, x, lw,
-                                           k_all, v_all, idx, pos, positions)
+                                           k_all, v_all, idx, pos, positions,
+                                           gather_fn)
             return (x, k_all, v_all), None
 
         idxs = jnp.arange(spec.n_layers, dtype=jnp.int32)
         (x, k_new, v_new), _ = jax.lax.scan(body, (x, cache.k, cache.v),
                                             (idxs, scanned))
         x = rmsnorm(x, params["rms_final"])
-        logits = _gather(matmul(params["wcls"], x))  # vocab bands -> full
+        # vocab bands -> full
+        logits = _gather(matmul(params["wcls"], x), gather_fn)
         return logits, KVCache(k_new, v_new)
+
+    return local_step
+
+
+def make_sharded_forward(spec: TransformerSpec, mesh: Mesh):
+    """Build the jitted tensor-parallel forward for this mesh.
+
+    Returns fn(params, cache, tokens (T,), pos) -> (logits (T, vocab), cache).
+    Works for any tp size on the mesh, including tp=1 (then it reduces to the
+    single-chip program; parity across tp sizes is the stage-4 gate of
+    SURVEY.md §7).
+    """
+    n_slices = mesh.shape["tp"]
+    n_sp = mesh.shape.get("sp", 1)
+    validate_sharding(spec, mesh)
+    local_step = make_local_step(spec, n_slices, n_sp)
 
     def wrap(params, cache, tokens, pos):
         in_specs = (param_specs(params), CACHE_SPEC, P(), P())

@@ -97,7 +97,7 @@ class ContinuousEngine:
     def __init__(self, spec: TransformerSpec, params: dict[str, Any],
                  slots: int, temperature: float, topp: float, seed: int,
                  cache_dtype=None, mesh=None, prefill_chunk: int = 0,
-                 block_steps: int = 1):
+                 block_steps: int = 1, use_native_sampler: bool = True):
         import functools
 
         import jax
@@ -113,6 +113,12 @@ class ContinuousEngine:
         self.seed = seed
         self.jnp = jnp
         self.prefill_chunk = prefill_chunk
+        # multi-host SPMD runs MUST pin the numpy sampler: native and numpy
+        # can differ by float ulps across libm builds (sampling.Sampler
+        # docstring), and divergent hosts feed different tokens into the
+        # lockstep step — silent corruption. cli.py passes False whenever
+        # --coordinator is set, mirroring the single-sequence Engine path.
+        self.use_native_sampler = use_native_sampler
         self.block_steps = block_steps  # >1: fused K-step chains (step_many)
         dtype = cache_dtype or jnp.float32
         self._cache_dtype = dtype
@@ -221,7 +227,16 @@ class ContinuousEngine:
         """Like ``k`` step_once calls in ONE device dispatch. Per-request
         token streams are identical to the per-step path (the parity gate);
         only scheduling differs: a slot freed mid-chain re-admits at the
-        chain boundary. Returns active slots after the chain."""
+        chain boundary. Returns active slots after the chain.
+
+        Parity caveat (same class of contract as PARITY.md's native==numpy
+        note): the chain samples on DEVICE (decode.sample_device_dynamic)
+        while step_once samples on HOST, so token-for-token equality at
+        temperature > 0 holds only while the two softmax/CDF implementations
+        agree to the ulp at every CDF boundary — pinned by tests on the
+        shipped configs, but an XLA or libm change could flip a
+        knife-edge coin. temperature == 0 (argmax) is exact by
+        construction."""
         if k <= 1:
             return self.step_once(quiet=quiet)
         jnp = self.jnp
@@ -368,7 +383,8 @@ class ContinuousEngine:
                     else self.temperature)
             topp = req.topp if req.topp is not None else self.topp
             seed = req.seed if req.seed is not None else self.seed + req.index
-            s.sampler = Sampler(spec.vocab_size, temp, topp, seed)
+            s.sampler = Sampler(spec.vocab_size, temp, topp, seed,
+                                use_native=self.use_native_sampler)
             self._maybe_prefill_slot(slot_index, s)
 
     def _maybe_prefill_slot(self, slot_index: int, s: _Slot):
@@ -491,7 +507,7 @@ def generate_continuous(spec: TransformerSpec, params: dict[str, Any],
                         temperature: float, topp: float, seed: int,
                         slots: int = 0, cache_dtype=None, mesh=None,
                         prefill_chunk: int = 0, block_steps: int = 1,
-                        quiet: bool = False):
+                        quiet: bool = False, use_native_sampler: bool = True):
     """CLI entry: encode prompts, stream them through a slot pool, print
     rows in the --prompts-file format ("[i] 'text'")."""
     reqs = [tokenizer.encode(p or "", bos=True, eos=False) for p in prompts]
@@ -499,7 +515,8 @@ def generate_continuous(spec: TransformerSpec, params: dict[str, Any],
     eng = ContinuousEngine(spec, params, slots, temperature, topp, seed,
                            cache_dtype=cache_dtype, mesh=mesh,
                            prefill_chunk=prefill_chunk,
-                           block_steps=block_steps)
+                           block_steps=block_steps,
+                           use_native_sampler=use_native_sampler)
     outs, stats = eng.run(reqs, steps, quiet=quiet)
     for b, (req, row) in enumerate(zip(reqs, outs)):
         if not quiet:

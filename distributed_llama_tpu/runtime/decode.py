@@ -102,22 +102,31 @@ def sample_device_dynamic(logits: jax.Array, coin: jax.Array,
                                _mult_walk(probs, coin)))
 
 
-def make_decode_loop(step_fn: StepFn, steps: int, temperature: float,
+def make_decode_loop(step_fn: StepFn, max_steps: int, temperature: float,
                      topp: float):
     """Build run(params, cache, prompt_padded, first_token, coins,
-    start_pos) -> (tokens (steps,), cache): the fused generation loop.
+    start_pos, num_steps) -> (tokens (max_steps,), cache): the fused
+    generation loop.
 
-    prompt_padded: (steps+1,) int32, prompt tokens then -1 padding. Step
-    ``i`` (absolute position start_pos + i) forces prompt_padded[i+1] when
-    >= 0, else samples — exactly the forced-prompt-then-sample schedule of
-    the reference loop (tokenizer.cpp:360-366). coins: (steps,) f32,
-    consumed at sampled steps. start_pos: 0 for a fresh generation, the
-    checkpointed position for a resumed one.
+    ``max_steps`` (typically seq_len) fixes the BUFFER shapes only; the
+    actual step budget ``num_steps`` is a traced scalar bound of the
+    while_loop, so every --steps value reuses ONE compilation (a distinct
+    --steps used to recompile the whole chain — the round-1 cold-start
+    trap). The int32 token buffer is max_steps long: seq_len=2048 costs
+    8 kB, nothing, against a ~minute XLA compile per distinct shape.
+
+    prompt_padded: (max_steps+1,) int32, prompt tokens then -1 padding.
+    Step ``i`` (absolute position start_pos + i) forces prompt_padded[i+1]
+    when >= 0, else samples — exactly the forced-prompt-then-sample
+    schedule of the reference loop (tokenizer.cpp:360-366). coins:
+    (max_steps,) f32, consumed at sampled steps. start_pos: 0 for a fresh
+    generation, the checkpointed position for a resumed one.
     """
 
     from ..io.tokenizer import BOS
 
-    def run(params, cache, prompt_padded, first_token, coins, start_pos):
+    def run(params, cache, prompt_padded, first_token, coins, start_pos,
+            num_steps):
         """start_pos: absolute position of the first step — 0 for a fresh
         generation, the checkpointed position for a resumed one (the cache
         must already hold positions 0..start_pos-1; runtime/checkpoint.py).
@@ -125,14 +134,15 @@ def make_decode_loop(step_fn: StepFn, steps: int, temperature: float,
         The loop is a lax.while_loop, not a scan: a sampled BOS ends the
         chain EARLY on device (the reference's stop condition), so a
         2048-step budget that terminates at step 50 costs 50 forwards, not
-        2048. The token buffer is BOS-initialized — untouched slots read as
-        the terminator, so the host-side truncation is unchanged.
+        2048 — and a num_steps budget below max_steps likewise stops at
+        num_steps. The token buffer is BOS-initialized — untouched slots
+        read as the terminator, so the host-side truncation is unchanged.
         """
-        toks0 = jnp.full((steps,), BOS, dtype=jnp.int32)
+        toks0 = jnp.full((max_steps,), BOS, dtype=jnp.int32)
 
         def cond(carry):
             i, done, token, cache, toks = carry
-            return (i < steps) & ~done
+            return (i < num_steps) & ~done
 
         def body(carry):
             i, done, token, cache, toks = carry

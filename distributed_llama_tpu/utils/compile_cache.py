@@ -1,0 +1,48 @@
+"""Persistent XLA compilation cache (cold-start attack, VERDICT r1 #6).
+
+The flagship fused decode chain costs minutes of XLA compile time on its
+first trace (a 32-layer scan over Pallas kernels inside a while_loop). The
+reference has no analogous cost (C++ is compiled once, offline) — so the
+TPU-native equivalent of "make main" is caching the compiled executable on
+disk: the first process pays the compile, every later process (including the
+driver's bench run) deserializes it in seconds.
+
+This wires up jax.config's persistent compilation cache with thresholds at
+zero (every executable is worth keeping for this workload). Callers:
+frontend/cli.py main(), bench.py, tools/*. The cache key includes the jax
+version, backend, and HLO — a changed model shape or kernel recompiles
+cleanly, it never serves stale artifacts.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def default_cache_dir() -> str:
+    """Env override, else `.jax_cache/` next to the package (the repo root in
+    a source checkout) — kept inside the project tree by design."""
+    env = os.environ.get("DLLAMA_JAX_CACHE_DIR")
+    if env:
+        return env
+    pkg_parent = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    return os.path.join(pkg_parent, ".jax_cache")
+
+
+def enable_persistent_cache(cache_dir: str | None = None) -> str | None:
+    """Turn on the on-disk compile cache; returns the directory, or None if
+    it could not be created (read-only install: degrade to no caching)."""
+    import jax
+
+    cache_dir = cache_dir or default_cache_dir()
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+    except OSError:
+        return None
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    # cache everything: even a 2-second compile beats a disk read loss, and
+    # the big chain compiles are the whole point
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    return cache_dir

@@ -22,3 +22,45 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 assert jax.default_backend() == "cpu"
+
+import pytest  # noqa: E402
+
+# Tests marked slow and deselected from the default run (pytest.ini). One
+# tunable place, chosen from measured -n 8 durations: multi-process
+# jax.distributed spawns, training soaks, deep-position/randomized parity
+# sweeps, and the heaviest sharded-compile combos. Their feature areas all
+# keep lighter always-on coverage; tools/ci.sh runs everything.
+# DLLAMA_RUN_SLOW=1 also re-includes them without editing flags.
+SLOW_FILES = {"test_multihost.py", "test_sp_train.py", "test_train_cli.py"}
+SLOW_TESTS = {
+    "test_prefill_early_bos_rng_rewind",
+    "test_continuous_more_requests_than_slots",
+    "test_continuous_randomized_workloads_agree",
+    "test_continuous_over_mesh_matches_single_chip",
+    "test_forward_batch_ragged_matches_singles",
+    "test_train_step_loss_decreases",
+    "test_train_checkpoint_exact_resume",
+    "test_convert_hf_logit_parity",
+    "test_tp_sharded_forward_with_kernel_layout",
+    "test_tp_sharded_forward_with_flash_attention",
+    "test_pack_q40_params_and_forward_parity",
+    "test_deep_position_decode_parity",
+    "test_cli_batch_prompts_file",
+    "test_sp_decode_parity",
+    "test_batch_sp_step_matches_single_chip",
+    "test_batch_tp_step_matches_single_chip",
+    "test_decode_matches_prefill",
+    "test_deep_gqa_continuous_composed",
+    "test_forward_batch_matches_singles",
+    "test_generate_prefill_on_sharded_engine",
+    "test_fast_resume_crosses_loops",
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    if os.environ.get("DLLAMA_RUN_SLOW"):
+        return
+    for item in items:
+        base = item.name.split("[")[0]
+        if base in SLOW_TESTS or item.path.name in SLOW_FILES:
+            item.add_marker(pytest.mark.slow)

@@ -79,7 +79,7 @@ def test_batch_decode_loop_matches_single_loop(params_dev):
         padded[:len(p)] = p
         toks, _ = run1(params_dev, init_cache(SPEC), jnp.asarray(padded),
                        jnp.int32(p[0]), jnp.zeros((steps,), jnp.float32),
-                       jnp.int32(0))
+                       jnp.int32(0), jnp.int32(steps))
         single_out.append(np.asarray(toks))
 
     runb = make_batch_decode_loop(SPEC, steps, temperature=0.0, topp=0.9)

@@ -320,3 +320,25 @@ def test_continuous_sampled_matches_generate(params):
                             seed=seed)
     outs, _ = ceng.run([tok.encode(p) for p in prompts], steps)
     assert outs == singles
+
+
+def test_use_native_sampler_plumbed_to_slots(params):
+    """use_native_sampler=False (the multi-host pin, cli.py) must reach every
+    admitted slot's Sampler — native and numpy can diverge by ulps across
+    libm builds, so SPMD hosts must all take the numpy path (ADVICE r1)."""
+    from distributed_llama_tpu.runtime.continuous import (ContinuousEngine,
+                                                          Request)
+
+    eng = ContinuousEngine(SPEC, params, slots=2, temperature=0.9, topp=0.9,
+                           seed=3, use_native_sampler=False)
+    for r in ([1, 5], [1, 7]):
+        eng.submit(Request(tokens=list(r), steps=4))
+    eng._admit()
+    samplers = [s.sampler for s in eng._pool if not s.free]
+    assert samplers and all(s.use_native is False for s in samplers)
+    # default stays native (single-host fast path)
+    eng2 = ContinuousEngine(SPEC, params, slots=1, temperature=0.9, topp=0.9,
+                            seed=3)
+    eng2.submit(Request(tokens=[1, 5], steps=4))
+    eng2._admit()
+    assert eng2._pool[0].sampler.use_native is True

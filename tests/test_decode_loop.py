@@ -203,3 +203,30 @@ def test_fused_loop_tensor_parallel():
     got, _ = generate_fast(eng, tok, Sampler(SPEC.vocab_size, 0.0, 0.9, 1),
                            "hi", steps=10, quiet=True)
     assert got == want
+
+
+def test_steps_change_reuses_one_compiled_loop():
+    """Two different --steps budgets must share ONE compiled chain (the
+    budget is a traced while_loop bound, not a shape — VERDICT r1 #6: the
+    old per-steps key recompiled the full chain per distinct --steps)."""
+    from distributed_llama_tpu.runtime.generate import Engine, generate_fast
+    from distributed_llama_tpu.runtime.sampling import Sampler
+
+    params = synth_params(SPEC, q40=False, seed=3, scale=0.3)
+
+    class _Tok:
+        def encode(self, text, bos=True, eos=False):
+            return [1, 5, 9]
+
+        def decode_piece(self, prev, tokn):
+            return b"?"
+
+    tok = _Tok()
+    eng = Engine(SPEC, params)
+    out5, _ = generate_fast(eng, tok, Sampler(SPEC.vocab_size, 0.0, 0.9, 1),
+                            "hi", steps=5, quiet=True)
+    out9, _ = generate_fast(eng, tok, Sampler(SPEC.vocab_size, 0.0, 0.9, 1),
+                            "hi", steps=9, quiet=True)
+    assert len(eng._loops) == 1  # same sampling config -> same program
+    # the shorter budget is a prefix of the longer greedy chain
+    assert out9[:len(out5)] == out5 and len(out9) > len(out5)

@@ -1,0 +1,12 @@
+#!/bin/sh
+# Full test suite — slow tests included — sharded across CPUs.
+#
+# The default `pytest tests/` path deselects slow-marked tests to stay fast
+# (pytest.ini); this script is the complete gate: run it before landing
+# changes to the parallel/runtime layers. ~18 min on an 8-core box.
+#
+# Usage: tools/ci.sh [extra pytest args]
+set -eu
+cd "$(dirname "$0")/.."
+exec python -m pytest tests/ -q -n "${CI_SHARDS:-8}" \
+    -m "slow or not slow" "$@"
