@@ -112,6 +112,10 @@ def cmd_inference(argv: list[str], quiet: bool = False) -> int:
                          "passes instead of one token at a time (same "
                          "output stream; ~20x prompt tokens/s on TPU; no "
                          "per-prompt-token stats lines)")
+    ap.add_argument("--fast-prefill", action="store_true",
+                    help="bf16 matmul precision for T>8 prefill chunks "
+                         "(documented tolerance; decode keeps the parity "
+                         "program). Needs --prefill-chunk > 1")
     ap.add_argument("--profile", default=None, metavar="DIR",
                     help="capture a jax.profiler device trace of the "
                          "generation into DIR (xprof/tensorboard format — "
@@ -146,6 +150,10 @@ def cmd_inference(argv: list[str], quiet: bool = False) -> int:
     if args.slots < 0:
         print(f"--slots must be non-negative (0 = auto: min(#prompts, 8)), "
               f"got {args.slots}", file=sys.stderr)
+        return 2
+    if args.fast_prefill and args.prefill_chunk <= 1:
+        print("--fast-prefill only affects chunked prefill; pass "
+              "--prefill-chunk N (N > 1)", file=sys.stderr)
         return 2
     if args.prompts_file:  # validate before the multi-GB model load
         if args.prefill_chunk > 1 and not args.continuous:
@@ -200,7 +208,8 @@ def cmd_inference(argv: list[str], quiet: bool = False) -> int:
                                 # multi-host: every host must sample the
                                 # identical stream — pin the numpy sampler
                                 # (see sampling.Sampler docstring)
-                                use_native_sampler=not args.coordinator)
+                                use_native_sampler=not args.coordinator,
+                                fast_prefill=args.fast_prefill)
             return 0
         from ..runtime.generate import generate_batch
 
@@ -208,7 +217,8 @@ def cmd_inference(argv: list[str], quiet: bool = False) -> int:
                        args.temperature, args.topp, seed,
                        cache_dtype=cache_dtype, mesh=mesh, quiet=quiet)
         return 0
-    engine = Engine(spec, params, mesh=mesh, cache_dtype=cache_dtype)
+    engine = Engine(spec, params, mesh=mesh, cache_dtype=cache_dtype,
+                    fast_prefill=args.fast_prefill)
     if not quiet:
         print(f"⏩ Loaded model in {time.time() - t0:.1f}s")
 
@@ -326,9 +336,16 @@ def cmd_serve(argv: list[str]) -> int:
                          "(admission + per-token streaming at chain "
                          "boundaries; cuts host round-trips Kx — set 8-16 "
                          "on remote/high-latency runtimes)")
+    ap.add_argument("--fast-prefill", action="store_true",
+                    help="bf16 matmul precision for admission prefill "
+                         "(documented tolerance; decode untouched)")
     args = ap.parse_args(argv)
     if args.slots < 1:
         print(f"--slots must be positive, got {args.slots}", file=sys.stderr)
+        return 2
+    if args.fast_prefill and args.prefill_chunk <= 1:
+        print("--fast-prefill only affects admission prefill; pass "
+              "--prefill-chunk N (N > 1)", file=sys.stderr)
         return 2
 
     import jax.numpy as jnp
@@ -349,7 +366,8 @@ def cmd_serve(argv: list[str]) -> int:
                              args.slots, args.steps, args.temperature,
                              args.topp, seed, cache_dtype=cache_dtype,
                              mesh=mesh, prefill_chunk=args.prefill_chunk,
-                             block_steps=args.block_steps)
+                             block_steps=args.block_steps,
+                             fast_prefill=args.fast_prefill)
     print(f"🌐 serving on http://{args.host}:{server.port} "
           f"({args.slots} slots, POST /generate, GET /health)")
     server.serve_forever()
@@ -381,7 +399,14 @@ def cmd_train(argv: list[str]) -> int:
     ap.add_argument("--tp", type=int, default=1)
     ap.add_argument("--save-state", default=None, metavar="PATH")
     ap.add_argument("--resume-state", default=None, metavar="PATH")
+    _add_common(ap)
     args = ap.parse_args(argv)
+    # multi-host training: every host joins the global dp x tp mesh and runs
+    # the identical program — the data schedule is already a pure function
+    # of (--seed, step), so all hosts feed the same global windows and jit
+    # shards them (dp can cross the host boundary); only host 0 prints
+    _maybe_distributed(args)
+    quiet = bool(args.host_id)
 
     import numpy as np
 
@@ -428,7 +453,8 @@ def cmd_train(argv: list[str]) -> int:
         p, o = init_fn(template_params(spec))
         p, o, start = load_train_state(args.resume_state, spec, p, o,
                                        return_step=True)
-        print(f"⏩ Resumed training at step {start}")
+        if not quiet:
+            print(f"⏩ Resumed training at step {start}")
     else:
         _, params = load_model(args.model, spec=spec)
         p, o = init_fn(densify_params(params))
@@ -446,9 +472,10 @@ def cmd_train(argv: list[str]) -> int:
         t0 = time.perf_counter()
         p, o, loss = step_fn(p, o, jnp.asarray(windows(step)))
         loss = float(loss)
-        print(f"🔶 step {step:5d}  loss {loss:8.4f}  "
-              f"{(time.perf_counter() - t0) * 1000:7.1f} ms")
-    if args.save_state:
+        if not quiet:
+            print(f"🔶 step {step:5d}  loss {loss:8.4f}  "
+                  f"{(time.perf_counter() - t0) * 1000:7.1f} ms")
+    if args.save_state and not args.host_id:  # one writer: the root host
         save_train_state(args.save_state, spec, p, o,
                          step=start + args.steps, data_seed=args.seed)
         print(f"⏩ Saved training state to {args.save_state} "

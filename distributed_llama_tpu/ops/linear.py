@@ -18,6 +18,8 @@ Semantics contract (BASELINE.md logit parity):
 
 from __future__ import annotations
 
+import contextlib
+import contextvars
 import os
 from typing import Any, NamedTuple
 
@@ -29,6 +31,44 @@ from ..io.loader import Q40Kernel, Q40Weight, from_kernel_layout, to_kernel_layo
 from .quants import dequantize_q40_jax, dequantize_q80_jax, quantize_q80_jax
 
 RMS_EPS = 1e-5
+
+# trace-time matmul precision mode. "parity" = f32 accumulation at HIGHEST
+# (the logit-parity contract); "bf16" = bf16 MXU passes with f32 accumulation
+# — ~3-6x the matmul throughput at a documented tolerance, used for the
+# opt-in fast-prefill path (--fast-prefill) where T is large and the outputs
+# only seed the KV cache. Read when a program is TRACED, so the mode must be
+# active inside the jitted function being built (Engine wraps its prefill
+# step in matmul_precision("bf16")); compiled parity programs are untouched.
+_MATMUL_MODE = contextvars.ContextVar("dllama_matmul_mode", default="parity")
+
+
+@contextlib.contextmanager
+def matmul_precision(mode: str):
+    if mode not in ("parity", "bf16"):
+        raise ValueError(f"unknown matmul precision mode {mode!r}")
+    token = _MATMUL_MODE.set(mode)
+    try:
+        yield
+    finally:
+        _MATMUL_MODE.reset(token)
+
+
+def matmul_mode() -> str:
+    return _MATMUL_MODE.get()
+
+
+def bf16_prefill(fn):
+    """Wrap a forward so it TRACES under bf16 matmul precision — THE one
+    fast-prefill wrapper (Engine and ContinuousEngine both build their
+    prefill programs through this, so the precision protocol lives in one
+    place). Works on raw or already-jitted ``fn``: a jitted fn traces on
+    first call, and the context is active around every call."""
+
+    def wrapped(*args):
+        with matmul_precision("bf16"):
+            return fn(*args)
+
+    return wrapped
 
 
 class StackedQ40(NamedTuple):
@@ -104,6 +144,11 @@ def matmul(w, x: jax.Array, *, prefer_pallas: bool = False) -> jax.Array:
         # below; supported dims with awkward T combos fall back INSIDE
         # q40_matmul instead
     wf = dequantize_weight(w)
+    if matmul_mode() == "bf16":
+        # fast-prefill mode: bf16 MXU passes, f32 accumulation
+        return jnp.einsum("dn,...n->...d", wf.astype(jnp.bfloat16),
+                          x.astype(jnp.bfloat16),
+                          preferred_element_type=jnp.float32)
     # HIGHEST: true f32 MXU accumulation — required for the 1e-5 logit-parity
     # contract on TPU (default TPU precision is bf16-input). The quantized
     # fast path (Pallas) has its own precision story.

@@ -130,24 +130,32 @@ MULTI_T_MAX = 8  # beyond this the per-row accumulators crowd VMEM; use MXU
 
 def _matmul_body(qs3, s, xlo_ref, xhi_ref, out_ref):
     """Shared T>1 MXU body: qs3 (NJ, R, nb) codes view, s (R, nb) scales."""
+    from .linear import matmul_mode
+
     dn = (((1,), (1,)), ((), ()))                # contract both minor dims
+    # fast-prefill mode (trace-time flag, ops/linear.matmul_precision):
+    # bf16 MXU passes with f32 accumulation instead of the 3-pass HIGHEST
+    # f32 discipline — T>8 prefill is MXU-bound, so this is the ~3x lever;
+    # parity programs never trace with it set
+    bf16 = matmul_mode() == "bf16"
+    wdt = jnp.bfloat16 if bf16 else jnp.float32
+    prec = None if bf16 else jax.lax.Precision.HIGHEST
     acc = None
     # unrolled over the 16 nibble planes: one grid step computes the whole
     # output tile, so the packed bytes stream in as few large DMAs and the
     # compiler can software-pipeline unpack against the MXU
     for j in range(NJ):
         q = qs3[j].astype(jnp.int32)             # (R, nb)
-        wlo = ((q & 0xF) - 8).astype(jnp.float32) * s
-        whi = ((q >> 4) - 8).astype(jnp.float32) * s
-        # HIGHEST: true f32 MXU passes — the parity contract; decode is
-        # HBM-bound on the packed weights, so the extra passes don't move
-        # the bottleneck
-        a = jax.lax.dot_general(xlo_ref[j], wlo, dn,
+        wlo = (((q & 0xF) - 8).astype(jnp.float32) * s).astype(wdt)
+        whi = (((q >> 4) - 8).astype(jnp.float32) * s).astype(wdt)
+        # parity mode: HIGHEST = true f32 MXU passes; decode is HBM-bound on
+        # the packed weights, so the extra passes don't move the bottleneck
+        a = jax.lax.dot_general(xlo_ref[j].astype(wdt), wlo, dn,
                                 preferred_element_type=jnp.float32,
-                                precision=jax.lax.Precision.HIGHEST)
-        a = a + jax.lax.dot_general(xhi_ref[j], whi, dn,
+                                precision=prec)
+        a = a + jax.lax.dot_general(xhi_ref[j].astype(wdt), whi, dn,
                                     preferred_element_type=jnp.float32,
-                                    precision=jax.lax.Precision.HIGHEST)
+                                    precision=prec)
         acc = a if acc is None else acc + a
     out_ref[...] = acc
 
@@ -368,6 +376,15 @@ def _dequant_matmul(w: Q40Kernel, x2: jax.Array,
         w = Q40Kernel(w.qs_t[layer], w.scale[layer])
     qs = jnp.transpose(w.qs_t, (1, 2, 0))            # (d, nb, 16)
     wf = dequantize_q40_jax(qs, w.scale)
+    from .linear import matmul_mode
+
+    if matmul_mode() == "bf16":
+        # fast-prefill applies to ALL three dispatch targets — without this
+        # the tp-sharded band shapes that land here (e.g. d=1376=11008/8,
+        # no legal MXU tiling) would silently run at parity speed
+        return jnp.einsum("dn,tn->td", wf.astype(jnp.bfloat16),
+                          x2.astype(jnp.bfloat16),
+                          preferred_element_type=jnp.float32)
     return jnp.einsum("dn,tn->td", wf, x2.astype(jnp.float32),
                       preferred_element_type=jnp.float32,
                       precision=jax.lax.Precision.HIGHEST)

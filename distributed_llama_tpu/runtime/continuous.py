@@ -61,6 +61,19 @@ class Request:
     on_token: Any = None
 
 
+def _maybe_bf16(fn, enable: bool, jax_mod, jit: bool = False):
+    """Route a prefill forward through the shared fast-prefill wrapper
+    (ops/linear.bf16_prefill) when enabled. Unlike Engine.prefill's T>8
+    gate, admission prefill runs ALL its chunks (tail included) through
+    this one dedicated program — the whole prefilled prefix shares one
+    documented tolerance."""
+    if enable:
+        from ..ops.linear import bf16_prefill
+
+        fn = bf16_prefill(fn)
+    return jax_mod.jit(fn, donate_argnums=1) if jit else fn
+
+
 @dataclasses.dataclass
 class _Slot:
     req: Request | None = None   # None = free
@@ -97,7 +110,8 @@ class ContinuousEngine:
     def __init__(self, spec: TransformerSpec, params: dict[str, Any],
                  slots: int, temperature: float, topp: float, seed: int,
                  cache_dtype=None, mesh=None, prefill_chunk: int = 0,
-                 block_steps: int = 1, use_native_sampler: bool = True):
+                 block_steps: int = 1, use_native_sampler: bool = True,
+                 fast_prefill: bool = False):
         import functools
 
         import jax
@@ -152,7 +166,8 @@ class ContinuousEngine:
             if prefill_chunk > 1:
                 # admission prefill: the sharded single-sequence forward
                 # (T=chunk under sp/tp) fills a sharded scratch cache
-                self._prefill_fwd = make_sharded_forward(spec, mesh)
+                self._prefill_fwd = _maybe_bf16(
+                    make_sharded_forward(spec, mesh), fast_prefill, jax)
                 self._scratch_cache = lambda: shard_cache(
                     init_cache(spec, dtype), mesh)
         else:
@@ -164,8 +179,9 @@ class ContinuousEngine:
             if prefill_chunk > 1:
                 # admission prefill: single-sequence T=chunk forward into a
                 # scratch cache + plane insert
-                self._prefill_fwd = jax.jit(functools.partial(forward, spec),
-                                            donate_argnums=1)
+                self._prefill_fwd = _maybe_bf16(
+                    functools.partial(forward, spec), fast_prefill, jax,
+                    jit=True)
                 self._scratch_cache = lambda: init_cache(spec, dtype)
         if prefill_chunk > 1:
             # donate only the batched cache (updated in place); the scratch
@@ -507,7 +523,8 @@ def generate_continuous(spec: TransformerSpec, params: dict[str, Any],
                         temperature: float, topp: float, seed: int,
                         slots: int = 0, cache_dtype=None, mesh=None,
                         prefill_chunk: int = 0, block_steps: int = 1,
-                        quiet: bool = False, use_native_sampler: bool = True):
+                        quiet: bool = False, use_native_sampler: bool = True,
+                        fast_prefill: bool = False):
     """CLI entry: encode prompts, stream them through a slot pool, print
     rows in the --prompts-file format ("[i] 'text'")."""
     reqs = [tokenizer.encode(p or "", bos=True, eos=False) for p in prompts]
@@ -516,7 +533,8 @@ def generate_continuous(spec: TransformerSpec, params: dict[str, Any],
                            cache_dtype=cache_dtype, mesh=mesh,
                            prefill_chunk=prefill_chunk,
                            block_steps=block_steps,
-                           use_native_sampler=use_native_sampler)
+                           use_native_sampler=use_native_sampler,
+                           fast_prefill=fast_prefill)
     outs, stats = eng.run(reqs, steps, quiet=quiet)
     for b, (req, row) in enumerate(zip(reqs, outs)):
         if not quiet:

@@ -34,7 +34,7 @@ class Engine:
     """Owns params + cache + the jitted step; exposes infer(token, pos)."""
 
     def __init__(self, spec: TransformerSpec, params: dict[str, Any],
-                 mesh=None, cache_dtype=None):
+                 mesh=None, cache_dtype=None, fast_prefill: bool = False):
         import functools
 
         import jax
@@ -43,6 +43,7 @@ class Engine:
         self.spec = spec
         self.jnp = jnp
         self.mesh = mesh
+        self.fast_prefill = fast_prefill
         # f32 = logit-parity default; bf16 halves cache memory + attention
         # HBM traffic (the reference's cache is f32, transformer.cpp:198-199)
         self.cache_dtype = cache_dtype or jnp.float32
@@ -66,6 +67,18 @@ class Engine:
             self.cache = init_cache(spec, self.cache_dtype)
             self._step_raw = functools.partial(forward, spec)
             self._fwd = jax.jit(self._step_raw, donate_argnums=1)
+        if fast_prefill:
+            # a SECOND compiled forward, traced under bf16 matmul precision
+            # (ops/linear.bf16_prefill) — used only for T>8 prefill chunks;
+            # decode and the T=1 prefill tail keep the parity program.
+            # Documented tolerance: tests/test_prefill.py pins the
+            # prefilled-cache drift bound.
+            from ..ops.linear import bf16_prefill
+
+            self._fwd_prefill = jax.jit(bf16_prefill(self._step_raw),
+                                        donate_argnums=1)
+        else:
+            self._fwd_prefill = None
 
     def infer(self, token: int, pos: int) -> np.ndarray:
         """One decode step; returns f32 logits (vocab,). Blocks on device."""
@@ -94,9 +107,13 @@ class Engine:
         jnp = self.jnp
 
         def fwd(part, start):
-            _, self.cache = self._fwd(self.params, self.cache,
-                                      jnp.asarray(part, jnp.int32),
-                                      jnp.int32(start))
+            # fast-prefill (bf16) applies to the T>8 MXU-bound chunks only;
+            # the T=1 tail shares the decode parity program
+            f = (self._fwd_prefill if self._fwd_prefill is not None
+                 and len(part) > 8 else self._fwd)
+            _, self.cache = f(self.params, self.cache,
+                              jnp.asarray(part, jnp.int32),
+                              jnp.int32(start))
 
         run_chunked_prefill(fwd, tokens, pos0, chunk, self.spec.seq_len)
 

@@ -45,7 +45,8 @@ class InferenceServer:
                  tokenizer: Tokenizer, host: str, port: int, slots: int,
                  steps: int, temperature: float, topp: float, seed: int,
                  cache_dtype=None, mesh=None, prefill_chunk: int = 0,
-                 block_steps: int = 1, quiet: bool = False):
+                 block_steps: int = 1, quiet: bool = False,
+                 fast_prefill: bool = False):
         self.spec = spec
         self.tokenizer = tokenizer
         self.default_steps = steps
@@ -54,7 +55,8 @@ class InferenceServer:
                                        topp, seed, cache_dtype=cache_dtype,
                                        mesh=mesh,
                                        prefill_chunk=prefill_chunk,
-                                       block_steps=block_steps)
+                                       block_steps=block_steps,
+                                       fast_prefill=fast_prefill)
         self._shutdown = threading.Event()
         server = self
 

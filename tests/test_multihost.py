@@ -67,23 +67,12 @@ def _free_port():
 
 def _run(mode, model, tok, host_id, coordinator, n_devices, cwd, tp=2,
          extra=()):
-    env = dict(os.environ)
-    env["JAX_PLATFORMS"] = "cpu"
-    env["XLA_FLAGS"] = (f"--xla_force_host_platform_device_count={n_devices}")
-    env["PYTHONPATH"] = REPO
-    env.pop("DLLAMA_Q40_KERNEL", None)
-    args = [sys.executable, "-m", "distributed_llama_tpu.frontend.cli", mode,
-            "--model", model, "--tokenizer", tok, "--prompt", "hi",
-            "--steps", "6", "--temperature", "0.9", "--topp", "0.9",
-            "--seed", "11", "--tp", str(tp), *extra]
-    if coordinator:
-        args += ["--coordinator", coordinator, "--num-hosts", "2",
-                 "--host-id", str(host_id)]
-    # cwd is OUTSIDE the repo: some environments activate a hardware-backend
-    # shim keyed on the repo directory that overrides JAX_PLATFORMS=cpu
-    return subprocess.Popen(args, cwd=cwd, env=env,
-                            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
-                            text=True)
+    """2-host spawn with the classic generation args (delegates to _run_n —
+    one place owns the spawn environment)."""
+    gen = ("--prompt", "hi", "--steps", "6", "--temperature", "0.9",
+           "--topp", "0.9")
+    return _run_n(mode, model, tok, host_id, coordinator, 2, n_devices, cwd,
+                  tp=tp, extra=gen + tuple(extra))
 
 
 def _pieces(out):
@@ -204,3 +193,87 @@ def test_two_process_continuous(tmp_path):
     assert root.returncode == 0, f"root: {err_root[-2000:]}"
     assert worker.returncode == 0, f"worker: {err_worker[-2000:]}"
     assert _rows(out_root, drop_done=True) == want, out_root
+
+
+def _run_n(mode, model, tok, host_id, coordinator, n_hosts, n_devices, cwd,
+           tp=4, extra=()):
+    """Spawn one CLI process of an n-host run (THE spawn helper; _run wraps
+    it for the 2-host generation tests). cwd is OUTSIDE the repo: some
+    environments activate a hardware-backend shim keyed on the repo
+    directory that overrides JAX_PLATFORMS=cpu."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={n_devices}"
+    env["PYTHONPATH"] = REPO
+    env.pop("DLLAMA_Q40_KERNEL", None)
+    args = [sys.executable, "-m", "distributed_llama_tpu.frontend.cli", mode,
+            "--model", model, "--tokenizer", tok,
+            "--seed", "11", "--tp", str(tp), *extra]
+    if coordinator:
+        args += ["--coordinator", coordinator, "--num-hosts", str(n_hosts),
+                 "--host-id", str(host_id)]
+    return subprocess.Popen(args, cwd=cwd, env=env,
+                            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                            text=True)
+
+
+def test_four_process_tp4_matches_single(tmp_path):
+    """tp=4 with every slice on its OWN process (4 x 1 device) — the
+    widest all-DCN topology this suite spawns (VERDICT r1 #8; the reference
+    validated 8 socket nodes only by hand, README.md:40-50). Token stream
+    must equal the single-process tp=4 run."""
+    model, tok = _write_model_files(tmp_path, SPEC4)
+    cwd = str(tmp_path)
+    gen = ("--prompt", "hi", "--steps", "5", "--temperature", "0.9",
+           "--topp", "0.9")
+
+    p = _run_n("inference", model, tok, None, None, 1, 4, cwd, extra=gen)
+    out_single, err = p.communicate(timeout=300)
+    assert p.returncode == 0, err[-2000:]
+    want = _pieces(out_single)
+    assert want
+
+    coord = f"127.0.0.1:{_free_port()}"
+    procs = [_run_n("inference" if i == 0 else "worker", model, tok, i,
+                    coord, 4, 1, cwd, extra=gen) for i in range(4)]
+    outs = [p.communicate(timeout=420) for p in procs]
+    for i, (p, (o, e)) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"host {i}: {e[-2000:]}"
+    assert _pieces(outs[0][0]) == want, outs[0][0]
+    for o, _ in outs[1:]:
+        assert _pieces(o) == []  # workers run silent
+
+
+def test_two_process_train_dp_across_hosts(tmp_path):
+    """Training with the dp axis CROSSING the host boundary: 2 processes x
+    1 device = a global dp=2 mesh; every host feeds the identical global
+    windows (the data schedule is a pure function of seed/step) and jit
+    shards rows across hosts. Root's per-step losses must equal the
+    single-process dp=2 run's."""
+    model, tok = _write_model_files(tmp_path, SPEC4)
+    data = str(tmp_path / "corpus.txt")
+    with open(data, "w") as fh:
+        fh.write("the quick brown fox jumps over the lazy dog " * 30)
+    cwd = str(tmp_path)
+    tr = ("--data", data, "--steps", "3", "--batch", "4", "--seq", "16",
+          "--weights-float-type", "q40")
+
+    def losses(out):
+        return [ln.split("loss")[1].split()[0] for ln in out.splitlines()
+                if ln.startswith("🔶 step")]
+
+    p = _run_n("train", model, tok, None, None, 1, 2, cwd, tp=1,
+               extra=tr + ("--dp", "2"))
+    out_single, err = p.communicate(timeout=300)
+    assert p.returncode == 0, err[-2000:]
+    want = losses(out_single)
+    assert len(want) == 3, out_single
+
+    coord = f"127.0.0.1:{_free_port()}"
+    procs = [_run_n("train", model, tok, i, coord, 2, 1, cwd, tp=1,
+                    extra=tr + ("--dp", "2")) for i in range(2)]
+    outs = [p.communicate(timeout=420) for p in procs]
+    for i, (p, (o, e)) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"host {i}: {e[-2000:]}"
+    assert losses(outs[0][0]) == want, outs[0][0]
+    assert losses(outs[1][0]) == []  # non-root hosts run silent

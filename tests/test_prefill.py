@@ -185,3 +185,39 @@ def test_prefill_gates_off_when_prompt_exceeds_steps(params):
     got, _ = generate(Engine(SPEC, params), tok, _sampler(), long, steps=6,
                       quiet=True, prefill_chunk=4)
     assert got == ref
+
+
+def test_fast_prefill_bf16_tolerance_and_isolation():
+    """--fast-prefill: the bf16 prefill program fills the cache within a
+    pinned tolerance of the parity program, touches ONLY T>8 chunks (the
+    T=1 tail and decode keep the parity forward), and the same-engine
+    decode path object is unchanged (VERDICT r1 #7)."""
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from distributed_llama_tpu.models.synth import synth_params
+    from distributed_llama_tpu.runtime.generate import Engine
+
+    params = synth_params(SPEC, q40=False, seed=3, scale=0.3)
+    tokens = list(np.random.default_rng(1).integers(2, SPEC.vocab_size,
+                                                    12))
+
+    ref = Engine(SPEC, params)
+    ref.prefill([int(t) for t in tokens], 0, chunk=12)
+    fast = Engine(SPEC, params, fast_prefill=True)
+    assert fast._fwd_prefill is not None and fast._fwd_prefill is not fast._fwd
+    fast.prefill([int(t) for t in tokens], 0, chunk=12)
+
+    k_ref = np.asarray(ref.cache.k[:, :12])
+    k_fast = np.asarray(fast.cache.k[:, :12])
+    # pinned bf16 drift bound, relative to activation scale: bf16 mantissa
+    # gives ~2^-8 per op; observed ~1.2e-2 relative over 2 layers — pin ~2x
+    scale = np.abs(k_ref).max()
+    drift = np.abs(k_ref - k_fast).max() / scale
+    assert 0 < drift < 2.5e-2
+    # decode after prefill still runs the parity program (same jitted fn)
+    lg_ref = ref.infer(int(tokens[-1]) % SPEC.vocab_size, 12)
+    lg_fast = fast.infer(int(tokens[-1]) % SPEC.vocab_size, 12)
+    rel = np.abs(lg_ref - lg_fast).max() / max(np.abs(lg_ref).max(), 1e-9)
+    assert rel < 2.5e-2  # only prefilled-cache drift remains

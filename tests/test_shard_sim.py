@@ -42,23 +42,11 @@ def test_sim_tp1_equals_single_chip_forward():
 
 
 def _dot_shapes(fn, *args):
-    import jax
+    from jaxpr_utils import walk_fn_eqns
 
-    shapes = []
-
-    def walk(jaxpr):
-        for eqn in jaxpr.eqns:
-            if eqn.primitive.name in ("dot_general", "einsum"):
-                shapes.append(tuple(tuple(v.aval.shape) for v in eqn.invars))
-            for v in eqn.params.values():
-                inner = getattr(v, "jaxpr", None)
-                if hasattr(v, "eqns"):
-                    walk(v)
-                elif inner is not None and hasattr(inner, "eqns"):
-                    walk(inner)
-
-    walk(jax.make_jaxpr(fn)(*args).jaxpr)
-    return sorted(shapes)
+    return sorted(tuple(tuple(v.aval.shape) for v in e.invars)
+                  for e in walk_fn_eqns(fn, *args)
+                  if e.primitive.name in ("dot_general", "einsum"))
 
 
 def test_sim_matches_real_rank_program_structure():

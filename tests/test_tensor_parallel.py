@@ -140,24 +140,12 @@ def test_engine_rejects_indivisible_before_device_put():
         Engine(spec, p, mesh=mesh)
 
 
-def _walk_eqns(jaxpr):
-    """Yield every eqn in a jaxpr, recursing into sub-jaxprs (shard_map,
-    scan, cond bodies) — how we X-ray what the collectives actually carry."""
-    for eqn in jaxpr.eqns:
-        yield eqn
-        for v in eqn.params.values():
-            inner = getattr(v, "jaxpr", None)
-            if hasattr(v, "eqns"):
-                yield from _walk_eqns(v)
-            elif inner is not None and hasattr(inner, "eqns"):
-                yield from _walk_eqns(inner)
-
-
 def _all_gather_dtypes(fn, *args):
-    import jax
+    """X-ray what the collectives actually carry (shared walker:
+    tests/jaxpr_utils.py)."""
+    from jaxpr_utils import walk_fn_eqns
 
-    closed = jax.make_jaxpr(fn)(*args)
-    return sorted(str(e.invars[0].aval.dtype) for e in _walk_eqns(closed.jaxpr)
+    return sorted(str(e.invars[0].aval.dtype) for e in walk_fn_eqns(fn, *args)
                   if e.primitive.name == "all_gather")
 
 
