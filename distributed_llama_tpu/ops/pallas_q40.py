@@ -326,7 +326,12 @@ def _pick_block_rows(d: int, t: int = 1, nb: int = 128) -> int | None:
       for the f32 wlo/whi temporaries.
     """
     if t == 1:
-        step, cap = 8, d
+        # rows*nb VMEM budget: the double-buffered tile set is ~(16+4) bytes
+        # per (row, block) — 16 u8 code planes + one f32 scale — so 360k
+        # keeps it under ~14.4 MB of the 16 MB scoped limit. Only binds at
+        # very wide inputs (nb=896 at 70B's hidden/8=28672-wide w2 slice:
+        # an uncapped 512-row tile measured 17.5 MB and failed to compile)
+        step, cap = 8, max(8, 360_000 // nb)
     elif t <= MULTI_T_MAX:
         # the compiler keeps several unrolled-plane temporaries live next to
         # the t accumulators; 300k f32 words of rows*nb*t keeps the whole

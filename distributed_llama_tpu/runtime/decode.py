@@ -102,11 +102,11 @@ def sample_device_dynamic(logits: jax.Array, coin: jax.Array,
                                _mult_walk(probs, coin)))
 
 
-def make_decode_loop(step_fn: StepFn, max_steps: int, temperature: float,
+def _make_decode_run(step_fn: StepFn, max_steps: int, temperature: float,
                      topp: float):
     """Build run(params, cache, prompt_padded, first_token, coins,
     start_pos, num_steps) -> (tokens (max_steps,), cache): the fused
-    generation loop.
+    generation loop (raw traceable fn; make_decode_loop jits it).
 
     ``max_steps`` (typically seq_len) fixes the BUFFER shapes only; the
     actual step budget ``num_steps`` is a traced scalar bound of the
@@ -160,7 +160,59 @@ def make_decode_loop(step_fn: StepFn, max_steps: int, temperature: float,
                          toks0))
         return toks, cache
 
-    return jax.jit(run, donate_argnums=1)
+    run.__name__ = "decode_chain"
+    return run
+
+
+def make_decode_loop(step_fn: StepFn, max_steps: int, temperature: float,
+                     topp: float):
+    """The fused generation loop, jitted (see _make_decode_run)."""
+    return jax.jit(_make_decode_run(step_fn, max_steps, temperature, topp),
+                   donate_argnums=1)
+
+
+def make_decode_loop_aot(step_fn: StepFn, max_steps: int,
+                         temperature: float, topp: float):
+    """make_decode_loop variant that AOT-compiles with the parameter layouts
+    PINNED to what the placed arrays actually have, instead of letting the
+    (tunnel-side) AOT compiler choose compact input layouts and convert
+    them inside the program.
+
+    Why: with unconstrained inputs the compiler may pick a parameter layout
+    different from what the Pallas kernels pin (row-major), materializing
+    layout-conversion copies of every multi-GB weight stack INSIDE the
+    chain — at 13B those tile-padded temps alone are ~10 GB, an OOM on a
+    16 GB chip. Pinning in_shardings to a layout we choose does not work
+    either: device_put over the tunnel runtime silently keeps its own
+    transfer layout, and Layout.AUTO can publish formats the final
+    executable then rejects. So the one self-consistent order is place
+    FIRST, read each leaf's actual Format, and compile with exactly those —
+    the executable accepts the arrays by construction, and any residual
+    conversion is the compiler's explicit, visible choice.
+
+    Returns compile_and_place(params_host, cache, prompt, first, coins,
+    start, n) -> (compiled, params_on_device).
+    """
+    import numpy as np
+
+    run = _make_decode_run(step_fn, max_steps, temperature, topp)
+
+    def compile_and_place(params_host, *rest):
+        def sds(a):
+            a = np.asarray(a) if not hasattr(a, "dtype") else a
+            return jax.ShapeDtypeStruct(a.shape, a.dtype)
+
+        placed = jax.tree_util.tree_map(
+            lambda a: jax.device_put(jnp.asarray(a)), params_host)
+        param_formats = jax.tree_util.tree_map(lambda a: a.format, placed)
+        jitted = jax.jit(run, donate_argnums=1,
+                         in_shardings=(param_formats,) + (None,) * 6)
+        abstract = (jax.tree_util.tree_map(sds, placed),
+                    *(jax.tree_util.tree_map(sds, r) for r in rest))
+        compiled = jitted.lower(*abstract).compile()
+        return compiled, placed
+
+    return compile_and_place
 
 
 def make_batch_decode_loop(spec, steps: int, temperature: float, topp: float,

@@ -253,3 +253,35 @@ def test_tp_sharded_forward_with_flash_attention(monkeypatch):
                            jnp.int32(pos))
     np.testing.assert_allclose(np.asarray(lg[0]), np.asarray(want[0]),
                                rtol=2e-5, atol=2e-5)
+
+
+def test_matvec_tile_vmem_cap_on_wide_inputs():
+    """70B-shard regression: the T=1 matvec tiler must cap rows*nb so the
+    double-buffered tile set (16 u8 planes + f32 scale per (row, block))
+    stays under the 16 MB scoped-VMEM limit. At nb=896 (w2's hidden/8 =
+    28672-wide input) an uncapped 512-row tile measured 17.5 MB and the
+    kernel failed to COMPILE on the real chip — the bench then silently
+    recorded the 3x-slower XLA fallback."""
+    import jax.numpy as jnp
+
+    from distributed_llama_tpu.ops.pallas_q40 import (_pick_block_rows,
+                                                      q40_matmul)
+
+    rows = _pick_block_rows(1024, 1, 896)
+    assert rows is not None and rows * 896 <= 360_000
+    # 7B/13B tilings unchanged by the cap (nb <= 432 never binds: the 768
+    # top is the binding limit there)
+    assert _pick_block_rows(4096, 1, 344) == 512  # 7B w2, as in round 1
+    for d, nb in ((4096, 128), (11008, 128), (4096, 344), (5120, 160)):
+        r = _pick_block_rows(d, 1, nb)
+        assert r is not None and r * nb <= 360_000
+
+    # correctness at the capped tiling (interpret mode; the REAL 70B w2
+    # band shape d=1024, so the cap actually binds: rows=256+grid, not a
+    # single full-d tile)
+    w = _mk(1024, 28672)
+    x = np.random.default_rng(3).standard_normal((1, 28672)).astype(
+        np.float32)
+    want = dequantize_q40(np.asarray(w.qs), np.asarray(w.d16)) @ x.T
+    got = q40_matmul(w, jnp.asarray(x), interpret=True)
+    np.testing.assert_allclose(np.asarray(got), want.T, rtol=1e-4, atol=1e-3)

@@ -84,11 +84,15 @@ def main() -> int:
 
     from distributed_llama_tpu.ops.linear import matmul, rmsnorm, silu
 
-    stacked, scanned = llama.split_layer_weights(params)
     idxs = jnp.arange(spec.n_layers, dtype=jnp.int32)
 
-    def layer_scan(body, x0):
+    # every phase fn takes ``params`` as an ARGUMENT (closing over the
+    # device tree would bake 4+ GB of weights into each executable as
+    # captured constants — re-uploaded per phase over the tunnel runtime)
+    def layer_scan(body, params, x0):
         """Scan ``body(x, lw, idx) -> x`` over the layers, K times."""
+        stacked, scanned = llama.split_layer_weights(params)
+
         def one_iter(x, _):
             def per_layer(x, per):
                 idx, lw_slice = per
@@ -101,6 +105,38 @@ def main() -> int:
         return x
 
     x0 = jnp.ones((1, spec.dim), jnp.float32) * 0.01
+
+    # -- phase 0: pure weight streaming (the HBM/DMA ceiling) -----------
+    # reduce-sum every packed byte of every layer's weights: XLA reads the
+    # same HBM bytes as the matmul phase but does no unpack/MXU work. If
+    # this time ~= the matmul phase, the kernels are DMA-bound and further
+    # compute-side optimization (e.g. an int8-MXU Q40xQ80 formulation,
+    # reference funcs.cpp:185-260) has no headroom — the proof-of-floor
+    # experiment VERDICT r1 #3 asks for.
+    def stream_body(acc, lw, idx):
+        # XOR with a CARRY-dependent byte: without it XLA's loop-invariant
+        # code motion hoists the (iteration-independent) sums out of the
+        # K-loop and the phase reads K-times too fast (observed on CPU)
+        m = (acc & 1).astype(jnp.uint8)
+
+        def bsum(a):
+            if a.dtype != jnp.uint8:
+                a = jax.lax.bitcast_convert_type(a, jnp.uint8)
+            return jnp.sum(a ^ m, dtype=jnp.int32)
+
+        for k, w in lw.items():
+            if hasattr(w, "w"):          # StackedQ40 view (kernel layout)
+                acc += bsum(w.w.qs_t[w.layer]) + bsum(w.w.scale[w.layer])
+            elif hasattr(w, "qs_t"):     # per-layer Q40Kernel
+                acc += bsum(w.qs_t) + bsum(w.scale)
+            elif hasattr(w, "qs"):       # codec-layout Q40Weight (no pack)
+                acc += bsum(w.qs) + bsum(w.d16)
+            else:                        # dense f32/bf16 weight or norm vec
+                acc += bsum(w)
+        return acc
+
+    p_stream = jax.jit(
+        lambda params, x: layer_scan(stream_body, params, x))
 
     # -- phase 1: matmuls only ------------------------------------------
     def mm_body(x, lw, idx):
@@ -120,7 +156,7 @@ def main() -> int:
             hb = matmul(lw["w1"], x) * matmul(lw["w3"], x)
         return x + 1e-6 * matmul(lw["w2"], hb)
 
-    p_mm = jax.jit(lambda x: layer_scan(mm_body, x))
+    p_mm = jax.jit(lambda params, x: layer_scan(mm_body, params, x))
 
     # -- phase 2: + glue (norms, rope, swiglu activation, q80) ----------
     positions0 = jnp.asarray([pos0])
@@ -130,12 +166,12 @@ def main() -> int:
         ao = q  # skip attention: feed q straight to wo
         return llama._post_attention(spec, lw, x * 1e-6, ao)
 
-    p_glue = jax.jit(lambda x: layer_scan(glue_body, x))
+    p_glue = jax.jit(lambda params, x: layer_scan(glue_body, params, x))
 
     # -- phase 3: + attention/cache = the real layer body ---------------
-    cache0 = llama.init_cache(spec)
+    def full_layers(params, x, k_all, v_all):
+        stacked, scanned = llama.split_layer_weights(params)
 
-    def full_layers(x, k_all, v_all):
         def one_iter(carry, _):
             x, k_all, v_all = carry
             def per_layer(c, per):
@@ -154,7 +190,7 @@ def main() -> int:
                                     length=K)
         return x
 
-    p_att = jax.jit(full_layers, donate_argnums=(1, 2))
+    p_att = jax.jit(full_layers, donate_argnums=(2, 3))
 
     # -- phase 4: full step (forward incl. wcls) ------------------------
     def full_steps(params, cache, tok):
@@ -187,9 +223,12 @@ def main() -> int:
     results = {}
     tok0 = jnp.asarray([7], jnp.int32)
     for name, fn, fargs in (
-            ("matmuls", p_mm, (x0,)),
-            ("glue", p_glue, (x0,)),
-            ("attention", lambda x: p_att(x, *llama.init_cache(spec)), (x0,)),
+            ("stream", p_stream, (params, jnp.int32(0))),
+            ("matmuls", p_mm, (params, x0)),
+            ("glue", p_glue, (params, x0)),
+            ("attention",
+             lambda params, x: p_att(params, x, *llama.init_cache(spec)),
+             (params, x0)),
             ("full_step", lambda: p_step(params, llama.init_cache(spec),
                                          tok0), ()),
             ("chain_step", p_chain, ())):
@@ -201,6 +240,7 @@ def main() -> int:
               file=sys.stderr)
 
     deltas = {
+        "weight_stream_floor": results["stream"],
         "matmuls": results["matmuls"],
         "glue_delta": round(results["glue"] - results["matmuls"], 3),
         "attention_delta": round(results["attention"] - results["glue"], 3),
