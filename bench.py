@@ -70,19 +70,20 @@ def _bench(spec, params, samples: int, per_step: bool = False,
 
     cache_dtype = (jnp.bfloat16 if os.environ.get("DLLAMA_BENCH_KV_BF16")
                    else jnp.float32)
+    # ONE pack+fuse recipe for both branches (kernel layout + wqkv/w13
+    # fusion; band shapes are rank-local already on the rank_tp path, where
+    # per-rank fusion is valid by construction — shard_sim)
+    from distributed_llama_tpu.ops.linear import (fuse_q40_layer_matmuls,
+                                                  pack_q40_params)
+
+    host_params = fuse_q40_layer_matmuls(pack_q40_params(params))
     if rank_tp:
-        from distributed_llama_tpu.ops.linear import pack_q40_params
         from distributed_llama_tpu.parallel import shard_sim
 
-        host_params = pack_q40_params(params, tp=1)
         step = shard_sim.make_rank_step(spec, rank_tp)
         init_cache = functools.partial(shard_sim.init_rank_cache, spec,
                                        rank_tp, cache_dtype)
     else:
-        from distributed_llama_tpu.ops.linear import (fuse_q40_layer_matmuls,
-                                                      pack_q40_params)
-
-        host_params = fuse_q40_layer_matmuls(pack_q40_params(params))
         step = functools.partial(forward, spec)
         init_cache = functools.partial(init_cache, spec, cache_dtype)
     if per_step:
@@ -190,6 +191,10 @@ def main():
     args = ap.parse_args()
     if args.small:
         args.config = "small"
+    # "=0" means f32 for EVERY config (the 13b branch advertises it);
+    # normalize once so the truthiness checks downstream can't invert it
+    if os.environ.get("DLLAMA_BENCH_KV_BF16") == "0":
+        del os.environ["DLLAMA_BENCH_KV_BF16"]
 
     import jax
 
@@ -246,8 +251,6 @@ def main():
                 os.environ["DLLAMA_BENCH_KV_BF16"] = "1"
                 print("13b: defaulting to bf16 KV cache (f32 exceeds one "
                       "16 GB chip)", file=sys.stderr)
-            elif os.environ["DLLAMA_BENCH_KV_BF16"] == "0":
-                del os.environ["DLLAMA_BENCH_KV_BF16"]
         elif args.config == "70b-tp8":
             from distributed_llama_tpu.parallel.shard_sim import synth_rank_q40
 

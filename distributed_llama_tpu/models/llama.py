@@ -88,14 +88,21 @@ def attention_core(head_size: int, kv_mul: int, q: jax.Array, k: jax.Array,
     n_kv = k.shape[-2]
     qg = q.reshape(*lead, t_len, n_kv, kv_mul, head_size)
     scale = 1.0 / jnp.sqrt(jnp.float32(head_size))
+    # fast-prefill (trace-time flag): bf16 MXU passes for the score and
+    # weighted-sum einsums, f32 accumulation + f32 softmax — the same
+    # documented-tolerance contract as the matmuls (ops/linear)
+    from ..ops.linear import matmul_mode
+
+    prec = (None if matmul_mode() == "bf16"
+            else jax.lax.Precision.HIGHEST)
     scores = jnp.einsum("...tgmd,...sgd->...gmts", qg, k,
                         preferred_element_type=jnp.float32,
-                        precision=jax.lax.Precision.HIGHEST) * scale
+                        precision=prec) * scale
     scores = jnp.where(mask[..., None, None, :, :], scores, -jnp.inf)
     att = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("...gmts,...sgd->...tgmd", att, v,
                      preferred_element_type=jnp.float32,
-                     precision=jax.lax.Precision.HIGHEST)
+                     precision=prec)
     return out.reshape(*lead, t_len, n_q * head_size)
 
 

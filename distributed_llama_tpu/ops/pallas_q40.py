@@ -128,16 +128,18 @@ def _kernel_multi_stacked(layer_ref, qs_ref, scale_ref, xlo_ref, xhi_ref,
 MULTI_T_MAX = 8  # beyond this the per-row accumulators crowd VMEM; use MXU
 
 
-def _matmul_body(qs3, s, xlo_ref, xhi_ref, out_ref):
-    """Shared T>1 MXU body: qs3 (NJ, R, nb) codes view, s (R, nb) scales."""
-    from .linear import matmul_mode
+def _matmul_body(qs3, s, xlo_ref, xhi_ref, out_ref, bf16=False):
+    """Shared T>1 MXU body: qs3 (NJ, R, nb) codes view, s (R, nb) scales.
 
+    ``bf16`` (fast-prefill, ops/linear.matmul_precision): bf16 MXU passes
+    with f32 accumulation instead of the 3-pass HIGHEST f32 discipline —
+    T>8 prefill is MXU-bound, so this is the big lever. The flag is threaded
+    EXPLICITLY from q40_matmul (where the trace-time contextvar is read)
+    because _q40_matmul_2d/_q40_matmul_stacked are themselves jitted and
+    their trace cache cannot see the contextvar — a cached parity trace
+    would silently serve the bf16 program (and did, round 2).
+    """
     dn = (((1,), (1,)), ((), ()))                # contract both minor dims
-    # fast-prefill mode (trace-time flag, ops/linear.matmul_precision):
-    # bf16 MXU passes with f32 accumulation instead of the 3-pass HIGHEST
-    # f32 discipline — T>8 prefill is MXU-bound, so this is the ~3x lever;
-    # parity programs never trace with it set
-    bf16 = matmul_mode() == "bf16"
     wdt = jnp.bfloat16 if bf16 else jnp.float32
     prec = None if bf16 else jax.lax.Precision.HIGHEST
     acc = None
@@ -160,13 +162,14 @@ def _matmul_body(qs3, s, xlo_ref, xhi_ref, out_ref):
     out_ref[...] = acc
 
 
-def _kernel(qs_ref, scale_ref, xlo_ref, xhi_ref, out_ref):
-    _matmul_body(qs_ref, scale_ref[...], xlo_ref, xhi_ref, out_ref)
+def _kernel(qs_ref, scale_ref, xlo_ref, xhi_ref, out_ref, *, bf16=False):
+    _matmul_body(qs_ref, scale_ref[...], xlo_ref, xhi_ref, out_ref, bf16)
 
 
-def _kernel_stacked(layer_ref, qs_ref, scale_ref, xlo_ref, xhi_ref, out_ref):
+def _kernel_stacked(layer_ref, qs_ref, scale_ref, xlo_ref, xhi_ref, out_ref,
+                    *, bf16=False):
     del layer_ref  # consumed by the index maps
-    _matmul_body(qs_ref[0], scale_ref[0], xlo_ref, xhi_ref, out_ref)
+    _matmul_body(qs_ref[0], scale_ref[0], xlo_ref, xhi_ref, out_ref, bf16)
 
 
 def _split_x(x: jax.Array, nb: int) -> tuple[jax.Array, jax.Array]:
@@ -179,8 +182,10 @@ def _split_x(x: jax.Array, nb: int) -> tuple[jax.Array, jax.Array]:
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("block_rows", "block_t", "interpret"))
-def _q40_matmul_2d(qs_t, scale, x, *, block_rows, block_t, interpret):
+                   static_argnames=("block_rows", "block_t", "interpret",
+                                    "bf16"))
+def _q40_matmul_2d(qs_t, scale, x, *, block_rows, block_t, interpret,
+                   bf16=False):
     _, d, nb = qs_t.shape
     t = x.shape[0]
     xlo, xhi = _split_x(x.astype(jnp.float32), nb)
@@ -220,7 +225,7 @@ def _q40_matmul_2d(qs_t, scale, x, *, block_rows, block_t, interpret):
         return jnp.transpose(out)                    # (t, d)
     grid = (t // block_t, d // block_rows)
     out = pl.pallas_call(
-        _kernel,
+        functools.partial(_kernel, bf16=bf16),
         grid=grid,
         in_specs=[
             pl.BlockSpec((NJ, block_rows, nb), lambda ti, i: (0, i, 0)),
@@ -236,9 +241,10 @@ def _q40_matmul_2d(qs_t, scale, x, *, block_rows, block_t, interpret):
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("block_rows", "block_t", "interpret"))
+                   static_argnames=("block_rows", "block_t", "interpret",
+                                    "bf16"))
 def _q40_matmul_stacked(layer, qs_t, scale, x, *, block_rows, block_t,
-                        interpret):
+                        interpret, bf16=False):
     _, _, d, nb = qs_t.shape
     t = x.shape[0]
     xlo, xhi = _split_x(x.astype(jnp.float32), nb)
@@ -298,7 +304,7 @@ def _q40_matmul_stacked(layer, qs_t, scale, x, *, block_rows, block_t,
                                lambda ti, i, L: (ti, i)),
     )
     return pl.pallas_call(
-        _kernel_stacked, grid_spec=grid_spec,
+        functools.partial(_kernel_stacked, bf16=bf16), grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((t, d), jnp.float32),
         interpret=interpret,
     )(layer, qs_t, scale, xlo, xhi)
@@ -310,7 +316,8 @@ def _q40_matmul_stacked(layer, qs_t, scale, x, *, block_rows, block_t,
 _MATMUL_ROWSXNB_CAP = 131072
 
 
-def _pick_block_rows(d: int, t: int = 1, nb: int = 128) -> int | None:
+def _pick_block_rows(d: int, t: int = 1, nb: int = 128,
+                     block_t: int | None = None) -> int | None:
     """Output-tile rows, up to ~768/tile (amortizes grid-step overhead while
     keeping the unpack working set in VMEM).
 
@@ -338,7 +345,18 @@ def _pick_block_rows(d: int, t: int = 1, nb: int = 128) -> int | None:
         # stack under the 16MB scoped-vmem limit with double buffering
         step, cap = 8, max(8, 300_000 // (t * nb))
     else:
-        step, cap = 128, _MATMUL_ROWSXNB_CAP // nb
+        # MXU path. With a FULL 128-row t-tile Mosaic pipelines the
+        # unrolled-plane f32 temporaries within the budget; at smaller
+        # t-tiles it keeps more of them live and big row tiles overflow
+        # scoped VMEM. Measured boundary: (nb=128, bt=32, rows=640) needs
+        # 17.6M and fails to compile; (nb=128, bt=32, rows=256) passes;
+        # (nb=344, bt=64, rows=256) is the round-1-proven 7B w2 prefill
+        # tile; (nb=128, bt=128, rows=640) passes. So: full-bt keeps the
+        # rows*nb word cap, small-bt caps rows at 256.
+        if (block_t or 128) >= 128:
+            step, cap = 128, _MATMUL_ROWSXNB_CAP // nb
+        else:
+            step, cap = 128, 256
     top = (min(d, 768, cap) // step) * step
     for cand in range(top, 0, -step):
         if d % cand == 0:
@@ -415,6 +433,12 @@ def q40_matmul(w: Q40Kernel | Q40Weight, x: jax.Array,
     d, nb = qs_t.shape[-2], qs_t.shape[-1]
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    # read the trace-time precision flag HERE (q40_matmul is inlined in the
+    # caller's trace) and thread it as a static arg — the inner jits below
+    # cache traces and cannot see the contextvar
+    from .linear import matmul_mode
+
+    bf16 = matmul_mode() == "bf16"
     lead = x.shape[:-1]
     n = x.shape[-1]
     x2 = x.reshape(-1, n)
@@ -428,23 +452,23 @@ def q40_matmul(w: Q40Kernel | Q40Weight, x: jax.Array,
                          block_rows=block_rows, interpret=interpret,
                          layer=layer)
         return out[:t].reshape(*lead, d)
+    block_t = _pick_block_t(t, nb)
     if block_rows is None:
-        block_rows = _pick_block_rows(d, t, nb)
+        block_rows = _pick_block_rows(d, t, nb, block_t)
         if block_rows is None:
             # this (d, t) combo has no legal tiling (e.g. TP-shard dims with
             # no multiple-of-128 divisor at MXU T): dequantize-then-dot on
             # the packed weight — correctness everywhere, kernel speed on
             # the shapes that matter
             return _dequant_matmul(w, x2, layer).reshape(*lead, d)
-    block_t = _pick_block_t(t, nb)
     if layer is not None:
         if qs_t.ndim != 4:
             raise ValueError("layer= requires stacked (L, 16, d, nb) weights")
         lidx = jnp.asarray(layer, dtype=jnp.int32).reshape(1)
         out = _q40_matmul_stacked(lidx, qs_t, scale, x2,
                                   block_rows=block_rows, block_t=block_t,
-                                  interpret=interpret)
+                                  interpret=interpret, bf16=bf16)
     else:
         out = _q40_matmul_2d(qs_t, scale, x2, block_rows=block_rows,
-                             block_t=block_t, interpret=interpret)
+                             block_t=block_t, interpret=interpret, bf16=bf16)
     return out.reshape(*lead, d)

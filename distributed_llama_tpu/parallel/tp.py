@@ -54,6 +54,11 @@ _MATMUL_SPECS = {
     "wv": P(None, "tp", None), "wo": P(None, "tp", None),
     "w1": P(None, "tp", None), "w2": P(None, "tp", None),
     "w3": P(None, "tp", None),
+    # NOTE: fused wqkv/w13 (ops/linear.fuse_q40_layer_matmuls) are
+    # deliberately ABSENT: contiguous P-sharding of a [q;k;v] concat would
+    # hand rank 0 only q rows while _tp_qkv splits each local chunk as
+    # [q|k|v] — silently wrong. Fused trees are per-rank-local only
+    # (shard_sim); a fused tree reaching shard_params fails loudly here.
     "wcls": P("tp", None),
 }
 _REPL_SPECS = {
@@ -156,7 +161,7 @@ def _wire_gather(spec: TransformerSpec, x: jax.Array,
     return _gather(x, gather_fn)
 
 
-def _tp_qkv(spec: TransformerSpec, lw, x, positions):
+def _tp_qkv(spec: TransformerSpec, n_slices: int, lw, x, positions):
     """Shard-local attention input path: norm -> (q80 wire) -> local q/k/v
     bands -> RoPE. x is the replicated activations, (T, dim) or (B, dim).
 
@@ -165,9 +170,17 @@ def _tp_qkv(spec: TransformerSpec, lw, x, positions):
     """
     xb = rmsnorm(x, lw["rms_att"])
     xb = _wire(spec, xb)  # reference quantizes xb before qkv (quantizeRmsAtt)
-    q = matmul(lw["wq"], xb)                       # (T, dim/S)
-    k = matmul(lw["wk"], xb)                       # (T, kvDim/S)
-    v = matmul(lw["wv"], xb)
+    if "wqkv" in lw:  # load-time fused local bands (one kernel call)
+        d_loc = spec.dim // n_slices
+        kv_loc = spec.kv_dim // n_slices
+        qkv = matmul(lw["wqkv"], xb)
+        q = qkv[..., :d_loc]
+        k = qkv[..., d_loc:d_loc + kv_loc]
+        v = qkv[..., d_loc + kv_loc:]
+    else:
+        q = matmul(lw["wq"], xb)                   # (T, dim/S)
+        k = matmul(lw["wk"], xb)                   # (T, kvDim/S)
+        v = matmul(lw["wv"], xb)
     q = rope_rotate(q, positions, spec.head_size)
     k = rope_rotate(k, positions, spec.head_size)
     return q, k, v
@@ -185,7 +198,12 @@ def _tp_tail(spec: TransformerSpec, x, lw, ao, gather_fn=_ici_gather):
 
     xb = rmsnorm(x, lw["rms_ffn"])
     xb = _wire(spec, xb)                           # ⇄ quantizeRmfFfn
-    hb = silu(matmul(lw["w1"], xb)) * matmul(lw["w3"], xb)  # (T, hidden/S)
+    if "w13" in lw:  # fused local SwiGLU input bands
+        h13 = matmul(lw["w13"], xb)
+        hid_loc = h13.shape[-1] // 2
+        hb = silu(h13[..., :hid_loc]) * h13[..., hid_loc:]
+    else:
+        hb = silu(matmul(lw["w1"], xb)) * matmul(lw["w3"], xb)  # (T, hid/S)
     hb = _wire_gather(spec, hb, gather_fn)         # ⇄ syncFfnA+syncFfnB
     xb2 = matmul(lw["w2"], hb)                     # (T, dim/S)
     return x + _wire_gather(spec, xb2, gather_fn)  # ⇄ syncFfn2 + residual
@@ -202,7 +220,7 @@ def _local_layer(spec: TransformerSpec, n_slices: int, n_sp: int, x, lw,
     kv_heads_loc = spec.n_kv_heads // n_slices
     seq_chunk = spec.seq_len // n_sp
 
-    q, k, v = _tp_qkv(spec, lw, x, positions)
+    q, k, v = _tp_qkv(spec, n_slices, lw, x, positions)
     dt = k_all.dtype  # f32 parity default; bf16 halves cache HBM/memory
     k_new = k.reshape(t_len, kv_heads_loc, spec.head_size).astype(dt)
     v_new = v.reshape(t_len, kv_heads_loc, spec.head_size).astype(dt)
@@ -421,7 +439,7 @@ def make_sharded_forward_batch(spec: TransformerSpec, mesh: Mesh):
             x, k_all, v_all = carry
             idx, lw_slice = per_layer
             lw = layer_view(stacked, lw_slice, idx)
-            q, k, v = _tp_qkv(spec, lw, x, positions)
+            q, k, v = _tp_qkv(spec, n_slices, lw, x, positions)
             if n_sp == 1:
                 # shared with the single-chip batch path; the shard's cache
                 # holds kv_loc heads, read off the carry

@@ -51,6 +51,9 @@ _COLLECTIVE_RE = re.compile(
     r"all[_-]gather|all[_-]reduce|reduce[_-]scatter|collective[_-]permute"
     r"|all[_-]to[_-]all|collective[_-]broadcast|\bsend\b|\brecv\b"
     r"|^send|^recv|ragged[_-]all[_-]to[_-]all")
+# TPU 'XLA Ops' lines carry the full HLO instruction text
+# ('%fusion.3 = f32[...] fusion(...)') — extract the instruction name
+_HLO_RE = re.compile(r"^%([\w.\-]+)\s*=")
 
 
 @dataclasses.dataclass
@@ -109,6 +112,9 @@ def parse_trace(path: str) -> dict[str, DeviceSplit]:
             split = DeviceSplit()
             for ev in line.events:
                 name = ev.name
+                hlo = _HLO_RE.match(name)
+                if hlo:
+                    name = hlo.group(1)
                 if _SKIP_RE.search(name) or not _OP_RE.match(name):
                     continue
                 ns = float(ev.duration_ns)

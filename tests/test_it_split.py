@@ -84,3 +84,15 @@ def test_classifier_rules():
 def test_missing_trace_errors(tmp_path):
     with pytest.raises(FileNotFoundError, match="profile"):
         it_split.find_xplane(str(tmp_path))
+
+
+def test_hlo_instruction_names_extracted():
+    """Real-TPU 'XLA Ops' lines carry full HLO text; the parser must
+    extract the instruction name and classify on it."""
+    m = it_split._HLO_RE.match(
+        "%all-gather.7 = f32[4096]{0} all-gather(f32[512]{0} %p), dims={0}")
+    assert m and m.group(1) == "all-gather.7"
+    assert it_split._COLLECTIVE_RE.search(m.group(1))
+    m2 = it_split._HLO_RE.match(
+        "%convolution_reduce_fusion = f32[]{:T(128)} fusion(...)")
+    assert m2 and not it_split._COLLECTIVE_RE.search(m2.group(1))

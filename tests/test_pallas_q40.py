@@ -285,3 +285,26 @@ def test_matvec_tile_vmem_cap_on_wide_inputs():
     want = dequantize_q40(np.asarray(w.qs), np.asarray(w.d16)) @ x.T
     got = q40_matmul(w, jnp.asarray(x), interpret=True)
     np.testing.assert_allclose(np.asarray(got), want.T, rtol=1e-4, atol=1e-3)
+
+
+def test_bf16_mode_not_served_from_parity_trace_cache():
+    """The jitted kernel wrappers key their trace cache on the precision
+    flag: tracing parity FIRST then bf16 must produce a bf16 result, not a
+    silently-reused parity trace (the contextvar alone is invisible to the
+    jit cache — the round-2 bug that made --fast-prefill a no-op)."""
+    import jax.numpy as jnp
+
+    from distributed_llama_tpu.ops.linear import matmul_precision
+    from distributed_llama_tpu.ops.pallas_q40 import q40_matmul
+
+    w = _mk(256, 512, seed=7)
+    x = jnp.asarray(np.random.default_rng(5).standard_normal(
+        (16, 512)).astype(np.float32) * 2.0)
+
+    parity = np.asarray(q40_matmul(w, x, interpret=True))   # caches traces
+    with matmul_precision("bf16"):
+        fast = np.asarray(q40_matmul(w, x, interpret=True))
+    # bf16 rounding must be VISIBLE (different result) but small
+    diff = np.abs(parity - fast).max()
+    scale = np.abs(parity).max()
+    assert 0 < diff < 0.03 * scale

@@ -111,3 +111,31 @@ def test_projection_itemization_consistent():
     proj80 = shard_sim.project_full_system(spec80, 2, shard_ms=5.0)
     assert proj80.n_collectives == SPEC.n_layers * 8 + 1
     assert proj80.gather_bytes_per_chip < proj.gather_bytes_per_chip / 2
+
+
+def test_rank_fused_q40_matches_dense(monkeypatch):
+    """rank_params_to_device fuses the rank's wq/wk/wv (w1/w3) bands into
+    wqkv/w13 kernel stacks; the fused Pallas rank program (interpret mode)
+    must match the dense-weight rank program on the same values."""
+    import jax.numpy as jnp
+
+    from distributed_llama_tpu.io.loader import Q40Kernel
+    from distributed_llama_tpu.ops.linear import dequantize_weight
+
+    bands = shard_sim.synth_rank_q40(SPEC, 2, seed=3)
+    dense = {k: (np.asarray(dequantize_weight(v)) if hasattr(v, "qs") else v)
+             for k, v in bands.items()}
+
+    tokens = jnp.asarray([5, 9], jnp.int32)
+    fwd = shard_sim.make_rank_forward(SPEC, 2)
+    want, _ = fwd(shard_sim.rank_params_to_device(dense),
+                  shard_sim.init_rank_cache(SPEC, 2), tokens, jnp.int32(0))
+
+    monkeypatch.setenv("DLLAMA_Q40_KERNEL", "pallas")
+    packed = shard_sim.rank_params_to_device(bands)
+    assert isinstance(packed.get("wqkv"), Q40Kernel)  # fusion fired
+    assert isinstance(packed.get("w13"), Q40Kernel)
+    got, _ = fwd(packed, shard_sim.init_rank_cache(SPEC, 2), tokens,
+                 jnp.int32(0))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)

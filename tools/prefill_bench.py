@@ -23,15 +23,18 @@ import numpy as np  # noqa: E402
 
 
 def _measure(engine, tokens, chunk: int, trials: int = 3) -> float:
-    """tokens/s of a full prefill of ``tokens`` (median of trials)."""
-    import jax
+    """tokens/s of a full prefill of ``tokens`` (median of trials).
 
+    Syncs by MATERIALIZING a cache slice: on the tunneled runtime
+    block_until_ready can return while one execution is still in flight
+    (the round-1 measurement trap), so wall clock must include a real
+    readback of data the prefill wrote."""
     rates = []
     for _ in range(trials + 1):  # first = compile + warm
         engine.reset()
         t0 = time.perf_counter()
         engine.prefill(tokens, 0, chunk)
-        jax.block_until_ready(engine.cache.k)
+        np.asarray(engine.cache.k[-1, len(tokens) - 1, 0, :8])
         rates.append(len(tokens) / (time.perf_counter() - t0))
     return float(np.median(rates[1:]))
 
