@@ -16,10 +16,12 @@ the same --model/--tokenizer/... flags as ``inference`` plus
 ``--coordinator host:port --num-hosts H --host-id i``, joins via
 jax.distributed, runs the identical generation loop (identical --seed makes
 every host sample the same token chain), and suppresses output — only the
-root host (``inference`` with --host-id 0) prints. Unlike the reference,
-where workers receive their weight slices over the wire (transformer.cpp:
-354-380), each host reads its shards straight from the model file — the
-scatter is the sharded device_put.
+root host (``inference`` with --host-id 0) prints. Each host reads its
+shards from the model file (the scatter onto chips is the sharded
+device_put); a host WITHOUT the file streams it from the root first —
+``--serve-weights PORT`` on the root, ``--model-from-root HOST:PORT`` on
+the worker (io/stream.py; the reference's wire transfer,
+transformer.cpp:354-380).
 """
 
 from __future__ import annotations
@@ -43,16 +45,56 @@ def _add_common(ap: argparse.ArgumentParser) -> None:
                          "(multi-host only)")
     ap.add_argument("--num-hosts", type=int, default=1)
     ap.add_argument("--host-id", type=int, default=None)
+    ap.add_argument("--serve-weights", type=int, default=None, metavar="PORT",
+                    help="(root) serve the model file's bytes on PORT so "
+                         "hosts without a local copy can fetch it — the "
+                         "reference's root->worker weight streaming "
+                         "(transformer.cpp:250-273)")
+    ap.add_argument("--model-from-root", default=None, metavar="HOST:PORT",
+                    help="(worker) fetch the model from the root's "
+                         "--serve-weights endpoint into the --model path "
+                         "when that file is absent (zero local model files, "
+                         "like reference workers, transformer.cpp:354-380)")
+
+
+def _weight_streaming(args, quiet: bool):
+    """Start the root-side weight server / run the worker-side fetch (both
+    BEFORE jax.distributed's barrier, so fetching overlaps nothing and a
+    dead transfer fails fast). Returns the server (or None) so it outlives
+    the load."""
+    server = None
+    if args.serve_weights is not None:
+        from ..io.stream import WeightServer
+
+        server = WeightServer(args.model, port=args.serve_weights)
+        if not quiet:
+            print(f"⏩ serving weights on port {server.port}")
+    if args.model_from_root:
+        from ..io.stream import fetch_model
+
+        # unconditional: fetch_model owns the staleness decision (skips
+        # only when the local size matches the server's; a truncated or
+        # wrong-size local file is repaired, not trusted)
+        fetch_model(args.model_from_root, args.model, quiet=quiet)
+    return server
 
 
 def _maybe_distributed(args) -> None:
     if args.coordinator:
         import jax
 
+        kw = {}
+        if getattr(args, "serve_weights", None) is not None or getattr(
+                args, "model_from_root", None):
+            # weight streaming happens BEFORE this barrier: the root must
+            # wait out a multi-GB fetch (e.g. ~40 GB of 70B over 1 GbE)
+            # without tripping the default ~300 s initialization timeout
+            kw["initialization_timeout"] = 3600
         jax.distributed.initialize(
             coordinator_address=args.coordinator,
             num_processes=args.num_hosts,
-            process_id=args.host_id if args.host_id is not None else 0)
+            process_id=args.host_id if args.host_id is not None else 0,
+            **kw)
 
 
 def cmd_inference(argv: list[str], quiet: bool = False) -> int:
@@ -130,9 +172,10 @@ def cmd_inference(argv: list[str], quiet: bool = False) -> int:
         print("multi-host runs need an explicit --seed so every host "
               "samples the same chain", file=sys.stderr)
         return 2
-    _maybe_distributed(args)
     if args.host_id:  # non-root hosts run silently in SPMD lockstep
         quiet = True
+    _ws = _weight_streaming(args, quiet)  # before the distributed barrier
+    _maybe_distributed(args)
 
     import jax
 
@@ -405,8 +448,9 @@ def cmd_train(argv: list[str]) -> int:
     # the identical program — the data schedule is already a pure function
     # of (--seed, step), so all hosts feed the same global windows and jit
     # shards them (dp can cross the host boundary); only host 0 prints
-    _maybe_distributed(args)
     quiet = bool(args.host_id)
+    _ws = _weight_streaming(args, quiet)  # before the distributed barrier
+    _maybe_distributed(args)
 
     import numpy as np
 

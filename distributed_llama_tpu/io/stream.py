@@ -1,0 +1,182 @@
+"""Root -> worker weight streaming: workers need ZERO local model files.
+
+The reference scatters weight slices from the root's mmap to each worker over
+its TCP star at startup (transformer.cpp:250-273 root side, :354-380 worker
+side, with the kB/s progress log). On TPU the "scatter" onto chips is the
+sharded device_put — but the HOST still needs the bytes, and round 1 required
+every host to have the .bin locally. This module closes that gap:
+
+* ``WeightServer`` (root): serves byte ranges of the .bin over TCP. The
+  protocol is three line-framed requests — ``SPEC`` (header + file size),
+  ``GET <offset> <length>`` (raw bytes), ``DONE`` — deliberately tiny, like
+  the reference's implicit statically-known-sizes framing, but explicit so a
+  version mismatch fails loudly instead of desynchronizing.
+* ``fetch_model`` (worker): downloads the file into a local cache path with
+  the reference's ⏩ kB/s progress line, then the normal loader takes over.
+  Chunked GETs keep memory flat; a size/byte-count mismatch raises (the
+  reference exits on any short read, socket.cpp:38-43).
+
+Design deviation, documented: the reference streams each worker ONLY its
+slices (1/n of the file). Here every fetching host pulls the whole file —
+JAX's multi-controller model wants each host able to build any of its
+devices' shards, and hosts that already have the file skip the fetch
+entirely. The fetch is a one-time load-phase cost on the LAN, traded for
+zero special-casing in the sharded load path.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import socketserver
+import struct
+import threading
+import time
+
+_MAGIC = b"DLTPU1"  # protocol version tag; bump on any framing change
+_CHUNK = 4 << 20
+
+
+class WeightServer:
+    """Serve a .bin's bytes to fetching hosts (root side).
+
+    Runs a daemon thread per connection; ``port=0`` picks a free port
+    (exposed as ``.port``). The server stays up until ``close()`` — workers
+    may connect at any point of the root's own load.
+    """
+
+    def __init__(self, path: str, host: str = "0.0.0.0", port: int = 0):
+        self.path = os.path.abspath(path)
+        self.size = os.path.getsize(self.path)
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                with open(outer.path, "rb") as fh:
+                    f = self.request.makefile("rb")
+                    while True:
+                        line = f.readline()
+                        if not line or line.strip() == b"DONE":
+                            return
+                        parts = line.split()
+                        if not parts:
+                            return  # blank line: malformed, drop
+                        if parts[0] == b"SPEC":
+                            self.request.sendall(
+                                _MAGIC + struct.pack("<q", outer.size))
+                        elif parts[0] == b"GET" and len(parts) == 3:
+                            off, ln = int(parts[1]), int(parts[2])
+                            if off < 0 or ln < 0 or off + ln > outer.size:
+                                return  # malformed: drop the connection
+                            fh.seek(off)
+                            remaining = ln
+                            while remaining:
+                                chunk = fh.read(min(remaining, _CHUNK))
+                                if not chunk:
+                                    return
+                                self.request.sendall(chunk)
+                                remaining -= len(chunk)
+                        else:
+                            return
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Server((host, port), Handler)
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    def close(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+
+def _recv_exact(sock: socket.socket, n: int, into=None) -> bytes | None:
+    buf = into if into is not None else bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        r = sock.recv_into(view[got:], n - got)
+        if r == 0:
+            raise ConnectionError("weight stream closed mid-transfer "
+                                  "(short read)")
+        got += r
+    return None if into is not None else bytes(buf)
+
+
+def _connect_with_retry(host: str, port: int, timeout: float,
+                        connect_window: float) -> socket.socket:
+    """Retry connection-refused for up to ``connect_window`` seconds: the
+    worker may legitimately start before the root's server binds (the
+    reference's worker likewise sits in accept() waiting for the root)."""
+    deadline = time.time() + connect_window
+    while True:
+        try:
+            return socket.create_connection((host, port), timeout=timeout)
+        except (ConnectionRefusedError, socket.timeout, OSError):
+            if time.time() >= deadline:
+                raise
+            time.sleep(0.25)
+
+
+def fetch_model(addr: str, cache_path: str, quiet: bool = False,
+                timeout: float = 600.0,
+                connect_window: float = 60.0) -> str:
+    """Download the model from ``host:port`` into ``cache_path``.
+
+    Returns ``cache_path``. If the file already exists with the advertised
+    size, the fetch is skipped (a host that has the model keeps using it —
+    re-running a worker does not re-pull gigabytes). A wrong-size existing
+    file is re-fetched — this is the ONE place that decides staleness, so
+    callers should invoke it unconditionally.
+    """
+    host, port = addr.rsplit(":", 1)
+    with _connect_with_retry(host, int(port), timeout, connect_window) as s:
+        s.sendall(b"SPEC\n")
+        head = _recv_exact(s, len(_MAGIC) + 8)
+        if head[:len(_MAGIC)] != _MAGIC:
+            raise ValueError("weight server protocol mismatch "
+                             f"(got {head[:len(_MAGIC)]!r})")
+        size = struct.unpack("<q", head[len(_MAGIC):])[0]
+        if (os.path.exists(cache_path)
+                and os.path.getsize(cache_path) == size):
+            s.sendall(b"DONE\n")
+            if not quiet:
+                print(f"⏩ weight cache hit: {cache_path} ({size} bytes)")
+            return cache_path
+
+        t0 = time.time()
+        # per-process unique temp in the target dir: two fetchers racing on
+        # the same cache_path each write their own file; os.replace installs
+        # whichever finishes (both byte-identical by the size check)
+        import tempfile
+
+        dst_dir = os.path.dirname(os.path.abspath(cache_path))
+        os.makedirs(dst_dir, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=dst_dir, suffix=".part")
+        with os.fdopen(fd, "wb") as out:
+            off = 0
+            buf = bytearray(_CHUNK)
+            while off < size:
+                ln = min(_CHUNK, size - off)
+                s.sendall(f"GET {off} {ln}\n".encode())
+                _recv_exact(s, ln, into=memoryview(buf)[:ln])
+                out.write(memoryview(buf)[:ln])
+                off += ln
+                if not quiet and off % (256 << 20) < _CHUNK:
+                    kbs = off / 1024 / max(time.time() - t0, 1e-9)
+                    print(f"⏩ fetched {off >> 20}/{size >> 20} MB "
+                          f"({kbs:.0f} kB/s)")
+        if os.path.getsize(tmp) != size:
+            raise ValueError(f"fetched {os.path.getsize(tmp)} bytes, "
+                             f"expected {size}")
+        os.replace(tmp, cache_path)
+        s.sendall(b"DONE\n")
+        if not quiet:
+            kbs = size / 1024 / max(time.time() - t0, 1e-9)
+            print(f"⏩ fetched model: {size} bytes in "
+                  f"{time.time() - t0:.1f}s ({kbs:.0f} kB/s)")
+    return cache_path

@@ -277,3 +277,43 @@ def test_two_process_train_dp_across_hosts(tmp_path):
         assert p.returncode == 0, f"host {i}: {e[-2000:]}"
     assert losses(outs[0][0]) == want, outs[0][0]
     assert losses(outs[1][0]) == []  # non-root hosts run silent
+
+
+def test_worker_streams_weights_from_root(tmp_path):
+    """The reference's zero-local-files worker: the worker host starts with
+    NO model file and fetches it from the root's --serve-weights endpoint
+    (io/stream.py) before joining the mesh; output must equal the
+    single-process run."""
+    model, tok = _write_model_files(tmp_path)
+    cwd = str(tmp_path)
+    gen = ("--prompt", "hi", "--steps", "5", "--temperature", "0.9",
+           "--topp", "0.9")
+
+    p = _run_n("inference", model, tok, None, None, 1, 2, cwd, tp=2,
+               extra=gen)
+    out_single, err = p.communicate(timeout=300)
+    assert p.returncode == 0, err[-2000:]
+    want = _pieces(out_single)
+    assert want
+
+    wport = _free_port()
+    coord = f"127.0.0.1:{_free_port()}"
+    # worker's --model points into an EMPTY directory; only the fetch can
+    # make the file exist
+    wdir = tmp_path / "workerhost"
+    wdir.mkdir()
+    wmodel = str(wdir / "model.bin")
+    root = _run_n("inference", model, tok, 0, coord, 2, 1, cwd, tp=2,
+                  extra=gen + ("--serve-weights", str(wport)))
+    # no sleep: fetch_model retries connection-refused while the root's
+    # server binds (io/stream._connect_with_retry)
+    worker = _run_n("worker", wmodel, tok, 1, coord, 2, 1, cwd, tp=2,
+                    extra=gen + ("--model-from-root", f"127.0.0.1:{wport}"))
+    out_root, err_root = root.communicate(timeout=420)
+    out_worker, err_worker = worker.communicate(timeout=60)
+    assert root.returncode == 0, f"root: {err_root[-2000:]}"
+    assert worker.returncode == 0, f"worker: {err_worker[-2000:]}"
+    assert _pieces(out_root) == want, out_root
+    import os as _os
+
+    assert _os.path.getsize(wmodel) == _os.path.getsize(model)  # fetched

@@ -1,0 +1,130 @@
+"""Root -> worker weight streaming (io/stream.py): the reference's
+zero-local-files worker capability (transformer.cpp:250-273, 354-380),
+rebuilt as a byte-range file service + fetch-then-normal-load.
+"""
+
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from distributed_llama_tpu.io.stream import WeightServer, fetch_model
+
+
+@pytest.fixture()
+def served_file(tmp_path):
+    src = tmp_path / "model.bin"
+    rng = np.random.default_rng(3)
+    data = rng.integers(0, 256, 9_000_017, dtype=np.uint8).tobytes()
+    src.write_bytes(data)
+    server = WeightServer(str(src), host="127.0.0.1")
+    yield server, str(src), data, tmp_path
+    server.close()
+
+
+def test_fetch_roundtrip_byte_exact(served_file):
+    server, src, data, tmp_path = served_file
+    dst = str(tmp_path / "fetched" / "model.bin")
+    got = fetch_model(f"127.0.0.1:{server.port}", dst, quiet=True)
+    assert got == dst
+    assert open(dst, "rb").read() == data
+    assert not os.path.exists(dst + ".part")  # atomic rename cleaned up
+
+
+def test_fetch_skips_existing_cache(served_file):
+    server, src, data, tmp_path = served_file
+    dst = str(tmp_path / "cache.bin")
+    with open(dst, "wb") as f:
+        f.write(data)
+    before = os.path.getmtime(dst)
+    fetch_model(f"127.0.0.1:{server.port}", dst, quiet=True)
+    assert os.path.getmtime(dst) == before  # untouched: size matched
+
+
+def test_concurrent_fetchers(served_file):
+    """Several workers fetch simultaneously (the reference serializes its
+    scatter; the threaded server need not)."""
+    server, src, data, tmp_path = served_file
+    errs = []
+
+    def fetch(i):
+        try:
+            p = str(tmp_path / f"w{i}" / "model.bin")
+            fetch_model(f"127.0.0.1:{server.port}", p, quiet=True)
+            assert open(p, "rb").read() == data
+        except Exception as e:  # pragma: no cover - surfaced below
+            errs.append(e)
+
+    threads = [threading.Thread(target=fetch, args=(i,)) for i in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+
+
+def test_protocol_mismatch_raises(tmp_path):
+    """A non-weight-server endpoint must fail loudly, not hang or corrupt."""
+    import socketserver
+
+    class Junk(socketserver.BaseRequestHandler):
+        def handle(self):
+            self.request.recv(64)
+            self.request.sendall(b"HTTP/1.1 200 OK\r\n" + b"x" * 16)
+
+    class Server(socketserver.ThreadingTCPServer):
+        allow_reuse_address = True
+        daemon_threads = True
+
+    srv = Server(("127.0.0.1", 0), Junk)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        with pytest.raises(ValueError, match="protocol mismatch"):
+            fetch_model(f"127.0.0.1:{srv.server_address[1]}",
+                        str(tmp_path / "x.bin"), quiet=True)
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_fetch_repairs_truncated_cache(served_file):
+    """A wrong-size local file must be re-fetched, not trusted (the CLI
+    calls fetch_model unconditionally; staleness is decided HERE)."""
+    server, src, data, tmp_path = served_file
+    dst = str(tmp_path / "stale.bin")
+    with open(dst, "wb") as f:
+        f.write(data[:1000])  # truncated earlier copy
+    fetch_model(f"127.0.0.1:{server.port}", dst, quiet=True)
+    assert open(dst, "rb").read() == data
+
+
+def test_connect_retry_tolerates_late_server(tmp_path):
+    """Worker starting before the root's server binds must retry, not die
+    (the reference's worker likewise blocks in accept())."""
+    import socket as _socket
+    import threading
+    import time as _time
+
+    src = tmp_path / "m.bin"
+    src.write_bytes(b"z" * 4096)
+    with _socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    box = {}
+
+    def late_start():
+        _time.sleep(1.0)
+        box["server"] = WeightServer(str(src), host="127.0.0.1", port=port)
+
+    t = threading.Thread(target=late_start)
+    t.start()
+    try:
+        dst = str(tmp_path / "out.bin")
+        fetch_model(f"127.0.0.1:{port}", dst, quiet=True, connect_window=15)
+        assert open(dst, "rb").read() == b"z" * 4096
+    finally:
+        t.join()
+        box["server"].close()
