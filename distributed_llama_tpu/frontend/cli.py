@@ -83,18 +83,15 @@ def _maybe_distributed(args) -> None:
     if args.coordinator:
         import jax
 
-        kw = {}
-        if getattr(args, "serve_weights", None) is not None or getattr(
-                args, "model_from_root", None):
-            # weight streaming happens BEFORE this barrier: the root must
-            # wait out a multi-GB fetch (e.g. ~40 GB of 70B over 1 GbE)
-            # without tripping the default ~300 s initialization timeout
-            kw["initialization_timeout"] = 3600
+        # generous barrier timeout on EVERY host: any peer may be doing a
+        # multi-GB --model-from-root fetch before it joins (e.g. ~40 GB of
+        # 70B over 1 GbE takes ~6 min), and a host that already has its
+        # file cannot know that — the default ~300 s would kill the job
         jax.distributed.initialize(
             coordinator_address=args.coordinator,
             num_processes=args.num_hosts,
             process_id=args.host_id if args.host_id is not None else 0,
-            **kw)
+            initialization_timeout=3600)
 
 
 def cmd_inference(argv: list[str], quiet: bool = False) -> int:

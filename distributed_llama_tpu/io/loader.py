@@ -65,6 +65,56 @@ class Q40Kernel(NamedTuple):
         return (*self.scale.shape[:-1], self.scale.shape[-1] * 32)
 
 
+class Q40KernelNb(NamedTuple):
+    """Lane-aligned kernel tiling for awkward block counts: qs_t uint8
+    (..., 16, nb, d), scale f32 (..., nb, d) — the OUTPUT dim d is minor.
+
+    TPU physical layouts tile the last two dims to (8, 128); the standard
+    ``Q40Kernel`` puts the block count nb minor, which pads nb up to a
+    multiple of 128 — at 13B (dim 5120 -> nb=160 -> padded 256) that is a
+    1.6x inflation of both HBM footprint AND every weight-streaming byte
+    the decode loop reads. This transposed layout puts d minor instead
+    (d is 128-aligned for every Llama shape), so there is NO padding.
+    Selected automatically by ``pack_q40_params`` when the padding ratio
+    is material; the matvec kernel has a dedicated body for it
+    (ops/pallas_q40._matvec_body_nb).
+    """
+
+    qs_t: np.ndarray
+    scale: np.ndarray
+
+    @property
+    def logical_shape(self) -> tuple[int, ...]:
+        return (*self.scale.shape[:-2], self.scale.shape[-1],
+                self.scale.shape[-2] * 32)
+
+
+def to_kernel_layout_nb(w: Q40Weight) -> Q40KernelNb:
+    """(..., d, nb, 16) -> (..., 16, nb, d) with f32 scales (..., nb, d)."""
+    qs = w.qs
+    nd = qs.ndim
+    perm = tuple(range(nd - 3)) + (nd - 1, nd - 2, nd - 3)
+    qs_t = qs.transpose(perm)
+    if isinstance(qs_t, np.ndarray):
+        qs_t = np.ascontiguousarray(qs_t)
+    sperm = tuple(range(nd - 3)) + (nd - 2, nd - 3)
+    scale = w.d16.transpose(sperm).astype(np.float32)
+    if isinstance(scale, np.ndarray):
+        scale = np.ascontiguousarray(scale)
+    return Q40KernelNb(qs_t, scale)
+
+
+def from_kernel_layout_nb(w: Q40KernelNb) -> Q40Weight:
+    qs_t = w.qs_t
+    nd = qs_t.ndim
+    perm = tuple(range(nd - 3)) + (nd - 1, nd - 2, nd - 3)
+    qs = qs_t.transpose(perm)
+    if isinstance(qs, np.ndarray):
+        qs = np.ascontiguousarray(qs)
+    scale = np.ascontiguousarray(np.swapaxes(w.scale, -1, -2))
+    return Q40Weight(qs, scale.astype(np.float16))
+
+
 def to_kernel_layout(w: Q40Weight) -> Q40Kernel:
     """(..., d, nb, 16) -> (..., 16, d, nb), one-time load-side re-tiling.
 

@@ -157,23 +157,30 @@ def fetch_model(addr: str, cache_path: str, quiet: bool = False,
         dst_dir = os.path.dirname(os.path.abspath(cache_path))
         os.makedirs(dst_dir, exist_ok=True)
         fd, tmp = tempfile.mkstemp(dir=dst_dir, suffix=".part")
-        with os.fdopen(fd, "wb") as out:
-            off = 0
-            buf = bytearray(_CHUNK)
-            while off < size:
-                ln = min(_CHUNK, size - off)
-                s.sendall(f"GET {off} {ln}\n".encode())
-                _recv_exact(s, ln, into=memoryview(buf)[:ln])
-                out.write(memoryview(buf)[:ln])
-                off += ln
-                if not quiet and off % (256 << 20) < _CHUNK:
-                    kbs = off / 1024 / max(time.time() - t0, 1e-9)
-                    print(f"⏩ fetched {off >> 20}/{size >> 20} MB "
-                          f"({kbs:.0f} kB/s)")
-        if os.path.getsize(tmp) != size:
-            raise ValueError(f"fetched {os.path.getsize(tmp)} bytes, "
-                             f"expected {size}")
-        os.replace(tmp, cache_path)
+        try:
+            with os.fdopen(fd, "wb") as out:
+                off = 0
+                buf = bytearray(_CHUNK)
+                while off < size:
+                    ln = min(_CHUNK, size - off)
+                    s.sendall(f"GET {off} {ln}\n".encode())
+                    _recv_exact(s, ln, into=memoryview(buf)[:ln])
+                    out.write(memoryview(buf)[:ln])
+                    off += ln
+                    if not quiet and off % (256 << 20) < _CHUNK:
+                        kbs = off / 1024 / max(time.time() - t0, 1e-9)
+                        print(f"⏩ fetched {off >> 20}/{size >> 20} MB "
+                              f"({kbs:.0f} kB/s)")
+            if os.path.getsize(tmp) != size:
+                raise ValueError(f"fetched {os.path.getsize(tmp)} bytes, "
+                                 f"expected {size}")
+            os.replace(tmp, cache_path)
+        except BaseException:
+            # never leave a multi-GB orphan behind (repeated retries of a
+            # 40 GB fetch would otherwise fill the disk with .part files)
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+            raise
         s.sendall(b"DONE\n")
         if not quiet:
             kbs = size / 1024 / max(time.time() - t0, 1e-9)

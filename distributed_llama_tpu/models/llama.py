@@ -31,7 +31,7 @@ from typing import Any, NamedTuple
 import jax
 import jax.numpy as jnp
 
-from ..io.loader import Q40Kernel
+from ..io.loader import Q40Kernel, Q40KernelNb
 from ..ops.linear import StackedQ40, fake_quant_q80, matmul, rmsnorm, silu
 from ..ops.quants import FloatType
 from .spec import TransformerSpec
@@ -213,7 +213,7 @@ def split_layer_weights(params: dict[str, Any]):
     else is scanned normally (sliced per step)."""
     keys = [k for k in LAYER_KEYS + FUSED_KEYS if k in params]
     stacked = {k: params[k] for k in keys
-               if isinstance(params[k], Q40Kernel)}
+               if isinstance(params[k], (Q40Kernel, Q40KernelNb))}
     scanned = {k: params[k] for k in keys if k not in stacked}
     return stacked, scanned
 
@@ -480,7 +480,7 @@ def params_to_device(params: dict[str, Any], dtype=None) -> dict[str, Any]:
 
     out = {}
     for k, v in params.items():
-        if isinstance(v, (Q40Weight, Q40Kernel)):
+        if isinstance(v, (Q40Weight, Q40Kernel, Q40KernelNb)):
             # quantized leaves keep their exact codec/kernel dtypes — the
             # dtype knob is for dense weights only (scales must stay f32/f16)
             out[k] = jax.tree_util.tree_map(jnp.asarray, v)

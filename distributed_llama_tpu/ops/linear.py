@@ -27,7 +27,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..io.loader import Q40Kernel, Q40Weight, from_kernel_layout, to_kernel_layout
+from ..io.loader import (Q40Kernel, Q40KernelNb, Q40Weight,
+                         from_kernel_layout, to_kernel_layout,
+                         to_kernel_layout_nb)
 from .quants import dequantize_q40_jax, dequantize_q80_jax, quantize_q80_jax
 
 RMS_EPS = 1e-5
@@ -101,6 +103,10 @@ def dequantize_weight(w) -> jax.Array:
     """Materialize any weight representation as f32 (d, n)."""
     if isinstance(w, StackedQ40):
         w = jax.tree_util.tree_map(lambda a: a[w.layer], w.w)
+    if isinstance(w, Q40KernelNb):
+        from .pallas_q40 import _dequant_nb
+
+        return _dequant_nb(jnp.asarray(w.qs_t), jnp.asarray(w.scale))
     if isinstance(w, Q40Kernel):
         w = from_kernel_layout(w)
     if isinstance(w, Q40Weight):
@@ -133,6 +139,10 @@ def matmul(w, x: jax.Array, *, prefer_pallas: bool = False) -> jax.Array:
         from .pallas_q40 import q40_matmul  # packing implies kernel support
 
         return q40_matmul(w.w, x, layer=w.layer)
+    if isinstance(w, Q40KernelNb):
+        from .pallas_q40 import q40_matmul  # nb-major has its own dispatch
+
+        return q40_matmul(w, x)
     if isinstance(w, (Q40Weight, Q40Kernel)) and (
             prefer_pallas or q40_kernel_mode() == "pallas"):
         from .pallas_q40 import kernel_supports, q40_matmul  # lazy
@@ -172,18 +182,30 @@ def pack_q40_params(params: dict, enable: bool | None = None,
         enable = q40_kernel_mode() == "pallas"
     if not enable:
         return params
-    from .pallas_q40 import kernel_supports
+    from .pallas_q40 import _pick_rows_nb, kernel_supports
 
-    # weights the kernel can't tile stay codec-layout: they take the XLA
-    # fallback in matmul(), which would otherwise pay a full re-transpose
-    # inside the jitted step on every call
-    return {k: to_kernel_layout(v)
-            if isinstance(v, Q40Weight)
-            and v.logical_shape[-2] % tp == 0
-            and kernel_supports(v.logical_shape[-2] // tp,
-                                v.logical_shape[-1])
-            else v
-            for k, v in params.items()}
+    def pick(v):
+        if not isinstance(v, Q40Weight):
+            return v
+        d, n = v.logical_shape[-2], v.logical_shape[-1]
+        if d % tp:
+            return v
+        nb = n // 32
+        pad_ratio = (nb + (-nb % 128)) / nb  # TPU lane padding of nb-minor
+        # nb-major layout when the standard tiling would pad the packed
+        # bytes materially (13B: nb=160 -> 1.6x HBM and read inflation).
+        # Single-chip only: the tp sharding specs do not carry it (and the
+        # shapes that need it are whole-model single-chip runs)
+        if tp == 1 and pad_ratio > 1.25 and _pick_rows_nb(d, nb) is not None:
+            return to_kernel_layout_nb(v)
+        if kernel_supports(d // tp, n):
+            return to_kernel_layout(v)
+        # untileable dims stay codec-layout: they take the XLA fallback in
+        # matmul(), which would otherwise pay a full re-transpose inside
+        # the jitted step on every call
+        return v
+
+    return {k: pick(v) for k, v in params.items()}
 
 
 def fuse_q40_layer_matmuls(params: dict) -> dict:
@@ -201,20 +223,28 @@ def fuse_q40_layer_matmuls(params: dict) -> dict:
     Only fires on stacked Q40Kernel entries (i.e. after pack_q40_params on
     the single-chip path); dense/TP trees pass through untouched.
     """
-    from .pallas_q40 import kernel_supports
+    from .pallas_q40 import _pick_rows_nb, kernel_supports
 
     out = dict(params)
 
     def fuse(dst, keys):
         ws = [out.get(k) for k in keys]
-        if not all(isinstance(w, Q40Kernel) and w.qs_t.ndim == 4
-                   for w in ws):
+        if all(isinstance(w, Q40Kernel) and w.qs_t.ndim == 4 for w in ws):
+            qs_t = np.concatenate([np.asarray(w.qs_t) for w in ws], axis=2)
+            scale = np.concatenate([np.asarray(w.scale) for w in ws], axis=1)
+            if not kernel_supports(qs_t.shape[2], qs_t.shape[3] * 32):
+                return
+            out[dst] = Q40Kernel(qs_t, scale)
+        elif all(isinstance(w, Q40KernelNb) and w.qs_t.ndim == 4
+                 for w in ws):
+            # nb-major: the output dim d is MINOR — concat along it
+            qs_t = np.concatenate([np.asarray(w.qs_t) for w in ws], axis=3)
+            scale = np.concatenate([np.asarray(w.scale) for w in ws], axis=2)
+            if _pick_rows_nb(qs_t.shape[3], qs_t.shape[2]) is None:
+                return
+            out[dst] = Q40KernelNb(qs_t, scale)
+        else:
             return
-        qs_t = np.concatenate([np.asarray(w.qs_t) for w in ws], axis=2)
-        scale = np.concatenate([np.asarray(w.scale) for w in ws], axis=1)
-        if not kernel_supports(qs_t.shape[2], qs_t.shape[3] * 32):
-            return
-        out[dst] = Q40Kernel(qs_t, scale)
         for k in keys:
             del out[k]
 

@@ -42,7 +42,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from ..io.loader import Q40Kernel, Q40Weight, to_kernel_layout
+from ..io.loader import (Q40Kernel, Q40KernelNb, Q40Weight,
+                         to_kernel_layout)
 
 QK = 32
 NJ = 16  # nibble positions per block byte-plane
@@ -123,6 +124,37 @@ def _kernel_multi_stacked(layer_ref, qs_ref, scale_ref, xlo_ref, xhi_ref,
     del layer_ref  # consumed by the index maps
     _matvec_body_multi(qs_ref[0], scale_ref[0], xlo_ref, xhi_ref, xsum_ref,
                        out_ref)
+
+
+def _matvec_body_nb(qs3, s, xlo_ref, xhi_ref, xsum_ref, out_ref):
+    """T=1 body for the nb-MAJOR layout (io.loader.Q40KernelNb): qs3
+    (NJ, nb, R) codes, s (nb, R) f32 scales, xlo/xhi (NJ, nb, 1), xsum
+    (nb, 1). Same math as _matvec_body with the tile transposed: the
+    output dim R rides the LANES (128-aligned for every Llama d), so
+    awkward nb values (160 at 13B) cost no tile padding. The reduction
+    runs over sublanes (axis 0) instead of lanes."""
+    acc = None
+    for j in range(NJ):
+        q = qs3[j].astype(jnp.int32)                 # (nb, R)
+        wlo = (q & 0xF).astype(jnp.float32)
+        whi = (q >> 4).astype(jnp.float32)
+        a = wlo * xlo_ref[j] + whi * xhi_ref[j]      # (nb, 1) bcast over R
+        acc = a if acc is None else acc + a
+    acc = acc - 8.0 * xsum_ref[...]                  # (nb, R) - (nb, 1)
+    out_ref[...] = jnp.sum(acc * s, axis=0, keepdims=True)  # (1, R)
+
+
+def _kernel_matvec_nb(qs_ref, scale_ref, xlo_ref, xhi_ref, xsum_ref,
+                      out_ref):
+    _matvec_body_nb(qs_ref, scale_ref[...], xlo_ref, xhi_ref, xsum_ref,
+                    out_ref)
+
+
+def _kernel_matvec_nb_stacked(layer_ref, qs_ref, scale_ref, xlo_ref, xhi_ref,
+                              xsum_ref, out_ref):
+    del layer_ref  # consumed by the index maps
+    _matvec_body_nb(qs_ref[0], scale_ref[0], xlo_ref, xhi_ref, xsum_ref,
+                    out_ref)
 
 
 MULTI_T_MAX = 8  # beyond this the per-row accumulators crowd VMEM; use MXU
@@ -413,6 +445,122 @@ def _dequant_matmul(w: Q40Kernel, x2: jax.Array,
                       precision=jax.lax.Precision.HIGHEST)
 
 
+def _pick_rows_nb(d: int, nb: int) -> int | None:
+    """Row tile for the nb-major matvec: rows ride the LANES, so they must
+    be a multiple of 128 (or the whole d when d < 128-divisible options);
+    rows*nb stays under the same ~(16+4)-bytes-per-word scoped-VMEM budget
+    as the d-major matvec."""
+    top = min(d, 768, max(128, 360_000 // nb))
+    for cand in range(top - top % 128, 0, -128):
+        if d % cand == 0:
+            return cand
+    return None
+
+
+def _dequant_nb(qs_t, scale):
+    """jnp dequant of an nb-major (16, nb, d) plane set -> f32 (d, n)."""
+    lo = ((qs_t & 0xF).astype(jnp.int8) - jnp.int8(8))
+    hi = ((qs_t >> 4).astype(jnp.int8) - jnp.int8(8))
+    codes = jnp.concatenate([lo, hi], axis=0)        # (32, nb, d): j then j+16
+    w = codes.astype(jnp.float32) * scale[None]
+    d = scale.shape[-1]
+    return jnp.transpose(w, (2, 1, 0)).reshape(d, -1)
+
+
+@functools.partial(jax.jit, static_argnames=("block_rows", "interpret"))
+def _q40_matvec_nb_2d(qs_t, scale, x, *, block_rows, interpret):
+    _, nb, d = qs_t.shape
+    xlo, xhi = _split_x(x.astype(jnp.float32), nb)   # (NJ, 1, nb)
+    xlo = jnp.transpose(xlo, (0, 2, 1))              # (NJ, nb, 1)
+    xhi = jnp.transpose(xhi, (0, 2, 1))
+    xsum = jnp.sum(xlo[:, :, 0] + xhi[:, :, 0], axis=0)[:, None]  # (nb, 1)
+    out = pl.pallas_call(
+        _kernel_matvec_nb,
+        grid=(d // block_rows,),
+        in_specs=[
+            pl.BlockSpec((NJ, nb, block_rows), lambda i: (0, 0, i)),
+            pl.BlockSpec((nb, block_rows), lambda i: (0, i)),
+            pl.BlockSpec((NJ, nb, 1), lambda i: (0, 0, 0)),
+            pl.BlockSpec((NJ, nb, 1), lambda i: (0, 0, 0)),
+            pl.BlockSpec((nb, 1), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_rows), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((1, d), jnp.float32),
+        interpret=interpret,
+    )(qs_t, scale, xlo, xhi, xsum)
+    return out                                        # (1, d)
+
+
+@functools.partial(jax.jit, static_argnames=("block_rows", "interpret"))
+def _q40_matvec_nb_stacked(layer, qs_t, scale, x, *, block_rows, interpret):
+    _, _, nb, d = qs_t.shape
+    xlo, xhi = _split_x(x.astype(jnp.float32), nb)
+    xlo = jnp.transpose(xlo, (0, 2, 1))
+    xhi = jnp.transpose(xhi, (0, 2, 1))
+    xsum = jnp.sum(xlo[:, :, 0] + xhi[:, :, 0], axis=0)[:, None]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(d // block_rows,),
+        in_specs=[
+            pl.BlockSpec((1, NJ, nb, block_rows),
+                         lambda i, L: (L[0], 0, 0, i)),
+            pl.BlockSpec((1, nb, block_rows), lambda i, L: (L[0], 0, i)),
+            pl.BlockSpec((NJ, nb, 1), lambda i, L: (0, 0, 0)),
+            pl.BlockSpec((NJ, nb, 1), lambda i, L: (0, 0, 0)),
+            pl.BlockSpec((nb, 1), lambda i, L: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_rows), lambda i, L: (0, i)),
+    )
+    out = pl.pallas_call(
+        _kernel_matvec_nb_stacked, grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((1, d), jnp.float32),
+        interpret=interpret,
+    )(layer, qs_t, scale, xlo, xhi, xsum)
+    return out
+
+
+def _q40_matmul_nbmajor(w: Q40KernelNb, x: jax.Array,
+                        interpret: bool | None,
+                        layer: jax.Array | None) -> jax.Array:
+    """nb-major dispatch: the T=1 decode matvec runs the dedicated kernel;
+    every other T dequantizes inline and dots (this layout exists for the
+    DECODE loop of models whose nb pads badly — prefill/batch correctness
+    is preserved at XLA-fallback speed, documented in pack_q40_params)."""
+    qs_t, scale = w.qs_t, w.scale
+    nb, d = qs_t.shape[-2], qs_t.shape[-1]
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    lead = x.shape[:-1]
+    x2 = x.reshape(-1, x.shape[-1])
+    t = x2.shape[0]
+    rows = _pick_rows_nb(d, nb)
+    if t == 1 and rows is not None:
+        if layer is not None:
+            lidx = jnp.asarray(layer, dtype=jnp.int32).reshape(1)
+            out = _q40_matvec_nb_stacked(lidx, qs_t, scale, x2,
+                                         block_rows=rows,
+                                         interpret=interpret)
+        else:
+            out = _q40_matvec_nb_2d(qs_t, scale, x2, block_rows=rows,
+                                    interpret=interpret)
+        return out.reshape(*lead, d)
+    if layer is not None:
+        qs_t = qs_t[layer]
+        scale = scale[layer]
+    wf = _dequant_nb(qs_t, scale)
+    from .linear import matmul_mode
+
+    if matmul_mode() == "bf16":
+        out = jnp.einsum("dn,tn->td", wf.astype(jnp.bfloat16),
+                         x2.astype(jnp.bfloat16),
+                         preferred_element_type=jnp.float32)
+    else:
+        out = jnp.einsum("dn,tn->td", wf, x2.astype(jnp.float32),
+                         preferred_element_type=jnp.float32,
+                         precision=jax.lax.Precision.HIGHEST)
+    return out.reshape(*lead, d)
+
+
 def q40_matmul(w: Q40Kernel | Q40Weight, x: jax.Array,
                block_rows: int | None = None,
                interpret: bool | None = None,
@@ -427,6 +575,8 @@ def q40_matmul(w: Q40Kernel | Q40Weight, x: jax.Array,
     (L, 16, d, nb)) and the kernel DMAs layer ``layer`` directly out of the
     stack via scalar prefetch — the zero-copy path for lax.scan over layers.
     """
+    if isinstance(w, Q40KernelNb):
+        return _q40_matmul_nbmajor(w, x, interpret, layer)
     if isinstance(w, Q40Weight):
         w = to_kernel_layout(w)
     qs_t, scale = w.qs_t, w.scale

@@ -72,6 +72,13 @@ def param_specs(params: dict[str, Any]) -> dict[str, Any]:
         spec = _MATMUL_SPECS.get(name) or _REPL_SPECS.get(name)
         if spec is None:
             raise KeyError(f"unknown param {name}")
+        from ..io.loader import Q40KernelNb
+
+        if isinstance(val, Q40KernelNb):
+            raise TypeError(
+                f"{name}: nb-major kernel layout (Q40KernelNb) is "
+                f"single-chip only — pack_q40_params never selects it when "
+                f"tp > 1, so a fused/hand-built tree reached shard_params")
         if isinstance(val, Q40Weight):
             # qs (L, d, nb, 16) and d16 (L, d, nb) shard the same d axis
             extra = len(val.qs.shape) - len(spec)

@@ -308,3 +308,83 @@ def test_bf16_mode_not_served_from_parity_trace_cache():
     diff = np.abs(parity - fast).max()
     scale = np.abs(parity).max()
     assert 0 < diff < 0.03 * scale
+
+
+def test_nbmajor_matvec_matches_dequant():
+    """nb-major (Q40KernelNb) T=1 kernel parity on a 13B-like shape whose
+    block count pads badly in the standard layout (n=5120 -> nb=160)."""
+    import jax.numpy as jnp
+
+    from distributed_llama_tpu.io.loader import (from_kernel_layout_nb,
+                                                 to_kernel_layout_nb)
+    from distributed_llama_tpu.ops.pallas_q40 import q40_matmul
+
+    w = _mk(256, 5120, seed=21)
+    wn = to_kernel_layout_nb(w)
+    assert wn.qs_t.shape == (16, 160, 256)
+    assert wn.logical_shape == (256, 5120)
+    back = from_kernel_layout_nb(wn)
+    np.testing.assert_array_equal(np.asarray(back.qs), np.asarray(w.qs))
+    np.testing.assert_array_equal(np.asarray(back.d16), np.asarray(w.d16))
+
+    x = np.random.default_rng(2).standard_normal((1, 5120)).astype(np.float32)
+    want = dequantize_q40(np.asarray(w.qs), np.asarray(w.d16)) @ x.T
+    got = q40_matmul(wn, x, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), want.T, rtol=1e-4, atol=1e-3)
+
+    # T>1 goes through the dequant fallback (correctness, not kernel speed)
+    xt = np.random.default_rng(4).standard_normal((5, 5120)).astype(
+        np.float32)
+    want_t = dequantize_q40(np.asarray(w.qs), np.asarray(w.d16)) @ xt.T
+    got_t = q40_matmul(wn, xt, interpret=True)
+    np.testing.assert_allclose(np.asarray(got_t), want_t.T, rtol=1e-4,
+                               atol=1e-3)
+
+
+def test_nbmajor_pack_selection_and_forward_parity(monkeypatch):
+    """pack_q40_params must pick nb-major exactly for badly-padding shapes
+    at tp=1 (13B's nb=160 -> 1.6x; 7B's nb=128/344 stays d-major), and the
+    full forward through stacked nb-major weights (scalar-prefetch scan)
+    must match the XLA path."""
+    import jax.numpy as jnp
+
+    from distributed_llama_tpu.io.loader import Q40Kernel, Q40KernelNb
+    from distributed_llama_tpu.models.llama import (forward, init_cache,
+                                                    params_to_device)
+    from distributed_llama_tpu.models.spec import TransformerSpec
+    from distributed_llama_tpu.models.synth import synth_params
+    from distributed_llama_tpu.ops.linear import pack_q40_params
+    from distributed_llama_tpu.ops.quants import FloatType
+
+    # dim 128 -> per-layer matmul inputs n=128 (nb=4 -> ratio 32: nb-major
+    # needs d%128==0 which holds) BUT tiny nb also passes the ratio gate; use
+    # hidden chosen so w1/w3 (n=128) and w2 (n=5120-like)... simpler: pin on
+    # a 13B-dim-shaped single tensor tree
+    spec = TransformerSpec(dim=128, hidden_dim=1280, n_layers=2, n_heads=4,
+                           n_kv_heads=2, vocab_size=256, seq_len=16,
+                           weights_float_type=FloatType.Q40)
+    params = synth_params(spec, q40=True, seed=31, scale=0.2)
+    monkeypatch.setenv("DLLAMA_Q40_KERNEL", "xla")
+    tok = jnp.asarray([5], dtype=jnp.int32)
+    ref_logits, _ = forward(spec, params_to_device(params), init_cache(spec),
+                            tok, jnp.int32(0))
+
+    monkeypatch.setenv("DLLAMA_Q40_KERNEL", "pallas")
+    packed = pack_q40_params(synth_params(spec, q40=True, seed=31,
+                                          scale=0.2))
+    # w2 consumes hidden=1280 -> nb=40 -> pads to 128 (3.2x): nb-major
+    assert isinstance(packed["w2"], Q40KernelNb)
+    # wq consumes dim=128 -> nb=4... also nb-major (ratio 32x); the point:
+    # selection keys on the pad ratio, not the tensor name
+    assert isinstance(packed["wq"], Q40KernelNb)
+
+    dev = params_to_device(synth_params(spec, q40=True, seed=31, scale=0.2))
+    got_logits, _ = forward(spec, dev, init_cache(spec), tok, jnp.int32(0))
+    np.testing.assert_allclose(np.asarray(got_logits),
+                               np.asarray(ref_logits), rtol=2e-5, atol=2e-5)
+
+    # 7B/70B shapes keep the tuned d-major layout
+    p7 = pack_q40_params({"wq": _mk(256, 4096)})   # nb=128: no padding
+    assert isinstance(p7["wq"], Q40Kernel)
+    p7b = pack_q40_params({"w2": _mk(256, 11008)})  # nb=344: 1.12x only
+    assert isinstance(p7b["w2"], Q40Kernel)
