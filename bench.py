@@ -76,7 +76,8 @@ def _bench(spec, params, samples: int, per_step: bool = False,
     from distributed_llama_tpu.ops.linear import (fuse_q40_layer_matmuls,
                                                   pack_q40_params)
 
-    host_params = fuse_q40_layer_matmuls(pack_q40_params(params))
+    host_params = fuse_q40_layer_matmuls(
+        pack_q40_params(params, allow_nb_major=(rank_tp == 0)))
     if rank_tp:
         from distributed_llama_tpu.parallel import shard_sim
 

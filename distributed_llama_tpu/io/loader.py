@@ -301,6 +301,9 @@ def densify_params(params: dict) -> dict:
         elif isinstance(val, Q40Kernel):  # pre-tiled: go through the codec
             w = from_kernel_layout(val)
             out[name] = dequantize_q40(w.qs, w.d16)
+        elif isinstance(val, Q40KernelNb):  # nb-major pre-tiled likewise
+            w = from_kernel_layout_nb(val)
+            out[name] = dequantize_q40(w.qs, w.d16)
         else:
             out[name] = np.asarray(val, dtype=np.float32)
     return out

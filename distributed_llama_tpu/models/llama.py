@@ -470,7 +470,8 @@ def params_to_device(params: dict[str, Any], dtype=None) -> dict[str, Any]:
     from ..io.loader import Q40Kernel, Q40Weight
     from ..ops.linear import fuse_q40_layer_matmuls, pack_q40_params
 
-    params = fuse_q40_layer_matmuls(pack_q40_params(params))
+    params = fuse_q40_layer_matmuls(pack_q40_params(params,
+                                                    allow_nb_major=True))
 
     def conv(a):
         x = jnp.asarray(a)

@@ -168,7 +168,7 @@ def matmul(w, x: jax.Array, *, prefer_pallas: bool = False) -> jax.Array:
 
 
 def pack_q40_params(params: dict, enable: bool | None = None,
-                    tp: int = 1) -> dict:
+                    tp: int = 1, allow_nb_major: bool | None = None) -> dict:
     """Re-tile every Q40Weight in a param tree to the kernel layout, once.
 
     ``enable=None`` means "iff the Pallas kernel will be used" — so CPU/test
@@ -182,6 +182,12 @@ def pack_q40_params(params: dict, enable: bool | None = None,
         enable = q40_kernel_mode() == "pallas"
     if not enable:
         return params
+    if allow_nb_major is None:
+        # nb-major is UNSHARDED-only (the sharding specs reject it), and
+        # tp==1 does not imply unsharded (an sp>1 mesh packs with tp=1) —
+        # so the truly-single-chip callers must OPT IN explicitly
+        # (params_to_device, shard_sim.rank_params_to_device, bench.py)
+        allow_nb_major = False
     from .pallas_q40 import _pick_rows_nb, kernel_supports
 
     def pick(v):
@@ -193,10 +199,9 @@ def pack_q40_params(params: dict, enable: bool | None = None,
         nb = n // 32
         pad_ratio = (nb + (-nb % 128)) / nb  # TPU lane padding of nb-minor
         # nb-major layout when the standard tiling would pad the packed
-        # bytes materially (13B: nb=160 -> 1.6x HBM and read inflation).
-        # Single-chip only: the tp sharding specs do not carry it (and the
-        # shapes that need it are whole-model single-chip runs)
-        if tp == 1 and pad_ratio > 1.25 and _pick_rows_nb(d, nb) is not None:
+        # bytes materially (13B: nb=160 -> 1.6x HBM and read inflation)
+        if (allow_nb_major and tp == 1 and pad_ratio > 1.25
+                and _pick_rows_nb(d, nb) is not None):
             return to_kernel_layout_nb(v)
         if kernel_supports(d // tp, n):
             return to_kernel_layout(v)

@@ -157,6 +157,74 @@ def _kernel_matvec_nb_stacked(layer_ref, qs_ref, scale_ref, xlo_ref, xhi_ref,
                     out_ref)
 
 
+def _matvec_body_multi_nb(qs3, s, xlo_ref, xhi_ref, xsum_ref, out_ref):
+    """Small-T (2..8) nb-major body: qs3 (NJ, nb, R), s (nb, R), xlo/xhi
+    (NJ, nb, T), xsum (nb, T); out (T, R). The d-major multi body
+    transposed: unpack once per plane, one accumulator per batch row,
+    sublane reduction."""
+    t = xlo_ref.shape[2]
+    accs = [None] * t
+    for j in range(NJ):
+        q = qs3[j].astype(jnp.int32)                 # (nb, R)
+        wlo = (q & 0xF).astype(jnp.float32)
+        whi = (q >> 4).astype(jnp.float32)
+        for ti in range(t):
+            a = (wlo * xlo_ref[j, :, ti][:, None]
+                 + whi * xhi_ref[j, :, ti][:, None])
+            accs[ti] = a if accs[ti] is None else accs[ti] + a
+    rows = []
+    for ti in range(t):
+        acc = accs[ti] - 8.0 * xsum_ref[:, ti][:, None]
+        rows.append(jnp.sum(acc * s, axis=0, keepdims=True))   # (1, R)
+    out_ref[...] = jnp.concatenate(rows, axis=0)               # (T, R)
+
+
+def _kernel_multi_nb(qs_ref, scale_ref, xlo_ref, xhi_ref, xsum_ref, out_ref):
+    _matvec_body_multi_nb(qs_ref, scale_ref[...], xlo_ref, xhi_ref, xsum_ref,
+                          out_ref)
+
+
+def _kernel_multi_nb_stacked(layer_ref, qs_ref, scale_ref, xlo_ref, xhi_ref,
+                             xsum_ref, out_ref):
+    del layer_ref
+    _matvec_body_multi_nb(qs_ref[0], scale_ref[0], xlo_ref, xhi_ref,
+                          xsum_ref, out_ref)
+
+
+def _matmul_body_nb(qs3, s, xlo_ref, xhi_ref, out_ref, bf16=False):
+    """T>8 MXU body, nb-major: qs3 (NJ, nb, R), s (nb, R), xlo/xhi
+    (NJ, bt, nb); out (bt, R). The contraction is a STANDARD (M,K)x(K,N)
+    dot (x rows x nb against weights nb x R) — no minor-dim contraction
+    gymnastics; bf16 as in _matmul_body."""
+    dn = (((1,), (0,)), ((), ()))
+    wdt = jnp.bfloat16 if bf16 else jnp.float32
+    prec = None if bf16 else jax.lax.Precision.HIGHEST
+    acc = None
+    for j in range(NJ):
+        q = qs3[j].astype(jnp.int32)                 # (nb, R)
+        wlo = (((q & 0xF) - 8).astype(jnp.float32) * s).astype(wdt)
+        whi = (((q >> 4) - 8).astype(jnp.float32) * s).astype(wdt)
+        a = jax.lax.dot_general(xlo_ref[j].astype(wdt), wlo, dn,
+                                preferred_element_type=jnp.float32,
+                                precision=prec)
+        a = a + jax.lax.dot_general(xhi_ref[j].astype(wdt), whi, dn,
+                                    preferred_element_type=jnp.float32,
+                                    precision=prec)
+        acc = a if acc is None else acc + a
+    out_ref[...] = acc
+
+
+def _kernel_mxu_nb(qs_ref, scale_ref, xlo_ref, xhi_ref, out_ref, *,
+                   bf16=False):
+    _matmul_body_nb(qs_ref, scale_ref[...], xlo_ref, xhi_ref, out_ref, bf16)
+
+
+def _kernel_mxu_nb_stacked(layer_ref, qs_ref, scale_ref, xlo_ref, xhi_ref,
+                           out_ref, *, bf16=False):
+    del layer_ref
+    _matmul_body_nb(qs_ref[0], scale_ref[0], xlo_ref, xhi_ref, out_ref, bf16)
+
+
 MULTI_T_MAX = 8  # beyond this the per-row accumulators crowd VMEM; use MXU
 
 
@@ -431,12 +499,19 @@ def _dequant_matmul(w: Q40Kernel, x2: jax.Array,
         w = Q40Kernel(w.qs_t[layer], w.scale[layer])
     qs = jnp.transpose(w.qs_t, (1, 2, 0))            # (d, nb, 16)
     wf = dequantize_q40_jax(qs, w.scale)
+    # fast-prefill applies to ALL dispatch targets — without this the
+    # tp-sharded band shapes that land here (e.g. d=1376=11008/8, no legal
+    # MXU tiling) would silently run at parity speed
+    return _precision_dot(wf, x2)
+
+
+def _precision_dot(wf, x2):
+    """Dequant-fallback einsum honoring the fast-prefill precision mode —
+    THE one copy of this dispatch for the dequantize-then-dot paths (the
+    kernel bodies carry their own threaded ``bf16`` flag)."""
     from .linear import matmul_mode
 
     if matmul_mode() == "bf16":
-        # fast-prefill applies to ALL three dispatch targets — without this
-        # the tp-sharded band shapes that land here (e.g. d=1376=11008/8,
-        # no legal MXU tiling) would silently run at parity speed
         return jnp.einsum("dn,tn->td", wf.astype(jnp.bfloat16),
                           x2.astype(jnp.bfloat16),
                           preferred_element_type=jnp.float32)
@@ -519,13 +594,120 @@ def _q40_matvec_nb_stacked(layer, qs_t, scale, x, *, block_rows, interpret):
     return out
 
 
+@functools.partial(jax.jit,
+                   static_argnames=("block_rows", "interpret"))
+def _q40_multi_nb_2d(qs_t, scale, x, *, block_rows, interpret):
+    _, nb, d = qs_t.shape
+    t = x.shape[0]
+    xlo, xhi = _split_x(x.astype(jnp.float32), nb)   # (NJ, t, nb)
+    xlo = jnp.transpose(xlo, (0, 2, 1))              # (NJ, nb, t)
+    xhi = jnp.transpose(xhi, (0, 2, 1))
+    xsum = jnp.sum(xlo + xhi, axis=0)                # (nb, t)
+    out = pl.pallas_call(
+        _kernel_multi_nb,
+        grid=(d // block_rows,),
+        in_specs=[
+            pl.BlockSpec((NJ, nb, block_rows), lambda i: (0, 0, i)),
+            pl.BlockSpec((nb, block_rows), lambda i: (0, i)),
+            pl.BlockSpec((NJ, nb, t), lambda i: (0, 0, 0)),
+            pl.BlockSpec((NJ, nb, t), lambda i: (0, 0, 0)),
+            pl.BlockSpec((nb, t), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((t, block_rows), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((t, d), jnp.float32),
+        interpret=interpret,
+    )(qs_t, scale, xlo, xhi, xsum)
+    return out                                        # (t, d)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("block_rows", "interpret"))
+def _q40_multi_nb_stacked(layer, qs_t, scale, x, *, block_rows, interpret):
+    _, _, nb, d = qs_t.shape
+    t = x.shape[0]
+    xlo, xhi = _split_x(x.astype(jnp.float32), nb)
+    xlo = jnp.transpose(xlo, (0, 2, 1))
+    xhi = jnp.transpose(xhi, (0, 2, 1))
+    xsum = jnp.sum(xlo + xhi, axis=0)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(d // block_rows,),
+        in_specs=[
+            pl.BlockSpec((1, NJ, nb, block_rows),
+                         lambda i, L: (L[0], 0, 0, i)),
+            pl.BlockSpec((1, nb, block_rows), lambda i, L: (L[0], 0, i)),
+            pl.BlockSpec((NJ, nb, t), lambda i, L: (0, 0, 0)),
+            pl.BlockSpec((NJ, nb, t), lambda i, L: (0, 0, 0)),
+            pl.BlockSpec((nb, t), lambda i, L: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((t, block_rows), lambda i, L: (0, i)),
+    )
+    return pl.pallas_call(
+        _kernel_multi_nb_stacked, grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((t, d), jnp.float32),
+        interpret=interpret,
+    )(layer, qs_t, scale, xlo, xhi, xsum)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("block_rows", "block_t", "interpret",
+                                    "bf16"))
+def _q40_mxu_nb_2d(qs_t, scale, x, *, block_rows, block_t, interpret,
+                   bf16=False):
+    _, nb, d = qs_t.shape
+    t = x.shape[0]
+    xlo, xhi = _split_x(x.astype(jnp.float32), nb)   # (NJ, t, nb) — natural
+    out = pl.pallas_call(
+        functools.partial(_kernel_mxu_nb, bf16=bf16),
+        grid=(t // block_t, d // block_rows),
+        in_specs=[
+            pl.BlockSpec((NJ, nb, block_rows), lambda ti, i: (0, 0, i)),
+            pl.BlockSpec((nb, block_rows), lambda ti, i: (0, i)),
+            pl.BlockSpec((NJ, block_t, nb), lambda ti, i: (0, ti, 0)),
+            pl.BlockSpec((NJ, block_t, nb), lambda ti, i: (0, ti, 0)),
+        ],
+        out_specs=pl.BlockSpec((block_t, block_rows), lambda ti, i: (ti, i)),
+        out_shape=jax.ShapeDtypeStruct((t, d), jnp.float32),
+        interpret=interpret,
+    )(qs_t, scale, xlo, xhi)
+    return out
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("block_rows", "block_t", "interpret",
+                                    "bf16"))
+def _q40_mxu_nb_stacked(layer, qs_t, scale, x, *, block_rows, block_t,
+                        interpret, bf16=False):
+    _, _, nb, d = qs_t.shape
+    t = x.shape[0]
+    xlo, xhi = _split_x(x.astype(jnp.float32), nb)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(t // block_t, d // block_rows),
+        in_specs=[
+            pl.BlockSpec((1, NJ, nb, block_rows),
+                         lambda ti, i, L: (L[0], 0, 0, i)),
+            pl.BlockSpec((1, nb, block_rows), lambda ti, i, L: (L[0], 0, i)),
+            pl.BlockSpec((NJ, block_t, nb), lambda ti, i, L: (0, ti, 0)),
+            pl.BlockSpec((NJ, block_t, nb), lambda ti, i, L: (0, ti, 0)),
+        ],
+        out_specs=pl.BlockSpec((block_t, block_rows),
+                               lambda ti, i, L: (ti, i)),
+    )
+    return pl.pallas_call(
+        functools.partial(_kernel_mxu_nb_stacked, bf16=bf16),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((t, d), jnp.float32),
+        interpret=interpret,
+    )(layer, qs_t, scale, xlo, xhi)
+
+
 def _q40_matmul_nbmajor(w: Q40KernelNb, x: jax.Array,
                         interpret: bool | None,
                         layer: jax.Array | None) -> jax.Array:
-    """nb-major dispatch: the T=1 decode matvec runs the dedicated kernel;
-    every other T dequantizes inline and dots (this layout exists for the
-    DECODE loop of models whose nb pads badly — prefill/batch correctness
-    is preserved at XLA-fallback speed, documented in pack_q40_params)."""
+    """nb-major dispatch, all T regimes on kernels (T=1 matvec, 2..8 VPU
+    multi, >8 MXU with the standard (M,K)x(K,N) dot); the dequant fallback
+    remains only for tilings the rules can't place."""
     qs_t, scale = w.qs_t, w.scale
     nb, d = qs_t.shape[-2], qs_t.shape[-1]
     if interpret is None:
@@ -533,32 +715,72 @@ def _q40_matmul_nbmajor(w: Q40KernelNb, x: jax.Array,
     lead = x.shape[:-1]
     x2 = x.reshape(-1, x.shape[-1])
     t = x2.shape[0]
+    if t > MULTI_T_MAX and t % 8 != 0:
+        pad = (-t) % 8
+        out = _q40_matmul_nbmajor(w, jnp.pad(x2, ((0, pad), (0, 0))),
+                                  interpret, layer)
+        return out[:t].reshape(*lead, d)
     rows = _pick_rows_nb(d, nb)
-    if t == 1 and rows is not None:
+    if rows is not None and 1 < t <= MULTI_T_MAX:
+        # the multi body carries t (nb, rows) f32 accumulators plus 16*t
+        # unrolled broadcast temporaries; measured on v5e: t=4/rows=256
+        # compiles, t=8 overflows scoped VMEM even at rows=128 — so the
+        # kernel serves t <= 4 and 5..8 take the dequant fallback below
+        if t > 4:
+            rows = None
+        else:
+            cap = max(128, 300_000 // (t * nb))
+            rows = next((r for r in
+                         range(min(rows, cap - cap % 128), 0, -128)
+                         if d % r == 0), None)
+    if rows is not None and t > MULTI_T_MAX:
+        # the MXU body's f32 wlo/whi temporaries obey the same measured
+        # rows*nb boundary as the d-major path (_MATMUL_ROWSXNB_CAP);
+        # _pick_rows_nb's matvec budget is looser, so re-cap here
+        cap = _MATMUL_ROWSXNB_CAP // nb
+        rows = next((r for r in range(min(rows, cap - cap % 128), 0, -128)
+                     if d % r == 0), None)
+        block_t = _pick_block_t(t, nb)
+        if rows is not None and block_t < 128 and rows > 256:
+            # same Mosaic small-t-tile VMEM behavior as the d-major MXU
+            # path: shrink the row tile (see _pick_block_rows)
+            rows = 256 if d % 256 == 0 else (128 if d % 128 == 0 else None)
+    if rows is not None:
+        from .linear import matmul_mode
+
+        bf16 = matmul_mode() == "bf16"
         if layer is not None:
             lidx = jnp.asarray(layer, dtype=jnp.int32).reshape(1)
-            out = _q40_matvec_nb_stacked(lidx, qs_t, scale, x2,
-                                         block_rows=rows,
-                                         interpret=interpret)
+            if t == 1:
+                out = _q40_matvec_nb_stacked(lidx, qs_t, scale, x2,
+                                             block_rows=rows,
+                                             interpret=interpret)
+            elif t <= MULTI_T_MAX:
+                out = _q40_multi_nb_stacked(lidx, qs_t, scale, x2,
+                                            block_rows=rows,
+                                            interpret=interpret)
+            else:
+                out = _q40_mxu_nb_stacked(lidx, qs_t, scale, x2,
+                                          block_rows=rows,
+                                          block_t=_pick_block_t(t, nb),
+                                          interpret=interpret, bf16=bf16)
         else:
-            out = _q40_matvec_nb_2d(qs_t, scale, x2, block_rows=rows,
-                                    interpret=interpret)
+            if t == 1:
+                out = _q40_matvec_nb_2d(qs_t, scale, x2, block_rows=rows,
+                                        interpret=interpret)
+            elif t <= MULTI_T_MAX:
+                out = _q40_multi_nb_2d(qs_t, scale, x2, block_rows=rows,
+                                       interpret=interpret)
+            else:
+                out = _q40_mxu_nb_2d(qs_t, scale, x2, block_rows=rows,
+                                     block_t=_pick_block_t(t, nb),
+                                     interpret=interpret, bf16=bf16)
         return out.reshape(*lead, d)
     if layer is not None:
         qs_t = qs_t[layer]
         scale = scale[layer]
     wf = _dequant_nb(qs_t, scale)
-    from .linear import matmul_mode
-
-    if matmul_mode() == "bf16":
-        out = jnp.einsum("dn,tn->td", wf.astype(jnp.bfloat16),
-                         x2.astype(jnp.bfloat16),
-                         preferred_element_type=jnp.float32)
-    else:
-        out = jnp.einsum("dn,tn->td", wf, x2.astype(jnp.float32),
-                         preferred_element_type=jnp.float32,
-                         precision=jax.lax.Precision.HIGHEST)
-    return out.reshape(*lead, d)
+    return _precision_dot(wf, x2).reshape(*lead, d)
 
 
 def q40_matmul(w: Q40Kernel | Q40Weight, x: jax.Array,

@@ -137,7 +137,8 @@ def rank_params_to_device(params: dict[str, Any]) -> dict[str, Any]:
 
     from ..ops.linear import fuse_q40_layer_matmuls, pack_q40_params
 
-    params = fuse_q40_layer_matmuls(pack_q40_params(params, tp=1))
+    params = fuse_q40_layer_matmuls(pack_q40_params(params, tp=1,
+                                                    allow_nb_major=True))
     return jax.tree_util.tree_map(
         lambda a: jax.device_put(jnp.asarray(a)), params)
 

@@ -37,11 +37,12 @@ import os
 import re
 import sys
 
-# HLO-op-shaped event names: start lower-case, no spaces/namespacing — this
-# admits thunk/op events ('dot_general.3', 'fusion.12', 'all_gather.3',
-# 'tpu_custom_call') and rejects runtime bookkeeping ('Rendezvous',
-# 'PjRtCpuExecutable::ExecuteHelper', 'Handle inputs', '$profiler.py...').
-_OP_RE = re.compile(r"^[a-z][\w.\-]*$")
+# HLO-op-shaped event names: lower-case (optionally ONE leading underscore —
+# jit-named Pallas custom calls like '_q40_matmul_stacked' carry their
+# Python fn name), no spaces/namespacing. Rejects runtime bookkeeping
+# ('Rendezvous', 'PjRtCpuExecutable::ExecuteHelper', 'Handle inputs',
+# '$profiler.py...') and dunder helpers ('__xla_...').
+_OP_RE = re.compile(r"^_?[a-z][\w.\-]*$")
 # 'end: X' markers, whole-module events, and control-flow ENVELOPES
 # (while/cond/call thunks contain their body ops, which are traced as their
 # own events) would double-count their contents

@@ -96,3 +96,12 @@ def test_hlo_instruction_names_extracted():
     m2 = it_split._HLO_RE.match(
         "%convolution_reduce_fusion = f32[]{:T(128)} fusion(...)")
     assert m2 and not it_split._COLLECTIVE_RE.search(m2.group(1))
+
+
+def test_op_name_filter_underscore_rules():
+    """Single-underscore Pallas custom calls (jit fn names) are ops; dunder
+    runtime helpers are not."""
+    assert it_split._OP_RE.match("_q40_matmul_stacked.48")
+    assert it_split._OP_RE.match("_q40_matvec_nb_stacked")
+    assert not it_split._OP_RE.match("__xla_thunk_helper")
+    assert not it_split._OP_RE.match("PjitFunction(f)")

@@ -332,13 +332,16 @@ def test_nbmajor_matvec_matches_dequant():
     got = q40_matmul(wn, x, interpret=True)
     np.testing.assert_allclose(np.asarray(got), want.T, rtol=1e-4, atol=1e-3)
 
-    # T>1 goes through the dequant fallback (correctness, not kernel speed)
-    xt = np.random.default_rng(4).standard_normal((5, 5120)).astype(
-        np.float32)
-    want_t = dequantize_q40(np.asarray(w.qs), np.asarray(w.d16)) @ xt.T
-    got_t = q40_matmul(wn, xt, interpret=True)
-    np.testing.assert_allclose(np.asarray(got_t), want_t.T, rtol=1e-4,
-                               atol=1e-3)
+    # the full dispatch ladder: T=2/4 (VPU multi-nb kernel — T=5..8 take
+    # the dequant fallback, whose scoped-VMEM footprint was measured to
+    # overflow), T=6 (that fallback), T=16 (MXU body), T=13 (pads to 16)
+    wd = dequantize_q40(np.asarray(w.qs), np.asarray(w.d16))
+    for t in (2, 4, 6, 16, 13):
+        xt = np.random.default_rng(t).standard_normal((t, 5120)).astype(
+            np.float32)
+        got_t = q40_matmul(wn, xt, interpret=True)
+        np.testing.assert_allclose(np.asarray(got_t), (wd @ xt.T).T,
+                                   rtol=1e-4, atol=1e-3)
 
 
 def test_nbmajor_pack_selection_and_forward_parity(monkeypatch):
@@ -371,7 +374,7 @@ def test_nbmajor_pack_selection_and_forward_parity(monkeypatch):
 
     monkeypatch.setenv("DLLAMA_Q40_KERNEL", "pallas")
     packed = pack_q40_params(synth_params(spec, q40=True, seed=31,
-                                          scale=0.2))
+                                          scale=0.2), allow_nb_major=True)
     # w2 consumes hidden=1280 -> nb=40 -> pads to 128 (3.2x): nb-major
     assert isinstance(packed["w2"], Q40KernelNb)
     # wq consumes dim=128 -> nb=4... also nb-major (ratio 32x); the point:
@@ -383,8 +386,12 @@ def test_nbmajor_pack_selection_and_forward_parity(monkeypatch):
     np.testing.assert_allclose(np.asarray(got_logits),
                                np.asarray(ref_logits), rtol=2e-5, atol=2e-5)
 
-    # 7B/70B shapes keep the tuned d-major layout
-    p7 = pack_q40_params({"wq": _mk(256, 4096)})   # nb=128: no padding
-    assert isinstance(p7["wq"], Q40Kernel)
-    p7b = pack_q40_params({"w2": _mk(256, 11008)})  # nb=344: 1.12x only
-    assert isinstance(p7b["w2"], Q40Kernel)
+    # 7B/70B shapes keep the tuned d-major layout even when allowed
+    p7 = pack_q40_params({"wq": _mk(256, 4096)}, allow_nb_major=True)
+    assert isinstance(p7["wq"], Q40Kernel)     # nb=128: no padding
+    p7b = pack_q40_params({"w2": _mk(256, 11008)}, allow_nb_major=True)
+    assert isinstance(p7b["w2"], Q40Kernel)    # nb=344: 1.12x only
+    # and WITHOUT the single-chip opt-in nothing goes nb-major (sharded
+    # callers: an sp>1 mesh packs with tp=1 but cannot carry Q40KernelNb)
+    psh = pack_q40_params({"w2": _mk(128, 1280)})  # nb=40: 3.2x ratio
+    assert isinstance(psh["w2"], Q40Kernel)
