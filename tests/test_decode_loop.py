@@ -230,3 +230,42 @@ def test_steps_change_reuses_one_compiled_loop():
     assert len(eng._loops) == 1  # same sampling config -> same program
     # the shorter budget is a prefix of the longer greedy chain
     assert out9[:len(out5)] == out5 and len(out9) > len(out5)
+
+
+def test_aot_decode_loop_matches_jit_path():
+    """decode.make_decode_loop_aot (the bench's AOT place-then-compile
+    path, layouts pinned to the placed arrays) must produce the same token
+    chain as the plain jitted loop."""
+    import functools
+
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_llama_tpu.models.llama import (forward, init_cache,
+                                                    params_to_device)
+    from distributed_llama_tpu.runtime.decode import (make_decode_loop,
+                                                      make_decode_loop_aot)
+
+    params = synth_params(SPEC, q40=False, seed=3, scale=0.3)
+    step = functools.partial(forward, SPEC)
+    steps = 6
+    padded = np.full((SPEC.seq_len + 1,), -1, dtype=np.int32)
+    padded[:3] = [1, 5, 9]
+    coins = jnp.zeros((SPEC.seq_len,), jnp.float32)
+
+    run = make_decode_loop(step, SPEC.seq_len, temperature=0.0, topp=0.9)
+    want, _ = run(params_to_device(params), init_cache(SPEC),
+                  jnp.asarray(padded), jnp.int32(1), coins, jnp.int32(0),
+                  jnp.int32(steps))
+
+    compile_and_place = make_decode_loop_aot(step, SPEC.seq_len,
+                                             temperature=0.0, topp=0.9)
+    compiled, placed = compile_and_place(
+        params, jax.eval_shape(lambda: init_cache(SPEC)),
+        jnp.asarray(padded), jnp.int32(1), coins, jnp.int32(0),
+        jnp.int32(steps))
+    got, _ = compiled(placed, init_cache(SPEC), jnp.asarray(padded),
+                      jnp.int32(1), coins, jnp.int32(0), jnp.int32(steps))
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
