@@ -54,6 +54,10 @@ def main() -> int:
     ap.add_argument("--pos", type=int, default=-1,
                     help="cache position for the attention reads "
                          "(-1 = seq_len/2)")
+    ap.add_argument("--kv-bf16", action="store_true",
+                    help="bf16 KV cache for the attention/full/chain phases "
+                         "(required at 13b: the f32 cache + weights exceed "
+                         "one 16 GB chip)")
     args = ap.parse_args()
 
     global jax
@@ -76,6 +80,11 @@ def main() -> int:
     K = args.iters
     print(f"backend {jax.default_backend()}  config {args.config}  "
           f"iters {K}  pos {pos0}", file=sys.stderr)
+
+    cache_dtype = jnp.bfloat16 if args.kv_bf16 else jnp.float32
+
+    def mk_cache():
+        return llama.init_cache(spec, cache_dtype)
 
     t0 = time.perf_counter()
     params = llama.params_to_device(synth_q40_fast(spec))
@@ -217,7 +226,7 @@ def main() -> int:
         # start the chain at pos0 so its attention reads match the other
         # phases' (decode cost grows with position; deltas must compare
         # like with like)
-        return run(params, llama.init_cache(spec), jnp.asarray(padded),
+        return run(params, mk_cache(), jnp.asarray(padded),
                    jnp.int32(7), coins, jnp.int32(pos0), jnp.int32(K))
 
     results = {}
@@ -227,27 +236,39 @@ def main() -> int:
             ("matmuls", p_mm, (params, x0)),
             ("glue", p_glue, (params, x0)),
             ("attention",
-             lambda params, x: p_att(params, x, *llama.init_cache(spec)),
+             lambda params, x: p_att(params, x, *mk_cache()),
              (params, x0)),
-            ("full_step", lambda: p_step(params, llama.init_cache(spec),
-                                         tok0), ()),
+            ("full_step", lambda: p_step(params, mk_cache(), tok0), ()),
             ("chain_step", p_chain, ())):
         t0 = time.perf_counter()
-        ms = _timed(fn, *fargs) / K
+        try:
+            ms = _timed(fn, *fargs) / K
+        except Exception as e:
+            # a phase that cannot compile (e.g. the attention phase's
+            # duplicated cache carries exceed HBM at 13B — the AOT tunnel
+            # gives no cross-dispatch donation) must not abort the ladder:
+            # later phases and the JSON still carry the attribution
+            results[name] = None
+            print(f"{name:>10}: FAILED ({type(e).__name__}; see stderr "
+                  f"above)", file=sys.stderr)
+            continue
         results[name] = round(ms, 3)
         print(f"{name:>10}: {ms:7.3f} ms/step   "
               f"(compile+3 trials {time.perf_counter() - t0:.1f}s)",
               file=sys.stderr)
 
+    def delta(a, b):
+        return (round(results[a] - results[b], 3)
+                if results.get(a) is not None and results.get(b) is not None
+                else None)
+
     deltas = {
-        "weight_stream_floor": results["stream"],
-        "matmuls": results["matmuls"],
-        "glue_delta": round(results["glue"] - results["matmuls"], 3),
-        "attention_delta": round(results["attention"] - results["glue"], 3),
-        "wcls_final_delta": round(results["full_step"]
-                                  - results["attention"], 3),
-        "loop_sampling_delta": round(results["chain_step"]
-                                     - results["full_step"], 3),
+        "weight_stream_floor": results.get("stream"),
+        "matmuls": results.get("matmuls"),
+        "glue_delta": delta("glue", "matmuls"),
+        "attention_delta": delta("attention", "glue"),
+        "wcls_final_delta": delta("full_step", "attention"),
+        "loop_sampling_delta": delta("chain_step", "full_step"),
     }
     print(json.dumps({"config": args.config, "iters": K, "pos": pos0,
                       "phases_ms_per_step": results, "deltas_ms": deltas}))
