@@ -49,7 +49,14 @@ def _add_common(ap: argparse.ArgumentParser) -> None:
                     help="(root) serve the model file's bytes on PORT so "
                          "hosts without a local copy can fetch it — the "
                          "reference's root->worker weight streaming "
-                         "(transformer.cpp:250-273)")
+                         "(transformer.cpp:250-273). UNAUTHENTICATED, like "
+                         "the reference's socket protocol: run it on a "
+                         "trusted LAN only, and restrict the interface with "
+                         "--serve-weights-bind")
+    ap.add_argument("--serve-weights-bind", default="0.0.0.0", metavar="ADDR",
+                    help="interface the weight server listens on (default "
+                         "all; bind a cluster-internal address to keep the "
+                         "unauthenticated byte service off public networks)")
     ap.add_argument("--model-from-root", default=None, metavar="HOST:PORT",
                     help="(worker) fetch the model from the root's "
                          "--serve-weights endpoint into the --model path "
@@ -66,7 +73,8 @@ def _weight_streaming(args, quiet: bool):
     if args.serve_weights is not None:
         from ..io.stream import WeightServer
 
-        server = WeightServer(args.model, port=args.serve_weights)
+        server = WeightServer(args.model, host=args.serve_weights_bind,
+                              port=args.serve_weights)
         if not quiet:
             print(f"⏩ serving weights on port {server.port}")
     if args.model_from_root:
@@ -307,8 +315,17 @@ def cmd_inference(argv: list[str], quiet: bool = False) -> int:
         try:
             from ..utils.it_split import parse_trace, summarize
 
+            # the trace wraps the WHOLE generate() call — a prefilled prompt's
+            # chunked forwards are inside it, so dividing by generated tokens
+            # overstates the decode-only per-token split; say so in the line
+            # (a resumed run prefills only the unconsumed prompt tail)
+            n_prompt = (len(rest0) if resume
+                        else len(tokenizer.encode(args.prompt or "",
+                                                  bos=True, eos=False)))
+            note = (f"; trace includes ~{n_prompt}-token prompt prefill"
+                    if n_prompt > 1 else "")
             summarize(parse_trace(args.profile),
-                      tokens=max(stats.tokens, 1))
+                      tokens=max(stats.tokens, 1), note=note)
         except Exception as e:  # a malformed trace must not fail the run
             print(f"💡 I/T split unavailable ({type(e).__name__}: {e}); "
                   f"run tools/it_split.py on the trace dir", file=sys.stderr)

@@ -43,6 +43,11 @@ class WeightServer:
     Runs a daemon thread per connection; ``port=0`` picks a free port
     (exposed as ``.port``). The server stays up until ``close()`` — workers
     may connect at any point of the root's own load.
+
+    Trust model: UNAUTHENTICATED byte service, same as the reference's
+    worker sockets — anyone who can reach the port can read the model file.
+    Run it on a trusted/cluster network; ``host`` restricts the listening
+    interface (the CLI exposes it as --serve-weights-bind).
     """
 
     def __init__(self, path: str, host: str = "0.0.0.0", port: int = 0):

@@ -522,9 +522,10 @@ def _precision_dot(wf, x2):
 
 def _pick_rows_nb(d: int, nb: int) -> int | None:
     """Row tile for the nb-major matvec: rows ride the LANES, so they must
-    be a multiple of 128 (or the whole d when d < 128-divisible options);
-    rows*nb stays under the same ~(16+4)-bytes-per-word scoped-VMEM budget
-    as the d-major matvec."""
+    be a multiple of 128 — a d with no multiple-of-128 divisor (including
+    every d < 128) returns None and the caller routes to the dequant
+    fallback; rows*nb stays under the same ~(16+4)-bytes-per-word
+    scoped-VMEM budget as the d-major matvec."""
     top = min(d, 768, max(128, 360_000 // nb))
     for cand in range(top - top % 128, 0, -128):
         if d % cand == 0:
@@ -704,10 +705,16 @@ def _q40_mxu_nb_stacked(layer, qs_t, scale, x, *, block_rows, block_t,
 
 def _q40_matmul_nbmajor(w: Q40KernelNb, x: jax.Array,
                         interpret: bool | None,
-                        layer: jax.Array | None) -> jax.Array:
+                        layer: jax.Array | None,
+                        block_rows: int | None = None) -> jax.Array:
     """nb-major dispatch, all T regimes on kernels (T=1 matvec, 2..8 VPU
     multi, >8 MXU with the standard (M,K)x(K,N) dot); the dequant fallback
-    remains only for tilings the rules can't place."""
+    remains only for tilings the rules can't place.
+
+    ``block_rows`` overrides the auto-picked row tile (q40_matmul's tuning
+    knob, plumbed through for nb-major too). Lane-riding rows must be a
+    multiple of 128 dividing d; the T-path VMEM caps below still apply, so
+    an oversized override is shrunk, not obeyed blindly."""
     qs_t, scale = w.qs_t, w.scale
     nb, d = qs_t.shape[-2], qs_t.shape[-1]
     if interpret is None:
@@ -718,9 +725,24 @@ def _q40_matmul_nbmajor(w: Q40KernelNb, x: jax.Array,
     if t > MULTI_T_MAX and t % 8 != 0:
         pad = (-t) % 8
         out = _q40_matmul_nbmajor(w, jnp.pad(x2, ((0, pad), (0, 0))),
-                                  interpret, layer)
+                                  interpret, layer, block_rows)
         return out[:t].reshape(*lead, d)
-    rows = _pick_rows_nb(d, nb)
+    if block_rows is not None:
+        if block_rows % 128 or d % block_rows:
+            raise ValueError(
+                f"nb-major block_rows={block_rows} must be a multiple of "
+                f"128 dividing d={d}")
+        rows = block_rows
+        if t == 1:
+            # same scoped-VMEM budget the auto pick enforces — an oversized
+            # override is shrunk, not obeyed blindly (the t>1 branches below
+            # re-cap for themselves)
+            cap = max(128, 360_000 // nb)
+            if rows > cap:
+                rows = next((r for r in range(cap - cap % 128, 0, -128)
+                             if d % r == 0), rows)
+    else:
+        rows = _pick_rows_nb(d, nb)
     if rows is not None and 1 < t <= MULTI_T_MAX:
         # the multi body carries t (nb, rows) f32 accumulators plus 16*t
         # unrolled broadcast temporaries; measured on v5e: t=4/rows=256
@@ -798,7 +820,7 @@ def q40_matmul(w: Q40Kernel | Q40Weight, x: jax.Array,
     stack via scalar prefetch — the zero-copy path for lax.scan over layers.
     """
     if isinstance(w, Q40KernelNb):
-        return _q40_matmul_nbmajor(w, x, interpret, layer)
+        return _q40_matmul_nbmajor(w, x, interpret, layer, block_rows)
     if isinstance(w, Q40Weight):
         w = to_kernel_layout(w)
     qs_t, scale = w.qs_t, w.scale

@@ -139,9 +139,11 @@ def parse_trace(path: str) -> dict[str, DeviceSplit]:
 
 
 def summarize(splits: dict[str, DeviceSplit], tokens: int = 0,
-              top: int = 8, out=None) -> tuple[float, float]:
+              top: int = 8, out=None, note: str = "") -> tuple[float, float]:
     """Print the reference-shaped split; returns (I_ms, T_ms) averaged
-    across devices (per token when ``tokens`` > 0)."""
+    across devices (per token when ``tokens`` > 0). ``note`` extends the
+    caveat parenthetical (e.g. the CLI flags that the traced region also
+    contains prefill work)."""
     out = out or sys.stdout
     n_dev = len(splits)
     i_ms = sum(s.inference_ns for s in splits.values()) / n_dev / 1e6
@@ -150,7 +152,7 @@ def summarize(splits: dict[str, DeviceSplit], tokens: int = 0,
     unit = "ms/token" if tokens else "ms"
     print(f"🔶 I {i_ms / denom:10.3f} {unit}  T {t_ms / denom:10.3f} {unit}"
           f"  ({n_dev} device{'s' if n_dev != 1 else ''}, op-time avg;"
-          f" I=compute T=collectives)", file=out)
+          f" I=compute T=collectives{note})", file=out)
     agg: collections.Counter = collections.Counter()
     for s in splits.values():
         agg.update(s.ops)
