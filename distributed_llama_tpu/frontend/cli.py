@@ -62,13 +62,48 @@ def _add_common(ap: argparse.ArgumentParser) -> None:
                          "--serve-weights endpoint into the --model path "
                          "when that file is absent (zero local model files, "
                          "like reference workers, transformer.cpp:354-380)")
+    ap.add_argument("--stream-slices", action="store_true",
+                    help="with --model-from-root: fetch ONLY this host's "
+                         "tp weight bands (~1/tp of the matmul bytes, like "
+                         "the reference's per-worker slice scatter, "
+                         "transformer.cpp:250-273) instead of the whole "
+                         "file. Needs an explicit --tp and equal devices "
+                         "per host; the run cross-checks the assumed ranks "
+                         "against the actual mesh and aborts on mismatch")
 
 
-def _weight_streaming(args, quiet: bool):
+def _assumed_tp_ranks(args) -> set[int]:
+    """The tp ranks this host's devices will hold, derived from CLI args
+    alone (the fetch runs BEFORE jax.distributed, so the mesh is not yet
+    buildable): make_mesh reshapes the global device list row-major into
+    (dp, sp, tp), and with H equal hosts, host i owns global devices
+    [i*D, (i+1)*D) for D = dp*sp*tp/H — so its tp coordinates are
+    {g % tp}. The run re-derives the REAL coordinates from the mesh later
+    and aborts on mismatch (fail loud, never compute on unfetched zeros)."""
+    tp = args.tp
+    if not tp or tp <= 1:
+        raise SystemExit("--stream-slices needs an explicit --tp > 1 (the "
+                         "slice layout is the tp weight sharding)")
+    sp = getattr(args, "sp", 1) or 1
+    dp = getattr(args, "dp", 1) or 1
+    need = dp * sp * tp
+    n_hosts = args.num_hosts
+    if need % n_hosts:
+        raise SystemExit(f"--stream-slices assumes equal devices/host; mesh "
+                         f"of {need} devices does not divide over "
+                         f"{n_hosts} hosts")
+    per_host = need // n_hosts
+    i = args.host_id or 0
+    return {g % tp for g in range(i * per_host, (i + 1) * per_host)}
+
+
+def _weight_streaming(args, quiet: bool, allow_slices: bool = True):
     """Start the root-side weight server / run the worker-side fetch (both
     BEFORE jax.distributed's barrier, so fetching overlaps nothing and a
     dead transfer fails fast). Returns the server (or None) so it outlives
-    the load."""
+    the load. With --stream-slices the fetch pulls only this host's tp
+    bands (io/stream.fetch_model_slices) and records the assumed rank set
+    on ``args`` for the post-mesh cross-check."""
     server = None
     if args.serve_weights is not None:
         from ..io.stream import WeightServer
@@ -78,12 +113,28 @@ def _weight_streaming(args, quiet: bool):
         if not quiet:
             print(f"⏩ serving weights on port {server.port}")
     if args.model_from_root:
-        from ..io.stream import fetch_model
+        if getattr(args, "stream_slices", False):
+            if not allow_slices:
+                raise SystemExit("--stream-slices is an inference/worker "
+                                 "feature (training re-shards densified "
+                                 "weights); fetch the whole file instead")
+            from ..io.stream import fetch_model_slices
 
-        # unconditional: fetch_model owns the staleness decision (skips
-        # only when the local size matches the server's; a truncated or
-        # wrong-size local file is repaired, not trusted)
-        fetch_model(args.model_from_root, args.model, quiet=quiet)
+            ranks = _assumed_tp_ranks(args)
+            fetch_model_slices(args.model_from_root, args.model,
+                               _FT[args.weights_float_type], args.tp, ranks,
+                               quiet=quiet)
+            args._slice_tp_ranks = ranks
+        else:
+            from ..io.stream import fetch_model
+
+            # unconditional: fetch_model owns the staleness decision (skips
+            # only when the local size matches the server's; a truncated or
+            # wrong-size local file is repaired, not trusted)
+            fetch_model(args.model_from_root, args.model, quiet=quiet)
+    elif getattr(args, "stream_slices", False):
+        raise SystemExit("--stream-slices only applies with "
+                         "--model-from-root")
     return server
 
 
@@ -238,6 +289,20 @@ def cmd_inference(argv: list[str], quiet: bool = False) -> int:
               f"{jax.devices()[0].platform})")
     mesh = (make_mesh(sp=args.sp, tp=tp)
             if tp > 1 or args.sp > 1 else None)
+    assumed = getattr(args, "_slice_tp_ranks", None)
+    if assumed is not None:
+        # slice-streamed weights: every band this host's devices will read
+        # must have been fetched — verify the pre-mesh rank arithmetic
+        # against the REAL mesh before any forward touches the params
+        from ..parallel.mesh import local_axis_indices
+
+        actual = local_axis_indices(mesh, "tp") if mesh is not None else {0}
+        if not actual <= assumed:
+            print(f"--stream-slices fetched tp ranks {sorted(assumed)} but "
+                  f"this host's devices hold ranks {sorted(actual)} — the "
+                  f"host->rank assumption does not match this topology; "
+                  f"re-run without --stream-slices", file=sys.stderr)
+            return 2
     import jax.numpy as jnp
 
     cache_dtype = jnp.bfloat16 if args.kv_cache_dtype == "bf16" else None
@@ -463,7 +528,9 @@ def cmd_train(argv: list[str]) -> int:
     # of (--seed, step), so all hosts feed the same global windows and jit
     # shards them (dp can cross the host boundary); only host 0 prints
     quiet = bool(args.host_id)
-    _ws = _weight_streaming(args, quiet)  # before the distributed barrier
+    # before the distributed barrier; slice streaming is inference-only
+    # (training densifies + re-shards, so a host needs the full tensors)
+    _ws = _weight_streaming(args, quiet, allow_slices=False)
     _maybe_distributed(args)
 
     import numpy as np

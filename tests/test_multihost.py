@@ -317,3 +317,59 @@ def test_worker_streams_weights_from_root(tmp_path):
     import os as _os
 
     assert _os.path.getsize(wmodel) == _os.path.getsize(model)  # fetched
+
+
+def test_worker_streams_weight_slices_from_root(tmp_path):
+    """--stream-slices: the worker host fetches ONLY its tp band of every
+    matmul tensor (the reference's slice-granular scatter,
+    transformer.cpp:250-273) — the sparse file passes the loader, the mesh
+    cross-check accepts the assumed ranks, and output equals the
+    single-process run. The sidecar must show materially less than the
+    whole file fetched."""
+    model, tok = _write_model_files(tmp_path)
+    cwd = str(tmp_path)
+    gen = ("--prompt", "hi", "--steps", "5", "--temperature", "0.9",
+           "--topp", "0.9")
+
+    p = _run_n("inference", model, tok, None, None, 1, 2, cwd, tp=2,
+               extra=gen)
+    out_single, err = p.communicate(timeout=300)
+    assert p.returncode == 0, err[-2000:]
+    want = _pieces(out_single)
+    assert want
+
+    wport = _free_port()
+    coord = f"127.0.0.1:{_free_port()}"
+    wdir = tmp_path / "slicehost"
+    wdir.mkdir()
+    wmodel = str(wdir / "model.bin")
+    root = _run_n("inference", model, tok, 0, coord, 2, 1, cwd, tp=2,
+                  extra=gen + ("--serve-weights", str(wport)))
+    worker = _run_n("worker", wmodel, tok, 1, coord, 2, 1, cwd, tp=2,
+                    extra=gen + ("--model-from-root", f"127.0.0.1:{wport}",
+                                 "--stream-slices"))
+    out_root, err_root = root.communicate(timeout=420)
+    out_worker, err_worker = worker.communicate(timeout=60)
+    assert root.returncode == 0, f"root: {err_root[-2000:]}"
+    assert worker.returncode == 0, f"worker: {err_worker[-2000:]}"
+    assert _pieces(out_root) == want, out_root
+
+    import json as _json
+    import os as _os
+
+    assert _os.path.getsize(wmodel) == _os.path.getsize(model)  # sparse full
+    with open(wmodel + ".slices") as fh:
+        meta = _json.load(fh)
+    fetched = sum(ln for _, ln in meta["ranges"])
+    # exactly the host's needed ranges: header + replicated tensors in full
+    # + HALF of every matmul tensor's bytes (rank 1 of tp=2) — in this tiny
+    # spec the replicated embedding dominates, so assert the exact sum, not
+    # a fraction (at 70B the matmul share is ~97% and this IS ~1/tp)
+    from distributed_llama_tpu.io.loader import tensor_byte_ranges
+    from distributed_llama_tpu.io.stream import needed_byte_ranges
+
+    want_bytes = sum(ln for _, ln in needed_byte_ranges(SPEC, 2, {1}))
+    assert fetched == want_bytes, meta
+    matmul = sum(tr.nbytes for tr in tensor_byte_ranges(SPEC)
+                 if tr.rows is not None)
+    assert fetched <= _os.path.getsize(model) - matmul // 2  # a real cut

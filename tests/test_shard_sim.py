@@ -102,15 +102,25 @@ def test_projection_itemization_consistent():
         proj.shard_ms + proj.ici_bandwidth_ms + proj.ici_latency_ms)
     assert proj.gather_bytes_per_chip == ici_all_gather_bytes(SPEC, 2).sent_bytes
     assert proj.n_collectives == SPEC.n_layers * 4 + 1
-    # Q80 buffers: byte total shrinks ~4x, collective count doubles per cut
-    # (hidden/tp must be a 32-block multiple for Q80 — use a wider ffn)
+    # Q80 buffers: byte total shrinks ~4x and the collective COUNT is
+    # unchanged — codes + deltas ride ONE packed uint8 gather per cut
+    # (tp._wire_gather, VERDICT r2 #4). (hidden/tp must be a 32-block
+    # multiple for Q80 — use a wider ffn)
     base = TransformerSpec(**{**SPEC.__dict__, "hidden_dim": 256})
     spec80 = TransformerSpec(**{**base.__dict__,
                                 "buffer_float_type": FloatType.Q80})
     proj = shard_sim.project_full_system(base, 2, shard_ms=5.0)
     proj80 = shard_sim.project_full_system(spec80, 2, shard_ms=5.0)
-    assert proj80.n_collectives == SPEC.n_layers * 8 + 1
+    assert proj80.n_collectives == proj.n_collectives == SPEC.n_layers * 4 + 1
     assert proj80.gather_bytes_per_chip < proj.gather_bytes_per_chip / 2
+    assert proj80.ici_latency_ms == proj.ici_latency_ms
+    # the north-star shape: 80 layers * 4 + logits = 321 collectives/token
+    # in BOTH buffer modes
+    from distributed_llama_tpu.models.synth import llama2_70b_spec
+
+    s70_80 = llama2_70b_spec(buffer_float_type=FloatType.Q80)
+    assert shard_sim.project_full_system(
+        s70_80, 8, shard_ms=16.5).n_collectives == 321
 
 
 def test_rank_fused_q40_matches_dense(monkeypatch):

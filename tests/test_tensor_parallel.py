@@ -153,11 +153,15 @@ def test_q80_wire_gathers_carry_int8_payload():
     """Under buffer_float_type=Q80 the per-layer collectives must move the
     REAL quantized payload — int8 codes + f16 deltas — not dequantized f32
     (VERDICT r1 #4: round 1 quantize-dequantized BEFORE the gather, so the
-    wire carried f32 while comm_stats claimed the 4x cut). The scan body
-    holds the per-layer program once: expect 4 int8 + 4 f16 gathers there
-    plus the single f32 logits gather; in f32 buffer mode all five are f32.
-    And values must be unchanged: quantize->gather->dequantize equals the
-    round-1 fake-quant path bit for bit, pinned against single-chip Q80."""
+    wire carried f32 while comm_stats claimed the 4x cut). Codes and deltas
+    are packed into ONE uint8 buffer of contiguous 34-byte blocks per cut
+    (VERDICT r2 #4: separate code/delta gathers doubled the per-collective
+    latency term that dominates the 70B ICI budget). The scan body holds
+    the per-layer program once: expect 4 uint8 gathers there plus the
+    single f32 logits gather; in f32 buffer mode all five are f32.
+    And values must be unchanged: quantize->pack->gather->unpack->dequantize
+    equals the round-1 fake-quant path bit for bit, pinned against
+    single-chip Q80."""
     import jax.numpy as jnp
 
     from distributed_llama_tpu.models.llama import forward, init_cache
@@ -178,7 +182,7 @@ def test_q80_wire_gathers_carry_int8_payload():
     fwd80 = make_sharded_forward(spec80, mesh)
     toks = jnp.asarray(tokens)
     assert _all_gather_dtypes(fwd80, sp, sc, toks, jnp.int32(0)) == (
-        ["float16"] * 4 + ["float32"] + ["int8"] * 4)
+        ["float32"] + ["uint8"] * 4)
     fwd32 = make_sharded_forward(base, mesh)
     assert _all_gather_dtypes(
         fwd32, shard_params(p, make_mesh(tp=2)),
@@ -195,3 +199,42 @@ def test_q80_wire_gathers_carry_int8_payload():
     want, _ = forward(spec80, pj, init_cache(spec80), toks, jnp.int32(0))
     got, _ = fwd80(sp, sc, toks, jnp.int32(0))
     assert np.abs(np.asarray(got) - np.asarray(want)).max() < 0.15
+
+
+def test_q80_wire_block_byte_layout():
+    """The packed wire buffer is the reference's contiguous 34-byte block
+    layout (quants.hpp:21-24): per 32-value block, 32 int8 codes then the 2
+    f16-delta bytes — asserted on the raw uint8 buffer handed to the
+    collective, and the unpack must reproduce the fake-quant values exactly
+    (pack/gather/unpack is lossless)."""
+    import jax.numpy as jnp
+
+    from distributed_llama_tpu.ops.linear import fake_quant_q80
+    from distributed_llama_tpu.ops.quants import quantize_q80_jax
+    from distributed_llama_tpu.parallel import tp
+
+    spec80 = TransformerSpec(dim=64, hidden_dim=128, n_layers=1, n_heads=2,
+                             n_kv_heads=2, vocab_size=32, seq_len=8,
+                             buffer_float_type=FloatType.Q80)
+    rng = np.random.default_rng(11)
+    x = jnp.asarray(rng.standard_normal((1, 64)).astype(np.float32))
+    captured = {}
+
+    def tile2(a, axis):
+        captured["buf"] = np.asarray(a)
+        return jnp.concatenate([a, a], axis=axis)
+
+    out = np.asarray(tp._wire_gather(spec80, x, gather_fn=tile2))
+
+    buf = captured["buf"]
+    assert buf.dtype == np.uint8 and buf.shape == (1, 2 * 34)  # nb=2 blocks
+    qs, d = quantize_q80_jax(x)
+    qs, d = np.asarray(qs), np.asarray(d)
+    for b in range(2):
+        blk = buf[0, b * 34:(b + 1) * 34]
+        np.testing.assert_array_equal(blk[:32], qs[0, b].view(np.uint8))
+        np.testing.assert_array_equal(blk[32:], d[0, b:b + 1]
+                                      .view(np.uint8).reshape(2))
+    # the gathered result = the fake-quant values, tiled in shard order
+    want = np.asarray(fake_quant_q80(x))
+    np.testing.assert_array_equal(out, np.concatenate([want, want], axis=-1))

@@ -128,3 +128,160 @@ def test_connect_retry_tolerates_late_server(tmp_path):
     finally:
         t.join()
         box["server"].close()
+
+
+# ---------------------------------------------------------------------------
+# Slice-granular streaming (VERDICT r2 #5): fetch only a host's tp bands
+# ---------------------------------------------------------------------------
+
+def _tiny_spec():
+    from distributed_llama_tpu.models.spec import TransformerSpec
+    from distributed_llama_tpu.ops.quants import FloatType
+
+    return TransformerSpec(dim=64, hidden_dim=160, n_layers=2, n_heads=4,
+                           n_kv_heads=2, vocab_size=300, seq_len=32,
+                           weights_float_type=FloatType.Q40)
+
+
+def _write_tiny_model(path, spec, seed=5):
+    from distributed_llama_tpu.io.loader import write_model
+
+    rng = np.random.default_rng(seed)
+
+    def t(*shape):
+        return (rng.standard_normal(shape) * 0.1).astype(np.float32)
+
+    tensors = {"tok_embedding": t(spec.vocab_size, spec.dim),
+               "rms_att": 1 + t(spec.n_layers, spec.dim),
+               "rms_ffn": 1 + t(spec.n_layers, spec.dim),
+               "rms_final": 1 + t(spec.dim),
+               "wcls": t(spec.vocab_size, spec.dim)}
+    for name, shape in spec.layer_matmul_shapes():
+        tensors[name] = t(spec.n_layers, *shape)
+    write_model(path, spec, tensors)
+    return tensors
+
+
+def test_range_algebra():
+    from distributed_llama_tpu.io.stream import merge_ranges, subtract_ranges
+
+    assert merge_ranges([(10, 5), (0, 4), (14, 6), (3, 2)]) == [
+        (0, 5), (10, 10)]
+    assert subtract_ranges([(0, 20)], [(5, 5)]) == [(0, 5), (10, 10)]
+    assert subtract_ranges([(0, 10)], [(0, 10)]) == []
+    assert subtract_ranges([(0, 10)], []) == [(0, 10)]
+    assert subtract_ranges([(5, 10)], [(0, 7), (12, 100)]) == [(7, 5)]
+
+
+def test_needed_ranges_tp2_half_matmul_bytes():
+    """A tp=2 single-rank host fetches the header + replicated tensors in
+    full and exactly HALF of every matmul tensor's bytes (VERDICT r2 #5's
+    acceptance: ~half the file's matmul bytes; reference scatter
+    transformer.cpp:250-273)."""
+    from distributed_llama_tpu.io.loader import tensor_byte_ranges
+    from distributed_llama_tpu.io.stream import needed_byte_ranges
+    from distributed_llama_tpu.models.spec import HEADER_BYTES
+
+    spec = _tiny_spec()
+    trs = tensor_byte_ranges(spec)
+    matmul = sum(tr.nbytes for tr in trs if tr.rows is not None)
+    repl = sum(tr.nbytes for tr in trs
+               if tr.rows is None and tr.name != "_rope_gap")
+    need = needed_byte_ranges(spec, 2, {0})
+    got = sum(ln for _, ln in need)
+    assert got == HEADER_BYTES + repl + matmul // 2
+    # both ranks = the whole file minus the rope gap
+    both = sum(ln for _, ln in needed_byte_ranges(spec, 2, {0, 1}))
+    assert both == HEADER_BYTES + repl + matmul
+
+
+def test_fetch_model_slices_e2e(tmp_path):
+    """Slice fetch -> sparse file: fetched bands byte-identical, unfetched
+    bands zero, sidecar enables the top-up path, and topping up to all
+    ranks reproduces the full file (modulo the rope gap, zeros both ways)."""
+    from distributed_llama_tpu.io.loader import (load_model,
+                                                 tensor_byte_ranges)
+    from distributed_llama_tpu.io.stream import fetch_model_slices
+    from distributed_llama_tpu.ops.quants import FloatType
+
+    spec = _tiny_spec()
+    src = str(tmp_path / "model.bin")
+    _write_tiny_model(src, spec)
+    server = WeightServer(src, host="127.0.0.1")
+    try:
+        dst = str(tmp_path / "worker" / "model.bin")
+        addr = f"127.0.0.1:{server.port}"
+        fetch_model_slices(addr, dst, FloatType.Q40, 2, {1}, quiet=True)
+        assert os.path.getsize(dst) == os.path.getsize(src)
+        assert os.path.exists(dst + ".slices")
+
+        _, want = load_model(src, weights_float_type=FloatType.Q40)
+        _, got = load_model(dst, weights_float_type=FloatType.Q40)
+        for name in ("tok_embedding", "rms_att", "rms_ffn", "rms_final"):
+            np.testing.assert_array_equal(got[name], want[name])
+        for tr in tensor_byte_ranges(spec):
+            if tr.rows is None or tr.layer not in (None, 0):
+                continue
+            w, g = want[tr.name], got[tr.name]
+            if tr.layer == 0:
+                w = type(w)(*(a[0] for a in w)) if hasattr(w, "qs") else w[0]
+                g = type(g)(*(a[0] for a in g)) if hasattr(g, "qs") else g[0]
+            half = tr.rows // 2
+            wq, gq = (w.qs, g.qs) if hasattr(w, "qs") else (w, g)
+            wd, gd = (w.d16, g.d16) if hasattr(w, "qs") else (None, None)
+            np.testing.assert_array_equal(gq[half:], wq[half:])  # rank 1
+            assert not gq[:half].any()                           # rank 0 hole
+            if wd is not None:
+                np.testing.assert_array_equal(gd[half:], wd[half:])
+                assert not gd[:half].any()
+
+        # cache hit: same ranks fetch nothing (mtime untouched)
+        before = os.path.getmtime(dst)
+        fetch_model_slices(addr, dst, FloatType.Q40, 2, {1}, quiet=True)
+        assert os.path.getmtime(dst) == before
+        # top-up: adding rank 0 completes the file byte-for-byte
+        fetch_model_slices(addr, dst, FloatType.Q40, 2, {0, 1}, quiet=True)
+        assert open(dst, "rb").read() == open(src, "rb").read()
+    finally:
+        server.close()
+
+
+def test_sparse_file_never_mistaken_for_full(tmp_path):
+    """Crash-safety of the slice cache protocol (review findings): (1) a
+    fetch killed before any range lands must leave a sidecar claiming ZERO
+    ranges — never a right-sized holey file that reads as a full cache;
+    (2) fetch_model must refuse a sparse file as a whole-file cache hit and
+    repair it (deleting the sidecar)."""
+    from distributed_llama_tpu.io.stream import fetch_model_slices
+    from distributed_llama_tpu.ops.quants import FloatType
+
+    spec = _tiny_spec()
+    src = str(tmp_path / "model.bin")
+    _write_tiny_model(src, spec)
+    server = WeightServer(src, host="127.0.0.1")
+    try:
+        addr = f"127.0.0.1:{server.port}"
+        dst = str(tmp_path / "w" / "model.bin")
+
+        # simulate the killed-fresh-fetch residue: full-size zero file +
+        # the empty sidecar the fetch writes BEFORE its first byte
+        os.makedirs(os.path.dirname(dst))
+        with open(dst, "wb") as fh:
+            fh.truncate(os.path.getsize(src))
+        import json
+
+        with open(dst + ".slices", "w") as fh:
+            json.dump({"size": os.path.getsize(src), "ranges": []}, fh)
+
+        # slice fetch does NOT trust the holes: it re-fetches its ranges
+        fetch_model_slices(addr, dst, FloatType.Q40, 2, {0}, quiet=True)
+        with open(dst + ".slices") as fh:
+            assert json.load(fh)["ranges"]  # real ranges recorded now
+
+        # whole-file fetch refuses the sparse file as a hit: repairs to a
+        # byte-identical full file and drops the sidecar
+        fetch_model(addr, dst, quiet=True)
+        assert open(dst, "rb").read() == open(src, "rb").read()
+        assert not os.path.exists(dst + ".slices")
+    finally:
+        server.close()

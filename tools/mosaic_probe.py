@@ -1,0 +1,255 @@
+"""Mosaic capability probes for the per-layer megakernel (VERDICT r2 #2).
+
+Fusing a whole layer into one pallas_call requires moving an intermediate
+VECTOR between matvec stages INSIDE the kernel. The matvec bodies consume
+inputs in a plane-split layout (xlo/xhi (NJ, nb) — value 32b+j at plane j,
+position b; ops/pallas_q40._split_x builds it with XLA reshape+transpose
+OUTSIDE the kernel today), so the question is which in-kernel relayout
+primitives Mosaic actually compiles on this chip. Each probe is one tiny
+pallas_call; the driver prints ok/FAIL per probe. Results are recorded in
+BASELINE.md (megakernel experiment section).
+
+Run: PYTHONPATH=/root/repo:/root/.axon_site python tools/mosaic_probe.py
+"""
+
+import functools
+import sys
+import traceback
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+
+def probe(name):
+    def deco(fn):
+        PROBES.append((name, fn))
+        return fn
+    return deco
+
+
+PROBES = []
+
+
+@probe("reshape (1,4096)->(128,32): lanes split to sublanes x lanes")
+def p_reshape_split():
+    def k(x_ref, o_ref):
+        o_ref[...] = x_ref[...].reshape(128, 32)
+
+    x = jnp.arange(4096, dtype=jnp.float32).reshape(1, 4096)
+    out = pl.pallas_call(
+        k, out_shape=jax.ShapeDtypeStruct((128, 32), jnp.float32))(x)
+    np.testing.assert_array_equal(np.asarray(out),
+                                  np.arange(4096, dtype=np.float32)
+                                  .reshape(128, 32))
+
+
+@probe("transpose 2d (128,32)->(32,128)")
+def p_transpose():
+    def k(x_ref, o_ref):
+        o_ref[...] = x_ref[...].T
+
+    x = jnp.arange(4096, dtype=jnp.float32).reshape(128, 32)
+    out = pl.pallas_call(
+        k, out_shape=jax.ShapeDtypeStruct((32, 128), jnp.float32))(x)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(x).T)
+
+
+@probe("reshape+transpose chain (1,4096)->(32,128) [the full _split_x]")
+def p_split_x_in_kernel():
+    def k(x_ref, o_ref):
+        o_ref[...] = x_ref[...].reshape(128, 32).T
+
+    x = jnp.arange(4096, dtype=jnp.float32).reshape(1, 4096)
+    out = pl.pallas_call(
+        k, out_shape=jax.ShapeDtypeStruct((32, 128), jnp.float32))(x)
+    np.testing.assert_array_equal(np.asarray(out),
+                                  np.arange(4096, dtype=np.float32)
+                                  .reshape(128, 32).T)
+
+
+@probe("reshape (256,1)->(8,32) [sublanes to sublanes x lanes]")
+def p_reshape_sublanes():
+    def k(x_ref, o_ref):
+        o_ref[...] = x_ref[...].reshape(8, 32)
+
+    x = jnp.arange(256, dtype=jnp.float32).reshape(256, 1)
+    out = pl.pallas_call(
+        k, out_shape=jax.ShapeDtypeStruct((8, 32), jnp.float32))(x)
+    np.testing.assert_array_equal(np.asarray(out),
+                                  np.arange(256, dtype=np.float32)
+                                  .reshape(8, 32))
+
+
+@probe("strided lane gather x[0, j::32] (deinterleave)")
+def p_strided():
+    def k(x_ref, o_ref):
+        o_ref[...] = x_ref[0, 3::32][None]
+
+    x = jnp.arange(4096, dtype=jnp.float32).reshape(1, 4096)
+    out = pl.pallas_call(
+        k, out_shape=jax.ShapeDtypeStruct((1, 128), jnp.float32))(x)
+    np.testing.assert_array_equal(np.asarray(out)[0],
+                                  np.arange(4096, dtype=np.float32)[3::32])
+
+
+@probe("dynamic lane store into scratch ref[:, pl.ds(i,1)]")
+def p_dyn_lane_store():
+    import jax.experimental.pallas.tpu as pltpu
+
+    def k(x_ref, o_ref, scratch):
+        i = pl.program_id(0)
+        scratch[:, pl.ds(i, 1)] = x_ref[...] * 2.0
+        @pl.when(i == 7)
+        def _():
+            o_ref[...] = scratch[...]
+
+    x = jnp.arange(32 * 8, dtype=jnp.float32).reshape(32, 8)
+    out = pl.pallas_call(
+        k, grid=(8,),
+        in_specs=[pl.BlockSpec((32, 1), lambda i: (0, i))],
+        out_specs=pl.BlockSpec((32, 8), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((32, 8), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((32, 8), jnp.float32)])(x)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(x) * 2.0)
+
+
+@probe("persistent VMEM scratch accumulation across grid steps")
+def p_scratch_accum():
+    import jax.experimental.pallas.tpu as pltpu
+
+    def k(x_ref, o_ref, acc):
+        i = pl.program_id(0)
+        @pl.when(i == 0)
+        def _():
+            acc[...] = jnp.zeros_like(acc)
+        acc[...] += x_ref[...]
+        @pl.when(i == 3)
+        def _():
+            o_ref[...] = acc[...]
+
+    x = jnp.arange(4 * 8 * 128, dtype=jnp.float32).reshape(4 * 8, 128)
+    out = pl.pallas_call(
+        k, grid=(4,),
+        in_specs=[pl.BlockSpec((8, 128), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((8, 128), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((8, 128), jnp.float32)])(x)
+    want = np.asarray(x).reshape(4, 8, 128).sum(0)
+    np.testing.assert_array_equal(np.asarray(out), want)
+
+
+@probe("phased grid: two inputs, index maps freeze across phases")
+def p_phased():
+    # grid 8 = 4 steps of phase A (input a advances) + 4 of phase B (b
+    # advances); a's map clamps in phase B and vice versa — the megakernel's
+    # multi-weight streaming pattern
+    import jax.experimental.pallas.tpu as pltpu
+
+    def k(a_ref, b_ref, o_ref, acc):
+        i = pl.program_id(0)
+        @pl.when(i == 0)
+        def _():
+            acc[...] = jnp.zeros_like(acc)
+        @pl.when(i < 4)
+        def _():
+            acc[...] += a_ref[...]
+        @pl.when(i >= 4)
+        def _():
+            acc[...] += b_ref[...] * 10.0
+        @pl.when(i == 7)
+        def _():
+            o_ref[...] = acc[...]
+
+    a = jnp.arange(4 * 8 * 128, dtype=jnp.float32).reshape(32, 128)
+    b = jnp.arange(4 * 8 * 128, dtype=jnp.float32).reshape(32, 128) + 1.0
+    out = pl.pallas_call(
+        k, grid=(8,),
+        in_specs=[
+            pl.BlockSpec((8, 128), lambda i: (jnp.minimum(i, 3), 0)),
+            pl.BlockSpec((8, 128),
+                         lambda i: (jnp.clip(i - 4, 0, 3), 0)),
+        ],
+        out_specs=pl.BlockSpec((8, 128), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((8, 128), jnp.float32)])(a, b)
+    aa, bb = np.asarray(a), np.asarray(b)
+    want = (aa.reshape(4, 8, 128).sum(0)
+            + 10.0 * bb.reshape(4, 8, 128).sum(0))
+    np.testing.assert_array_equal(np.asarray(out), want)
+
+
+@probe("sublane-range slice of scratch (plane extraction)")
+def p_sublane_slice():
+    import jax.experimental.pallas.tpu as pltpu
+
+    def k(x_ref, o_ref, scratch):
+        scratch[...] = x_ref[...]
+        # 16 static sublane slices summed — the plane-consume pattern
+        acc = jnp.zeros((8, 128), jnp.float32)
+        for j in range(16):
+            acc = acc + scratch[j * 8:(j + 1) * 8, :]
+        o_ref[...] = acc
+
+    x = jnp.arange(128 * 128, dtype=jnp.float32).reshape(128, 128)
+    out = pl.pallas_call(
+        k,
+        out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((128, 128), jnp.float32)])(x)
+    want = np.asarray(x).reshape(16, 8, 128).sum(0)
+    np.testing.assert_array_equal(np.asarray(out), want)
+
+
+@probe("iota + pow/exp/sin/cos on lanes (in-kernel RoPE angles)")
+def p_rope_math():
+    def k(o_ref):
+        b = jax.lax.broadcasted_iota(jnp.float32, (1, 128), 1)
+        freq = jnp.exp(b * (-0.1))
+        o_ref[...] = jnp.sin(freq * 7.0) + jnp.cos(freq * 3.0)
+
+    out = pl.pallas_call(
+        k, out_shape=jax.ShapeDtypeStruct((1, 128), jnp.float32))()
+    b = np.arange(128, dtype=np.float32)
+    want = np.sin(np.exp(b * -0.1) * 7.0) + np.cos(np.exp(b * -0.1) * 3.0)
+    np.testing.assert_allclose(np.asarray(out)[0], want, rtol=2e-5)
+
+
+@probe("uint8 nibble unpack + f32 convert in same kernel as MXU dot")
+def p_unpack_plus_dot():
+    def k(q_ref, x_ref, o_ref):
+        q = q_ref[...].astype(jnp.int32)
+        w = ((q & 0xF) - 8).astype(jnp.float32)
+        o_ref[...] = jax.lax.dot_general(
+            x_ref[...], w, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    q = jnp.arange(128 * 128, dtype=jnp.uint8).reshape(128, 128)
+    x = jnp.ones((8, 128), jnp.float32)
+    out = pl.pallas_call(
+        k, out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32))(q, x)
+    w = ((np.arange(128 * 128, dtype=np.int64).reshape(128, 128) & 0xF) - 8)
+    np.testing.assert_array_equal(np.asarray(out),
+                                  np.ones((8, 128)) @ w.T.astype(np.float32))
+
+
+def main():
+    dev = jax.devices()[0]
+    print(f"backend: {dev.platform} ({dev})", file=sys.stderr)
+    ok = fail = 0
+    for name, fn in PROBES:
+        try:
+            fn()
+            print(f"ok    {name}")
+            ok += 1
+        except Exception as e:
+            msg = str(e).split("\n")[0][:140]
+            print(f"FAIL  {name}\n      {type(e).__name__}: {msg}")
+            if "--trace" in sys.argv:
+                traceback.print_exc()
+            fail += 1
+    print(f"{ok} ok, {fail} failed")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,237 @@
+"""Mosaic probes, round 2: the exact primitives the megakernel design uses.
+
+Design under test (see tools/mosaic_probe.py for round 1): intermediate
+vectors ride in COLUMN form (d, 1); each matvec phase accumulates row tiles
+into a (d, 1) scratch at dynamic SUBLANE offsets; a phase-end conversion
+reshapes (d, 1) -> (d/32, 32) -> transpose -> (32, d/32) planes for the
+next matvec; the final residual transposes (R, 1) -> (1, R).
+
+Run: PYTHONPATH=/root/repo:/root/.axon_site python tools/mosaic_probe2.py
+"""
+
+import sys
+import traceback
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+PROBES = []
+
+
+def probe(name):
+    def deco(fn):
+        PROBES.append((name, fn))
+        return fn
+    return deco
+
+
+@probe("dynamic sublane store scratch[pl.ds(i*256,256), :] = (256,1) tile")
+def p_dyn_sublane_store():
+    def k(x_ref, o_ref, scratch):
+        i = pl.program_id(0)
+        scratch[pl.ds(i * 256, 256), :] = x_ref[...] * 2.0
+        @pl.when(i == 3)
+        def _():
+            o_ref[...] = scratch[...]
+
+    x = jnp.arange(1024, dtype=jnp.float32).reshape(1024, 1)
+    out = pl.pallas_call(
+        k, grid=(4,),
+        in_specs=[pl.BlockSpec((256, 1), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((1024, 1), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((1024, 1), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((1024, 1), jnp.float32)])(x)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(x) * 2.0)
+
+
+@probe("reshape (11008,1)->(344,32) + transpose -> (32,344)")
+def p_convert_hidden():
+    def k(x_ref, o_ref):
+        o_ref[...] = x_ref[...].reshape(344, 32).T
+
+    x = jnp.arange(11008, dtype=jnp.float32).reshape(11008, 1)
+    out = pl.pallas_call(
+        k, out_shape=jax.ShapeDtypeStruct((32, 344), jnp.float32))(x)
+    np.testing.assert_array_equal(
+        np.asarray(out), np.arange(11008, dtype=np.float32)
+        .reshape(344, 32).T)
+
+
+@probe("reshape (4096,1)->(128,32) + transpose -> (32,128)")
+def p_convert_dim():
+    def k(x_ref, o_ref):
+        o_ref[...] = x_ref[...].reshape(128, 32).T
+
+    x = jnp.arange(4096, dtype=jnp.float32).reshape(4096, 1)
+    out = pl.pallas_call(
+        k, out_shape=jax.ShapeDtypeStruct((32, 128), jnp.float32))(x)
+    np.testing.assert_array_equal(
+        np.asarray(out), np.arange(4096, dtype=np.float32)
+        .reshape(128, 32).T)
+
+
+@probe("transpose (512,1)->(1,512) [column to row]")
+def p_col_to_row():
+    def k(x_ref, o_ref):
+        o_ref[...] = x_ref[...].T
+
+    x = jnp.arange(512, dtype=jnp.float32).reshape(512, 1)
+    out = pl.pallas_call(
+        k, out_shape=jax.ShapeDtypeStruct((1, 512), jnp.float32))(x)
+    np.testing.assert_array_equal(np.asarray(out)[0],
+                                  np.arange(512, dtype=np.float32))
+
+
+@probe("matvec body vs plane scratch: acc over 16 plane slices of (32,nb)")
+def p_plane_consume():
+    # the d-major matvec body reading xlo/xhi as sublane slices of one
+    # (32, nb) planes scratch instead of separate (NJ, 1, nb) inputs
+    def k(q_ref, planes_ref, o_ref):
+        acc = None
+        for j in range(16):
+            q = q_ref[j].astype(jnp.int32)
+            wlo = (q & 0xF).astype(jnp.float32)
+            whi = (q >> 4).astype(jnp.float32)
+            a = (wlo * planes_ref[j:j + 1, :]
+                 + whi * planes_ref[j + 16:j + 17, :])
+            acc = a if acc is None else acc + a
+        o_ref[...] = jnp.sum(acc, axis=1, keepdims=True)
+
+    rng = np.random.default_rng(0)
+    q = rng.integers(0, 256, (16, 256, 128), dtype=np.uint8)
+    planes = rng.standard_normal((32, 128)).astype(np.float32)
+    out = pl.pallas_call(
+        k, out_shape=jax.ShapeDtypeStruct((256, 1), jnp.float32))(
+        jnp.asarray(q), jnp.asarray(planes))
+    qi = q.astype(np.int64)
+    want = ((qi & 0xF) * planes[:16][:, None, :]
+            + (qi >> 4) * planes[16:][:, None, :]).sum(axis=(0, 2))
+    np.testing.assert_allclose(np.asarray(out)[:, 0], want, rtol=1e-5)
+
+
+@probe("silu + elementwise mul on (256,1) columns")
+def p_silu():
+    def k(a_ref, b_ref, o_ref):
+        a = a_ref[...]
+        o_ref[...] = a / (1.0 + jnp.exp(-a)) * b_ref[...]
+
+    a = jnp.linspace(-3, 3, 256, dtype=jnp.float32).reshape(256, 1)
+    b = jnp.linspace(1, 2, 256, dtype=jnp.float32).reshape(256, 1)
+    out = pl.pallas_call(
+        k, out_shape=jax.ShapeDtypeStruct((256, 1), jnp.float32))(a, b)
+    aa, bb = np.asarray(a), np.asarray(b)
+    np.testing.assert_allclose(np.asarray(out),
+                               aa / (1 + np.exp(-aa)) * bb, rtol=1e-6)
+
+
+@probe("rsqrt reduction over (32,128) planes (in-kernel rmsnorm scale)")
+def p_rms():
+    def k(x_ref, o_ref):
+        ss = jnp.sum(x_ref[...] * x_ref[...]) / 4096.0 + 1e-5
+        o_ref[...] = x_ref[...] * jax.lax.rsqrt(ss)
+
+    x = jnp.arange(4096, dtype=jnp.float32).reshape(32, 128) / 4096.0
+    out = pl.pallas_call(
+        k, out_shape=jax.ShapeDtypeStruct((32, 128), jnp.float32))(x)
+    xx = np.asarray(x)
+    want = xx / np.sqrt((xx * xx).sum() / 4096.0 + 1e-5)
+    np.testing.assert_allclose(np.asarray(out), want, rtol=1e-5)
+
+
+@probe("iota (8,128) on lanes (RoPE angle construction)")
+def p_iota8():
+    def k(o_ref):
+        o_ref[...] = jax.lax.broadcasted_iota(jnp.float32, (8, 128), 1)
+
+    out = pl.pallas_call(
+        k, out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32))()
+    np.testing.assert_array_equal(np.asarray(out),
+                                  np.tile(np.arange(128.0), (8, 1)))
+
+
+@probe("cos/sin of scalar*array (SMEM scalar via PrefetchScalarGridSpec)")
+def p_pos_trig():
+    def k(pos_ref, f_ref, o_ref):
+        ang = pos_ref[0].astype(jnp.float32) * f_ref[...]
+        o_ref[...] = jnp.cos(ang) + jnp.sin(ang)
+
+    f = jnp.linspace(0, 1, 128, dtype=jnp.float32).reshape(1, 128)
+    gs = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1, grid=(1,),
+        in_specs=[pl.BlockSpec((1, 128), lambda i, p: (0, 0))],
+        out_specs=pl.BlockSpec((1, 128), lambda i, p: (0, 0)))
+    out = pl.pallas_call(
+        k, grid_spec=gs,
+        out_shape=jax.ShapeDtypeStruct((1, 128), jnp.float32))(
+        jnp.asarray([7], jnp.int32), f)
+    ff = np.asarray(f)
+    np.testing.assert_allclose(np.asarray(out), np.cos(7 * ff)
+                               + np.sin(7 * ff), rtol=1e-5, atol=1e-5)
+
+
+@probe("two weight tensors, phased maps, REAL 7B ffn tile sizes in VMEM")
+def p_vmem_budget():
+    # w13 tile (16, 512, 128) u8 = 1 MB + w2 tile (16, 512, 344) u8 =
+    # 2.8 MB, double-buffered ~7.6 MB + scales + scratch: the real VMEM
+    # question for the ffn megakernel
+    G1, G2 = 4, 2
+    R1, R2 = 512, 512
+    nb1, nb2 = 128, 344
+
+    def k(a_ref, b_ref, o_ref, acc):
+        i = pl.program_id(0)
+        @pl.when(i == 0)
+        def _():
+            acc[...] = jnp.zeros_like(acc)
+        @pl.when(i < G1)
+        def _():
+            acc[...] += jnp.sum(a_ref[...].astype(jnp.float32))
+        @pl.when(i >= G1)
+        def _():
+            acc[...] += jnp.sum(b_ref[...].astype(jnp.float32))
+        @pl.when(i == G1 + G2 - 1)
+        def _():
+            o_ref[...] = acc[...]
+
+    rng = np.random.default_rng(1)
+    a = jnp.asarray(rng.integers(0, 255, (16, G1 * R1, nb1), np.uint8))
+    b = jnp.asarray(rng.integers(0, 255, (16, G2 * R2, nb2), np.uint8))
+    out = pl.pallas_call(
+        k, grid=(G1 + G2,),
+        in_specs=[
+            pl.BlockSpec((16, R1, nb1),
+                         lambda i: (0, jnp.minimum(i, G1 - 1), 0)),
+            pl.BlockSpec((16, R2, nb2),
+                         lambda i: (0, jnp.clip(i - G1, 0, G2 - 1), 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((1, 1), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((1, 1), jnp.float32)])(a, b)
+    want = (np.asarray(a).astype(np.float64).sum()
+            + np.asarray(b).astype(np.float64).sum())
+    np.testing.assert_allclose(np.asarray(out)[0, 0], want, rtol=1e-6)
+
+
+def main():
+    dev = jax.devices()[0]
+    print(f"backend: {dev.platform} ({dev})", file=sys.stderr)
+    ok = fail = 0
+    for name, fn in PROBES:
+        try:
+            fn()
+            print(f"ok    {name}")
+            ok += 1
+        except Exception as e:
+            msg = str(e).split("\n")[0][:140]
+            print(f"FAIL  {name}\n      {type(e).__name__}: {msg}")
+            if "--trace" in sys.argv:
+                traceback.print_exc()
+            fail += 1
+    print(f"{ok} ok, {fail} failed")
+
+
+if __name__ == "__main__":
+    main()

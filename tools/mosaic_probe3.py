@@ -1,0 +1,139 @@
+"""Mosaic probes, round 3: rerun round-2 failures with diagnostics/fixes.
+
+- plane-consume and silu failed with value mismatches: print the actual
+  error magnitude (tolerance artifact vs real miscompile).
+- uint8 -> float32 direct cast is unsupported: go through int32 (what the
+  production kernels already do) and re-check the VMEM-budget probe.
+- iota is broken on this toolchain: RoPE angles will ride a precomputed
+  input table instead (probe2 p_pos_trig already passed).
+
+Run: PYTHONPATH=/root/repo:/root/.axon_site python tools/mosaic_probe3.py
+"""
+
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+PROBES = []
+
+
+def probe(name):
+    def deco(fn):
+        PROBES.append((name, fn))
+        return fn
+    return deco
+
+
+@probe("plane-consume diag: report max |diff|")
+def p_plane_consume_diag():
+    def k(q_ref, planes_ref, o_ref):
+        acc = None
+        for j in range(16):
+            q = q_ref[j].astype(jnp.int32)
+            wlo = (q & 0xF).astype(jnp.float32)
+            whi = (q >> 4).astype(jnp.float32)
+            a = (wlo * planes_ref[j:j + 1, :]
+                 + whi * planes_ref[j + 16:j + 17, :])
+            acc = a if acc is None else acc + a
+        o_ref[...] = jnp.sum(acc, axis=1, keepdims=True)
+
+    rng = np.random.default_rng(0)
+    q = rng.integers(0, 256, (16, 256, 128), dtype=np.uint8)
+    planes = rng.standard_normal((32, 128)).astype(np.float32)
+    out = np.asarray(pl.pallas_call(
+        k, out_shape=jax.ShapeDtypeStruct((256, 1), jnp.float32))(
+        jnp.asarray(q), jnp.asarray(planes)))[:, 0]
+    qi = q.astype(np.int64)
+    want = ((qi & 0xF) * planes[:16][:, None, :].astype(np.float64)
+            + (qi >> 4) * planes[16:][:, None, :]).sum(axis=(0, 2))
+    err = np.abs(out - want)
+    rel = err / np.maximum(np.abs(want), 1e-3)
+    print(f"      max abs {err.max():.6f}  max rel {rel.max():.2e}  "
+          f"want range [{want.min():.1f}, {want.max():.1f}]")
+    assert rel.max() < 1e-3
+
+
+@probe("silu diag on (256,1): report max |diff|")
+def p_silu_diag():
+    def k(a_ref, b_ref, o_ref):
+        a = a_ref[...]
+        o_ref[...] = a / (1.0 + jnp.exp(-a)) * b_ref[...]
+
+    a = jnp.linspace(-3, 3, 256, dtype=jnp.float32).reshape(256, 1)
+    b = jnp.linspace(1, 2, 256, dtype=jnp.float32).reshape(256, 1)
+    out = np.asarray(pl.pallas_call(
+        k, out_shape=jax.ShapeDtypeStruct((256, 1), jnp.float32))(a, b))
+    aa, bb = np.asarray(a, np.float64), np.asarray(b, np.float64)
+    want = aa / (1 + np.exp(-aa)) * bb
+    err = np.abs(out - want).max()
+    print(f"      max abs err {err:.3e}")
+    assert err < 1e-4
+
+
+@probe("VMEM budget with int32-route casts (7B ffn tile sizes)")
+def p_vmem_budget_fixed():
+    G1, G2 = 4, 2
+    R1, R2 = 512, 512
+    nb1, nb2 = 128, 344
+
+    def k(a_ref, b_ref, o_ref, acc):
+        i = pl.program_id(0)
+        @pl.when(i == 0)
+        def _():
+            acc[...] = jnp.zeros_like(acc)
+        @pl.when(i < G1)
+        def _():
+            acc[...] += jnp.sum(
+                a_ref[...].astype(jnp.int32).astype(jnp.float32))
+        @pl.when(i >= G1)
+        def _():
+            acc[...] += jnp.sum(
+                b_ref[...].astype(jnp.int32).astype(jnp.float32))
+        @pl.when(i == G1 + G2 - 1)
+        def _():
+            o_ref[...] = acc[...]
+
+    rng = np.random.default_rng(1)
+    a = jnp.asarray(rng.integers(0, 255, (16, G1 * R1, nb1), np.uint8))
+    b = jnp.asarray(rng.integers(0, 255, (16, G2 * R2, nb2), np.uint8))
+    out = pl.pallas_call(
+        k, grid=(G1 + G2,),
+        in_specs=[
+            pl.BlockSpec((16, R1, nb1),
+                         lambda i: (0, jnp.minimum(i, G1 - 1), 0)),
+            pl.BlockSpec((16, R2, nb2),
+                         lambda i: (0, jnp.clip(i - G1, 0, G2 - 1), 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((1, 1), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((1, 1), jnp.float32)])(a, b)
+    want = (np.asarray(a).astype(np.float64).sum()
+            + np.asarray(b).astype(np.float64).sum())
+    got = float(np.asarray(out)[0, 0])
+    print(f"      got {got:.1f} want {want:.1f} rel "
+          f"{abs(got - want) / want:.2e}")
+    assert abs(got - want) / want < 1e-4
+
+
+def main():
+    dev = jax.devices()[0]
+    print(f"backend: {dev.platform} ({dev})", file=sys.stderr)
+    ok = fail = 0
+    for name, fn in PROBES:
+        try:
+            fn()
+            print(f"ok    {name}")
+            ok += 1
+        except Exception as e:
+            msg = str(e).split("\n")[0][:160]
+            print(f"FAIL  {name}\n      {type(e).__name__}: {msg}")
+            fail += 1
+    print(f"{ok} ok, {fail} failed")
+
+
+if __name__ == "__main__":
+    main()
