@@ -172,7 +172,8 @@ def make_decode_loop(step_fn: StepFn, max_steps: int, temperature: float,
 
 
 def make_decode_loop_aot(step_fn: StepFn, max_steps: int,
-                         temperature: float, topp: float):
+                         temperature: float, topp: float,
+                         exe_cache_dir: str | None = None):
     """make_decode_loop variant that AOT-compiles with the parameter layouts
     PINNED to what the placed arrays actually have, instead of letting the
     (tunnel-side) AOT compiler choose compact input layouts and convert
@@ -189,6 +190,14 @@ def make_decode_loop_aot(step_fn: StepFn, max_steps: int,
     FIRST, read each leaf's actual Format, and compile with exactly those —
     the executable accepts the arrays by construction, and any residual
     conversion is the compiler's explicit, visible choice.
+
+    ``exe_cache_dir`` (VERDICT r2 #7, sub-minute warm start): persist the
+    fully-compiled executable via jax.experimental.serialize_executable,
+    keyed by the sha256 of the LOWERED HLO (any code/shape/kernel change
+    re-keys cleanly) + jax version + platform. Unlike the persistent HLO
+    compile cache, the serialized executable also carries the compiled
+    custom-call artifacts, so a warm process skips the per-kernel
+    compile-service round-trips the first execution otherwise pays.
 
     Returns compile_and_place(params_host, cache, prompt, first, coins,
     start, n) -> (compiled, params_on_device).
@@ -209,10 +218,59 @@ def make_decode_loop_aot(step_fn: StepFn, max_steps: int,
                          in_shardings=(param_formats,) + (None,) * 6)
         abstract = (jax.tree_util.tree_map(sds, placed),
                     *(jax.tree_util.tree_map(sds, r) for r in rest))
-        compiled = jitted.lower(*abstract).compile()
+        lowered = jitted.lower(*abstract)
+        compiled = _load_or_compile(lowered, exe_cache_dir)
         return compiled, placed
 
     return compile_and_place
+
+
+def _load_or_compile(lowered, exe_cache_dir: str | None):
+    """Deserialize a cached executable for this exact lowering, else
+    compile and serialize it. Any failure in the serialization layer
+    degrades to a plain compile (never blocks the run)."""
+    if not exe_cache_dir:
+        return lowered.compile()
+    import hashlib
+    import os
+    import pickle
+    import sys
+
+    try:
+        # key on everything that could invalidate a compiled binary: jax +
+        # runtime lib versions, the CHIP KIND (default_backend() is just
+        # 'tpu' for every TPU generation), and the lowered HLO itself
+        dev = jax.devices()[0]
+        salt = (jax.__version__ + getattr(jax.lib, "__version__", "")
+                + jax.default_backend() + getattr(dev, "device_kind", ""))
+        key = hashlib.sha256(
+            (salt + lowered.as_text()).encode()).hexdigest()[:32]
+        path = os.path.join(exe_cache_dir, f"exe_{key}.pkl")
+        from jax.experimental.serialize_executable import (
+            deserialize_and_load, serialize)
+
+        if os.path.exists(path):
+            try:
+                with open(path, "rb") as fh:
+                    payload, in_tree, out_tree = pickle.load(fh)
+                compiled = deserialize_and_load(payload, in_tree, out_tree)
+                print(f"⏩ loaded serialized executable ({path})",
+                      file=sys.stderr)
+                return compiled
+            except Exception:
+                os.unlink(path)  # corrupt/stale entry: recompile fresh
+                raise
+        compiled = lowered.compile()
+        os.makedirs(exe_cache_dir, exist_ok=True)
+        tmp = path + f".tmp{os.getpid()}"
+        with open(tmp, "wb") as fh:
+            pickle.dump(serialize(compiled), fh)
+        os.replace(tmp, path)
+        return compiled
+    except Exception as e:  # noqa: BLE001 - cache must never kill the run
+        print(f"💡 executable cache unavailable "
+              f"({type(e).__name__}: {e}); compiling", file=sys.stderr)
+        return lowered.compile()
 
 
 def make_batch_decode_loop(spec, steps: int, temperature: float, topp: float,

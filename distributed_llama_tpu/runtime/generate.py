@@ -63,7 +63,7 @@ class Engine:
         else:
             from ..models.llama import params_to_device
 
-            self.params = params_to_device(params)
+            self.params = params_to_device(params, spec=spec)
             self.cache = init_cache(spec, self.cache_dtype)
             self._step_raw = functools.partial(forward, spec)
             self._fwd = jax.jit(self._step_raw, donate_argnums=1)
@@ -362,7 +362,7 @@ def generate_batch(spec: TransformerSpec, params: dict[str, Any],
         run = make_batch_decode_loop(spec, steps, temperature, topp,
                                      step_fn=step_fn)
     else:
-        dev_params = params_to_device(params)
+        dev_params = params_to_device(params)  # batch: T>1 paths, no mega prep
         cache0 = init_cache_batch(spec, B, dtype)
         run = make_batch_decode_loop(spec, steps, temperature, topp)
     t0 = time.perf_counter()

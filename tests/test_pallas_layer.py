@@ -1,0 +1,217 @@
+"""Fused per-layer kernel parity (ops/pallas_layer, VERDICT r2 #2).
+
+The fused head/tail kernels run in interpret mode here; the value map must
+match the unfused forward (same Q40 dequant math, same rmsnorm/silu/RoPE
+formulas) to float-associativity noise.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from distributed_llama_tpu.models.spec import TransformerSpec
+from distributed_llama_tpu.ops.quants import FloatType
+
+SPEC = TransformerSpec(dim=64, hidden_dim=96, n_layers=2, n_heads=4,
+                       n_kv_heads=2, vocab_size=128, seq_len=32,
+                       weights_float_type=FloatType.Q40)
+
+
+def _packed(spec, seed=11, with_spec=False):
+    from distributed_llama_tpu.models.llama import params_to_device
+    from distributed_llama_tpu.models.synth import synth_params
+
+    return params_to_device(synth_params(spec, q40=True, seed=seed,
+                                         scale=0.2),
+                            spec=spec if with_spec else None)
+
+
+# head_size=128 shapes (the megakernel's attention layout): MHA and GQA
+MEGA_MHA = TransformerSpec(dim=256, hidden_dim=96, n_layers=2, n_heads=2,
+                           n_kv_heads=2, vocab_size=64, seq_len=16,
+                           weights_float_type=FloatType.Q40)
+MEGA_GQA = TransformerSpec(dim=512, hidden_dim=160, n_layers=2, n_heads=4,
+                           n_kv_heads=2, vocab_size=64, seq_len=16,
+                           weights_float_type=FloatType.Q40)
+
+
+def _packed_d_major(spec, seed=11):
+    """Mega-kernel packing at TEST dims: tiny nb values make the auto
+    packer pick nb-major (its lane-padding heuristic), which excludes the
+    d-major-only mega path — 7B's nb=128 picks d-major naturally. Force
+    d-major + mega prep here."""
+    import jax
+
+    from distributed_llama_tpu.models.synth import synth_params
+    from distributed_llama_tpu.ops.linear import (fuse_q40_layer_matmuls,
+                                                  pack_q40_params)
+    from distributed_llama_tpu.ops.pallas_layer import prepare_mega_params
+
+    params = synth_params(spec, q40=True, seed=seed, scale=0.2)
+    params = fuse_q40_layer_matmuls(
+        pack_q40_params(params, enable=True, allow_nb_major=False))
+    params = prepare_mega_params(spec, params)
+    return jax.tree_util.tree_map(jnp.asarray, params)
+
+
+def test_supports_gating(monkeypatch):
+    from distributed_llama_tpu.ops import pallas_layer
+
+    monkeypatch.setenv("DLLAMA_Q40_KERNEL", "pallas")
+    params = _packed(SPEC)
+    assert pallas_layer.supports(SPEC, params)
+    # Q80 buffer mode is out of scope for the fused path
+    spec80 = TransformerSpec(**{**SPEC.__dict__,
+                                "buffer_float_type": FloatType.Q80})
+    assert not pallas_layer.supports(spec80, params)
+    # codec-layout (unpacked) weights: no fused path
+    monkeypatch.setenv("DLLAMA_Q40_KERNEL", "xla")
+    assert not pallas_layer.supports(SPEC, _packed(SPEC))
+
+
+@pytest.mark.parametrize("spec", [
+    SPEC,
+    # GQA shape (kv_mul=2) at a different head size
+    TransformerSpec(dim=128, hidden_dim=160, n_layers=2, n_heads=4,
+                    n_kv_heads=2, vocab_size=96, seq_len=16,
+                    weights_float_type=FloatType.Q40),
+])
+def test_fused_decode_matches_unfused(monkeypatch, spec):
+    """A multi-step greedy decode chain through the fused path must match
+    the unfused kernel path step for step (logits to float-assoc noise,
+    tokens exactly)."""
+    from distributed_llama_tpu.models.llama import forward, init_cache
+
+    monkeypatch.setenv("DLLAMA_Q40_KERNEL", "pallas")
+    params = _packed(spec)
+
+    def run(steps=5):
+        cache = init_cache(spec)
+        tok = jnp.asarray([3], jnp.int32)
+        logits_all, toks = [], []
+        for pos in range(steps):
+            logits, cache = forward(spec, params, cache, tok,
+                                    jnp.int32(pos))
+            logits_all.append(np.asarray(logits[0]))
+            tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            toks.append(int(tok[0]))
+        return np.stack(logits_all), toks
+
+    monkeypatch.setenv("DLLAMA_LAYER_FUSION", "off")
+    want, want_toks = run()
+    monkeypatch.setenv("DLLAMA_LAYER_FUSION", "on")
+    got, got_toks = run()
+    np.testing.assert_allclose(got, want, atol=5e-4, rtol=1e-4)
+    assert got_toks == want_toks
+
+
+def test_fused_after_prefill(monkeypatch):
+    """Prefill (T>1, unfused — fusion is T=1-only) then fused decode must
+    equal the fully unfused run: the two paths share one cache layout."""
+    from distributed_llama_tpu.models.llama import forward, init_cache
+
+    monkeypatch.setenv("DLLAMA_Q40_KERNEL", "pallas")
+    spec = SPEC
+    params = _packed(spec)
+    prompt = jnp.asarray([3, 7, 11], jnp.int32)
+
+    def run():
+        cache = init_cache(spec)
+        _, cache = forward(spec, params, cache, prompt, jnp.int32(0))
+        logits, cache = forward(spec, params, cache,
+                                jnp.asarray([5], jnp.int32), jnp.int32(3))
+        return np.asarray(logits[0])
+
+    monkeypatch.setenv("DLLAMA_LAYER_FUSION", "off")
+    want = run()
+    monkeypatch.setenv("DLLAMA_LAYER_FUSION", "on")
+    got = run()
+    np.testing.assert_allclose(got, want, atol=5e-4, rtol=1e-4)
+
+
+def test_fused_kernels_cut_op_count(monkeypatch):
+    """The point of the fusion: the per-layer program collapses to the two
+    fused pallas_calls (+ attention). Count custom_call/pallas eqns in the
+    jaxpr's scan body."""
+    from jaxpr_utils import walk_fn_eqns
+
+    from distributed_llama_tpu.models.llama import forward, init_cache
+
+    monkeypatch.setenv("DLLAMA_Q40_KERNEL", "pallas")
+    monkeypatch.setenv("DLLAMA_LAYER_FUSION", "on")
+    params = _packed(SPEC)
+    tok = jnp.asarray([3], jnp.int32)
+
+    import functools
+
+    fn = functools.partial(forward, SPEC)
+    names = [e.primitive.name
+             for e in walk_fn_eqns(fn, params, init_cache(SPEC), tok,
+                                   jnp.int32(0))]
+    # exactly two pallas_calls inside the scan body (head + tail; the
+    # interpret-mode attention fallback is XLA einsum here) + wcls matmul
+    assert names.count("pallas_call") >= 2
+
+
+@pytest.mark.parametrize("spec", [MEGA_MHA, MEGA_GQA])
+def test_mega_decode_matches_unfused(monkeypatch, spec):
+    """The whole-layer megakernel (1 pallas_call per layer, in-kernel
+    attention + cache write via aliased outputs) must match the unfused
+    path: logits per step AND the final cache content (which pins the
+    input_output_aliases indices and the (layer, pos) write placement)."""
+    from distributed_llama_tpu.models.llama import forward, init_cache
+    from distributed_llama_tpu.ops import pallas_layer
+
+    monkeypatch.setenv("DLLAMA_Q40_KERNEL", "pallas")
+    monkeypatch.setenv("DLLAMA_LAYER_FUSION", "on")
+    params = _packed_d_major(spec)
+    assert pallas_layer.mega_supported(spec, params), "mega prep missing"
+
+    def run(use):
+        monkeypatch.setenv("DLLAMA_LAYER_FUSION", use)
+        p = dict(params)
+        if use == "off":
+            p.pop("wo_mega", None)
+        cache = init_cache(spec)
+        tok = jnp.asarray([3], jnp.int32)
+        logits_all, toks = [], []
+        for pos in range(5):
+            logits, cache = forward(spec, p, cache, tok, jnp.int32(pos))
+            logits_all.append(np.asarray(logits[0]))
+            tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            toks.append(int(tok[0]))
+        return np.stack(logits_all), toks, cache
+
+    want, want_toks, want_cache = run("off")
+    got, got_toks, got_cache = run("on")
+    np.testing.assert_allclose(got, want, atol=5e-4, rtol=1e-4)
+    assert got_toks == want_toks
+    # K carries RoPE: the in-kernel cos/sin differ from XLA's by a few
+    # 1e-5 ABSOLUTE (different transcendental lowerings; relative error is
+    # unbounded near zero crossings); V is rotation-free
+    np.testing.assert_allclose(np.asarray(got_cache.k),
+                               np.asarray(want_cache.k), atol=1e-4)
+    # V inherits the in-kernel rmsnorm's reduction-order noise through the
+    # wqkv dot (~1e-7 relative on xb, amplified by the contraction)
+    np.testing.assert_allclose(np.asarray(got_cache.v),
+                               np.asarray(want_cache.v), atol=1e-4)
+
+
+def test_mega_one_op_per_layer(monkeypatch):
+    """The fused T=1 program must contain exactly ONE pallas_call (the
+    megakernel) in its layer scan body."""
+    import functools
+
+    from jaxpr_utils import walk_fn_eqns
+
+    from distributed_llama_tpu.models.llama import forward, init_cache
+
+    monkeypatch.setenv("DLLAMA_Q40_KERNEL", "pallas")
+    monkeypatch.setenv("DLLAMA_LAYER_FUSION", "on")
+    params = _packed_d_major(MEGA_MHA)
+    fn = functools.partial(forward, MEGA_MHA)
+    eqns = list(walk_fn_eqns(fn, params, init_cache(MEGA_MHA),
+                             jnp.asarray([3], jnp.int32), jnp.int32(0)))
+    # one megakernel inside the scan + the wcls matmul outside of it
+    assert [e.primitive.name for e in eqns].count("pallas_call") <= 2

@@ -1,0 +1,105 @@
+"""Mosaic probes, round 4: RoPE without the (n/2,2)->(n,1) merge reshape.
+
+The head-kernel compile failed on `tpu.reshape (2048x2) -> (4096x1)` —
+Mosaic supports the SPLIT direction only. Candidate fix: rotate interleaved
+pairs in place on the (n, 1) column with sublane rolls:
+
+  up[v] = seg[v+1], down[v] = seg[v-1]
+  rotated = seg*cos_ext + where(even(v), -up*sin_ext, down*sin_ext)
+
+with cos/sin built from a per-VALUE frequency column and the parity mask
+passed as constant inputs (in-kernel iota is broken on this toolchain).
+
+Run: PYTHONPATH=/root/repo:/root/.axon_site python tools/mosaic_probe4.py
+"""
+
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+PROBES = []
+
+
+def probe(name):
+    def deco(fn):
+        PROBES.append((name, fn))
+        return fn
+    return deco
+
+
+@probe("pltpu.roll on sublanes of (4096,1)")
+def p_roll():
+    def k(x_ref, o_ref):
+        o_ref[...] = pltpu.roll(x_ref[...], 1, 0)  # down: o[v] = x[v-1]
+
+    x = jnp.arange(4096, dtype=jnp.float32).reshape(4096, 1)
+    out = pl.pallas_call(
+        k, out_shape=jax.ShapeDtypeStruct((4096, 1), jnp.float32))(x)
+    np.testing.assert_array_equal(np.asarray(out)[:, 0],
+                                  np.roll(np.arange(4096.0), 1))
+
+
+@probe("full in-place RoPE on (4096,1) via rolls + parity mask")
+def p_rope_rolls():
+    hs = 128
+
+    def k(pos_ref, x_ref, freq_ref, even_ref, o_ref):
+        pos = pos_ref[0].astype(jnp.float32)
+        seg = x_ref[...]
+        ang = pos * freq_ref[...]
+        c, s = jnp.cos(ang), jnp.sin(ang)
+        up = pltpu.roll(seg, seg.shape[0] - 1, 0)  # up[v] = seg[v+1]
+        down = pltpu.roll(seg, 1, 0)   # down[v] = seg[v-1]
+        even = even_ref[...]
+        o_ref[...] = seg * c + (-up * s) * even + down * s * (1.0 - even)
+
+    n = 4096
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((n, 1)).astype(np.float32)
+    i = np.arange(0, n, 2, dtype=np.float32)
+    freq_pair = 1.0 / np.power(np.float32(10000.0), (i % hs) / hs)
+    freq_ext = np.repeat(freq_pair, 2).reshape(n, 1).astype(np.float32)
+    even = (np.arange(n) % 2 == 0).astype(np.float32).reshape(n, 1)
+    pos = 7
+
+    gs = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1, grid=(1,),
+        in_specs=[pl.BlockSpec((n, 1), lambda g, p: (0, 0))] * 3,
+        out_specs=pl.BlockSpec((n, 1), lambda g, p: (0, 0)))
+    out = pl.pallas_call(
+        k, grid_spec=gs,
+        out_shape=jax.ShapeDtypeStruct((n, 1), jnp.float32))(
+        jnp.asarray([pos], jnp.int32), jnp.asarray(x),
+        jnp.asarray(freq_ext), jnp.asarray(even))
+
+    # reference: interleaved-pair rotation (models/llama.rope_rotate)
+    pair = x[:, 0].reshape(-1, 2)
+    ang = pos * freq_pair
+    c, s = np.cos(ang), np.sin(ang)
+    want = np.stack([pair[:, 0] * c - pair[:, 1] * s,
+                     pair[:, 0] * s + pair[:, 1] * c], axis=1).reshape(n)
+    np.testing.assert_allclose(np.asarray(out)[:, 0], want, rtol=2e-5,
+                               atol=2e-5)
+
+
+def main():
+    print(f"backend: {jax.devices()[0]}", file=sys.stderr)
+    ok = fail = 0
+    for name, fn in PROBES:
+        try:
+            fn()
+            print(f"ok    {name}")
+            ok += 1
+        except Exception as e:
+            msg = str(e).split("\n")[0][:160]
+            print(f"FAIL  {name}\n      {type(e).__name__}: {msg}")
+            fail += 1
+    print(f"{ok} ok, {fail} failed")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,73 @@
+"""Probe: can this runtime serialize/deserialize compiled executables?
+
+VERDICT r2 #7 (sub-minute warm start) hinges on skipping BOTH the XLA
+compile (already covered by the persistent cache) and whatever the first
+execution pays that the cache does not cover (on the tunneled runtime the
+round-2 warm numbers showed 6 s compile + ~100 s first chain — suspected
+Mosaic/remote-compile work at first execute). jax.experimental.
+serialize_executable captures the fully compiled PjRt executable; if the
+axon PJRT plugin supports it, a warm process can deserialize and run
+without any compile service round-trips.
+
+Run: PYTHONPATH=/root/repo:/root/.axon_site python tools/warmstart_probe.py
+"""
+
+import pickle
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main():
+    print(f"backend: {jax.devices()[0]}", file=sys.stderr)
+
+    from distributed_llama_tpu.ops.pallas_q40 import q40_matmul
+    from distributed_llama_tpu.io.loader import Q40Kernel
+
+    rng = np.random.default_rng(0)
+    qs = rng.integers(0, 256, (16, 256, 8), dtype=np.uint8)
+    sc = (rng.random((256, 8), dtype=np.float32) * 0.01)
+    x = rng.standard_normal((1, 256)).astype(np.float32)
+
+    def fn(qs, sc, x):
+        return q40_matmul(Q40Kernel(qs, sc), x)
+
+    t0 = time.perf_counter()
+    jitted = jax.jit(fn)
+    lowered = jitted.lower(jax.ShapeDtypeStruct(qs.shape, jnp.uint8),
+                           jax.ShapeDtypeStruct(sc.shape, jnp.float32),
+                           jax.ShapeDtypeStruct(x.shape, jnp.float32))
+    compiled = lowered.compile()
+    print(f"compile: {time.perf_counter() - t0:.1f}s")
+
+    want = np.asarray(compiled(jnp.asarray(qs), jnp.asarray(sc),
+                               jnp.asarray(x)))
+
+    try:
+        from jax.experimental.serialize_executable import (
+            deserialize_and_load, serialize)
+    except ImportError as e:
+        print(f"serialize_executable unavailable: {e}")
+        return 1
+    t0 = time.perf_counter()
+    payload, in_tree, out_tree = serialize(compiled)
+    blob = pickle.dumps((payload, in_tree, out_tree))
+    print(f"serialize: {time.perf_counter() - t0:.2f}s, "
+          f"{len(blob)} bytes")
+
+    t0 = time.perf_counter()
+    payload2, it2, ot2 = pickle.loads(blob)
+    reloaded = deserialize_and_load(payload2, it2, ot2)
+    got = np.asarray(reloaded(jnp.asarray(qs), jnp.asarray(sc),
+                              jnp.asarray(x)))
+    print(f"deserialize+run: {time.perf_counter() - t0:.2f}s")
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+    print("serialize/deserialize round trip OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
