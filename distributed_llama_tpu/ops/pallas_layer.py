@@ -333,6 +333,23 @@ def q40_tail_fused(spec, wo: Q40Kernel, w13: Q40Kernel, w2: Q40Kernel,
 # ---------------------------------------------------------------------------
 
 
+def _rope_rot(seg, posf, freq, even):
+    """Interleaved-pair RoPE rotation on a column segment, via sublane
+    rolls + a parity mask: Mosaic cannot merge (n/2, 2) back to (n, 1)
+    (unsupported shape cast — the failed first design,
+    tools/mosaic_probe4.py), so
+      even v: seg[v]*cos - seg[v+1]*sin   (up-roll partner)
+      odd  v: seg[v-1]*sin + seg[v]*cos   (down-roll partner)
+    cos/sin come from a per-VALUE frequency column (in-kernel iota is
+    broken on this toolchain); the roll wrap-around contributions are
+    killed by the mask. Shared by the head kernel and the megakernel."""
+    ang = posf * freq
+    c, s = jnp.cos(ang), jnp.sin(ang)
+    up = pltpu.roll(seg, seg.shape[0] - 1, 0)   # up[v] = seg[v+1]
+    down = pltpu.roll(seg, 1, 0)                # down[v] = seg[v-1]
+    return seg * c + (-up * s) * even + down * s * (1.0 - even)
+
+
 def _head_kernel(dims, sref, qkv_qs, qkv_s, x_col, watt_col, freq_col,
                  even_col, out_ref, planes, xsum, qkv):
     dim, kv_dim, dqkv, r_qkv = dims
@@ -351,28 +368,12 @@ def _head_kernel(dims, sref, qkv_qs, qkv_s, x_col, watt_col, freq_col,
 
     @pl.when(i == g - 1)
     def _():
-        # RoPE on the q and k segments, IN interleaved column form: Mosaic
-        # cannot merge (n/2, 2) back to (n, 1) (unsupported shape cast —
-        # the failed first design, tools/mosaic_probe4.py), so the pair
-        # rotation runs via sublane rolls + a parity mask instead:
-        #   even v: seg[v]*cos - seg[v+1]*sin   (up-roll partner)
-        #   odd  v: seg[v-1]*sin + seg[v]*cos   (down-roll partner)
-        # cos/sin come from a per-VALUE frequency column (in-kernel iota is
-        # broken on this toolchain); the roll wrap-around contributions are
-        # killed by the mask. pos arrives via SMEM scalar prefetch.
+        # RoPE via _rope_rot; pos arrives via SMEM scalar prefetch.
         pos = sref[1].astype(jnp.float32)
-
-        def rot(seg, freq, even):
-            ang = pos * freq
-            c, s = jnp.cos(ang), jnp.sin(ang)
-            up = pltpu.roll(seg, seg.shape[0] - 1, 0)   # up[v] = seg[v+1]
-            down = pltpu.roll(seg, 1, 0)                # down[v] = seg[v-1]
-            return seg * c + (-up * s) * even + down * s * (1.0 - even)
-
-        q = rot(qkv[pl.ds(0, dim), :], freq_col[0:dim, :],
-                even_col[0:dim, :])
-        k = rot(qkv[pl.ds(dim, kv_dim), :], freq_col[0:kv_dim, :],
-                even_col[0:kv_dim, :])
+        q = _rope_rot(qkv[pl.ds(0, dim), :], pos, freq_col[0:dim, :],
+                      even_col[0:dim, :])
+        k = _rope_rot(qkv[pl.ds(dim, kv_dim), :], pos,
+                      freq_col[0:kv_dim, :], even_col[0:kv_dim, :])
         out_ref[pl.ds(0, dim), :] = q
         out_ref[pl.ds(dim, kv_dim), :] = k
         out_ref[pl.ds(dim + kv_dim, kv_dim), :] = qkv[
@@ -458,7 +459,7 @@ def _mega_kernel(cfg, sref, qkv_qs, qkv_s, wo_qs, wo_s, w1_qs, w1_s,
                  planes, xsum, planes_h, xsum_h, qkv, xnew, hb,
                  k_buf, v_buf, kv_wr, sems, wsem):
     (dim, kv_dim, hid, n_kv, kv_mul, hs, chunk,
-     r_qkv, r_wo, r_13, r_w2) = cfg
+     r_qkv, r_wo, r_13, r_w2, skip) = cfg
     dqkv = dim + 2 * kv_dim
     g_qkv = dqkv // r_qkv
     att = g_qkv            # the dedicated attention step
@@ -469,9 +470,12 @@ def _mega_kernel(cfg, sref, qkv_qs, qkv_s, wo_qs, wo_s, w1_qs, w1_s,
     i = pl.program_id(0)
     layer = sref[0]
     pos = sref[1]
-    # trace-time bisection knob: skip named phase BODIES (DMA still streams
-    # — index maps drive it — so compute cost isolates from DMA cost)
-    _skip = set(os.environ.get("DLLAMA_MEGA_SKIP", "").split(","))
+    # bisection knob (DLLAMA_MEGA_SKIP): skip named phase BODIES — DMA
+    # still streams (index maps drive it), so compute cost isolates from
+    # DMA cost. Threaded through cfg (a STATIC jit arg read in
+    # q40_layer_mega) so changing the env between calls re-traces instead
+    # of silently reusing the previous kernel.
+    _skip = set(skip.split(","))
 
     # ---- phase 1: rms_att -> wqkv tiles -> (last step) RoPE ---------------
     if "qkv" not in _skip:
@@ -490,18 +494,11 @@ def _mega_kernel(cfg, sref, qkv_qs, qkv_s, wo_qs, wo_s, w1_qs, w1_s,
     @pl.when(jnp.logical_and(i == g_qkv - 1, "rope" not in _skip))
     def _():
         posf = pos.astype(jnp.float32)
-
-        def rot(seg, freq, even):
-            ang = posf * freq
-            c, s = jnp.cos(ang), jnp.sin(ang)
-            up = pltpu.roll(seg, seg.shape[0] - 1, 0)
-            down = pltpu.roll(seg, 1, 0)
-            return seg * c + (-up * s) * even + down * s * (1.0 - even)
-
-        qkv[pl.ds(0, dim), :] = rot(qkv[pl.ds(0, dim), :],
-                                    freq_col[0:dim, :], even_col[0:dim, :])
-        kseg = rot(qkv[pl.ds(dim, kv_dim), :], freq_col[0:kv_dim, :],
-                   even_col[0:kv_dim, :])
+        qkv[pl.ds(0, dim), :] = _rope_rot(qkv[pl.ds(0, dim), :], posf,
+                                          freq_col[0:dim, :],
+                                          even_col[0:dim, :])
+        kseg = _rope_rot(qkv[pl.ds(dim, kv_dim), :], posf,
+                         freq_col[0:kv_dim, :], even_col[0:kv_dim, :])
         qkv[pl.ds(dim, kv_dim), :] = kseg
         # stage the new K/V rows in cache layout and LAUNCH the cache
         # writes now — they land while the attention walk below runs
@@ -603,12 +600,17 @@ def _mega_kernel(cfg, sref, qkv_qs, qkv_s, wo_qs, wo_s, w1_qs, w1_s,
         p = _ao_to_planes(ao, n_heads, hs)        # sigma-permuted planes
         planes[...] = p
         xsum[...] = jnp.sum(p, axis=0, keepdims=True)
-        # cache writes must land before the kernel ends
-        if "rope" not in _skip:
-            pltpu.make_async_copy(kv_wr.at[0], k_out.at[layer, pos],
-                                  wsem.at[0]).wait()
-            pltpu.make_async_copy(kv_wr.at[1], v_out.at[layer, pos],
-                                  wsem.at[1]).wait()
+
+    # the cache-write DMAs started in the RoPE step must land before the
+    # kernel ends — waited whenever they were STARTED ("rope" ran), in a
+    # block independent of the "att" bisection skip (an "att"-skipped run
+    # would otherwise finish with outstanding DMA semaphores and fault)
+    @pl.when(jnp.logical_and(i == att, "rope" not in _skip))
+    def _():
+        pltpu.make_async_copy(kv_wr.at[0], k_out.at[layer, pos],
+                              wsem.at[0]).wait()
+        pltpu.make_async_copy(kv_wr.at[1], v_out.at[layer, pos],
+                              wsem.at[1]).wait()
 
     # ---- phase 3: wo (sigma-permuted blocks) + residual -------------------
     @pl.when((i >= wo0) & (i < w130) & ("wo" not in _skip))
@@ -663,7 +665,7 @@ def _mega_call(layer_pos, qkv_qs, qkv_s, wo_qs, wo_s, w13_qs, w13_s,
                w2_qs, w2_s, x_col, watt_col, wffn_col, freq_col, even_col,
                k_cache, v_cache, *, cfg, interpret):
     (dim, kv_dim, hid, n_kv, kv_mul, hs, chunk,
-     r_qkv, r_wo, r_13, r_w2) = cfg
+     r_qkv, r_wo, r_13, r_w2, skip) = cfg
     dqkv = dim + 2 * kv_dim
     nb_d, nb_h = dim // 32, hid // 32
     g_qkv, g_wo, g_13, g_w2 = (dqkv // r_qkv, dim // r_wo, hid // r_13,
@@ -795,7 +797,8 @@ def q40_layer_mega(spec, wqkv: Q40Kernel, wo_perm: Q40Kernel,
                        jnp.dtype(k_cache.dtype).itemsize)
     cfg = (spec.dim, spec.kv_dim, spec.hidden_dim, spec.n_kv_heads,
            spec.kv_mul, spec.head_size, chunk,
-           p["r_qkv"], p["r_wo"], p["r_13"], p["r_w2"])
+           p["r_qkv"], p["r_wo"], p["r_13"], p["r_w2"],
+           os.environ.get("DLLAMA_MEGA_SKIP", ""))
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     layer_pos = jnp.stack([jnp.asarray(layer, jnp.int32),

@@ -36,6 +36,7 @@ with the XLA path is bit-tight at f32.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -47,6 +48,36 @@ from ..io.loader import (Q40Kernel, Q40KernelNb, Q40Weight,
 
 QK = 32
 NJ = 16  # nibble positions per block byte-plane
+
+
+def _prefill_matmul_mode() -> str:
+    """T>8 (prefill-chunk) matmul strategy — DLLAMA_PREFILL_MATMUL:
+
+    * 'dequant': unpack the packed weight once per chunk into an HBM
+      bf16/f32 temp and run a plain XLA dot.
+    * 'scratch': d-outer grid, unpack-once-to-VMEM-scratch MXU kernel —
+      the packed tile is DMA'd and unpacked exactly once per chunk
+      (_matmul_body_scratch), but every x tile re-streams once per d tile.
+    * 'legacy': the original (t/bt, d/rows) grid, which re-fetches and
+      re-unpacks every weight tile t/bt times per chunk.
+    * 'auto' (default): 'dequant' under the bf16 fast-prefill precision,
+      'legacy' in f32 parity mode.
+
+    The arms are the prefill ladder (tools/prefill_ladder.py, VERDICT r2
+    #6). Measured on v5e at 7B (tok/s at chunk 480/960/1920): dequant
+    3255/4055/4487 beats scratch 2623/3685/3761 beats legacy
+    2408/3565/4249 in bf16 — the Pallas grids re-stream one of the two
+    operands t/bt or d/rows times, while XLA's dense dot tiles both ways
+    and the one-time dequant temp costs less than either re-stream. In f32
+    parity mode the dense path triples MXU passes (HIGHEST) on 4x the temp
+    bytes, so the packed kernel stays ahead there (BASELINE.md r3 ladder).
+    Read at trace time, like the precision contextvar."""
+    mode = os.environ.get("DLLAMA_PREFILL_MATMUL", "auto")
+    if mode == "auto":
+        from .linear import matmul_mode
+
+        return "dequant" if matmul_mode() == "bf16" else "legacy"
+    return mode
 
 
 def _matvec_body(qs3, s, xlo_ref, xhi_ref, xsum_ref, out_ref):
@@ -227,6 +258,125 @@ def _kernel_mxu_nb_stacked(layer_ref, qs_ref, scale_ref, xlo_ref, xhi_ref,
 
 MULTI_T_MAX = 8  # beyond this the per-row accumulators crowd VMEM; use MXU
 
+# The scratch MXU kernels keep a whole unpacked weight tile resident next to
+# the pipeline buffers; Mosaic's conservative scoped-VMEM accounting rejects
+# that at the default 16 MB even though the real footprint is ~8-12 MB (v5e
+# has 128 MB physical). Same approach as ops/pallas_layer._VMEM_LIMIT.
+_PREFILL_PARAMS = pltpu.CompilerParams(vmem_limit_bytes=64 * 1024 * 1024)
+
+
+def _matmul_body_scratch(qs3, s, xlo_ref, xhi_ref, out_ref, wlo_ref, whi_ref,
+                         bf16=False):
+    """T>8 MXU body, d-OUTER grid, unpack-once: grid is (d/rows, t/bt) with
+    the t tiles innermost, so each packed weight tile is DMA'd and unpacked
+    exactly ONCE (at ti == 0, into the wlo/whi VMEM scratch) and every t
+    tile dots against the resident unpacked planes.
+
+    The legacy body (_matmul_body) runs on a (t/bt, d/rows) grid where the
+    weight tile is re-fetched and re-unpacked for EVERY t tile — t/bt = 15x
+    the packed bytes and VPU work at a 1920-token chunk (the prefill-ladder
+    finding, BASELINE.md r3). Decode (t == 1) is unaffected: one t tile
+    means the two schedules are identical, so the matvec path keeps its
+    tuned shape.
+    """
+    dn = (((1,), (1,)), ((), ()))
+    wdt = jnp.bfloat16 if bf16 else jnp.float32
+    prec = None if bf16 else jax.lax.Precision.HIGHEST
+
+    @pl.when(pl.program_id(1) == 0)
+    def _unpack():
+        for j in range(NJ):
+            q = qs3[j].astype(jnp.int32)
+            wlo_ref[j, :, :] = ((((q & 0xF) - 8).astype(jnp.float32))
+                                * s).astype(wdt)
+            whi_ref[j, :, :] = ((((q >> 4) - 8).astype(jnp.float32))
+                                * s).astype(wdt)
+
+    acc = None
+    for j in range(NJ):
+        a = jax.lax.dot_general(xlo_ref[j].astype(wdt), wlo_ref[j], dn,
+                                preferred_element_type=jnp.float32,
+                                precision=prec)
+        a = a + jax.lax.dot_general(xhi_ref[j].astype(wdt), whi_ref[j], dn,
+                                    preferred_element_type=jnp.float32,
+                                    precision=prec)
+        acc = a if acc is None else acc + a
+    out_ref[...] = acc
+
+
+def _kernel_scratch(qs_ref, scale_ref, xlo_ref, xhi_ref, out_ref,
+                    wlo_ref, whi_ref, *, bf16=False):
+    _matmul_body_scratch(qs_ref, scale_ref[...], xlo_ref, xhi_ref, out_ref,
+                         wlo_ref, whi_ref, bf16)
+
+
+def _kernel_scratch_stacked(layer_ref, qs_ref, scale_ref, xlo_ref, xhi_ref,
+                            out_ref, wlo_ref, whi_ref, *, bf16=False):
+    del layer_ref  # consumed by the index maps
+    _matmul_body_scratch(qs_ref[0], scale_ref[0], xlo_ref, xhi_ref, out_ref,
+                         wlo_ref, whi_ref, bf16)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("block_rows", "block_t", "interpret",
+                                    "bf16"))
+def _q40_matmul_2d_scratch(qs_t, scale, x, *, block_rows, block_t,
+                           interpret, bf16=False):
+    _, d, nb = qs_t.shape
+    t = x.shape[0]
+    xlo, xhi = _split_x(x.astype(jnp.float32), nb)
+    wdt = jnp.bfloat16 if bf16 else jnp.float32
+    out = pl.pallas_call(
+        functools.partial(_kernel_scratch, bf16=bf16),
+        grid=(d // block_rows, t // block_t),
+        in_specs=[
+            pl.BlockSpec((NJ, block_rows, nb), lambda i, ti: (0, i, 0)),
+            pl.BlockSpec((block_rows, nb), lambda i, ti: (i, 0)),
+            pl.BlockSpec((NJ, block_t, nb), lambda i, ti: (0, ti, 0)),
+            pl.BlockSpec((NJ, block_t, nb), lambda i, ti: (0, ti, 0)),
+        ],
+        out_specs=pl.BlockSpec((block_t, block_rows), lambda i, ti: (ti, i)),
+        out_shape=jax.ShapeDtypeStruct((t, d), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((NJ, block_rows, nb), wdt),
+                        pltpu.VMEM((NJ, block_rows, nb), wdt)],
+        compiler_params=_PREFILL_PARAMS,
+        interpret=interpret,
+    )(qs_t, scale, xlo, xhi)
+    return out
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("block_rows", "block_t", "interpret",
+                                    "bf16"))
+def _q40_matmul_stacked_scratch(layer, qs_t, scale, x, *, block_rows,
+                                block_t, interpret, bf16=False):
+    _, _, d, nb = qs_t.shape
+    t = x.shape[0]
+    xlo, xhi = _split_x(x.astype(jnp.float32), nb)
+    wdt = jnp.bfloat16 if bf16 else jnp.float32
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(d // block_rows, t // block_t),
+        in_specs=[
+            pl.BlockSpec((1, NJ, block_rows, nb),
+                         lambda i, ti, L: (L[0], 0, i, 0)),
+            pl.BlockSpec((1, block_rows, nb), lambda i, ti, L: (L[0], i, 0)),
+            pl.BlockSpec((NJ, block_t, nb), lambda i, ti, L: (0, ti, 0)),
+            pl.BlockSpec((NJ, block_t, nb), lambda i, ti, L: (0, ti, 0)),
+        ],
+        out_specs=pl.BlockSpec((block_t, block_rows),
+                               lambda i, ti, L: (ti, i)),
+        scratch_shapes=[pltpu.VMEM((NJ, block_rows, nb), wdt),
+                        pltpu.VMEM((NJ, block_rows, nb), wdt)],
+    )
+    return pl.pallas_call(
+        functools.partial(_kernel_scratch_stacked, bf16=bf16),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((t, d), jnp.float32),
+        compiler_params=_PREFILL_PARAMS,
+        interpret=interpret,
+    )(layer, qs_t, scale, xlo, xhi)
+
 
 def _matmul_body(qs3, s, xlo_ref, xhi_ref, out_ref, bf16=False):
     """Shared T>1 MXU body: qs3 (NJ, R, nb) codes view, s (R, nb) scales.
@@ -326,6 +476,7 @@ def _q40_matmul_2d(qs_t, scale, x, *, block_rows, block_t, interpret,
     grid = (t // block_t, d // block_rows)
     out = pl.pallas_call(
         functools.partial(_kernel, bf16=bf16),
+        compiler_params=_PREFILL_PARAMS,
         grid=grid,
         in_specs=[
             pl.BlockSpec((NJ, block_rows, nb), lambda ti, i: (0, i, 0)),
@@ -406,7 +557,7 @@ def _q40_matmul_stacked(layer, qs_t, scale, x, *, block_rows, block_t,
     return pl.pallas_call(
         functools.partial(_kernel_stacked, bf16=bf16), grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((t, d), jnp.float32),
-        interpret=interpret,
+        compiler_params=_PREFILL_PARAMS, interpret=interpret,
     )(layer, qs_t, scale, xlo, xhi)
 
 
@@ -650,6 +801,110 @@ def _q40_multi_nb_stacked(layer, qs_t, scale, x, *, block_rows, interpret):
     )(layer, qs_t, scale, xlo, xhi, xsum)
 
 
+def _matmul_body_nb_scratch(qs3, s, xlo_ref, xhi_ref, out_ref, wlo_ref,
+                            whi_ref, bf16=False):
+    """nb-major twin of _matmul_body_scratch: d-outer grid, the packed tile
+    unpacked once into VMEM scratch at ti == 0, standard (M,K)x(K,N) dots
+    from the resident planes for every t tile."""
+    dn = (((1,), (0,)), ((), ()))
+    wdt = jnp.bfloat16 if bf16 else jnp.float32
+    prec = None if bf16 else jax.lax.Precision.HIGHEST
+
+    @pl.when(pl.program_id(1) == 0)
+    def _unpack():
+        for j in range(NJ):
+            q = qs3[j].astype(jnp.int32)                 # (nb, R)
+            wlo_ref[j, :, :] = ((((q & 0xF) - 8).astype(jnp.float32))
+                                * s).astype(wdt)
+            whi_ref[j, :, :] = ((((q >> 4) - 8).astype(jnp.float32))
+                                * s).astype(wdt)
+
+    acc = None
+    for j in range(NJ):
+        a = jax.lax.dot_general(xlo_ref[j].astype(wdt), wlo_ref[j], dn,
+                                preferred_element_type=jnp.float32,
+                                precision=prec)
+        a = a + jax.lax.dot_general(xhi_ref[j].astype(wdt), whi_ref[j], dn,
+                                    preferred_element_type=jnp.float32,
+                                    precision=prec)
+        acc = a if acc is None else acc + a
+    out_ref[...] = acc
+
+
+def _kernel_scratch_nb(qs_ref, scale_ref, xlo_ref, xhi_ref, out_ref,
+                       wlo_ref, whi_ref, *, bf16=False):
+    _matmul_body_nb_scratch(qs_ref, scale_ref[...], xlo_ref, xhi_ref,
+                            out_ref, wlo_ref, whi_ref, bf16)
+
+
+def _kernel_scratch_nb_stacked(layer_ref, qs_ref, scale_ref, xlo_ref,
+                               xhi_ref, out_ref, wlo_ref, whi_ref, *,
+                               bf16=False):
+    del layer_ref
+    _matmul_body_nb_scratch(qs_ref[0], scale_ref[0], xlo_ref, xhi_ref,
+                            out_ref, wlo_ref, whi_ref, bf16)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("block_rows", "block_t", "interpret",
+                                    "bf16"))
+def _q40_mxu_nb_2d_scratch(qs_t, scale, x, *, block_rows, block_t,
+                           interpret, bf16=False):
+    _, nb, d = qs_t.shape
+    t = x.shape[0]
+    xlo, xhi = _split_x(x.astype(jnp.float32), nb)
+    wdt = jnp.bfloat16 if bf16 else jnp.float32
+    return pl.pallas_call(
+        functools.partial(_kernel_scratch_nb, bf16=bf16),
+        grid=(d // block_rows, t // block_t),
+        in_specs=[
+            pl.BlockSpec((NJ, nb, block_rows), lambda i, ti: (0, 0, i)),
+            pl.BlockSpec((nb, block_rows), lambda i, ti: (0, i)),
+            pl.BlockSpec((NJ, block_t, nb), lambda i, ti: (0, ti, 0)),
+            pl.BlockSpec((NJ, block_t, nb), lambda i, ti: (0, ti, 0)),
+        ],
+        out_specs=pl.BlockSpec((block_t, block_rows), lambda i, ti: (ti, i)),
+        out_shape=jax.ShapeDtypeStruct((t, d), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((NJ, nb, block_rows), wdt),
+                        pltpu.VMEM((NJ, nb, block_rows), wdt)],
+        compiler_params=_PREFILL_PARAMS,
+        interpret=interpret,
+    )(qs_t, scale, xlo, xhi)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("block_rows", "block_t", "interpret",
+                                    "bf16"))
+def _q40_mxu_nb_stacked_scratch(layer, qs_t, scale, x, *, block_rows,
+                                block_t, interpret, bf16=False):
+    _, _, nb, d = qs_t.shape
+    t = x.shape[0]
+    xlo, xhi = _split_x(x.astype(jnp.float32), nb)
+    wdt = jnp.bfloat16 if bf16 else jnp.float32
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(d // block_rows, t // block_t),
+        in_specs=[
+            pl.BlockSpec((1, NJ, nb, block_rows),
+                         lambda i, ti, L: (L[0], 0, 0, i)),
+            pl.BlockSpec((1, nb, block_rows), lambda i, ti, L: (L[0], 0, i)),
+            pl.BlockSpec((NJ, block_t, nb), lambda i, ti, L: (0, ti, 0)),
+            pl.BlockSpec((NJ, block_t, nb), lambda i, ti, L: (0, ti, 0)),
+        ],
+        out_specs=pl.BlockSpec((block_t, block_rows),
+                               lambda i, ti, L: (ti, i)),
+        scratch_shapes=[pltpu.VMEM((NJ, nb, block_rows), wdt),
+                        pltpu.VMEM((NJ, nb, block_rows), wdt)],
+    )
+    return pl.pallas_call(
+        functools.partial(_kernel_scratch_nb_stacked, bf16=bf16),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((t, d), jnp.float32),
+        compiler_params=_PREFILL_PARAMS,
+        interpret=interpret,
+    )(layer, qs_t, scale, xlo, xhi)
+
+
 @functools.partial(jax.jit,
                    static_argnames=("block_rows", "block_t", "interpret",
                                     "bf16"))
@@ -660,6 +915,7 @@ def _q40_mxu_nb_2d(qs_t, scale, x, *, block_rows, block_t, interpret,
     xlo, xhi = _split_x(x.astype(jnp.float32), nb)   # (NJ, t, nb) — natural
     out = pl.pallas_call(
         functools.partial(_kernel_mxu_nb, bf16=bf16),
+        compiler_params=_PREFILL_PARAMS,
         grid=(t // block_t, d // block_rows),
         in_specs=[
             pl.BlockSpec((NJ, nb, block_rows), lambda ti, i: (0, 0, i)),
@@ -699,7 +955,7 @@ def _q40_mxu_nb_stacked(layer, qs_t, scale, x, *, block_rows, block_t,
         functools.partial(_kernel_mxu_nb_stacked, bf16=bf16),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((t, d), jnp.float32),
-        interpret=interpret,
+        compiler_params=_PREFILL_PARAMS, interpret=interpret,
     )(layer, qs_t, scale, xlo, xhi)
 
 
@@ -727,6 +983,13 @@ def _q40_matmul_nbmajor(w: Q40KernelNb, x: jax.Array,
         out = _q40_matmul_nbmajor(w, jnp.pad(x2, ((0, pad), (0, 0))),
                                   interpret, layer, block_rows)
         return out[:t].reshape(*lead, d)
+    if t > MULTI_T_MAX and _prefill_matmul_mode() == "dequant":
+        # prefill-ladder experiment arm — see q40_matmul
+        if layer is not None:
+            qs_t = qs_t[layer]
+            scale = scale[layer]
+        return _precision_dot(_dequant_nb(qs_t, scale),
+                              x2).reshape(*lead, d)
     if block_rows is not None:
         if block_rows % 128 or d % block_rows:
             raise ValueError(
@@ -771,6 +1034,7 @@ def _q40_matmul_nbmajor(w: Q40KernelNb, x: jax.Array,
         from .linear import matmul_mode
 
         bf16 = matmul_mode() == "bf16"
+        scratch = t > MULTI_T_MAX and _prefill_matmul_mode() == "scratch"
         if layer is not None:
             lidx = jnp.asarray(layer, dtype=jnp.int32).reshape(1)
             if t == 1:
@@ -782,10 +1046,11 @@ def _q40_matmul_nbmajor(w: Q40KernelNb, x: jax.Array,
                                             block_rows=rows,
                                             interpret=interpret)
             else:
-                out = _q40_mxu_nb_stacked(lidx, qs_t, scale, x2,
-                                          block_rows=rows,
-                                          block_t=_pick_block_t(t, nb),
-                                          interpret=interpret, bf16=bf16)
+                call = (_q40_mxu_nb_stacked_scratch if scratch
+                        else _q40_mxu_nb_stacked)
+                out = call(lidx, qs_t, scale, x2, block_rows=rows,
+                           block_t=_pick_block_t(t, nb),
+                           interpret=interpret, bf16=bf16)
         else:
             if t == 1:
                 out = _q40_matvec_nb_2d(qs_t, scale, x2, block_rows=rows,
@@ -794,9 +1059,11 @@ def _q40_matmul_nbmajor(w: Q40KernelNb, x: jax.Array,
                 out = _q40_multi_nb_2d(qs_t, scale, x2, block_rows=rows,
                                        interpret=interpret)
             else:
-                out = _q40_mxu_nb_2d(qs_t, scale, x2, block_rows=rows,
-                                     block_t=_pick_block_t(t, nb),
-                                     interpret=interpret, bf16=bf16)
+                call = (_q40_mxu_nb_2d_scratch if scratch
+                        else _q40_mxu_nb_2d)
+                out = call(qs_t, scale, x2, block_rows=rows,
+                           block_t=_pick_block_t(t, nb),
+                           interpret=interpret, bf16=bf16)
         return out.reshape(*lead, d)
     if layer is not None:
         qs_t = qs_t[layer]
@@ -837,6 +1104,12 @@ def q40_matmul(w: Q40Kernel | Q40Weight, x: jax.Array,
     n = x.shape[-1]
     x2 = x.reshape(-1, n)
     t = x2.shape[0]
+    if t > MULTI_T_MAX and _prefill_matmul_mode() == "dequant":
+        # prefill-ladder experiment arm (tools/prefill_ladder.py): unpack the
+        # weight ONCE into a bf16/f32 HBM temp and let XLA drive a plain MXU
+        # dot, instead of the Pallas grid re-unpacking the weight tile per
+        # T-tile. Decode (t==1) never takes this.
+        return _dequant_matmul(w, x2, layer).reshape(*lead, d)
     if t > MULTI_T_MAX and t % 8 != 0:
         # pad to a multiple of 8 so the MXU path always has an under-cap
         # t-tile divisor (a full-t block of awkward length can exceed the
@@ -855,14 +1128,17 @@ def q40_matmul(w: Q40Kernel | Q40Weight, x: jax.Array,
             # the packed weight — correctness everywhere, kernel speed on
             # the shapes that matter
             return _dequant_matmul(w, x2, layer).reshape(*lead, d)
+    scratch = t > MULTI_T_MAX and _prefill_matmul_mode() == "scratch"
     if layer is not None:
         if qs_t.ndim != 4:
             raise ValueError("layer= requires stacked (L, 16, d, nb) weights")
         lidx = jnp.asarray(layer, dtype=jnp.int32).reshape(1)
-        out = _q40_matmul_stacked(lidx, qs_t, scale, x2,
-                                  block_rows=block_rows, block_t=block_t,
-                                  interpret=interpret, bf16=bf16)
+        call = _q40_matmul_stacked_scratch if scratch else _q40_matmul_stacked
+        out = call(lidx, qs_t, scale, x2,
+                   block_rows=block_rows, block_t=block_t,
+                   interpret=interpret, bf16=bf16)
     else:
-        out = _q40_matmul_2d(qs_t, scale, x2, block_rows=block_rows,
-                             block_t=block_t, interpret=interpret, bf16=bf16)
+        call = _q40_matmul_2d_scratch if scratch else _q40_matmul_2d
+        out = call(qs_t, scale, x2, block_rows=block_rows,
+                   block_t=block_t, interpret=interpret, bf16=bf16)
     return out.reshape(*lead, d)

@@ -236,18 +236,20 @@ def _load_or_compile(lowered, exe_cache_dir: str | None):
     import pickle
     import sys
 
+    path = None
     try:
         # key on everything that could invalidate a compiled binary: jax +
         # runtime lib versions, the CHIP KIND (default_backend() is just
-        # 'tpu' for every TPU generation), and the lowered HLO itself
+        # 'tpu' for every TPU generation), and the lowered HLO itself (which
+        # embeds source line numbers in op metadata — so ANY edit to files
+        # on the traced path re-keys; conservative by design)
         dev = jax.devices()[0]
         salt = (jax.__version__ + getattr(jax.lib, "__version__", "")
                 + jax.default_backend() + getattr(dev, "device_kind", ""))
         key = hashlib.sha256(
             (salt + lowered.as_text()).encode()).hexdigest()[:32]
         path = os.path.join(exe_cache_dir, f"exe_{key}.pkl")
-        from jax.experimental.serialize_executable import (
-            deserialize_and_load, serialize)
+        from jax.experimental.serialize_executable import deserialize_and_load
 
         if os.path.exists(path):
             try:
@@ -257,20 +259,32 @@ def _load_or_compile(lowered, exe_cache_dir: str | None):
                 print(f"⏩ loaded serialized executable ({path})",
                       file=sys.stderr)
                 return compiled
-            except Exception:
-                os.unlink(path)  # corrupt/stale entry: recompile fresh
-                raise
-        compiled = lowered.compile()
-        os.makedirs(exe_cache_dir, exist_ok=True)
-        tmp = path + f".tmp{os.getpid()}"
-        with open(tmp, "wb") as fh:
-            pickle.dump(serialize(compiled), fh)
-        os.replace(tmp, path)
-        return compiled
+            except Exception as e:
+                # corrupt/stale entry: drop it and fall through to a fresh
+                # compile + re-serialize below (returning early here would
+                # leave the cache empty for the NEXT process too)
+                print(f"💡 dropping unreadable executable cache entry "
+                      f"({type(e).__name__}: {e})", file=sys.stderr)
+                os.unlink(path)
     except Exception as e:  # noqa: BLE001 - cache must never kill the run
         print(f"💡 executable cache unavailable "
               f"({type(e).__name__}: {e}); compiling", file=sys.stderr)
-        return lowered.compile()
+        path = None
+    compiled = lowered.compile()
+    if path is not None:
+        try:  # serialize/write failures must not recompile or kill the run
+            from jax.experimental.serialize_executable import serialize
+
+            os.makedirs(exe_cache_dir, exist_ok=True)
+            tmp = path + f".tmp{os.getpid()}"
+            with open(tmp, "wb") as fh:
+                pickle.dump(serialize(compiled), fh)
+            os.replace(tmp, path)
+        except Exception as e:  # noqa: BLE001
+            print(f"💡 executable serialization unavailable "
+                  f"({type(e).__name__}: {e}); continuing uncached",
+                  file=sys.stderr)
+    return compiled
 
 
 def make_batch_decode_loop(spec, steps: int, temperature: float, topp: float,

@@ -103,8 +103,34 @@ class Engine:
         the writes back over real positions); that tail runs as T=1 steps,
         reusing the decode compilation. Logits are discarded; callers
         continue with the next real token through the decode path.
+
+        Two or more full windows run as ONE device program (a fori_loop
+        over the chunk index with the cache donated through): the tunneled
+        runtime charges a fixed ~100 ms dispatch per launched chain, so a
+        7680-token prompt at chunk 1920 pays it once instead of 4x —
+        measured prefill ladder, BASELINE.md r3. The traced chunk-count
+        bound means one compilation per chunk size serves every prompt
+        length.
         """
         jnp = self.jnp
+        seq_len = self.spec.seq_len
+        c = min(chunk, seq_len)
+        n_full = len(tokens) // c
+        rest, rest_pos = tokens, pos0
+        if n_full >= 2 and c > 8:
+            import numpy as _np
+
+            max_chunks = seq_len // c
+            mat = _np.zeros((max_chunks, c), _np.int32)
+            mat[:n_full] = _np.asarray(tokens[:n_full * c],
+                                       _np.int32).reshape(n_full, c)
+            self.cache = self._prefill_loop(c)(
+                self.params, self.cache, jnp.asarray(mat),
+                jnp.int32(pos0), jnp.int32(n_full))
+            rest = tokens[n_full * c:]
+            rest_pos = pos0 + n_full * c
+        if not rest:
+            return
 
         def fwd(part, start):
             # fast-prefill (bf16) applies to the T>8 MXU-bound chunks only;
@@ -115,7 +141,35 @@ class Engine:
                               jnp.asarray(part, jnp.int32),
                               jnp.int32(start))
 
-        run_chunked_prefill(fwd, tokens, pos0, chunk, self.spec.seq_len)
+        run_chunked_prefill(fwd, rest, rest_pos, chunk, seq_len)
+
+    def _prefill_loop(self, chunk: int):
+        """Compiled whole-prompt prefill (cached per chunk size): fori_loop
+        over full T=chunk windows, cache donated, chunk count traced. Traces
+        under the engine's prefill precision (bf16_prefill when
+        fast_prefill is set, parity otherwise)."""
+        import jax
+
+        key = ("prefill", chunk)
+        if key not in self._loops:
+            jnp = self.jnp
+            step = self._step_raw
+            if self.fast_prefill:
+                from ..ops.linear import bf16_prefill
+
+                step = bf16_prefill(step)
+
+            def run(params, cache, toks_mat, pos0, n_chunks):
+                def body(i, cache):
+                    part = jax.lax.dynamic_index_in_dim(
+                        toks_mat, i, 0, keepdims=False)
+                    _, cache = step(params, cache, part,
+                                    pos0 + i * jnp.int32(chunk))
+                    return cache
+                return jax.lax.fori_loop(0, n_chunks, body, cache)
+
+            self._loops[key] = jax.jit(run, donate_argnums=1)
+        return self._loops[key]
 
     def decode_loop(self, temperature: float, topp: float):
         """Compiled on-device generation loop for this engine (cached).

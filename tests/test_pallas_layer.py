@@ -150,8 +150,10 @@ def test_fused_kernels_cut_op_count(monkeypatch):
              for e in walk_fn_eqns(fn, params, init_cache(SPEC), tok,
                                    jnp.int32(0))]
     # exactly two pallas_calls inside the scan body (head + tail; the
-    # interpret-mode attention fallback is XLA einsum here) + wcls matmul
-    assert names.count("pallas_call") >= 2
+    # interpret-mode attention fallback is XLA einsum here) plus the wcls
+    # matvec after the scan — an exact count, so a regression back to ~10
+    # per-layer calls fails loudly
+    assert names.count("pallas_call") == 3
 
 
 @pytest.mark.parametrize("spec", [MEGA_MHA, MEGA_GQA])

@@ -395,3 +395,53 @@ def test_nbmajor_pack_selection_and_forward_parity(monkeypatch):
     # callers: an sp>1 mesh packs with tp=1 but cannot carry Q40KernelNb)
     psh = pack_q40_params({"w2": _mk(128, 1280)})  # nb=40: 3.2x ratio
     assert isinstance(psh["w2"], Q40Kernel)
+
+
+@pytest.mark.parametrize("layout", ["d_major", "nb_major"])
+@pytest.mark.parametrize("mode", ["legacy", "scratch", "dequant"])
+def test_prefill_matmul_modes_match(mode, layout, monkeypatch):
+    """The three T>8 prefill strategies (DLLAMA_PREFILL_MATMUL) compute the
+    same product on both kernel layouts: legacy (t-outer grid), scratch
+    (d-outer grid, unpack-once into VMEM scratch), dequant (HBM temp +
+    XLA dot)."""
+    import jax.numpy as jnp
+
+    from distributed_llama_tpu.io.loader import to_kernel_layout_nb
+    from distributed_llama_tpu.ops.pallas_q40 import q40_matmul
+
+    monkeypatch.setenv("DLLAMA_PREFILL_MATMUL", mode)
+    if layout == "nb_major":
+        d, n, t = 256, 5120, 32   # 13B-like badly-padding block count
+        w = _mk(d, n, seed=11)
+        wk = to_kernel_layout_nb(w)
+    else:
+        d, n, t = 256, 512, 32
+        w = wk = _mk(d, n, seed=11)
+    x = np.random.default_rng(12).standard_normal((t, n)).astype(np.float32)
+    want = dequantize_q40(np.asarray(w.qs), np.asarray(w.d16)) @ x.T
+    got = q40_matmul(wk, jnp.asarray(x), interpret=True)
+    np.testing.assert_allclose(np.asarray(got), want.T, rtol=1e-4, atol=1e-3)
+
+
+def test_prefill_scratch_stacked_matches(monkeypatch):
+    """Stacked (lax.scan layer-indexed) scratch kernel parity."""
+    import jax.numpy as jnp
+
+    from distributed_llama_tpu.io.loader import to_kernel_layout
+    from distributed_llama_tpu.ops.pallas_q40 import q40_matmul
+
+    monkeypatch.setenv("DLLAMA_PREFILL_MATMUL", "scratch")
+    d, n, t, L = 128, 256, 16, 3
+    ws = [_mk(d, n, seed=20 + i) for i in range(L)]
+    ks = [to_kernel_layout(w) for w in ws]
+    from distributed_llama_tpu.io.loader import Q40Kernel
+
+    stacked = Q40Kernel(np.stack([np.asarray(k.qs_t) for k in ks]),
+                        np.stack([np.asarray(k.scale) for k in ks]))
+    x = np.random.default_rng(30).standard_normal((t, n)).astype(np.float32)
+    for layer in range(L):
+        want = dequantize_q40(np.asarray(ws[layer].qs),
+                              np.asarray(ws[layer].d16)) @ x.T
+        got = q40_matmul(stacked, jnp.asarray(x), layer=jnp.int32(layer))
+        np.testing.assert_allclose(np.asarray(got), want.T,
+                                   rtol=1e-5, atol=1e-4)

@@ -7,7 +7,8 @@ import pytest
 from distributed_llama_tpu.models.spec import TransformerSpec
 from distributed_llama_tpu.models.synth import synth_params
 from distributed_llama_tpu.runtime.generate import (Engine, generate,
-                                                    generate_fast)
+                                                    generate_fast,
+                                                    run_chunked_prefill)
 from distributed_llama_tpu.runtime.sampling import Sampler
 
 SPEC = TransformerSpec(dim=64, hidden_dim=160, n_layers=2, n_heads=4,
@@ -221,3 +222,40 @@ def test_fast_prefill_bf16_tolerance_and_isolation():
     lg_fast = fast.infer(int(tokens[-1]) % SPEC.vocab_size, 12)
     rel = np.abs(lg_ref - lg_fast).max() / max(np.abs(lg_ref).max(), 1e-9)
     assert rel < 2.5e-2  # only prefilled-cache drift remains
+
+
+def test_fused_prefill_loop_matches_per_chunk_dispatch():
+    """>=2 full windows at chunk>8 run as ONE device program (fori_loop
+    over windows, cache donated — Engine._prefill_loop). Cache and
+    next-step logits must match the per-chunk host dispatch exactly
+    (same per-window program, f32)."""
+    import jax.numpy as jnp
+
+    spec = TransformerSpec(dim=64, hidden_dim=160, n_layers=2, n_heads=4,
+                           n_kv_heads=2, vocab_size=300, seq_len=64)
+    params = synth_params(spec, q40=False, seed=11, scale=0.3)
+    tokens = list(np.random.default_rng(3).integers(2, 290, 41))  # 3x12+5
+
+    eng_a = Engine(spec, params)
+    eng_a.prefill(tokens, 0, chunk=12)  # fused: 3 full windows + tail 5
+    la = eng_a.infer(7, len(tokens))
+
+    eng_b = Engine(spec, params)  # reference: windows dispatched one by one
+    for lo in range(0, 36, 12):
+        _, eng_b.cache = eng_b._fwd(eng_b.params, eng_b.cache,
+                                    jnp.asarray(tokens[lo:lo + 12],
+                                                jnp.int32), jnp.int32(lo))
+    run_chunked_prefill(
+        lambda part, start: setattr(
+            eng_b, "cache",
+            eng_b._fwd(eng_b.params, eng_b.cache,
+                       jnp.asarray(part, jnp.int32),
+                       jnp.int32(start))[1]),
+        tokens[36:], 36, 12, spec.seq_len)
+    lb = eng_b.infer(7, len(tokens))
+
+    n = len(tokens) + 1
+    np.testing.assert_allclose(np.asarray(eng_a.cache.k[:, :n]),
+                               np.asarray(eng_b.cache.k[:, :n]),
+                               rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(la, lb, rtol=1e-6, atol=1e-6)

@@ -1,0 +1,177 @@
+"""Prefill floor ladder (VERDICT r2 #6): where does a prefill chunk's time go?
+
+Decomposes per-chunk prefill wall time at 7B into
+  * device op time, split by op family from a profiler trace:
+      - the Q40 matmul kernels (unpack + MXU; Pallas custom calls)
+      - the flash-attention kernel
+      - XLA fusions (activation plane transposes / layout / glue)
+      - everything else
+  * dispatch = wall - device-op total (the tunneled runtime's per-launch
+    constant; decode's phase ladder showed ~390-410 GB/s program streaming
+    against ~670 GB/s op-time streaming for the same reason)
+
+across chunk sizes x matmul strategies (DLLAMA_PREFILL_MATMUL):
+  * legacy  — the round-2 Pallas MXU body. Its grid is (t/bt, d/rows) with
+    bt capped at 128 by VMEM, so a 1920-token chunk re-DMAs AND re-unpacks
+    every packed weight tile t/bt = 15x per chunk.
+  * scratch — d-outer grid + unpack-once-to-VMEM-scratch MXU body
+    (_matmul_body_scratch): weight bytes move and unpack exactly once.
+  * dequant — unpack once per chunk into an HBM bf16 temp, plain XLA dot:
+    trades the re-reads for 2x dense-byte traffic (write+read of the temp).
+
+Modes run under --fast-prefill (bf16 MXU) and parity f32 anchors. MXU
+ceiling for scale: 7B prefill is ~13.4 GFLOP/token; v5e bf16 peak
+~197 TFLOP/s -> ~0.068 ms/token ~ 14.7k tok/s.
+
+Run: PYTHONPATH=/root/repo:/root/.axon_site python tools/prefill_ladder.py
+     [--chunks 480,960,1920] [--modes ...] [--out ladder.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import gc
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+_MODES = {
+    # name -> (fast_prefill, DLLAMA_PREFILL_MATMUL)
+    "legacy_bf16": (True, "legacy"),
+    "scratch_bf16": (True, "scratch"),
+    "dequant_bf16": (True, "dequant"),
+    "legacy_f32": (False, "legacy"),
+    "scratch_f32": (False, "scratch"),
+    "dequant_f32": (False, "dequant"),
+}
+
+
+def _bucket(op_name: str) -> str:
+    n = op_name.lower()
+    if "q40" in n or "matmul" in n or "matvec" in n or "mxu" in n:
+        return "q40_kernels"
+    if "attention" in n or "flash" in n:
+        return "attention"
+    if n.startswith(("fusion", "transpose", "copy", "bitcast", "reshape",
+                     "convert", "dynamic")):
+        return "fusion_layout"
+    return "other"
+
+
+def _profile_chunk(engine, toks, chunk, trace_dir):
+    """Op-time split of ONE steady-state chunk (prior chunks warm the
+    compile caches so the trace holds execution only)."""
+    import jax
+
+    from distributed_llama_tpu.utils.it_split import parse_trace
+
+    engine.reset()
+    engine.prefill(toks[:chunk], 0, chunk)  # warm/compile outside the trace
+    with jax.profiler.trace(trace_dir):
+        engine.prefill(toks[:chunk], chunk, chunk)
+        np.asarray(engine.cache.k[-1, 2 * chunk - 1, 0, :8])
+    splits = parse_trace(trace_dir)
+    buckets: dict[str, float] = {}
+    for split in splits.values():
+        for name, ns in split.ops.items():
+            buckets[_bucket(name)] = buckets.get(_bucket(name), 0.0) + ns
+    return {k: round(v / 1e6, 2) for k, v in sorted(buckets.items())}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--chunks", default="480,960,1920")
+    ap.add_argument("--modes", default="legacy_bf16,scratch_bf16,dequant_bf16,legacy_f32")
+    ap.add_argument("--config", default="7b", choices=("7b", "small"))
+    ap.add_argument("--trials", type=int, default=3)
+    ap.add_argument("--out", default=None, help="also write the JSON here")
+    args = ap.parse_args()
+    chunks = [int(c) for c in args.chunks.split(",")]
+    modes = args.modes.split(",")
+
+    import jax
+
+    from distributed_llama_tpu.models.synth import (llama2_7b_spec,
+                                                    small_bench_spec,
+                                                    synth_q40_fast)
+    from distributed_llama_tpu.utils.compile_cache import (
+        enable_persistent_cache)
+
+    enable_persistent_cache()
+    spec = llama2_7b_spec() if args.config == "7b" else small_bench_spec()
+    print(f"backend {jax.default_backend()}  config {args.config}", flush=True,
+          file=sys.stderr)
+    t0 = time.perf_counter()
+    # pack once on host for the tree structure, then regenerate the values
+    # ON DEVICE: the tunnel's lazy device_put would otherwise charge a
+    # ~240 s upload to the first prefill of EVERY engine (bench.py r3)
+    from distributed_llama_tpu.models.synth import device_params_like
+    from distributed_llama_tpu.ops.linear import (fuse_q40_layer_matmuls,
+                                                  pack_q40_params)
+
+    params = device_params_like(fuse_q40_layer_matmuls(
+        pack_q40_params(synth_q40_fast(spec), enable=True,
+                        allow_nb_major=False)))
+    jax.block_until_ready(params)
+    print(f"synth+pack+devgen: {time.perf_counter() - t0:.1f}s",
+          file=sys.stderr)
+
+    results = []
+    for mode in modes:
+        fast, strategy = _MODES[mode]
+        os.environ["DLLAMA_PREFILL_MATMUL"] = strategy
+        from distributed_llama_tpu.runtime.generate import Engine
+
+        engine = Engine(spec, params, fast_prefill=fast)
+        for chunk in chunks:
+            n = min(4 * chunk, spec.seq_len - 8)
+            n -= n % chunk  # whole windows only: per-chunk math stays exact
+            toks = [7] * n
+            rates, walls = [], []
+            try:
+                for trial in range(args.trials + 1):  # first = compile+warm
+                    engine.reset()
+                    t0 = time.perf_counter()
+                    engine.prefill(toks, 0, chunk)
+                    np.asarray(engine.cache.k[-1, n - 1, 0, :8])
+                    dt = time.perf_counter() - t0
+                    if trial:
+                        rates.append(n / dt)
+                        walls.append(dt / (n / chunk) * 1000)
+                row = {"mode": mode, "chunk": chunk,
+                       "tok_s": round(float(np.median(rates)), 1),
+                       "wall_ms_per_chunk":
+                           round(float(np.median(walls)), 2)}
+                trace = f"/tmp/prefill_ladder_{mode}_{chunk}"
+                try:
+                    ops = _profile_chunk(engine, toks, chunk, trace)
+                    op_total = round(sum(ops.values()), 2)
+                    row["op_ms_per_chunk"] = ops
+                    row["op_total_ms"] = op_total
+                    row["dispatch_ms_per_chunk"] = round(
+                        row["wall_ms_per_chunk"] - op_total, 2)
+                except Exception as e:  # profile is best-effort
+                    row["profile_error"] = f"{type(e).__name__}: {e}"
+            except Exception as e:
+                row = {"mode": mode, "chunk": chunk,
+                       "error": f"{type(e).__name__}: {e}"}
+            results.append(row)
+            print(json.dumps(row), flush=True)
+        del engine
+        gc.collect()
+
+    out = {"metric": "prefill ladder", "config": args.config, "rows": results}
+    print(json.dumps(out))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(out, f, indent=1)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
