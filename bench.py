@@ -53,7 +53,7 @@ _PROC_T0 = time.perf_counter()  # warm-start accounting anchor
 _STARTUP: dict = {}
 
 
-def _tree_shapes_cached(spec, rank_tp: int, build):
+def _tree_shapes_cached(spec, rank_tp: int, build, build_sig: str = ""):
     """Shape manifest for the packed host tree (synthetic benches only).
 
     The host-side prep for a synthetic bench — RNG synth + kernel re-tiling
@@ -75,10 +75,11 @@ def _tree_shapes_cached(spec, rank_tp: int, build):
 
     # every knob that changes the packed tree's CONTENTS must be in the
     # key: layer fusion adds the wo_mega stack (prepare_mega_params), the
-    # kernel mode decides kernel-vs-codec layout
+    # kernel mode decides kernel-vs-codec layout, and builder kwargs (e.g.
+    # the 70b rank tree's embed_dtype) change leaf shapes/dtypes
     key = hashlib.sha256(
         f"v1|{spec!r}|{rank_tp}|{q40_kernel_mode()}|{fusion_enabled()}"
-        .encode()).hexdigest()[:16]
+        f"|{build_sig}".encode()).hexdigest()[:16]
     path = os.path.join(default_cache_dir(), "shapes", f"tree_{key}.pkl")
     if os.environ.get("DLLAMA_SHAPE_CACHE", "1") != "0" \
             and os.path.exists(path):
@@ -163,7 +164,14 @@ def _bench(spec, params, samples: int, per_step: bool = False,
         # (~240 s for 7B at the measured ~17 MB/s; VERDICT r2 #7).
         from distributed_llama_tpu.models.synth import device_params_like
 
-        host_params = _tree_shapes_cached(spec, rank_tp, prep)
+        if callable(params):
+            fn = getattr(params, "func", params)
+            build_sig = (f"{getattr(fn, '__name__', repr(fn))}"
+                         f"|{getattr(params, 'args', ())!r}"
+                         f"|{sorted(getattr(params, 'keywords', {}).items())!r}")
+        else:
+            build_sig = ""
+        host_params = _tree_shapes_cached(spec, rank_tp, prep, build_sig)
         t_gen = time.perf_counter()
         host_params = device_params_like(host_params)
         jax.block_until_ready(host_params)

@@ -266,7 +266,7 @@ _PREFILL_PARAMS = pltpu.CompilerParams(vmem_limit_bytes=64 * 1024 * 1024)
 
 
 def _matmul_body_scratch(qs3, s, xlo_ref, xhi_ref, out_ref, wlo_ref, whi_ref,
-                         bf16=False):
+                         bf16=False, nb_major=False):
     """T>8 MXU body, d-OUTER grid, unpack-once: grid is (d/rows, t/bt) with
     the t tiles innermost, so each packed weight tile is DMA'd and unpacked
     exactly ONCE (at ti == 0, into the wlo/whi VMEM scratch) and every t
@@ -278,8 +278,12 @@ def _matmul_body_scratch(qs3, s, xlo_ref, xhi_ref, out_ref, wlo_ref, whi_ref,
     finding, BASELINE.md r3). Decode (t == 1) is unaffected: one t tile
     means the two schedules are identical, so the matvec path keeps its
     tuned shape.
+
+    ``nb_major``: the planes are (nb, R) instead of (R, nb) — the ONLY
+    difference is which weight dim the x (bt, nb) tiles contract against,
+    so one body serves both layouts via the dot dimension numbers.
     """
-    dn = (((1,), (1,)), ((), ()))
+    dn = ((((1,), (0,)) if nb_major else ((1,), (1,))), ((), ()))
     wdt = jnp.bfloat16 if bf16 else jnp.float32
     prec = None if bf16 else jax.lax.Precision.HIGHEST
 
@@ -801,48 +805,18 @@ def _q40_multi_nb_stacked(layer, qs_t, scale, x, *, block_rows, interpret):
     )(layer, qs_t, scale, xlo, xhi, xsum)
 
 
-def _matmul_body_nb_scratch(qs3, s, xlo_ref, xhi_ref, out_ref, wlo_ref,
-                            whi_ref, bf16=False):
-    """nb-major twin of _matmul_body_scratch: d-outer grid, the packed tile
-    unpacked once into VMEM scratch at ti == 0, standard (M,K)x(K,N) dots
-    from the resident planes for every t tile."""
-    dn = (((1,), (0,)), ((), ()))
-    wdt = jnp.bfloat16 if bf16 else jnp.float32
-    prec = None if bf16 else jax.lax.Precision.HIGHEST
-
-    @pl.when(pl.program_id(1) == 0)
-    def _unpack():
-        for j in range(NJ):
-            q = qs3[j].astype(jnp.int32)                 # (nb, R)
-            wlo_ref[j, :, :] = ((((q & 0xF) - 8).astype(jnp.float32))
-                                * s).astype(wdt)
-            whi_ref[j, :, :] = ((((q >> 4) - 8).astype(jnp.float32))
-                                * s).astype(wdt)
-
-    acc = None
-    for j in range(NJ):
-        a = jax.lax.dot_general(xlo_ref[j].astype(wdt), wlo_ref[j], dn,
-                                preferred_element_type=jnp.float32,
-                                precision=prec)
-        a = a + jax.lax.dot_general(xhi_ref[j].astype(wdt), whi_ref[j], dn,
-                                    preferred_element_type=jnp.float32,
-                                    precision=prec)
-        acc = a if acc is None else acc + a
-    out_ref[...] = acc
-
-
 def _kernel_scratch_nb(qs_ref, scale_ref, xlo_ref, xhi_ref, out_ref,
                        wlo_ref, whi_ref, *, bf16=False):
-    _matmul_body_nb_scratch(qs_ref, scale_ref[...], xlo_ref, xhi_ref,
-                            out_ref, wlo_ref, whi_ref, bf16)
+    _matmul_body_scratch(qs_ref, scale_ref[...], xlo_ref, xhi_ref,
+                         out_ref, wlo_ref, whi_ref, bf16, nb_major=True)
 
 
 def _kernel_scratch_nb_stacked(layer_ref, qs_ref, scale_ref, xlo_ref,
                                xhi_ref, out_ref, wlo_ref, whi_ref, *,
                                bf16=False):
     del layer_ref
-    _matmul_body_nb_scratch(qs_ref[0], scale_ref[0], xlo_ref, xhi_ref,
-                            out_ref, wlo_ref, whi_ref, bf16)
+    _matmul_body_scratch(qs_ref[0], scale_ref[0], xlo_ref, xhi_ref,
+                         out_ref, wlo_ref, whi_ref, bf16, nb_major=True)
 
 
 @functools.partial(jax.jit,

@@ -64,17 +64,20 @@ def _bucket(op_name: str) -> str:
 
 
 def _profile_chunk(engine, toks, chunk, trace_dir):
-    """Op-time split of ONE steady-state chunk (prior chunks warm the
-    compile caches so the trace holds execution only)."""
+    """Op-time split of ONE chunk at positions 0..chunk (a first warm run
+    compiles; the traced run starts from a reset cache so every position
+    stays inside seq_len — a window at pos0=chunk would run past the cache
+    for chunk > seq_len/2 and silently clamp its writes)."""
     import jax
 
     from distributed_llama_tpu.utils.it_split import parse_trace
 
     engine.reset()
     engine.prefill(toks[:chunk], 0, chunk)  # warm/compile outside the trace
+    engine.reset()
     with jax.profiler.trace(trace_dir):
-        engine.prefill(toks[:chunk], chunk, chunk)
-        np.asarray(engine.cache.k[-1, 2 * chunk - 1, 0, :8])
+        engine.prefill(toks[:chunk], 0, chunk)
+        np.asarray(engine.cache.k[-1, chunk - 1, 0, :8])
     splits = parse_trace(trace_dir)
     buckets: dict[str, float] = {}
     for split in splits.values():
@@ -87,7 +90,8 @@ def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--chunks", default="480,960,1920")
     ap.add_argument("--modes", default="legacy_bf16,scratch_bf16,dequant_bf16,legacy_f32")
-    ap.add_argument("--config", default="7b", choices=("7b", "small"))
+    ap.add_argument("--config", default="7b",
+                    choices=("7b", "13b", "small"))
     ap.add_argument("--trials", type=int, default=3)
     ap.add_argument("--out", default=None, help="also write the JSON here")
     args = ap.parse_args()
@@ -96,14 +100,16 @@ def main() -> int:
 
     import jax
 
-    from distributed_llama_tpu.models.synth import (llama2_7b_spec,
+    from distributed_llama_tpu.models.synth import (llama2_13b_spec,
+                                                    llama2_7b_spec,
                                                     small_bench_spec,
                                                     synth_q40_fast)
     from distributed_llama_tpu.utils.compile_cache import (
         enable_persistent_cache)
 
     enable_persistent_cache()
-    spec = llama2_7b_spec() if args.config == "7b" else small_bench_spec()
+    spec = {"7b": llama2_7b_spec, "13b": llama2_13b_spec,
+            "small": small_bench_spec}[args.config]()
     print(f"backend {jax.default_backend()}  config {args.config}", flush=True,
           file=sys.stderr)
     t0 = time.perf_counter()
@@ -114,9 +120,10 @@ def main() -> int:
     from distributed_llama_tpu.ops.linear import (fuse_q40_layer_matmuls,
                                                   pack_q40_params)
 
+    # 13b picks the nb-major layout (its nb=160 pads 1.6x d-major)
     params = device_params_like(fuse_q40_layer_matmuls(
         pack_q40_params(synth_q40_fast(spec), enable=True,
-                        allow_nb_major=False)))
+                        allow_nb_major=(args.config == "13b"))))
     jax.block_until_ready(params)
     print(f"synth+pack+devgen: {time.perf_counter() - t0:.1f}s",
           file=sys.stderr)
@@ -127,10 +134,24 @@ def main() -> int:
         os.environ["DLLAMA_PREFILL_MATMUL"] = strategy
         from distributed_llama_tpu.runtime.generate import Engine
 
-        engine = Engine(spec, params, fast_prefill=fast)
+        cache_dtype = None
+        if args.config == "13b":
+            import jax.numpy as jnp
+
+            cache_dtype = jnp.bfloat16  # 13B f32 cache exceeds one chip
+        engine = Engine(spec, params, fast_prefill=fast,
+                        cache_dtype=cache_dtype)
         for chunk in chunks:
             n = min(4 * chunk, spec.seq_len - 8)
             n -= n % chunk  # whole windows only: per-chunk math stays exact
+            if n == 0:
+                row = {"mode": mode, "chunk": chunk,
+                       "skipped": f"chunk {chunk} exceeds "
+                                  f"seq_len-8={spec.seq_len - 8}"}
+                results.append(row)
+                print(json.dumps(row), flush=True)
+                continue
+            windows = n // chunk
             toks = [7] * n
             rates, walls = [], []
             try:
@@ -142,19 +163,23 @@ def main() -> int:
                     dt = time.perf_counter() - t0
                     if trial:
                         rates.append(n / dt)
-                        walls.append(dt / (n / chunk) * 1000)
-                row = {"mode": mode, "chunk": chunk,
+                        walls.append(dt * 1000)
+                # >=2 full windows run as ONE device program (Engine's
+                # fused window loop), so dispatch is per PREFILL CALL, not
+                # per chunk — report it that way
+                wall = float(np.median(walls))
+                row = {"mode": mode, "chunk": chunk, "windows": windows,
+                       "launches_per_prefill": 1 if windows >= 2 else windows,
                        "tok_s": round(float(np.median(rates)), 1),
-                       "wall_ms_per_chunk":
-                           round(float(np.median(walls)), 2)}
+                       "wall_ms_per_prefill": round(wall, 2)}
                 trace = f"/tmp/prefill_ladder_{mode}_{chunk}"
                 try:
                     ops = _profile_chunk(engine, toks, chunk, trace)
                     op_total = round(sum(ops.values()), 2)
                     row["op_ms_per_chunk"] = ops
                     row["op_total_ms"] = op_total
-                    row["dispatch_ms_per_chunk"] = round(
-                        row["wall_ms_per_chunk"] - op_total, 2)
+                    row["dispatch_ms_per_prefill"] = round(
+                        wall - op_total * windows, 2)
                 except Exception as e:  # profile is best-effort
                     row["profile_error"] = f"{type(e).__name__}: {e}"
             except Exception as e:
