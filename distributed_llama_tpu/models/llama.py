@@ -112,13 +112,95 @@ def causal_cache_mask(seq_len: int, pos: jax.Array, t_len: int) -> jax.Array:
     return jnp.arange(seq_len)[None, :] <= q_pos[:, None]
 
 
+def _prefill_attn_mode() -> str:
+    """T>8 attention strategy — DLLAMA_PREFILL_ATTN: 'block' (while_loop
+    over live KV blocks, work bounded by pos+T), 'dense' (score the whole
+    seq_len plane, mask the rest), 'auto' (= block). Read at trace time.
+    Unknown values raise (a typo would otherwise silently run the ~38%-
+    slower dense path)."""
+    import os
+
+    mode = os.environ.get("DLLAMA_PREFILL_ATTN", "auto")
+    if mode not in ("auto", "block", "dense"):
+        raise ValueError(f"DLLAMA_PREFILL_ATTN={mode!r}: "
+                         f"expected auto|block|dense")
+    return "block" if mode == "auto" else mode
+
+
+def _pick_attn_block(seq_len: int) -> int | None:
+    """Largest KV block <= 512 dividing seq_len (None -> dense path)."""
+    for cand in (512, 256, 128, 64, 32):
+        if seq_len % cand == 0:
+            return cand
+    return None
+
+
+def _attention_blockwise(spec: TransformerSpec, q: jax.Array,
+                         k_cache: jax.Array, v_cache: jax.Array,
+                         pos: jax.Array, t_len: int,
+                         block: int) -> jax.Array:
+    """Prefill attention with work bounded by the LIVE prefix: a while_loop
+    over ceil((pos+T)/block) KV blocks with running-LSE accumulation
+    (parallel.ring._partial_attention — the same flash partials the sp and
+    ring paths use), merged block by block.
+
+    The dense path (attention_core) scores every one of seq_len cache slots
+    and masks the dead ones — at seq_len 8192 an early chunk of a
+    long-context prefill wastes ~4x its attention FLOPs and score traffic
+    on masked keys (measured ~35% of deep-chunk op time, BASELINE.md r3
+    ladder note 4). Same masking contract, f32 accumulation; online-softmax
+    reassociation only (prefill parity tolerances unchanged).
+    """
+    from ..ops.linear import matmul_mode
+    from ..parallel.ring import _partial_attention  # lazy: no import cycle
+
+    hs, kv_mul = spec.head_size, spec.kv_mul
+    n_q = q.shape[-2]
+    bf16 = matmul_mode() == "bf16"  # fast-prefill: bf16 MXU passes
+    q_pos = pos + jnp.arange(t_len)
+    n_live = (pos + t_len + block - 1) // block
+
+    def cond(carry):
+        return carry[0] < n_live
+
+    def body(carry):
+        b, m, l, o = carry
+        k_blk = jax.lax.dynamic_slice_in_dim(k_cache, b * block, block, 0)
+        v_blk = jax.lax.dynamic_slice_in_dim(v_cache, b * block, block, 0)
+        key_pos = b * block + jnp.arange(block)
+        valid = key_pos[None, :] <= q_pos[:, None]
+        pm, pl, po = _partial_attention(hs, kv_mul, q, k_blk, v_blk, valid,
+                                        bf16=bf16)
+        m_new = jnp.maximum(m, pm)
+        # block 0 always holds visible keys for every query row (pos >= 0),
+        # so m_new is finite from the first merge; -inf partials of fully
+        # masked later rows contribute exp(-inf - finite) = 0
+        c_old = jnp.exp(m - m_new)
+        c_new = jnp.exp(pm - m_new)
+        return (b + 1, m_new, l * c_old + pl * c_new,
+                o * c_old + po * c_new)
+
+    init = (jnp.int32(0),
+            jnp.full((t_len, n_q, 1), -jnp.inf, jnp.float32),
+            jnp.zeros((t_len, n_q, 1), jnp.float32),
+            jnp.zeros((t_len, n_q, hs), jnp.float32))
+    _, _, l, o = jax.lax.while_loop(cond, body, init)
+    return (o / jnp.maximum(l, 1e-38)).reshape(t_len, -1)
+
+
 def attention(spec: TransformerSpec, q: jax.Array, k_cache: jax.Array,
               v_cache: jax.Array, pos: jax.Array, t_len: int) -> jax.Array:
     """Causal attention of t_len new queries against the full cache.
 
     q: (T, n_heads, head_size); caches: (seq_len, n_kv_heads, head_size).
-    Returns (T, dim).
+    Returns (T, dim). T>8 (prefill chunks) takes the blockwise live-prefix
+    path by default; T<=8 and the dense fallback score the full plane.
     """
+    if t_len > 8 and _prefill_attn_mode() == "block":
+        block = _pick_attn_block(spec.seq_len)
+        if block is not None:
+            return _attention_blockwise(spec, q, k_cache, v_cache, pos,
+                                        t_len, block)
     mask = causal_cache_mask(spec.seq_len, pos, t_len)
     return attention_core(spec.head_size, spec.kv_mul, q, k_cache, v_cache,
                           mask)

@@ -39,27 +39,34 @@ def _lse_combine_partials(m, l, o, axis: str):
     return g_o / jnp.maximum(g_l, 1e-38)
 
 
-def _partial_attention(head_size: int, kv_mul: int, q, k, v, valid):
+def _partial_attention(head_size: int, kv_mul: int, q, k, v, valid,
+                       bf16: bool = False):
     """Flash-style partials of q against one key chunk.
 
     q: (T, n_q, hs); k/v: (C, n_kv, hs); valid: (T, C) True where the key is
     visible. Returns m (T, n_q, 1), l (T, n_q, 1), o (T, n_q, hs) in f32.
+    ``bf16`` (fast-prefill, threaded by the blockwise prefill path): bf16
+    MXU passes with f32 accumulation for the two einsums — softmax stats
+    and merges stay f32. The sp/ring callers keep the HIGHEST default (the
+    training/parity contract).
     """
     t_len, n_q, _ = q.shape
     n_kv = k.shape[1]
-    qg = q.reshape(t_len, n_kv, kv_mul, head_size)
+    wdt = jnp.bfloat16 if bf16 else jnp.float32
+    prec = None if bf16 else jax.lax.Precision.HIGHEST
+    qg = q.reshape(t_len, n_kv, kv_mul, head_size).astype(wdt)
     scale = 1.0 / jnp.sqrt(jnp.float32(head_size))
-    s = jnp.einsum("tgmd,cgd->gmtc", qg, k,
+    s = jnp.einsum("tgmd,cgd->gmtc", qg, k.astype(wdt),
                    preferred_element_type=jnp.float32,
-                   precision=jax.lax.Precision.HIGHEST) * scale
+                   precision=prec) * scale
     s = jnp.where(valid[None, None, :, :], s, -jnp.inf)
     m = jnp.max(s, axis=-1, keepdims=True)            # (g, m, T, 1)
     m_safe = jnp.where(jnp.isfinite(m), m, 0.0)       # all-masked chunk -> 0
     p = jnp.where(jnp.isfinite(m), jnp.exp(s - m_safe), 0.0)
     l = jnp.sum(p, axis=-1, keepdims=True)
-    o = jnp.einsum("gmtc,cgd->gmtd", p, v,
+    o = jnp.einsum("gmtc,cgd->gmtd", p.astype(wdt), v.astype(wdt),
                    preferred_element_type=jnp.float32,
-                   precision=jax.lax.Precision.HIGHEST)
+                   precision=prec)
     # -> (T, n_q, ...) layout
     perm = (2, 0, 1, 3)
     return (m.transpose(perm).reshape(t_len, n_q, 1),

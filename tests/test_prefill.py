@@ -259,3 +259,33 @@ def test_fused_prefill_loop_matches_per_chunk_dispatch():
                                np.asarray(eng_b.cache.k[:, :n]),
                                rtol=1e-6, atol=1e-6)
     np.testing.assert_allclose(la, lb, rtol=1e-6, atol=1e-6)
+
+
+def test_blockwise_prefill_attention_matches_dense(monkeypatch):
+    """T>8 prefill attention via the blockwise live-prefix while_loop
+    (DLLAMA_PREFILL_ATTN=block, the default) must match the dense
+    masked-plane path within online-softmax reassociation noise."""
+    spec = TransformerSpec(dim=64, hidden_dim=160, n_layers=2, n_heads=4,
+                           n_kv_heads=2, vocab_size=300, seq_len=64)
+    params = synth_params(spec, q40=False, seed=21, scale=0.3)
+    tokens = list(np.random.default_rng(5).integers(2, 290, 48))
+
+    out = {}
+    for mode in ("block", "dense"):
+        monkeypatch.setenv("DLLAMA_PREFILL_ATTN", mode)
+        eng = Engine(spec, params)
+        eng.prefill(tokens, 0, chunk=16)
+        out[mode] = (np.asarray(eng.cache.k[:, :49]),
+                     eng.infer(7, len(tokens)))
+    np.testing.assert_allclose(out["block"][0], out["dense"][0],
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(out["block"][1], out["dense"][1],
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_prefill_attn_mode_rejects_typos(monkeypatch):
+    monkeypatch.setenv("DLLAMA_PREFILL_ATTN", "blockwise")
+    from distributed_llama_tpu.models.llama import _prefill_attn_mode
+
+    with pytest.raises(ValueError, match="DLLAMA_PREFILL_ATTN"):
+        _prefill_attn_mode()
