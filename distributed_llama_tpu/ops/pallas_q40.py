@@ -258,11 +258,13 @@ def _kernel_mxu_nb_stacked(layer_ref, qs_ref, scale_ref, xlo_ref, xhi_ref,
 
 MULTI_T_MAX = 8  # beyond this the per-row accumulators crowd VMEM; use MXU
 
-# The scratch MXU kernels keep a whole unpacked weight tile resident next to
-# the pipeline buffers; Mosaic's conservative scoped-VMEM accounting rejects
-# that at the default 16 MB even though the real footprint is ~8-12 MB (v5e
-# has 128 MB physical). Same approach as ops/pallas_layer._VMEM_LIMIT.
-_PREFILL_PARAMS = pltpu.CompilerParams(vmem_limit_bytes=64 * 1024 * 1024)
+# Raised scoped-VMEM limit for the T>1 kernels (MXU prefill bodies, the
+# unpack-once scratch kernels, and the T<=8 VPU multi bodies batched decode
+# uses): Mosaic's conservative stack accounting rejects several measured-fine
+# tile sets at the default 16 MB (e.g. 22.6M at w2's nb=344/bt=32 prefill
+# tile, 26.3M at the 13B B=2 multi tile) though v5e has 128 MB physical.
+# Same approach as ops/pallas_layer._VMEM_LIMIT.
+_VMEM64_PARAMS = pltpu.CompilerParams(vmem_limit_bytes=64 * 1024 * 1024)
 
 
 def _matmul_body_scratch(qs3, s, xlo_ref, xhi_ref, out_ref, wlo_ref, whi_ref,
@@ -343,7 +345,7 @@ def _q40_matmul_2d_scratch(qs_t, scale, x, *, block_rows, block_t,
         out_shape=jax.ShapeDtypeStruct((t, d), jnp.float32),
         scratch_shapes=[pltpu.VMEM((NJ, block_rows, nb), wdt),
                         pltpu.VMEM((NJ, block_rows, nb), wdt)],
-        compiler_params=_PREFILL_PARAMS,
+        compiler_params=_VMEM64_PARAMS,
         interpret=interpret,
     )(qs_t, scale, xlo, xhi)
     return out
@@ -377,7 +379,7 @@ def _q40_matmul_stacked_scratch(layer, qs_t, scale, x, *, block_rows,
         functools.partial(_kernel_scratch_stacked, bf16=bf16),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((t, d), jnp.float32),
-        compiler_params=_PREFILL_PARAMS,
+        compiler_params=_VMEM64_PARAMS,
         interpret=interpret,
     )(layer, qs_t, scale, xlo, xhi)
 
@@ -474,13 +476,16 @@ def _q40_matmul_2d(qs_t, scale, x, *, block_rows, block_t, interpret,
             ],
             out_specs=pl.BlockSpec((block_rows, t), lambda i: (i, 0)),
             out_shape=jax.ShapeDtypeStruct((d, t), jnp.float32),
+            # wide-nb 13B shapes (w2 nb=432 at t=2) measure ~26M of scoped
+            # stack against the 16M default — raise like the MXU kernels
+            compiler_params=_VMEM64_PARAMS,
             interpret=interpret,
         )(qs_t, scale, xlo, xhi, xsum)
         return jnp.transpose(out)                    # (t, d)
     grid = (t // block_t, d // block_rows)
     out = pl.pallas_call(
         functools.partial(_kernel, bf16=bf16),
-        compiler_params=_PREFILL_PARAMS,
+        compiler_params=_VMEM64_PARAMS,
         grid=grid,
         in_specs=[
             pl.BlockSpec((NJ, block_rows, nb), lambda ti, i: (0, i, 0)),
@@ -542,7 +547,7 @@ def _q40_matmul_stacked(layer, qs_t, scale, x, *, block_rows, block_t,
         out = pl.pallas_call(
             _kernel_multi_stacked, grid_spec=grid_spec,
             out_shape=jax.ShapeDtypeStruct((d, t), jnp.float32),
-            interpret=interpret,
+            compiler_params=_VMEM64_PARAMS, interpret=interpret,
         )(layer, qs_t, scale, xlo, xhi, xsum)
         return jnp.transpose(out)                    # (t, d)
     grid_spec = pltpu.PrefetchScalarGridSpec(
@@ -561,7 +566,7 @@ def _q40_matmul_stacked(layer, qs_t, scale, x, *, block_rows, block_t,
     return pl.pallas_call(
         functools.partial(_kernel_stacked, bf16=bf16), grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((t, d), jnp.float32),
-        compiler_params=_PREFILL_PARAMS, interpret=interpret,
+        compiler_params=_VMEM64_PARAMS, interpret=interpret,
     )(layer, qs_t, scale, xlo, xhi)
 
 
@@ -596,8 +601,11 @@ def _pick_block_rows(d: int, t: int = 1, nb: int = 128,
         step, cap = 8, max(8, 360_000 // nb)
     elif t <= MULTI_T_MAX:
         # the compiler keeps several unrolled-plane temporaries live next to
-        # the t accumulators; 300k f32 words of rows*nb*t keeps the whole
-        # stack under the 16MB scoped-vmem limit with double buffering
+        # the t accumulators; the 300k rows*nb*t cap was sized against the
+        # old 16MB scoped limit — the multi kernels now run with the raised
+        # _VMEM64_PARAMS (wide-nb shapes measured ~26M), so the cap is a
+        # conservative tile-size heuristic, not a hard ceiling; bigger tiles
+        # are unexplored headroom
         step, cap = 8, max(8, 300_000 // (t * nb))
     else:
         # MXU path. With a FULL 128-row t-tile Mosaic pipelines the
@@ -771,6 +779,9 @@ def _q40_multi_nb_2d(qs_t, scale, x, *, block_rows, interpret):
         ],
         out_specs=pl.BlockSpec((t, block_rows), lambda i: (0, i)),
         out_shape=jax.ShapeDtypeStruct((t, d), jnp.float32),
+        # 13B batch shapes (wqkv d=15360 at t=2) measure 16.9M of scoped
+        # stack against the 16M default — raise like the MXU kernels
+        compiler_params=_VMEM64_PARAMS,
         interpret=interpret,
     )(qs_t, scale, xlo, xhi, xsum)
     return out                                        # (t, d)
@@ -801,7 +812,7 @@ def _q40_multi_nb_stacked(layer, qs_t, scale, x, *, block_rows, interpret):
     return pl.pallas_call(
         _kernel_multi_nb_stacked, grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((t, d), jnp.float32),
-        interpret=interpret,
+        compiler_params=_VMEM64_PARAMS, interpret=interpret,
     )(layer, qs_t, scale, xlo, xhi, xsum)
 
 
@@ -841,7 +852,7 @@ def _q40_mxu_nb_2d_scratch(qs_t, scale, x, *, block_rows, block_t,
         out_shape=jax.ShapeDtypeStruct((t, d), jnp.float32),
         scratch_shapes=[pltpu.VMEM((NJ, nb, block_rows), wdt),
                         pltpu.VMEM((NJ, nb, block_rows), wdt)],
-        compiler_params=_PREFILL_PARAMS,
+        compiler_params=_VMEM64_PARAMS,
         interpret=interpret,
     )(qs_t, scale, xlo, xhi)
 
@@ -874,7 +885,7 @@ def _q40_mxu_nb_stacked_scratch(layer, qs_t, scale, x, *, block_rows,
         functools.partial(_kernel_scratch_nb_stacked, bf16=bf16),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((t, d), jnp.float32),
-        compiler_params=_PREFILL_PARAMS,
+        compiler_params=_VMEM64_PARAMS,
         interpret=interpret,
     )(layer, qs_t, scale, xlo, xhi)
 
@@ -889,7 +900,7 @@ def _q40_mxu_nb_2d(qs_t, scale, x, *, block_rows, block_t, interpret,
     xlo, xhi = _split_x(x.astype(jnp.float32), nb)   # (NJ, t, nb) — natural
     out = pl.pallas_call(
         functools.partial(_kernel_mxu_nb, bf16=bf16),
-        compiler_params=_PREFILL_PARAMS,
+        compiler_params=_VMEM64_PARAMS,
         grid=(t // block_t, d // block_rows),
         in_specs=[
             pl.BlockSpec((NJ, nb, block_rows), lambda ti, i: (0, 0, i)),
@@ -929,7 +940,7 @@ def _q40_mxu_nb_stacked(layer, qs_t, scale, x, *, block_rows, block_t,
         functools.partial(_kernel_mxu_nb_stacked, bf16=bf16),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((t, d), jnp.float32),
-        compiler_params=_PREFILL_PARAMS, interpret=interpret,
+        compiler_params=_VMEM64_PARAMS, interpret=interpret,
     )(layer, qs_t, scale, xlo, xhi)
 
 
