@@ -604,9 +604,18 @@ def _pick_block_rows(d: int, t: int = 1, nb: int = 128,
         # the t accumulators; the 300k rows*nb*t cap was sized against the
         # old 16MB scoped limit — the multi kernels now run with the raised
         # _VMEM64_PARAMS (wide-nb shapes measured ~26M), so the cap is a
-        # conservative tile-size heuristic, not a hard ceiling; bigger tiles
-        # are unexplored headroom
-        step, cap = 8, max(8, 300_000 // (t * nb))
+        # tile-size heuristic, not a hard ceiling. DLLAMA_MULTI_CAP
+        # overrides it (tile-size experiments via tools/batch_bench.py;
+        # measured flat 300k/600k/1200k at 13B B=2 — tile granularity is
+        # not that path's limiter)
+        raw = os.environ.get("DLLAMA_MULTI_CAP", "")
+        try:
+            cap_words = int(raw) if raw else 300_000
+        except ValueError:
+            raise ValueError(
+                f"DLLAMA_MULTI_CAP={raw!r}: expected a plain integer "
+                f"(rows*nb*t word budget, e.g. 600000)") from None
+        step, cap = 8, max(8, cap_words // (t * nb))
     else:
         # MXU path. With a FULL 128-row t-tile Mosaic pipelines the
         # unrolled-plane f32 temporaries within the budget; at smaller
