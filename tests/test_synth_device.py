@@ -1,0 +1,55 @@
+"""device_params_like: on-device synthetic regeneration of a packed tree.
+
+The bench path depends on two properties (BASELINE.md r3 warm start): the
+regenerated tree must be structurally IDENTICAL to the host tree (shapes,
+dtypes, treedef — the AOT decode loop compiles against these), and float
+leaves must be small positive values (Q40 scales must be positive; no
+inf/nan reachable downstream).
+"""
+
+import numpy as np
+
+from distributed_llama_tpu.models.synth import (device_params_like,
+                                                small_bench_spec,
+                                                synth_q40_fast)
+from distributed_llama_tpu.ops.linear import (fuse_q40_layer_matmuls,
+                                              pack_q40_params)
+
+
+def test_device_params_like_preserves_structure():
+    import jax
+
+    spec = small_bench_spec()
+    host = fuse_q40_layer_matmuls(
+        pack_q40_params(synth_q40_fast(spec), enable=True,
+                        allow_nb_major=False))
+    dev = device_params_like(host)
+    h_leaves, h_def = jax.tree_util.tree_flatten(host)
+    d_leaves, d_def = jax.tree_util.tree_flatten(dev)
+    assert h_def == d_def
+    for h, d in zip(h_leaves, d_leaves):
+        assert tuple(h.shape) == tuple(d.shape)
+        assert str(np.asarray(h).dtype) == str(d.dtype)
+    for leaf in d_leaves:
+        if str(leaf.dtype).startswith(("float", "bfloat")):
+            a = np.asarray(leaf, dtype=np.float32)
+            assert np.isfinite(a).all()
+            assert a.min() > 0.0  # positive: the Q40 scale contract
+
+
+def test_device_params_like_accepts_shape_structs():
+    """The bench shape-manifest path feeds ShapeDtypeStructs, not arrays."""
+    import jax
+
+    spec = small_bench_spec()
+    host = fuse_q40_layer_matmuls(
+        pack_q40_params(synth_q40_fast(spec), enable=True,
+                        allow_nb_major=False))
+    sds = jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(np.asarray(a).shape,
+                                       np.asarray(a).dtype), host)
+    dev = device_params_like(sds)
+    for h, d in zip(jax.tree_util.tree_leaves(sds),
+                    jax.tree_util.tree_leaves(dev)):
+        assert tuple(h.shape) == tuple(d.shape)
+        assert str(h.dtype) == str(d.dtype)
