@@ -149,42 +149,17 @@ def _attention_blockwise(spec: TransformerSpec, q: jax.Array,
     long-context prefill wastes ~4x its attention FLOPs and score traffic
     on masked keys (measured ~35% of deep-chunk op time, BASELINE.md r3
     ladder note 4). Same masking contract, f32 accumulation; online-softmax
-    reassociation only (prefill parity tolerances unchanged).
+    reassociation only (prefill parity tolerances unchanged). The walk
+    itself is parallel.ring.blockwise_chunk_partials (shared with the
+    sp-sharded path), with chunk_start=0 for the unsharded plane.
     """
     from ..ops.linear import matmul_mode
-    from ..parallel.ring import _partial_attention  # lazy: no import cycle
+    from ..parallel.ring import blockwise_chunk_partials  # lazy: no cycle
 
-    hs, kv_mul = spec.head_size, spec.kv_mul
-    n_q = q.shape[-2]
-    bf16 = matmul_mode() == "bf16"  # fast-prefill: bf16 MXU passes
     q_pos = pos + jnp.arange(t_len)
-    n_live = (pos + t_len + block - 1) // block
-
-    def cond(carry):
-        return carry[0] < n_live
-
-    def body(carry):
-        b, m, l, o = carry
-        k_blk = jax.lax.dynamic_slice_in_dim(k_cache, b * block, block, 0)
-        v_blk = jax.lax.dynamic_slice_in_dim(v_cache, b * block, block, 0)
-        key_pos = b * block + jnp.arange(block)
-        valid = key_pos[None, :] <= q_pos[:, None]
-        pm, pl, po = _partial_attention(hs, kv_mul, q, k_blk, v_blk, valid,
-                                        bf16=bf16)
-        m_new = jnp.maximum(m, pm)
-        # block 0 always holds visible keys for every query row (pos >= 0),
-        # so m_new is finite from the first merge; -inf partials of fully
-        # masked later rows contribute exp(-inf - finite) = 0
-        c_old = jnp.exp(m - m_new)
-        c_new = jnp.exp(pm - m_new)
-        return (b + 1, m_new, l * c_old + pl * c_new,
-                o * c_old + po * c_new)
-
-    init = (jnp.int32(0),
-            jnp.full((t_len, n_q, 1), -jnp.inf, jnp.float32),
-            jnp.zeros((t_len, n_q, 1), jnp.float32),
-            jnp.zeros((t_len, n_q, hs), jnp.float32))
-    _, _, l, o = jax.lax.while_loop(cond, body, init)
+    _, l, o = blockwise_chunk_partials(
+        spec.head_size, spec.kv_mul, q, k_cache, v_cache, jnp.int32(0),
+        q_pos, block=block, bf16=matmul_mode() == "bf16")
     return (o / jnp.maximum(l, 1e-38)).reshape(t_len, -1)
 
 

@@ -39,6 +39,18 @@ def _lse_combine_partials(m, l, o, axis: str):
     return g_o / jnp.maximum(g_l, 1e-38)
 
 
+def _lse_merge(m, l, o, pm, pl, po):
+    """Merge one flash partial into the running (m, l, o) stats — -inf-safe
+    on BOTH sides (rows that have seen no visible key stay zeroed). THE one
+    copy of the running-softmax merge, shared by ring_attention,
+    blockwise_chunk_partials, and models.llama's blockwise prefill."""
+    m_new = jnp.maximum(m, pm)
+    m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+    c_old = jnp.where(jnp.isfinite(m), jnp.exp(m - m_safe), 0.0)
+    c_new = jnp.where(jnp.isfinite(pm), jnp.exp(pm - m_safe), 0.0)
+    return m_new, l * c_old + pl * c_new, o * c_old + po * c_new
+
+
 def _partial_attention(head_size: int, kv_mul: int, q, k, v, valid,
                        bf16: bool = False):
     """Flash-style partials of q against one key chunk.
@@ -83,13 +95,76 @@ def sp_cache_attention(head_size: int, kv_mul: int, seq_chunk: int,
     absolute position. Returns (T, n_q*hs), exact softmax over the global
     cache prefix 0..pos+T-1.
     """
+    from ..models.llama import _prefill_attn_mode  # lazy: no import cycle
+
     t_len = q.shape[0]
     q_pos = pos + jnp.arange(t_len)                     # (T,)
-    key_pos = sp_index * seq_chunk + jnp.arange(seq_chunk)
-    valid = key_pos[None, :] <= q_pos[:, None]          # (T, C)
-    m, l, o = _partial_attention(head_size, kv_mul, q, k_chunk, v_chunk, valid)
+    if t_len > 8 and _prefill_attn_mode() == "block":
+        # prefill chunks: bound the scored keys by the live prefix (the
+        # dense partial below masks-but-computes the whole chunk — at
+        # tp-only meshes the chunk IS the full seq plane; same finding as
+        # models.llama's blockwise prefill, BASELINE.md r3). Honors the
+        # same DLLAMA_PREFILL_ATTN=dense escape hatch as the single-chip
+        # path.
+        m, l, o = blockwise_chunk_partials(
+            head_size, kv_mul, q, k_chunk, v_chunk,
+            sp_index * seq_chunk, q_pos)
+    else:
+        key_pos = sp_index * seq_chunk + jnp.arange(seq_chunk)
+        valid = key_pos[None, :] <= q_pos[:, None]      # (T, C)
+        m, l, o = _partial_attention(head_size, kv_mul, q, k_chunk,
+                                     v_chunk, valid)
     out = _lse_combine_partials(m, l, o, axis)          # (T, n_q, hs)
     return out.reshape(t_len, -1)
+
+
+def blockwise_chunk_partials(head_size: int, kv_mul: int, q, k_chunk,
+                             v_chunk, chunk_start, q_pos, block: int = 512,
+                             bf16: bool = False):
+    """Flash partials of q against ONE cache chunk, walking only the KV
+    blocks the causal mask can reach: a while_loop over blocks of the chunk
+    below max(q_pos)+1, running-LSE merged. Same (m, l, o) contract as
+    _partial_attention — fully-masked chunks return m = -inf, so the
+    cross-axis LSE combine is unchanged.
+
+    ``chunk_start``: absolute position of k_chunk[0] (the sp shard offset;
+    0 for an unsharded plane). Blocks whose start is past the last query
+    are never touched; within the walked range the per-key mask applies as
+    usual. ``bf16`` threads the fast-prefill MXU precision into the
+    partials (stats and merges stay f32).
+    """
+    t_len, n_q, _ = q.shape
+    c = k_chunk.shape[0]
+    blk = block
+    while c % blk:  # largest power-of-two-ish divisor fallback
+        blk //= 2
+        if blk < 8:
+            blk = c
+            break
+    last_q = q_pos[-1]  # positions ascend: the deepest visible key
+    # live blocks of THIS chunk: keys at chunk_start + [0, c) are visible
+    # iff <= last_q
+    n_live = jnp.clip((last_q + 1 - chunk_start + blk - 1) // blk, 0, c // blk)
+
+    def cond(carry):
+        return carry[0] < n_live
+
+    def body(carry):
+        b, m, l, o = carry
+        k_blk = jax.lax.dynamic_slice_in_dim(k_chunk, b * blk, blk, 0)
+        v_blk = jax.lax.dynamic_slice_in_dim(v_chunk, b * blk, blk, 0)
+        key_pos = chunk_start + b * blk + jnp.arange(blk)
+        valid = key_pos[None, :] <= q_pos[:, None]
+        pm, pl, po = _partial_attention(head_size, kv_mul, q, k_blk, v_blk,
+                                        valid, bf16=bf16)
+        return (b + 1, *_lse_merge(m, l, o, pm, pl, po))
+
+    init = (jnp.int32(0),
+            jnp.full((t_len, n_q, 1), -jnp.inf, jnp.float32),
+            jnp.zeros((t_len, n_q, 1), jnp.float32),
+            jnp.zeros((t_len, n_q, head_size), jnp.float32))
+    _, m, l, o = jax.lax.while_loop(cond, body, init)
+    return m, l, o
 
 
 def update_sp_cache(cache_chunk, new_vals, pos, sp_index, seq_chunk: int):
@@ -133,13 +208,7 @@ def ring_attention(head_size: int, kv_mul: int, q, k, v, q_start, chunk: int,
         valid = key_pos[None, :] <= q_pos[:, None]
         pm, plv, po = _partial_attention(head_size, kv_mul, q, k_rot, v_rot,
                                          valid)
-        # running LSE merge of (m,l,o) with the new partial
-        nm = jnp.maximum(m, pm)
-        nm_safe = jnp.where(jnp.isfinite(nm), nm, 0.0)
-        c_old = jnp.where(jnp.isfinite(m), jnp.exp(m - nm_safe), 0.0)
-        c_new = jnp.where(jnp.isfinite(pm), jnp.exp(pm - nm_safe), 0.0)
-        l2 = l * c_old + plv * c_new
-        o2 = o * c_old + po * c_new
+        nm, l2, o2 = _lse_merge(m, l, o, pm, plv, po)
         # rotate KV to the next rank (ring: receive from rank+1's chunk)
         perm = [(j, (j - 1) % axis_size) for j in range(axis_size)]
         k_next = jax.lax.ppermute(k_rot, axis, perm)

@@ -131,3 +131,40 @@ def test_update_sp_cache_straddle():
     np.testing.assert_array_equal(np.asarray(c1[1]), np.asarray(new[3]))
     assert not np.any(np.asarray(c0[:6]))
     assert not np.any(np.asarray(c1[2:]))
+
+
+def test_blockwise_chunk_partials_match_dense_partials():
+    """The T>8 live-prefix walk inside sp_cache_attention must produce the
+    same (m, l, o) flash partials as one dense masked pass over the chunk,
+    including rows that see nothing of this chunk (m = -inf) and chunks
+    entirely past the live prefix."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_llama_tpu.parallel.ring import (_partial_attention,
+                                                     blockwise_chunk_partials)
+
+    rng = np.random.default_rng(17)
+    hs, kv_mul, n_kv, t_len, c = 16, 2, 2, 12, 64
+    n_q = n_kv * kv_mul
+    q = jnp.asarray(rng.standard_normal((t_len, n_q, hs)).astype(np.float32))
+    k = jnp.asarray(rng.standard_normal((c, n_kv, hs)).astype(np.float32))
+    v = jnp.asarray(rng.standard_normal((c, n_kv, hs)).astype(np.float32))
+
+    for chunk_start, pos in ((0, 5), (64, 5), (64, 70), (0, 100)):
+        q_pos = pos + jnp.arange(t_len)
+        key_pos = chunk_start + np.arange(c)
+        valid = jnp.asarray(key_pos[None, :] <= np.asarray(q_pos)[:, None])
+        want = _partial_attention(hs, kv_mul, q, k, v, valid)
+        got = blockwise_chunk_partials(hs, kv_mul, q, k, v,
+                                       jnp.int32(chunk_start), q_pos,
+                                       block=16)
+        for w, g, name in zip(want, got, ("m", "l", "o")):
+            w, g = np.asarray(w), np.asarray(g)
+            if name == "m":
+                # -inf rows must agree exactly; finite rows to fp tolerance
+                np.testing.assert_array_equal(np.isfinite(w), np.isfinite(g))
+                w, g = np.nan_to_num(w, neginf=0), np.nan_to_num(g, neginf=0)
+            np.testing.assert_allclose(g, w, rtol=1e-5, atol=1e-5,
+                                       err_msg=f"{name} at "
+                                               f"({chunk_start}, {pos})")
