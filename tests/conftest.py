@@ -33,6 +33,7 @@ import pytest  # noqa: E402
 # DLLAMA_RUN_SLOW=1 also re-includes them without editing flags.
 SLOW_FILES = {"test_multihost.py", "test_sp_train.py", "test_train_cli.py"}
 SLOW_TESTS = {
+    "test_bench_all_emits_one_json_line_with_rows",
     "test_prefill_early_bos_rng_rewind",
     "test_continuous_more_requests_than_slots",
     "test_continuous_randomized_workloads_agree",

@@ -1,0 +1,43 @@
+"""bench.py driver-protocol smoke: the default `python bench.py` run must
+emit ONE final JSON line whose payload carries every config row (the
+artifact the driver parses into BENCH_r{N}.json — VERDICT r2 #1).
+
+Runs the aggregation over the tiny config only (DLLAMA_BENCH_CONFIGS=small,
+the documented test hook) on the CPU backend; the real 7b/13b/70b-tp8 rows
+are exercised on hardware."""
+
+import json
+import os
+import subprocess
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_bench_all_emits_one_json_line_with_rows(tmp_path):
+    # strip the axon sitecustomize from the child's path: it force-sets
+    # jax_platforms='axon,cpu' as explicit config at interpreter start
+    # (conftest.py header), which would override JAX_PLATFORMS=cpu and
+    # dial the TPU tunnel from what must stay a CPU smoke run
+    pypath = os.pathsep.join(
+        p for p in os.environ.get("PYTHONPATH", "").split(os.pathsep)
+        if p and "axon" not in p)
+    env = {**os.environ,
+           "PYTHONPATH": pypath,
+           "DLLAMA_BENCH_CONFIGS": "small",
+           "DLLAMA_JAX_CACHE_DIR": str(tmp_path / "cache"),
+           "JAX_PLATFORMS": "cpu",
+           "XLA_FLAGS": "--xla_force_host_platform_device_count=1"}
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "bench.py"), "--samples", "4"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env,
+        timeout=900, cwd=_ROOT)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    line = proc.stdout.strip().splitlines()[-1]
+    payload = json.loads(line)
+    assert payload["unit"] == "ms/token"
+    assert payload["value"] > 0
+    assert "small" in payload["rows"]
+    row = payload["rows"]["small"]
+    assert row["value"] > 0 and row["executed"] >= 1
+    assert "startup_to_first_token_s" in row
