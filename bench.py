@@ -95,10 +95,19 @@ def _tree_shapes_cached(spec, rank_tp: int, build, build_sig: str = ""):
     tree = build()
     try:
         leaves, treedef = jax.tree_util.tree_flatten(tree)
+        dts = [a.dtype if hasattr(a, "dtype") else np.asarray(a).dtype
+               for a in leaves]
         manifest = (treedef,
-                    [(tuple(a.shape), str(np.asarray(a).dtype
-                                          if not hasattr(a, "dtype")
-                                          else a.dtype)) for a in leaves])
+                    [(tuple(a.shape), str(d))
+                     for a, d in zip(leaves, dts)])
+        for (_, name), want in zip(manifest[1], dts):
+            # a dtype whose str() doesn't round-trip through np.dtype
+            # (e.g. an unregistered extension type) would otherwise make
+            # every LOAD fail and silently rebuild each run — detect the
+            # non-cacheable tree at save time instead
+            if np.dtype(name) != want:
+                raise TypeError(f"dtype {want!r} does not round-trip "
+                                f"via np.dtype({name!r})")
         os.makedirs(os.path.dirname(path), exist_ok=True)
         tmp = path + f".tmp{os.getpid()}"
         with open(tmp, "wb") as fh:

@@ -115,7 +115,9 @@ def causal_cache_mask(seq_len: int, pos: jax.Array, t_len: int) -> jax.Array:
 def _prefill_attn_mode() -> str:
     """T>8 attention strategy — DLLAMA_PREFILL_ATTN: 'block' (while_loop
     over live KV blocks, work bounded by pos+T), 'dense' (score the whole
-    seq_len plane, mask the rest), 'auto' (= block). Read at trace time.
+    seq_len plane, mask the rest), 'auto' (= block). Read at trace time —
+    programs already traced (an existing Engine's cached jits) keep the
+    mode they were traced with; construct a new Engine to change it.
     Unknown values raise (a typo would otherwise silently run the ~38%-
     slower dense path)."""
     import os

@@ -71,8 +71,14 @@ def _prefill_matmul_mode() -> str:
     and the one-time dequant temp costs less than either re-stream. In f32
     parity mode the dense path triples MXU passes (HIGHEST) on 4x the temp
     bytes, so the packed kernel stays ahead there (BASELINE.md r3 ladder).
-    Read at trace time, like the precision contextvar."""
+    Read at trace time, like the precision contextvar — programs already
+    traced (an existing Engine's cached jits) keep the mode they were
+    traced with; construct a new Engine to change it. Unknown values
+    raise (a typo would otherwise silently run a slower path)."""
     mode = os.environ.get("DLLAMA_PREFILL_MATMUL", "auto")
+    if mode not in ("auto", "dequant", "scratch", "legacy"):
+        raise ValueError(f"DLLAMA_PREFILL_MATMUL={mode!r}: "
+                         f"expected auto|dequant|scratch|legacy")
     if mode == "auto":
         from .linear import matmul_mode
 

@@ -114,6 +114,13 @@ class Engine:
         """
         jnp = self.jnp
         seq_len = self.spec.seq_len
+        if pos0 + len(tokens) > seq_len:
+            # fail loudly before any cache write: past here the fused path
+            # would raise an opaque numpy broadcast error and the unfused
+            # path would clamp cache writes — divergent, silent corruption
+            raise ValueError(
+                f"prefill overflow: pos0={pos0} + {len(tokens)} tokens "
+                f"> seq_len={seq_len}")
         c = min(chunk, seq_len)
         n_full = len(tokens) // c
         rest, rest_pos = tokens, pos0
