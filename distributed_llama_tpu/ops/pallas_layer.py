@@ -67,8 +67,14 @@ _PARAMS = pltpu.CompilerParams(vmem_limit_bytes=_VMEM_LIMIT)
 
 
 def fusion_mode() -> str:
-    """'auto', 'on', or 'off' — DLLAMA_LAYER_FUSION."""
-    return os.environ.get("DLLAMA_LAYER_FUSION", "auto")
+    """'auto', 'on', 'headtail', or 'off' — DLLAMA_LAYER_FUSION. Read at
+    trace/load time; already-built engines keep their mode. Unknown values
+    raise (a typo would silently run the unfused path)."""
+    mode = os.environ.get("DLLAMA_LAYER_FUSION", "auto")
+    if mode not in ("auto", "on", "headtail", "off"):
+        raise ValueError(f"DLLAMA_LAYER_FUSION={mode!r}: "
+                         f"expected auto|on|headtail|off")
+    return mode
 
 
 def fusion_enabled() -> bool:
@@ -78,9 +84,21 @@ def fusion_enabled() -> bool:
     multi-window DMA streams at ~550 GB/s vs the standalone kernels'
     ~670 GB/s (same bytes; measured tools/layer_kernel_bench +
     mega bisections, r3), so fusion does not yet beat the unfused path
-    end-to-end. Opt in with DLLAMA_LAYER_FUSION=on (parity is pinned by
-    tests/test_pallas_layer.py either way)."""
-    return fusion_mode() == "on"
+    end-to-end. Opt in with DLLAMA_LAYER_FUSION=on (whole-layer megakernel
+    when the spec supports it) or =headtail (the two-pallas_call pair with
+    the flash-attention kernel between them — r4's launch-tax attempt #2:
+    the r3 end-to-end A/B only ever exercised the megakernel). Parity is
+    pinned by tests/test_pallas_layer.py for every mode."""
+    return fusion_mode() in ("on", "headtail")
+
+
+def fusion_cache_key() -> str:
+    """'off' | 'headtail' | 'mega' — the value that decides the param
+    TREE's contents (prepare_mega_params adds wo_mega only under 'mega'),
+    for shape-manifest/executable cache keys."""
+    if not fusion_enabled():
+        return "off"
+    return "mega" if fusion_mode() == "on" else "headtail"
 
 
 def _pick_rows(d: int, cap: int) -> int | None:
@@ -774,7 +792,7 @@ def prepare_mega_params(spec, params: dict) -> dict:
     the sigma-permuted wo stack as ``wo_mega`` (the megakernel's attention-
     output plane layout — see wo_block_perm). ``wo`` stays for the T>1
     prefill path, which runs the unfused kernels."""
-    if not (fusion_enabled() and supports(spec, params)
+    if not (fusion_mode() == "on" and supports(spec, params)
             and _mega_shapes_ok(spec)):
         return params
     out = dict(params)

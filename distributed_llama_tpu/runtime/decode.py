@@ -213,16 +213,68 @@ def make_decode_loop_aot(step_fn: StepFn, max_steps: int,
 
         placed = jax.tree_util.tree_map(
             lambda a: jax.device_put(jnp.asarray(a)), params_host)
-        param_formats = jax.tree_util.tree_map(lambda a: a.format, placed)
-        jitted = jax.jit(run, donate_argnums=1,
-                         in_shardings=(param_formats,) + (None,) * 6)
-        abstract = (jax.tree_util.tree_map(sds, placed),
-                    *(jax.tree_util.tree_map(sds, r) for r in rest))
-        lowered = jitted.lower(*abstract)
-        compiled = _load_or_compile(lowered, exe_cache_dir)
+        touchers = _touch_async(placed)
+        try:
+            param_formats = jax.tree_util.tree_map(lambda a: a.format,
+                                                   placed)
+            jitted = jax.jit(run, donate_argnums=1,
+                             in_shardings=(param_formats,) + (None,) * 6)
+            abstract = (jax.tree_util.tree_map(sds, placed),
+                        *(jax.tree_util.tree_map(sds, r) for r in rest))
+            lowered = jitted.lower(*abstract)
+            compiled = _load_or_compile(lowered, exe_cache_dir)
+        except BaseException:
+            if touchers is not None:
+                # failure path: drop queued touches so they don't contend
+                # with the caller's retry attempt
+                touchers.shutdown(wait=False, cancel_futures=True)
+            raise
+        if touchers is not None:
+            # success (incl. warm exe-cache hits, where compile returns in
+            # seconds): queued touches must KEEP draining so the upload
+            # still overlaps the first chain instead of stalling it
+            touchers.shutdown(wait=False)
         return compiled, placed
 
     return compile_and_place
+
+
+def _touch_async(placed):
+    """Start materializing every placed leaf from a thread pool, so the
+    host->device upload streams WHILE the caller lowers + compiles
+    (VERDICT r3 #5: on the tunneled runtime device_put is lazy and the
+    ~4 GB 7B upload otherwise runs serially AFTER compile, stalling the
+    first chain). Reading one element forces the whole buffer resident.
+    DLLAMA_UPLOAD_OVERLAP=0 disables (the measurement ladder's off arm).
+    Returns the executor (caller may shutdown(wait=False)) or None."""
+    import concurrent.futures as cf
+    import os
+
+    import numpy as np
+
+    if os.environ.get("DLLAMA_UPLOAD_OVERLAP", "1") == "0":
+        return None
+    leaves = [a for a in jax.tree_util.tree_leaves(placed)
+              if hasattr(a, "addressable_shards")]
+    if not leaves:
+        return None
+    ex = cf.ThreadPoolExecutor(max_workers=8,
+                               thread_name_prefix="dllama-upload")
+
+    def touch(a):
+        try:
+            # read ONE element (tiny slice program) — a.reshape(-1) would
+            # materialize a full-size device copy of every leaf
+            np.asarray(a[(0,) * (a.ndim - 1)][:1])
+        except Exception as e:  # noqa: BLE001 - overlap is best-effort
+            import sys
+
+            print(f"upload touch failed ({type(e).__name__}: {e}); leaf "
+                  f"uploads lazily at first use", file=sys.stderr)
+
+    for a in sorted(leaves, key=lambda a: -a.nbytes):
+        ex.submit(touch, a)
+    return ex
 
 
 def _load_or_compile(lowered, exe_cache_dir: str | None):

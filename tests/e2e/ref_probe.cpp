@@ -1,0 +1,80 @@
+// Cross-binary parity probe: drives the REFERENCE implementation's
+// public API (compiled unmodified from /root/reference/src, headers via
+// -I) and dumps the per-step token ids + raw logits so the test suite can
+// compare them numerically against this repo's Engine on the same fixture.
+//
+// This file is part of *this* repo's test harness — it contains no code
+// from the reference; it only calls the entry points the reference's own
+// main.cpp uses (main.cpp:38-63, tokenizer.cpp:321-394).
+//
+// Usage: ref_probe <model.bin> <tokenizer.bin> <prompt> <steps> <logits.out>
+//
+// Output (stdout): one "TOK <pos> <token> <next>" line per step, where
+// <next> is the forced prompt token while the prompt lasts, else the
+// argmax of the logits (the temperature=0 sampling path). Logits for every
+// step are appended raw-f32 to <logits.out> (steps x vocabSize).
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+
+#include "quants.hpp"
+#include "socket.hpp"
+#include "tokenizer.hpp"
+#include "transformer-tasks.hpp"
+#include "transformer.hpp"
+
+int main(int argc, char** argv) {
+    if (argc != 6) {
+        fprintf(stderr,
+                "usage: ref_probe MODEL TOKENIZER PROMPT STEPS LOGITS_OUT\n");
+        return 2;
+    }
+    char* modelPath = argv[1];
+    char* tokenizerPath = argv[2];
+    char* prompt = argv[3];
+    int steps = atoi(argv[4]);
+    FILE* logitsOut = fopen(argv[5], "wb");
+    if (logitsOut == NULL) {
+        fprintf(stderr, "cannot open %s\n", argv[5]);
+        return 2;
+    }
+
+    initQuants();
+    SocketPool* socketPool = SocketPool::connect(0, NULL, NULL);
+    TransformerSpec spec =
+        Transformer::loadSpecFromFile(modelPath, 1, F32, F32);
+    Transformer transformer =
+        Transformer::loadRootFromFile(modelPath, &spec, socketPool);
+    Inference inference = Inference(1, &transformer, socketPool);
+
+    Tokenizer tokenizer(tokenizerPath, spec.vocabSize);
+    int* promptTokens = (int*)malloc((strlen(prompt) + 3) * sizeof(int));
+    int numPromptTokens = 0;
+    tokenizer.encode(prompt, 1, 0, promptTokens, &numPromptTokens);
+    if (numPromptTokens < 1) {
+        fprintf(stderr, "empty prompt encoding\n");
+        return 1;
+    }
+
+    int token = promptTokens[0];
+    for (int pos = 0; pos < steps; pos++) {
+        float* logits = inference.infer(token, pos);
+        fwrite(logits, sizeof(float), spec.vocabSize, logitsOut);
+        int next;
+        if (pos < numPromptTokens - 1) {
+            next = promptTokens[pos + 1];
+        } else {
+            next = 0;
+            for (int i = 1; i < spec.vocabSize; i++) {
+                if (logits[i] > logits[next]) next = i;
+            }
+        }
+        printf("TOK %d %d %d\n", pos, token, next);
+        token = next;
+    }
+    fclose(logitsOut);
+    free(promptTokens);
+    delete socketPool;
+    return 0;
+}

@@ -106,6 +106,47 @@ def test_fused_decode_matches_unfused(monkeypatch, spec):
     assert got_toks == want_toks
 
 
+def test_headtail_mode_skips_mega_and_matches(monkeypatch):
+    """DLLAMA_LAYER_FUSION=headtail (r4 launch-tax attempt #2) builds the
+    head/tail pair WITHOUT the megakernel: prepare_mega_params must not
+    add wo_mega, fusion_cache_key must distinguish the tree, and the
+    decode must match the unfused path."""
+    from distributed_llama_tpu.models.llama import forward, init_cache
+    from distributed_llama_tpu.ops.pallas_layer import (fusion_cache_key,
+                                                        prepare_mega_params)
+
+    monkeypatch.setenv("DLLAMA_Q40_KERNEL", "pallas")
+    spec = SPEC
+    params = _packed(spec)
+
+    monkeypatch.setenv("DLLAMA_LAYER_FUSION", "headtail")
+    assert fusion_cache_key() == "headtail"
+    assert "wo_mega" not in prepare_mega_params(spec, params)
+    monkeypatch.setenv("DLLAMA_LAYER_FUSION", "on")
+    assert fusion_cache_key() == "mega"
+    monkeypatch.setenv("DLLAMA_LAYER_FUSION", "off")
+    assert fusion_cache_key() == "off"
+
+    def run():
+        cache = init_cache(spec)
+        logits, cache = forward(spec, params, cache,
+                                jnp.asarray([3], jnp.int32), jnp.int32(0))
+        logits2, _ = forward(spec, params, cache,
+                             jnp.asarray([7], jnp.int32), jnp.int32(1))
+        return np.asarray(logits[0]), np.asarray(logits2[0])
+
+    monkeypatch.setenv("DLLAMA_LAYER_FUSION", "off")
+    want = run()
+    monkeypatch.setenv("DLLAMA_LAYER_FUSION", "headtail")
+    got = run()
+    np.testing.assert_allclose(got[0], want[0], atol=5e-4, rtol=1e-4)
+    np.testing.assert_allclose(got[1], want[1], atol=5e-4, rtol=1e-4)
+
+    monkeypatch.setenv("DLLAMA_LAYER_FUSION", "dequnat")
+    with pytest.raises(ValueError):
+        fusion_cache_key()
+
+
 def test_fused_after_prefill(monkeypatch):
     """Prefill (T>1, unfused — fusion is T=1-only) then fused decode must
     equal the fully unfused run: the two paths share one cache layout."""

@@ -1,0 +1,94 @@
+"""Probe: does the tunneled runtime serialize host->device uploads?
+
+The round-3 finding (BASELINE.md): device_put over the tunnel is LAZY and
+the real upload runs at ~17 MB/s at first use, so a real 7B .bin pays
+~240 s before its first token. The <60 s warm-start bar (VERDICT r3 #5)
+hinges on two questions this probe answers on the real chip:
+
+1. serial rate: force-materialize placed arrays one at a time -> MB/s.
+2. concurrency: force-materialize many placed arrays from a thread pool —
+   if aggregate MB/s scales with threads, the loader can parallelize the
+   upload; if not, the tunnel serializes placement and overlap can only
+   hide compile time behind the transfer, not shrink it.
+3. chunk-size sensitivity: the same bytes as a few big arrays vs many
+   small ones (per-transfer constant vs streaming rate).
+
+Run: PYTHONPATH=/root/repo:/root/.axon_site python tools/upload_probe.py
+     [--mb 256] [--n 8] [--threads 8]
+"""
+
+import argparse
+import concurrent.futures as cf
+import sys
+import time
+
+import numpy as np
+
+
+def _place(n: int, mb: int):
+    import jax
+    import jax.numpy as jnp
+
+    host = [np.full((mb, 1024, 1024), i, dtype=np.uint8)
+            for i in range(n)]
+    t0 = time.perf_counter()
+    placed = [jax.device_put(jnp.asarray(h)) for h in host]
+    jax.block_until_ready(placed)
+    print(f"device_put+block_until_ready of {n}x{mb} MB: "
+          f"{time.perf_counter() - t0:.2f}s (lazy if << transfer time)",
+          file=sys.stderr)
+    return placed
+
+
+def _touch(a) -> int:
+    # reading ONE element forces the whole buffer resident on device and
+    # proves the upload completed (np.asarray round-trips through device)
+    return int(np.asarray(a[0, 0, :1])[0])
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--mb", type=int, default=256)
+    ap.add_argument("--n", type=int, default=8)
+    ap.add_argument("--threads", type=int, default=8)
+    args = ap.parse_args()
+
+    import jax
+
+    print(f"backend: {jax.devices()[0]}", file=sys.stderr)
+
+    # 1) serial
+    placed = _place(args.n, args.mb)
+    t0 = time.perf_counter()
+    for a in placed:
+        _touch(a)
+    dt = time.perf_counter() - t0
+    total_mb = args.n * args.mb
+    print(f"serial materialize: {total_mb} MB in {dt:.1f}s = "
+          f"{total_mb / dt:.1f} MB/s")
+    del placed
+
+    # 2) concurrent
+    placed = _place(args.n, args.mb)
+    t0 = time.perf_counter()
+    with cf.ThreadPoolExecutor(args.threads) as ex:
+        list(ex.map(_touch, placed))
+    dt = time.perf_counter() - t0
+    print(f"concurrent materialize ({args.threads} threads): "
+          f"{total_mb} MB in {dt:.1f}s = {total_mb / dt:.1f} MB/s")
+    del placed
+
+    # 3) chunk-size sensitivity: same bytes, 4x smaller pieces
+    small_n, small_mb = args.n * 4, args.mb // 4
+    placed = _place(small_n, small_mb)
+    t0 = time.perf_counter()
+    with cf.ThreadPoolExecutor(args.threads) as ex:
+        list(ex.map(_touch, placed))
+    dt = time.perf_counter() - t0
+    print(f"concurrent materialize ({small_n}x{small_mb} MB): "
+          f"{total_mb} MB in {dt:.1f}s = {total_mb / dt:.1f} MB/s")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
