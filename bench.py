@@ -77,16 +77,18 @@ def _tree_shapes_cached(spec, rank_tp: int, build, build_sig: str = ""):
 
     from distributed_llama_tpu.ops.linear import q40_kernel_mode
     from distributed_llama_tpu.ops.pallas_layer import fusion_cache_key
+    from distributed_llama_tpu.ops.pallas_q40 import _matvec_cap
     from distributed_llama_tpu.utils.compile_cache import default_cache_dir
 
     # every knob that changes the packed tree's CONTENTS must be in the
     # key: layer fusion adds the wo_mega stack only in 'mega' mode
     # (prepare_mega_params), the kernel mode decides kernel-vs-codec
-    # layout, and builder kwargs (e.g. the 70b rank tree's embed_dtype)
-    # change leaf shapes/dtypes
+    # layout, the matvec row cap feeds the layout picks, and builder
+    # kwargs (e.g. the 70b rank tree's embed_dtype) change leaf
+    # shapes/dtypes
     key = hashlib.sha256(
         f"v2|{spec!r}|{rank_tp}|{q40_kernel_mode()}|{fusion_cache_key()}"
-        f"|{build_sig}".encode()).hexdigest()[:16]
+        f"|{_matvec_cap()}|{build_sig}".encode()).hexdigest()[:16]
     path = os.path.join(default_cache_dir(), "shapes", f"tree_{key}.pkl")
     if os.environ.get("DLLAMA_SHAPE_CACHE", "1") != "0" \
             and os.path.exists(path):
@@ -294,6 +296,24 @@ def _bench(spec, params, samples: int, per_step: bool = False,
     # a produced BOS (possible with real weights; BOS fills the tail), and
     # elapsed/samples would then understate the true per-token cost
     from distributed_llama_tpu.io.tokenizer import BOS
+
+    prof_dir = os.environ.get("DLLAMA_BENCH_PROFILE")
+    if prof_dir:
+        # op-time attribution of ONE timed chain (the in-situ analog of
+        # tools/prefill_ladder's op-family split): per-token device op ms
+        # by kernel family, printed to stderr next to the wall number
+        from distributed_llama_tpu.utils.it_split import bucket_ops
+
+        with jax.profiler.trace(prof_dir):
+            toks, _ = run(*args())
+            toks = np.asarray(toks)
+        # divide by the steps the chain actually RAN (a --model chain can
+        # BOS-terminate early), mirroring the timed loop below
+        bos = np.flatnonzero(toks[:samples] == BOS)
+        ran = int(bos[0]) + 1 if len(bos) else samples
+        per_tok = bucket_ops(prof_dir, ran)
+        print(f"op-time per token (ms, {ran}-step chain): {per_tok} "
+              f"total {round(sum(per_tok.values()), 3)}", file=sys.stderr)
 
     times = []
     executed = samples

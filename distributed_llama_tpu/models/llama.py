@@ -122,7 +122,7 @@ def _prefill_attn_mode() -> str:
     slower dense path)."""
     import os
 
-    mode = os.environ.get("DLLAMA_PREFILL_ATTN", "auto")
+    mode = os.environ.get("DLLAMA_PREFILL_ATTN") or "auto"  # '' = unset
     if mode not in ("auto", "block", "dense"):
         raise ValueError(f"DLLAMA_PREFILL_ATTN={mode!r}: "
                          f"expected auto|block|dense")

@@ -70,7 +70,7 @@ def fusion_mode() -> str:
     """'auto', 'on', 'headtail', or 'off' — DLLAMA_LAYER_FUSION. Read at
     trace/load time; already-built engines keep their mode. Unknown values
     raise (a typo would silently run the unfused path)."""
-    mode = os.environ.get("DLLAMA_LAYER_FUSION", "auto")
+    mode = os.environ.get("DLLAMA_LAYER_FUSION") or "auto"  # '' = unset
     if mode not in ("auto", "on", "headtail", "off"):
         raise ValueError(f"DLLAMA_LAYER_FUSION={mode!r}: "
                          f"expected auto|on|headtail|off")

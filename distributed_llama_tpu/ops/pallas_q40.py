@@ -75,7 +75,7 @@ def _prefill_matmul_mode() -> str:
     traced (an existing Engine's cached jits) keep the mode they were
     traced with; construct a new Engine to change it. Unknown values
     raise (a typo would otherwise silently run a slower path)."""
-    mode = os.environ.get("DLLAMA_PREFILL_MATMUL", "auto")
+    mode = os.environ.get("DLLAMA_PREFILL_MATMUL") or "auto"  # '' = unset
     if mode not in ("auto", "dequant", "scratch", "legacy"):
         raise ValueError(f"DLLAMA_PREFILL_MATMUL={mode!r}: "
                          f"expected auto|dequant|scratch|legacy")
@@ -635,12 +635,13 @@ def _pick_block_rows(d: int, t: int = 1, nb: int = 128,
             step, cap = 128, _MATMUL_ROWSXNB_CAP // nb
         else:
             step, cap = 128, 256
-    top = (min(d, 768, cap) // step) * step
+    top_rows = _matvec_cap() if t == 1 else 768
+    top = (min(d, top_rows, cap) // step) * step
     for cand in range(top, 0, -step):
         if d % cand == 0:
             return cand
     # small odd dims: a full-d block is legal when it fits the same budget
-    return d if d <= min(768, cap) else None
+    return d if d <= min(top_rows, cap) else None
 
 
 def kernel_supports(d: int, n: int) -> bool:
@@ -698,13 +699,36 @@ def _precision_dot(wf, x2):
                       precision=jax.lax.Precision.HIGHEST)
 
 
+def _matvec_cap() -> int:
+    """T=1 matvec row-tile cap — DLLAMA_MATVEC_CAP, default 768 (the
+    tuned d-major pick). Raising it trades grid-step count for longer
+    per-tile DMAs (tile-size experiments on the real bench; the scoped-
+    VMEM word budget still applies on top)."""
+    raw = os.environ.get("DLLAMA_MATVEC_CAP", "")
+    if not raw:
+        return 768
+    try:
+        cap = int(raw)
+    except ValueError:
+        raise ValueError(f"DLLAMA_MATVEC_CAP={raw!r}: expected a plain "
+                         f"integer row cap (e.g. 1536)") from None
+    if cap < 128:
+        # below the nb-major lane minimum the cap would silently drop
+        # leaves off the kernel layout (a LAYOUT change, not a tile
+        # change) — refuse rather than measure the wrong code path
+        raise ValueError(f"DLLAMA_MATVEC_CAP={cap} < 128: the nb-major "
+                         f"row tile needs a multiple of 128")
+    return cap
+
+
 def _pick_rows_nb(d: int, nb: int) -> int | None:
     """Row tile for the nb-major matvec: rows ride the LANES, so they must
     be a multiple of 128 — a d with no multiple-of-128 divisor (including
     every d < 128) returns None and the caller routes to the dequant
     fallback; rows*nb stays under the same ~(16+4)-bytes-per-word
-    scoped-VMEM budget as the d-major matvec."""
-    top = min(d, 768, max(128, 360_000 // nb))
+    scoped-VMEM budget as the d-major matvec (DLLAMA_MATVEC_CAP lifts the
+    768-row default for tile experiments)."""
+    top = min(d, _matvec_cap(), max(128, 360_000 // nb))
     for cand in range(top - top % 128, 0, -128):
         if d % cand == 0:
             return cand

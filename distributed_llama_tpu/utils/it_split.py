@@ -138,6 +138,30 @@ def parse_trace(path: str) -> dict[str, DeviceSplit]:
     return out
 
 
+def bucket_ops(trace_dir: str, denom: int = 1) -> dict[str, float]:
+    """Op time from a trace grouped by kernel family, in ms (divided by
+    ``denom``, e.g. steps or tokens) — THE one copy of the family
+    classifier used by bench.py, tools/prefill_ladder.py and
+    tools/continuous_bench.py (the buckets are a measurement contract
+    cited in BASELINE.md)."""
+    buckets: dict[str, float] = {}
+    for split in parse_trace(trace_dir).values():
+        for name, ns in split.ops.items():
+            n = name.lower()
+            if "q40" in n or "matmul" in n or "matvec" in n or "mxu" in n:
+                b = "q40_kernels"
+            elif "attention" in n or "flash" in n:
+                b = "attention"
+            elif n.startswith(("fusion", "transpose", "copy", "bitcast",
+                               "reshape", "convert", "dynamic")):
+                b = "fusion_layout"
+            else:
+                b = "other"
+            buckets[b] = buckets.get(b, 0.0) + ns
+    return {k: round(v / 1e6 / max(denom, 1), 3)
+            for k, v in sorted(buckets.items())}
+
+
 def summarize(splits: dict[str, DeviceSplit], tokens: int = 0,
               top: int = 8, out=None, note: str = "") -> tuple[float, float]:
     """Print the reference-shaped split; returns (I_ms, T_ms) averaged

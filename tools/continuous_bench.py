@@ -32,6 +32,10 @@ def main():
     ap.add_argument("--steps", type=int, default=48)
     ap.add_argument("--small", action="store_true",
                     help="tiny config for CI/CPU smoke runs")
+    ap.add_argument("--profile", default=None, metavar="DIR",
+                    help="trace the timed pass and print the per-step "
+                         "op-time split by kernel family (the VERDICT r3 "
+                         "#8 attribution)")
     args = ap.parse_args()
 
     import jax
@@ -71,6 +75,24 @@ def main():
           f"{st.tokens / dt:.1f} tok/s ({dt * 1000 / st.steps:.2f} ms/step, "
           f"slots={args.slots}, block={args.block_steps}, "
           f"cache={args.kv_cache_dtype})")
+
+    if args.profile:
+        from distributed_llama_tpu.utils.it_split import bucket_ops
+
+        with jax.profiler.trace(args.profile):
+            # time eng.run alone: trace start/stop + export would inflate
+            # the host-gap attribution this tool exists to pin
+            t0 = time.perf_counter()
+            outs3, st3 = eng.run(reqs, steps=args.steps)
+            dt3 = time.perf_counter() - t0
+        assert outs3 == outs
+        per_step = bucket_ops(args.profile, st3.steps)
+        op_total = sum(per_step.values())
+        print(f"profiled pass: {dt3:.2f}s, {st3.steps} steps -> op-time "
+              f"per step (ms): {per_step} total {op_total:.2f}; wall "
+              f"{dt3 * 1000 / st3.steps:.2f} ms/step -> "
+              f"{dt3 * 1000 / st3.steps - op_total:.2f} ms/step of "
+              f"dispatch/host gaps")
 
 
 if __name__ == "__main__":

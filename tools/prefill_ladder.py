@@ -51,18 +51,6 @@ _MODES = {
 }
 
 
-def _bucket(op_name: str) -> str:
-    n = op_name.lower()
-    if "q40" in n or "matmul" in n or "matvec" in n or "mxu" in n:
-        return "q40_kernels"
-    if "attention" in n or "flash" in n:
-        return "attention"
-    if n.startswith(("fusion", "transpose", "copy", "bitcast", "reshape",
-                     "convert", "dynamic")):
-        return "fusion_layout"
-    return "other"
-
-
 def _profile_chunk(engine, toks, chunk, trace_dir):
     """Op-time split of ONE chunk at positions 0..chunk (a first warm run
     compiles; the traced run starts from a reset cache so every position
@@ -70,7 +58,7 @@ def _profile_chunk(engine, toks, chunk, trace_dir):
     for chunk > seq_len/2 and silently clamp its writes)."""
     import jax
 
-    from distributed_llama_tpu.utils.it_split import parse_trace
+    from distributed_llama_tpu.utils.it_split import bucket_ops
 
     engine.reset()
     engine.prefill(toks[:chunk], 0, chunk)  # warm/compile outside the trace
@@ -78,12 +66,7 @@ def _profile_chunk(engine, toks, chunk, trace_dir):
     with jax.profiler.trace(trace_dir):
         engine.prefill(toks[:chunk], 0, chunk)
         np.asarray(engine.cache.k[-1, chunk - 1, 0, :8])
-    splits = parse_trace(trace_dir)
-    buckets: dict[str, float] = {}
-    for split in splits.values():
-        for name, ns in split.ops.items():
-            buckets[_bucket(name)] = buckets.get(_bucket(name), 0.0) + ns
-    return {k: round(v / 1e6, 2) for k, v in sorted(buckets.items())}
+    return bucket_ops(trace_dir)
 
 
 def main() -> int:

@@ -78,15 +78,16 @@ def main():
           f"{total_mb} MB in {dt:.1f}s = {total_mb / dt:.1f} MB/s")
     del placed
 
-    # 3) chunk-size sensitivity: same bytes, 4x smaller pieces
-    small_n, small_mb = args.n * 4, args.mb // 4
+    # 3) chunk-size sensitivity: ~same bytes, 4x smaller pieces
+    small_n, small_mb = args.n * 4, max(1, args.mb // 4)
+    small_total = small_n * small_mb  # == total_mb only when 4 | mb
     placed = _place(small_n, small_mb)
     t0 = time.perf_counter()
     with cf.ThreadPoolExecutor(args.threads) as ex:
         list(ex.map(_touch, placed))
     dt = time.perf_counter() - t0
     print(f"concurrent materialize ({small_n}x{small_mb} MB): "
-          f"{total_mb} MB in {dt:.1f}s = {total_mb / dt:.1f} MB/s")
+          f"{small_total} MB in {dt:.1f}s = {small_total / dt:.1f} MB/s")
     return 0
 
 
