@@ -171,6 +171,7 @@ def decode_attention_batch(q, k4, v4, layer, pos, *, kv_mul: int,
             pltpu.VMEM((2, chunk, n_kv, hs), k4.dtype),
             pltpu.SemaphoreType.DMA((2, 2)),
         ],
+        compiler_params=_VMEM64_PARAMS,
         interpret=interpret,
     )(jnp.asarray(layer, jnp.int32).reshape(1), pos, qg, k4, v4)
     return out.reshape(B, n_kv * kv_mul * hs)
@@ -216,7 +217,17 @@ def attn_kernel_mode() -> str:
     return env
 
 
-_VMEM_BUDGET = 12 * 1024 * 1024  # scoped-vmem limit is 16MB; leave headroom
+_VMEM_BUDGET = 12 * 1024 * 1024  # scratch budget: bounds the DMA chunk size
+
+# Raised scoped-VMEM limit (v5e has 128 MB physical): with the DEFAULT
+# 16 MB limit, shapes whose scratch sits near the 12 MB budget can exceed
+# the limit once the compiler's own temporaries stack on top — measured:
+# 13B tp=4 rank (n_kv=10, hs=128, f32 cache, chunk 512) needs 16.07 MB and
+# fell back to the XLA attention path (or compiled a pessimized marginal
+# kernel), costing ~4 ms/token rank time — the r4 scaling curve's tp=4
+# anomaly. ONE shared constant with the matmul kernels: a missed copy
+# reintroduces exactly this silent-fallback class of bug.
+from .pallas_q40 import _VMEM64_PARAMS  # noqa: E402
 
 
 def _scratch_bytes(chunk: int, n_kv: int, hs: int, itemsize: int) -> int:
@@ -290,6 +301,7 @@ def decode_attention(q, k_all, v_all, layer, pos, *, kv_mul: int,
             pltpu.VMEM((2, chunk, n_kv, hs), k_all.dtype),
             pltpu.SemaphoreType.DMA((2, 2)),
         ],
+        compiler_params=_VMEM64_PARAMS,
         interpret=interpret,
     )(jnp.asarray(layer, jnp.int32).reshape(1),
       jnp.asarray(pos, jnp.int32).reshape(1), qg, k_all, v_all)
