@@ -78,29 +78,27 @@ def ref_binaries(tmp_path_factory):
     return ref_main, ref_probe
 
 
-@pytest.fixture(scope="module")
-def fixture_files(tmp_path_factory):
+def _make_fixture(spec, d):
     """Tiny seeded F32 model + tokenizer, written by this repo's writers."""
-    d = tmp_path_factory.mktemp("fixture")
     rng = np.random.default_rng(11)
 
     def t(*shape):
         return (rng.standard_normal(shape) * 0.08).astype(np.float32)
 
-    tensors = {"tok_embedding": t(SPEC.vocab_size, SPEC.dim),
-               "rms_att": 1 + 0.1 * t(SPEC.n_layers, SPEC.dim),
-               "rms_ffn": 1 + 0.1 * t(SPEC.n_layers, SPEC.dim),
-               "rms_final": 1 + 0.1 * t(SPEC.dim),
-               "wcls": t(SPEC.vocab_size, SPEC.dim)}
-    for name, shape in SPEC.layer_matmul_shapes():
-        tensors[name] = t(SPEC.n_layers, *shape)
+    tensors = {"tok_embedding": t(spec.vocab_size, spec.dim),
+               "rms_att": 1 + 0.1 * t(spec.n_layers, spec.dim),
+               "rms_ffn": 1 + 0.1 * t(spec.n_layers, spec.dim),
+               "rms_final": 1 + 0.1 * t(spec.dim),
+               "wcls": t(spec.vocab_size, spec.dim)}
+    for name, shape in spec.layer_matmul_shapes():
+        tensors[name] = t(spec.n_layers, *shape)
     model = str(d / "model.bin")
-    write_model(model, SPEC, tensors)
+    write_model(model, spec, tensors)
 
     pieces = [b"<unk>", b"<s>", b"</s>"]
     pieces += [f"<0x{i:02X}>".encode() for i in range(256)]
     pieces += [b" ", b"h", b"i", b"hi", b" hi", b"x", b" h"]
-    while len(pieces) < SPEC.vocab_size:
+    while len(pieces) < spec.vocab_size:
         pieces.append(f"tok{len(pieces)}".encode())
     scores = [0.0] * len(pieces)
     scores[pieces.index(b"hi")] = -0.5
@@ -109,6 +107,11 @@ def fixture_files(tmp_path_factory):
     tok = str(d / "tok.bin")
     write_tokenizer(tok, pieces, scores)
     return model, tok
+
+
+@pytest.fixture(scope="module")
+def fixture_files(tmp_path_factory):
+    return _make_fixture(SPEC, tmp_path_factory.mktemp("fixture"))
 
 
 def _run_ref_main(ref_main, model, tok):
@@ -179,33 +182,36 @@ def test_token_stream_matches_reference_binary(ref_binaries, fixture_files,
     assert ref_n > 5
 
 
-def test_distributed_stream_matches_reference_2node(ref_binaries,
-                                                    fixture_files, capsys):
-    """The DISTRIBUTED composed system vs the reference's: the reference
-    runs root + worker as two real processes over localhost TCP (its
-    actual socket protocol, weight scatter included — main.cpp:65-77,
-    transformer.cpp:354-380), this repo runs its tp=2 mesh program; the
-    decoded stream and token count must agree. Extends the single-node
-    parity gate to the reference's core feature, tensor parallelism."""
+def _distributed_parity(ref_main, model, tok, n_workers, capsys):
+    """Run the reference root + n_workers worker PROCESSES over localhost
+    TCP (its actual socket protocol, weight scatter included —
+    main.cpp:65-77, transformer.cpp:354-380) against this repo's
+    tp=(n_workers+1) mesh program; decoded stream and token count must
+    agree."""
     import socket as socketlib
     import time as timelib
 
     from distributed_llama_tpu.frontend.cli import main
 
-    ref_main, _ = ref_binaries
-    model, tok = fixture_files
+    def free_port():
+        with socketlib.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
 
-    with socketlib.socket() as s:  # free port
-        s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
-    worker = subprocess.Popen(
-        [ref_main, "worker", "--port", str(port), "--nthreads", "1"],
-        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    def spawn(port):
+        return subprocess.Popen(
+            [ref_main, "worker", "--port", str(port), "--nthreads", "1"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+
+    ports = [free_port() for _ in range(n_workers)]
+    workers = [spawn(p) for p in ports]
     try:
         # a fixed readiness sleep races on loaded hosts, and a probe
-        # connection would be CONSUMED as the worker's single accept() —
-        # so retry the root itself: a refused connect exits nonzero
-        # without touching the worker's accept state
+        # connection would be CONSUMED as a worker's single accept() — so
+        # retry the root itself. A PARTIAL connect (some workers up, one
+        # not yet listening) consumes the up workers' accept and they die
+        # when the root exits (socket.cpp:58-61 exit on closed socket), so
+        # each retry respawns dead workers on fresh ports.
         deadline = timelib.time() + 30
         while True:
             r = subprocess.run(
@@ -213,29 +219,54 @@ def test_distributed_stream_matches_reference_2node(ref_binaries,
                  "--tokenizer", tok, "--prompt", PROMPT,
                  "--steps", str(STEPS), "--temperature", "0",
                  "--nthreads", "1", "--weights-float-type", "f32",
-                 "--buffer-float-type", "f32",
-                 "--workers", f"127.0.0.1:{port}"],
+                 "--buffer-float-type", "f32", "--workers",
+                 *[f"127.0.0.1:{p}" for p in ports]],
                 capture_output=True, text=True, timeout=120)
             if r.returncode == 0:
                 break
-            assert worker.poll() is None, (
-                f"worker died: {worker.stdout.read()}")
             assert timelib.time() < deadline, (
                 f"root never connected: {r.stdout}\n{r.stderr}")
             timelib.sleep(0.25)
+            for i, w in enumerate(workers):
+                if w.poll() is not None:
+                    w.wait()
+                    ports[i] = free_port()
+                    workers[i] = spawn(ports[i])
     finally:
-        worker.kill()
-        worker.wait()
+        for w in workers:
+            w.kill()
+            w.wait()
     ref_text, ref_n, ref_lines = _parse_ref_pieces(r.stdout)
 
     rc = main(["inference", "--model", model, "--tokenizer", tok,
                "--prompt", PROMPT, "--steps", str(STEPS),
-               "--temperature", "0", "--tp", "2",
+               "--temperature", "0", "--tp", str(n_workers + 1),
                "--weights-float-type", "f32", "--buffer-float-type", "f32",
                "--seed", "1"])
     assert rc == 0
     our_text, our_n, our_lines = _parse_our_pieces(capsys.readouterr().out)
     assert (our_n, our_lines, our_text) == (ref_n, ref_lines, ref_text)
+
+
+def test_distributed_stream_matches_reference_2node(ref_binaries,
+                                                    fixture_files, capsys):
+    ref_main, _ = ref_binaries
+    model, tok = fixture_files
+    _distributed_parity(ref_main, model, tok, n_workers=1, capsys=capsys)
+
+
+def test_distributed_stream_matches_reference_4node(ref_binaries,
+                                                    tmp_path, capsys):
+    """tp=4: the reference's published sweet-spot device count
+    (README.md:46-47). Needs 8 query / 4 kv heads so every rank holds a
+    whole head (GQA kv_mul=2 — the deep-GQA slicing is part of what's
+    under test on our side)."""
+    spec4 = TransformerSpec(dim=64, hidden_dim=160, n_layers=2, n_heads=8,
+                            n_kv_heads=4, vocab_size=300, seq_len=32,
+                            weights_float_type=FloatType.F32)
+    ref_main, _ = ref_binaries
+    model, tok = _make_fixture(spec4, tmp_path)
+    _distributed_parity(ref_main, model, tok, n_workers=3, capsys=capsys)
 
 
 def test_per_step_logits_match_reference(ref_binaries, fixture_files,

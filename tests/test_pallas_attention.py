@@ -110,3 +110,31 @@ def test_decode_attention_ignores_stale_suffix():
     b = decode_attention(q, jnp.asarray(k_all), jnp.asarray(v_all), 0, pos,
                          kv_mul=1, interpret=True)
     np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_shard_shapes_have_vmem_headroom():
+    """Every bench (model, tp) shard shape must admit a cache chunking
+    whose scratch fits the budget, under a raised scoped-VMEM limit with
+    real headroom — the 13b-tp4 margin bug (BASELINE.md r4): scratch near
+    the 12 MB budget plus compiler temporaries landed 76 KB over the
+    default 16 MB limit and silently fell back to the XLA path."""
+    from distributed_llama_tpu.models.synth import (llama2_7b_spec,
+                                                    llama2_13b_spec,
+                                                    llama2_70b_spec)
+    from distributed_llama_tpu.ops import pallas_attention as pa
+
+    # the raised limit must leave a wide margin over the scratch budget,
+    # not the 33% the default limit gave
+    assert pa._VMEM64_PARAMS.vmem_limit_bytes >= 4 * pa._VMEM_BUDGET
+
+    for spec in (llama2_7b_spec(), llama2_13b_spec(), llama2_70b_spec()):
+        for tp in (1, 2, 4, 8):
+            if spec.n_kv_heads % tp:
+                continue
+            n_kv = spec.n_kv_heads // tp
+            for itemsize in (2, 4):  # bf16 and f32 caches
+                c = pa._chunk(spec.seq_len, n_kv, spec.head_size, itemsize)
+                assert c is not None, (spec.n_layers, tp, itemsize)
+                assert (pa._scratch_bytes(c, n_kv, spec.head_size,
+                                          itemsize)
+                        <= pa._VMEM_BUDGET), (spec.n_layers, tp, itemsize)
