@@ -264,8 +264,13 @@ def _touch_async(placed):
     def touch(a):
         try:
             # read ONE element (tiny slice program) — a.reshape(-1) would
-            # materialize a full-size device copy of every leaf
-            np.asarray(a[(0,) * (a.ndim - 1)][:1])
+            # materialize a full-size device copy of every leaf. 0-d
+            # leaves have no axis to slice ((0,)*-1 == () then [:1] fails
+            # on a scalar) and nothing worth overlapping — read directly.
+            if a.ndim == 0:
+                np.asarray(a)
+            else:
+                np.asarray(a[(0,) * (a.ndim - 1)][:1])
         except Exception as e:  # noqa: BLE001 - overlap is best-effort
             import sys
 

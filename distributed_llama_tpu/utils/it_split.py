@@ -143,9 +143,25 @@ def bucket_ops(trace_dir: str, denom: int = 1) -> dict[str, float]:
     ``denom``, e.g. steps or tokens) — THE one copy of the family
     classifier used by bench.py, tools/prefill_ladder.py and
     tools/continuous_bench.py (the buckets are a measurement contract
-    cited in BASELINE.md)."""
+    cited in BASELINE.md).
+
+    Known blind spot: the match is by HLO instruction NAME. Pallas custom
+    calls keep their Python fn name ('_q40_matvec...'), but XLA-FALLBACK
+    matmuls (the dequant-then-dot path) usually execute inside fused
+    instructions literally named 'fusion.N', so on fallback paths their
+    compute lands in ``fusion_layout``/``other`` and ``q40_kernels``
+    undercounts. Attribution consumers must not read ``fusion_layout`` as
+    pure layout overhead when the traced program ran the XLA path."""
+    return bucket_ops_from_splits(parse_trace(trace_dir), denom)
+
+
+def bucket_ops_from_splits(splits: dict[str, DeviceSplit],
+                           denom: int = 1) -> dict[str, float]:
+    """`bucket_ops` over an already-parsed trace (callers that also need
+    the I/T split parse the multi-hundred-MB xplane file ONCE and feed
+    both consumers)."""
     buckets: dict[str, float] = {}
-    for split in parse_trace(trace_dir).values():
+    for split in splits.values():
         for name, ns in split.ops.items():
             n = name.lower()
             if "q40" in n or "matmul" in n or "matvec" in n or "mxu" in n:
