@@ -569,10 +569,16 @@ def _run_all(args) -> int:
         "DLLAMA_BENCH_FULL_PATH",
         os.path.join(os.path.dirname(os.path.abspath(__file__)),
                      "BENCH_FULL.json"))
-    with open(full_path, "w") as fh:
-        json.dump(full, fh, indent=1)
-        fh.write("\n")
-    print(f"full table -> {full_path}", file=sys.stderr)
+    try:
+        with open(full_path, "w") as fh:
+            json.dump(full, fh, indent=1)
+            fh.write("\n")
+        print(f"full table -> {full_path}", file=sys.stderr)
+    except OSError as e:
+        # hours of measured rows must survive a bad path/full disk: the
+        # compact stdout line below is the record of last resort
+        print(f"could not write {full_path} ({e}); full table lost, "
+              f"compact line still emitted", file=sys.stderr)
     print(json.dumps(_compact_summary(configs, rows, curve)))
     return 0
 
