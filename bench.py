@@ -701,10 +701,13 @@ def main():
             raise SystemExit("--per-step times host dispatch, not rank "
                              "compute; it cannot feed a rank projection")
     if args.model:
-        from distributed_llama_tpu.io.loader import load_model
+        # sidecar-cached load (VERDICT r4 #7): the second --model run
+        # memory-maps the pre-tiled kernel tree and skips the GB-scale
+        # host re-tiling (--config tp rows already rejected --model above)
+        from distributed_llama_tpu.io.kernel_cache import load_model_packed
 
-        spec, params = load_model(args.model,
-                                  weights_float_type=FloatType.Q40)
+        spec, params = load_model_packed(args.model,
+                                         weights_float_type=FloatType.Q40)
     else:
         from distributed_llama_tpu.models.synth import (llama2_7b_spec,
                                                         llama2_13b_spec,
@@ -770,6 +773,16 @@ def main():
                   file=sys.stderr)
             os.environ["DLLAMA_Q40_KERNEL"] = "xla"
             os.environ["DLLAMA_ATTN_KERNEL"] = "xla"
+            if args.model:
+                # the packed-at-load tree (load_model_packed) hardwires
+                # kernel-layout leaves whose nb-major dispatch is
+                # pallas-only — the XLA fallback needs the codec tree
+                # (with the mode now 'xla', this load skips packing)
+                from distributed_llama_tpu.io.kernel_cache import (
+                    load_model_packed)
+
+                spec, params = load_model_packed(
+                    args.model, weights_float_type=FloatType.Q40)
         try:
             ms, executed = _bench(spec, params, args.samples,
                                   per_step=args.per_step, rank_tp=rank_tp,
