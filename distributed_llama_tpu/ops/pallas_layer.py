@@ -1,16 +1,37 @@
 """Fused per-layer Pallas kernels: the launch-tax attack (VERDICT r2 #2).
 
-Single-token decode at 7B runs ~130 device ops/token; the builder's own
-profiler attribution (BASELINE.md) shows ~2 ms/token of inter-op pipeline
-bubbles on top of ~8.1 ms of op time. These kernels collapse each layer's
-matvec chain + glue into TWO pallas_calls (plus the flash-attention kernel
-between them):
+STATUS: FROZEN as a documented negative (round 5, VERDICT r4 #9). Both
+fusion modes lost rigorous end-to-end A/Bs on the real chip: the
+megakernel by ~4.6 ms/token (r3: 9.30-9.50 unfused vs 13.92-14.21 fused
+at 128-step chains) and the head/tail pair by ~1.1 ms/token (r4: 9.80 vs
+10.91 at 64 steps, 9.08 vs 10.13 at 128) — the fused kernels' multi-weight
+DMA pipelines stream at ~550-600 GB/s vs the standalone matvec kernels'
+~650-670 on the same bytes, which eats more than the saved launches. The
+r4 off-arm also re-measured the thing this attack targets: solving
+s + C/steps from the 64/128-step pair gives a dispatch-free steady state
+~8.36 ms/token against 8.1 ms of profiler op time, i.e. the inter-op
+bubble budget is now ~0.25 ms/token. The remaining follow-up ideas
+(2-layer grid, cross-kernel prefetch) cannot win against that budget even
+at 100% efficiency, so no further fusion hypotheses are planned; the
+hardware findings that shaped these kernels (Mosaic lane-split limits,
+plane-conversion idioms, dynamic sublane stores, in-kernel RoPE) are
+recorded below and in BASELINE.md. The kernels stay opt-in
+(DLLAMA_LAYER_FUSION=on|headtail), parity-pinned either way by
+tests/test_pallas_layer.py, as the reusable substrate for any future
+layer-granularity work.
+
+Single-token decode at 7B ran ~130 device ops/token; round 2's profiler
+attribution showed ~2 ms/token of inter-op pipeline bubbles on top of
+~8.1 ms of op time (a gap later closed by toolchain/runtime improvements,
+see above). These kernels collapse each layer's matvec chain + glue into
+TWO pallas_calls (plus the flash-attention kernel between them):
 
   head:  rmsnorm(x, rms_att) -> wqkv matvec -> RoPE(q, k)
   tail:  wo matvec -> +residual -> rmsnorm(rms_ffn) -> w13 matvec ->
          silu*mul -> w2 matvec -> +residual
 
-Design (hardware-verified in tools/mosaic_probe*.py): Mosaic cannot
+Design (hardware-verified on v5e; the probe scripts that established
+these constraints were retired with the freeze): Mosaic cannot
 lane-split a (1, n) row vector into the matvec plane layout in-kernel, but
 it CAN reshape (d, 1) -> (d/32, 32) and 2-D-transpose to (32, d/32). So
 every intermediate vector lives in COLUMN form (d, 1):
