@@ -31,7 +31,7 @@ from typing import Any, NamedTuple
 import jax
 import jax.numpy as jnp
 
-from ..io.loader import Q40Kernel, Q40KernelNb
+from ..io.loader import Q40Kernel, Q40KernelI4, Q40KernelNb, Q40KernelNbI4
 from ..ops.linear import StackedQ40, fake_quant_q80, matmul, rmsnorm, silu
 from ..ops.quants import FloatType
 from .spec import TransformerSpec
@@ -113,20 +113,22 @@ def causal_cache_mask(seq_len: int, pos: jax.Array, t_len: int) -> jax.Array:
 
 
 def _prefill_attn_mode() -> str:
-    """T>8 attention strategy — DLLAMA_PREFILL_ATTN: 'block' (while_loop
-    over live KV blocks, work bounded by pos+T), 'dense' (score the whole
-    seq_len plane, mask the rest), 'auto' (= block). Read at trace time —
-    programs already traced (an existing Engine's cached jits) keep the
-    mode they were traced with; construct a new Engine to change it.
-    Unknown values raise (a typo would otherwise silently run the ~38%-
-    slower dense path)."""
+    """T>8 attention strategy — DLLAMA_PREFILL_ATTN: 'flash' (in-VMEM
+    Pallas online-softmax walk over live KV blocks, scores never touch
+    HBM — ops/pallas_attention.prefill_attention), 'block' (while_loop of
+    XLA einsum partials over live KV blocks), 'dense' (score the whole
+    seq_len plane, mask the rest), 'auto' (= flash where the kernel +
+    pallas backend apply, else block). Read at trace time — programs
+    already traced (an existing Engine's cached jits) keep the mode they
+    were traced with; construct a new Engine to change it. Unknown values
+    raise (a typo would otherwise silently run a slower path)."""
     import os
 
     mode = os.environ.get("DLLAMA_PREFILL_ATTN") or "auto"  # '' = unset
-    if mode not in ("auto", "block", "dense"):
+    if mode not in ("auto", "flash", "block", "dense"):
         raise ValueError(f"DLLAMA_PREFILL_ATTN={mode!r}: "
-                         f"expected auto|block|dense")
-    return "block" if mode == "auto" else mode
+                         f"expected auto|flash|block|dense")
+    return mode
 
 
 def _pick_attn_block(seq_len: int) -> int | None:
@@ -173,7 +175,23 @@ def attention(spec: TransformerSpec, q: jax.Array, k_cache: jax.Array,
     Returns (T, dim). T>8 (prefill chunks) takes the blockwise live-prefix
     path by default; T<=8 and the dense fallback score the full plane.
     """
-    if t_len > 8 and _prefill_attn_mode() == "block":
+    mode = _prefill_attn_mode() if t_len > 8 else "dense"
+    if mode in ("auto", "flash"):
+        from ..ops.pallas_attention import (attn_kernel_mode,
+                                            prefill_attention,
+                                            supports_prefill)
+
+        if (attn_kernel_mode() == "pallas"
+                and supports_prefill(spec.seq_len, spec.head_size, t_len,
+                                     spec.kv_mul)):
+            from ..ops.linear import matmul_mode
+
+            out = prefill_attention(q, k_cache, v_cache, pos,
+                                    kv_mul=spec.kv_mul,
+                                    bf16=matmul_mode() == "bf16")
+            return out.reshape(t_len, -1)
+        mode = "block" if mode == "auto" else mode
+    if mode in ("block", "flash"):  # flash unsupported here: live-prefix walk
         block = _pick_attn_block(spec.seq_len)
         if block is not None:
             return _attention_blockwise(spec, q, k_cache, v_cache, pos,
@@ -273,7 +291,8 @@ def split_layer_weights(params: dict[str, Any]):
     else is scanned normally (sliced per step)."""
     keys = [k for k in LAYER_KEYS + FUSED_KEYS if k in params]
     stacked = {k: params[k] for k in keys
-               if isinstance(params[k], (Q40Kernel, Q40KernelNb))}
+               if isinstance(params[k], (Q40Kernel, Q40KernelNb,
+                                         Q40KernelI4, Q40KernelNbI4))}
     scanned = {k: params[k] for k in keys if k not in stacked}
     return stacked, scanned
 

@@ -43,8 +43,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from ..io.loader import (Q40Kernel, Q40KernelNb, Q40Weight,
-                         to_kernel_layout)
+from ..io.loader import (Q40Kernel, Q40KernelI4, Q40KernelNb, Q40KernelNbI4,
+                         Q40Weight, to_kernel_layout)
 
 QK = 32
 NJ = 16  # nibble positions per block byte-plane
@@ -273,6 +273,166 @@ MULTI_T_MAX = 8  # beyond this the per-row accumulators crowd VMEM; use MXU
 _VMEM64_PARAMS = pltpu.CompilerParams(vmem_limit_bytes=64 * 1024 * 1024)
 
 
+def q40_i4_enabled() -> bool:
+    """DLLAMA_Q40_I4=on routes the fused decode chain through signed-int4
+    weight planes (VERDICT r4 #2's second nb-major formulation, extended
+    to d-major too).
+
+    What it does: at CHAIN START (inside the jitted program — this
+    runtime cannot pass int4 across a jit boundary) every Q40Kernel[Nb]
+    leaf is re-expressed as (code - 8) int4 planes (to_i4_planes); the
+    T=1 matvec body then needs ONE convert + mul + add per plane instead
+    of convert/mask/shift/2xconvert/2xmul/2xadd — measured 701 GB/s vs
+    638 on the 13B w13 shape, against a 746 GB/s DMA floor
+    (tools/nb_probe.py). Cost: the conversion pass (~0.06 ms/token
+    amortized over a 64-step chain) and TRANSIENT extra HBM for the i4
+    copy while the chain runs (~+50% of the codes' bytes; the u8
+    originals remain the placed arguments). Exact same integers — parity
+    is bit-tight with the u8 bodies. Default off until the memory
+    headroom story is per-model; the bench flips it per config."""
+    mode = os.environ.get("DLLAMA_Q40_I4", "off")
+    if mode not in ("on", "off"):
+        raise ValueError(f"DLLAMA_Q40_I4={mode!r}: expected on|off")
+    return mode == "on"
+
+
+def to_i4_planes(tree):
+    """Re-express every Q40Kernel / Q40KernelNb leaf of a param tree (or a
+    single leaf) as its signed-int4 plane form. Jit-internal only — see
+    Q40KernelI4's device-only caveat."""
+    def planes(qs_t):
+        # cast each nibble plane to int4 BEFORE the concat: an int32
+        # intermediate of the whole concat is 8x the packed bytes and
+        # OOMs 13B (24.3 GB observed); int4-typed pieces keep transients
+        # at half the u8 size
+        q = qs_t.astype(jnp.int32)
+        lo = ((q & 0xF) - 8).astype(jnp.int4)
+        hi = ((q >> 4) - 8).astype(jnp.int4)
+        return jnp.concatenate([lo, hi], axis=-3)
+
+    def conv(v):
+        if isinstance(v, Q40Kernel):
+            return Q40KernelI4(planes(v.qs_t), v.scale)
+        if isinstance(v, Q40KernelNb):
+            return Q40KernelNbI4(planes(v.qs_t), v.scale)
+        return v
+
+    if isinstance(tree, (Q40Kernel, Q40KernelNb)):
+        return conv(tree)
+    return {k: conv(v) for k, v in tree.items()}
+
+
+def _matvec_body_i4(qs4, s, x32_ref, out_ref):
+    """T=1 d-major int4 body: qs4 (32, R, nb) signed planes (code-8
+    pre-applied), s (R, nb) f32, x32 (32, 1, nb) f32 plane-split inputs
+    (lo planes then hi). One convert + broadcast-mul + add per plane —
+    no mask, no shift, no xsum correction."""
+    acc = None
+    for j in range(2 * NJ):
+        w = qs4[j].astype(jnp.float32)               # (R, nb)
+        a = w * x32_ref[j]                           # (1, nb) bcast over R
+        acc = a if acc is None else acc + a
+    out_ref[...] = jnp.sum(acc * s, axis=1, keepdims=True)  # (R, 1)
+
+
+def _kernel_matvec_i4_stacked(layer_ref, qs_ref, scale_ref, x32_ref,
+                              out_ref):
+    del layer_ref  # consumed by the index maps
+    _matvec_body_i4(qs_ref[0], scale_ref[0], x32_ref, out_ref)
+
+
+def _kernel_matvec_i4(qs_ref, scale_ref, x32_ref, out_ref):
+    _matvec_body_i4(qs_ref, scale_ref[...], x32_ref, out_ref)
+
+
+def _matvec_body_nb_i4(qs4, s, x32_ref, out_ref):
+    """T=1 nb-major int4 body: qs4 (32, nb, R), s (nb, R), x32 (32, nb, 1);
+    out (1, R). The tools/nb_probe.py 'i4' winner verbatim."""
+    acc = None
+    for j in range(2 * NJ):
+        w = qs4[j].astype(jnp.float32)               # (nb, R)
+        a = w * x32_ref[j]                           # (nb, 1) bcast over R
+        acc = a if acc is None else acc + a
+    out_ref[...] = jnp.sum(acc * s, axis=0, keepdims=True)  # (1, R)
+
+
+def _kernel_matvec_nb_i4_stacked(layer_ref, qs_ref, scale_ref, x32_ref,
+                                 out_ref):
+    del layer_ref
+    _matvec_body_nb_i4(qs_ref[0], scale_ref[0], x32_ref, out_ref)
+
+
+def _kernel_matvec_nb_i4(qs_ref, scale_ref, x32_ref, out_ref):
+    _matvec_body_nb_i4(qs_ref, scale_ref[...], x32_ref, out_ref)
+
+
+def _multi_t_body() -> str:
+    """T in (2..MULTI_T_MAX) body — DLLAMA_MULTI_T_BODY:
+
+    * 'vpu' (default): the shared-unpack VPU accumulate body
+      (_matvec_body_multi). Exact f32 math; per-row MAC work scales with
+      T (the continuous-batching step-floor term, BASELINE.md r4:
+      23.9 ms of the 8-slot 31 ms op floor).
+    * 'dequant': one-dot MXU body (VERDICT r4 #6's "new formulation"):
+      unpack each weight tile ONCE into a flat (rows, 32*nb) bf16
+      scratch, then a single long dot (T, 32*nb) x (rows, 32*nb)^T —
+      per-row work rides the otherwise-idle MXU instead of the VPU.
+      bf16 multiply with f32 accumulation: a DOCUMENTED TOLERANCE on
+      batched decode logits (same contract as --fast-prefill), so it is
+      opt-in. Read at trace time.
+
+    Unknown values raise (a typo would silently run the default)."""
+    mode = os.environ.get("DLLAMA_MULTI_T_BODY") or "vpu"  # '' = unset
+    if mode not in ("vpu", "dequant"):
+        raise ValueError(f"DLLAMA_MULTI_T_BODY={mode!r}: "
+                         f"expected vpu|dequant")
+    return mode
+
+
+def _multi_body_dequant(qs3, s, xp_ref, out_ref, w_ref):
+    """T<=8 one-dot body: qs3 (NJ, R, nb) d-major codes, s (R, nb) f32,
+    xp (T, 32*nb) bf16 in PLANE order (xp[t, j*nb + b] = x[t, b*32 + j]
+    for j < 16, x[t, b*32 + j] for the hi planes at j-16 >= 0 shifted by
+    +16), w_ref (R, 32*nb) bf16 scratch; out (R, T) — R minor-most rides
+    the legal (8,128) block tiling (a (T, R) block with T=8 rows would
+    need R % 128, which small-d leaves can't give).
+
+    The VPU pays ~13 unpack ops/byte ONCE per tile (vs 5 + 4*T for the
+    accumulate body); the T-proportional MAC work becomes one MXU dot
+    with K = 32*nb — long enough to pipeline, M = T wasted rows accepted
+    (the MXU is idle in this phase anyway)."""
+    nb = s.shape[-1]
+    for j in range(NJ):
+        q = qs3[j].astype(jnp.int32)
+        w_ref[:, j * nb:(j + 1) * nb] = \
+            (((q & 0xF) - 8).astype(jnp.float32) * s).astype(jnp.bfloat16)
+        w_ref[:, (NJ + j) * nb:(NJ + j + 1) * nb] = \
+            (((q >> 4) - 8).astype(jnp.float32) * s).astype(jnp.bfloat16)
+    out_ref[...] = jax.lax.dot_general(
+        w_ref[...], xp_ref[...], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+
+def _kernel_multi_dequant(qs_ref, scale_ref, xp_ref, out_ref, w_ref):
+    _multi_body_dequant(qs_ref, scale_ref[...], xp_ref, out_ref, w_ref)
+
+
+def _kernel_multi_dequant_stacked(layer_ref, qs_ref, scale_ref, xp_ref,
+                                  out_ref, w_ref):
+    del layer_ref  # consumed by the index maps
+    _multi_body_dequant(qs_ref[0], scale_ref[0], xp_ref, out_ref, w_ref)
+
+
+def _x_planes(x: jax.Array, nb: int) -> jax.Array:
+    """(T, n) f32 -> (T, 32*nb) bf16 in the _multi_body_dequant plane
+    order (lo planes 0..15 then hi planes 16..31, each nb wide)."""
+    xlo, xhi = _split_x(x.astype(jnp.float32), nb)     # (NJ, T, nb)
+    xp = jnp.concatenate([xlo, xhi], axis=0)           # (32, T, nb)
+    t = x.shape[0]
+    return jnp.transpose(xp, (1, 0, 2)).reshape(t, 2 * NJ * nb) \
+        .astype(jnp.bfloat16)
+
+
 def _matmul_body_scratch(qs3, s, xlo_ref, xhi_ref, out_ref, wlo_ref, whi_ref,
                          bf16=False, nb_major=False):
     """T>8 MXU body, d-OUTER grid, unpack-once: grid is (d/rows, t/bt) with
@@ -445,9 +605,9 @@ def _split_x(x: jax.Array, nb: int) -> tuple[jax.Array, jax.Array]:
 
 @functools.partial(jax.jit,
                    static_argnames=("block_rows", "block_t", "interpret",
-                                    "bf16"))
+                                    "bf16", "multi_body"))
 def _q40_matmul_2d(qs_t, scale, x, *, block_rows, block_t, interpret,
-                   bf16=False):
+                   bf16=False, multi_body="vpu"):
     _, d, nb = qs_t.shape
     t = x.shape[0]
     xlo, xhi = _split_x(x.astype(jnp.float32), nb)
@@ -469,6 +629,23 @@ def _q40_matmul_2d(qs_t, scale, x, *, block_rows, block_t, interpret,
         )(qs_t, scale, xlo, xhi, xsum)
         return out.reshape(1, d)
     if t <= MULTI_T_MAX:
+        if multi_body == "dequant":
+            out = pl.pallas_call(
+                _kernel_multi_dequant,
+                grid=(d // block_rows,),
+                in_specs=[
+                    pl.BlockSpec((NJ, block_rows, nb), lambda i: (0, i, 0)),
+                    pl.BlockSpec((block_rows, nb), lambda i: (i, 0)),
+                    pl.BlockSpec((t, 2 * NJ * nb), lambda i: (0, 0)),
+                ],
+                out_specs=pl.BlockSpec((block_rows, t), lambda i: (i, 0)),
+                out_shape=jax.ShapeDtypeStruct((d, t), jnp.float32),
+                scratch_shapes=[
+                    pltpu.VMEM((block_rows, 2 * NJ * nb), jnp.bfloat16)],
+                compiler_params=_VMEM64_PARAMS,
+                interpret=interpret,
+            )(qs_t, scale, _x_planes(x, nb))
+            return jnp.transpose(out)                # (t, d)
         xsum = jnp.sum(xlo + xhi, axis=0)            # (t, nb)
         out = pl.pallas_call(
             _kernel_multi,
@@ -508,9 +685,9 @@ def _q40_matmul_2d(qs_t, scale, x, *, block_rows, block_t, interpret,
 
 @functools.partial(jax.jit,
                    static_argnames=("block_rows", "block_t", "interpret",
-                                    "bf16"))
+                                    "bf16", "multi_body"))
 def _q40_matmul_stacked(layer, qs_t, scale, x, *, block_rows, block_t,
-                        interpret, bf16=False):
+                        interpret, bf16=False, multi_body="vpu"):
     _, _, d, nb = qs_t.shape
     t = x.shape[0]
     xlo, xhi = _split_x(x.astype(jnp.float32), nb)
@@ -536,6 +713,28 @@ def _q40_matmul_stacked(layer, qs_t, scale, x, *, block_rows, block_t,
         )(layer, qs_t, scale, xlo, xhi, xsum)
         return out.reshape(1, d)
     if t <= MULTI_T_MAX:
+        if multi_body == "dequant":
+            grid_spec = pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=1,
+                grid=(d // block_rows,),
+                in_specs=[
+                    pl.BlockSpec((1, NJ, block_rows, nb),
+                                 lambda i, L: (L[0], 0, i, 0)),
+                    pl.BlockSpec((1, block_rows, nb),
+                                 lambda i, L: (L[0], i, 0)),
+                    pl.BlockSpec((t, 2 * NJ * nb), lambda i, L: (0, 0)),
+                ],
+                out_specs=pl.BlockSpec((block_rows, t),
+                                       lambda i, L: (i, 0)),
+                scratch_shapes=[
+                    pltpu.VMEM((block_rows, 2 * NJ * nb), jnp.bfloat16)],
+            )
+            out = pl.pallas_call(
+                _kernel_multi_dequant_stacked, grid_spec=grid_spec,
+                out_shape=jax.ShapeDtypeStruct((d, t), jnp.float32),
+                compiler_params=_VMEM64_PARAMS, interpret=interpret,
+            )(layer, qs_t, scale, _x_planes(x, nb))
+            return jnp.transpose(out)                # (t, d)
         xsum = jnp.sum(xlo + xhi, axis=0)            # (t, nb)
         grid_spec = pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
@@ -1096,6 +1295,146 @@ def _q40_matmul_nbmajor(w: Q40KernelNb, x: jax.Array,
     return _precision_dot(wf, x2).reshape(*lead, d)
 
 
+def _dequant_i4(w) -> jax.Array:
+    """f32 dense weight from int4 planes (the T>1 / untileable fallback):
+    plane index IS the in-block value position (0..31)."""
+    qs4, scale = w.qs4, w.scale
+    vals = qs4.astype(jnp.float32)
+    if isinstance(w, Q40KernelNbI4):
+        # (..., 32, nb, d) -> (..., d, nb, 32)
+        vals = jnp.moveaxis(jnp.moveaxis(vals, -3, -1), -3, -2)
+        scale = jnp.swapaxes(scale, -1, -2)
+    else:
+        vals = jnp.moveaxis(vals, -3, -1)          # (..., d, nb, 32)
+    w_f = vals * scale[..., None]
+    return w_f.reshape(*w_f.shape[:-2], w_f.shape[-2] * 32)
+
+
+def _q40_matmul_i4(w, x, interpret, layer, block_rows):
+    """Dispatch for the int4-plane layouts (chain-internal, T=1 hot path;
+    anything else takes the dequantize-then-dot fallback)."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    nb_major = isinstance(w, Q40KernelNbI4)
+    d = w.logical_shape[-2]
+    nb = (w.scale.shape[-2] if nb_major else w.scale.shape[-1])
+    lead = x.shape[:-1]
+    x2 = x.reshape(-1, x.shape[-1])
+    if x2.shape[0] == 1:
+        if nb_major:
+            rows = block_rows or _pick_rows_nb(d, nb)
+        else:
+            rows = block_rows or _pick_block_rows(d, 1, nb)
+        if rows:
+            if layer is not None:
+                out = (_q40_matvec_nb_i4_stacked if nb_major
+                       else _q40_matvec_i4_stacked)(
+                    jnp.asarray(layer, jnp.int32).reshape(1), w.qs4,
+                    w.scale, x2, block_rows=rows, interpret=interpret)
+            else:
+                out = (_q40_matvec_nb_i4_2d if nb_major
+                       else _q40_matvec_i4_2d)(
+                    w.qs4, w.scale, x2, block_rows=rows,
+                    interpret=interpret)
+            return out.reshape(*lead, d)
+    wf = _dequant_i4(w)
+    if layer is not None:
+        wf = wf[layer]
+    return jnp.einsum("dn,tn->td", wf, x2.astype(jnp.float32),
+                      preferred_element_type=jnp.float32,
+                      precision=jax.lax.Precision.HIGHEST) \
+        .reshape(*lead, d)
+
+
+@functools.partial(jax.jit, static_argnames=("block_rows", "interpret"))
+def _q40_matvec_i4_2d(qs4, scale, x, *, block_rows, interpret):
+    nj2, d, nb = qs4.shape
+    xlo, xhi = _split_x(x.astype(jnp.float32), nb)   # (NJ, 1, nb)
+    x32 = jnp.concatenate([xlo, xhi], axis=0)        # (32, 1, nb)
+    out = pl.pallas_call(
+        _kernel_matvec_i4,
+        grid=(d // block_rows,),
+        in_specs=[
+            pl.BlockSpec((nj2, block_rows, nb), lambda i: (0, i, 0)),
+            pl.BlockSpec((block_rows, nb), lambda i: (i, 0)),
+            pl.BlockSpec((nj2, 1, nb), lambda i: (0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((block_rows, 1), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((d, 1), jnp.float32),
+        compiler_params=_VMEM64_PARAMS, interpret=interpret,
+    )(qs4, scale, x32)
+    return out.reshape(1, d)
+
+
+@functools.partial(jax.jit, static_argnames=("block_rows", "interpret"))
+def _q40_matvec_i4_stacked(layer, qs4, scale, x, *, block_rows, interpret):
+    _, nj2, d, nb = qs4.shape
+    xlo, xhi = _split_x(x.astype(jnp.float32), nb)
+    x32 = jnp.concatenate([xlo, xhi], axis=0)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(d // block_rows,),
+        in_specs=[
+            pl.BlockSpec((1, nj2, block_rows, nb),
+                         lambda i, L: (L[0], 0, i, 0)),
+            pl.BlockSpec((1, block_rows, nb), lambda i, L: (L[0], i, 0)),
+            pl.BlockSpec((nj2, 1, nb), lambda i, L: (0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((block_rows, 1), lambda i, L: (i, 0)),
+    )
+    out = pl.pallas_call(
+        _kernel_matvec_i4_stacked, grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((d, 1), jnp.float32),
+        compiler_params=_VMEM64_PARAMS, interpret=interpret,
+    )(layer, qs4, scale, x32)
+    return out.reshape(1, d)
+
+
+@functools.partial(jax.jit, static_argnames=("block_rows", "interpret"))
+def _q40_matvec_nb_i4_2d(qs4, scale, x, *, block_rows, interpret):
+    nj2, nb, d = qs4.shape
+    xlo, xhi = _split_x(x.astype(jnp.float32), nb)   # (NJ, 1, nb)
+    x32 = jnp.transpose(jnp.concatenate([xlo, xhi], axis=0),
+                        (0, 2, 1))                   # (32, nb, 1)
+    out = pl.pallas_call(
+        _kernel_matvec_nb_i4,
+        grid=(d // block_rows,),
+        in_specs=[
+            pl.BlockSpec((nj2, nb, block_rows), lambda i: (0, 0, i)),
+            pl.BlockSpec((nb, block_rows), lambda i: (0, i)),
+            pl.BlockSpec((nj2, nb, 1), lambda i: (0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_rows), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((1, d), jnp.float32),
+        compiler_params=_VMEM64_PARAMS, interpret=interpret,
+    )(qs4, scale, x32)
+    return out
+
+
+@functools.partial(jax.jit, static_argnames=("block_rows", "interpret"))
+def _q40_matvec_nb_i4_stacked(layer, qs4, scale, x, *, block_rows,
+                              interpret):
+    _, nj2, nb, d = qs4.shape
+    xlo, xhi = _split_x(x.astype(jnp.float32), nb)
+    x32 = jnp.transpose(jnp.concatenate([xlo, xhi], axis=0), (0, 2, 1))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(d // block_rows,),
+        in_specs=[
+            pl.BlockSpec((1, nj2, nb, block_rows),
+                         lambda i, L: (L[0], 0, 0, i)),
+            pl.BlockSpec((1, nb, block_rows), lambda i, L: (L[0], 0, i)),
+            pl.BlockSpec((nj2, nb, 1), lambda i, L: (0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_rows), lambda i, L: (0, i)),
+    )
+    return pl.pallas_call(
+        _kernel_matvec_nb_i4_stacked, grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((1, d), jnp.float32),
+        compiler_params=_VMEM64_PARAMS, interpret=interpret,
+    )(layer, qs4, scale, x32)
+
+
 def q40_matmul(w: Q40Kernel | Q40Weight, x: jax.Array,
                block_rows: int | None = None,
                interpret: bool | None = None,
@@ -1110,6 +1449,8 @@ def q40_matmul(w: Q40Kernel | Q40Weight, x: jax.Array,
     (L, 16, d, nb)) and the kernel DMAs layer ``layer`` directly out of the
     stack via scalar prefetch — the zero-copy path for lax.scan over layers.
     """
+    if isinstance(w, (Q40KernelI4, Q40KernelNbI4)):
+        return _q40_matmul_i4(w, x, interpret, layer, block_rows)
     if isinstance(w, Q40KernelNb):
         return _q40_matmul_nbmajor(w, x, interpret, layer, block_rows)
     if isinstance(w, Q40Weight):
@@ -1153,6 +1494,11 @@ def q40_matmul(w: Q40Kernel | Q40Weight, x: jax.Array,
             # the shapes that matter
             return _dequant_matmul(w, x2, layer).reshape(*lead, d)
     scratch = t > MULTI_T_MAX and _prefill_matmul_mode() == "scratch"
+    # like bf16 above: the T<=8 body mode must be read at the CALLER's
+    # trace point and threaded as a static arg, or a cached inner trace
+    # silently serves the other body after the env flips
+    extra = {} if scratch else {"multi_body": _multi_t_body()
+                                if t <= MULTI_T_MAX else "vpu"}
     if layer is not None:
         if qs_t.ndim != 4:
             raise ValueError("layer= requires stacked (L, 16, d, nb) weights")
@@ -1160,9 +1506,9 @@ def q40_matmul(w: Q40Kernel | Q40Weight, x: jax.Array,
         call = _q40_matmul_stacked_scratch if scratch else _q40_matmul_stacked
         out = call(lidx, qs_t, scale, x2,
                    block_rows=block_rows, block_t=block_t,
-                   interpret=interpret, bf16=bf16)
+                   interpret=interpret, bf16=bf16, **extra)
     else:
         call = _q40_matmul_2d_scratch if scratch else _q40_matmul_2d
         out = call(qs_t, scale, x2, block_rows=block_rows,
-                   block_t=block_t, interpret=interpret, bf16=bf16)
+                   block_t=block_t, interpret=interpret, bf16=bf16, **extra)
     return out.reshape(*lead, d)

@@ -99,13 +99,16 @@ def sp_cache_attention(head_size: int, kv_mul: int, seq_chunk: int,
 
     t_len = q.shape[0]
     q_pos = pos + jnp.arange(t_len)                     # (T,)
-    if t_len > 8 and _prefill_attn_mode() == "block":
+    if t_len > 8 and _prefill_attn_mode() != "dense":
         # prefill chunks: bound the scored keys by the live prefix (the
         # dense partial below masks-but-computes the whole chunk — at
         # tp-only meshes the chunk IS the full seq plane; same finding as
-        # models.llama's blockwise prefill, BASELINE.md r3). Honors the
-        # same DLLAMA_PREFILL_ATTN=dense escape hatch as the single-chip
-        # path.
+        # models.llama's blockwise prefill, BASELINE.md r3). 'auto',
+        # 'flash', and 'block' all take the blockwise walk here — the
+        # Pallas flash kernel is the UNSHARDED path's implementation; the
+        # sp-sharded partials keep the XLA walk (the LSE cross-axis
+        # combine needs m/l/o partials, not finished outputs). Only the
+        # DLLAMA_PREFILL_ATTN=dense escape hatch scores the full plane.
         m, l, o = blockwise_chunk_partials(
             head_size, kv_mul, q, k_chunk, v_chunk,
             sp_index * seq_chunk, q_pos)

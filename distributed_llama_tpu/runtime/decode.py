@@ -138,6 +138,15 @@ def _make_decode_run(step_fn: StepFn, max_steps: int, temperature: float,
         num_steps. The token buffer is BOS-initialized — untouched slots
         read as the terminator, so the host-side truncation is unchanged.
         """
+        if isinstance(params, dict):
+            from ..ops.pallas_q40 import q40_i4_enabled, to_i4_planes
+
+            if q40_i4_enabled():
+                # DLLAMA_Q40_I4: re-express the packed kernel leaves as
+                # signed-int4 planes ONCE per chain, inside the program
+                # (int4 cannot cross this runtime's jit boundary) —
+                # ~0.06 ms/token amortized, faster matvec body every step
+                params = to_i4_planes(params)
         toks0 = jnp.full((max_steps,), BOS, dtype=jnp.int32)
 
         def cond(carry):
