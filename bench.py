@@ -89,9 +89,12 @@ def _tree_shapes_cached(spec, rank_tp: int, build, build_sig: str = ""):
     # layout, the matvec row cap feeds the layout picks, and builder
     # kwargs (e.g. the 70b rank tree's embed_dtype) change leaf
     # shapes/dtypes
+    from distributed_llama_tpu.ops.pallas_q40 import q40_i4_enabled
+
     key = hashlib.sha256(
-        f"v2|{spec!r}|{rank_tp}|{q40_kernel_mode()}|{fusion_cache_key()}"
-        f"|{_matvec_cap()}|{build_sig}".encode()).hexdigest()[:16]
+        f"v3|{spec!r}|{rank_tp}|{q40_kernel_mode()}|{fusion_cache_key()}"
+        f"|{_matvec_cap()}|i4={q40_i4_enabled()}|{build_sig}"
+        .encode()).hexdigest()[:16]
     path = os.path.join(default_cache_dir(), "shapes", f"tree_{key}.pkl")
     if os.environ.get("DLLAMA_SHAPE_CACHE", "1") != "0" \
             and os.path.exists(path):
@@ -183,6 +186,11 @@ def _bench(spec, params, samples: int, per_step: bool = False,
         # everywhere; 13B's nb=160 leaves (wq..wo, w1/w3, wcls, pad 1.6x)
         # switch to nb-major while its w2 (nb=432, 1.19x) stays d-major
         hp = fuse_q40_layer_matmuls(pack_q40_params(p, allow_nb_major=True))
+        # DLLAMA_Q40_I4=on needs NO host prep: the chain converts u8
+        # nb-major leaves to int4 planes in-program (chain_weight_prep) —
+        # the astype-produced s4 arrays get XLA-native layouts, which the
+        # packed-u8-carrier + bitcast route does NOT (measured 4.7x rank
+        # slowdown from the bitcast-materialized layout; BASELINE.md r5)
         if rank_tp == 0:
             # whole-layer megakernel prep (permuted-wo stack) if supported
             from distributed_llama_tpu.ops.pallas_layer import (
@@ -225,11 +233,18 @@ def _bench(spec, params, samples: int, per_step: bool = False,
     # r4: rank rows pack with allow_nb_major=True — legal for the plain-jit
     # rank program, but the shard_map sharding specs reject nb-major, so a
     # deployed tp program would run d-major; the caveat must ride the JSON)
-    from distributed_llama_tpu.io.loader import Q40KernelNb
+    from distributed_llama_tpu.io.loader import (Q40KernelI4PackedD,
+                                                 Q40KernelI4PackedNb,
+                                                 Q40KernelNb)
 
-    has_nb = any(isinstance(x, Q40KernelNb) for x in jax.tree_util.tree_leaves(
-        host_params, is_leaf=lambda x: isinstance(x, Q40KernelNb)))
-    _STARTUP["q40_layout"] = "nb-major+d-major mix" if has_nb else "d-major"
+    _nbish = (Q40KernelNb, Q40KernelI4PackedNb)
+    _i4p = (Q40KernelI4PackedD, Q40KernelI4PackedNb)
+    leaves = jax.tree_util.tree_leaves(
+        host_params, is_leaf=lambda x: isinstance(x, _nbish + _i4p))
+    has_nb = any(isinstance(x, _nbish) for x in leaves)
+    _STARTUP["q40_layout"] = (
+        ("i4-packed " if any(isinstance(x, _i4p) for x in leaves) else "")
+        + ("nb-major+d-major mix" if has_nb else "d-major"))
     if rank_tp and has_nb:
         _STARTUP["rank_layout_caveat"] = (
             "rank measured with nb-major leaves (unsharded-plain-jit-only "
@@ -520,6 +535,12 @@ def _run_all(args) -> int:
                "--config", cfg, "--samples", str(args.samples)]
         print(f"=== bench --config {cfg} ===", file=sys.stderr)
         env = dict(os.environ)
+        if cfg.startswith("13b-tp") and "DLLAMA_Q40_I4" not in env:
+            # nb-major rank bands take the int4-plane body (measured:
+            # 13b-tp4 rank 7.8 -> 7.51 ms, 105.6x same-n; BASELINE.md r5).
+            # 13B single-chip OOMs the transient copy and d-major bodies
+            # measured slower, so only these rows default it on.
+            env["DLLAMA_Q40_I4"] = "on"
         prof = None
         if env.get("DLLAMA_BENCH_NO_PROFILE") != "1" \
                 and "DLLAMA_BENCH_PROFILE" not in env:
@@ -811,6 +832,9 @@ def main():
         # recorded here so the comparison basis is explicit)
         "kv_cache": ("bf16" if os.environ.get("DLLAMA_BENCH_KV_BF16")
                      else "f32"),
+        # int4-plane chain conversion active? (nb-major leaves only —
+        # the layout label above reports the HOST tree, which stays u8)
+        "q40_i4": os.environ.get("DLLAMA_Q40_I4", "off"),
         **_STARTUP,
     }
     if rank_tp:

@@ -31,7 +31,8 @@ import time
 
 import numpy as np
 
-from .loader import Q40Kernel, Q40KernelNb, Q40Weight
+from .loader import (Q40Kernel, Q40KernelI4PackedD, Q40KernelI4PackedNb,
+                     Q40KernelNb, Q40Weight)
 
 MAGIC = b"DLKC0001"
 _ALIGN = 4096
@@ -41,6 +42,8 @@ _KINDS = {
     "q40w": (Q40Weight, 2),
     "q40k": (Q40Kernel, 2),
     "q40knb": (Q40KernelNb, 2),
+    "q40i4pd": (Q40KernelI4PackedD, 2),
+    "q40i4pnb": (Q40KernelI4PackedNb, 2),
 }
 
 
@@ -51,6 +54,10 @@ def _kind_of(v) -> str:
         return "q40k"
     if isinstance(v, Q40KernelNb):
         return "q40knb"
+    if isinstance(v, Q40KernelI4PackedD):
+        return "q40i4pd"
+    if isinstance(v, Q40KernelI4PackedNb):
+        return "q40i4pnb"
     return "dense"
 
 
@@ -62,12 +69,12 @@ def layout_key(model_path: str | None = None, tp: int = 1) -> str:
     the old weights."""
     from ..ops.linear import q40_kernel_mode
     from ..ops.pallas_layer import fusion_cache_key
-    from ..ops.pallas_q40 import _matvec_cap
+    from ..ops.pallas_q40 import _matvec_cap, q40_i4_enabled
 
-    src = ""
+    src = f"|i4={q40_i4_enabled()}"
     if model_path is not None:
         st = os.stat(model_path)
-        src = f"|src={st.st_size}:{st.st_mtime_ns}"
+        src += f"|src={st.st_size}:{st.st_mtime_ns}"
     return (f"v1|{q40_kernel_mode()}|{_matvec_cap()}|{fusion_cache_key()}"
             f"|nb=auto|tp={tp}{src}")
 

@@ -31,7 +31,8 @@ from typing import Any, NamedTuple
 import jax
 import jax.numpy as jnp
 
-from ..io.loader import Q40Kernel, Q40KernelI4, Q40KernelNb, Q40KernelNbI4
+from ..io.loader import (Q40Kernel, Q40KernelI4, Q40KernelI4PackedD,
+                         Q40KernelI4PackedNb, Q40KernelNb, Q40KernelNbI4)
 from ..ops.linear import StackedQ40, fake_quant_q80, matmul, rmsnorm, silu
 from ..ops.quants import FloatType
 from .spec import TransformerSpec
@@ -292,7 +293,9 @@ def split_layer_weights(params: dict[str, Any]):
     keys = [k for k in LAYER_KEYS + FUSED_KEYS if k in params]
     stacked = {k: params[k] for k in keys
                if isinstance(params[k], (Q40Kernel, Q40KernelNb,
-                                         Q40KernelI4, Q40KernelNbI4))}
+                                         Q40KernelI4, Q40KernelNbI4,
+                                         Q40KernelI4PackedD,
+                                         Q40KernelI4PackedNb))}
     scanned = {k: params[k] for k in keys if k not in stacked}
     return stacked, scanned
 

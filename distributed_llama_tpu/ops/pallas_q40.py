@@ -43,7 +43,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from ..io.loader import (Q40Kernel, Q40KernelI4, Q40KernelNb, Q40KernelNbI4,
+from ..io.loader import (Q40Kernel, Q40KernelI4, Q40KernelI4PackedD,
+                         Q40KernelI4PackedNb, Q40KernelNb, Q40KernelNbI4,
                          Q40Weight, to_kernel_layout)
 
 QK = 32
@@ -311,15 +312,76 @@ def to_i4_planes(tree):
         return jnp.concatenate([lo, hi], axis=-3)
 
     def conv(v):
-        if isinstance(v, Q40Kernel):
-            return Q40KernelI4(planes(v.qs_t), v.scale)
+        # nb-major only in production (see repack_i4_packed); the d-major
+        # planes exist for tests/experiments via the single-leaf form
         if isinstance(v, Q40KernelNb):
             return Q40KernelNbI4(planes(v.qs_t), v.scale)
         return v
 
     if isinstance(tree, (Q40Kernel, Q40KernelNb)):
+        if isinstance(tree, Q40Kernel):
+            return Q40KernelI4(planes(tree.qs_t), tree.scale)
         return conv(tree)
     return {k: conv(v) for k, v in tree.items()}
+
+
+def repack_i4_packed(tree):
+    """HOST-side: re-express u8 kernel leaves as the RESIDENT packed-i4
+    carrier (Q40KernelI4Packed*): (code - 8) signed nibbles, pairwise
+    along the minor dim, low nibble = even index (XLA S4 bit order).
+    (c - 8) & 0xF == c ^ 0x8 for 4-bit codes, so the repack is two XORs
+    and an interleave. Leaves whose minor dim is odd (tiny test specs)
+    stay u8 — the chain's legacy in-program conversion covers them."""
+    import numpy as np
+
+    def pack(qs_t):
+        lo = (qs_t & 0xF) ^ 0x8
+        hi = (qs_t >> 4) ^ 0x8
+        pl = np.concatenate([np.asarray(lo), np.asarray(hi)], axis=-3)
+        return (pl[..., 0::2] | (pl[..., 1::2] << 4)).astype(np.uint8)
+
+    def conv(v):
+        # nb-major ONLY: the d-major s4 body measured ~6x SLOWER than u8
+        # on hardware (64 vs 10.3 ms/token at 7B — Mosaic's s4->f32
+        # unpack on (rows, nb) tiles is pathological), while the nb-major
+        # body is the probe's 701 GB/s winner. Q40Kernel leaves stay u8.
+        if isinstance(v, Q40KernelNb) and v.qs_t.shape[-1] % 2 == 0:
+            return Q40KernelI4PackedNb(pack(np.asarray(v.qs_t)), v.scale)
+        return v
+
+    return {k: conv(v) for k, v in tree.items()}
+
+
+def unpack_i4_packed(v):
+    """Jit-internal: the packed-u8 carrier -> int4 plane leaf. The
+    bitcast adds a trailing pair dim that the minor reshape collapses —
+    both are layout reinterpretations of the SAME packed bits (no second
+    copy of the weights)."""
+    q4 = jax.lax.bitcast_convert_type(v.qs_p, jnp.int4)   # (..., X, Y/2, 2)
+    q4 = q4.reshape(*q4.shape[:-2], q4.shape[-2] * 2)     # (..., X, Y)
+    if isinstance(v, Q40KernelI4PackedD):
+        return Q40KernelI4(q4, v.scale)
+    return Q40KernelNbI4(q4, v.scale)
+
+
+def chain_weight_prep(params):
+    """Decode-chain weight prep, run INSIDE the jitted chain: packed-i4
+    carriers always unpack (they are unreadable otherwise); u8 kernel
+    leaves additionally convert to i4 planes when DLLAMA_Q40_I4=on (the
+    legacy double-residency path — fine at 7B, OOMs 13B)."""
+    i4 = q40_i4_enabled()
+
+    def conv(v):
+        if isinstance(v, (Q40KernelI4PackedD, Q40KernelI4PackedNb)):
+            return unpack_i4_packed(v)
+        # nb-major ONLY (the d-major s4 body is the documented ~6x
+        # negative; the single-leaf to_i4_planes form still converts
+        # d-major for tests, so gate HERE)
+        if i4 and isinstance(v, Q40KernelNb):
+            return to_i4_planes(v)
+        return v
+
+    return {k: conv(v) for k, v in params.items()}
 
 
 def _matvec_body_i4(qs4, s, x32_ref, out_ref):
@@ -1310,6 +1372,18 @@ def _dequant_i4(w) -> jax.Array:
     return w_f.reshape(*w_f.shape[:-2], w_f.shape[-2] * 32)
 
 
+def _pick_rows_i4(d: int, nb: int) -> int | None:
+    """Row tile for the d-major int4 matvec: int4 operands carry a
+    (64, 128) native tile, so the second-minor block dim (rows) must be a
+    multiple of 64 (Mosaic: 'has tiling (64, 128)'), under the same
+    VMEM-word budget as the u8 picker."""
+    top = min(d, _matvec_cap(), max(64, 360_000 // nb))
+    for cand in range(top - top % 64, 0, -64):
+        if d % cand == 0:
+            return cand
+    return None
+
+
 def _q40_matmul_i4(w, x, interpret, layer, block_rows):
     """Dispatch for the int4-plane layouts (chain-internal, T=1 hot path;
     anything else takes the dequantize-then-dot fallback)."""
@@ -1324,7 +1398,7 @@ def _q40_matmul_i4(w, x, interpret, layer, block_rows):
         if nb_major:
             rows = block_rows or _pick_rows_nb(d, nb)
         else:
-            rows = block_rows or _pick_block_rows(d, 1, nb)
+            rows = block_rows or _pick_rows_i4(d, nb)
         if rows:
             if layer is not None:
                 out = (_q40_matvec_nb_i4_stacked if nb_major
@@ -1449,6 +1523,10 @@ def q40_matmul(w: Q40Kernel | Q40Weight, x: jax.Array,
     (L, 16, d, nb)) and the kernel DMAs layer ``layer`` directly out of the
     stack via scalar prefetch — the zero-copy path for lax.scan over layers.
     """
+    if isinstance(w, (Q40KernelI4PackedD, Q40KernelI4PackedNb)):
+        # callers outside a prepped chain (prefill, tests): unpack per
+        # call — the bitcast is a reinterpretation, not a weight copy
+        w = unpack_i4_packed(w)
     if isinstance(w, (Q40KernelI4, Q40KernelNbI4)):
         return _q40_matmul_i4(w, x, interpret, layer, block_rows)
     if isinstance(w, Q40KernelNb):

@@ -139,14 +139,13 @@ def _make_decode_run(step_fn: StepFn, max_steps: int, temperature: float,
         read as the terminator, so the host-side truncation is unchanged.
         """
         if isinstance(params, dict):
-            from ..ops.pallas_q40 import q40_i4_enabled, to_i4_planes
+            # packed-i4 carriers always unpack here (a bitcast, not a
+            # compute pass); u8 leaves convert iff DLLAMA_Q40_I4=on.
+            # In-program because int4 cannot cross this runtime's jit
+            # boundary.
+            from ..ops.pallas_q40 import chain_weight_prep
 
-            if q40_i4_enabled():
-                # DLLAMA_Q40_I4: re-express the packed kernel leaves as
-                # signed-int4 planes ONCE per chain, inside the program
-                # (int4 cannot cross this runtime's jit boundary) —
-                # ~0.06 ms/token amortized, faster matvec body every step
-                params = to_i4_planes(params)
+            params = chain_weight_prep(params)
         toks0 = jnp.full((max_steps,), BOS, dtype=jnp.int32)
 
         def cond(carry):
