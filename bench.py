@@ -535,11 +535,12 @@ def _run_all(args) -> int:
                "--config", cfg, "--samples", str(args.samples)]
         print(f"=== bench --config {cfg} ===", file=sys.stderr)
         env = dict(os.environ)
-        if cfg.startswith("13b-tp") and "DLLAMA_Q40_I4" not in env:
-            # nb-major rank bands take the int4-plane body (measured:
-            # 13b-tp4 rank 7.8 -> 7.51 ms, 105.6x same-n; BASELINE.md r5).
-            # 13B single-chip OOMs the transient copy and d-major bodies
-            # measured slower, so only these rows default it on.
+        if cfg in ("13b-tp2", "13b-tp4") and "DLLAMA_Q40_I4" not in env:
+            # nb-major rank bands take the int4-plane body where it wins
+            # (same-session A/B, r5: tp2 10.68 vs 11.41, tp4 8.09 vs 8.46
+            # — but tp8 7.41 vs 6.76: the per-chain conversion tax beats
+            # the kernel gain at tp8 band sizes). 13B single-chip OOMs
+            # the transient copy; d-major bodies measured slower.
             env["DLLAMA_Q40_I4"] = "on"
         prof = None
         if env.get("DLLAMA_BENCH_NO_PROFILE") != "1" \

@@ -69,9 +69,12 @@ def layout_key(model_path: str | None = None, tp: int = 1) -> str:
     the old weights."""
     from ..ops.linear import q40_kernel_mode
     from ..ops.pallas_layer import fusion_cache_key
-    from ..ops.pallas_q40 import _matvec_cap, q40_i4_enabled
+    from ..ops.pallas_q40 import _matvec_cap
 
-    src = f"|i4={q40_i4_enabled()}"
+    # DLLAMA_Q40_I4 is deliberately NOT in this key: the sidecar stores
+    # the host u8 tree either way (i4 conversion is in-chain), and keying
+    # on it would rebuild the GB-scale sidecar on every flag flip
+    src = ""
     if model_path is not None:
         st = os.stat(model_path)
         src += f"|src={st.st_size}:{st.st_mtime_ns}"

@@ -276,11 +276,13 @@ _VMEM64_PARAMS = pltpu.CompilerParams(vmem_limit_bytes=64 * 1024 * 1024)
 
 def q40_i4_enabled() -> bool:
     """DLLAMA_Q40_I4=on routes the fused decode chain through signed-int4
-    weight planes (VERDICT r4 #2's second nb-major formulation, extended
-    to d-major too).
+    weight planes (VERDICT r4 #2's second nb-major formulation).
+    NB-MAJOR LEAVES ONLY: d-major trees (7B/70B shapes) are a silent
+    no-op — their s4 body measured ~6x SLOWER on hardware (BASELINE.md
+    r5), so the flag only changes 13B-class nb-major leaves.
 
     What it does: at CHAIN START (inside the jitted program — this
-    runtime cannot pass int4 across a jit boundary) every Q40Kernel[Nb]
+    runtime cannot pass int4 across a jit boundary) every Q40KernelNb
     leaf is re-expressed as (code - 8) int4 planes (to_i4_planes); the
     T=1 matvec body then needs ONE convert + mul + add per plane instead
     of convert/mask/shift/2xconvert/2xmul/2xadd — measured 701 GB/s vs
