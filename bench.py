@@ -93,7 +93,8 @@ def _tree_shapes_cached(spec, rank_tp: int, build, build_sig: str = ""):
 
     key = hashlib.sha256(
         f"v3|{spec!r}|{rank_tp}|{q40_kernel_mode()}|{fusion_cache_key()}"
-        f"|{_matvec_cap()}|i4={q40_i4_enabled()}|{build_sig}"
+        f"|{_matvec_cap()}|i4={q40_i4_enabled()}"
+        f"|nbm={os.environ.get('DLLAMA_NB_MAJOR', '')}|{build_sig}"
         .encode()).hexdigest()[:16]
     path = os.path.join(default_cache_dir(), "shapes", f"tree_{key}.pkl")
     if os.environ.get("DLLAMA_SHAPE_CACHE", "1") != "0" \
@@ -542,6 +543,17 @@ def _run_all(args) -> int:
             # the kernel gain at tp8 band sizes). 13B single-chip OOMs
             # the transient copy; d-major bodies measured slower.
             env["DLLAMA_Q40_I4"] = "on"
+        if cfg == "7b" and "DLLAMA_Q40_I4" not in env \
+                and "DLLAMA_NB_MAJOR" not in env:
+            # 7B single-chip: forced nb-major + int4 planes measured
+            # 9.645 vs 9.98-10.37 ms/token same-session (the i4 body is
+            # nb-major-only, so pad-free 7B shapes need the forced
+            # layout). The tp rows keep d-major: force+i4 measured a
+            # wash at tp4 (4.96 vs 5.00) and a loss at tp2/tp8/70b-tp8
+            # (6.74 vs 6.59, 4.66 vs 4.60, 19.67 vs 18.62) — the
+            # per-chain conversion tax against band-sized matvec shares.
+            env["DLLAMA_Q40_I4"] = "on"
+            env["DLLAMA_NB_MAJOR"] = "force"
         prof = None
         if env.get("DLLAMA_BENCH_NO_PROFILE") != "1" \
                 and "DLLAMA_BENCH_PROFILE" not in env:

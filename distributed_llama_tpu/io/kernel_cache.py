@@ -78,8 +78,9 @@ def layout_key(model_path: str | None = None, tp: int = 1) -> str:
     if model_path is not None:
         st = os.stat(model_path)
         src += f"|src={st.st_size}:{st.st_mtime_ns}"
+    nbm = os.environ.get("DLLAMA_NB_MAJOR", "auto") or "auto"
     return (f"v1|{q40_kernel_mode()}|{_matvec_cap()}|{fusion_cache_key()}"
-            f"|nb=auto|tp={tp}{src}")
+            f"|nb={nbm}|tp={tp}{src}")
 
 
 def sidecar_path(model_path: str) -> str:

@@ -209,8 +209,12 @@ def pack_q40_params(params: dict, enable: bool | None = None,
         nb = n // 32
         pad_ratio = (nb + (-nb % 128)) / nb  # TPU lane padding of nb-minor
         # nb-major layout when the standard tiling would pad the packed
-        # bytes materially (13B: nb=160 -> 1.6x HBM and read inflation)
-        if (allow_nb_major and tp == 1 and pad_ratio > 1.25
+        # bytes materially (13B: nb=160 -> 1.6x HBM and read inflation).
+        # DLLAMA_NB_MAJOR=force takes it for EVERY eligible leaf (the
+        # i4-formulation experiment arm: the int4 body exists only for
+        # nb-major, so pad-free shapes need the forced layout to reach it)
+        force_nb = os.environ.get("DLLAMA_NB_MAJOR", "") == "force"
+        if (allow_nb_major and tp == 1 and (pad_ratio > 1.25 or force_nb)
                 and _pick_rows_nb(d, nb) is not None):
             return to_kernel_layout_nb(v)
         if kernel_supports(d // tp, n):

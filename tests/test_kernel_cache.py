@@ -152,3 +152,16 @@ def test_packed_tree_decodes_like_codec_tree(tmp_path, monkeypatch):
                          init_cache(spec), tok, jnp.int32(0))
     np.testing.assert_allclose(np.asarray(logits1), np.asarray(logits2),
                                atol=2e-5)
+
+
+def test_nb_major_force_invalidates(tmp_path, monkeypatch):
+    """DLLAMA_NB_MAJOR changes the packed layout, so it must re-key the
+    sidecar (a d-major sidecar served to a force run would silently
+    ignore the layout request)."""
+    monkeypatch.setenv("DLLAMA_Q40_KERNEL", "pallas")
+    path = _model_file(tmp_path)
+    kc.load_model_packed(path)
+    side = kc.sidecar_path(path)
+    assert kc.load_packed(side, kc.layout_key(path)) is not None
+    monkeypatch.setenv("DLLAMA_NB_MAJOR", "force")
+    assert kc.load_packed(side, kc.layout_key(path)) is None
