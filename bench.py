@@ -508,6 +508,30 @@ def _compact_summary(configs, rows, curve) -> dict:
     return out
 
 
+def _row_env(cfg: str, env: dict) -> dict:
+    """Per-row kernel-policy env for the --config all subprocesses —
+    every default here is a SAME-SESSION A/B winner (BASELINE.md r5);
+    explicit user env always wins.
+
+    * 13b-tp2/tp4: int4-plane body on the nb-major rank bands (tp2
+      10.68 vs 11.41, tp4 8.09 vs 8.46 — but tp8 7.41 vs 6.76: the
+      per-chain conversion tax beats the kernel gain at tp8 band sizes;
+      13B single-chip OOMs the transient copy).
+    * 7b: forced nb-major + int4 (9.645 vs 9.98-10.37; the i4 body is
+      nb-major-only, so the pad-free 7B shapes need the forced layout).
+      The 7b tp rows keep d-major: force+i4 measured a wash at tp4
+      (4.96 vs 5.00) and losses at tp2/tp8/70b-tp8 (6.74 vs 6.59,
+      4.66 vs 4.60, 19.67 vs 18.62).
+    """
+    if cfg in ("13b-tp2", "13b-tp4") and "DLLAMA_Q40_I4" not in env:
+        env["DLLAMA_Q40_I4"] = "on"
+    if cfg == "7b" and "DLLAMA_Q40_I4" not in env \
+            and "DLLAMA_NB_MAJOR" not in env:
+        env["DLLAMA_Q40_I4"] = "on"
+        env["DLLAMA_NB_MAJOR"] = "force"
+    return env
+
+
 def _run_all(args) -> int:
     """Default driver protocol (VERDICT r2 #1 + r3 #2): run the 7b, 13b,
     70b-tp8 configs plus the six {7b,13b}-tp{2,4,8} scaling rows — each in
@@ -535,25 +559,7 @@ def _run_all(args) -> int:
         cmd = [sys.executable, os.path.abspath(__file__),
                "--config", cfg, "--samples", str(args.samples)]
         print(f"=== bench --config {cfg} ===", file=sys.stderr)
-        env = dict(os.environ)
-        if cfg in ("13b-tp2", "13b-tp4") and "DLLAMA_Q40_I4" not in env:
-            # nb-major rank bands take the int4-plane body where it wins
-            # (same-session A/B, r5: tp2 10.68 vs 11.41, tp4 8.09 vs 8.46
-            # — but tp8 7.41 vs 6.76: the per-chain conversion tax beats
-            # the kernel gain at tp8 band sizes). 13B single-chip OOMs
-            # the transient copy; d-major bodies measured slower.
-            env["DLLAMA_Q40_I4"] = "on"
-        if cfg == "7b" and "DLLAMA_Q40_I4" not in env \
-                and "DLLAMA_NB_MAJOR" not in env:
-            # 7B single-chip: forced nb-major + int4 planes measured
-            # 9.645 vs 9.98-10.37 ms/token same-session (the i4 body is
-            # nb-major-only, so pad-free 7B shapes need the forced
-            # layout). The tp rows keep d-major: force+i4 measured a
-            # wash at tp4 (4.96 vs 5.00) and a loss at tp2/tp8/70b-tp8
-            # (6.74 vs 6.59, 4.66 vs 4.60, 19.67 vs 18.62) — the
-            # per-chain conversion tax against band-sized matvec shares.
-            env["DLLAMA_Q40_I4"] = "on"
-            env["DLLAMA_NB_MAJOR"] = "force"
+        env = _row_env(cfg, dict(os.environ))
         prof = None
         if env.get("DLLAMA_BENCH_NO_PROFILE") != "1" \
                 and "DLLAMA_BENCH_PROFILE" not in env:
