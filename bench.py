@@ -135,6 +135,16 @@ def _tree_shapes_cached(spec, rank_tp: int, build, build_sig: str = ""):
     return tree
 
 
+def _record_latency(times_ms) -> None:
+    """Row-JSON latency summary — the SAME p50/p95/p99 shape the serving
+    metrics report (/health, generate()'s final line), via
+    obs/metrics.summarize_values."""
+    from distributed_llama_tpu.obs.metrics import summarize_values
+
+    _STARTUP["latency_ms"] = {
+        k: round(v, 3) for k, v in summarize_values(times_ms).items()}
+
+
 def _bench(spec, params, samples: int, per_step: bool = False,
            rank_tp: int = 0, forced: bool = False) -> float:
     """ms/token of single-token Q40 decode.
@@ -162,7 +172,8 @@ def _bench(spec, params, samples: int, per_step: bool = False,
     # metadata — the emitted row would pair attempt 1's profiler
     # attribution/layout with attempt 3's timing
     for k in ("it_split", "op_ms_per_token", "q40_layout",
-              "rank_layout_caveat", "startup_to_first_token_s"):
+              "rank_layout_caveat", "startup_to_first_token_s",
+              "latency_ms"):
         _STARTUP.pop(k, None)
 
     cache_dtype = (jnp.bfloat16 if os.environ.get("DLLAMA_BENCH_KV_BF16")
@@ -289,6 +300,7 @@ def _bench(spec, params, samples: int, per_step: bool = False,
         ms = float(np.mean(times))
         print(f"per-token ms: mean {ms:.2f}  min {min(times):.2f}  "
               f"max {max(times):.2f}", file=sys.stderr)
+        _record_latency(times)
         return ms, samples
 
     # seq_len-shaped buffers + traced num_steps bound: every --samples value
@@ -391,6 +403,10 @@ def _bench(spec, params, samples: int, per_step: bool = False,
           + ("" if executed == samples else f" — BOS-terminated early of "
              f"{samples}")
           + f", trials {[round(t, 2) for t in times]})", file=sys.stderr)
+    # fused chains yield one ms/token per trial, not per token: the summary
+    # spreads over chain trials (the per-step path summarizes real
+    # per-token samples) — same shape either way for the row JSON
+    _record_latency(times)
     return ms, executed
 
 
@@ -856,6 +872,14 @@ def main():
         "q40_i4": os.environ.get("DLLAMA_Q40_I4", "off"),
         **_STARTUP,
     }
+    # the reference benchmark line carries socket kB/token; ours carries the
+    # analytic per-chip ICI collective bytes (parallel/comm_stats) — 0/0 on
+    # a single chip, the per-rank all_gather budget on tp rows
+    from distributed_llama_tpu.parallel.comm_stats import ici_all_gather_bytes
+
+    comm = ici_all_gather_bytes(spec, rank_tp or 1)
+    result["ici_bytes_per_token"] = {"sent": comm.sent_bytes,
+                                     "recv": comm.recv_bytes}
     if rank_tp:
         result.update(_project_tp(spec, rank_tp, ms, baseline))
     print(json.dumps(result))

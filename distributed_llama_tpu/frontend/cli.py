@@ -27,6 +27,7 @@ transformer.cpp:354-380).
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 import time
 
@@ -34,6 +35,27 @@ from ..ops.quants import FloatType
 
 _FT = {"f32": FloatType.F32, "f16": FloatType.F16, "q40": FloatType.Q40,
        "q80": FloatType.Q80}
+
+
+# --model help shared by the modes that take the sidecar-cached load path
+# (satellite: the GB-scale .kcache write must not be a disk-space surprise)
+_MODEL_HELP = ("path to the reference-format .bin model. Single-chip Q40 "
+               "runs write a pre-tiled <model>.kcache sidecar next to it "
+               "(roughly the packed weight size on disk) so later loads "
+               "mmap it instead of re-tiling for minutes; set "
+               "DLLAMA_TILED_CACHE=0 to disable the sidecar read AND write")
+
+
+def _obs_flags(ap: argparse.ArgumentParser) -> None:
+    ap.add_argument("--log-json", action="store_true",
+                    help="emit runtime narration (🌐/⏩/🔶 lines) as "
+                         "newline-delimited JSON events instead of emoji "
+                         "text (same as DLLAMA_LOG_JSON=1)")
+
+
+def _apply_log_json(args) -> None:
+    if getattr(args, "log_json", False):
+        os.environ["DLLAMA_LOG_JSON"] = "1"
 
 
 def _add_common(ap: argparse.ArgumentParser) -> None:
@@ -155,7 +177,7 @@ def _maybe_distributed(args) -> None:
 
 def cmd_inference(argv: list[str], quiet: bool = False) -> int:
     ap = argparse.ArgumentParser(prog="dllama-tpu inference")
-    ap.add_argument("--model", required=True)
+    ap.add_argument("--model", required=True, help=_MODEL_HELP)
     ap.add_argument("--tokenizer", required=True)
     ap.add_argument("--prompt", default=None)
     ap.add_argument("--weights-float-type", default="q40", choices=sorted(_FT))
@@ -218,9 +240,21 @@ def cmd_inference(argv: list[str], quiet: bool = False) -> int:
                     help="capture a jax.profiler device trace of the "
                          "generation into DIR (xprof/tensorboard format — "
                          "the TPU-native equivalent of the reference's "
-                         "per-task I/T timing split)")
+                         "per-task I/T timing split). DLLAMA_PROFILE_DIR "
+                         "sets the same thing without flag plumbing")
+    ap.add_argument("--metrics", action="store_true",
+                    help="collect run telemetry (obs registry: per-token "
+                         "latency histogram, generated-token counters) and "
+                         "dump the Prometheus text exposition to stderr at "
+                         "exit; 'serve' exposes GET /metrics instead")
+    _obs_flags(ap)
     _add_common(ap)
     args = ap.parse_args(argv)
+    _apply_log_json(args)
+    if args.profile is None:  # one-shot env hook (obs/profiler.py)
+        from ..obs.profiler import env_profile_dir
+
+        args.profile = env_profile_dir()
     if args.coordinator and args.seed is None:
         # every host (root included) must sample the same chain, or hosts
         # hit the BOS early-stop at different steps and deadlock in the
@@ -323,6 +357,11 @@ def cmd_inference(argv: list[str], quiet: bool = False) -> int:
         if args.continuous:
             from ..runtime.continuous import generate_continuous
 
+            reg = None
+            if args.metrics:
+                from ..obs.metrics import Registry
+
+                reg = Registry()
             generate_continuous(spec, params, tokenizer, prompts, args.steps,
                                 args.temperature, args.topp, seed,
                                 slots=args.slots, cache_dtype=cache_dtype,
@@ -333,10 +372,20 @@ def cmd_inference(argv: list[str], quiet: bool = False) -> int:
                                 # identical stream — pin the numpy sampler
                                 # (see sampling.Sampler docstring)
                                 use_native_sampler=not args.coordinator,
-                                fast_prefill=args.fast_prefill)
+                                fast_prefill=args.fast_prefill,
+                                metrics=reg)
+            if reg is not None:
+                print(reg.expose(), file=sys.stderr, end="")
             return 0
         from ..runtime.generate import generate_batch
 
+        if args.metrics:
+            # lockstep batch: one fused device program, no per-request
+            # lifecycle to trace — say so instead of silently dropping
+            # the flag (the continuous engine has the instruments)
+            print("--metrics has nothing to collect on the lockstep batch "
+                  "path; use --continuous for request-lifecycle metrics",
+                  file=sys.stderr)
         generate_batch(spec, params, tokenizer, prompts, args.steps,
                        args.temperature, args.topp, seed,
                        cache_dtype=cache_dtype, mesh=mesh, quiet=quiet)
@@ -405,6 +454,21 @@ def cmd_inference(argv: list[str], quiet: bool = False) -> int:
         except Exception as e:  # a malformed trace must not fail the run
             print(f"💡 I/T split unavailable ({type(e).__name__}: {e}); "
                   f"run tools/it_split.py on the trace dir", file=sys.stderr)
+    if args.metrics:
+        # one-shot runs have no /metrics endpoint: expose the run's
+        # telemetry as a Prometheus text dump on stderr (same metric
+        # names as the server's scrape)
+        from ..obs.metrics import Registry
+        from ..obs.trace import STEP_BUCKETS
+
+        reg = Registry()
+        h = reg.histogram("dllama_request_decode_token_seconds",
+                          "Per-token decode latency", buckets=STEP_BUCKETS)
+        for ms in stats.token_ms:
+            h.observe(ms / 1000.0)
+        reg.counter("dllama_generated_tokens_total",
+                    "Tokens generated this run").inc(stats.tokens)
+        print(reg.expose(), file=sys.stderr, end="")
     if args.save_state:
         from ..io.tokenizer import BOS
         from ..runtime.checkpoint import save_generation_state
@@ -444,7 +508,7 @@ def cmd_serve(argv: list[str]) -> int:
     """HTTP inference server over the continuous-batching engine
     (runtime/server.py) — concurrent clients stream through the slot pool."""
     ap = argparse.ArgumentParser(prog="dllama-tpu serve")
-    ap.add_argument("--model", required=True)
+    ap.add_argument("--model", required=True, help=_MODEL_HELP)
     ap.add_argument("--tokenizer", required=True)
     ap.add_argument("--weights-float-type", default="q40", choices=sorted(_FT))
     ap.add_argument("--buffer-float-type", default="f32", choices=sorted(_FT))
@@ -472,7 +536,16 @@ def cmd_serve(argv: list[str]) -> int:
     ap.add_argument("--fast-prefill", action="store_true",
                     help="bf16 matmul precision for admission prefill "
                          "(documented tolerance; decode untouched)")
+    ap.add_argument("--metrics", action=argparse.BooleanOptionalAction,
+                    default=True,
+                    help="serve GET /metrics (Prometheus text) and collect "
+                         "request-lifecycle histograms (queue wait, TTFT, "
+                         "per-token latency) + engine step metrics; "
+                         "--no-metrics turns collection fully off the "
+                         "decode hot path")
+    _obs_flags(ap)
     args = ap.parse_args(argv)
+    _apply_log_json(args)
     if args.slots < 1:
         print(f"--slots must be positive, got {args.slots}", file=sys.stderr)
         return 2
@@ -503,9 +576,12 @@ def cmd_serve(argv: list[str]) -> int:
                              args.topp, seed, cache_dtype=cache_dtype,
                              mesh=mesh, prefill_chunk=args.prefill_chunk,
                              block_steps=args.block_steps,
-                             fast_prefill=args.fast_prefill)
+                             fast_prefill=args.fast_prefill,
+                             metrics=args.metrics)
+    endpoints = "POST /generate, GET /health" + (
+        ", GET /metrics, POST /profile" if args.metrics else "")
     print(f"🌐 serving on http://{args.host}:{server.port} "
-          f"({args.slots} slots, POST /generate, GET /health)")
+          f"({args.slots} slots, {endpoints})")
     server.serve_forever()
     return 0
 

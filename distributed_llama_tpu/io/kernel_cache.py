@@ -61,9 +61,12 @@ def _kind_of(v) -> str:
     return "dense"
 
 
-def layout_key(model_path: str | None = None, tp: int = 1) -> str:
+def layout_key(model_path: str | None = None, tp: int = 1,
+               weights_float_type=None, buffer_float_type=None) -> str:
     """Everything that decides the packed tree's contents: the layout
-    knobs (mirroring the bench shape-manifest key) AND the model file's
+    knobs (mirroring the bench shape-manifest key), the float types the
+    tree was decoded/packed under (a future packed form for another float
+    type must not collide under the same key), AND the model file's
     identity (size + mtime) — overwriting the .bin with a new checkpoint
     at the same path must invalidate the sidecar, never silently serve
     the old weights."""
@@ -79,12 +82,78 @@ def layout_key(model_path: str | None = None, tp: int = 1) -> str:
         st = os.stat(model_path)
         src += f"|src={st.st_size}:{st.st_mtime_ns}"
     nbm = os.environ.get("DLLAMA_NB_MAJOR", "auto") or "auto"
+    wf = getattr(weights_float_type, "name", weights_float_type) or "Q40"
+    bf = getattr(buffer_float_type, "name", buffer_float_type) or "F32"
     return (f"v1|{q40_kernel_mode()}|{_matvec_cap()}|{fusion_cache_key()}"
-            f"|nb={nbm}|tp={tp}{src}")
+            f"|nb={nbm}|tp={tp}|wf={wf}|bf={bf}{src}")
 
 
 def sidecar_path(model_path: str) -> str:
     return model_path + ".kcache"
+
+
+# A build lock older than this is presumed orphaned (holder crashed between
+# O_EXCL create and unlink) and is broken. GB-scale sidecar writes take
+# minutes, not hours.
+_LOCK_STALE_S = 3600.0
+
+
+def _lock_path(side: str) -> str:
+    return side + ".lock"
+
+
+def try_build_lock(side: str):
+    """O_EXCL lock file guarding the sidecar build: two concurrent loads of
+    the same model must not BOTH stream GB-scale .tmp<pid> files onto disk
+    (ADVICE r5). Returns an opaque token (pass to release_build_lock) or
+    None when another live process holds the lock — the caller then skips
+    the write; its own load already has the packed tree in memory, and the
+    other process's completed sidecar serves every later load."""
+    lock = _lock_path(side)
+    for _ in range(2):
+        try:
+            fd = os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            os.write(fd, f"{os.getpid()}\n".encode())
+            os.close(fd)
+            return lock
+        except FileExistsError:
+            try:
+                age = time.time() - os.stat(lock).st_mtime
+            except OSError:
+                continue  # holder released between open and stat: retry
+            if age < _LOCK_STALE_S:
+                return None
+            # stale: the holder crashed. Claim the break by RENAME (atomic;
+            # exactly one racer succeeds) rather than unlink — a bare
+            # unlink could delete a FRESH lock another breaker just
+            # re-created, letting two writers in
+            try:
+                claimed = lock + f".stale{os.getpid()}"
+                os.rename(lock, claimed)
+                # the rename could still have grabbed a FRESH lock (a
+                # racing breaker re-created it between our stat and our
+                # rename): re-check on the claimed copy, and restore it
+                # atomically (link fails if a new lock appeared) if so
+                if time.time() - os.stat(claimed).st_mtime < _LOCK_STALE_S:
+                    try:
+                        os.link(claimed, lock)
+                    except OSError:
+                        pass  # a newer lock exists; it stands
+                    os.unlink(claimed)
+                    return None
+                os.unlink(claimed)
+            except OSError:
+                return None  # another breaker won the rename: back off
+        except OSError:
+            return None  # unwritable dir: save_packed will say so itself
+    return None
+
+
+def release_build_lock(token) -> None:
+    try:
+        os.unlink(token)
+    except OSError:
+        pass
 
 
 def save_packed(path: str, key: str, tree: dict) -> None:
@@ -196,11 +265,13 @@ def load_model_packed(path: str, spec=None, weights_float_type=None,
     packing = wft == FloatType.Q40 and q40_kernel_mode() == "pallas"
     use_cache = cache_enabled() and packing
     side = sidecar_path(path)
+    key = layout_key(path, weights_float_type=wft,
+                     buffer_float_type=buffer_float_type)
     if use_cache and os.path.exists(side):
         t0 = time.perf_counter()
         if spec is None:
             spec = read_spec(path, wft, **kw)
-        tree = load_packed(side, layout_key(path))
+        tree = load_packed(side, key)
         if tree is not None:
             print(f"⏩ kernel-layout cache hit ({side}): "
                   f"{time.perf_counter() - t0:.1f}s host prep "
@@ -215,9 +286,15 @@ def load_model_packed(path: str, spec=None, weights_float_type=None,
         print(f"kernel re-tile + fuse: {dt:.1f}s", file=sys.stderr)
     if use_cache and any(isinstance(v, (Q40Kernel, Q40KernelNb))
                          for v in packed.values()):
+        lock = try_build_lock(side)
+        if lock is None:
+            print(f"⏩ another process is writing {side}; skipping the "
+                  f"sidecar write (this load keeps its in-memory tree)",
+                  file=sys.stderr)
+            return spec, packed
         try:
             t0 = time.perf_counter()
-            save_packed(side, layout_key(path), packed)
+            save_packed(side, key, packed)
             print(f"⏩ kernel-layout cache written ({side}, "
                   f"{os.path.getsize(side) / 1e9:.2f} GB, "
                   f"{time.perf_counter() - t0:.1f}s); next load skips "
@@ -225,4 +302,6 @@ def load_model_packed(path: str, spec=None, weights_float_type=None,
         except OSError as e:
             print(f"kernel cache not written ({e}); loads keep re-tiling",
                   file=sys.stderr)
+        finally:
+            release_build_lock(lock)
     return spec, packed

@@ -44,6 +44,8 @@ import struct
 import threading
 import time
 
+from ..obs.log import log_event
+
 _MAGIC = b"DLTPU1"  # protocol version tag; bump on any framing change
 _CHUNK = 4 << 20
 
@@ -263,14 +265,21 @@ def fetch_model_slices(addr: str, cache_path: str, weights_float_type,
             if have is None:  # full file, no sidecar: everything is real
                 s.sendall(b"DONE\n")
                 if not quiet:
-                    print(f"⏩ weight cache hit: {cache_path} ({size} bytes)")
+                    log_event("weights.cache_hit",
+                              f"⏩ weight cache hit: {cache_path} "
+                              f"({size} bytes)",
+                              path=cache_path, bytes=size)
                 return cache_path
         missing = subtract_ranges(need, have or [])
         if not missing:
             s.sendall(b"DONE\n")
             if not quiet:
-                print(f"⏩ weight slice cache hit: {cache_path} "
-                      f"({sum(l for _, l in have or [])} bytes resident)")
+                log_event("weights.slice_cache_hit",
+                          f"⏩ weight slice cache hit: {cache_path} "
+                          f"({sum(l for _, l in have or [])} bytes "
+                          f"resident)",
+                          path=cache_path,
+                          resident_bytes=sum(l for _, l in have or []))
             return cache_path
 
         t0 = time.time()
@@ -302,18 +311,26 @@ def fetch_model_slices(addr: str, cache_path: str, weights_float_type,
                     done += step
                     if not quiet and done % (256 << 20) < _CHUNK:
                         kbs = done / 1024 / max(time.time() - t0, 1e-9)
-                        print(f"⏩ fetched {done >> 20}/{total >> 20} MB "
-                              f"of slices ({kbs:.0f} kB/s)")
+                        log_event("weights.fetch_progress",
+                                  f"⏩ fetched {done >> 20}/{total >> 20} "
+                                  f"MB of slices ({kbs:.0f} kB/s)",
+                                  done_bytes=done, total_bytes=total,
+                                  kb_per_s=round(kbs))
         with open(_sidecar_path(cache_path), "w") as fh:
             json.dump({"size": size,
                        "ranges": merge_ranges((have or []) + need)}, fh)
         s.sendall(b"DONE\n")
         if not quiet:
             kbs = total / 1024 / max(time.time() - t0, 1e-9)
-            print(f"⏩ fetched {total} slice bytes of {size} "
-                  f"({100.0 * total / size:.0f}%, tp ranks "
-                  f"{sorted(ranks)}) in {time.time() - t0:.1f}s "
-                  f"({kbs:.0f} kB/s)")
+            log_event("weights.fetched_slices",
+                      f"⏩ fetched {total} slice bytes of {size} "
+                      f"({100.0 * total / size:.0f}%, tp ranks "
+                      f"{sorted(ranks)}) in {time.time() - t0:.1f}s "
+                      f"({kbs:.0f} kB/s)",
+                      fetched_bytes=total, file_bytes=size,
+                      tp_ranks=sorted(ranks),
+                      seconds=round(time.time() - t0, 1),
+                      kb_per_s=round(kbs))
     return cache_path
 
 
@@ -343,7 +360,10 @@ def fetch_model(addr: str, cache_path: str, quiet: bool = False,
                 and not os.path.exists(_sidecar_path(cache_path))):
             s.sendall(b"DONE\n")
             if not quiet:
-                print(f"⏩ weight cache hit: {cache_path} ({size} bytes)")
+                log_event("weights.cache_hit",
+                          f"⏩ weight cache hit: {cache_path} "
+                          f"({size} bytes)",
+                          path=cache_path, bytes=size)
             return cache_path
 
         t0 = time.time()
@@ -367,8 +387,11 @@ def fetch_model(addr: str, cache_path: str, quiet: bool = False,
                     off += ln
                     if not quiet and off % (256 << 20) < _CHUNK:
                         kbs = off / 1024 / max(time.time() - t0, 1e-9)
-                        print(f"⏩ fetched {off >> 20}/{size >> 20} MB "
-                              f"({kbs:.0f} kB/s)")
+                        log_event("weights.fetch_progress",
+                                  f"⏩ fetched {off >> 20}/{size >> 20} MB "
+                                  f"({kbs:.0f} kB/s)",
+                                  done_bytes=off, total_bytes=size,
+                                  kb_per_s=round(kbs))
             if os.path.getsize(tmp) != size:
                 raise ValueError(f"fetched {os.path.getsize(tmp)} bytes, "
                                  f"expected {size}")
@@ -386,6 +409,9 @@ def fetch_model(addr: str, cache_path: str, quiet: bool = False,
         s.sendall(b"DONE\n")
         if not quiet:
             kbs = size / 1024 / max(time.time() - t0, 1e-9)
-            print(f"⏩ fetched model: {size} bytes in "
-                  f"{time.time() - t0:.1f}s ({kbs:.0f} kB/s)")
+            log_event("weights.fetched",
+                      f"⏩ fetched model: {size} bytes in "
+                      f"{time.time() - t0:.1f}s ({kbs:.0f} kB/s)",
+                      bytes=size, seconds=round(time.time() - t0, 1),
+                      kb_per_s=round(kbs))
     return cache_path

@@ -132,6 +132,34 @@ def _prefill_attn_mode() -> str:
     return mode
 
 
+_flash_degrade_warned = False
+
+
+def _warn_flash_degrade(spec: TransformerSpec, t_len: int) -> None:
+    """One-time loud warning when an EXPLICIT DLLAMA_PREFILL_ATTN=flash
+    cannot take the Pallas kernel and degrades to the blockwise XLA walk.
+    'auto' degrading silently is by design; an explicit mode falling back
+    silently violates the fail-loud policy (_prefill_attn_mode raises on
+    typos for the same reason). A warning, not a raise: the walk computes
+    the same attention, just slower — aborting a long run over a perf mode
+    would be worse. Fires at trace time, once per process."""
+    global _flash_degrade_warned
+    if _flash_degrade_warned:
+        return
+    _flash_degrade_warned = True
+    import sys
+
+    from ..ops.pallas_attention import attn_kernel_mode
+
+    print(f"⚠️  DLLAMA_PREFILL_ATTN=flash requested but the Pallas prefill "
+          f"kernel does not apply (attn kernel mode "
+          f"{attn_kernel_mode()!r}, seq_len {spec.seq_len}, head_size "
+          f"{spec.head_size}, chunk T={t_len}, kv_mul {spec.kv_mul}); "
+          f"falling back to the blockwise XLA walk for this trace. Use "
+          f"DLLAMA_PREFILL_ATTN=block to pick the walk explicitly, or "
+          f"unset the variable for auto.", file=sys.stderr)
+
+
 def _pick_attn_block(seq_len: int) -> int | None:
     """Largest KV block <= 512 dividing seq_len (None -> dense path)."""
     for cand in (512, 256, 128, 64, 32):
@@ -191,6 +219,8 @@ def attention(spec: TransformerSpec, q: jax.Array, k_cache: jax.Array,
                                     kv_mul=spec.kv_mul,
                                     bf16=matmul_mode() == "bf16")
             return out.reshape(t_len, -1)
+        if mode == "flash":  # explicit request degrading: say so, once
+            _warn_flash_degrade(spec, t_len)
         mode = "block" if mode == "auto" else mode
     if mode in ("block", "flash"):  # flash unsupported here: live-prefix walk
         block = _pick_attn_block(spec.seq_len)

@@ -1,0 +1,25 @@
+"""Serving telemetry: metrics registry, request tracing, structured logs,
+profiler hooks.
+
+Stdlib-only observability for the serving stack (the reference's only
+instrument is one end-of-run benchmark line, tokenizer.cpp:381):
+
+* ``obs.metrics`` — thread-safe Counter/Gauge/Histogram + Registry with
+  Prometheus text exposition (``GET /metrics``);
+* ``obs.trace`` — per-request lifecycle instruments (queue wait, TTFT,
+  per-token decode latency) and engine step/occupancy accounting;
+* ``obs.log`` — optional NDJSON event log (``DLLAMA_LOG_JSON=1``) behind
+  the existing 🌐/⏩/🔶 print sites;
+* ``obs.profiler`` — guarded jax.profiler captures (``POST /profile``,
+  ``DLLAMA_PROFILE_DIR``).
+
+Collection is opt-in: hot paths hold a None handle when disabled and make
+zero registry calls (tests/test_obs.py pins this).
+"""
+
+from .log import json_mode, log_event
+from .metrics import (Counter, Gauge, Histogram, Registry, summarize_values)
+from .trace import EngineMetrics
+
+__all__ = ["Counter", "Gauge", "Histogram", "Registry", "EngineMetrics",
+           "json_mode", "log_event", "summarize_values"]

@@ -1,0 +1,46 @@
+"""Structured event log: optional newline-delimited JSON.
+
+The repo's runtime narration is emoji-prefixed prints (🌐 server lines,
+⏩ load/fetch lines, 🔶 per-token stats). Those stay the human default;
+``DLLAMA_LOG_JSON=1`` (or the ``--log-json`` CLI flag) reroutes each site
+through here as one machine-parseable JSON object per line, so a log
+shipper gets typed fields instead of emoji scraping. The print sites in
+runtime/server.py, runtime/generate.py, and io/stream.py call
+``log_event(event, text, **fields)``: JSON mode emits
+``{"ts", "event", **fields}``; text mode prints ``text`` verbatim (or
+nothing when text is None — a JSON-only event).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def json_mode() -> bool:
+    """DLLAMA_LOG_JSON=1 switches every routed print site to NDJSON."""
+    return os.environ.get("DLLAMA_LOG_JSON", "") not in ("", "0")
+
+
+def log_event(event: str, text: str | None = None, *, file=None,
+              **fields) -> None:
+    """Emit one log line: NDJSON in json_mode(), else the human text.
+
+    ``file`` defaults to stdout (the emoji sites' stream); pass
+    ``sys.stderr`` for diagnostics. Non-JSON-serializable field values
+    degrade to ``repr`` rather than raising — a log line must never take
+    down the loop that emits it.
+    """
+    out = sys.stdout if file is None else file
+    if json_mode():
+        rec = {"ts": round(time.time(), 6), "event": event}
+        rec.update(fields)
+        try:
+            line = json.dumps(rec)
+        except (TypeError, ValueError):
+            line = json.dumps({k: repr(v) for k, v in rec.items()})
+        print(line, file=out, flush=True)
+    elif text is not None:
+        print(text, file=out)

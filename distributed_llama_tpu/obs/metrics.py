@@ -1,0 +1,280 @@
+"""Thread-safe in-process metrics: counters, gauges, fixed-bucket histograms.
+
+The reference's only observability is the end-of-run benchmark line
+(tokenizer.cpp:381); a serving system needs live instruments. This registry
+is stdlib-only (no prometheus_client dependency) and exposes the Prometheus
+text format (version 0.0.4) so any scraper can consume `GET /metrics`
+(runtime/server.py) or a one-shot dump (`--metrics` CLI runs).
+
+Design constraints:
+* every mutation is O(1) under one registry-wide lock — the instruments are
+  written from the scheduler thread, HTTP handler threads, and the stream.py
+  fetch loop concurrently (tests/test_obs.py pins exactness under racing
+  writers);
+* histograms use FIXED bucket bounds chosen at creation: observation is a
+  bisect, exposition is a cumulative walk, and percentiles come from linear
+  interpolation inside the winning bucket — good enough for p50/p95/p99
+  health summaries without storing samples;
+* collection is opt-in at the call site: the hot paths hold a reference that
+  is None when metrics are disabled, so a disabled run makes ZERO registry
+  calls (the acceptance gate in tests/test_obs.py).
+"""
+
+from __future__ import annotations
+
+import bisect
+import math
+import threading
+
+# Default bounds for latency-shaped histograms (seconds). Spans 1 ms (a
+# fused CPU step) to 60 s (a cold-compile first step) in roughly 2.5x hops.
+LATENCY_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                   0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+
+# Throughput-shaped bounds (tokens/s): 0.1 .. 10k in decade-ish hops.
+RATE_BUCKETS = (0.1, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0,
+                250.0, 500.0, 1000.0, 2500.0, 10000.0)
+
+# Small-integer bounds (batch occupancy, queue depth).
+COUNT_BUCKETS = (0.0, 1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0)
+
+
+class Counter:
+    """Monotonic float counter."""
+
+    kind = "counter"
+    __slots__ = ("name", "help", "_lock", "_value")
+
+    def __init__(self, name: str, help: str = "", lock=None):
+        self.name = name
+        self.help = help
+        self._lock = lock or threading.Lock()
+        self._value = 0.0
+
+    def inc(self, v: float = 1.0) -> None:
+        if v < 0:
+            raise ValueError(f"counter {self.name} cannot decrease ({v})")
+        with self._lock:
+            self._value += v
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+    def expose(self) -> list[str]:
+        return [f"{self.name} {_fmt(self.value)}"]
+
+
+class Gauge:
+    """Instantaneous value (set/inc/dec)."""
+
+    kind = "gauge"
+    __slots__ = ("name", "help", "_lock", "_value")
+
+    def __init__(self, name: str, help: str = "", lock=None):
+        self.name = name
+        self.help = help
+        self._lock = lock or threading.Lock()
+        self._value = 0.0
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._value = float(v)
+
+    def inc(self, v: float = 1.0) -> None:
+        with self._lock:
+            self._value += v
+
+    def dec(self, v: float = 1.0) -> None:
+        with self._lock:
+            self._value -= v
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+    def expose(self) -> list[str]:
+        return [f"{self.name} {_fmt(self.value)}"]
+
+
+class Histogram:
+    """Fixed-bucket histogram with sum/count and interpolated percentiles.
+
+    ``buckets`` are the finite upper bounds (sorted, strictly increasing);
+    an implicit +Inf bucket catches the rest. Per-bucket counts are stored
+    NON-cumulative and accumulated at exposition time (one add per observe,
+    not one per bucket).
+    """
+
+    kind = "histogram"
+    __slots__ = ("name", "help", "buckets", "_lock", "_counts", "_sum",
+                 "_count")
+
+    def __init__(self, name: str, help: str = "",
+                 buckets: tuple = LATENCY_BUCKETS, lock=None):
+        if not buckets or list(buckets) != sorted(set(buckets)):
+            raise ValueError(f"histogram {name}: buckets must be sorted "
+                             f"unique upper bounds, got {buckets!r}")
+        self.name = name
+        self.help = help
+        self.buckets = tuple(float(b) for b in buckets)
+        self._lock = lock or threading.Lock()
+        self._counts = [0] * (len(self.buckets) + 1)  # + the +Inf bucket
+        self._sum = 0.0
+        self._count = 0
+
+    def observe(self, v: float) -> None:
+        i = bisect.bisect_left(self.buckets, v)
+        with self._lock:
+            self._counts[i] += 1
+            self._sum += v
+            self._count += 1
+
+    def snapshot(self) -> tuple[list[int], float, int]:
+        with self._lock:
+            return list(self._counts), self._sum, self._count
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    @property
+    def sum(self) -> float:
+        with self._lock:
+            return self._sum
+
+    def percentile(self, q: float) -> float:
+        """Estimate the q-quantile (q in [0, 1]) by linear interpolation
+        inside the winning bucket. The +Inf bucket clamps to the last
+        finite bound (there is no upper edge to interpolate toward); an
+        empty histogram reports 0.0."""
+        counts, _, total = self.snapshot()
+        if total == 0:
+            return 0.0
+        rank = q * total
+        seen = 0
+        for i, c in enumerate(counts):
+            if c == 0:
+                continue
+            if seen + c >= rank:
+                if i >= len(self.buckets):   # +Inf bucket
+                    return self.buckets[-1]
+                lo = self.buckets[i - 1] if i > 0 else 0.0
+                hi = self.buckets[i]
+                frac = (rank - seen) / c
+                return lo + (hi - lo) * min(max(frac, 0.0), 1.0)
+            seen += c
+        return self.buckets[-1]
+
+    def summary(self) -> dict:
+        """{'count', 'mean', 'p50', 'p95', 'p99'} — the health-line shape
+        shared by /health, generate()'s final line, and bench rows."""
+        counts, s, total = self.snapshot()
+        return {"count": total,
+                "mean": (s / total) if total else 0.0,
+                "p50": self.percentile(0.50),
+                "p95": self.percentile(0.95),
+                "p99": self.percentile(0.99)}
+
+    def expose(self) -> list[str]:
+        counts, s, total = self.snapshot()
+        out, cum = [], 0
+        for b, c in zip(self.buckets, counts):
+            cum += c
+            out.append(f'{self.name}_bucket{{le="{_fmt(b)}"}} {cum}')
+        cum += counts[-1]
+        out.append(f'{self.name}_bucket{{le="+Inf"}} {cum}')
+        out.append(f"{self.name}_sum {_fmt(s)}")
+        out.append(f"{self.name}_count {total}")
+        return out
+
+
+def _fmt(v: float) -> str:
+    """Prometheus number formatting: integers without a trailing .0."""
+    if math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    if float(v).is_integer() and abs(v) < 1e15:
+        return str(int(v))
+    return repr(float(v))
+
+
+class Registry:
+    """Named metric store with get-or-create accessors and text exposition.
+
+    One lock guards the name table; each instrument carries its own lock
+    for value mutation (a scrape never blocks writers for long). Accessors
+    are idempotent — asking for an existing name returns the existing
+    instrument; a kind or bucket mismatch raises (two call sites silently
+    disagreeing about a metric is a bug, not a fallback).
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: dict = {}  # name -> instrument, insertion-ordered
+
+    def _get_or_create(self, cls, name: str, help: str, **kw):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is not None:
+                if not isinstance(m, cls):
+                    raise ValueError(f"metric {name} already registered as "
+                                     f"{m.kind}, requested {cls.kind}")
+                want = kw.get("buckets")
+                if want is not None and tuple(
+                        float(b) for b in want) != m.buckets:
+                    raise ValueError(f"histogram {name} already registered "
+                                     f"with different buckets")
+                return m
+            m = cls(name, help, **kw)
+            self._metrics[name] = m
+            return m
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get_or_create(Counter, name, help)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get_or_create(Gauge, name, help)
+
+    def histogram(self, name: str, help: str = "",
+                  buckets: tuple = LATENCY_BUCKETS) -> Histogram:
+        return self._get_or_create(Histogram, name, help, buckets=buckets)
+
+    def get(self, name: str):
+        with self._lock:
+            return self._metrics.get(name)
+
+    def expose(self) -> str:
+        """Prometheus text exposition (format version 0.0.4)."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        lines: list[str] = []
+        for m in metrics:
+            if m.help:
+                lines.append(f"# HELP {m.name} {m.help}")
+            lines.append(f"# TYPE {m.name} {m.kind}")
+            lines.extend(m.expose())
+        return "\n".join(lines) + ("\n" if lines else "")
+
+
+def summarize_values(values, unit_scale: float = 1.0) -> dict:
+    """Exact {'count','mean','p50','p95','p99'} from a raw value list —
+    the SAME summary shape Histogram.summary() reports, for call sites
+    that already hold every sample (generate()'s per-token ms list,
+    bench.py's trial times). ``unit_scale`` multiplies values on the way
+    in (e.g. 1e-3 to report a ms list in seconds)."""
+    vals = sorted(float(v) * unit_scale for v in values)
+    if not vals:
+        return {"count": 0, "mean": 0.0, "p50": 0.0, "p95": 0.0, "p99": 0.0}
+
+    def pct(q: float) -> float:
+        # nearest-rank with linear interpolation (numpy 'linear' method)
+        idx = q * (len(vals) - 1)
+        lo = int(math.floor(idx))
+        hi = min(lo + 1, len(vals) - 1)
+        return vals[lo] + (vals[hi] - vals[lo]) * (idx - lo)
+
+    return {"count": len(vals), "mean": sum(vals) / len(vals),
+            "p50": pct(0.50), "p95": pct(0.95), "p99": pct(0.99)}

@@ -1,0 +1,123 @@
+"""Per-request lifecycle tracing + engine step accounting.
+
+The serving literature's instrument set (Orca/vLLM-style): a request's
+latency decomposes as queue wait (enqueue -> admit), TTFT (enqueue -> first
+SAMPLED token; prompt echo is forced output, not generation), and per-token
+decode latency; the engine's health decomposes as step duration and batch
+occupancy. ``EngineMetrics`` bundles those instruments from one Registry;
+the continuous engine holds it as ``self._obs`` and guards EVERY call site
+on ``_obs is not None`` — a disabled engine makes zero registry calls
+(the off-the-hot-path acceptance gate, tests/test_obs.py).
+
+Timestamps are ``time.monotonic()`` and live on the Request itself
+(runtime/continuous.py stamps them), so the derived observations need no
+extra bookkeeping structure.
+"""
+
+from __future__ import annotations
+
+import os
+
+from .metrics import (COUNT_BUCKETS, LATENCY_BUCKETS, RATE_BUCKETS, Registry)
+
+# Finer low end than LATENCY_BUCKETS: a fused decode step is sub-ms on a
+# warm chip and ~100 ms on a tunneled runtime — both ends must resolve.
+STEP_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+
+
+def sync_device_timing() -> bool:
+    """DLLAMA_METRICS_SYNC=1: block_until_ready the cache after each timed
+    step so step-duration histograms measure DEVICE time, not dispatch time.
+    Off by default — the host-side logits/tokens conversion already syncs
+    the step's outputs, and an extra sync point can serialize a pipelined
+    remote runtime."""
+    return os.environ.get("DLLAMA_METRICS_SYNC", "") not in ("", "0")
+
+
+class EngineMetrics:
+    """The continuous engine's instrument bundle (one per engine/registry).
+
+    Creation registers every instrument immediately, so a scrape of a
+    freshly started server already exposes the full metric set at zero.
+    """
+
+    def __init__(self, registry: Registry):
+        self.registry = registry
+        self.sync = sync_device_timing()
+        h, c, g = registry.histogram, registry.counter, registry.gauge
+        self.queue_wait = h(
+            "dllama_request_queue_wait_seconds",
+            "Time from submit() to slot admission")
+        self.ttft = h(
+            "dllama_request_ttft_seconds",
+            "Time from submit() to the first sampled token")
+        self.decode_token = h(
+            "dllama_request_decode_token_seconds",
+            "Per-sampled-token decode latency, averaged per request",
+            buckets=STEP_BUCKETS)
+        self.prefill = h(
+            "dllama_request_prefill_seconds",
+            "Admission-prefill duration (chunked prompt fill)")
+        self.tokens_per_s = h(
+            "dllama_request_tokens_per_second",
+            "Sampled tokens/s over a request's admit->finish window",
+            buckets=RATE_BUCKETS)
+        self.step_duration = h(
+            "dllama_engine_step_duration_seconds",
+            "One scheduler iteration around the jitted step (step_once or "
+            "a fused step_many chain)", buckets=STEP_BUCKETS)
+        self.occupancy = h(
+            "dllama_engine_batch_occupancy",
+            "Active slots entering each device step", buckets=COUNT_BUCKETS)
+        self.active_slots = g(
+            "dllama_engine_active_slots", "Active slots right now")
+        self.queued = g(
+            "dllama_engine_queued_requests", "Requests waiting for a slot")
+        self.generated = c(
+            "dllama_generated_tokens_total",
+            "Tokens emitted into request outputs (prompt echoes included, "
+            "matching the CLI's Generated-tokens accounting)")
+        self.steps = c(
+            "dllama_engine_steps_total", "Device decode steps executed")
+        self.compile_events = c(
+            "dllama_engine_compile_events_total",
+            "Step-shape cache misses (new fused-chain shapes traced)")
+        self.completed = c(
+            "dllama_requests_total", "Requests retired normally")
+        self.failed = c(
+            "dllama_requests_failed_total",
+            "Requests failed by a scheduler error (fail_all)")
+        self.cancelled = c(
+            "dllama_requests_cancelled_total",
+            "Requests retired because the consumer vanished")
+
+    def record_step(self, dt_s: float, active: int, steps: int = 1) -> None:
+        """One scheduler iteration: ``steps`` device steps (1 for
+        step_once, K for a fused chain) over ``active`` slots."""
+        self.steps.inc(steps)
+        self.step_duration.observe(dt_s)
+        self.occupancy.observe(active)
+        self.active_slots.set(active)
+
+    def record_retire(self, req, now: float) -> None:
+        """Derive the lifecycle histograms at retirement. Cancelled and
+        failed requests count in their own counters only — their truncated
+        windows would poison the latency distributions."""
+        if req.cancelled:
+            self.cancelled.inc()
+            return
+        if req.error is not None:
+            self.failed.inc()
+            return
+        self.completed.inc()
+        if req.t_admit and req.t_enqueue:
+            self.queue_wait.observe(req.t_admit - req.t_enqueue)
+        if req.t_first_token and req.t_enqueue:
+            self.ttft.observe(req.t_first_token - req.t_enqueue)
+        if req.n_sampled > 0 and req.t_first_token:
+            span = now - req.t_first_token
+            self.decode_token.observe(span / req.n_sampled)
+            window = now - (req.t_admit or req.t_enqueue or now)
+            if window > 0:
+                self.tokens_per_s.observe(req.n_sampled / window)

@@ -271,7 +271,9 @@ MULTI_T_MAX = 8  # beyond this the per-row accumulators crowd VMEM; use MXU
 # tile sets at the default 16 MB (e.g. 22.6M at w2's nb=344/bt=32 prefill
 # tile, 26.3M at the 13B B=2 multi tile) though v5e has 128 MB physical.
 # Same approach as ops/pallas_layer._VMEM_LIMIT.
-_VMEM64_PARAMS = pltpu.CompilerParams(vmem_limit_bytes=64 * 1024 * 1024)
+from ..utils.compat import pallas_tpu_compiler_params as _compiler_params
+
+_VMEM64_PARAMS = _compiler_params(vmem_limit_bytes=64 * 1024 * 1024)
 
 
 def q40_i4_enabled() -> bool:

@@ -28,6 +28,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..models.llama import forward_seq
 from ..models.spec import TransformerSpec
 from .ring import ring_attention
+from ..utils.compat import shard_map as _shard_map
 
 
 def _local_forward_seq(spec: TransformerSpec, params: dict[str, Any],
@@ -77,10 +78,10 @@ def make_sp_train_step(spec: TransformerSpec, mesh: Mesh,
         return jax.lax.pmean(ce.mean(), ("dp", "sp"))
 
     def sharded_loss(params, inputs, targets):
-        fn = jax.shard_map(
+        fn = _shard_map(
             local_loss, mesh=mesh,
             in_specs=(P(), P("dp", "sp"), P("dp", "sp")),
-            out_specs=P(), check_vma=False)
+            out_specs=P())
         return fn(params, inputs, targets)
 
     def step(params, opt_state, tokens):

@@ -46,6 +46,7 @@ from ..models.llama import (KVCache, attention_core, batch_decode_attention,
 from ..models.spec import TransformerSpec
 from ..ops.linear import fake_quant_q80, matmul, rmsnorm, silu
 from ..ops.quants import FloatType, dequantize_q80_jax, quantize_q80_jax
+from ..utils.compat import shard_map as _shard_map
 
 # params tree -> PartitionSpec for the stacked arrays (layer axis leading).
 # Output-dim sharding = axis 1 for per-layer matmuls, axis 0 for wcls.
@@ -381,8 +382,8 @@ def make_sharded_forward(spec: TransformerSpec, mesh: Mesh):
     def wrap(params, cache, tokens, pos):
         in_specs = (param_specs(params), CACHE_SPEC, P(), P())
         out_specs = (P(), CACHE_SPEC)
-        fn = jax.shard_map(local_step, mesh=mesh, in_specs=in_specs,
-                           out_specs=out_specs, check_vma=False)
+        fn = _shard_map(local_step, mesh=mesh, in_specs=in_specs,
+                        out_specs=out_specs)
         return fn(params, cache, tokens, pos)
 
     return jax.jit(wrap, donate_argnums=1)
@@ -495,8 +496,8 @@ def make_sharded_forward_batch(spec: TransformerSpec, mesh: Mesh):
     def wrap(params, cache, tokens, pos):
         in_specs = (param_specs(params), CACHE_SPEC_BATCH, P(), P())
         out_specs = (P(), CACHE_SPEC_BATCH)
-        fn = jax.shard_map(local_step, mesh=mesh, in_specs=in_specs,
-                           out_specs=out_specs, check_vma=False)
+        fn = _shard_map(local_step, mesh=mesh, in_specs=in_specs,
+                        out_specs=out_specs)
         return fn(params, cache, tokens, pos)
 
     return jax.jit(wrap, donate_argnums=1)

@@ -59,6 +59,15 @@ class Request:
     # lands in ``out`` (prompt echoes included, prefill echoes in one burst);
     # must be fast and must not raise — it runs inside the decode loop
     on_token: Any = None
+    # lifecycle timestamps (time.monotonic; 0.0 = not reached): queue wait =
+    # t_admit - t_enqueue, TTFT = t_first_token - t_enqueue. t_first_token
+    # marks the first SAMPLED token — forced prompt echo is input replay,
+    # not generation. obs/trace.EngineMetrics derives histograms from these.
+    t_enqueue: float = 0.0
+    t_admit: float = 0.0
+    t_first_token: float = 0.0
+    t_finish: float = 0.0
+    n_sampled: int = 0  # sampled (non-forced) tokens emitted
 
 
 def _maybe_bf16(fn, enable: bool, jax_mod, jit: bool = False):
@@ -111,7 +120,7 @@ class ContinuousEngine:
                  slots: int, temperature: float, topp: float, seed: int,
                  cache_dtype=None, mesh=None, prefill_chunk: int = 0,
                  block_steps: int = 1, use_native_sampler: bool = True,
-                 fast_prefill: bool = False):
+                 fast_prefill: bool = False, metrics=None):
         import functools
 
         import jax
@@ -193,6 +202,16 @@ class ContinuousEngine:
         self._submitted = 0
         self._chains: dict = {}  # (k, greedy_only) -> fused chain program
         self.stats = ContinuousStats()
+        # telemetry is opt-in: ``metrics`` is an obs.metrics.Registry; when
+        # None (the default) self._obs stays None and every guarded call
+        # site below is skipped — the hot path makes ZERO registry calls
+        # (the off-unless-enabled contract, tests/test_obs.py)
+        if metrics is not None:
+            from ..obs.trace import EngineMetrics
+
+            self._obs = EngineMetrics(metrics)
+        else:
+            self._obs = None
 
     def _chain(self, k: int, greedy_only: bool):
         """Build (and cache) the fused K-step device program: K ragged
@@ -208,6 +227,8 @@ class ContinuousEngine:
         key = (k, greedy_only)
         if key in self._chains:
             return self._chains[key]
+        if self._obs is not None:  # step-shape cache miss: a new trace
+            self._obs.compile_events.inc()
 
         from .decode import sample_device_dynamic
 
@@ -283,6 +304,7 @@ class ContinuousEngine:
                         k - n_forced)
 
         run = self._chain(k, greedy_only=all(t == 0.0 for t in temps))
+        t0 = time.monotonic() if self._obs is not None else 0.0
         cache, toks, acts = run(
             self.params, self.cache,
             jnp.asarray([s.token for s in pool], jnp.int32),
@@ -294,6 +316,16 @@ class ContinuousEngine:
         self.cache = cache
         toks = np.asarray(toks)
         acts = np.asarray(acts)
+        if self._obs is not None:
+            # toks/acts above already synced the chain's host outputs; the
+            # sync flag additionally drains the donated cache write so the
+            # histogram sees pure device time (obs/trace.sync_device_timing)
+            if self._obs.sync:
+                import jax
+
+                jax.block_until_ready(self.cache)
+            self._obs.record_step(time.monotonic() - t0, sum(active0),
+                                  steps=k)
         self.stats.steps += k
         self.stats.max_active = max(self.stats.max_active, sum(active0))
         # host replay: apply the recorded per-step outcomes with exactly
@@ -307,11 +339,12 @@ class ContinuousEngine:
             for i in range(k):
                 if not acts[i, b]:
                     break
+                sampled = not s.forced
                 if s.forced:
                     s.forced.pop(0)
                 elif s.sampler.temperature != 0.0:
                     s.sampler.rng.f32()  # the coin the chain consumed
-                if self._advance(s, int(toks[i, b]), quiet):
+                if self._advance(s, int(toks[i, b]), quiet, sampled=sampled):
                     break
         self._admit()
         return sum(not s.free for s in pool)
@@ -321,10 +354,13 @@ class ContinuousEngine:
         the scheduler thread steps). ``req.done`` fires when it retires."""
         if not req.tokens:
             raise ValueError("request has no prompt tokens")
+        req.t_enqueue = time.monotonic()
         with self._lock:
             req.index = self._submitted
             self._submitted += 1
             self._queue.append(req)
+            if self._obs is not None:
+                self._obs.queued.set(len(self._queue))
         return req
 
     def step_once(self, quiet: bool = True) -> int:
@@ -337,14 +373,23 @@ class ContinuousEngine:
         pool = self._pool
         if all(s.free for s in pool):
             return 0
+        active0 = sum(not s.free for s in pool)
+        t0 = time.monotonic() if self._obs is not None else 0.0
         tokens = jnp.asarray([s.token for s in pool], jnp.int32)
         pos_vec = jnp.asarray([s.pos for s in pool], jnp.int32)
         logits, self.cache = self._step(self.params, self.cache, tokens,
                                         pos_vec)
         logits = np.asarray(logits)
+        if self._obs is not None:
+            # np.asarray synced the logits; the sync flag also drains the
+            # donated cache write (obs/trace.sync_device_timing)
+            if self._obs.sync:
+                import jax
+
+                jax.block_until_ready(self.cache)
+            self._obs.record_step(time.monotonic() - t0, active0)
         self.stats.steps += 1
-        self.stats.max_active = max(self.stats.max_active,
-                                    sum(not s.free for s in pool))
+        self.stats.max_active = max(self.stats.max_active, active0)
         for i, s in enumerate(pool):
             if s.free:
                 continue
@@ -353,24 +398,34 @@ class ContinuousEngine:
                 continue
             if s.forced:
                 nxt = s.forced.pop(0)
+                self._advance(s, nxt, quiet)
             else:
                 nxt = int(s.sampler.sample(logits[i]))
-            self._advance(s, nxt, quiet)
+                self._advance(s, nxt, quiet, sampled=True)
         self._admit()
         return sum(not s.free for s in pool)
 
-    def _advance(self, s: _Slot, nxt: int, quiet: bool) -> bool:
+    def _advance(self, s: _Slot, nxt: int, quiet: bool,
+                 sampled: bool = False) -> bool:
         """Apply one decode outcome to a slot — the per-token bookkeeping
         (position clock, BOS stop, output append/notify/count, budget stop)
         shared by step_once and step_many's replay so the two paths cannot
-        drift. Returns True when the slot retired."""
+        drift. ``sampled`` marks a token the sampler produced (vs forced
+        prompt replay) — the TTFT anchor. Returns True when the slot
+        retired."""
         s.pos += 1
+        if sampled:
+            s.req.n_sampled += 1
+            if not s.req.t_first_token:
+                s.req.t_first_token = time.monotonic()
         if nxt == BOS:  # reference stop: BOS before decoding it
             self._retire(s, quiet)
             return True
         s.req.out.append(nxt)
         self._notify(s.req, nxt)
         self.stats.tokens += 1
+        if self._obs is not None:
+            self._obs.generated.inc()
         s.token = nxt
         if s.pos >= s.budget:
             self._retire(s, quiet)
@@ -388,9 +443,12 @@ class ContinuousEngine:
                     if not self._queue:
                         return
                     req = self._queue.pop(0)
+                    if self._obs is not None:
+                        self._obs.queued.set(len(self._queue))
                 if req.cancelled:  # consumer gone before admission
                     req.done.set()
                     req = None
+            req.t_admit = time.monotonic()
             s.req, s.pos = req, 0
             s.token = req.tokens[0]
             s.forced = list(req.tokens[1:])
@@ -422,6 +480,7 @@ class ContinuousEngine:
             return
         from .generate import run_chunked_prefill
 
+        t0 = time.monotonic() if self._obs is not None else 0.0
         jnp = self.jnp
         cache_box = [self._scratch_cache()]
 
@@ -441,6 +500,9 @@ class ContinuousEngine:
         for t in tokens[1:n_pre + 1]:
             self._notify(s.req, t)
         self.stats.tokens += n_pre
+        if self._obs is not None:
+            self._obs.generated.inc(n_pre)
+            self._obs.prefill.observe(time.monotonic() - t0)
         s.pos = n_pre
         s.token = tokens[n_pre]
         s.forced = []
@@ -459,6 +521,9 @@ class ContinuousEngine:
         if not quiet:
             print(f"[{s.req.index}] done: {len(s.req.out)} tokens "
                   f"(pos {s.pos}/{s.budget})")
+        s.req.t_finish = time.monotonic()
+        if self._obs is not None:
+            self._obs.record_retire(s.req, s.req.t_finish)
         s.req.done.set()
         s.req = None
         # park the freed slot at pos 0: a retired row's clock can equal
@@ -474,8 +539,12 @@ class ContinuousEngine:
         with self._lock:
             pending = self._queue
             self._queue = []
+            if self._obs is not None:
+                self._obs.queued.set(0)
         for req in pending:
             req.error = message
+            if self._obs is not None:
+                self._obs.failed.inc()
             req.done.set()
         for s in self._pool:
             if not s.free:
@@ -524,7 +593,7 @@ def generate_continuous(spec: TransformerSpec, params: dict[str, Any],
                         slots: int = 0, cache_dtype=None, mesh=None,
                         prefill_chunk: int = 0, block_steps: int = 1,
                         quiet: bool = False, use_native_sampler: bool = True,
-                        fast_prefill: bool = False):
+                        fast_prefill: bool = False, metrics=None):
     """CLI entry: encode prompts, stream them through a slot pool, print
     rows in the --prompts-file format ("[i] 'text'")."""
     reqs = [tokenizer.encode(p or "", bos=True, eos=False) for p in prompts]
@@ -534,7 +603,7 @@ def generate_continuous(spec: TransformerSpec, params: dict[str, Any],
                            prefill_chunk=prefill_chunk,
                            block_steps=block_steps,
                            use_native_sampler=use_native_sampler,
-                           fast_prefill=fast_prefill)
+                           fast_prefill=fast_prefill, metrics=metrics)
     outs, stats = eng.run(reqs, steps, quiet=quiet)
     for b, (req, row) in enumerate(zip(reqs, outs)):
         if not quiet:

@@ -223,8 +223,10 @@ def make_decode_loop_aot(step_fn: StepFn, max_steps: int,
             lambda a: jax.device_put(jnp.asarray(a)), params_host)
         touchers = _touch_async(placed)
         try:
-            param_formats = jax.tree_util.tree_map(lambda a: a.format,
-                                                   placed)
+            # Array.format is newer-jax; older images pin layouts via the
+            # sharding only (same in_shardings slot either way)
+            param_formats = jax.tree_util.tree_map(
+                lambda a: getattr(a, "format", None) or a.sharding, placed)
             jitted = jax.jit(run, donate_argnums=1,
                              in_shardings=(param_formats,) + (None,) * 6)
             abstract = (jax.tree_util.tree_map(sds, placed),

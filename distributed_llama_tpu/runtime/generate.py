@@ -25,6 +25,8 @@ import numpy as np
 from ..io.tokenizer import BOS, Tokenizer
 from ..models.llama import forward, init_cache
 from ..models.spec import TransformerSpec
+from ..obs.log import log_event
+from ..obs.metrics import summarize_values
 from ..parallel.comm_stats import (CommStats, ici_all_gather_bytes,
                                    sp_lse_bytes)
 from .sampling import Sampler
@@ -237,6 +239,9 @@ class GenStats:
     host_ms: float = 0.0
     final_pos: int = 0    # next step's pos — checkpoint/resume anchor
     final_token: int = 0  # next step's input token
+    token_ms: list = dataclasses.field(default_factory=list)
+    # ^ per-token wall ms (per-step loop only; the fused loop is one
+    #   device program) — feeds the final-line latency histogram summary
     prompt_rest: list = dataclasses.field(default_factory=list)
     # ^ prompt tokens NOT yet consumed when the run ended (forced-token tail
     #   for a resumed continuation; empty once the prompt is exhausted)
@@ -345,6 +350,7 @@ def generate(engine: Engine, tokenizer: Tokenizer, sampler: Sampler,
         stats.total_ms += gen_ms
         stats.infer_ms += (t1 - t0) * 1000
         stats.host_ms += (t2 - t1) * 1000
+        stats.token_ms.append(gen_ms)
 
         pos += 1
         stats.final_pos, stats.final_token = pos, int(next_token)
@@ -356,19 +362,43 @@ def generate(engine: Engine, tokenizer: Tokenizer, sampler: Sampler,
         if emit is not None:
             emit(piece.decode("utf-8", errors="replace"))
         if not quiet:
-            print(f"🔶 G {gen_ms:7.2f} ms I {(t1 - t0) * 1000:7.2f} ms "
-                  f"T {(t2 - t1) * 1000:7.2f} ms "
-                  f"S {comm.sent_bytes / 1024:7.0f} kB "
-                  f"R {comm.recv_bytes / 1024:7.0f} kB "
-                  f"{piece.decode('utf-8', errors='replace')!r}")
+            # the 🔶 reference stats line, or one NDJSON object per token
+            # with the same fields under DLLAMA_LOG_JSON=1 (obs/log.py)
+            log_event(
+                "decode.token",
+                f"🔶 G {gen_ms:7.2f} ms I {(t1 - t0) * 1000:7.2f} ms "
+                f"T {(t2 - t1) * 1000:7.2f} ms "
+                f"S {comm.sent_bytes / 1024:7.0f} kB "
+                f"R {comm.recv_bytes / 1024:7.0f} kB "
+                f"{piece.decode('utf-8', errors='replace')!r}",
+                pos=pos, token=int(next_token),
+                gen_ms=round(gen_ms, 3),
+                infer_ms=round((t1 - t0) * 1000, 3),
+                host_ms=round((t2 - t1) * 1000, 3),
+                sent_bytes=comm.sent_bytes, recv_bytes=comm.recv_bytes,
+                piece=piece.decode("utf-8", errors="replace"))
         token = next_token
 
-    if not quiet and stats.tokens:
-        g, i, t = stats.avg
-        print(f"Generated tokens:    {stats.tokens}")
-        print(f"Avg generation time: {g:.2f} ms")
-        print(f"Avg inference time:  {i:.2f} ms")
-        print(f"Avg transfer time:   {t:.2f} ms")
+    if stats.tokens:
+        # the SAME summary shape the serving metrics expose (/health,
+        # bench.py rows): p50/p95/p99 over the per-token wall times plus
+        # the analytic per-token collective bytes
+        lat = summarize_values(stats.token_ms)
+        if not quiet:
+            g, i, t = stats.avg
+            print(f"Generated tokens:    {stats.tokens}")
+            print(f"Avg generation time: {g:.2f} ms")
+            print(f"Avg inference time:  {i:.2f} ms")
+            print(f"Avg transfer time:   {t:.2f} ms")
+            print(f"Latency ms/token:    p50 {lat['p50']:.2f}  "
+                  f"p95 {lat['p95']:.2f}  p99 {lat['p99']:.2f} | "
+                  f"ICI S {comm.sent_bytes / 1024:.0f} kB "
+                  f"R {comm.recv_bytes / 1024:.0f} kB /token")
+        log_event("run.summary", None, tokens=stats.tokens,
+                  avg_ms=round(stats.total_ms / stats.tokens, 3),
+                  latency_ms={k: round(v, 3) for k, v in lat.items()},
+                  sent_bytes_per_token=comm.sent_bytes,
+                  recv_bytes_per_token=comm.recv_bytes)
     return out_tokens, stats
 
 
@@ -570,11 +600,14 @@ def generate_fast(engine: Engine, tokenizer: Tokenizer, sampler: Sampler,
         stats.final_pos = start_pos + steps
         stats.final_token = int(toks[steps - 1])
         stats.prompt_rest = prompt_tail
+    # the while_loop stops on a produced BOS: executed = generated
+    # tokens + the terminating step, not the whole budget
+    executed = chain_generated + 1 if early_bos else steps
     if not quiet:
-        # the while_loop stops on a produced BOS: executed = generated
-        # tokens + the terminating step, not the whole budget
-        executed = chain_generated + 1 if early_bos else steps
         print(f"\nGenerated tokens:    {stats.tokens}")
         print(f"Avg generation time: {total_ms / n:.2f} ms "
               f"(fused loop, {executed} device steps)")
+    log_event("run.summary", None, tokens=stats.tokens,
+              avg_ms=round(total_ms / n, 3), fused=True,
+              device_steps=executed)
     return out_tokens, stats

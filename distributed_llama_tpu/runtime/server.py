@@ -14,7 +14,14 @@ Endpoints:
                one {"token": int, "piece": str} line per token as it
                decodes, then a final {"done": true, "text": ..., "steps": N}
   GET  /health -> {"active": int, "queued": int, "slots": int,
-                   "steps": int, "generated_tokens": int}
+                   "steps": int, "generated_tokens": int, "uptime_s",
+                   "occupancy", and (metrics on) "ttft_s"/"token_latency_s"/
+                   "queue_wait_s" p50/p95/p99 summaries}
+  GET  /metrics -> Prometheus text exposition of the obs registry (request
+               lifecycle histograms, engine step/occupancy, counters)
+  POST /profile  {"seconds"?: float, "dir"?: str} -> starts a jax.profiler
+               capture into dir for N seconds WHILE SERVING (409 if one is
+               already running) — profile under real load
 
 Threading model: http.server's ThreadingHTTPServer handles each connection
 on its own thread; handlers only encode, submit (thread-safe), and wait on
@@ -33,6 +40,7 @@ from typing import Any
 
 from ..io.tokenizer import Tokenizer
 from ..models.spec import TransformerSpec
+from ..obs.log import log_event
 from .continuous import ContinuousEngine, Request
 
 _IDLE_SLEEP_S = 0.002
@@ -46,17 +54,30 @@ class InferenceServer:
                  steps: int, temperature: float, topp: float, seed: int,
                  cache_dtype=None, mesh=None, prefill_chunk: int = 0,
                  block_steps: int = 1, quiet: bool = False,
-                 fast_prefill: bool = False):
+                 fast_prefill: bool = False, metrics: bool = True,
+                 registry=None):
         self.spec = spec
         self.tokenizer = tokenizer
         self.default_steps = steps
         self.quiet = quiet
+        # metrics default ON for the server (it IS the observability
+        # surface); --no-metrics turns collection off, and /metrics then
+        # 404s. Each server gets its OWN registry unless one is injected —
+        # two servers in one process must not sum their counters.
+        if metrics:
+            from ..obs.metrics import Registry
+
+            self.registry = registry if registry is not None else Registry()
+        else:
+            self.registry = None
+        self._t_start = time.monotonic()
         self.engine = ContinuousEngine(spec, params, slots, temperature,
                                        topp, seed, cache_dtype=cache_dtype,
                                        mesh=mesh,
                                        prefill_chunk=prefill_chunk,
                                        block_steps=block_steps,
-                                       fast_prefill=fast_prefill)
+                                       fast_prefill=fast_prefill,
+                                       metrics=self.registry)
         self._shutdown = threading.Event()
         server = self
 
@@ -69,7 +90,10 @@ class InferenceServer:
 
             def log_message(self, fmt, *args):  # quiet the per-request noise
                 if not server.quiet:
-                    print(f"🌐 {self.address_string()} {fmt % args}")
+                    log_event("http.request",
+                              f"🌐 {self.address_string()} {fmt % args}",
+                              client=self.address_string(),
+                              line=fmt % args)
 
             def _json(self, code: int, payload: dict):
                 body = json.dumps(payload).encode()
@@ -80,20 +104,50 @@ class InferenceServer:
                 self.wfile.write(body)
 
             def do_GET(self):
+                if self.path == "/metrics":
+                    if server.registry is None:
+                        return self._json(404, {"error": "metrics disabled "
+                                                "(--no-metrics)"})
+                    body = server.registry.expose().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "text/plain; version=0.0.4; "
+                                     "charset=utf-8")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
                 if self.path != "/health":
                     return self._json(404, {"error": "unknown path"})
                 eng = server.engine
                 with eng._lock:
                     queued = len(eng._queue)
-                self._json(200, {
-                    "active": sum(not s.free for s in eng._pool),
+                active = sum(not s.free for s in eng._pool)
+                payload = {
+                    "active": active,
                     "queued": queued,
                     "slots": eng.slots,
                     "steps": eng.stats.steps,
                     "generated_tokens": eng.stats.tokens,
-                })
+                    "uptime_s": round(time.monotonic() - server._t_start, 3),
+                    "occupancy": round(active / eng.slots, 4),
+                }
+                if server.registry is not None:
+                    for key, name in (
+                            ("ttft_s", "dllama_request_ttft_seconds"),
+                            ("token_latency_s",
+                             "dllama_request_decode_token_seconds"),
+                            ("queue_wait_s",
+                             "dllama_request_queue_wait_seconds")):
+                        h = server.registry.get(name)
+                        s = h.summary()
+                        payload[key] = {k: round(v, 6) if k != "count"
+                                        else v for k, v in s.items()}
+                self._json(200, payload)
 
             def do_POST(self):
+                if self.path == "/profile":
+                    return self._profile()
                 if self.path != "/generate":
                     return self._json(404, {"error": "unknown path"})
                 try:
@@ -112,6 +166,30 @@ class InferenceServer:
                 text = server.decode(req)
                 self._json(200, {"text": text, "tokens": req.out,
                                  "steps": len(req.out)})
+
+            def _profile(self):
+                """POST /profile: capture a jax.profiler trace for N
+                seconds while the server keeps serving. One capture per
+                process (jax.profiler is a singleton) -> 409 on overlap."""
+                import tempfile
+
+                from ..obs import profiler
+
+                try:
+                    n = int(self.headers.get("Content-Length", "0"))
+                    payload = json.loads(self.rfile.read(n) or b"{}")
+                    if not isinstance(payload, dict):
+                        raise ValueError("body must be a JSON object")
+                    seconds = float(payload.get("seconds", 5.0))
+                    trace_dir = payload.get("dir") \
+                        or profiler.env_profile_dir() \
+                        or tempfile.mkdtemp(prefix="dllama-profile-")
+                    profiler.start_capture(trace_dir, seconds)
+                except RuntimeError as e:  # capture already in flight
+                    return self._json(409, {"error": str(e)})
+                except (ValueError, KeyError, TypeError) as e:
+                    return self._json(400, {"error": str(e)})
+                self._json(200, {"dir": trace_dir, "seconds": seconds})
 
             def _stream(self, req):
                 """Chunked newline-delimited JSON, one line per token.
@@ -211,8 +289,10 @@ class InferenceServer:
                 import traceback
 
                 traceback.print_exc()
-                print(f"🌐 scheduler step failed: {e!r}; failing pending "
-                      f"requests")
+                log_event("scheduler.error",
+                          f"🌐 scheduler step failed: {e!r}; failing "
+                          f"pending requests",
+                          error=f"{type(e).__name__}: {e}")
                 self.engine.fail_all(f"{type(e).__name__}: {e}")
                 time.sleep(0.1)
                 continue

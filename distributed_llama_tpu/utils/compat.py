@@ -1,0 +1,96 @@
+"""Version-portability shims for the jax API surface this repo targets.
+
+The code is written against the current jax names (``jax.shard_map`` with
+``check_vma``, ``pltpu.CompilerParams``); older 0.4.x images carry the same
+functionality under pre-rename names (``jax.experimental.shard_map`` with
+``check_rep``, ``pltpu.TPUCompilerParams``). Resolving here keeps every
+kernel/parallel module importable on both — an unimportable ops module
+would take the whole model stack (and its tests) down with it.
+"""
+
+from __future__ import annotations
+
+
+def shard_map(f, *, mesh, in_specs, out_specs):
+    """jax.shard_map with replication/varying-manual-axes checking off,
+    under whichever spelling this jax provides."""
+    import jax
+
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      check_rep=False)
+
+
+def pallas_tpu_compiler_params(**kwargs):
+    """pltpu.CompilerParams (renamed from TPUCompilerParams in newer jax)."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None) \
+        or getattr(pltpu, "TPUCompilerParams")
+    return cls(**kwargs)
+
+
+class _XEvent:
+    __slots__ = ("name", "duration_ns")
+
+    def __init__(self, name, duration_ns):
+        self.name = name
+        self.duration_ns = duration_ns
+
+
+class _XLine:
+    __slots__ = ("name", "events")
+
+    def __init__(self, name, events):
+        self.name = name
+        self.events = events
+
+
+class _XPlane:
+    __slots__ = ("name", "lines")
+
+    def __init__(self, name, lines):
+        self.name = name
+        self.lines = lines
+
+
+def profile_data_planes(path: str):
+    """The planes of an .xplane.pb trace, ProfileData-shaped.
+
+    jax.profiler.ProfileData where this jax has it; otherwise a direct
+    parse of the XSpace proto via the tensorflow tsl copy that ships in
+    the image — plane.name / plane.lines / line.name / line.events /
+    event.name / event.duration_ns, exactly the surface utils/it_split.py
+    walks.
+    """
+    try:
+        from jax.profiler import ProfileData
+    except ImportError:
+        ProfileData = None
+    if ProfileData is not None:
+        return ProfileData.from_file(path).planes
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+    space = xplane_pb2.XSpace()
+    with open(path, "rb") as fh:
+        space.ParseFromString(fh.read())
+    planes = []
+    for plane in space.planes:
+        meta = plane.event_metadata
+        lines = []
+        for line in plane.lines:
+            events = [
+                _XEvent(
+                    # display_name carries the full HLO text on TPU 'XLA
+                    # Ops' lines; name is the instruction name elsewhere
+                    meta[ev.metadata_id].display_name
+                    or meta[ev.metadata_id].name,
+                    ev.duration_ps / 1e3)
+                for ev in line.events]
+            lines.append(_XLine(line.name, events))
+        planes.append(_XPlane(plane.name, lines))
+    return planes

@@ -100,11 +100,10 @@ def parse_trace(path: str) -> dict[str, DeviceSplit]:
     Keys are 'plane-name[/line]' — one entry per device for TPU traces, one
     per virtual-device executor thread for CPU-mesh traces.
     """
-    from jax.profiler import ProfileData
+    from .compat import profile_data_planes
 
-    data = ProfileData.from_file(find_xplane(path))
     out: dict[str, DeviceSplit] = {}
-    for plane in data.planes:
+    for plane in profile_data_planes(find_xplane(path)):
         lines = list(plane.lines)
         has_xla_ops = any(ln.name == "XLA Ops" for ln in lines)
         for line in lines:

@@ -165,3 +165,62 @@ def test_nb_major_force_invalidates(tmp_path, monkeypatch):
     assert kc.load_packed(side, kc.layout_key(path)) is not None
     monkeypatch.setenv("DLLAMA_NB_MAJOR", "force")
     assert kc.load_packed(side, kc.layout_key(path)) is None
+
+
+def test_layout_key_folds_float_types(tmp_path, monkeypatch):
+    """weights/buffer float types are part of the layout key: a future
+    packed form for another float type cannot collide with the Q40/F32
+    sidecar under the same key."""
+    monkeypatch.setenv("DLLAMA_Q40_KERNEL", "pallas")
+    path = _model_file(tmp_path)
+    base = kc.layout_key(path)
+    assert "|wf=Q40|" in base and "|bf=F32" in base
+    # defaults are spelled out: explicit Q40/F32 == the default key
+    assert kc.layout_key(path, weights_float_type=FloatType.Q40,
+                         buffer_float_type=FloatType.F32) == base
+    assert kc.layout_key(path, weights_float_type=FloatType.F16) != base
+    assert kc.layout_key(path, buffer_float_type=FloatType.Q80) != base
+    # and the written sidecar round-trips under the default key
+    kc.load_model_packed(path)
+    assert kc.load_packed(kc.sidecar_path(path), base) is not None
+
+
+def test_build_lock_skips_concurrent_write(tmp_path, monkeypatch, capsys):
+    """A held build lock makes a racing load SKIP the sidecar write (no
+    duplicate GB-scale .tmp<pid> streams — ADVICE r5) while still
+    returning a fully packed in-memory tree; once the lock is released
+    the next load writes normally."""
+    monkeypatch.setenv("DLLAMA_Q40_KERNEL", "pallas")
+    path = _model_file(tmp_path)
+    side = kc.sidecar_path(path)
+
+    token = kc.try_build_lock(side)  # "another process" holds the lock
+    assert token is not None
+    assert kc.try_build_lock(side) is None  # held: second taker refused
+    _, tree = kc.load_model_packed(path)
+    assert not os.path.exists(side)  # write skipped
+    assert any(isinstance(v, (Q40Kernel, Q40KernelNb))
+               for v in tree.values())  # but the load itself is packed
+    assert not [f for f in os.listdir(str(tmp_path))
+                if ".kcache.tmp" in f]  # no orphan tmp sidecars
+
+    kc.release_build_lock(token)
+    kc.load_model_packed(path)
+    assert os.path.exists(side)  # lock released: the write proceeds
+    assert not os.path.exists(side + ".lock")  # and released its own lock
+
+
+def test_build_lock_breaks_stale_holder(tmp_path, monkeypatch):
+    """A lock whose holder crashed (old mtime) must not wedge sidecar
+    writes forever: it is broken and re-acquired."""
+    monkeypatch.setenv("DLLAMA_Q40_KERNEL", "pallas")
+    path = _model_file(tmp_path)
+    side = kc.sidecar_path(path)
+    lock = side + ".lock"
+    with open(lock, "w") as fh:
+        fh.write("99999\n")
+    os.utime(lock, (1, 1))  # ancient: way past _LOCK_STALE_S
+    token = kc.try_build_lock(side)
+    assert token is not None
+    kc.release_build_lock(token)
+    assert not os.path.exists(lock)

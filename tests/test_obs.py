@@ -1,0 +1,373 @@
+"""Telemetry subsystem: metric math, registry thread-safety, Prometheus
+exposition, engine lifecycle tracing, and the off-unless-enabled contract
+(a disabled engine makes ZERO registry calls on the hot path)."""
+
+import json
+import threading
+import urllib.request
+
+import pytest
+
+from distributed_llama_tpu.models.spec import TransformerSpec
+from distributed_llama_tpu.models.synth import synth_params
+from distributed_llama_tpu.obs.metrics import (Counter, Gauge, Histogram,
+                                               Registry, summarize_values)
+
+SPEC = TransformerSpec(dim=64, hidden_dim=160, n_layers=2, n_heads=4,
+                       n_kv_heads=2, vocab_size=128, seq_len=16)
+
+
+class _IdTokenizer:
+    def encode(self, text, bos=True, eos=False):
+        return [1] + [3 + b for b in text.encode()]
+
+    def decode_piece(self, prev, tok):
+        return b"<%d>" % tok
+
+
+@pytest.fixture(scope="module")
+def params():
+    return synth_params(SPEC, q40=False, seed=4, scale=0.3)
+
+
+# ---------------------------------------------------------------- metrics
+
+
+def test_histogram_bucket_and_percentile_math():
+    h = Histogram("h", buckets=(1.0, 2.0, 4.0, 8.0))
+    for v in (0.5, 1.5, 1.5, 3.0, 7.0, 100.0):
+        h.observe(v)
+    counts, s, total = h.snapshot()
+    assert counts == [1, 2, 1, 1, 1]  # per-bucket, +Inf last
+    assert total == 6 and s == pytest.approx(113.5)
+    # p50: rank 3 of 6 -> second bucket (1, 2]: 1 + (3-1)/2 * 1 = 2.0
+    assert h.percentile(0.50) == pytest.approx(2.0)
+    # p100 lands in +Inf: clamps to the last finite bound
+    assert h.percentile(1.0) == pytest.approx(8.0)
+    # empty histogram: all zeros
+    assert Histogram("e", buckets=(1.0,)).percentile(0.9) == 0.0
+    summ = h.summary()
+    assert summ["count"] == 6
+    assert summ["mean"] == pytest.approx(113.5 / 6)
+
+
+def test_histogram_rejects_unsorted_buckets():
+    with pytest.raises(ValueError):
+        Histogram("bad", buckets=(2.0, 1.0))
+    with pytest.raises(ValueError):
+        Histogram("bad", buckets=(1.0, 1.0))
+
+
+def test_summarize_values_matches_percentile_semantics():
+    s = summarize_values(range(1, 101))  # 1..100
+    assert s["count"] == 100
+    assert s["mean"] == pytest.approx(50.5)
+    assert s["p50"] == pytest.approx(50.5)
+    assert s["p99"] == pytest.approx(99.01)
+    assert summarize_values([])["p95"] == 0.0
+    # unit_scale rescales on the way in (ms list -> seconds)
+    assert summarize_values([1000.0], unit_scale=1e-3)["p50"] == 1.0
+
+
+def test_registry_get_or_create_and_mismatch():
+    reg = Registry()
+    c1 = reg.counter("c", "help")
+    assert reg.counter("c") is c1
+    with pytest.raises(ValueError):
+        reg.gauge("c")
+    h = reg.histogram("h", buckets=(1.0, 2.0))
+    assert reg.histogram("h", buckets=(1.0, 2.0)) is h
+    with pytest.raises(ValueError):
+        reg.histogram("h", buckets=(1.0, 3.0))
+
+
+def test_registry_thread_safety_exact_counts():
+    reg = Registry()
+    c = reg.counter("dllama_test_total")
+    g = reg.gauge("dllama_test_gauge")
+    h = reg.histogram("dllama_test_seconds", buckets=(0.5, 1.5))
+    N, T = 2000, 8
+
+    def writer():
+        for i in range(N):
+            c.inc()
+            g.inc()
+            h.observe(i % 2)
+
+    threads = [threading.Thread(target=writer) for _ in range(T)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value == N * T
+    assert g.value == N * T
+    counts, s, total = h.snapshot()
+    assert total == N * T
+    assert counts == [N * T // 2, N * T // 2, 0]
+    assert s == pytest.approx(N * T // 2)
+
+
+def test_counter_rejects_negative():
+    with pytest.raises(ValueError):
+        Counter("c").inc(-1)
+
+
+def test_exposition_format_golden():
+    reg = Registry()
+    reg.counter("dllama_generated_tokens_total", "Tokens emitted").inc(7)
+    g = reg.gauge("dllama_active_slots", "Active now")
+    g.set(2.5)
+    h = reg.histogram("dllama_ttft_seconds", "TTFT",
+                      buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(3.0)
+    assert reg.expose() == (
+        "# HELP dllama_generated_tokens_total Tokens emitted\n"
+        "# TYPE dllama_generated_tokens_total counter\n"
+        "dllama_generated_tokens_total 7\n"
+        "# HELP dllama_active_slots Active now\n"
+        "# TYPE dllama_active_slots gauge\n"
+        "dllama_active_slots 2.5\n"
+        "# HELP dllama_ttft_seconds TTFT\n"
+        "# TYPE dllama_ttft_seconds histogram\n"
+        'dllama_ttft_seconds_bucket{le="0.1"} 1\n'
+        'dllama_ttft_seconds_bucket{le="1"} 2\n'
+        'dllama_ttft_seconds_bucket{le="+Inf"} 3\n'
+        "dllama_ttft_seconds_sum 3.55\n"
+        "dllama_ttft_seconds_count 3\n")
+
+
+# ------------------------------------------------------------ event log
+
+
+def test_log_event_json_and_text_modes(capsys, monkeypatch):
+    from distributed_llama_tpu.obs.log import log_event
+
+    monkeypatch.delenv("DLLAMA_LOG_JSON", raising=False)
+    log_event("x", "human line", field=1)
+    assert capsys.readouterr().out == "human line\n"
+
+    monkeypatch.setenv("DLLAMA_LOG_JSON", "1")
+    log_event("decode.token", "human line", pos=3, gen_ms=1.5)
+    rec = json.loads(capsys.readouterr().out)
+    assert rec["event"] == "decode.token"
+    assert rec["pos"] == 3 and rec["gen_ms"] == 1.5
+    assert "ts" in rec
+
+    # text=None: JSON-only event, silent in human mode
+    monkeypatch.delenv("DLLAMA_LOG_JSON", raising=False)
+    log_event("run.summary", None, tokens=5)
+    assert capsys.readouterr().out == ""
+
+
+# -------------------------------------------------- engine lifecycle
+
+
+def _patch_instrument_calls(monkeypatch):
+    """Wrap every registry-instrument mutator with a call counter."""
+    calls = []
+
+    def wrap(cls, name):
+        orig = getattr(cls, name)
+
+        def spy(self, *a, **kw):
+            calls.append((cls.__name__, name))
+            return orig(self, *a, **kw)
+
+        monkeypatch.setattr(cls, name, spy)
+
+    wrap(Counter, "inc")
+    wrap(Gauge, "set")
+    wrap(Gauge, "inc")
+    wrap(Gauge, "dec")
+    wrap(Histogram, "observe")
+    return calls
+
+
+def test_engine_zero_registry_calls_when_disabled(params, monkeypatch):
+    """The acceptance gate: metrics collection is OFF the hot path unless
+    enabled — an engine built without a registry must not touch any
+    instrument during submit/step/retire."""
+    from distributed_llama_tpu.runtime.continuous import ContinuousEngine
+
+    calls = _patch_instrument_calls(monkeypatch)
+    eng = ContinuousEngine(SPEC, params, slots=2, temperature=0.0,
+                           topp=0.9, seed=5)
+    outs, _ = eng.run([[1, 5, 9], [1, 7]], steps=8)
+    assert all(outs)
+    assert calls == []
+
+
+def test_engine_lifecycle_metrics_populated(params):
+    from distributed_llama_tpu.runtime.continuous import ContinuousEngine
+
+    reg = Registry()
+    eng = ContinuousEngine(SPEC, params, slots=2, temperature=0.0,
+                           topp=0.9, seed=5, metrics=reg)
+    outs, stats = eng.run([[1, 5, 9], [1, 7], [1, 2]], steps=8)
+    assert reg.get("dllama_requests_total").value == 3
+    assert reg.get("dllama_request_ttft_seconds").count == 3
+    assert reg.get("dllama_request_queue_wait_seconds").count == 3
+    assert reg.get("dllama_request_decode_token_seconds").count == 3
+    assert reg.get("dllama_generated_tokens_total").value == stats.tokens
+    assert reg.get("dllama_engine_steps_total").value == stats.steps
+    assert reg.get("dllama_engine_step_duration_seconds").count > 0
+    occ = reg.get("dllama_engine_batch_occupancy")
+    assert occ.count > 0
+    # queue drained at the end
+    assert reg.get("dllama_engine_queued_requests").value == 0
+
+
+def test_engine_compile_event_counter(params):
+    """Fused-chain shape-cache misses count as compile events; reusing a
+    chain shape does not."""
+    from distributed_llama_tpu.runtime.continuous import ContinuousEngine
+
+    reg = Registry()
+    eng = ContinuousEngine(SPEC, params, slots=2, temperature=0.0,
+                           topp=0.9, seed=5, block_steps=3, metrics=reg)
+    eng.run([[1, 5]], steps=6)
+    first = reg.get("dllama_engine_compile_events_total").value
+    assert first >= 1
+    eng.run([[1, 7]], steps=6)  # same chain shape: no new trace
+    assert reg.get("dllama_engine_compile_events_total").value == first
+
+
+# ---------------------------------------------------- server round-trip
+
+
+@pytest.fixture()
+def server(params):
+    from distributed_llama_tpu.runtime.server import InferenceServer
+
+    srv = InferenceServer(SPEC, params, _IdTokenizer(), "127.0.0.1", 0,
+                          slots=2, steps=8, temperature=0.0, topp=0.9,
+                          seed=5, quiet=True)
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def _post(port, path, payload):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=60) as r:
+        return json.loads(r.read())
+
+
+def test_server_metrics_round_trip(server):
+    """/metrics after a /generate: valid Prometheus text whose values are
+    consistent with the completed request (the acceptance criterion)."""
+    r = _post(server.port, "/generate", {"prompt": "ab", "steps": 8})
+    n_tokens = len(r["tokens"])
+    assert n_tokens > 0
+
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/metrics", timeout=30) as resp:
+        assert resp.headers["Content-Type"].startswith("text/plain")
+        text = resp.read().decode()
+
+    metrics = {}
+    for line in text.splitlines():
+        assert line, "blank line in exposition"
+        if line.startswith("#"):
+            assert line.startswith(("# HELP ", "# TYPE "))
+            continue
+        name_part, value = line.rsplit(" ", 1)
+        metrics[name_part] = float(value)
+    assert metrics["dllama_request_ttft_seconds_count"] == 1
+    assert metrics["dllama_request_queue_wait_seconds_count"] == 1
+    assert metrics["dllama_generated_tokens_total"] == n_tokens
+    assert metrics["dllama_engine_step_duration_seconds_count"] >= 1
+    assert metrics["dllama_requests_total"] == 1
+    # cumulative bucket invariant: +Inf bucket == count
+    assert metrics['dllama_request_ttft_seconds_bucket{le="+Inf"}'] \
+        == metrics["dllama_request_ttft_seconds_count"]
+
+
+def test_server_health_enriched(server):
+    _post(server.port, "/generate", {"prompt": "x", "steps": 4})
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/health", timeout=30) as r:
+        h = json.loads(r.read())
+    assert h["slots"] == 2
+    assert h["uptime_s"] > 0
+    assert 0.0 <= h["occupancy"] <= 1.0
+    for key in ("ttft_s", "token_latency_s", "queue_wait_s"):
+        assert h[key]["count"] >= 1
+        assert h[key]["p50"] <= h[key]["p95"] <= h[key]["p99"]
+
+
+def test_server_no_metrics_disables_endpoint(params):
+    from distributed_llama_tpu.runtime.server import InferenceServer
+
+    srv = InferenceServer(SPEC, params, _IdTokenizer(), "127.0.0.1", 0,
+                          slots=1, steps=4, temperature=0.0, topp=0.9,
+                          seed=5, quiet=True, metrics=False)
+    srv.start()
+    try:
+        assert srv.engine._obs is None
+        try:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/metrics", timeout=30)
+            assert False, "expected 404"
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+        # /health still serves its engine-level fields
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/health", timeout=30) as r:
+            h = json.loads(r.read())
+        assert "ttft_s" not in h and h["slots"] == 1
+    finally:
+        srv.stop()
+
+
+def test_server_profile_endpoint(server, tmp_path):
+    from distributed_llama_tpu.obs import profiler
+
+    d = str(tmp_path / "trace")
+    out = _post(server.port, "/profile", {"seconds": 0.2, "dir": d})
+    assert out == {"dir": d, "seconds": 0.2}
+    # a second capture while one is running -> 409
+    try:
+        _post(server.port, "/profile", {"seconds": 0.2, "dir": d})
+        overlapped = False
+    except urllib.error.HTTPError as e:
+        assert e.code == 409
+        overlapped = True
+    assert profiler.wait_capture(30)
+    assert overlapped or profiler.capture_active() is None
+    # bad duration -> 400
+    try:
+        _post(server.port, "/profile", {"seconds": -1})
+        assert False, "expected 400"
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
+
+
+# ------------------------------------------- flash-degrade warning
+
+
+def test_explicit_flash_degrade_warns_once(monkeypatch, capsys):
+    """DLLAMA_PREFILL_ATTN=flash degrading to the blockwise walk must say
+    so loudly, once (the fail-loud policy for explicit modes)."""
+    import jax.numpy as jnp
+
+    from distributed_llama_tpu.models import llama
+
+    monkeypatch.setenv("DLLAMA_PREFILL_ATTN", "flash")
+    monkeypatch.setenv("DLLAMA_ATTN_KERNEL", "xla")  # kernel unavailable
+    monkeypatch.setattr(llama, "_flash_degrade_warned", False)
+    t_len = 16
+    q = jnp.zeros((t_len, SPEC.n_heads, SPEC.head_size))
+    k = jnp.zeros((SPEC.seq_len, SPEC.n_kv_heads, SPEC.head_size))
+    v = jnp.zeros_like(k)
+    llama.attention(SPEC, q, k, v, jnp.int32(0), t_len)
+    err = capsys.readouterr().err
+    assert "DLLAMA_PREFILL_ATTN=flash" in err
+    assert "blockwise" in err
+    llama.attention(SPEC, q, k, v, jnp.int32(0), t_len)
+    assert "DLLAMA_PREFILL_ATTN" not in capsys.readouterr().err  # once
