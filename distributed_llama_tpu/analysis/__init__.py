@@ -1,0 +1,26 @@
+"""Static analysis for the TPU port: AST hazard lint + jaxpr contracts.
+
+Two heads, one gate (``python -m distributed_llama_tpu.analysis``, alias
+``tools/dlint.py``):
+
+* ``rules.py`` — pure-AST hazard rules (D001–D005) over the package
+  source: implicit device->host syncs in hot paths, jit retrace traps,
+  closure hygiene, per-step host allocation, and unsynced timing. No jax
+  import needed; runs in milliseconds; gated in tier-1 CI
+  (tests/test_dlint_repo.py) against ``tools/dlint_baseline.txt``.
+* ``jaxpr_contracts.py`` — traces the real entry points on CPU
+  (make_jaxpr / eval_shape / lower; no compile, no data) and pins program
+  structure: per-layer collective schedule vs parallel/comm_stats.py,
+  KV-cache donation on the decode step, and decode shape stability.
+
+The reference C++ program wears its sync points and transfer sizes in the
+source; JAX tracing hides ours. PR 1's telemetry *measures* regressions at
+run time — this subsystem *prevents* the known classes of them at test
+time.
+"""
+
+from .jaxpr_contracts import (run_contracts, walk_eqns,  # noqa: F401
+                              walk_fn_eqns)
+from .lint import (Finding, apply_baseline, lint_paths,  # noqa: F401
+                   load_baseline, package_files, write_baseline)
+from .rules import RULES  # noqa: F401

@@ -1,0 +1,131 @@
+"""dlint CLI: ``python -m distributed_llama_tpu.analysis`` (tools/dlint.py).
+
+    --lint            AST hazard rules over the package source (default)
+    --contracts       jaxpr program-structure contracts (traces on CPU)
+    --all             both heads
+    --baseline PATH   grandfathered-findings file
+                      (default tools/dlint_baseline.txt)
+    --write-baseline  rewrite the baseline from current findings and exit 0
+    --no-baseline     report every finding, baseline ignored
+
+Exit status: 0 = no new findings and all contracts hold; 1 = findings;
+2 = usage error. The contract head forces JAX_PLATFORMS=cpu and an 8-way
+virtual host mesh BEFORE jax initializes, so it is safe (and fast) on a
+box with a TPU attached.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parents[2]
+PACKAGE_DIR = Path(__file__).resolve().parents[1]
+DEFAULT_BASELINE = REPO_ROOT / "tools" / "dlint_baseline.txt"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="dlint", description="JAX/TPU static analysis: AST hazard "
+        "lint + jaxpr contract verifier")
+    ap.add_argument("--lint", action="store_true",
+                    help="run the AST hazard rules (default)")
+    ap.add_argument("--contracts", action="store_true",
+                    help="run the jaxpr contracts (imports jax, CPU-only)")
+    ap.add_argument("--all", action="store_true", help="both heads")
+    ap.add_argument("--baseline", type=Path, default=DEFAULT_BASELINE,
+                    help=f"baseline file (default {DEFAULT_BASELINE})")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="rewrite the baseline from current lint findings")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline (report everything)")
+    ap.add_argument("paths", nargs="*", type=Path,
+                    help="files to lint (default: the whole package)")
+    args = ap.parse_args(argv)
+
+    # --write-baseline is a lint-head operation: it implies --lint, so
+    # `--contracts --write-baseline` can't silently skip the rewrite
+    do_lint = (args.lint or args.all or args.write_baseline
+               or not args.contracts)
+    do_contracts = args.contracts or args.all
+    if args.write_baseline and args.paths:
+        # the baseline is global: rewriting it from a partial scan would
+        # silently drop every grandfathered entry for unscanned files
+        print("dlint: --write-baseline requires a full-package scan "
+              "(no explicit paths)", file=sys.stderr)
+        return 2
+    status = 0
+
+    if do_lint:
+        from .lint import (apply_baseline, lint_paths, load_baseline,
+                           package_files, write_baseline)
+
+        if args.paths:
+            missing = [p for p in args.paths if not p.exists()]
+            if missing:
+                print(f"dlint: no such file: {missing[0]}",
+                      file=sys.stderr)
+                return 2
+            # a directory argument means "everything under it"
+            files = [f for p in args.paths
+                     for f in (package_files(p) if p.is_dir() else [p])]
+        else:
+            files = package_files(PACKAGE_DIR)
+        findings = lint_paths(files, REPO_ROOT)
+        if args.write_baseline:
+            write_baseline(args.baseline, findings)
+            print(f"dlint: baseline rewritten with {len(findings)} "
+                  f"finding(s) -> {args.baseline}")
+            return 0
+        baseline = (load_baseline(args.baseline) if not args.no_baseline
+                    else None)
+        if baseline is not None:
+            new, suppressed, stale = apply_baseline(findings, baseline)
+            if args.paths:
+                # partial scan: a baseline entry for an unscanned file is
+                # not stale, it just wasn't looked at this run
+                stale = []
+        else:
+            new, suppressed, stale = findings, 0, []
+        for f in new:
+            print(f.render())
+        for key in stale:
+            print(f"dlint: stale baseline entry (finding fixed — prune "
+                  f"with --write-baseline): {key}", file=sys.stderr)
+        print(f"dlint: {len(new)} new finding(s), {suppressed} "
+              f"baseline-suppressed, {len(files)} file(s)")
+        if new:
+            status = 1
+
+    if do_contracts:
+        # the contracts trace on a virtual CPU mesh regardless of what
+        # hardware is attached. The env vars must land before jax's
+        # backend initializes — and an axon sitecustomize sets
+        # jax_platforms='axon,cpu' as EXPLICIT config at interpreter
+        # start, which overrides the env var (tests/conftest.py fights
+        # the same battle), so re-update the config value too.
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8").strip()
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        from .jaxpr_contracts import run_contracts
+
+        results = run_contracts()
+        for r in results:
+            mark = "ok " if r.ok else "FAIL"
+            print(f"dlint: contract {r.contract} {mark} {r.name}: "
+                  f"{r.detail}")
+            if not r.ok:
+                status = 1
+
+    return status
+
+
+if __name__ == "__main__":
+    sys.exit(main())

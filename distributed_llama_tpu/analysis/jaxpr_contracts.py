@@ -1,0 +1,305 @@
+"""Jaxpr contract verifier — machine-readable program-structure contracts.
+
+Where the AST head (rules.py) reads the *source*, this head reads the
+*traced program*: `jax.make_jaxpr` / `jax.eval_shape` / `.lower()` on CPU
+materialize nothing and compile nothing, so the real model-scale entry
+points can be verified in seconds on any box. Three contracts pin the
+properties every benchmark number in this repo leans on:
+
+  J001  collective count/kind + payload bytes of the tp forward equal the
+        analytic model in parallel/comm_stats.py (4 all_gathers per layer
+        + the logits gather, ring accounting) — the ICI term of every
+        multi-chip projection;
+  J002  buffer donation on the decode step actually reaches the lowering:
+        both KV-cache planes carry input/output aliases, so steady-state
+        decode allocates zero new cache buffers per token;
+  J003  the decode step is shape-stable: the output cache aval tree equals
+        the input cache aval tree (a fixed point), so the engine's step
+        loop reuses ONE compiled program instead of retracing per step.
+
+``walk_eqns``/``walk_fn_eqns`` moved here from tests/jaxpr_utils.py (a
+re-export shim remains) — the recursion duck-types on JAX internals (eqn
+params holding Jaxpr / ClosedJaxpr values), and keeping ONE copy means a
+JAX upgrade breakage shows up everywhere at once instead of leaving a
+vacuously-passing twin behind.
+
+Run under JAX_PLATFORMS=cpu (the CLI forces it); J001 additionally needs
+an N-device virtual mesh (--xla_force_host_platform_device_count, set by
+the CLI / tests/conftest.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+def walk_eqns(jaxpr):
+    """Yield every eqn in a jaxpr, recursing into sub-jaxprs (shard_map,
+    scan, while, cond bodies)."""
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for v in eqn.params.values():
+            inner = getattr(v, "jaxpr", None)
+            if hasattr(v, "eqns"):
+                yield from walk_eqns(v)
+            elif inner is not None and hasattr(inner, "eqns"):
+                yield from walk_eqns(inner)
+
+
+def walk_fn_eqns(fn, *args):
+    """walk_eqns over jax.make_jaxpr(fn)(*args); asserts non-empty so an
+    internal-API drift can't silently yield zero eqns."""
+    import jax
+
+    eqns = list(walk_eqns(jax.make_jaxpr(fn)(*args).jaxpr))
+    assert eqns, "jaxpr walk yielded nothing — JAX internals changed?"
+    return eqns
+
+
+def collect_collectives(jaxpr, mult=1):
+    """[(primitive_name, per_shard_aval, multiplicity)] for every
+    collective eqn, weighting eqns inside scan bodies by trip count (the
+    layer loop appears ONCE in the jaxpr but runs n_layers times)."""
+    out = []
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        m = mult
+        if name == "scan":
+            m = mult * eqn.params["length"]
+        if name.startswith(("all_gather", "all_to_all", "psum", "pmax",
+                            "pmin", "ppermute", "reduce_scatter")):
+            out.append((name, eqn.invars[0].aval, mult))
+        for v in eqn.params.values():
+            inner = getattr(v, "jaxpr", None)
+            if hasattr(v, "eqns"):
+                out.extend(collect_collectives(v, m))
+            elif inner is not None and hasattr(inner, "eqns"):
+                out.extend(collect_collectives(inner, m))
+    return out
+
+
+@dataclasses.dataclass(frozen=True)
+class ContractResult:
+    contract: str  # J00x
+    name: str
+    ok: bool
+    detail: str
+    hint: str = ""
+
+
+# -- shared abstract inputs ------------------------------------------------
+
+
+def _contract_spec():
+    """The tiny synth shape the contracts trace: small_bench dims with
+    dense f32 weights (the codec tree adds a host packing stage that is
+    irrelevant to collective count / donation / shape stability)."""
+    from ..models.synth import small_bench_spec
+    from ..ops.quants import FloatType
+
+    return small_bench_spec(weights_float_type=FloatType.F32)
+
+
+def abstract_params(spec):
+    """The param tree as avals only — nothing is materialized, so even the
+    70B tree traces in seconds."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..models.synth import _build_tree
+
+    def t(*shape):
+        return jnp.zeros(shape, jnp.float32)
+
+    return jax.eval_shape(lambda: _build_tree(spec, t, t))
+
+
+def _aval_trees_equal(a, b) -> str | None:
+    """None when the two aval trees match; else a description of the first
+    mismatch (structure, shape, or dtype)."""
+    import jax
+
+    ta, la = jax.tree_util.tree_flatten(a)[1], jax.tree_util.tree_leaves(a)
+    tb, lb = jax.tree_util.tree_flatten(b)[1], jax.tree_util.tree_leaves(b)
+    if str(ta) != str(tb):
+        return f"tree structure changed: {ta} vs {tb}"
+    for i, (x, y) in enumerate(zip(la, lb)):
+        if tuple(x.shape) != tuple(y.shape) or x.dtype != y.dtype:
+            return (f"leaf {i}: {x.shape}/{x.dtype} vs {y.shape}/{y.dtype}")
+    return None
+
+
+# -- J001: tp collectives vs the analytic model ----------------------------
+
+
+def contract_tp_collectives(spec=None, tp: int = 4) -> ContractResult:
+    """Trace make_sharded_forward and pin the collective schedule to the
+    analytic model: exactly 4*n_layers + 1 all_gathers (4 per layer + the
+    logits gather) and ring-accounted bytes equal to
+    comm_stats.ici_all_gather_bytes. (F32 buffer mode; the Q80 wire
+    packing variant is pinned at model scale by
+    tests/test_collective_pinning.py.)"""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from ..models.llama import init_cache
+    from ..parallel import make_mesh, make_sharded_forward
+    from ..parallel.comm_stats import ici_all_gather_bytes
+
+    name = "tp_collectives"
+    hint = ("an added/removed collective or payload dtype change must land "
+            "together with parallel/comm_stats.py")
+    spec = spec or _contract_spec()
+    if len(jax.devices()) < tp:
+        return ContractResult(
+            "J001", name, False,
+            f"needs {tp} devices, have {len(jax.devices())} — set "
+            f"--xla_force_host_platform_device_count", hint)
+    mesh = make_mesh(tp=tp, devices=jax.devices()[:tp])
+    fwd = make_sharded_forward(spec, mesh)
+    params = abstract_params(spec)
+    cache = jax.eval_shape(lambda: init_cache(spec, jnp.float32))
+    tokens = jax.ShapeDtypeStruct((1,), jnp.int32)
+    pos = jax.ShapeDtypeStruct((), jnp.int32)
+    jaxpr = jax.make_jaxpr(fwd)(params, cache, tokens, pos).jaxpr
+    colls = collect_collectives(jaxpr)
+    if not colls:
+        return ContractResult("J001", name, False,
+                              "no collectives found — jaxpr walk or "
+                              "shard_map internals changed?", hint)
+    n_expected = 4 * spec.n_layers + 1
+    n_actual = sum(m for _, _, m in colls)
+    kinds = sorted({n for n, _, _ in colls})
+    if any(not k.startswith("all_gather") for k in kinds):
+        return ContractResult(
+            "J001", name, False,
+            f"unmodeled collective kinds {kinds} in the tp forward", hint)
+    if n_actual != n_expected:
+        return ContractResult(
+            "J001", name, False,
+            f"{n_actual} all_gathers traced, analytic model says "
+            f"{n_expected} (4*{spec.n_layers} layers + logits)", hint)
+    moved = sum((tp - 1) * int(np.prod(a.shape)) * a.dtype.itemsize * m
+                for _, a, m in colls)
+    expected = ici_all_gather_bytes(spec, tp).sent_bytes
+    if moved != expected:
+        return ContractResult(
+            "J001", name, False,
+            f"traced payload {moved} B/token != analytic {expected} B",
+            hint)
+    return ContractResult(
+        "J001", name, True,
+        f"{n_actual} all_gathers, {moved} B/token/chip (tp={tp}) — "
+        f"matches comm_stats", hint)
+
+
+# -- J002: decode-step KV-cache donation -----------------------------------
+
+
+def contract_decode_donation(spec=None, slots: int = 4) -> ContractResult:
+    """Lower the continuous decode step exactly as the engine builds it
+    (jit(forward_batch_ragged, donate_argnums=1)) and verify BOTH cache
+    planes carry an input/output alias in the stablehlo — dropped donation
+    means a full cache copy per decode step."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from ..models.llama import forward_batch_ragged, init_cache_batch
+
+    name = "decode_kv_donation"
+    hint = ("keep donate_argnums=1 on the decode step and keep the output "
+            "cache aval identical to the input (aliasing needs matching "
+            "shape/dtype)")
+    spec = spec or _contract_spec()
+    step = jax.jit(functools.partial(forward_batch_ragged, spec),
+                   donate_argnums=1)
+    params = abstract_params(spec)
+    cache = jax.eval_shape(lambda: init_cache_batch(spec, slots,
+                                                    jnp.float32))
+    tokens = jax.ShapeDtypeStruct((slots,), jnp.int32)
+    pos = jax.ShapeDtypeStruct((slots,), jnp.int32)
+    lowered = step.lower(params, cache, tokens, pos)
+    n_aliased = lowered.as_text().count("tf.aliasing_output")
+    n_cache_leaves = len(jax.tree_util.tree_leaves(cache))
+    if n_aliased < n_cache_leaves:
+        return ContractResult(
+            "J002", name, False,
+            f"only {n_aliased} of {n_cache_leaves} donated cache planes "
+            f"got an input/output alias in the lowering", hint)
+    return ContractResult(
+        "J002", name, True,
+        f"{n_aliased} aliased buffers cover the {n_cache_leaves}-plane KV "
+        f"cache", hint)
+
+
+# -- J003: decode-step shape stability -------------------------------------
+
+
+def contract_decode_shape_stability(spec=None,
+                                    slots: int = 4) -> ContractResult:
+    """eval_shape the decode step and require the output cache aval tree to
+    EQUAL the input cache aval tree — the fixed point that lets the
+    engine's step loop (and the fused scan chain) reuse one compiled
+    program for every step. A widened dtype or a reshaped cache breaks the
+    fixed point and turns each decode step into a fresh compile."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from ..models.llama import forward_batch_ragged, init_cache_batch
+
+    name = "decode_shape_stability"
+    hint = ("the decode step must return the cache with the exact input "
+            "shapes/dtypes — check promotions (f32 vs bf16) on the cache "
+            "update path")
+    spec = spec or _contract_spec()
+    step = functools.partial(forward_batch_ragged, spec)
+    params = abstract_params(spec)
+    cache = jax.eval_shape(lambda: init_cache_batch(spec, slots,
+                                                    jnp.float32))
+    tokens = jax.ShapeDtypeStruct((slots,), jnp.int32)
+    pos = jax.ShapeDtypeStruct((slots,), jnp.int32)
+    logits, cache_out = jax.eval_shape(step, params, cache, tokens, pos)
+    mismatch = _aval_trees_equal(cache, cache_out)
+    if mismatch is not None:
+        return ContractResult("J003", name, False,
+                              f"cache aval drifted across one step — "
+                              f"{mismatch}", hint)
+    if tuple(logits.shape) != (slots, spec.vocab_size):
+        return ContractResult(
+            "J003", name, False,
+            f"logits aval {logits.shape} != ({slots}, {spec.vocab_size})",
+            hint)
+    return ContractResult(
+        "J003", name, True,
+        f"cache aval is a fixed point across steps (B={slots}); one "
+        f"compile serves the whole decode", hint)
+
+
+contract_tp_collectives.contract_id = "J001"
+contract_decode_donation.contract_id = "J002"
+contract_decode_shape_stability.contract_id = "J003"
+
+CONTRACTS = (contract_tp_collectives, contract_decode_donation,
+             contract_decode_shape_stability)
+
+
+def run_contracts(spec=None) -> list[ContractResult]:
+    """Run every contract; import/trace failures become failed results
+    rather than crashes (the CLI reports them and fails the run), keyed
+    by the same J-id a clean failure would carry."""
+    results = []
+    for contract in CONTRACTS:
+        try:
+            results.append(contract(spec))
+        except Exception as e:  # noqa: BLE001 - report, don't crash the CLI
+            results.append(ContractResult(
+                contract.contract_id, contract.__name__, False,
+                f"raised {type(e).__name__}: {e}",
+                "contract could not run — fix the trace error first"))
+    return results

@@ -1,0 +1,401 @@
+"""dlint engine: AST module contexts, findings, pragmas, and the baseline.
+
+The linter is pure static analysis — it never imports the modules it checks
+(a lint pass must not depend on jax being importable, and must not execute
+package side effects). Each rule in ``rules.py`` receives a ``ModuleContext``
+with the parsed AST plus the shared resolution helpers (import-alias dotted
+names, enclosing-function maps, jit-binding discovery) and yields
+``Finding``s.
+
+Suppression has two layers, serving two different needs:
+
+* **pragmas** — ``# dlint: allow[D001] reason`` on the finding line (or the
+  line above, for findings inside multi-line expressions) marks an
+  *intentional* hazard at the site itself, with the reason in the source
+  where the next editor will see it.
+* **baseline** — ``tools/dlint_baseline.txt`` grandfathers pre-existing
+  findings so CI can gate on "no NEW findings" from day one. Keys are
+  line-number-independent (rule + file + enclosing def + content hash of the
+  flagged line) so unrelated edits above a finding don't churn the file.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import hashlib
+import re
+from collections import Counter
+from pathlib import Path
+from typing import Iterator
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One lint hit. ``path`` is repo-relative posix; ``context`` is the
+    enclosing def's qualified name ("<module>" at top level); ``snippet``
+    is the stripped source line (feeds the baseline key)."""
+
+    rule: str
+    path: str
+    line: int
+    message: str
+    hint: str
+    context: str = "<module>"
+    snippet: str = ""
+
+    def key(self) -> str:
+        """Baseline identity: stable across line renumbering (uses a hash
+        of the flagged line's text, not its position)."""
+        digest = hashlib.sha1(self.snippet.encode()).hexdigest()[:12]
+        return f"{self.rule} {self.path}:{self.context} {digest}"
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}: {self.rule} {self.message}"
+                f"  [fix: {self.hint}]")
+
+
+_PRAGMA_RE = re.compile(r"#\s*dlint:\s*allow\[([A-Z0-9,\s]+)\]")
+
+
+def parse_pragmas(lines: list[str]) -> tuple[dict[int, set[str]],
+                                             dict[int, set[str]]]:
+    """(same_line, line_below) suppression maps, both 1-based.
+
+    A trailing pragma on a code line covers THAT line only; a standalone
+    comment pragma covers the line below it (for findings inside
+    multi-line expressions). Keeping the two distinct stops a trailing
+    pragma from silently blessing the next statement too."""
+    same: dict[int, set[str]] = {}
+    below: dict[int, set[str]] = {}
+    for i, text in enumerate(lines, start=1):
+        m = _PRAGMA_RE.search(text)
+        if not m:
+            continue
+        rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+        same[i] = rules
+        if text.strip().startswith("#"):  # comment-only pragma line
+            below[i + 1] = rules
+    return same, below
+
+
+class ModuleContext:
+    """Parsed module + the resolution helpers every rule needs."""
+
+    def __init__(self, path: Path, relpath: str, source: str):
+        self.path = path
+        self.relpath = relpath  # repo-relative posix ("distributed_.../x.py")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=str(path))
+        self.pragmas, self.pragmas_below = parse_pragmas(self.lines)
+        self._parents: dict[ast.AST, ast.AST] = {}
+        self._func_of: dict[ast.AST, ast.AST | None] = {}
+        self._qualname: dict[ast.AST, str] = {}
+        self.aliases = self._collect_aliases()
+        self._index_tree()
+        self.jitted_defs, self.jitted_names, self.jit_static = (
+            self._collect_jit_bindings())
+
+    # -- tree indexing -----------------------------------------------------
+
+    def _index_tree(self):
+        def walk(node, func, qual):
+            for child in ast.iter_child_nodes(node):
+                self._parents[child] = node
+                cqual, cfunc = qual, func
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.ClassDef)):
+                    cqual = f"{qual}.{child.name}" if qual else child.name
+                    if isinstance(child, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef)):
+                        cfunc = child
+                elif isinstance(child, ast.Lambda):
+                    cqual = f"{qual}.<lambda>" if qual else "<lambda>"
+                    cfunc = child
+                self._func_of[child] = cfunc
+                self._qualname[child] = cqual or "<module>"
+                walk(child, cfunc, cqual)
+
+        self._func_of[self.tree] = None
+        self._qualname[self.tree] = "<module>"
+        walk(self.tree, None, "")
+
+    def parent(self, node: ast.AST) -> ast.AST | None:
+        return self._parents.get(node)
+
+    def enclosing_function(self, node: ast.AST):
+        """Nearest enclosing FunctionDef/Lambda (None at module level).
+        For a def node itself, returns its *enclosing* function."""
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return self._func_of.get(self._parents.get(node))
+        return self._func_of.get(node)
+
+    def qualname(self, node: ast.AST) -> str:
+        """Qualified name of the def enclosing ``node`` ("<module>" at top
+        level) — the baseline context component."""
+        fn = self.enclosing_function(node)
+        if fn is None:
+            return "<module>"
+        return self._qualname.get(fn, "<module>")
+
+    def in_loop(self, node: ast.AST) -> bool:
+        """Is ``node`` lexically inside a for/while loop (within its own
+        function — loops in *enclosing* defs don't count)?"""
+        cur, func = self._parents.get(node), self.enclosing_function(node)
+        while cur is not None and cur is not func:
+            if isinstance(cur, (ast.For, ast.AsyncFor, ast.While)):
+                return True
+            cur = self._parents.get(cur)
+        return False
+
+    # -- name resolution ---------------------------------------------------
+
+    def _collect_aliases(self) -> dict[str, str]:
+        """Local name -> canonical dotted module/symbol, from every import
+        statement in the file (function-local imports included — this repo
+        imports jax lazily all over)."""
+        aliases: dict[str, str] = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    aliases[a.asname or a.name.split(".")[0]] = (
+                        a.name if a.asname else a.name.split(".")[0])
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for a in node.names:
+                    aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+        return aliases
+
+    def dotted(self, node: ast.AST) -> str | None:
+        """'np.asarray'-style dotted string for a Name/Attribute chain, with
+        the leading segment resolved through the import aliases (so
+        ``_np.asarray`` -> 'numpy.asarray', ``jnp.zeros`` ->
+        'jax.numpy.zeros'). None for non-name expressions."""
+        parts: list[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        parts.append(self.aliases.get(node.id, node.id))
+        return ".".join(reversed(parts))
+
+    def call_target(self, call: ast.Call) -> str | None:
+        return self.dotted(call.func)
+
+    def function_calls_device(self, func: ast.AST) -> bool:
+        """Does this def dispatch jax work (any jax.* / jax.numpy.* call)?
+        The D005 'around device work' gate."""
+        for node in ast.walk(func):
+            if isinstance(node, ast.Call):
+                t = self.call_target(node)
+                if t and (t == "jax" or t.startswith(("jax.", "jax.numpy."))):
+                    return True
+        return False
+
+    def function_calls(self, func: ast.AST, target: str) -> bool:
+        for node in ast.walk(func):
+            if isinstance(node, ast.Call):
+                t = self.call_target(node)
+                if t is not None and (t == target
+                                      or t.endswith("." + target)):
+                    return True
+        return False
+
+    # -- jit-binding discovery --------------------------------------------
+
+    def _is_jax_jit(self, node: ast.AST) -> bool:
+        return self.dotted(node) in ("jax.jit", "jax.jit.jit")
+
+    def _static_names_from_call(self, call: ast.Call) -> set[str]:
+        names: set[str] = set()
+        for kw in call.keywords:
+            if kw.arg == "static_argnames":
+                v = kw.value
+                vals = v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
+                for e in vals:
+                    if isinstance(e, ast.Constant) and isinstance(e.value,
+                                                                  str):
+                        names.add(e.value)
+        return names
+
+    def _collect_jit_bindings(self):
+        """Find every function the module jits.
+
+        Returns (jitted_defs, jitted_names, jit_static):
+          jitted_defs: {def node: (jit-site node, static name set)}
+          jitted_names: {local name a jitted callable is bound to: def node
+                         or None when the wrapped fn isn't a local def}
+          jit_static:  {def node: static name set} for decorated defs.
+        """
+        defs_by_name: dict[str, list[ast.FunctionDef]] = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.FunctionDef):
+                defs_by_name.setdefault(node.name, []).append(node)
+
+        jitted_defs: dict[ast.AST, tuple[ast.AST, set[str]]] = {}
+        jitted_names: dict[str, ast.AST | None] = {}
+        jit_static: dict[ast.AST, set[str]] = {}
+
+        def resolve_local_def(expr):
+            if isinstance(expr, ast.Name):
+                cands = defs_by_name.get(expr.id, [])
+                if len(cands) == 1:
+                    return cands[0]
+            if isinstance(expr, ast.Lambda):
+                return expr
+            return None
+
+        for node in ast.walk(self.tree):
+            # decorated defs: @jax.jit / @functools.partial(jax.jit, ...)
+            if isinstance(node, ast.FunctionDef):
+                for dec in node.decorator_list:
+                    static: set[str] | None = None
+                    if self._is_jax_jit(dec):
+                        static = set()
+                    elif (isinstance(dec, ast.Call)
+                          and self.dotted(dec.func) == "functools.partial"
+                          and dec.args and self._is_jax_jit(dec.args[0])):
+                        static = self._static_names_from_call(dec)
+                    elif isinstance(dec, ast.Call) and self._is_jax_jit(
+                            dec.func):
+                        static = self._static_names_from_call(dec)
+                    if static is not None:
+                        jitted_defs[node] = (dec, static)
+                        jit_static[node] = static
+                        jitted_names[node.name] = node
+            # call form: jax.jit(f, ...) — mark f, remember assigned names
+            elif isinstance(node, ast.Call) and self._is_jax_jit(node.func):
+                static = self._static_names_from_call(node)
+                target = resolve_local_def(node.args[0]) if node.args else None
+                if target is not None:
+                    jitted_defs[target] = (node, static)
+                    jit_static[target] = static
+                parent = self._parents.get(node)
+                if isinstance(parent, ast.Assign):
+                    for t in parent.targets:
+                        if isinstance(t, ast.Name):
+                            jitted_names[t.id] = target
+        return jitted_defs, jitted_names, jit_static
+
+
+# -- scanning --------------------------------------------------------------
+
+
+def iter_module_contexts(files: list[Path],
+                         rel_to: Path) -> Iterator[ModuleContext]:
+    for path in files:
+        try:
+            relpath = path.resolve().relative_to(
+                rel_to.resolve()).as_posix()
+        except ValueError:
+            relpath = path.as_posix()
+        try:
+            source = path.read_text(encoding="utf-8")
+            yield ModuleContext(path, relpath, source)
+        except (OSError, UnicodeDecodeError, SyntaxError) as e:
+            # an unreadable or unparseable input is itself a finding — a
+            # silent skip would let a typo'd path report a clean tree
+            yield relpath, e  # type: ignore[misc]  # caller branches
+
+
+def lint_paths(files: list[Path], rel_to: Path,
+               rules=None) -> list[Finding]:
+    """Run every rule over ``files``; returns pragma-filtered findings
+    sorted by (path, line, rule). ``rel_to`` anchors the repo-relative
+    paths that scoped rules (and baseline keys) match against."""
+    from . import rules as rules_mod
+
+    active = rules if rules is not None else rules_mod.RULES
+    findings: list[Finding] = []
+    for ctx in iter_module_contexts(files, rel_to):
+        if isinstance(ctx, tuple):  # (relpath, read/parse error)
+            relpath, err = ctx
+            findings.append(Finding(
+                rule="D000", path=relpath,
+                line=getattr(err, "lineno", None) or 0,
+                message=f"unreadable or unparseable: "
+                        f"{type(err).__name__}: {err}",
+                hint="fix the path or the parse error",
+                snippet=getattr(err, "text", None) or ""))
+            continue
+        for rule in active:
+            scope = getattr(rule, "scope", None)
+            if scope and not any(s in ctx.relpath for s in scope):
+                continue
+            for f in rule(ctx):
+                allowed = (ctx.pragmas.get(f.line, set())
+                           | ctx.pragmas_below.get(f.line, set()))
+                if f.rule not in allowed:
+                    findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
+def package_files(package_dir: Path) -> list[Path]:
+    """Every .py under the package — the lint surface. Probe/bench scripts
+    under tools/ and the test tree are intentionally NOT scanned (they run
+    on the host, off the serving path)."""
+    return sorted(p for p in package_dir.rglob("*.py")
+                  if "__pycache__" not in p.parts)
+
+
+# -- baseline --------------------------------------------------------------
+
+_BASELINE_LINE_RE = re.compile(
+    r"^(?P<key>\S+ \S+ [0-9a-f]{12})(?: x(?P<count>\d+))?$")
+
+
+def load_baseline(path: Path) -> Counter:
+    """Baseline file -> Counter of finding keys. Lines: ``<key>`` or
+    ``<key> xN`` for N identical findings; '#' comments and blanks skipped.
+    Missing file = empty baseline."""
+    counts: Counter = Counter()
+    if not path.exists():
+        return counts
+    for raw in path.read_text(encoding="utf-8").splitlines():
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = _BASELINE_LINE_RE.match(line)
+        if m:
+            counts[m.group("key")] += int(m.group("count") or 1)
+    return counts
+
+
+def write_baseline(path: Path, findings: list[Finding]) -> None:
+    counts = Counter(f.key() for f in findings)
+    lines = [
+        "# dlint baseline — grandfathered findings, suppressed so CI gates",
+        "# on \"no NEW findings\". Regenerate with:",
+        "#   python -m distributed_llama_tpu.analysis --lint "
+        "--write-baseline",
+        "# Key: <rule> <path>:<enclosing def> <sha1[:12] of the flagged "
+        "line>; xN = count.",
+        "",
+    ]
+    for key in sorted(counts):
+        n = counts[key]
+        lines.append(key if n == 1 else f"{key} x{n}")
+    path.write_text("\n".join(lines) + "\n", encoding="utf-8")
+
+
+def apply_baseline(findings: list[Finding],
+                   baseline: Counter) -> tuple[list[Finding], int, list[str]]:
+    """Split findings into (new, n_suppressed, stale_keys). The first N
+    findings matching a baseline key (in file order) are suppressed; any
+    extra occurrence is NEW. Baseline keys with no current match are stale
+    (fixed since the baseline was written) and should be pruned."""
+    budget = Counter(baseline)
+    new: list[Finding] = []
+    suppressed = 0
+    for f in findings:
+        k = f.key()
+        if budget.get(k, 0) > 0:
+            budget[k] -= 1
+            suppressed += 1
+        else:
+            new.append(f)
+    stale = sorted(k for k, n in budget.items() if n > 0)
+    return new, suppressed, stale

@@ -310,7 +310,7 @@ def cmd_inference(argv: list[str], quiet: bool = False) -> int:
         tp = args.tp or 1
     else:
         tp = args.tp or max(1, n_dev // args.sp)
-    t0 = time.time()
+    t0 = time.perf_counter()
     if tp > 1 or args.sp > 1:
         # mesh runs keep the codec tree: tp-aware packing happens in
         # parallel/tp.shard_params (the single-chip nb-major layout is
@@ -393,7 +393,7 @@ def cmd_inference(argv: list[str], quiet: bool = False) -> int:
     engine = Engine(spec, params, mesh=mesh, cache_dtype=cache_dtype,
                     fast_prefill=args.fast_prefill)
     if not quiet:
-        print(f"⏩ Loaded model in {time.time() - t0:.1f}s")
+        print(f"⏩ Loaded model in {time.perf_counter() - t0:.1f}s")
 
     tokenizer = Tokenizer(args.tokenizer, spec.vocab_size)
     seed = args.seed if args.seed is not None else int(time.time())

@@ -216,6 +216,7 @@ def make_decode_loop_aot(step_fn: StepFn, max_steps: int,
 
     def compile_and_place(params_host, *rest):
         def sds(a):
+            # dlint: allow[D001] host-tree leaves only — shape/dtype probe
             a = np.asarray(a) if not hasattr(a, "dtype") else a
             return jax.ShapeDtypeStruct(a.shape, a.dtype)
 
@@ -278,8 +279,9 @@ def _touch_async(placed):
             # leaves have no axis to slice ((0,)*-1 == () then [:1] fails
             # on a scalar) and nothing worth overlapping — read directly.
             if a.ndim == 0:
-                np.asarray(a)
+                np.asarray(a)  # dlint: allow[D001] the sync IS the point
             else:
+                # dlint: allow[D001] upload touch — blocking is the point
                 np.asarray(a[(0,) * (a.ndim - 1)][:1])
         except Exception as e:  # noqa: BLE001 - overlap is best-effort
             import sys

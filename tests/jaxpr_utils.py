@@ -1,33 +1,8 @@
-"""Shared jaxpr introspection for structure-pinning tests.
+"""Back-compat shim: the jaxpr introspection helpers moved into the
+package as ``distributed_llama_tpu.analysis.jaxpr_contracts`` (the dlint
+contract head uses them at CLI time, not just under pytest). Import from
+there; this shim keeps old `from jaxpr_utils import ...` call sites
+working."""
 
-The recursion duck-types on JAX internals (eqn params that hold Jaxpr /
-ClosedJaxpr values), which can break quietly on a JAX upgrade — keeping ONE
-copy means a breakage shows up everywhere at once instead of leaving a
-vacuously-passing twin behind. The self-check below turns "yields nothing"
-into a loud failure.
-"""
-
-from __future__ import annotations
-
-
-def walk_eqns(jaxpr):
-    """Yield every eqn in a jaxpr, recursing into sub-jaxprs (shard_map,
-    scan, while, cond bodies)."""
-    for eqn in jaxpr.eqns:
-        yield eqn
-        for v in eqn.params.values():
-            inner = getattr(v, "jaxpr", None)
-            if hasattr(v, "eqns"):
-                yield from walk_eqns(v)
-            elif inner is not None and hasattr(inner, "eqns"):
-                yield from walk_eqns(inner)
-
-
-def walk_fn_eqns(fn, *args):
-    """walk_eqns over jax.make_jaxpr(fn)(*args); asserts non-empty so an
-    internal-API drift can't silently yield zero eqns."""
-    import jax
-
-    eqns = list(walk_eqns(jax.make_jaxpr(fn)(*args).jaxpr))
-    assert eqns, "jaxpr walk yielded nothing — JAX internals changed?"
-    return eqns
+from distributed_llama_tpu.analysis.jaxpr_contracts import (  # noqa: F401
+    walk_eqns, walk_fn_eqns)

@@ -1,0 +1,372 @@
+"""dlint rule fixtures: every rule gets a firing AND a non-firing snippet,
+plus pragma suppression and the baseline add/remove round-trip.
+
+Fixture modules are written under a fake package layout (tmp/runtime/...,
+tmp/ops/...) so the per-rule path scoping is exercised exactly as it is on
+the real tree. The linter is pure AST — none of these snippets is ever
+imported or executed."""
+
+from __future__ import annotations
+
+import textwrap
+from pathlib import Path
+
+from distributed_llama_tpu.analysis.lint import (Finding, apply_baseline,
+                                                 lint_paths, load_baseline,
+                                                 write_baseline)
+
+
+def run_on(tmp_path: Path, rel: str, source: str, rules=None):
+    path = tmp_path / rel
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(source), encoding="utf-8")
+    return lint_paths([path], tmp_path, rules=rules)
+
+
+def rules_fired(findings):
+    return {f.rule for f in findings}
+
+
+# -- D001: implicit device->host sync --------------------------------------
+
+
+def test_d001_fires_on_sync_calls_in_hot_path(tmp_path):
+    findings = run_on(tmp_path, "runtime/hot.py", """
+        import numpy as np
+        import jax
+        import jax.numpy as jnp
+
+        def step(fwd, params, cache, tok):
+            logits, cache = fwd(params, cache, tok)
+            host = np.asarray(logits)          # sync
+            jax.block_until_ready(cache)       # sync
+            n = logits.sum().item()            # sync
+            f = float(jnp.max(logits))         # sync
+            return host, n, f
+    """)
+    d001 = [f for f in findings if f.rule == "D001"]
+    assert len(d001) == 4, findings
+    assert {f.line for f in d001} == {8, 9, 10, 11}
+    assert all(f.context == "step" for f in d001)
+
+
+def test_d001_ignores_host_literals_and_cold_modules(tmp_path):
+    quiet = """
+        import numpy as np
+
+        def stage(pool):
+            a = np.asarray([s.token for s in pool])   # host list comp
+            b = np.asarray((1, 2, 3))                 # host literal
+            return a, b
+    """
+    assert run_on(tmp_path, "runtime/hot.py", quiet) == []
+    # same device->host syncs OUTSIDE the hot-path scope: not D001's beat
+    loud = """
+        import numpy as np
+
+        def dump(x):
+            return np.asarray(x)
+    """
+    assert run_on(tmp_path, "frontend/cold.py", loud) == []
+
+
+def test_d001_pragma_suppresses_with_reason(tmp_path):
+    findings = run_on(tmp_path, "runtime/hot.py", """
+        import numpy as np
+
+        def step(logits, acts):
+            out = np.asarray(logits)  # dlint: allow[D001] host sampler input
+            # dlint: allow[D001] pragma on the line above also works
+            keep = np.asarray(acts)
+            return out, keep
+    """)
+    assert findings == []
+
+
+def test_trailing_pragma_does_not_bless_the_next_line(tmp_path):
+    # a pragma trailing a CODE line covers that line only; only a
+    # standalone comment pragma covers the line below it
+    findings = run_on(tmp_path, "runtime/hot.py", """
+        import numpy as np
+
+        def step(logits, acts):
+            a = np.asarray(logits)  # dlint: allow[D001] intentional
+            b = np.asarray(acts)
+            return a, b
+    """)
+    assert [f.line for f in findings] == [6]
+
+
+def test_unreadable_path_is_a_finding_not_a_clean_exit(tmp_path):
+    from distributed_llama_tpu.analysis.lint import lint_paths
+
+    findings = lint_paths([tmp_path / "runtime"], tmp_path)  # a directory
+    assert [f.rule for f in findings] == ["D000"]
+
+
+def test_pragma_suppresses_only_the_named_rule(tmp_path):
+    findings = run_on(tmp_path, "runtime/hot.py", """
+        import numpy as np
+
+        def step(logits):
+            return np.asarray(logits)  # dlint: allow[D999] wrong id
+    """)
+    assert rules_fired(findings) == {"D001"}
+
+
+# -- D002: retrace traps ----------------------------------------------------
+
+
+def test_d002_fires_on_unknown_static_argname(tmp_path):
+    findings = run_on(tmp_path, "ops/kern.py", """
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, static_argnames=("interpert",))
+        def kernel(x, interpret=False):
+            return x
+    """)
+    assert rules_fired(findings) == {"D002"}
+    assert "interpert" in findings[0].message
+
+
+def test_d002_fires_on_literal_into_traced_param(tmp_path):
+    findings = run_on(tmp_path, "ops/kern.py", """
+        import jax
+
+        def f(x, mode):
+            return x
+
+        g = jax.jit(f)
+
+        def caller(x):
+            return g(x, "fast")
+    """)
+    assert rules_fired(findings) == {"D002"}
+    assert "'mode'" in findings[0].message
+
+
+def test_d002_quiet_when_static_names_match(tmp_path):
+    findings = run_on(tmp_path, "ops/kern.py", """
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, static_argnames=("interpret",))
+        def kernel(x, interpret=False):
+            return x
+
+        def caller(x):
+            return kernel(x, interpret=True)
+    """)
+    assert findings == []
+
+
+# -- D003: jit closure hygiene ----------------------------------------------
+
+
+def test_d003_fires_on_self_closure_and_mutable_global(tmp_path):
+    findings = run_on(tmp_path, "runtime/eng.py", """
+        import jax
+
+        _CACHE = {}
+
+        class Engine:
+            def build(self):
+                def step(tok):
+                    return self.params[tok] + len(_CACHE)
+                return jax.jit(step)
+    """)
+    d003 = [f for f in findings if f.rule == "D003"]
+    assert len(d003) == 2
+    assert any("self.params" in f.message for f in d003)
+    assert any("_CACHE" in f.message for f in d003)
+
+
+def test_d003_quiet_when_state_is_hoisted_to_locals(tmp_path):
+    findings = run_on(tmp_path, "runtime/eng.py", """
+        import jax
+
+        class Engine:
+            def build(self):
+                params = self.params  # hoisted OUTSIDE the jitted fn
+
+                def step(tok):
+                    return params[tok]
+                return jax.jit(step)
+    """)
+    assert [f for f in findings if f.rule == "D003"] == []
+
+
+# -- D004: per-step host list materialization -------------------------------
+
+
+def test_d004_fires_in_step_functions_and_loops(tmp_path):
+    findings = run_on(tmp_path, "runtime/eng.py", """
+        import jax.numpy as jnp
+
+        class Engine:
+            def step_once(self, pool):
+                toks = [s.token for s in pool]
+                a = jnp.asarray(toks)                       # named comp
+                b = jnp.asarray([s.pos for s in pool])      # inline comp
+                return a, b
+
+        def outer(chunks):
+            for c in chunks:
+                yield jnp.asarray([x + 1 for x in c])       # comp in loop
+    """)
+    d004 = [f for f in findings if f.rule == "D004"]
+    assert len(d004) == 3, findings
+
+
+def test_d004_quiet_on_staged_upload_and_cold_functions(tmp_path):
+    findings = run_on(tmp_path, "runtime/eng.py", """
+        import numpy as np
+        import jax.numpy as jnp
+
+        class Engine:
+            def step_once(self, pool):
+                st = self._stage
+                for b, s in enumerate(pool):
+                    st[0, b] = s.token
+                return jnp.asarray(st)          # ndarray upload: fine
+
+        def build_once(prompts):
+            # one-time setup, not a step function, not in a loop
+            return jnp.asarray([p[0] for p in prompts])
+    """)
+    assert [f for f in findings if f.rule == "D004"] == []
+
+
+# -- D005: time.time() around device work -----------------------------------
+
+
+def test_d005_fires_on_unsynced_time_time_delta(tmp_path):
+    findings = run_on(tmp_path, "runtime/bench.py", """
+        import time
+        import jax.numpy as jnp
+
+        def bench(fn, x):
+            t0 = time.time()
+            y = jnp.dot(x, x)
+            return y, time.time() - t0
+    """)
+    assert rules_fired(findings) == {"D005"}
+
+
+def test_d005_quiet_with_sync_or_without_device_work(tmp_path):
+    synced = """
+        import time
+        import jax
+        import jax.numpy as jnp
+
+        def bench(fn, x):
+            t0 = time.time()
+            y = jax.block_until_ready(jnp.dot(x, x))
+            return y, time.time() - t0
+    """
+    # (the explicit block_until_ready in a hot-path dir still fires D001 —
+    # by design, an intentional sync needs its allow-pragma; D005 is quiet)
+    assert "D005" not in rules_fired(
+        run_on(tmp_path, "runtime/bench.py", synced))
+    host_only = """
+        import time
+
+        def wait(deadline):
+            return deadline - time.time()
+    """
+    assert run_on(tmp_path, "io/net.py", host_only) == []
+    seed_not_delta = """
+        import time
+        import jax.numpy as jnp
+
+        def seeded(x):
+            return jnp.sum(x) + int(time.time())
+    """
+    assert run_on(tmp_path, "runtime/bench.py", seed_not_delta) == []
+    nested_host_helper = """
+        import time
+        import jax.numpy as jnp
+
+        def outer(x, deadline):
+            def remaining():
+                return deadline - time.time()   # host timeout math only
+            y = jnp.dot(x, x)
+            return y, remaining()
+    """
+    assert run_on(tmp_path, "runtime/bench.py", nested_host_helper) == []
+
+
+def test_d005_nested_qualifying_fn_reported_once(tmp_path):
+    findings = run_on(tmp_path, "runtime/bench.py", """
+        import time
+        import jax.numpy as jnp
+
+        def outer(x):
+            def inner(z):
+                t0 = time.time()
+                w = jnp.dot(z, z)
+                return w, time.time() - t0
+            return jnp.sum(x), inner(x)
+    """)
+    d005 = [f for f in findings if f.rule == "D005"]
+    assert len(d005) == 1, findings  # inner's delta, exactly once
+
+
+# -- baseline round-trip ----------------------------------------------------
+
+
+def _mk(rule, path, ctx, snippet):
+    return Finding(rule=rule, path=path, line=1, message="m", hint="h",
+                   context=ctx, snippet=snippet)
+
+
+def test_baseline_round_trip_add_and_remove(tmp_path):
+    f1 = _mk("D001", "ops/a.py", "f", "np.asarray(x)")
+    f2 = _mk("D001", "ops/a.py", "f", "np.asarray(y)")
+    base = tmp_path / "baseline.txt"
+    write_baseline(base, [f1, f2])
+    loaded = load_baseline(base)
+    assert sum(loaded.values()) == 2
+
+    # unchanged findings: all suppressed, nothing new, nothing stale
+    new, suppressed, stale = apply_baseline([f1, f2], loaded)
+    assert (new, suppressed, stale) == ([], 2, [])
+
+    # a NEW finding is reported even though siblings are grandfathered
+    f3 = _mk("D004", "runtime/b.py", "step", "jnp.asarray([t for t in p])")
+    new, suppressed, stale = apply_baseline([f1, f2, f3], loaded)
+    assert new == [f3] and suppressed == 2 and stale == []
+
+    # a FIXED finding leaves a stale key (prompting a baseline rewrite)
+    new, suppressed, stale = apply_baseline([f1], loaded)
+    assert new == [] and suppressed == 1 and len(stale) == 1
+
+    # rewrite round-trips to the shrunken set
+    write_baseline(base, [f1])
+    assert sum(load_baseline(base).values()) == 1
+
+
+def test_baseline_counts_identical_findings(tmp_path):
+    # two hits with the SAME key (same line text, same context) must both
+    # be representable — the xN syntax
+    f = _mk("D001", "ops/a.py", "pack", "np.asarray(w.qs_t)")
+    base = tmp_path / "baseline.txt"
+    write_baseline(base, [f, f])
+    loaded = load_baseline(base)
+    assert loaded[f.key()] == 2
+    new, suppressed, _ = apply_baseline([f, f, f], loaded)
+    assert suppressed == 2 and len(new) == 1
+
+
+def test_baseline_key_survives_line_renumbering(tmp_path):
+    a = _mk("D001", "ops/a.py", "f", "np.asarray(x)")
+    b = Finding(rule="D001", path="ops/a.py", line=99, message="m",
+                hint="h", context="f", snippet="np.asarray(x)")
+    assert a.key() == b.key()
+
+
+def test_line_number_is_not_part_of_identity_but_path_is(tmp_path):
+    a = _mk("D001", "ops/a.py", "f", "np.asarray(x)")
+    c = _mk("D001", "ops/b.py", "f", "np.asarray(x)")
+    assert a.key() != c.key()

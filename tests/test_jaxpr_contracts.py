@@ -1,0 +1,66 @@
+"""Jaxpr contract head: pin collective count + KV donation + shape
+stability on the tiny synth model, under JAX_PLATFORMS=cpu (conftest).
+
+These run the SAME contract functions the `--contracts` CLI head runs, so
+a contract that drifts fails here first — in tier-1, before any bench run
+could notice the regression the slow way."""
+
+from __future__ import annotations
+
+from distributed_llama_tpu.analysis.jaxpr_contracts import (
+    contract_decode_donation, contract_decode_shape_stability,
+    contract_tp_collectives, run_contracts, walk_fn_eqns)
+from distributed_llama_tpu.models.synth import small_bench_spec
+from distributed_llama_tpu.ops.quants import FloatType
+
+
+def _spec():
+    return small_bench_spec(weights_float_type=FloatType.F32)
+
+
+def test_tp_collectives_match_analytic_model():
+    r = contract_tp_collectives(_spec(), tp=4)
+    assert r.ok, r.detail
+    # the count is part of the public claim: 4 all_gathers/layer + logits
+    n = 4 * _spec().n_layers + 1
+    assert f"{n} all_gathers" in r.detail
+
+
+def test_decode_step_kv_cache_donation_holds():
+    r = contract_decode_donation(_spec(), slots=4)
+    assert r.ok, r.detail
+    assert "2 aliased" in r.detail  # both KV planes, not just one
+
+
+def test_decode_step_shape_stability_holds():
+    r = contract_decode_shape_stability(_spec(), slots=4)
+    assert r.ok, r.detail
+
+
+def test_run_contracts_reports_all_and_passes():
+    results = run_contracts(_spec())
+    assert [r.contract for r in results] == ["J001", "J002", "J003"]
+    assert all(r.ok for r in results), [r.detail for r in results]
+
+
+def test_contract_failure_becomes_finding_not_crash():
+    # a spec that cannot shard onto the mesh must yield a failed result
+    # (the CLI turns it into a finding), never an exception
+    bad = small_bench_spec(weights_float_type=FloatType.F32,
+                           vocab_size=1023)  # 1023 % tp != 0
+    results = run_contracts(bad)
+    assert any(not r.ok for r in results)
+    # even on a raised error, results keep the documented J-ids (the CLI
+    # and contract_findings key on them)
+    assert [r.contract for r in results] == ["J001", "J002", "J003"]
+
+
+def test_walk_fn_eqns_shim_still_works():
+    # the tests/jaxpr_utils.py re-export shim keeps old callers alive
+    import jax.numpy as jnp
+
+    from jaxpr_utils import walk_fn_eqns as shimmed
+
+    assert shimmed is walk_fn_eqns
+    eqns = shimmed(lambda x: jnp.sin(x) + 1.0, jnp.zeros((4,)))
+    assert any(e.primitive.name == "sin" for e in eqns)

@@ -175,7 +175,7 @@ def test_fused_kernels_cut_op_count(monkeypatch):
     """The point of the fusion: the per-layer program collapses to the two
     fused pallas_calls (+ attention). Count custom_call/pallas eqns in the
     jaxpr's scan body."""
-    from jaxpr_utils import walk_fn_eqns
+    from distributed_llama_tpu.analysis.jaxpr_contracts import walk_fn_eqns
 
     from distributed_llama_tpu.models.llama import forward, init_cache
 
@@ -246,7 +246,7 @@ def test_mega_one_op_per_layer(monkeypatch):
     megakernel) in its layer scan body."""
     import functools
 
-    from jaxpr_utils import walk_fn_eqns
+    from distributed_llama_tpu.analysis.jaxpr_contracts import walk_fn_eqns
 
     from distributed_llama_tpu.models.llama import forward, init_cache
 

@@ -594,7 +594,7 @@ def test_i4_decode_chain_parity(monkeypatch):
     # prove the i4 program actually traces: the conversion must appear
     # in the jaxpr of the enabled arm
     from distributed_llama_tpu.runtime.decode import _make_decode_run
-    from tests.jaxpr_utils import walk_fn_eqns
+    from distributed_llama_tpu.analysis.jaxpr_contracts import walk_fn_eqns
 
     padded = jnp.full((13,), -1, jnp.int32).at[0].set(1)
     eqns = walk_fn_eqns(

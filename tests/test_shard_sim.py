@@ -42,7 +42,7 @@ def test_sim_tp1_equals_single_chip_forward():
 
 
 def _dot_shapes(fn, *args):
-    from jaxpr_utils import walk_fn_eqns
+    from distributed_llama_tpu.analysis.jaxpr_contracts import walk_fn_eqns
 
     return sorted(tuple(tuple(v.aval.shape) for v in e.invars)
                   for e in walk_fn_eqns(fn, *args)

@@ -142,8 +142,8 @@ def test_engine_rejects_indivisible_before_device_put():
 
 def _all_gather_dtypes(fn, *args):
     """X-ray what the collectives actually carry (shared walker:
-    tests/jaxpr_utils.py)."""
-    from jaxpr_utils import walk_fn_eqns
+    analysis/jaxpr_contracts.py)."""
+    from distributed_llama_tpu.analysis.jaxpr_contracts import walk_fn_eqns
 
     return sorted(str(e.invars[0].aval.dtype) for e in walk_fn_eqns(fn, *args)
                   if e.primitive.name == "all_gather")

@@ -5,8 +5,16 @@
 # (pytest.ini); this script is the complete gate: run it before landing
 # changes to the parallel/runtime layers. ~18 min on an 8-core box.
 #
+# Static analysis runs FIRST: the dlint lint head (tools/dlint.py, also
+# `python -m distributed_llama_tpu.analysis`) fails the gate on any finding
+# not grandfathered in tools/dlint_baseline.txt — a new implicit sync or
+# retrace trap stops the build before 18 minutes of tests do. (The jaxpr
+# contract head runs inside the suite, tests/test_jaxpr_contracts.py;
+# tools/ probe scripts are outside the lint surface by design.)
+#
 # Usage: tools/ci.sh [extra pytest args]
 set -eu
 cd "$(dirname "$0")/.."
+python -m distributed_llama_tpu.analysis --lint
 exec python -m pytest tests/ -q -n "${CI_SHARDS:-8}" \
     -m "slow or not slow" "$@"

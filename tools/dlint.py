@@ -1,0 +1,15 @@
+#!/usr/bin/env python
+"""Alias for ``python -m distributed_llama_tpu.analysis`` — see that
+module's --help. Lives in tools/ so `tools/dlint.py --all` works from a
+checkout without installing the package."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir))
+
+from distributed_llama_tpu.analysis.__main__ import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())
