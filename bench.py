@@ -90,11 +90,15 @@ def _tree_shapes_cached(spec, rank_tp: int, build, build_sig: str = ""):
     # kwargs (e.g. the 70b rank tree's embed_dtype) change leaf
     # shapes/dtypes
     from distributed_llama_tpu.ops.pallas_q40 import q40_i4_enabled
+    from distributed_llama_tpu.parallel.comm_stats import tp_scheme
 
+    # tp scheme is in the key: the fused scheme's rank trees slice wo/w2
+    # along the INPUT dim, so a warm ref-scheme manifest has wrong shapes
     key = hashlib.sha256(
-        f"v3|{spec!r}|{rank_tp}|{q40_kernel_mode()}|{fusion_cache_key()}"
+        f"v4|{spec!r}|{rank_tp}|{q40_kernel_mode()}|{fusion_cache_key()}"
         f"|{_matvec_cap()}|i4={q40_i4_enabled()}"
-        f"|nbm={os.environ.get('DLLAMA_NB_MAJOR', '')}|{build_sig}"
+        f"|nbm={os.environ.get('DLLAMA_NB_MAJOR', '')}"
+        f"|tpscheme={tp_scheme()}|{build_sig}"
         .encode()).hexdigest()[:16]
     path = os.path.join(default_cache_dir(), "shapes", f"tree_{key}.pkl")
     if os.environ.get("DLLAMA_SHAPE_CACHE", "1") != "0" \
@@ -135,6 +139,47 @@ def _tree_shapes_cached(spec, rank_tp: int, build, build_sig: str = ""):
     return tree
 
 
+def _env_fingerprint() -> dict:
+    """Session fingerprint recorded with every row (bench drift defense,
+    ISSUE 3): the BASELINE note concedes ±5-8% drift across sessions on
+    the tunneled runtime — pinning the jax/runtime versions, the chip
+    kind, and the clock source makes rows from different sessions
+    comparable (or visibly not)."""
+    import jax
+
+    try:
+        import importlib.metadata as _md
+
+        jaxlib_v = _md.version("jaxlib")
+    except Exception:  # noqa: BLE001 - fingerprint is best-effort
+        jaxlib_v = getattr(jax.lib, "__version__", "")
+    d = jax.devices()[0]
+    clock = time.get_clock_info("perf_counter")
+    return {
+        "jax": jax.__version__,
+        "jaxlib": jaxlib_v,
+        "backend": d.platform,
+        "device_kind": getattr(d, "device_kind", ""),
+        "n_devices": len(jax.devices()),
+        "clock": clock.implementation,
+        "clock_resolution_s": clock.resolution,
+    }
+
+
+def _bench_trials() -> int:
+    """Timed-chain repeat count (median-of-N; N recorded in the row and
+    printed next to the number). DLLAMA_BENCH_TRIALS overrides the
+    default 3 — raise it when chasing the documented session drift."""
+    raw = os.environ.get("DLLAMA_BENCH_TRIALS", "3")
+    try:
+        n = int(raw)
+    except ValueError:
+        raise SystemExit(f"DLLAMA_BENCH_TRIALS={raw!r}: expected an int")
+    if n < 1:
+        raise SystemExit(f"DLLAMA_BENCH_TRIALS must be >= 1, got {n}")
+    return n
+
+
 def _record_latency(times_ms) -> None:
     """Row-JSON latency summary — the SAME p50/p95/p99 shape the serving
     metrics report (/health, generate()'s final line), via
@@ -173,7 +218,7 @@ def _bench(spec, params, samples: int, per_step: bool = False,
     # attribution/layout with attempt 3's timing
     for k in ("it_split", "op_ms_per_token", "q40_layout",
               "rank_layout_caveat", "startup_to_first_token_s",
-              "latency_ms"):
+              "latency_ms", "trials"):
         _STARTUP.pop(k, None)
 
     cache_dtype = (jnp.bfloat16 if os.environ.get("DLLAMA_BENCH_KV_BF16")
@@ -192,11 +237,14 @@ def _bench(spec, params, samples: int, per_step: bool = False,
         # nb-major is legal on any UNSHARDED tree; rank band trees are
         # local by construction (shard_sim runs them as plain jit, not
         # shard_map), and the pad-ratio gate (>1.25) decides per leaf.
-        # Rank bands slice the OUTPUT dim only (shard_sim.synth_rank_q40),
-        # so each band keeps the whole model's input dim and pad ratio:
-        # 7B/70B shapes (nb 128/344/256...) pad <=1.19 and keep d-major
-        # everywhere; 13B's nb=160 leaves (wq..wo, w1/w3, wcls, pad 1.6x)
-        # switch to nb-major while its w2 (nb=432, 1.19x) stays d-major
+        # Under the ref scheme rank bands slice the OUTPUT dim only
+        # (shard_sim.synth_rank_q40), so each band keeps the whole model's
+        # input dim and pad ratio: 7B/70B shapes (nb 128/344/256...) pad
+        # <=1.19 and keep d-major everywhere; 13B's nb=160 leaves (wq..wo,
+        # w1/w3, wcls, pad 1.6x) switch to nb-major while its w2 (nb=432,
+        # 1.19x) stays d-major. The fused scheme's wo/w2 bands slice the
+        # INPUT dim (nb/S), which can move their pad ratio — the layout
+        # the program actually ran is recorded in the row JSON either way
         hp = fuse_q40_layer_matmuls(pack_q40_params(p, allow_nb_major=True))
         # DLLAMA_Q40_I4=on needs NO host prep: the chain converts u8
         # nb-major leaves to int4 planes in-program (chain_weight_prep) —
@@ -388,7 +436,8 @@ def _bench(spec, params, samples: int, per_step: bool = False,
 
     times = []
     executed = samples
-    for _ in range(3):
+    n_trials = _bench_trials()
+    for _ in range(n_trials):
         t0 = time.perf_counter()
         toks, _ = run(*args())
         toks = np.asarray(toks)
@@ -399,7 +448,9 @@ def _bench(spec, params, samples: int, per_step: bool = False,
         executed = int(bos[0]) + 1 if len(bos) else samples
         times.append(elapsed_ms / executed)
     ms = float(np.median(times))
-    print(f"fused-loop per-token ms: {ms:.2f} ({executed} steps/chain"
+    _STARTUP["trials"] = n_trials
+    print(f"fused-loop per-token ms: {ms:.2f} (median of {n_trials} timed "
+          f"chains, {executed} steps/chain"
           + ("" if executed == samples else f" — BOS-terminated early of "
              f"{samples}")
           + f", trials {[round(t, 2) for t in times]})", file=sys.stderr)
@@ -413,38 +464,47 @@ def _bench(spec, params, samples: int, per_step: bool = False,
 def _project_tp(spec, rank_tp: int, ms: float, baseline: float) -> dict:
     """Projection fields for any measured-rank config (70b-tp8 and the
     7b/13b scaling rows): measured rank compute + modeled ICI, under
-    BOTH buffer modes (f32 gathers vs the packed Q80 wire) plus a latency
-    sensitivity row (VERDICT r2 #4 asked for both to be printed — the
-    per-collective latency constant is asserted from published
-    microbenchmarks, unmeasurable on one chip, so the JSON carries how the
-    projection moves if it is 10x worse). The headline value stays the f32
-    (reference-parity buffer) projection. The Q80 row reuses the f32-mode
-    shard measurement: the wire pack/unpack is elementwise glue the rank
-    step would fuse, a second-order term vs the 13:1 latency:bandwidth
-    split it halves.
+    BOTH buffer modes (f32 gathers vs the packed Q80 wire), under BOTH
+    tp schemes (the active scheme carries the headline; the ref scheme
+    rides along as the parity anchor against the reference binaries),
+    plus a latency sensitivity row (VERDICT r2 #4 asked for both to be
+    printed — the per-collective latency constant is asserted from
+    published microbenchmarks, unmeasurable on one chip, so the JSON
+    carries how the projection moves if it is 10x worse). The headline
+    value stays the f32 (reference-parity buffer) projection. The Q80 row
+    reuses the f32-mode shard measurement: the wire pack/unpack is
+    elementwise glue the rank step would fuse, a second-order term vs the
+    13:1 latency:bandwidth split. The cross-scheme rows reuse the active
+    scheme's shard measurement too — the FLOPs are identical, only the
+    wo/w2 band orientation differs (recorded in the note).
     """
     import dataclasses as _dc
 
     from distributed_llama_tpu.ops.quants import FloatType
+    from distributed_llama_tpu.parallel.comm_stats import SCHEMES, tp_scheme
     from distributed_llama_tpu.parallel.shard_sim import (
         ICI_COLLECTIVE_LATENCY_US, V5E_ICI_GBPS_PER_DIRECTION,
         project_full_system)
 
+    scheme = tp_scheme()
     spec80 = _dc.replace(spec, buffer_float_type=FloatType.Q80)
-    proj = project_full_system(spec, rank_tp, ms)
-    proj80 = project_full_system(spec80, rank_tp, ms)
+    by_scheme = {s: project_full_system(spec, rank_tp, ms, scheme=s)
+                 for s in SCHEMES}
+    proj = by_scheme[scheme]  # the headline IS the active scheme's row
+    proj80 = project_full_system(spec80, rank_tp, ms, scheme=scheme)
     lat10 = {
         "f32_total_ms": round(project_full_system(
-            spec, rank_tp, ms,
+            spec, rank_tp, ms, scheme=scheme,
             latency_us=10 * ICI_COLLECTIVE_LATENCY_US).total_ms, 3),
         "q80_total_ms": round(project_full_system(
-            spec80, rank_tp, ms,
+            spec80, rank_tp, ms, scheme=scheme,
             latency_us=10 * ICI_COLLECTIVE_LATENCY_US).total_ms, 3),
     }
-    for name, p in (("f32 buffers", proj), ("q80 wire   ", proj80)):
-        print(f"collective budget [{name}] (tp={rank_tp}, per token): "
+    for label, p in ([(f"{s:<5} f32", by_scheme[s]) for s in SCHEMES]
+                     + [(f"{scheme} q80", proj80)]):
+        print(f"collective budget [{label}] (tp={rank_tp}, per token): "
               f"{p.gather_bytes_per_chip / 1024:.0f} kB/chip over "
-              f"{p.n_collectives} all_gathers -> "
+              f"{p.n_collectives} collectives -> "
               f"{p.ici_bandwidth_ms:.3f} ms bandwidth "
               f"(@{V5E_ICI_GBPS_PER_DIRECTION:.0f} GB/s/chip ring) + "
               f"{p.ici_latency_ms:.3f} ms latency "
@@ -453,7 +513,7 @@ def _project_tp(spec, rank_tp: int, ms: float, baseline: float) -> dict:
               f"-> projected v5e-8 total {p.total_ms:.3f} ms/token "
               f"(no-overlap sum)", file=sys.stderr)
     print(f"latency sensitivity (x10 -> "
-          f"{10 * ICI_COLLECTIVE_LATENCY_US:.0f} us/hop): "
+          f"{10 * ICI_COLLECTIVE_LATENCY_US:.0f} us/hop, {scheme}): "
           f"f32 {lat10['f32_total_ms']:.3f} ms, "
           f"q80 {lat10['q80_total_ms']:.3f} ms"
           + (" (bar: 48.4 ms)" if spec.n_layers == 80 else ""),
@@ -470,9 +530,17 @@ def _project_tp(spec, rank_tp: int, ms: float, baseline: float) -> dict:
             "n_collectives_per_token": p.n_collectives,
         }
 
+    schemes_out = {s: row(p) for s, p in by_scheme.items()}
+    schemes_out["ref"]["note"] = ("parity anchor: the reference's "
+                                  "4-gather MatmulSlice schedule")
+    if scheme != "ref":
+        schemes_out[scheme]["note"] = (
+            "rank compute measured under this scheme's band layout; other "
+            "schemes reuse it (identical FLOPs, different wo/w2 bands)")
     return {
         "value": round(proj.total_ms, 3),
         "vs_baseline": round(baseline / proj.total_ms, 2),
+        "tp_scheme": scheme,
         "shard_ms_measured": round(proj.shard_ms, 3),
         "ici_bandwidth_ms_modeled": round(proj.ici_bandwidth_ms, 3),
         "ici_latency_ms_modeled": round(proj.ici_latency_ms, 3),
@@ -480,6 +548,7 @@ def _project_tp(spec, rank_tp: int, ms: float, baseline: float) -> dict:
             round(proj.gather_bytes_per_chip / 1024, 1),
         "n_collectives_per_token": proj.n_collectives,
         "buffer_modes": {"f32": row(proj), "q80_wire": row(proj80)},
+        "schemes_f32": schemes_out,
         "ici_latency_sensitivity_10x": lat10,
     }
 
@@ -874,12 +943,15 @@ def main():
     }
     # the reference benchmark line carries socket kB/token; ours carries the
     # analytic per-chip ICI collective bytes (parallel/comm_stats) — 0/0 on
-    # a single chip, the per-rank all_gather budget on tp rows
+    # a single chip, the per-rank collective budget on tp rows (under the
+    # active DLLAMA_TP_SCHEME)
     from distributed_llama_tpu.parallel.comm_stats import ici_all_gather_bytes
 
     comm = ici_all_gather_bytes(spec, rank_tp or 1)
     result["ici_bytes_per_token"] = {"sent": comm.sent_bytes,
                                      "recv": comm.recv_bytes}
+    # session drift defense (ISSUE 3): every row says where it was measured
+    result["env_fingerprint"] = _env_fingerprint()
     if rank_tp:
         result.update(_project_tp(spec, rank_tp, ms, baseline))
     print(json.dumps(result))

@@ -7,9 +7,12 @@ points can be verified in seconds on any box. Three contracts pin the
 properties every benchmark number in this repo leans on:
 
   J001  collective count/kind + payload bytes of the tp forward equal the
-        analytic model in parallel/comm_stats.py (4 all_gathers per layer
-        + the logits gather, ring accounting) — the ICI term of every
-        multi-chip projection;
+        analytic model in parallel/comm_stats.py, PER SCHEME (ref: 4
+        all_gathers/layer + the logits gather; fused: 2 psums/layer +
+        logits gather — comm_stats.tp_collective_budget, ring accounting)
+        — the ICI term of every multi-chip projection. Runs once per
+        scheme, and fails on any traced collective kind the budget has no
+        term for (the drift guard the D006 source rule mirrors);
   J002  buffer donation on the decode step actually reaches the lowering:
         both KV-cache planes carry input/output aliases, so steady-state
         decode allocates zero new cache buffers per token;
@@ -132,25 +135,58 @@ def _aval_trees_equal(a, b) -> str | None:
 # -- J001: tp collectives vs the analytic model ----------------------------
 
 
-def contract_tp_collectives(spec=None, tp: int = 4) -> ContractResult:
-    """Trace make_sharded_forward and pin the collective schedule to the
-    analytic model: exactly 4*n_layers + 1 all_gathers (4 per layer + the
-    logits gather) and ring-accounted bytes equal to
-    comm_stats.ici_all_gather_bytes. (F32 buffer mode; the Q80 wire
-    packing variant is pinned at model scale by
-    tests/test_collective_pinning.py.)"""
+def _collective_kind(primitive_name: str) -> str:
+    """Normalize a collective primitive name to the comm_stats kind
+    vocabulary (psum lowers as psum/psum2/psum_invariant across jax
+    versions; all_gather may carry suffixes)."""
+    for kind in ("all_gather", "reduce_scatter", "psum"):
+        if primitive_name.startswith(kind):
+            return kind
+    return primitive_name
+
+
+def _moved_bytes(kind: str, aval, tp: int) -> int:
+    """Per-chip ring-accounted bytes for ONE collective of ``kind`` whose
+    per-shard input aval is ``aval`` — the same accounting
+    comm_stats.tp_collective_budget uses (its docstring derives these)."""
     import numpy as np
+
+    b = int(np.prod(aval.shape)) * aval.dtype.itemsize
+    if kind == "all_gather":
+        return (tp - 1) * b          # input is the shard
+    if kind == "reduce_scatter":
+        return (tp - 1) * b // tp    # input is the full per-chip payload
+    if kind == "psum":
+        return 2 * (tp - 1) * b // tp
+    raise ValueError(f"no ring model for collective kind {kind!r}")
+
+
+def contract_tp_collectives(spec=None, tp: int = 4,
+                            scheme: str | None = None) -> ContractResult:
+    """Trace make_sharded_forward for ``scheme`` (default: the active
+    DLLAMA_TP_SCHEME) and pin the collective schedule to the analytic
+    model: per-kind counts AND ring-accounted bytes equal
+    comm_stats.tp_collective_budget — ref: 4*n_layers+1 all_gathers;
+    fused: 2*n_layers psums + the logits gather. Any traced collective
+    kind without a budget term fails (so a collective added to tp.py
+    without its comm_stats term cannot land — dlint D006 flags the same
+    drift at source level). (F32 buffer mode; the Q80 wire packing
+    variants are pinned at model scale by tests/test_collective_pinning.py.)
+    """
+    import collections
 
     import jax
     import jax.numpy as jnp
 
     from ..models.llama import init_cache
     from ..parallel import make_mesh, make_sharded_forward
-    from ..parallel.comm_stats import ici_all_gather_bytes
+    from ..parallel.comm_stats import tp_collective_budget, tp_scheme
 
-    name = "tp_collectives"
+    scheme = scheme or tp_scheme()
+    name = f"tp_collectives[{scheme}]"
     hint = ("an added/removed collective or payload dtype change must land "
-            "together with parallel/comm_stats.py")
+            "together with parallel/comm_stats.py (tp_collective_budget, "
+            f"scheme={scheme!r})")
     spec = spec or _contract_spec()
     if len(jax.devices()) < tp:
         return ContractResult(
@@ -158,7 +194,7 @@ def contract_tp_collectives(spec=None, tp: int = 4) -> ContractResult:
             f"needs {tp} devices, have {len(jax.devices())} — set "
             f"--xla_force_host_platform_device_count", hint)
     mesh = make_mesh(tp=tp, devices=jax.devices()[:tp])
-    fwd = make_sharded_forward(spec, mesh)
+    fwd = make_sharded_forward(spec, mesh, scheme=scheme)
     params = abstract_params(spec)
     cache = jax.eval_shape(lambda: init_cache(spec, jnp.float32))
     tokens = jax.ShapeDtypeStruct((1,), jnp.int32)
@@ -169,30 +205,36 @@ def contract_tp_collectives(spec=None, tp: int = 4) -> ContractResult:
         return ContractResult("J001", name, False,
                               "no collectives found — jaxpr walk or "
                               "shard_map internals changed?", hint)
-    n_expected = 4 * spec.n_layers + 1
-    n_actual = sum(m for _, _, m in colls)
-    kinds = sorted({n for n, _, _ in colls})
-    if any(not k.startswith("all_gather") for k in kinds):
+    budget = tp_collective_budget(spec, tp, scheme)
+    want_counts = budget.kind_counts()
+    got_counts = collections.Counter()
+    for prim, _, m in colls:
+        got_counts[_collective_kind(prim)] += m
+    unmodeled = sorted(set(got_counts) - set(want_counts))
+    if unmodeled:
         return ContractResult(
             "J001", name, False,
-            f"unmodeled collective kinds {kinds} in the tp forward", hint)
-    if n_actual != n_expected:
+            f"collective kind(s) {unmodeled} in the tp forward have no "
+            f"comm_stats term for scheme {scheme!r}", hint)
+    if dict(got_counts) != want_counts:
         return ContractResult(
             "J001", name, False,
-            f"{n_actual} all_gathers traced, analytic model says "
-            f"{n_expected} (4*{spec.n_layers} layers + logits)", hint)
-    moved = sum((tp - 1) * int(np.prod(a.shape)) * a.dtype.itemsize * m
-                for _, a, m in colls)
-    expected = ici_all_gather_bytes(spec, tp).sent_bytes
+            f"traced collective counts {dict(got_counts)} != analytic "
+            f"{want_counts}", hint)
+    moved = sum(_moved_bytes(_collective_kind(prim), a, tp) * m
+                for prim, a, m in colls)
+    expected = budget.moved_bytes
     if moved != expected:
         return ContractResult(
             "J001", name, False,
             f"traced payload {moved} B/token != analytic {expected} B",
             hint)
+    n_actual = sum(got_counts.values())
     return ContractResult(
         "J001", name, True,
-        f"{n_actual} all_gathers, {moved} B/token/chip (tp={tp}) — "
-        f"matches comm_stats", hint)
+        f"{n_actual} collectives ({dict(got_counts)}), {moved} "
+        f"B/token/chip (tp={tp}, scheme={scheme}) — matches comm_stats",
+        hint)
 
 
 # -- J002: decode-step KV-cache donation -----------------------------------
@@ -281,12 +323,24 @@ def contract_decode_shape_stability(spec=None,
         f"compile serves the whole decode", hint)
 
 
+def contract_tp_collectives_ref(spec=None) -> ContractResult:
+    return contract_tp_collectives(spec, scheme="ref")
+
+
+def contract_tp_collectives_fused(spec=None) -> ContractResult:
+    return contract_tp_collectives(spec, scheme="fused")
+
+
 contract_tp_collectives.contract_id = "J001"
+contract_tp_collectives_ref.contract_id = "J001"
+contract_tp_collectives_fused.contract_id = "J001"
 contract_decode_donation.contract_id = "J002"
 contract_decode_shape_stability.contract_id = "J003"
 
-CONTRACTS = (contract_tp_collectives, contract_decode_donation,
-             contract_decode_shape_stability)
+# J001 runs once per scheme: BOTH schedules stay pinned regardless of which
+# DLLAMA_TP_SCHEME the current process happens to run under
+CONTRACTS = (contract_tp_collectives_ref, contract_tp_collectives_fused,
+             contract_decode_donation, contract_decode_shape_stability)
 
 
 def run_contracts(spec=None) -> list[ContractResult]:

@@ -13,6 +13,7 @@ at lint time:
   D003  jitted function closing over mutable module/instance state
   D004  per-step list-comp feeding jnp.asarray in the decode step
   D005  time.time() deltas around device work without block_until_ready
+  D006  tp collective issued outside parallel/tp.py's _ici_* helpers
 
 False-positive policy: rules stay *narrow* (better to miss a hazard than to
 train people to pragma reflexively); intentional sites carry
@@ -314,5 +315,46 @@ def d005_bare_time(ctx: ModuleContext) -> Iterator[Finding]:
                     d005_bare_time.hint)
 
 
+# jax.lax collectives that would add un-modeled ICI traffic to the tp
+# forward; pmean/pmax/pmin included — any reduction over the mesh crosses
+# the wire
+_COLLECTIVE_CALLS = frozenset(
+    f"jax.lax.{name}" for name in
+    ("all_gather", "psum", "psum_scatter", "all_to_all", "ppermute",
+     "pmax", "pmin", "pmean", "reduce_scatter"))
+# the blessed sites: the ONLY functions in parallel/tp.py allowed to bind a
+# collective — comm_stats.tp_collective_budget models exactly what flows
+# through these three, and the J001 contract pins the traced program to it
+_TP_COMM_HELPERS = frozenset(("_ici_gather", "_ici_psum", "_ici_scatter"))
+
+
+@rule("D006", "tp collective outside the comm-model helpers",
+      "route tp collectives through the _ici_* helpers in parallel/tp.py "
+      "and land the matching parallel/comm_stats.py budget term in the "
+      "same change, or the J001 contract (and every ICI projection) drifts "
+      "from the program",
+      scope=("parallel/tp.py",))
+def d006_unmodeled_collective(ctx: ModuleContext) -> Iterator[Finding]:
+    """Every collective the tp forward issues must have a comm_stats term.
+    J001 catches traced drift after the fact; this rule catches it at the
+    source: any ``jax.lax`` collective call in parallel/tp.py outside the
+    _ici_gather/_ici_psum/_ici_scatter helpers is flagged — a new
+    collective belongs in a helper (so shard_sim can stand it in locally)
+    with its budget entry, not inline in a layer body."""
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if ctx.call_target(node) not in _COLLECTIVE_CALLS:
+            continue
+        fn = ctx.enclosing_function(node)
+        if fn is not None and getattr(fn, "name", "") in _TP_COMM_HELPERS:
+            continue
+        yield _finding(
+            ctx, node, "D006",
+            f"collective {ctx.call_target(node)} issued outside the "
+            f"_ici_* comm-model helpers",
+            d006_unmodeled_collective.hint)
+
+
 RULES = (d001_implicit_sync, d002_retrace_trap, d003_jit_closure,
-         d004_hot_loop_alloc, d005_bare_time)
+         d004_hot_loop_alloc, d005_bare_time, d006_unmodeled_collective)

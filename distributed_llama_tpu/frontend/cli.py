@@ -188,6 +188,12 @@ def cmd_inference(argv: list[str], quiet: bool = False) -> int:
     ap.add_argument("--seed", type=int, default=None)
     ap.add_argument("--tp", type=int, default=None,
                     help="tensor-parallel ways (default: all local devices)")
+    ap.add_argument("--tp-scheme", default=None, choices=("ref", "fused"),
+                    help="tp collective schedule (= DLLAMA_TP_SCHEME): "
+                         "'fused' (default) pairs column/row-parallel "
+                         "matmuls Megatron-style — 2 collectives/layer; "
+                         "'ref' keeps the reference's 4-gather MatmulSlice "
+                         "schedule, the bit-parity anchor")
     ap.add_argument("--sp", type=int, default=1,
                     help="sequence-parallel ways (sp-sharded KV cache + "
                          "distributed flash attention; reference has none)")
@@ -302,6 +308,11 @@ def cmd_inference(argv: list[str], quiet: bool = False) -> int:
             print("prompts file is empty", file=sys.stderr)
             return 2
 
+    if args.tp_scheme:
+        os.environ["DLLAMA_TP_SCHEME"] = args.tp_scheme
+    from ..parallel.comm_stats import tp_scheme
+
+    scheme = tp_scheme()  # validate (env or flag) before the model load
     wft = _FT[args.weights_float_type]
     bft = _FT[args.buffer_float_type]
     n_dev = len(jax.devices())
@@ -320,9 +331,17 @@ def cmd_inference(argv: list[str], quiet: bool = False) -> int:
     else:
         # single-chip: sidecar-cached pre-tiled load (VERDICT r4 #7) —
         # a warm <model>.kcache makes host prep an mmap, like the
-        # reference's loader (transformer.cpp:280-296)
+        # reference's loader (transformer.cpp:280-296). The Q40 body
+        # policy (bench-winning i4-plane + nb-major layout where the
+        # device/shape supports it) must land BEFORE the load: the
+        # sidecar's layout key reads the env knobs it sets
         from ..io.kernel_cache import load_model_packed
+        from ..io.loader import read_spec
+        from ..ops.linear import apply_q40_body_policy
 
+        if wft == FloatType.Q40:
+            apply_q40_body_policy(read_spec(args.model,
+                                            weights_float_type=wft))
         spec, params = load_model_packed(args.model, weights_float_type=wft,
                                          buffer_float_type=bft)
     if not quiet:
@@ -330,7 +349,8 @@ def cmd_inference(argv: list[str], quiet: bool = False) -> int:
               f"💡 nLayers: {spec.n_layers}\n💡 nHeads: {spec.n_heads}\n"
               f"💡 nKvHeads: {spec.n_kv_heads}\n"
               f"💡 vocabSize: {spec.vocab_size}\n💡 seqLen: {spec.seq_len}\n"
-              f"💡 nSlices: {tp} sp: {args.sp} ({n_dev} devices, "
+              f"💡 nSlices: {tp} sp: {args.sp} scheme: "
+              f"{scheme if tp > 1 else '-'} ({n_dev} devices, "
               f"{jax.devices()[0].platform})")
     mesh = (make_mesh(sp=args.sp, tp=tp)
             if tp > 1 or args.sp > 1 else None)
@@ -523,6 +543,9 @@ def cmd_serve(argv: list[str]) -> int:
     ap.add_argument("--seed", type=int, default=None)
     ap.add_argument("--tp", type=int, default=None,
                     help="tensor-parallel ways (default: single chip)")
+    ap.add_argument("--tp-scheme", default=None, choices=("ref", "fused"),
+                    help="tp collective schedule (= DLLAMA_TP_SCHEME; see "
+                         "'inference --help')")
     ap.add_argument("--kv-cache-dtype", default="f32",
                     choices=("f32", "bf16"))
     ap.add_argument("--prefill-chunk", type=int, default=128, metavar="N",
@@ -557,13 +580,25 @@ def cmd_serve(argv: list[str]) -> int:
     import jax.numpy as jnp
 
     from ..io.kernel_cache import load_model_packed
-    from ..io.loader import load_model
+    from ..io.loader import load_model, read_spec
     from ..io.tokenizer import Tokenizer
     from ..parallel import make_mesh
+    from ..parallel.comm_stats import tp_scheme
     from ..runtime.server import InferenceServer
 
-    load = (load_model if args.tp and args.tp > 1  # mesh: tp-aware packing
-            else load_model_packed)                # single-chip: sidecar
+    if args.tp_scheme:
+        os.environ["DLLAMA_TP_SCHEME"] = args.tp_scheme
+    tp_scheme()  # validate before the model load
+    sharded = bool(args.tp and args.tp > 1)
+    load = (load_model if sharded  # mesh: tp-aware packing in shard_params
+            else load_model_packed)  # single-chip: sidecar
+    if not sharded and _FT[args.weights_float_type] == FloatType.Q40:
+        # same bench-winning layout policy as single-chip inference; must
+        # precede the load (sidecar layout key reads the env knobs)
+        from ..ops.linear import apply_q40_body_policy
+
+        apply_q40_body_policy(read_spec(
+            args.model, weights_float_type=_FT[args.weights_float_type]))
     spec, params = load(args.model,
                         weights_float_type=_FT[args.weights_float_type],
                         buffer_float_type=_FT[args.buffer_float_type])

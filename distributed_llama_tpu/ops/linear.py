@@ -178,14 +178,17 @@ def matmul(w, x: jax.Array, *, prefer_pallas: bool = False) -> jax.Array:
 
 
 def pack_q40_params(params: dict, enable: bool | None = None,
-                    tp: int = 1, allow_nb_major: bool | None = None) -> dict:
+                    tp: int = 1, allow_nb_major: bool | None = None,
+                    input_sharded=()) -> dict:
     """Re-tile every Q40Weight in a param tree to the kernel layout, once.
 
     ``enable=None`` means "iff the Pallas kernel will be used" — so CPU/test
     runs keep the codec layout and the golden-parity paths are untouched.
     ``tp`` is the tensor-parallel degree the weights will be sharded to:
-    kernel support is decided on the shard-LOCAL output dim (d/tp), since
-    that is what the kernel tiles inside shard_map.
+    kernel support is decided on the shard-LOCAL shape, since that is what
+    the kernel tiles inside shard_map. ``input_sharded`` names the keys the
+    fused tp scheme shards along the INPUT dim (wo/w2 — parallel/tp.py):
+    their local shape is (d, n/tp) instead of (d/tp, n).
     Call this at load time, before device_put; never inside a jitted step.
     """
     if enable is None:
@@ -200,10 +203,22 @@ def pack_q40_params(params: dict, enable: bool | None = None,
         allow_nb_major = False
     from .pallas_q40 import _pick_rows_nb, kernel_supports
 
-    def pick(v):
+    def pick(k, v):
         if not isinstance(v, Q40Weight):
             return v
         d, n = v.logical_shape[-2], v.logical_shape[-1]
+        if k in input_sharded and tp > 1:
+            # fused-scheme wo/w2: full output rows, 1/tp of the input
+            # blocks per shard — the nb axis is the sharded one, so the
+            # local block count must stay whole (shard_params validated
+            # divisibility already; re-check defensively)
+            if (n // 32) % tp:
+                raise ValueError(
+                    f"{k}: input-dim sharding needs n/tp to be a "
+                    f"32-multiple, got n={n} tp={tp}")
+            if kernel_supports(d, n // tp):
+                return to_kernel_layout(v)
+            return v
         if d % tp:
             return v
         nb = n // 32
@@ -224,7 +239,7 @@ def pack_q40_params(params: dict, enable: bool | None = None,
         # the jitted step on every call
         return v
 
-    return {k: pick(v) for k, v in params.items()}
+    return {k: pick(k, v) for k, v in params.items()}
 
 
 def fuse_q40_layer_matmuls(params: dict) -> dict:
@@ -270,6 +285,89 @@ def fuse_q40_layer_matmuls(params: dict) -> dict:
     fuse("wqkv", ("wq", "wk", "wv"))
     fuse("w13", ("w1", "w3"))
     return out
+
+
+def q40_body_policy(spec) -> tuple[str, str]:
+    """Resolve the single-chip Q40 decode-body policy: (policy, reason).
+
+    Promotes the bench's same-session A/B winner (BASELINE.md r5: 7B
+    9.645 ms/token with the int4-plane body on forced nb-major layout, vs
+    9.98-10.37 for the defaults) into the real CLI path — until now only
+    ``bench.py:_row_env`` applied it, so a plain ``inference`` run left
+    ~4% on the table (VERDICT round 5).
+
+    Explicit ``DLLAMA_Q40_I4``/``DLLAMA_NB_MAJOR`` env wins over
+    everything (including DLLAMA_Q40_BODY — nothing ever unsets a user
+    knob), and the returned label then REPORTS what that env actually
+    engages rather than a policy nobody chose. Otherwise
+    ``DLLAMA_Q40_BODY`` overrides: ``auto`` (default), ``i4-nb`` (force
+    the winning combo), ``d-major`` (keep the stock layout picks). auto
+    picks ``i4-nb`` iff ALL of:
+      * the Pallas kernel path is active (TPU; elsewhere layouts are moot),
+      * every matmul leaf places on the nb-major row tiler (the i4 body is
+        nb-major-only — pad-free 7B-class shapes need the forced layout),
+      * the packed weights leave conversion headroom: the in-chain i4
+        conversion transiently holds an extra ~half of the packed bytes
+        while the chain runs, which OOMed 13B on a 16 GB chip (PARITY.md
+        round-5 table) — gated at DLLAMA_Q40_BODY_MAX_GB packed (default
+        6.0, between 7B's ~4.2 and 13B's ~7.8).
+    """
+    choice = os.environ.get("DLLAMA_Q40_BODY", "auto")
+    if choice not in ("auto", "i4-nb", "d-major"):
+        raise ValueError(f"DLLAMA_Q40_BODY={choice!r}: expected "
+                         f"auto|i4-nb|d-major")
+    i4 = os.environ.get("DLLAMA_Q40_I4")
+    nbm = os.environ.get("DLLAMA_NB_MAJOR")
+    if i4 or nbm:
+        label = ("i4-nb" if i4 == "on" and nbm == "force"
+                 else f"env(i4={i4 or 'off'}, nb-major={nbm or 'auto'})")
+        return label, "explicit DLLAMA_Q40_I4/DLLAMA_NB_MAJOR env respected"
+    if choice != "auto":
+        return choice, "explicit DLLAMA_Q40_BODY"
+    if q40_kernel_mode() != "pallas":
+        return "d-major", "XLA matmul path (no Pallas kernels here)"
+    from .pallas_q40 import _pick_rows_nb
+
+    shapes = [shape for _, shape in spec.layer_matmul_shapes()]
+    shapes.append((spec.vocab_size, spec.dim))  # wcls
+    bad = [(d, n) for d, n in shapes if _pick_rows_nb(d, n // 32) is None]
+    if bad:
+        return "d-major", (f"shape {bad[0]} has no nb-major row tiling "
+                           f"(rows must divide by 128)")
+    packed_gb = (spec.n_layers * sum(d * (n // 32) * 18 for d, n in shapes[:-1])
+                 + spec.vocab_size * (spec.dim // 32) * 18) / 1e9
+    raw_gb = os.environ.get("DLLAMA_Q40_BODY_MAX_GB", "6")
+    try:
+        max_gb = float(raw_gb)
+    except ValueError:
+        raise ValueError(f"DLLAMA_Q40_BODY_MAX_GB={raw_gb!r}: expected a "
+                         f"number of GB (e.g. 6)") from None
+    if packed_gb > max_gb:
+        return "d-major", (f"~{packed_gb:.1f} GB packed exceeds the "
+                           f"{max_gb:.0f} GB i4-conversion headroom gate "
+                           f"(DLLAMA_Q40_BODY_MAX_GB; 13B-class OOM, "
+                           f"BASELINE.md r5)")
+    return "i4-nb", (f"auto: shapes place nb-major, ~{packed_gb:.1f} GB "
+                     f"packed fits the i4 headroom gate")
+
+
+def apply_q40_body_policy(spec) -> str:
+    """Apply q40_body_policy by setting the layout env knobs the packers
+    and the decode chain already read (DLLAMA_NB_MAJOR=force +
+    DLLAMA_Q40_I4=on), BEFORE any pack/sidecar load — the kcache layout
+    key includes DLLAMA_NB_MAJOR. Prints the chosen policy to stderr
+    unconditionally, even for quiet callers: a silent layout change would
+    make runs incomparable. setdefault only: explicit user env is never
+    overridden."""
+    import sys
+
+    policy, reason = q40_body_policy(spec)
+    if policy == "i4-nb":
+        os.environ.setdefault("DLLAMA_NB_MAJOR", "force")
+        os.environ.setdefault("DLLAMA_Q40_I4", "on")
+    print(f"💡 Q40 body policy: {policy} ({reason}; the i4 body "
+          f"engages on fused decode chains)", file=sys.stderr)
+    return policy
 
 
 def fake_quant_q80(x: jax.Array) -> jax.Array:

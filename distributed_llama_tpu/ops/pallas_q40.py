@@ -360,9 +360,21 @@ def unpack_i4_packed(v):
     """Jit-internal: the packed-u8 carrier -> int4 plane leaf. The
     bitcast adds a trailing pair dim that the minor reshape collapses —
     both are layout reinterpretations of the SAME packed bits (no second
-    copy of the weights)."""
-    q4 = jax.lax.bitcast_convert_type(v.qs_p, jnp.int4)   # (..., X, Y/2, 2)
-    q4 = q4.reshape(*q4.shape[:-2], q4.shape[-2] * 2)     # (..., X, Y)
+    copy of the weights). On jax builds whose u8->s4 bitcast does NOT
+    split pairs (int4 stored one byte per element, e.g. 0.4.37 CPU), the
+    nibbles unpack arithmetically instead — same values, the bitcast's
+    zero-copy property traded for a few VPU ops."""
+    q8 = v.qs_p
+    q4 = jax.lax.bitcast_convert_type(q8, jnp.int4)
+    if q4.shape == (*q8.shape, 2):                        # (..., X, Y/2, 2)
+        q4 = q4.reshape(*q4.shape[:-2], q4.shape[-2] * 2)  # (..., X, Y)
+    else:
+        # low nibble = even index (the repack_i4_packed layout); nibbles
+        # hold (c - 8) two's-complement: ((n + 8) & 0xF) - 8 re-signs
+        pairs = jnp.stack([q8 & 0xF, q8 >> 4], axis=-1)   # (..., Y/2, 2)
+        signed = ((pairs.astype(jnp.int32) + 8) & 0xF) - 8
+        q4 = signed.astype(jnp.int4).reshape(*q8.shape[:-1],
+                                             q8.shape[-1] * 2)
     if isinstance(v, Q40KernelI4PackedD):
         return Q40KernelI4(q4, v.scale)
     return Q40KernelNbI4(q4, v.scale)

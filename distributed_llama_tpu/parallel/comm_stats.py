@@ -1,22 +1,56 @@
-"""Analytic per-token communication accounting.
+"""Analytic per-token communication accounting — per TP scheme.
 
 The reference's benchmark metric includes sent/received kB per token measured
 by atomic socket counters (src/socket.cpp:114-123, printed at
 tokenizer.cpp:381). On an ICI mesh the collectives are compiler-issued, so we
-account analytically — both for OUR all_gather scheme (what actually crosses
-ICI per chip) and for the REFERENCE's star topology (root-side S/R, which the
-README tables publish) so runs can print comparable numbers.
+account analytically — both for OUR schemes (what actually crosses ICI per
+chip) and for the REFERENCE's star topology (root-side S/R, which the README
+tables publish) so runs can print comparable numbers.
+
+Two tp collective schemes exist (selected by ``DLLAMA_TP_SCHEME``, see
+``tp_scheme``); every per-token budget in this module is derived from ONE
+budget function (``tp_collective_budget``) so the runtime print, the bench
+projection, and the dlint J001 jaxpr contract all read the same numbers:
+
+  ref    the reference's all-output-sliced MatmulSlice port: 4 all_gathers
+         per layer + the logits gather (parallel/tp.py ref branch) — the
+         bit-parity anchor against the reference binaries.
+  fused  Megatron-style pairing (Shoeybi et al. 2019; Pope et al. 2022):
+         wo/w2 are INPUT-dim sharded, so attention-out and ffn-out are
+         row-parallel partial sums combined with ONE psum per block under
+         f32 buffers (2 collectives/layer), or a psum_scatter + Q80-packed
+         all_gather pair under Q80 buffers (the wire-quantization cut point
+         is preserved on the gather half).
 
 Validated against the published tables (README.md:58-69) in
-tests/test_comm_stats.py.
+tests/test_comm_stats.py; pinned to the traced program in
+tests/test_collective_pinning.py and analysis/jaxpr_contracts.py (J001).
 """
 
 from __future__ import annotations
 
 import dataclasses
+import os
 
 from ..models.spec import TransformerSpec
 from ..ops.quants import FloatType, batch_bytes
+
+SCHEMES = ("ref", "fused")
+
+
+def tp_scheme() -> str:
+    """The active tp collective scheme: DLLAMA_TP_SCHEME=ref|fused.
+
+    Default ``fused`` — the fastest policy (half the per-layer collective
+    launches, the dominant term of the multi-chip latency budget; ISSUE 3 /
+    BENCH_r05). ``ref`` keeps the reference's 4-gather MatmulSlice schedule
+    and remains the bit-parity anchor against the reference binaries.
+    """
+    s = os.environ.get("DLLAMA_TP_SCHEME", "fused")
+    if s not in SCHEMES:
+        raise ValueError(f"DLLAMA_TP_SCHEME={s!r}: expected one of "
+                         f"{'|'.join(SCHEMES)}")
+    return s
 
 
 def _vb(ftype: FloatType, n: int) -> int:
@@ -34,28 +68,89 @@ class CommStats:
         return (self.sent_bytes + self.recv_bytes) / 1024.0
 
 
-def ici_all_gather_bytes(spec: TransformerSpec, n_slices: int) -> CommStats:
-    """Per-chip bytes/token of our scheme: 4 all_gathers per layer + logits.
+@dataclasses.dataclass(frozen=True)
+class CollectiveBudget:
+    """The per-token tp collective schedule, aggregated by primitive kind.
 
-    An S-way all_gather of a vector with per-shard size b moves (S-1)*b out of
-    and into every chip (ring: S-1 hops of one shard each). Under Q80 buffer
-    mode the counted bytes are the int8-codes + f16-deltas payload that the
-    collectives ACTUALLY carry (tp._wire_gather quantizes before the gather);
-    the logits gather stays f32 in both modes.
+    ``entries`` holds (kind, count, moved_bytes) per collective kind, where
+    ``moved_bytes`` is the ring-accounted bytes each chip moves per token
+    for ALL collectives of that kind (logits gather included). This is the
+    ONE structure the analytic model exposes: the runtime byte counters,
+    the bench ICI projection, and the J001 jaxpr contract all consume it —
+    a collective added to the forward without a term here fails J001 (and
+    dlint D006 flags the source site).
     """
+
+    entries: tuple  # ((kind, count, moved_bytes), ...)
+
+    @property
+    def n_collectives(self) -> int:
+        return sum(c for _, c, _ in self.entries)
+
+    @property
+    def moved_bytes(self) -> int:
+        return sum(b for _, _, b in self.entries)
+
+    def kind_counts(self) -> dict[str, int]:
+        return {k: c for k, c, _ in self.entries}
+
+
+def tp_collective_budget(spec: TransformerSpec, n_slices: int,
+                         scheme: str | None = None) -> CollectiveBudget:
+    """Per-chip/token collective schedule of the tp forward, per scheme.
+
+    Ring accounting (S = n_slices, b = per-shard payload bytes):
+      all_gather      moves (S-1)*b out of and into every chip;
+      reduce_scatter  moves (S-1)*p/S for a full per-chip payload p;
+      psum            moves 2*(S-1)*p/S (reduce-scatter + gather phases).
+    A psum is charged as ONE collective: its two phases ride the counter-
+    rotating rings of the full-duplex ICI links back to back, and the term
+    the count feeds (per-collective launch/sync latency, see
+    shard_sim.project_full_system) is paid once per issued collective —
+    halving the launches is exactly the fused scheme's win.
+
+    Under Q80 buffer mode the gather halves carry the REAL packed payload
+    (int8 codes + f16 deltas, tp._wire_gather); reduce halves stay f32 —
+    partial sums cannot ride the wire quantized without compounding each
+    shard's rounding error into the total.
+    """
+    scheme = scheme or tp_scheme()
+    if scheme not in SCHEMES:
+        raise ValueError(f"unknown tp scheme {scheme!r}")
     if n_slices <= 1:
-        return CommStats(0, 0)
+        return CollectiveBudget(())
     ft = spec.buffer_float_type
-    s = n_slices
-    per_layer = (
-        _vb(ft, spec.dim // s)      # att heads out
-        + _vb(ft, spec.dim // s)    # wo out
-        + _vb(ft, spec.hidden_dim // s)  # hb before w2
-        + _vb(ft, spec.dim // s)    # w2 out
-    )
-    total = spec.n_layers * per_layer + _vb(FloatType.F32,
-                                            spec.vocab_size // s)
-    moved = (s - 1) * total
+    s, L = n_slices, spec.n_layers
+    logits_bytes = (s - 1) * _vb(FloatType.F32, spec.vocab_size // s)
+    if scheme == "ref":
+        per_layer = (s - 1) * (3 * _vb(ft, spec.dim // s)
+                               + _vb(ft, spec.hidden_dim // s))
+        return CollectiveBudget(
+            (("all_gather", 4 * L + 1, L * per_layer + logits_bytes),))
+    # fused: wo/w2 row-parallel — one combine per block, 2 blocks/layer,
+    # both of width dim (attention out and ffn out are residual-stream
+    # vectors; hidden_dim never crosses the wire in this scheme)
+    if ft == FloatType.Q80:
+        rs_bytes = 2 * L * (s - 1) * (spec.dim // s) * 4
+        ag_bytes = 2 * L * (s - 1) * _vb(FloatType.Q80, spec.dim // s)
+        return CollectiveBudget(
+            (("reduce_scatter", 2 * L, rs_bytes),
+             ("all_gather", 2 * L + 1, ag_bytes + logits_bytes)))
+    psum_bytes = 2 * L * 2 * (s - 1) * (spec.dim // s) * 4
+    return CollectiveBudget(
+        (("psum", 2 * L, psum_bytes),
+         ("all_gather", 1, logits_bytes)))
+
+
+def ici_all_gather_bytes(spec: TransformerSpec, n_slices: int,
+                         scheme: str | None = None) -> CommStats:
+    """Per-chip bytes/token of the active (or given) scheme's collectives.
+
+    Historic name — under the fused scheme the bytes include psum /
+    reduce_scatter traffic, not only gathers. Sent == received: every
+    collective here is ring-symmetric.
+    """
+    moved = tp_collective_budget(spec, n_slices, scheme).moved_bytes
     return CommStats(moved, moved)
 
 

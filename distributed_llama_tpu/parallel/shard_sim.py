@@ -6,8 +6,10 @@ on one chip (~38.7 GB packed), and this environment exposes exactly one real
 chip. What CAN run whole is one tp=8 rank: its weight bands are ~5 GB packed
 (wq 1024x8192 etc., 80 layers, GQA 1 kv head/rank), and its per-layer program
 is EXACTLY tp.make_local_step — the function shard_map runs on every chip of
-a real v5e-8 — with the four per-layer all_gathers swapped for a local band
-tile (``jnp.concatenate([band]*8)``): same output shapes, same post-gather
+a real v5e-8 — with the per-layer collectives (all_gathers in the ref
+scheme; psum / psum_scatter+gather combines in the fused scheme) swapped
+for local stand-ins (band tile ``jnp.concatenate([band]*8)``, identity,
+band slice): same output shapes, same post-collective
 memory writes, no ICI. Measuring this on the real chip gives the per-chip
 compute+HBM cost of the real 8-way program; the ICI side is added
 analytically (comm_stats byte counts over measured-assumption link bandwidth
@@ -34,7 +36,7 @@ from typing import Any
 import numpy as np
 
 from ..models.spec import TransformerSpec
-from .comm_stats import ici_all_gather_bytes
+from .comm_stats import tp_collective_budget, tp_scheme
 
 
 def make_tile_gather(n_slices: int):
@@ -51,23 +53,57 @@ def make_tile_gather(n_slices: int):
     return tile
 
 
+def _sim_psum(a):
+    """psum stand-in (tp._ici_psum signature) for the one-rank sim: the
+    row-parallel partial already has the full output shape, and the real
+    psum's local arithmetic is a negligible add tree — identity keeps the
+    shapes and memory traffic honest with zero ICI."""
+    return a
+
+
+def make_tile_scatter(n_slices: int):
+    """psum_scatter stand-in (tp._ici_scatter signature): keep this rank's
+    1/n_slices band of the axis — same local output shape as the real
+    reduce_scatter, zero ICI. (Values are garbage by construction, like the
+    tile gather's.)"""
+    import jax.lax
+
+    def scatter(a, axis):
+        if n_slices == 1:
+            return a
+        return jax.lax.slice_in_dim(a, 0, a.shape[axis] // n_slices,
+                                    axis=axis)
+
+    return scatter
+
+
 def synth_rank_q40(spec: TransformerSpec, n_slices: int, seed: int = 0,
-                   embed_dtype=None) -> dict[str, Any]:
+                   embed_dtype=None,
+                   scheme: str | None = None) -> dict[str, Any]:
     """Random Q40 params at ONE rank's band shapes (models/synth.synth_q40_fast
     semantics: packed bytes directly — timing is value-independent).
 
     Replicated tensors (tok_embedding, norms) come at full size, exactly what
     every chip of the real mesh holds; matmul weights come as the rank's
-    output-dim band: wq/wo (dim/S, dim), wk/wv (kv_dim/S, dim), w1/w3
-    (hidden/S, dim), w2 (dim/S, hidden), wcls (vocab/S, dim).
+    band under the active tp ``scheme`` (tp.py): output-dim bands for
+    wq/wk/wv/w1/w3/wcls in both schemes, and for wo/w2 either output-dim
+    bands (ref: wo/w2 (dim/S, dim)/(dim/S, hidden)) or INPUT-dim bands
+    (fused: wo (dim, dim/S), w2 (dim, hidden/S)).
     ``embed_dtype`` (e.g. bf16) shrinks the 1 GB-at-70B replicated embedding
     table; timing impact is negligible (one row read per token).
     """
     from ..io.loader import Q40Weight
 
+    scheme = scheme or tp_scheme()
     if spec.n_heads % n_slices or spec.n_kv_heads % n_slices:
         raise ValueError(f"tp={n_slices} does not divide heads "
                          f"{spec.n_heads}/{spec.n_kv_heads}")
+    if scheme == "fused":
+        for name, n_in in (("wo", spec.dim), ("w2", spec.hidden_dim)):
+            if (n_in // n_slices) % 32:
+                raise ValueError(
+                    f"fused tp scheme slices {name}'s Q40 input dim: "
+                    f"{n_in}/{n_slices} must be a 32-multiple")
     rng = np.random.default_rng(seed)
 
     def t(*shape):
@@ -88,27 +124,36 @@ def synth_rank_q40(spec: TransformerSpec, n_slices: int, seed: int = 0,
          "rms_ffn": t(spec.n_layers, spec.dim).astype(np.float32),
          "wcls": mm(spec.vocab_size // S, spec.dim)}
     for name, (d, n) in spec.layer_matmul_shapes():
-        p[name] = mm(spec.n_layers, d // S, n)
+        if scheme == "fused" and name in ("wo", "w2"):
+            p[name] = mm(spec.n_layers, d, n // S)  # input-dim band
+        else:
+            p[name] = mm(spec.n_layers, d // S, n)
     return p
 
 
-def make_rank_step(spec: TransformerSpec, n_slices: int):
+def make_rank_step(spec: TransformerSpec, n_slices: int,
+                   scheme: str | None = None):
     """One rank's raw (traceable) step fn — feed this to the fused decode
     loop (runtime/decode.make_decode_loop) so the whole chain is one device
-    program, like the flagship bench path."""
+    program, like the flagship bench path. All three collective hooks get
+    local stand-ins (tile gather / identity psum / band-slice scatter), so
+    the sim runs whichever scheme's exact compute program with zero ICI."""
     from .tp import make_local_step
 
     return make_local_step(spec, n_slices, 1,
-                           gather_fn=make_tile_gather(n_slices))
+                           gather_fn=make_tile_gather(n_slices),
+                           scheme=scheme, psum_fn=_sim_psum,
+                           scatter_fn=make_tile_scatter(n_slices))
 
 
-def make_rank_forward(spec: TransformerSpec, n_slices: int):
+def make_rank_forward(spec: TransformerSpec, n_slices: int,
+                      scheme: str | None = None):
     """Jitted fn(params, cache, tokens (T,), pos) running one rank's program
-    on the local chip (tp.make_local_step with the tile gather). The cache
-    argument is the rank-local (L, seq, n_kv/S, hs) shard."""
+    on the local chip (tp.make_local_step with the tile stand-ins). The
+    cache argument is the rank-local (L, seq, n_kv/S, hs) shard."""
     import jax
 
-    return jax.jit(make_rank_step(spec, n_slices), donate_argnums=1)
+    return jax.jit(make_rank_step(spec, n_slices, scheme), donate_argnums=1)
 
 
 def init_rank_cache(spec: TransformerSpec, n_slices: int, dtype=None):
@@ -126,7 +171,8 @@ def init_rank_cache(spec: TransformerSpec, n_slices: int, dtype=None):
 def rank_params_to_device(params: dict[str, Any]) -> dict[str, Any]:
     """Kernel-pack + fuse + device_put the band tree (shapes are already
     local, so pack with tp=1 — identical layout to the band a real
-    shard_params device_puts to each chip: packing is row-band-local).
+    shard_params device_puts to each chip: packing is band-local in both
+    schemes, whichever dim the band slices).
     Fusing the rank's wq/wk/wv (and w1/w3) bands into wqkv/w13 is valid
     per-rank by construction (the bands are this rank's contiguous rows)
     and cuts per-token kernel launches from 7 to 4 per layer — at 80
@@ -173,25 +219,29 @@ class FullSystemProjection:
 def project_full_system(spec: TransformerSpec, n_slices: int,
                         shard_ms: float,
                         gbps: float = V5E_ICI_GBPS_PER_DIRECTION,
-                        latency_us: float = ICI_COLLECTIVE_LATENCY_US
-                        ) -> FullSystemProjection:
+                        latency_us: float = ICI_COLLECTIVE_LATENCY_US,
+                        scheme: str | None = None) -> FullSystemProjection:
     """Combine a measured rank time with the analytic collective budget.
 
-    Ring all_gather of per-shard size b over S chips: every chip sends and
-    receives (S-1)*b bytes in S-1 hop-steps; with full-duplex links the
-    bandwidth term is (S-1)*b / per-direction-bandwidth. Byte counts come
-    from comm_stats.ici_all_gather_bytes — the same accounting the runtime
-    prints (and, under Q80 buffers, the same int8+f16 payload the real
-    gathers carry).
+    Byte counts and the collective count come from ONE source of truth,
+    comm_stats.tp_collective_budget for the active (or given) ``scheme`` —
+    the same accounting the runtime prints, the J001 contract pins to the
+    traced program, and (under Q80 buffers) the same int8+f16 payload the
+    real gathers carry. Ring accounting: an all_gather of per-shard size b
+    moves (S-1)*b per chip over full-duplex links; a psum moves
+    2*(S-1)/S of its payload and is charged as ONE collective launch (its
+    reduce and gather phases pipeline on the counter-rotating rings, and
+    the launch/sync overhead this latency term models — dominant 13:1 over
+    bandwidth at 13b-tp8 — is paid per issued collective). That per-launch
+    count is what the fused scheme halves: 2L+1 vs the ref scheme's 4L+1
+    under f32 buffers (budget.n_collectives; under the Q80 wire the fused
+    combine decomposes into scatter+gather pairs and the count returns to
+    4L+1 with the packed payload preserved).
     """
-    st = ici_all_gather_bytes(spec, n_slices)
-    # 4 per-layer gathers + the logits gather. Q80 mode packs int8 codes +
-    # f16 deltas into ONE gathered uint8 buffer per cut (tp._wire_gather),
-    # so the collective count — whose per-op latency dominates this budget
-    # 13:1 over bandwidth — is buffer-mode-independent (VERDICT r2 #4; it
-    # used to be 8/layer in Q80 mode, doubling the dominant term)
-    n_coll = spec.n_layers * 4 + 1
-    bw_ms = st.sent_bytes / (gbps * 1e9) * 1e3
+    scheme = scheme or tp_scheme()
+    budget = tp_collective_budget(spec, n_slices, scheme)
+    n_coll = budget.n_collectives
+    bw_ms = budget.moved_bytes / (gbps * 1e9) * 1e3
     lat_ms = n_coll * (n_slices - 1) * latency_us / 1e3
     return FullSystemProjection(shard_ms, bw_ms, lat_ms, n_slices,
-                                st.sent_bytes, n_coll)
+                                budget.moved_bytes, n_coll)

@@ -1,14 +1,15 @@
-"""Tensor-parallel forward: the reference's TP scheme as one shard_map program.
+"""Tensor-parallel forward: two collective schemes as one shard_map program.
 
-Slicing layout = MatmulSlice (reference src/transformer.cpp:14-50): every one
-of the 7 per-layer matmuls is sharded along its OUTPUT dim into contiguous
-row bands, one band per tp-mesh coordinate. Because bands are contiguous and
-band size is a multiple of head_size, the q/k/v bands are whole (kv-)heads, so
-attention runs fully head-parallel with the KV cache sharded over kv heads —
-the idiomatic upgrade over the reference's root-only attention
-(transformer-tasks.cpp:206-278), with identical math.
+``DLLAMA_TP_SCHEME`` selects the per-layer collective schedule
+(comm_stats.tp_scheme; default ``fused``):
 
-Collective map (ours ⇄ reference transformer-tasks.cpp):
+**ref** — the reference's MatmulSlice port (src/transformer.cpp:14-50):
+every one of the 7 per-layer matmuls is sharded along its OUTPUT dim into
+contiguous row bands, one band per tp-mesh coordinate, and 4 all_gathers
+per layer stitch the bands back together. The bit-parity anchor against the
+reference binaries.
+
+Collective map, ref scheme (ours ⇄ reference transformer-tasks.cpp):
   all_gather(att out)   ⇄ quantizeMultiheadAtt+syncMultiheadAtt broadcast (:280-290)
   all_gather(wo out)    ⇄ syncAtt gather + next broadcast      (:303-315)
   all_gather(ffn hb)    ⇄ syncFfnA gather + syncFfnB star all-gather (:389-399,
@@ -16,19 +17,53 @@ Collective map (ours ⇄ reference transformer-tasks.cpp):
   all_gather(w2 out)    ⇄ syncFfn2 gather (:417-427)
   all_gather(logits)    ⇄ (none: reference wcls is root-only, :474-483; we
                            shard the vocab dim too)
-The reference's syncRmsAtt broadcast (:161) disappears: x is replicated, every
-device computes the (cheap) rmsnorm itself.
 
-With buffer_float_type == Q80 each all_gather moves the ACTUAL Q80 payload —
+**fused** — the Megatron-LM pairing (Shoeybi et al. 2019; Pope et al. 2022):
+the INPUT matmuls of each block stay column-parallel (output-dim bands, as
+in ref), but ``wo`` and ``w2`` re-shard along their INPUT dim, so each block
+ends in a row-parallel matmul whose full-width outputs are partial sums —
+combined with ONE collective per block instead of two. 2 collectives per
+layer (f32 buffers), halving the per-collective launch latency that
+dominates the multi-chip T term (BENCH_r05: 13b-tp8 paid 1.127 of 1.174 ms
+in launch latency across 161 collectives/token).
+
+Collective map, fused scheme (ours ⇄ reference transformer-tasks.cpp):
+  (local _wire quant)   ⇄ quantizeMultiheadAtt (:280; no wire here — the
+                           attention out is already rank-local)
+  psum(wo partials)     ⇄ syncMultiheadAtt + syncAtt collapsed (:280-315)
+  (local _wire quant)   ⇄ quantizeFfnA (:389; hb never crosses the wire)
+  psum(w2 partials)     ⇄ syncFfnA + syncFfnB + syncFfn2 collapsed (:389-427)
+  all_gather(logits)    ⇄ (none; as above)
+Under Q80 buffers each psum decomposes into psum_scatter (f32 — partial
+sums cannot ride the wire quantized without compounding per-shard rounding)
++ the SAME packed-Q80 ``_wire_gather`` the ref scheme uses, so the wire-
+quantization cut point of the reference is preserved on the gather half.
+
+In both schemes the reference's syncRmsAtt broadcast (:161) disappears: x is
+replicated, every device computes the (cheap) rmsnorm itself. Attention runs
+fully head-parallel with the KV cache sharded over kv heads — the idiomatic
+upgrade over the reference's root-only attention (transformer-tasks.cpp:
+206-278), with identical math — in both schemes (q/k/v are always
+output-dim bands).
+
+With buffer_float_type == Q80 every all_gather moves the ACTUAL Q80 payload —
 int8 codes + f16 block deltas, 34 bytes per 32 values (_wire_gather) — the
 wire-quantization the reference applies in its quantize*/sync* task pairs,
 reproduced at the same cut points with the same ~4x transfer cut
 (README.md:67-69); dequantization happens after the gather, so values match
 the round-1 quantize-dequantize-then-gather scheme bit for bit.
 
+The collective map is load-bearing in four places that must move together:
+this forward, the analytic model (parallel/comm_stats.py), the jaxpr
+contract (analysis/jaxpr_contracts.py J001), and the bench projection
+(parallel/shard_sim.py). dlint D006 flags any collective added here outside
+the _ici_* helpers those four know about.
+
 Requirements: tp divides n_heads, n_kv_heads, hidden_dim, vocab_size (the
 reference's analogous constraint is `assert(d % nSlices == 0)`,
-transformer.cpp:15).
+transformer.cpp:15); the fused scheme additionally needs dim/tp and
+hidden_dim/tp to be 32-block multiples when weights are Q40 (wo/w2 shard
+along their quantized input axis) or buffers are Q80.
 """
 
 from __future__ import annotations
@@ -47,6 +82,7 @@ from ..models.spec import TransformerSpec
 from ..ops.linear import fake_quant_q80, matmul, rmsnorm, silu
 from ..ops.quants import FloatType, dequantize_q80_jax, quantize_q80_jax
 from ..utils.compat import shard_map as _shard_map
+from .comm_stats import tp_scheme
 
 # params tree -> PartitionSpec for the stacked arrays (layer axis leading).
 # Output-dim sharding = axis 1 for per-layer matmuls, axis 0 for wcls.
@@ -65,12 +101,23 @@ _MATMUL_SPECS = {
 _REPL_SPECS = {
     "tok_embedding": P(), "rms_att": P(), "rms_ffn": P(), "rms_final": P(),
 }
+# fused scheme: wo/w2 re-shard along their INPUT dim (axis 2 of the stacked
+# (L, d_out, n_in) array) — row-parallel matmuls whose outputs are partial
+# sums, combined by _combine. For Q40 leaves the input axis is the nb block
+# axis, so n_in/tp must stay a 32-multiple (checked in shard_params).
+_FUSED_OVERRIDES = {"wo": P(None, None, "tp"), "w2": P(None, None, "tp")}
+# the keys pack_q40_params must judge on shard-LOCAL input width (fused)
+FUSED_INPUT_SHARDED = frozenset(_FUSED_OVERRIDES)
 
 
-def param_specs(params: dict[str, Any]) -> dict[str, Any]:
+def param_specs(params: dict[str, Any],
+                scheme: str | None = None) -> dict[str, Any]:
+    scheme = scheme or tp_scheme()
     specs: dict[str, Any] = {}
     for name, val in params.items():
         spec = _MATMUL_SPECS.get(name) or _REPL_SPECS.get(name)
+        if scheme == "fused":
+            spec = _FUSED_OVERRIDES.get(name, spec)
         if spec is None:
             raise KeyError(f"unknown param {name}")
         from ..io.loader import Q40KernelNb
@@ -81,7 +128,9 @@ def param_specs(params: dict[str, Any]) -> dict[str, Any]:
                 f"single-chip only — pack_q40_params never selects it when "
                 f"tp > 1, so a fused/hand-built tree reached shard_params")
         if isinstance(val, Q40Weight):
-            # qs (L, d, nb, 16) and d16 (L, d, nb) shard the same d axis
+            # qs (L, d, nb, 16) and d16 (L, d, nb) shard the same logical
+            # axis the spec names — d (output bands) or, for the fused
+            # scheme's wo/w2, nb (input-block bands)
             extra = len(val.qs.shape) - len(spec)
             qs_spec = P(*spec, *([None] * extra))
             d_spec = P(*spec, *([None] * (len(val.d16.shape) - len(spec))))
@@ -103,8 +152,10 @@ def param_specs(params: dict[str, Any]) -> dict[str, Any]:
 CACHE_SPEC = KVCache(P(None, "sp", "tp", None), P(None, "sp", "tp", None))
 
 
-def shard_params(params: dict[str, Any], mesh: Mesh) -> dict[str, Any]:
-    """Place the param tree with MatmulSlice-equivalent shardings.
+def shard_params(params: dict[str, Any], mesh: Mesh,
+                 scheme: str | None = None) -> dict[str, Any]:
+    """Place the param tree with the active scheme's shardings (ref:
+    MatmulSlice output-dim bands everywhere; fused: wo/w2 input-dim bands).
 
     Q40 weights are re-tiled to the Pallas kernel layout first (host side,
     once) when the Q40 fast path is active. Placement goes through
@@ -118,8 +169,22 @@ def shard_params(params: dict[str, Any], mesh: Mesh) -> dict[str, Any]:
 
     from ..ops.linear import pack_q40_params
 
-    params = pack_q40_params(params, tp=mesh.shape["tp"])
-    specs = param_specs(params)
+    scheme = scheme or tp_scheme()
+    n_tp = mesh.shape["tp"]
+    if scheme == "fused" and n_tp > 1:
+        # quantized wo/w2 shard along their nb block axis: fail with the
+        # clear constraint here, not a sharding traceback mid-device_put
+        for name in FUSED_INPUT_SHARDED:
+            v = params.get(name)
+            if isinstance(v, Q40Weight) and v.qs.shape[-2] % n_tp:
+                raise ValueError(
+                    f"{name}: fused tp scheme shards the input dim, but "
+                    f"{v.qs.shape[-2]} Q40 blocks do not divide over "
+                    f"tp={n_tp} (need input_dim/tp to be a 32-multiple)")
+    params = pack_q40_params(
+        params, tp=n_tp,
+        input_sharded=FUSED_INPUT_SHARDED if scheme == "fused" else ())
+    specs = param_specs(params, scheme)
 
     def put(a, s):
         a = np.asarray(a)
@@ -146,11 +211,30 @@ def _wire(spec: TransformerSpec, x: jax.Array) -> jax.Array:
 
 
 def _ici_gather(a: jax.Array, axis: int) -> jax.Array:
-    """THE tp collective: all_gather over the mesh axis, shard order = band
-    order. Layer-program builders take this as a ``gather_fn`` parameter so
-    parallel/shard_sim.py can swap in a local band-tile and run ONE rank's
-    exact program on a single chip (the 70B measurement path)."""
+    """The tp gather collective: all_gather over the mesh axis, shard order
+    = band order. Layer-program builders take this as a ``gather_fn``
+    parameter so parallel/shard_sim.py can swap in a local band-tile and run
+    ONE rank's exact program on a single chip (the 70B measurement path).
+
+    _ici_gather/_ici_psum/_ici_scatter are the ONLY places the tp forward
+    may issue a collective: comm_stats models exactly these, J001 pins the
+    traced program to that model, and dlint D006 flags any jax.lax
+    collective in this module outside the three helpers."""
     return jax.lax.all_gather(a, "tp", axis=axis, tiled=True)
+
+
+def _ici_psum(a: jax.Array) -> jax.Array:
+    """The fused scheme's f32 combine: ONE all_reduce of the row-parallel
+    partial block outputs over tp (swappable like _ici_gather; shard_sim
+    substitutes identity — the local partial already has the full shape)."""
+    return jax.lax.psum(a, "tp")
+
+
+def _ici_scatter(a: jax.Array, axis: int) -> jax.Array:
+    """The fused scheme's Q80 reduce half: psum_scatter leaves each device
+    the EXACT f32 sum of its band of ``axis`` (band order = shard order),
+    which _wire_gather then moves as the packed Q80 payload."""
+    return jax.lax.psum_scatter(a, "tp", scatter_dimension=axis, tiled=True)
 
 
 def _gather(x: jax.Array, gather_fn=_ici_gather) -> jax.Array:
@@ -221,31 +305,75 @@ def _tp_qkv(spec: TransformerSpec, n_slices: int, lw, x, positions):
     return q, k, v
 
 
-def _tp_tail(spec: TransformerSpec, x, lw, ao, gather_fn=_ici_gather):
+def _combine(spec: TransformerSpec, part: jax.Array,
+             gather_fn=_ici_gather, psum_fn=_ici_psum,
+             scatter_fn=_ici_scatter) -> jax.Array:
+    """Fused-scheme block combine: sum the row-parallel partial outputs.
+
+    F32 buffers: ONE psum — the Megatron combine, half the ref scheme's
+    collective launches per block. Q80 buffers: psum_scatter in f32 (the
+    sums must be exact before quantization — quantizing per-shard partials
+    would compound S rounding errors into the total), then _wire_gather, so
+    the gather half carries the reference's packed int8+f16 wire payload at
+    the same quantization cut point as the ref scheme."""
+    if spec.buffer_float_type == FloatType.Q80:
+        shard = scatter_fn(part, part.ndim - 1)    # (T, dim/S) exact sums
+        return _wire_gather(spec, shard, gather_fn)
+    return psum_fn(part)
+
+
+def _swiglu_local(lw, xb):
+    """Shard-local SwiGLU input bands (w1/w3, or the load-time-fused w13):
+    (T, hidden/S) — shared by both schemes' tails."""
+    if "w13" in lw:  # fused local SwiGLU input bands
+        h13 = matmul(lw["w13"], xb)
+        hid_loc = h13.shape[-1] // 2
+        return silu(h13[..., :hid_loc]) * h13[..., hid_loc:]
+    return silu(matmul(lw["w1"], xb)) * matmul(lw["w3"], xb)
+
+
+def _tp_tail(spec: TransformerSpec, x, lw, ao, gather_fn=_ici_gather,
+             scheme: str = "ref", psum_fn=_ici_psum,
+             scatter_fn=_ici_scatter):
     """Shard-local layer tail: attention output -> wo -> residual -> ffn.
 
-    The four all_gathers here are THE per-layer tp collectives (see module
-    docstring for the reference sync-task mapping); under Q80 buffer mode
-    each moves the real int8+f16 payload (_wire_gather)."""
+    ref scheme: the four all_gathers here are THE per-layer tp collectives
+    (see module docstring for the reference sync-task mapping); under Q80
+    buffer mode each moves the real int8+f16 payload (_wire_gather).
+
+    fused scheme: wo/w2 are input-dim bands consuming the SHARD-LOCAL
+    attention out / hb, so the only per-layer collectives are the two block
+    combines (_combine). The reference's quantize cut points survive as
+    local fake-quants (_wire) where no wire remains.
+    """
+    if scheme == "fused":
+        ao = _wire(spec, ao)                       # ⇄ quantizeMultiheadAtt
+        xb2 = matmul(lw["wo"], ao)                 # (T, dim) partial sums
+        x = x + _combine(spec, xb2, gather_fn, psum_fn,
+                         scatter_fn)               # ⇄ syncMultiheadAtt+syncAtt
+
+        xb = rmsnorm(x, lw["rms_ffn"])
+        xb = _wire(spec, xb)                       # ⇄ quantizeRmfFfn
+        hb = _wire(spec, _swiglu_local(lw, xb))    # ⇄ quantizeFfnA (local)
+        xb2 = matmul(lw["w2"], hb)                 # (T, dim) partial sums
+        return x + _combine(spec, xb2, gather_fn, psum_fn,
+                            scatter_fn)            # ⇄ syncFfnA/B+syncFfn2
     xb = _wire_gather(spec, ao, gather_fn)         # ⇄ syncMultiheadAtt
     xb2 = matmul(lw["wo"], xb)                     # (T, dim/S)
     x = x + _wire_gather(spec, xb2, gather_fn)     # ⇄ syncAtt + residual
 
     xb = rmsnorm(x, lw["rms_ffn"])
     xb = _wire(spec, xb)                           # ⇄ quantizeRmfFfn
-    if "w13" in lw:  # fused local SwiGLU input bands
-        h13 = matmul(lw["w13"], xb)
-        hid_loc = h13.shape[-1] // 2
-        hb = silu(h13[..., :hid_loc]) * h13[..., hid_loc:]
-    else:
-        hb = silu(matmul(lw["w1"], xb)) * matmul(lw["w3"], xb)  # (T, hid/S)
-    hb = _wire_gather(spec, hb, gather_fn)         # ⇄ syncFfnA+syncFfnB
+    hb = _wire_gather(spec, _swiglu_local(lw, xb),
+                      gather_fn)                   # ⇄ syncFfnA+syncFfnB
     xb2 = matmul(lw["w2"], hb)                     # (T, dim/S)
     return x + _wire_gather(spec, xb2, gather_fn)  # ⇄ syncFfn2 + residual
 
 
 def _local_layer(spec: TransformerSpec, n_slices: int, n_sp: int, x, lw,
-                 k_all, v_all, idx, pos, positions, gather_fn=_ici_gather):
+                 k_all, v_all, idx, pos, positions, gather_fn=_ici_gather,
+                 scheme: str = "ref", psum_fn=_ici_psum,
+                 scatter_fn=_ici_scatter):
     """Per-device layer body. x replicated (T, dim); lw holds local tp bands;
     k/v_all hold this device's STACKED (L, sp-chunk, tp-kv-heads, hs) cache
     shard — updated in place at layer ``idx`` (see models/llama.forward on
@@ -298,7 +426,7 @@ def _local_layer(spec: TransformerSpec, n_slices: int, n_sp: int, x, lw,
         ao = sp_cache_attention(spec.head_size, spec.kv_mul, seq_chunk,
                                 sp_index, qh, k_c, v_c, pos)
 
-    x = _tp_tail(spec, x, lw, ao, gather_fn)
+    x = _tp_tail(spec, x, lw, ao, gather_fn, scheme, psum_fn, scatter_fn)
     return x, k_all, v_all
 
 
@@ -332,12 +460,17 @@ def validate_sharding(spec: TransformerSpec, mesh: Mesh) -> None:
 
 
 def make_local_step(spec: TransformerSpec, n_slices: int, n_sp: int,
-                    gather_fn=_ici_gather):
+                    gather_fn=_ici_gather, scheme: str | None = None,
+                    psum_fn=_ici_psum, scatter_fn=_ici_scatter):
     """ONE tp-rank's single-sequence step program (embed -> scanned layers ->
     final norm -> vocab-band logits). This is the function shard_map runs on
     every chip (make_sharded_forward); parallel/shard_sim.py runs the same
-    function on a single chip with a tiling ``gather_fn`` to measure the
-    per-chip cost of shapes too big to run whole (70B tp=8)."""
+    function on a single chip with tiling/identity collective stand-ins
+    (``gather_fn``/``psum_fn``/``scatter_fn``) to measure the per-chip cost
+    of shapes too big to run whole (70B tp=8). ``scheme`` picks the
+    collective schedule (module docstring); default = the active
+    DLLAMA_TP_SCHEME."""
+    scheme = scheme or tp_scheme()
 
     def local_step(params, cache, tokens, pos):
         t_len = tokens.shape[0]
@@ -352,7 +485,8 @@ def make_local_step(spec: TransformerSpec, n_slices: int, n_sp: int,
             lw = layer_view(stacked, lw_slice, idx)
             x, k_all, v_all = _local_layer(spec, n_slices, n_sp, x, lw,
                                            k_all, v_all, idx, pos, positions,
-                                           gather_fn)
+                                           gather_fn, scheme, psum_fn,
+                                           scatter_fn)
             return (x, k_all, v_all), None
 
         idxs = jnp.arange(spec.n_layers, dtype=jnp.int32)
@@ -366,21 +500,24 @@ def make_local_step(spec: TransformerSpec, n_slices: int, n_sp: int,
     return local_step
 
 
-def make_sharded_forward(spec: TransformerSpec, mesh: Mesh):
+def make_sharded_forward(spec: TransformerSpec, mesh: Mesh,
+                         scheme: str | None = None):
     """Build the jitted tensor-parallel forward for this mesh.
 
     Returns fn(params, cache, tokens (T,), pos) -> (logits (T, vocab), cache).
     Works for any tp size on the mesh, including tp=1 (then it reduces to the
     single-chip program; parity across tp sizes is the stage-4 gate of
-    SURVEY.md §7).
+    SURVEY.md §7). ``scheme`` (default: the active DLLAMA_TP_SCHEME) is
+    resolved ONCE here — the built program never re-reads the env.
     """
     n_slices = mesh.shape["tp"]
     n_sp = mesh.shape.get("sp", 1)
+    scheme = scheme or tp_scheme()
     validate_sharding(spec, mesh)
-    local_step = make_local_step(spec, n_slices, n_sp)
+    local_step = make_local_step(spec, n_slices, n_sp, scheme=scheme)
 
     def wrap(params, cache, tokens, pos):
-        in_specs = (param_specs(params), CACHE_SPEC, P(), P())
+        in_specs = (param_specs(params, scheme), CACHE_SPEC, P(), P())
         out_specs = (P(), CACHE_SPEC)
         fn = _shard_map(local_step, mesh=mesh, in_specs=in_specs,
                         out_specs=out_specs)
@@ -438,7 +575,8 @@ def _batch_sp_attention(spec: TransformerSpec, seq_chunk: int, q, k, v,
     return ao.reshape(B, -1), k_all, v_all
 
 
-def make_sharded_forward_batch(spec: TransformerSpec, mesh: Mesh):
+def make_sharded_forward_batch(spec: TransformerSpec, mesh: Mesh,
+                               scheme: str | None = None):
     """Tensor/sequence-parallel lockstep batch decode step (forward_batch
     over the mesh).
 
@@ -447,14 +585,16 @@ def make_sharded_forward_batch(spec: TransformerSpec, mesh: Mesh):
     kv-head-sharded over tp. Per-row math == models/llama.forward_batch
     (same kernels; pos is a shared scalar clock for the lockstep loop or a
     (B,) vector for continuous batching, exactly as in forward_batch);
-    per-layer collectives == make_sharded_forward's (the four all_gathers
-    now carry B rows each, plus the per-row LSE combine over sp). Gates:
-    tp ∈ {2, 4} and sp ∈ {2, 4} logits/tokens match the single-chip batch
-    path (tests/test_batch_tp.py) and the single-chip continuous scheduler
+    per-layer collectives == make_sharded_forward's for the same ``scheme``
+    (ref: four all_gathers, fused: two block combines — now carrying B rows
+    each, plus the per-row LSE combine over sp). Gates: tp ∈ {2, 4} and
+    sp ∈ {2, 4} logits/tokens match the single-chip batch path
+    (tests/test_batch_tp.py) and the single-chip continuous scheduler
     (tests/test_continuous.py).
     """
     n_slices = mesh.shape["tp"]
     n_sp = mesh.shape.get("sp", 1)
+    scheme = scheme or tp_scheme()
     validate_sharding(spec, mesh)
     kv_loc = spec.n_kv_heads // n_slices
     L, S, hs = spec.n_layers, spec.seq_len, spec.head_size
@@ -483,7 +623,7 @@ def make_sharded_forward_batch(spec: TransformerSpec, mesh: Mesh):
             else:
                 ao, k_all, v_all = _batch_sp_attention(
                     spec, C, q, k, v, k_all, v_all, idx, pos, kv_loc, hs)
-            x = _tp_tail(spec, x, lw, ao)
+            x = _tp_tail(spec, x, lw, ao, scheme=scheme)
             return (x, k_all, v_all), None
 
         idxs = jnp.arange(L, dtype=jnp.int32)
@@ -494,7 +634,7 @@ def make_sharded_forward_batch(spec: TransformerSpec, mesh: Mesh):
                                v4.reshape(L, B, C, kv_loc, hs))
 
     def wrap(params, cache, tokens, pos):
-        in_specs = (param_specs(params), CACHE_SPEC_BATCH, P(), P())
+        in_specs = (param_specs(params, scheme), CACHE_SPEC_BATCH, P(), P())
         out_specs = (P(), CACHE_SPEC_BATCH)
         fn = _shard_map(local_step, mesh=mesh, in_specs=in_specs,
                         out_specs=out_specs)

@@ -27,8 +27,12 @@ from .tp import param_specs
 
 
 def _sharding_tree(params: dict[str, Any], mesh: Mesh):
+    # training pins the ref (all-output-band) layout regardless of
+    # DLLAMA_TP_SCHEME: GSPMD owns the training collectives, checkpoints
+    # stay mesh-shape-portable, and the fused scheme's input-dim wo/w2
+    # bands buy nothing here (no per-token latency term to halve)
     return jax.tree_util.tree_map(
-        lambda s: NamedSharding(mesh, s), param_specs(params),
+        lambda s: NamedSharding(mesh, s), param_specs(params, scheme="ref"),
         is_leaf=lambda x: isinstance(x, P))
 
 
@@ -170,7 +174,7 @@ def load_train_state(path: str, spec: TransformerSpec, params_template,
     # else (scalar counts) loads mesh-replicated.
     mesh = next(l.sharding.mesh for _, l in paths_and_leaves
                 if isinstance(l.sharding, NamedSharding))
-    p_specs = param_specs(params_template)
+    p_specs = param_specs(params_template, scheme="ref")  # see _sharding_tree
     repl = NamedSharding(mesh, P())
 
     def leaf_sharding(path, tmpl):

@@ -166,17 +166,22 @@ class ContinuousEngine:
                                     make_sharded_forward_batch, shard_cache,
                                     shard_cache_batch, shard_params,
                                     validate_sharding)
+            from ..parallel.comm_stats import tp_scheme
 
+            scheme = tp_scheme()  # one resolution: decode + prefill +
+            #                       params all run the same schedule
             validate_sharding(spec, mesh)
-            self.params = shard_params(params, mesh)
+            self.params = shard_params(params, mesh, scheme=scheme)
             self.cache = shard_cache_batch(
                 init_cache_batch(spec, slots, dtype), mesh)
-            self._step = make_sharded_forward_batch(spec, mesh)
+            self._step = make_sharded_forward_batch(spec, mesh,
+                                                    scheme=scheme)
             if prefill_chunk > 1:
                 # admission prefill: the sharded single-sequence forward
                 # (T=chunk under sp/tp) fills a sharded scratch cache
                 self._prefill_fwd = _maybe_bf16(
-                    make_sharded_forward(spec, mesh), fast_prefill, jax)
+                    make_sharded_forward(spec, mesh, scheme=scheme),
+                    fast_prefill, jax)
                 self._scratch_cache = lambda: shard_cache(
                     init_cache(spec, dtype), mesh)
         else:

@@ -28,7 +28,7 @@ from ..models.spec import TransformerSpec
 from ..obs.log import log_event
 from ..obs.metrics import summarize_values
 from ..parallel.comm_stats import (CommStats, ici_all_gather_bytes,
-                                   sp_lse_bytes)
+                                   sp_lse_bytes, tp_scheme)
 from .sampling import Sampler
 
 
@@ -52,15 +52,19 @@ class Engine:
         self.tp = mesh.shape["tp"] if mesh is not None else 1
         self.sp = mesh.shape.get("sp", 1) if mesh is not None else 1
         self.sharded = self.tp > 1 or self.sp > 1
+        # resolved ONCE: the engine's program, its comm accounting, and the
+        # stats line all describe the same collective schedule
+        self.tp_scheme = tp_scheme()
         self._loops: dict = {}  # (temp, topp) -> compiled device loop
         if self.sharded:
             from ..parallel import (make_sharded_forward, shard_cache,
                                     shard_params, validate_sharding)
 
             validate_sharding(spec, mesh)  # clear error before any device_put
-            self.params = shard_params(params, mesh)
+            self.params = shard_params(params, mesh, scheme=self.tp_scheme)
             self.cache = shard_cache(init_cache(spec, self.cache_dtype), mesh)
-            self._fwd = make_sharded_forward(spec, mesh)
+            self._fwd = make_sharded_forward(spec, mesh,
+                                             scheme=self.tp_scheme)
             self._step_raw = self._fwd  # shard_map wrapper; traceable in scan
         else:
             from ..models.llama import params_to_device
@@ -205,7 +209,7 @@ class Engine:
             self.cache = shard_cache(self.cache, self.mesh)
 
     def comm_stats(self) -> CommStats:
-        tp_st = ici_all_gather_bytes(self.spec, self.tp)
+        tp_st = ici_all_gather_bytes(self.spec, self.tp, self.tp_scheme)
         sp_st = sp_lse_bytes(self.spec, self.sp, self.tp)
         return CommStats(tp_st.sent_bytes + sp_st.sent_bytes,
                          tp_st.recv_bytes + sp_st.recv_bytes)
@@ -447,10 +451,11 @@ def generate_batch(spec: TransformerSpec, params: dict[str, Any],
         from ..parallel import (make_sharded_forward_batch, shard_cache_batch,
                                 shard_params, validate_sharding)
 
+        scheme = tp_scheme()  # one resolution for program + params
         validate_sharding(spec, mesh)
-        dev_params = shard_params(params, mesh)
+        dev_params = shard_params(params, mesh, scheme=scheme)
         cache0 = shard_cache_batch(init_cache_batch(spec, B, dtype), mesh)
-        step_fn = make_sharded_forward_batch(spec, mesh)
+        step_fn = make_sharded_forward_batch(spec, mesh, scheme=scheme)
         run = make_batch_decode_loop(spec, steps, temperature, topp,
                                      step_fn=step_fn)
     else:

@@ -1,17 +1,17 @@
 """Pin the analytic ICI model to the compiled program (VERDICT r4 #4).
 
 Every multi-chip performance number in this repo carries an ICI term built
-from `comm_stats.ici_all_gather_bytes` (payload) and shard_sim's
-`n_coll = 4*L + 1` (collective count). Until now those were asserted only
-by the same arithmetic that produced them. These tests derive BOTH numbers
-independently from the program itself:
+from `comm_stats.tp_collective_budget` (per-scheme counts AND payload).
+Until now those were asserted only by the same arithmetic that produced
+them. These tests derive BOTH numbers independently from the program
+itself, for BOTH tp schemes (ref and fused — parallel/tp.py):
 
   * jaxpr level — trace `make_sharded_forward` for the REAL 7B/13B/70B
     specs (abstract params; nothing is materialized) on the virtual
     8-device mesh, walk the equation graph with scan-length multiplicity,
     and count every collective primitive with its per-shard payload aval.
-  * compiled level — lower + compile the 7B program on the CPU mesh and
-    count the all-gather instructions XLA actually emitted.
+  * compiled level — lower + compile the small program on the CPU mesh and
+    count the all-gather / all-reduce instructions XLA actually emitted.
 
 If the traced program ever gains/loses a collective, changes a payload
 dtype (e.g. the Q80 wire packing), or the analytic model drifts from what
@@ -37,7 +37,8 @@ from distributed_llama_tpu.models.synth import (_build_tree, llama2_7b_spec,
                                                 small_bench_spec)
 from distributed_llama_tpu.ops.quants import FloatType, batch_bytes
 from distributed_llama_tpu.parallel import make_mesh, make_sharded_forward
-from distributed_llama_tpu.parallel.comm_stats import ici_all_gather_bytes
+from distributed_llama_tpu.parallel.comm_stats import (ici_all_gather_bytes,
+                                                       tp_collective_budget)
 
 
 def _abstract_params(spec: TransformerSpec):
@@ -76,9 +77,9 @@ def _collect_collectives(jaxpr, mult=1):
     return out
 
 
-def _trace_collectives(spec: TransformerSpec, tp: int):
+def _trace_collectives(spec: TransformerSpec, tp: int, scheme: str):
     mesh = make_mesh(tp=tp)
-    fwd = make_sharded_forward(spec, mesh)
+    fwd = make_sharded_forward(spec, mesh, scheme=scheme)
     params = _abstract_params(spec)
     cache = jax.eval_shape(lambda: init_cache(spec, jnp.float32))
     tokens = jax.ShapeDtypeStruct((1,), jnp.int32)
@@ -90,15 +91,13 @@ def _trace_collectives(spec: TransformerSpec, tp: int):
 
 
 def _moved_bytes_per_chip(colls, tp: int) -> int:
-    """Ring all_gather of per-shard payload b over S chips: every chip
-    sends (and receives) (S-1)*b — the same accounting comm_stats uses."""
-    total = 0
-    for name, aval, mult in colls:
-        assert name.startswith("all_gather"), \
-            f"unmodeled collective {name} in the tp forward"
-        shard_bytes = int(np.prod(aval.shape)) * aval.dtype.itemsize
-        total += (tp - 1) * shard_bytes * mult
-    return total
+    """Ring accounting per collective kind — the same model comm_stats and
+    the J001 contract use (jaxpr_contracts._moved_bytes)."""
+    from distributed_llama_tpu.analysis.jaxpr_contracts import (
+        _collective_kind, _moved_bytes)
+
+    return sum(_moved_bytes(_collective_kind(name), aval, tp) * mult
+               for name, aval, mult in colls)
 
 
 _SPECS = {
@@ -108,11 +107,19 @@ _SPECS = {
 }
 
 
+# per (scheme, wire): expected collectives per layer (+1 logits gather)
+_PER_LAYER = {("ref", "f32"): 4, ("ref", "q80"): 4,
+              ("fused", "f32"): 2, ("fused", "q80"): 4}
+
+
 @pytest.mark.parametrize("name", sorted(_SPECS))
 @pytest.mark.parametrize("wire", ["f32", "q80"])
-def test_traced_collectives_match_analytic_model(name, wire):
+@pytest.mark.parametrize("scheme", ["ref", "fused"])
+def test_traced_collectives_match_analytic_model(name, wire, scheme):
     """The traced program's collective count and payload bytes equal the
-    analytic model's, for the real model specs in both buffer modes."""
+    analytic model's, for the real model specs in both buffer modes and
+    both schemes. The fused/f32 row is the ISSUE 3 acceptance bar: <= 2
+    collectives per layer, jaxpr-verified at model scale."""
     spec = _SPECS[name]()
     if wire == "q80":
         import dataclasses
@@ -120,20 +127,21 @@ def test_traced_collectives_match_analytic_model(name, wire):
         spec = dataclasses.replace(spec,
                                    buffer_float_type=FloatType.Q80)
     tp = 8
-    colls = _trace_collectives(spec, tp)
+    colls = _trace_collectives(spec, tp, scheme)
 
-    # count: 4 per-layer gathers + the logits gather (shard_sim's n_coll)
     n_coll = sum(m for _, _, m in colls)
-    assert n_coll == spec.n_layers * 4 + 1
+    assert n_coll == spec.n_layers * _PER_LAYER[(scheme, wire)] + 1
+    assert n_coll == tp_collective_budget(spec, tp, scheme).n_collectives
 
     # payload: per-chip moved bytes == comm_stats (the bench/runtime model)
-    want = ici_all_gather_bytes(spec, tp).sent_bytes
+    want = ici_all_gather_bytes(spec, tp, scheme).sent_bytes
     got = _moved_bytes_per_chip(colls, tp)
     assert got == want, (got, want)
 
-    # the Q80 wire really packs each cut into ONE u8 gather (the count —
-    # whose latency term dominates the ICI budget 13:1 — must not double)
-    if wire == "q80":
+    if wire == "q80" and scheme == "ref":
+        # the Q80 wire really packs each cut into ONE u8 gather (the count
+        # — whose latency term dominates the ICI budget 13:1 — must not
+        # double)
         layer_colls = [c for c in colls if c[2] == spec.n_layers]
         assert len(layer_colls) == 4
         assert all(a.dtype == jnp.uint8 for _, a, _ in layer_colls), \
@@ -144,40 +152,79 @@ def test_traced_collectives_match_analytic_model(name, wire):
                            + [batch_bytes(FloatType.Q80,
                                           spec.hidden_dim // tp)])
         assert dims == want_dims
+    if wire == "q80" and scheme == "fused":
+        # scatter+gather pairs: the gather halves carry the packed Q80
+        # payload of the dim/tp shard; the scatter halves are f32
+        layer_colls = [c for c in colls if c[2] == spec.n_layers]
+        kinds = sorted((n.split("[")[0], str(a.dtype))
+                       for n, a, _ in layer_colls)
+        assert [k for k, _ in kinds].count("reduce_scatter") == 2
+        ag = [(n, a) for n, a, _ in layer_colls
+              if n.startswith("all_gather")]
+        assert len(ag) == 2
+        assert all(a.dtype == jnp.uint8 for _, a in ag)
+        assert all(int(np.prod(a.shape)) ==
+                   batch_bytes(FloatType.Q80, spec.dim // tp)
+                   for _, a in ag)
+    if wire == "f32" and scheme == "fused":
+        # the acceptance shape: 2 full-dim f32 psums per layer, nothing else
+        layer_colls = [c for c in colls if c[2] == spec.n_layers]
+        assert len(layer_colls) == 2
+        assert all(n.startswith("psum") for n, _, _ in layer_colls)
+        assert all(int(np.prod(a.shape)) == spec.dim
+                   for _, a, _ in layer_colls)
 
 
 def test_70b_headline_budget_literals():
-    """The numbers the 70B projection publishes (BASELINE.md): 321
-    collectives moving ~14,669 kB per chip per token with f32 buffers,
-    cut ~3.8x by the Q80 wire. Derived here from the traced program, not
-    from comm_stats."""
+    """The numbers the 70B projection publishes (BASELINE.md): ref scheme
+    321 collectives moving ~14,669 kB per chip per token with f32 buffers,
+    cut ~3.8x by the Q80 wire; fused scheme 161 collectives (~9,070 kB
+    f32). Derived here from the traced program, not from comm_stats."""
     import dataclasses
 
-    colls = _trace_collectives(llama2_70b_spec(), 8)
+    colls = _trace_collectives(llama2_70b_spec(), 8, "ref")
     assert sum(m for _, _, m in colls) == 321
     kb = _moved_bytes_per_chip(colls, 8) / 1024
     assert abs(kb - 14669) < 1.0, kb
 
     spec80 = dataclasses.replace(llama2_70b_spec(),
                                  buffer_float_type=FloatType.Q80)
-    kb80 = _moved_bytes_per_chip(_trace_collectives(spec80, 8), 8) / 1024
+    kb80 = _moved_bytes_per_chip(_trace_collectives(spec80, 8, "ref"),
+                                 8) / 1024
     # ~3.76x on the per-layer cuts, diluted slightly by the always-f32
     # logits gather
     assert 3.6 < kb / kb80 < 3.9, (kb, kb80)
 
+    fused = _trace_collectives(llama2_70b_spec(), 8, "fused")
+    assert sum(m for _, _, m in fused) == 161  # HALF the launches + logits
+    kbf = _moved_bytes_per_chip(fused, 8) / 1024
+    assert abs(kbf - 9070) < 1.0, kbf
 
-def test_compiled_hlo_keeps_the_gathers():
-    """XLA must not merge, split, or eliminate the shard_map gathers: the
-    optimized module for the small spec contains exactly 4 all-gather
-    instructions in the layer loop + 1 for the logits."""
+
+@pytest.mark.parametrize("scheme,want_ag,want_ar", [
+    ("ref", 5, 0),    # 4 loop + 1 logits all-gathers
+    ("fused", 1, 2),  # 2 loop all-reduces + 1 logits all-gather
+])
+def test_compiled_hlo_keeps_the_collectives(scheme, want_ag, want_ar):
+    """XLA must not merge, split, or eliminate the shard_map collectives:
+    the optimized module for the small spec contains exactly the
+    scheduled instructions (the layer loop body appears once). Dense f32
+    abstract weights: the census is dtype-independent, and the small
+    spec's hidden (22 Q40 blocks) cannot input-shard 4 ways — quantized
+    fused runs need hidden/tp as a 32-multiple (real shapes all qualify;
+    shard_params raises the clear error otherwise)."""
+    from distributed_llama_tpu.analysis.jaxpr_contracts import \
+        abstract_params
+
     spec = small_bench_spec()
     tp = 4  # the small spec has 4 heads
     mesh = make_mesh(tp=tp)
-    fwd = make_sharded_forward(spec, mesh)
-    params = _abstract_params(spec)
+    fwd = make_sharded_forward(spec, mesh, scheme=scheme)
+    params = abstract_params(spec)
     cache = jax.eval_shape(lambda: init_cache(spec, jnp.float32))
     tokens = jax.ShapeDtypeStruct((1,), jnp.int32)
     pos = jax.ShapeDtypeStruct((), jnp.int32)
     txt = fwd.lower(params, cache, tokens, pos).compile().as_text()
-    n = txt.count(" all-gather(") + txt.count(" all-gather-start(")
-    assert n == 5, f"expected 4 loop + 1 logits all-gathers, found {n}"
+    n_ag = txt.count(" all-gather(") + txt.count(" all-gather-start(")
+    n_ar = txt.count(" all-reduce(") + txt.count(" all-reduce-start(")
+    assert (n_ag, n_ar) == (want_ag, want_ar), (n_ag, n_ar)

@@ -313,6 +313,61 @@ def test_d005_nested_qualifying_fn_reported_once(tmp_path):
     assert len(d005) == 1, findings  # inner's delta, exactly once
 
 
+# -- D006: tp collective outside the comm-model helpers ----------------------
+
+
+def test_d006_fires_on_inline_collective_in_tp(tmp_path):
+    findings = run_on(tmp_path, "parallel/tp.py", """
+        import jax
+
+        def _tp_tail(spec, x, part):
+            # an inline combine bypasses the comm model
+            return x + jax.lax.psum(part, "tp")
+
+        def _extra_sync(a):
+            return jax.lax.all_gather(a, "tp", axis=0, tiled=True)
+    """)
+    d006 = [f for f in findings if f.rule == "D006"]
+    assert len(d006) == 2, findings
+
+
+def test_d006_quiet_in_helpers_and_outside_tp(tmp_path):
+    # the three blessed helpers may bind collectives; other files (even in
+    # parallel/) are out of scope — ring.py's sp collectives have their own
+    # comm_stats term (sp_lse_bytes) and schedule
+    quiet = run_on(tmp_path, "parallel/tp.py", """
+        import jax
+
+        def _ici_gather(a, axis):
+            return jax.lax.all_gather(a, "tp", axis=axis, tiled=True)
+
+        def _ici_psum(a):
+            return jax.lax.psum(a, "tp")
+
+        def _ici_scatter(a, axis):
+            return jax.lax.psum_scatter(a, "tp", scatter_dimension=axis,
+                                        tiled=True)
+    """)
+    assert "D006" not in rules_fired(quiet)
+    ring = run_on(tmp_path, "parallel/ring.py", """
+        import jax
+
+        def lse_combine(m):
+            return jax.lax.pmax(m, "sp")
+    """)
+    assert "D006" not in rules_fired(ring)
+
+
+def test_d006_pragma_suppresses_with_reason(tmp_path):
+    findings = run_on(tmp_path, "parallel/tp.py", """
+        import jax
+
+        def _debug_probe(a):
+            return jax.lax.psum(a, "tp")  # dlint: allow[D006] probe only
+    """)
+    assert "D006" not in rules_fired(findings)
+
+
 # -- baseline round-trip ----------------------------------------------------
 
 

@@ -19,11 +19,23 @@ def _spec():
 
 
 def test_tp_collectives_match_analytic_model():
-    r = contract_tp_collectives(_spec(), tp=4)
+    # the counts are part of the public claim: ref 4 all_gathers/layer +
+    # logits; fused 2 psums/layer + logits — HALF the launches
+    L = _spec().n_layers
+    r = contract_tp_collectives(_spec(), tp=4, scheme="ref")
     assert r.ok, r.detail
-    # the count is part of the public claim: 4 all_gathers/layer + logits
-    n = 4 * _spec().n_layers + 1
-    assert f"{n} all_gathers" in r.detail
+    assert f"{4 * L + 1} collectives" in r.detail
+    r = contract_tp_collectives(_spec(), tp=4, scheme="fused")
+    assert r.ok, r.detail
+    assert f"{2 * L + 1} collectives" in r.detail
+    assert "'psum': " + str(2 * L) in r.detail
+
+
+def test_tp_collectives_default_scheme_is_env(monkeypatch):
+    # scheme=None resolves DLLAMA_TP_SCHEME exactly like the runtime
+    monkeypatch.setenv("DLLAMA_TP_SCHEME", "ref")
+    r = contract_tp_collectives(_spec(), tp=4)
+    assert r.ok and "[ref]" in r.name, (r.name, r.detail)
 
 
 def test_decode_step_kv_cache_donation_holds():
@@ -39,7 +51,10 @@ def test_decode_step_shape_stability_holds():
 
 def test_run_contracts_reports_all_and_passes():
     results = run_contracts(_spec())
-    assert [r.contract for r in results] == ["J001", "J002", "J003"]
+    # J001 runs once per scheme (ref + fused) — both schedules stay pinned
+    assert [r.contract for r in results] == ["J001", "J001", "J002", "J003"]
+    assert {r.name for r in results if r.contract == "J001"} == {
+        "tp_collectives[ref]", "tp_collectives[fused]"}
     assert all(r.ok for r in results), [r.detail for r in results]
 
 
@@ -52,7 +67,7 @@ def test_contract_failure_becomes_finding_not_crash():
     assert any(not r.ok for r in results)
     # even on a raised error, results keep the documented J-ids (the CLI
     # and contract_findings key on them)
-    assert [r.contract for r in results] == ["J001", "J002", "J003"]
+    assert [r.contract for r in results] == ["J001", "J001", "J002", "J003"]
 
 
 def test_walk_fn_eqns_shim_still_works():

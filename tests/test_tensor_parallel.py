@@ -116,6 +116,21 @@ def test_tp_q80_buffer_wire_quantization():
     assert 0 < diff < 0.15  # Q80 rounding compounds over layers/sync points
 
 
+def test_fused_rejects_unsplittable_q40_blocks():
+    """A Q40 wo/w2 whose input dim cannot split into whole 32-blocks per
+    shard must fail shard_params with the clear constraint, not a
+    shard_map axis-divisibility traceback mid-placement."""
+    from distributed_llama_tpu.models.synth import synth_params
+    from distributed_llama_tpu.parallel import make_mesh, shard_params
+
+    spec = TransformerSpec(dim=64, hidden_dim=160, n_layers=1, n_heads=2,
+                           n_kv_heads=2, vocab_size=64, seq_len=8)
+    p = synth_params(spec, q40=True, seed=5)  # hidden 160 = 5 blocks
+    with pytest.raises(ValueError, match="32-multiple"):
+        shard_params(p, make_mesh(tp=2), scheme="fused")
+    assert shard_params(p, make_mesh(tp=2), scheme="ref")  # ref: fine
+
+
 def test_tp_rejects_indivisible():
     from distributed_llama_tpu.parallel import make_mesh, make_sharded_forward
 
@@ -140,13 +155,26 @@ def test_engine_rejects_indivisible_before_device_put():
         Engine(spec, p, mesh=mesh)
 
 
-def _all_gather_dtypes(fn, *args):
-    """X-ray what the collectives actually carry (shared walker:
-    analysis/jaxpr_contracts.py)."""
-    from distributed_llama_tpu.analysis.jaxpr_contracts import walk_fn_eqns
+def _collective_census(fn, *args):
+    """X-ray what the collectives actually carry: sorted (kind, dtype)
+    pairs, one per collective EQN (the scan body holds the per-layer
+    program once). Shared walker: analysis/jaxpr_contracts.py."""
+    from distributed_llama_tpu.analysis.jaxpr_contracts import (
+        _collective_kind, walk_fn_eqns)
 
-    return sorted(str(e.invars[0].aval.dtype) for e in walk_fn_eqns(fn, *args)
-                  if e.primitive.name == "all_gather")
+    kinds = ("all_gather", "reduce_scatter", "psum", "all_to_all",
+             "ppermute", "pmax", "pmin")
+    return sorted(
+        (_collective_kind(e.primitive.name), str(e.invars[0].aval.dtype))
+        for e in walk_fn_eqns(fn, *args)
+        if e.primitive.name.startswith(kinds))
+
+
+# the small census spec (Q80 needs dim/tp and hidden/tp as 32-multiples)
+_WIRE = TransformerSpec(dim=128, hidden_dim=256, n_layers=2, n_heads=4,
+                        n_kv_heads=4, vocab_size=96, seq_len=16)
+_WIRE80 = TransformerSpec(**{**_WIRE.__dict__,
+                             "buffer_float_type": FloatType.Q80})
 
 
 def test_q80_wire_gathers_carry_int8_payload():
@@ -156,9 +184,9 @@ def test_q80_wire_gathers_carry_int8_payload():
     wire carried f32 while comm_stats claimed the 4x cut). Codes and deltas
     are packed into ONE uint8 buffer of contiguous 34-byte blocks per cut
     (VERDICT r2 #4: separate code/delta gathers doubled the per-collective
-    latency term that dominates the 70B ICI budget). The scan body holds
-    the per-layer program once: expect 4 uint8 gathers there plus the
-    single f32 logits gather; in f32 buffer mode all five are f32.
+    latency term that dominates the 70B ICI budget). Scheme ref: the scan
+    body holds 4 uint8 gathers plus the single f32 logits gather; in f32
+    buffer mode all five are f32 (the reference schedule, unchanged).
     And values must be unchanged: quantize->pack->gather->unpack->dequantize
     equals the round-1 fake-quant path bit for bit, pinned against
     single-chip Q80."""
@@ -169,25 +197,22 @@ def test_q80_wire_gathers_carry_int8_payload():
                                                 make_sharded_forward,
                                                 shard_cache, shard_params)
 
-    base = TransformerSpec(dim=128, hidden_dim=256, n_layers=2, n_heads=4,
-                           n_kv_heads=4, vocab_size=96, seq_len=16)
-    spec80 = TransformerSpec(**{**base.__dict__,
-                                "buffer_float_type": FloatType.Q80})
+    base, spec80 = _WIRE, _WIRE80
     p = _params(base)
     tokens = np.array([4, 8], dtype=np.int32)
     mesh = make_mesh(tp=2)
 
-    sp = shard_params(p, mesh)
+    sp = shard_params(p, mesh, scheme="ref")
     sc = shard_cache(init_cache(spec80), mesh)
-    fwd80 = make_sharded_forward(spec80, mesh)
+    fwd80 = make_sharded_forward(spec80, mesh, scheme="ref")
     toks = jnp.asarray(tokens)
-    assert _all_gather_dtypes(fwd80, sp, sc, toks, jnp.int32(0)) == (
-        ["float32"] + ["uint8"] * 4)
-    fwd32 = make_sharded_forward(base, mesh)
-    assert _all_gather_dtypes(
-        fwd32, shard_params(p, make_mesh(tp=2)),
+    assert _collective_census(fwd80, sp, sc, toks, jnp.int32(0)) == (
+        [("all_gather", "float32")] + [("all_gather", "uint8")] * 4)
+    fwd32 = make_sharded_forward(base, mesh, scheme="ref")
+    assert _collective_census(
+        fwd32, shard_params(p, make_mesh(tp=2), scheme="ref"),
         shard_cache(init_cache(base), mesh), toks,
-        jnp.int32(0)) == ["float32"] * 5
+        jnp.int32(0)) == [("all_gather", "float32")] * 5
 
     # within quant tolerance of the single-chip Q80 path. Not bit-exact by
     # design: the tp program ALSO rounds the wo/w2 outputs (they cross the
@@ -199,6 +224,83 @@ def test_q80_wire_gathers_carry_int8_payload():
     want, _ = forward(spec80, pj, init_cache(spec80), toks, jnp.int32(0))
     got, _ = fwd80(sp, sc, toks, jnp.int32(0))
     assert np.abs(np.asarray(got) - np.asarray(want)).max() < 0.15
+
+
+def test_fused_scheme_collective_census():
+    """The fused scheme's traced schedule: f32 buffers — 2 psums in the
+    scan body (one per block) + the f32 logits gather, nothing else (the
+    ≤2-collectives-per-layer acceptance bar of ISSUE 3, jaxpr-verified
+    again at model scale by test_collective_pinning / J001); Q80 buffers —
+    each psum decomposes into a f32 psum_scatter + a PACKED uint8 gather,
+    preserving the reference's wire-quantization cut on the gather half."""
+    import jax.numpy as jnp
+
+    from distributed_llama_tpu.models.llama import init_cache
+    from distributed_llama_tpu.parallel import (make_mesh,
+                                                make_sharded_forward,
+                                                shard_cache, shard_params)
+
+    p = _params(_WIRE)
+    toks = jnp.asarray([4, 8], jnp.int32)
+    mesh = make_mesh(tp=2)
+
+    fwd32 = make_sharded_forward(_WIRE, mesh, scheme="fused")
+    census = _collective_census(
+        fwd32, shard_params(p, mesh, scheme="fused"),
+        shard_cache(init_cache(_WIRE), mesh), toks, jnp.int32(0))
+    assert census == [("all_gather", "float32"),
+                      ("psum", "float32"), ("psum", "float32")]
+
+    fwd80 = make_sharded_forward(_WIRE80, mesh, scheme="fused")
+    census80 = _collective_census(
+        fwd80, shard_params(p, mesh, scheme="fused"),
+        shard_cache(init_cache(_WIRE80), mesh), toks, jnp.int32(0))
+    assert census80 == [("all_gather", "float32"),
+                        ("all_gather", "uint8"), ("all_gather", "uint8"),
+                        ("reduce_scatter", "float32"),
+                        ("reduce_scatter", "float32")]
+
+
+@pytest.mark.parametrize("tp", [2, 4])
+def test_tp_scheme_parity_ref_vs_fused(tp):
+    """ref-vs-fused equivalence on the synth model (the satellite gate of
+    ISSUE 3): same logits on both wire modes — f32 buffers to fp tolerance
+    (the schemes differ only in summation order: band-concat-then-matmul
+    vs partial-matmul-then-psum), Q80 buffers within the compounded
+    quantization tolerance (the schemes place the rounding cuts at the
+    same reference task boundaries but wire different tensors)."""
+    import jax.numpy as jnp
+
+    from distributed_llama_tpu.models.llama import init_cache
+    from distributed_llama_tpu.parallel import (make_mesh,
+                                                make_sharded_forward,
+                                                shard_cache, shard_params)
+
+    p = _params(_WIRE, seed=31)
+    toks = jnp.asarray([4, 8, 61], jnp.int32)
+    mesh = make_mesh(tp=tp)
+    want = _reference_logits(_WIRE, p, np.asarray(toks))
+
+    outs = {}
+    for spec in (_WIRE, _WIRE80):
+        for scheme in ("ref", "fused"):
+            fwd = make_sharded_forward(spec, mesh, scheme=scheme)
+            got, _ = fwd(shard_params(p, mesh, scheme=scheme),
+                         shard_cache(init_cache(spec), mesh), toks,
+                         jnp.int32(0))
+            outs[(spec.buffer_float_type, scheme)] = np.asarray(got)
+
+    f32_ref = outs[(FloatType.F32, "ref")]
+    f32_fused = outs[(FloatType.F32, "fused")]
+    np.testing.assert_allclose(f32_ref, want, rtol=0, atol=2e-5)
+    np.testing.assert_allclose(f32_fused, want, rtol=0, atol=2e-5)
+    np.testing.assert_allclose(f32_fused, f32_ref, rtol=0, atol=2e-5)
+    # Q80: both schemes within quant tolerance of each other and the f32
+    # logits (the 0.15 bound of the existing Q80 gates)
+    q80_ref = outs[(FloatType.Q80, "ref")]
+    q80_fused = outs[(FloatType.Q80, "fused")]
+    assert np.abs(q80_fused - q80_ref).max() < 0.15
+    assert np.abs(q80_fused - want).max() < 0.15
 
 
 def test_q80_wire_block_byte_layout():

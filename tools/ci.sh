@@ -8,13 +8,16 @@
 # Static analysis runs FIRST: the dlint lint head (tools/dlint.py, also
 # `python -m distributed_llama_tpu.analysis`) fails the gate on any finding
 # not grandfathered in tools/dlint_baseline.txt — a new implicit sync or
-# retrace trap stops the build before 18 minutes of tests do. (The jaxpr
-# contract head runs inside the suite, tests/test_jaxpr_contracts.py;
+# retrace trap stops the build before 18 minutes of tests do — and the
+# jaxpr contract head verifies the program-structure contracts, including
+# J001 for BOTH tp collective schemes (ref and fused; a collective added
+# to the tp forward without its comm_stats term fails here). (The same
+# contracts also run inside the suite, tests/test_jaxpr_contracts.py;
 # tools/ probe scripts are outside the lint surface by design.)
 #
 # Usage: tools/ci.sh [extra pytest args]
 set -eu
 cd "$(dirname "$0")/.."
-python -m distributed_llama_tpu.analysis --lint
+python -m distributed_llama_tpu.analysis --all
 exec python -m pytest tests/ -q -n "${CI_SHARDS:-8}" \
     -m "slow or not slow" "$@"
