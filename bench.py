@@ -502,6 +502,9 @@ def _project_tp(spec, rank_tp: int, ms: float, baseline: float) -> dict:
     }
     for label, p in ([(f"{s:<5} f32", by_scheme[s]) for s in SCHEMES]
                      + [(f"{scheme} q80", proj80)]):
+        fit = (f"fits, {p.hbm_headroom_gib:+.1f} GiB headroom"
+               if p.hbm_fits else
+               f"DOES NOT FIT ({p.hbm_headroom_gib:+.1f} GiB)")
         print(f"collective budget [{label}] (tp={rank_tp}, per token): "
               f"{p.gather_bytes_per_chip / 1024:.0f} kB/chip over "
               f"{p.n_collectives} collectives -> "
@@ -511,7 +514,8 @@ def _project_tp(spec, rank_tp: int, ms: float, baseline: float) -> dict:
               f"(@{ICI_COLLECTIVE_LATENCY_US:.1f} us/hop); "
               f"measured rank compute {p.shard_ms:.3f} ms "
               f"-> projected v5e-8 total {p.total_ms:.3f} ms/token "
-              f"(no-overlap sum)", file=sys.stderr)
+              f"(no-overlap sum); HBM {p.hbm_per_device_gib:.1f} GiB/chip "
+              f"({fit})", file=sys.stderr)
     print(f"latency sensitivity (x10 -> "
           f"{10 * ICI_COLLECTIVE_LATENCY_US:.0f} us/hop, {scheme}): "
           f"f32 {lat10['f32_total_ms']:.3f} ms, "
@@ -528,6 +532,10 @@ def _project_tp(spec, rank_tp: int, ms: float, baseline: float) -> dict:
             "ici_gather_kb_per_chip_per_token":
                 round(p.gather_bytes_per_chip / 1024, 1),
             "n_collectives_per_token": p.n_collectives,
+            # shardcheck's memory model: does this config FIT the chip?
+            "hbm_per_device_gib": p.hbm_per_device_gib,
+            "hbm_headroom_gib": p.hbm_headroom_gib,
+            "hbm_fits": p.hbm_fits,
         }
 
     schemes_out = {s: row(p) for s, p in by_scheme.items()}
@@ -547,6 +555,9 @@ def _project_tp(spec, rank_tp: int, ms: float, baseline: float) -> dict:
         "ici_gather_kb_per_chip_per_token":
             round(proj.gather_bytes_per_chip / 1024, 1),
         "n_collectives_per_token": proj.n_collectives,
+        "hbm_per_device_gib": proj.hbm_per_device_gib,
+        "hbm_headroom_gib": proj.hbm_headroom_gib,
+        "hbm_fits": proj.hbm_fits,
         "buffer_modes": {"f32": row(proj), "q80_wire": row(proj80)},
         "schemes_f32": schemes_out,
         "ici_latency_sensitivity_10x": lat10,

@@ -267,11 +267,17 @@ int64_t tok_encode(void* handle, const uint8_t* text, int64_t len,
     }
 
     // greedy highest-score merges (reference tokenizer.cpp:169-194)
+    const int32_t n_pieces = (int32_t)v->pieces.size();
     while (true) {
         float best_score = -1e10f;
         int32_t best_id = -1;
         int64_t best_idx = -1;
         for (int64_t k = 0; k + 1 < (int64_t)toks.size(); k++) {
+            // byte-fallback ids (byte + 3) have no piece when the vocab
+            // is smaller than 259: they can never merge, and indexing
+            // pieces[] with them reads out of bounds (ASan-found)
+            if (toks[(size_t)k] >= n_pieces
+                || toks[(size_t)k + 1] >= n_pieces) continue;
             std::string merged = v->pieces[(size_t)toks[(size_t)k]]
                                + v->pieces[(size_t)toks[(size_t)k + 1]];
             auto it = v->lookup.find(merged);

@@ -1,22 +1,33 @@
-"""Static analysis for the TPU port: AST hazard lint + jaxpr contracts.
+"""Static analysis for the TPU port: AST lint + jaxpr contracts + shardcheck.
 
-Two heads, one gate (``python -m distributed_llama_tpu.analysis``, alias
-``tools/dlint.py``):
+Three heads, one gate (``python -m distributed_llama_tpu.analysis``, alias
+``tools/dlint.py``; shardcheck's JSON surface is ``tools/shardcheck.py``):
 
-* ``rules.py`` — pure-AST hazard rules (D001–D005) over the package
+* ``rules.py`` — pure-AST hazard rules (D001–D007) over the package
   source: implicit device->host syncs in hot paths, jit retrace traps,
-  closure hygiene, per-step host allocation, and unsynced timing. No jax
-  import needed; runs in milliseconds; gated in tier-1 CI
-  (tests/test_dlint_repo.py) against ``tools/dlint_baseline.txt``.
+  closure hygiene, per-step host allocation, unsynced timing, unmodeled
+  tp collectives, and implicit dtype promotion. No jax import needed;
+  runs in milliseconds; gated in tier-1 CI (tests/test_dlint_repo.py)
+  against ``tools/dlint_baseline.txt``.
 * ``jaxpr_contracts.py`` — traces the real entry points on CPU
   (make_jaxpr / eval_shape / lower; no compile, no data) and pins program
-  structure: per-layer collective schedule vs parallel/comm_stats.py,
-  KV-cache donation on the decode step, and decode shape stability.
+  structure: per-layer collective schedule vs parallel/comm_stats.py
+  (J001), KV-cache donation on the decode step (J002), and decode shape
+  stability (J003).
+* ``shardcheck.py`` + ``memory_model.py`` — proves, per (model, tp,
+  scheme, dtype) config of the declared support matrix, that the traced
+  sharding matches parallel/tp.py's contract with no replicated weights
+  (J004), Q40 blocks dequantize only at registered sites (J005), shards
+  are rank-uniform (J006), and the closed-form per-device HBM footprint
+  (weight shards + KV cache + traced activation peak + collective
+  staging) fits the device budget with headroom — gated in tier-1 by
+  tests/test_shardcheck_repo.py.
 
-The reference C++ program wears its sync points and transfer sizes in the
-source; JAX tracing hides ours. PR 1's telemetry *measures* regressions at
-run time — this subsystem *prevents* the known classes of them at test
-time.
+The reference C++ program wears its sync points, transfer sizes, and
+per-node memory in the source; JAX tracing hides ours. PR 1's telemetry
+*measures* regressions at run time — this subsystem *prevents* the known
+classes of them (including the most expensive one: an OOM or silent full
+replication discovered mid-TPU-session) at test time.
 """
 
 from .jaxpr_contracts import (run_contracts, walk_eqns,  # noqa: F401

@@ -2,16 +2,20 @@
 
     --lint            AST hazard rules over the package source (default)
     --contracts       jaxpr program-structure contracts (traces on CPU)
-    --all             both heads
+    --shardcheck      sharding & HBM-footprint verifier over the support
+                      matrix (J004/J005/J006 + budget; tools/shardcheck.py
+                      emits the same run as JSON)
+    --shardcheck-matrix PATH  JSON support-matrix override for --shardcheck
+    --all             all three heads
     --baseline PATH   grandfathered-findings file
                       (default tools/dlint_baseline.txt)
     --write-baseline  rewrite the baseline from current findings and exit 0
     --no-baseline     report every finding, baseline ignored
 
-Exit status: 0 = no new findings and all contracts hold; 1 = findings;
-2 = usage error. The contract head forces JAX_PLATFORMS=cpu and an 8-way
-virtual host mesh BEFORE jax initializes, so it is safe (and fast) on a
-box with a TPU attached.
+Exit status: 0 = no new findings and all contracts/configs hold; 1 =
+findings; 2 = usage error. The contract and shardcheck heads force
+JAX_PLATFORMS=cpu and an 8-way virtual host mesh BEFORE jax initializes,
+so they are safe (and fast) on a box with a TPU attached.
 """
 
 from __future__ import annotations
@@ -34,7 +38,12 @@ def main(argv=None) -> int:
                     help="run the AST hazard rules (default)")
     ap.add_argument("--contracts", action="store_true",
                     help="run the jaxpr contracts (imports jax, CPU-only)")
-    ap.add_argument("--all", action="store_true", help="both heads")
+    ap.add_argument("--shardcheck", action="store_true",
+                    help="verify sharding + HBM budgets over the support "
+                         "matrix (imports jax, CPU-only)")
+    ap.add_argument("--shardcheck-matrix", type=Path, default=None,
+                    help="JSON support-matrix override for --shardcheck")
+    ap.add_argument("--all", action="store_true", help="all three heads")
     ap.add_argument("--baseline", type=Path, default=DEFAULT_BASELINE,
                     help=f"baseline file (default {DEFAULT_BASELINE})")
     ap.add_argument("--write-baseline", action="store_true",
@@ -48,8 +57,14 @@ def main(argv=None) -> int:
     # --write-baseline is a lint-head operation: it implies --lint, so
     # `--contracts --write-baseline` can't silently skip the rewrite
     do_lint = (args.lint or args.all or args.write_baseline
-               or not args.contracts)
+               or not (args.contracts or args.shardcheck
+                       or args.shardcheck_matrix is not None))
     do_contracts = args.contracts or args.all
+    # a matrix override implies the head that consumes it (same rule as
+    # --write-baseline implying --lint): a forgotten --shardcheck must not
+    # silently skip the drift gate the matrix encodes
+    do_shardcheck = (args.shardcheck or args.all
+                     or args.shardcheck_matrix is not None)
     if args.write_baseline and args.paths:
         # the baseline is global: rewriting it from a partial scan would
         # silently drop every grandfathered entry for unscanned files
@@ -99,8 +114,8 @@ def main(argv=None) -> int:
         if new:
             status = 1
 
-    if do_contracts:
-        # the contracts trace on a virtual CPU mesh regardless of what
+    if do_contracts or do_shardcheck:
+        # the traced heads run on a virtual CPU mesh regardless of what
         # hardware is attached. The env vars must land before jax's
         # backend initializes — and an axon sitecustomize sets
         # jax_platforms='axon,cpu' as EXPLICIT config at interpreter
@@ -114,6 +129,8 @@ def main(argv=None) -> int:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+
+    if do_contracts:
         from .jaxpr_contracts import run_contracts
 
         results = run_contracts()
@@ -123,6 +140,29 @@ def main(argv=None) -> int:
                   f"{r.detail}")
             if not r.ok:
                 status = 1
+
+    if do_shardcheck:
+        from .memory_model import GIB
+        from .shardcheck import load_matrix, run_shardcheck
+
+        matrix = (load_matrix(args.shardcheck_matrix)
+                  if args.shardcheck_matrix else None)
+        results = run_shardcheck(matrix)
+        n_bad = 0
+        for r in results:
+            if r.ok:
+                rep = r.report
+                print(f"shardcheck: {r.config} ok "
+                      f"{'fits' if rep.fits else 'no-fit (as declared)'}, "
+                      f"{rep.total_bytes / GIB:.2f} GiB/chip, headroom "
+                      f"{rep.headroom_bytes / GIB:+.2f} GiB")
+            else:
+                n_bad += 1
+                for f in r.findings:
+                    print(f.render())
+        print(f"shardcheck: {len(results)} config(s), {n_bad} violating")
+        if n_bad:
+            status = 1
 
     return status
 

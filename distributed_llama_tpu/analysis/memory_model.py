@@ -1,0 +1,320 @@
+"""Closed-form per-device HBM accounting for the sharded serving path.
+
+Megatron-LM budgets per-device memory analytically before a job ever
+touches an accelerator; vLLM refuses to serve a config that cannot fit.
+This module is that arithmetic for our (model, tp, scheme, dtype) grid —
+every term hand-checkable against the spec dims:
+
+  weights      Q40 shards resident in the Pallas kernel layout (16 B codes
+               + 4 B f32 scale per 32-block — see io/loader.to_kernel_layout;
+               the on-disk codec layout is 18 B/block, ``q40_codec_bytes``),
+               f16/f32 shards at 2/4 B per value. Every matmul weight is
+               sharded 1/tp in BOTH schemes (output bands everywhere in
+               ref; wo/w2 flip to input bands in fused — same byte count).
+  replicated   the f32 embedding table + rms norms every chip holds whole.
+  kv_cache     2 (K and V) x L x B x S/sp x n_kv/tp x head_size planes.
+  activations  live-interval peak of the traced rank program
+               (``live_interval_peak``; analysis/shardcheck.py feeds it the
+               shard_map body), or the closed-form vector bound
+               (``activation_bytes_analytic``) on no-trace paths like the
+               bench projection column.
+  collectives  double-buffer staging for the largest in-flight collective
+               (parallel/comm_stats.collective_staging_bytes — same cut
+               points as the ICI byte budget).
+
+The budget table is v5e-centric (16 GiB HBM/chip) with a 10% headroom
+reserve for the XLA runtime, compiled executables, and fragmentation; a
+config "fits" when the component total stays inside the usable fraction.
+``analysis/shardcheck.py`` gates the declared support matrix on these
+verdicts; ``parallel/shard_sim.project_full_system`` and bench.py surface
+the same fits/headroom numbers next to every multi-chip projection.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from ..models.spec import TransformerSpec
+from ..ops.quants import QK, FloatType
+
+GIB = 1024 ** 3
+
+# Per-device HBM by accelerator. v5e is the measurement platform of record
+# (BASELINE.json); add entries as new device kinds appear in bench rows.
+DEVICE_HBM_BYTES = {"v5e": 16 * GIB}
+# Fraction of HBM reserved for the XLA runtime/executables/fragmentation —
+# the footprint must fit in (1 - headroom) * HBM.
+HBM_HEADROOM_FRACTION = 0.10
+
+Q40_KERNEL_BLOCK_BYTES = 16 + 4   # u8 nibble planes + f32 scale (resident)
+Q40_CODEC_BLOCK_BYTES = 16 + 2    # u8 nibble planes + f16 delta (file/wire)
+
+
+def usable_hbm_bytes(device: str = "v5e") -> int:
+    return int(DEVICE_HBM_BYTES[device] * (1 - HBM_HEADROOM_FRACTION))
+
+
+def q40_kernel_bytes(values: int) -> int:
+    """Resident bytes of ``values`` Q40-quantized scalars in the Pallas
+    kernel layout (f32 scales — io/loader.to_kernel_layout)."""
+    return (values // QK) * Q40_KERNEL_BLOCK_BYTES
+
+
+def q40_codec_bytes(values: int) -> int:
+    """File/wire bytes of ``values`` Q40 scalars (f16 deltas)."""
+    return (values // QK) * Q40_CODEC_BLOCK_BYTES
+
+
+def weight_values_per_device(spec: TransformerSpec, n_slices: int) -> int:
+    """Matmul-weight scalars per device: all 7 per-layer matmuls plus wcls
+    shard exactly 1/tp of their values in both schemes (tp.py)."""
+    per_layer = sum(d * n for _, (d, n) in spec.layer_matmul_shapes())
+    total = spec.n_layers * per_layer + spec.vocab_size * spec.dim
+    return total // n_slices
+
+
+def weights_device_bytes(spec: TransformerSpec, n_slices: int) -> int:
+    """Resident bytes of this device's matmul-weight shards."""
+    values = weight_values_per_device(spec, n_slices)
+    ft = spec.weights_float_type
+    if ft == FloatType.Q40:
+        return q40_kernel_bytes(values)
+    if ft == FloatType.F16:
+        return 2 * values
+    if ft == FloatType.F32:
+        return 4 * values
+    raise ValueError(f"no weight byte model for {ft!r}")
+
+
+def replicated_device_bytes(spec: TransformerSpec) -> int:
+    """Bytes every chip holds whole regardless of tp: the f32 embedding
+    table and the rms norm vectors (2 per layer + final)."""
+    embedding = spec.vocab_size * spec.dim * 4
+    norms = (2 * spec.n_layers + 1) * spec.dim * 4
+    return embedding + norms
+
+
+def kv_cache_device_bytes(spec: TransformerSpec, n_slices: int,
+                          batch: int = 1, n_sp: int = 1,
+                          cache_itemsize: int = 4) -> int:
+    """K+V planes at max sequence: kv heads shard over tp, sequence chunks
+    over sp (tp.CACHE_SPEC / CACHE_SPEC_BATCH)."""
+    return (2 * spec.n_layers * batch * (spec.seq_len // n_sp)
+            * (spec.n_kv_heads // n_slices) * spec.head_size
+            * cache_itemsize)
+
+
+def activation_bytes_analytic(spec: TransformerSpec, n_slices: int,
+                              t_len: int = 1) -> int:
+    """No-trace activation bound for projection columns: the residual
+    stream + norm buffer + local qkv/swiglu bands + full and local logits,
+    all f32. The traced live-interval peak (shardcheck) supersedes this
+    where a jaxpr is available; both land within a few MB of each other at
+    decode shapes — activations are a rounding error next to weights/KV."""
+    s = n_slices
+    vecs = (4 * spec.dim                      # x, xb, gathered block outs
+            + 2 * (spec.hidden_dim // s)      # swiglu bands
+            + (spec.dim + 2 * spec.kv_dim) // s   # local q/k/v
+            + spec.vocab_size + spec.vocab_size // s)  # logits full + band
+    return 4 * t_len * vecs
+
+
+# -- live-interval walk -----------------------------------------------------
+
+
+def _aval_bytes(aval) -> int:
+    shape = getattr(aval, "shape", None)
+    dtype = getattr(aval, "dtype", None)
+    if shape is None or dtype is None:
+        return 0
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return n * dtype.itemsize
+
+
+def sub_jaxprs(eqn):
+    """Inner jaxprs of an eqn (scan/while/cond/pjit bodies, tuple-valued
+    branch params included), unwrapped to raw Jaxpr — the ONE recursion
+    helper for both the live walk and shardcheck's eqn searches."""
+    out = []
+    for v in eqn.params.values():
+        vals = v if isinstance(v, (tuple, list)) else (v,)
+        for item in vals:
+            # unwrap ClosedJaxpr (which also proxies .eqns) to its Jaxpr
+            inner = getattr(item, "jaxpr", None)
+            if inner is not None and hasattr(inner, "eqns"):
+                out.append(inner)
+            elif hasattr(item, "eqns") and hasattr(item, "outvars"):
+                out.append(item)
+    return out
+
+
+def live_interval_peak(jaxpr, exclude_eqn=None) -> int:
+    """Peak bytes of simultaneously-live *intermediate* values in ``jaxpr``.
+
+    A linear walk over the eqns in program order: each eqn allocates its
+    outputs, and a value is freed after its last use — the classic live-
+    interval model of a straight-line allocator. What the model charges:
+
+    * jaxpr invars/constvars are NOT counted — weights, cache, and tokens
+      are accounted by the closed-form components (and per-layer weight
+      slices of a scan over top-level invars are a CPU-fallback artifact:
+      the serving path reads stacked Q40 weights in place via scalar
+      prefetch, ops/linear.StackedQ40);
+    * a ``dynamic_update_slice`` whose operand is dead after the eqn (or is
+      an untracked input — the donated-cache carry) updates in place, and a
+      scan/while carry output whose carry INIT is untracked or dies at the
+      loop aliases that init: zero new bytes — mirroring XLA's donation and
+      loop-carry aliasing on the real device (the decode cache rides the
+      scan carry donated; charging it again would double-count the KV
+      component);
+    * control-flow eqns recurse: a scan's peak is its body's peak (plus the
+      per-iteration slices of any *intermediate* scanned xs), branches take
+      the max, and the inner peak lands on top of everything live outside;
+    * ``exclude_eqn(eqn)`` -> True drops that eqn's outputs from the model —
+      shardcheck passes the dequant-site filter so registered XLA-fallback
+      dequant transients (absent on the Pallas path) don't read as serving
+      HBM.
+    """
+    def is_var(v) -> bool:
+        # core.Var (hashable, has aval); Literals carry .val and are not
+        # hashable — they hold no buffer and are skipped
+        return hasattr(v, "aval") and not hasattr(v, "val")
+
+    eqns = list(jaxpr.eqns)
+    last_use: dict = {}
+    for i, eqn in enumerate(eqns):
+        for v in eqn.invars:
+            if is_var(v):
+                last_use[v] = i
+    for v in jaxpr.outvars:
+        if is_var(v):
+            last_use[v] = len(eqns)
+
+    live: dict = {}       # var -> counted bytes
+    live_total = 0
+    peak = 0
+    for i, eqn in enumerate(eqns):
+        prim = eqn.primitive.name
+        excluded = exclude_eqn is not None and exclude_eqn(eqn)
+
+        def freeable(v, i=i):
+            # an operand that is untracked (jaxpr input: donated/accounted
+            # elsewhere) or dead after this eqn can be updated in place
+            return not is_var(v) or v not in live \
+                or last_use.get(v, -1) == i
+
+        alias_out: set = set()
+        if prim == "dynamic_update_slice" and eqn.invars \
+                and freeable(eqn.invars[0]):
+            alias_out.add(id(eqn.outvars[0]))
+        elif prim == "scan":
+            nc = eqn.params.get("num_consts", 0)
+            ncar = eqn.params.get("num_carry", 0)
+            for k in range(min(ncar, len(eqn.outvars))):
+                if freeable(eqn.invars[nc + k]):
+                    alias_out.add(id(eqn.outvars[k]))
+        elif prim == "while":
+            n_carry = len(eqn.outvars)
+            inits = eqn.invars[len(eqn.invars) - n_carry:]
+            for k, init in enumerate(inits):
+                if freeable(init):
+                    alias_out.add(id(eqn.outvars[k]))
+
+        inner = 0
+        subs = sub_jaxprs(eqn)
+        if subs:
+            inner = max(live_interval_peak(s, exclude_eqn) for s in subs)
+            if prim == "scan":
+                n_xs = (len(eqn.invars) - eqn.params.get("num_consts", 0)
+                        - eqn.params.get("num_carry", 0))
+                length = max(int(eqn.params.get("length", 1)), 1)
+                for v in eqn.invars[len(eqn.invars) - n_xs:]:
+                    if is_var(v) and v in live:
+                        # intermediate xs: per-iteration slice copy
+                        inner += live[v] // length
+
+        counted = []
+        if not excluded:
+            counted = [v for v in eqn.outvars
+                       if is_var(v) and id(v) not in alias_out]
+        out_bytes = sum(_aval_bytes(v.aval) for v in counted)
+        peak = max(peak, live_total + out_bytes + inner)
+        for v in counted:
+            live[v] = _aval_bytes(v.aval)
+            live_total += live[v]
+        for v in eqn.invars + list(eqn.outvars):
+            if is_var(v) and v in live and last_use.get(v, -1) <= i:
+                live_total -= live.pop(v)
+    return peak
+
+
+# -- the assembled report ---------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class MemoryReport:
+    """Per-device HBM footprint of one (spec, tp, scheme) config."""
+
+    model: str
+    tp: int
+    scheme: str
+    weights_float_type: str
+    weights_bytes: int
+    replicated_bytes: int
+    kv_cache_bytes: int
+    activation_bytes: int
+    collective_bytes: int
+    budget_bytes: int
+
+    @property
+    def total_bytes(self) -> int:
+        return (self.weights_bytes + self.replicated_bytes
+                + self.kv_cache_bytes + self.activation_bytes
+                + self.collective_bytes)
+
+    @property
+    def headroom_bytes(self) -> int:
+        return self.budget_bytes - self.total_bytes
+
+    @property
+    def fits(self) -> bool:
+        return self.headroom_bytes >= 0
+
+    def as_json(self) -> dict:
+        gib = {k: round(getattr(self, k) / GIB, 3)
+               for k in ("weights_bytes", "replicated_bytes",
+                         "kv_cache_bytes", "activation_bytes",
+                         "collective_bytes")}
+        return {
+            "model": self.model, "tp": self.tp, "scheme": self.scheme,
+            "weights_float_type": self.weights_float_type,
+            "components_gib": {k.replace("_bytes", ""): v
+                               for k, v in gib.items()},
+            "total_gib": round(self.total_bytes / GIB, 3),
+            "budget_gib": round(self.budget_bytes / GIB, 3),
+            "headroom_gib": round(self.headroom_bytes / GIB, 3),
+            "fits": self.fits,
+        }
+
+
+def device_footprint(spec: TransformerSpec, n_slices: int, scheme: str,
+                     model: str = "?", batch: int = 1,
+                     activation_bytes: int | None = None,
+                     device: str = "v5e") -> MemoryReport:
+    """Assemble the per-device report; ``activation_bytes`` overrides the
+    analytic bound with a traced live-interval peak when available."""
+    from ..parallel.comm_stats import collective_staging_bytes
+
+    if activation_bytes is None:
+        activation_bytes = activation_bytes_analytic(spec, n_slices)
+    return MemoryReport(
+        model=model, tp=n_slices, scheme=scheme,
+        weights_float_type=FloatType(spec.weights_float_type).name,
+        weights_bytes=weights_device_bytes(spec, n_slices),
+        replicated_bytes=replicated_device_bytes(spec),
+        kv_cache_bytes=kv_cache_device_bytes(spec, n_slices, batch=batch),
+        activation_bytes=int(activation_bytes),
+        collective_bytes=collective_staging_bytes(spec, n_slices, scheme),
+        budget_bytes=usable_hbm_bytes(device))

@@ -14,6 +14,8 @@ at lint time:
   D004  per-step list-comp feeding jnp.asarray in the decode step
   D005  time.time() deltas around device work without block_until_ready
   D006  tp collective issued outside parallel/tp.py's _ici_* helpers
+  D007  implicit dtype promotion: a bf16/f16 value mixed with an explicit
+        f32 operand silently upcasts the whole expression
 
 False-positive policy: rules stay *narrow* (better to miss a hazard than to
 train people to pragma reflexively); intentional sites carry
@@ -356,5 +358,89 @@ def d006_unmodeled_collective(ctx: ModuleContext) -> Iterator[Finding]:
             d006_unmodeled_collective.hint)
 
 
+# dtype names on each side of the D007 promotion hazard, post alias
+# resolution (jnp -> jax.numpy). String forms cover .astype("bfloat16").
+_LOW_DTYPES = frozenset(("jax.numpy.bfloat16", "jax.numpy.float16",
+                         "numpy.float16", "bfloat16", "float16"))
+_F32_DTYPES = frozenset(("jax.numpy.float32", "numpy.float32", "float32"))
+# calls whose RESULT is a strong-typed f32/f64 scalar or array — unlike a
+# bare Python literal (weak-typed, keeps the array's dtype), these win the
+# promotion against a bf16/f16 operand
+_F32_CONSTRUCTORS = frozenset(("jax.numpy.float32", "numpy.float32",
+                               "numpy.float64"))
+
+
+@rule("D007", "implicit dtype promotion to f32 in a low-precision path",
+      "pick ONE dtype for the expression: cast the constant/operand to the "
+      "bf16/f16 side (or the value to f32 explicitly) — a silent upcast "
+      "doubles the bytes of every downstream read",
+      scope=("ops/", "parallel/"))
+def d007_dtype_promotion(ctx: ModuleContext) -> Iterator[Finding]:
+    """Arithmetic mixing a KNOWN-low-precision local (assigned from
+    ``.astype(jnp.bfloat16/float16)`` or a dtype=bf16/f16 builder) with an
+    EXPLICIT f32 operand (``jnp.float32(...)``/``np.float32(...)``
+    constructors — strong-typed, unlike weak Python literals — or a local
+    assigned from ``.astype(jnp.float32)``). JAX promotes the whole
+    expression to f32 silently: the Q40/bf16 memory saving evaporates one
+    op downstream, with no error and no visible cast. Stays narrow by
+    design: both sides must be provably typed within the same function —
+    a bare ``x * 0.5`` never fires (weak scalars keep the array dtype)."""
+
+    def dtype_class(expr) -> str | None:
+        """'low' / 'f32' for a dtype-expression (jnp.bfloat16, "float16",
+        np.float32, ...), else None."""
+        name = None
+        if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+            name = expr.value
+        elif isinstance(expr, (ast.Attribute, ast.Name)):
+            name = ctx.dotted(expr)
+        if name in _LOW_DTYPES:
+            return "low"
+        if name in _F32_DTYPES:
+            return "f32"
+        return None
+
+    def value_class(expr, local) -> str | None:
+        """'low' / 'f32' for a value expression: a tracked local name, an
+        .astype(...) call, or a dtype=... builder / f32 constructor."""
+        if isinstance(expr, ast.Name):
+            return local.get(expr.id)
+        if not isinstance(expr, ast.Call):
+            return None
+        if (isinstance(expr.func, ast.Attribute)
+                and expr.func.attr == "astype" and expr.args):
+            return dtype_class(expr.args[0])
+        if ctx.call_target(expr) in _F32_CONSTRUCTORS:
+            return "f32"
+        for kw in expr.keywords:
+            if kw.arg == "dtype":
+                return dtype_class(kw.value)
+        return None
+
+    # per-function map of local name -> 'low' | 'f32'
+    locals_of: dict = {}
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            cls = value_class(node.value, {})
+            if cls is not None:
+                fn = ctx.enclosing_function(node)
+                locals_of.setdefault(fn, {})[node.targets[0].id] = cls
+
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.BinOp):
+            continue
+        local = locals_of.get(ctx.enclosing_function(node), {})
+        sides = {value_class(node.left, local),
+                 value_class(node.right, local)}
+        if sides == {"low", "f32"}:
+            yield _finding(
+                ctx, node, "D007",
+                "bf16/f16 value mixed with an explicit f32 operand — the "
+                "expression silently upcasts to f32",
+                d007_dtype_promotion.hint)
+
+
 RULES = (d001_implicit_sync, d002_retrace_trap, d003_jit_closure,
-         d004_hot_loop_alloc, d005_bare_time, d006_unmodeled_collective)
+         d004_hot_loop_alloc, d005_bare_time, d006_unmodeled_collective,
+         d007_dtype_promotion)

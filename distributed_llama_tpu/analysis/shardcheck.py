@@ -1,0 +1,467 @@
+"""shardcheck: static sharding & HBM-footprint verifier for the tp grid.
+
+Third analysis head, beside the AST lint (rules.py) and the jaxpr
+contracts (jaxpr_contracts.py). For every config in the declared support
+matrix — model in {7B, 13B, 70B} x tp in {1,2,4,8} x scheme in
+{ref, fused} x weights in {Q40, F16} — it proves, statically, on CPU,
+with zero weight bytes materialized:
+
+  HBM     the per-device footprint (analysis/memory_model.py: weight
+          shards, replicated tensors, KV cache at max sequence, traced
+          activation peak, collective staging) fits the device budget with
+          headroom, and the verdict AGREES with the declared matrix — a
+          config that stops fitting fails loudly, and a config that starts
+          fitting flags the matrix as stale. Megatron budgets memory this
+          way before a job starts; vLLM rejects un-servable configs before
+          serving — this is the same gate for our grid, where an OOM or a
+          silent full replication on an 8-chip 70B run is the most
+          expensive bug class we can hit.
+  J004    the traced program's per-operand sharding (shard_map in_names)
+          equals parallel/tp.py's declared contract
+          (tp.expected_shard_names), and no matmul-weight operand rides
+          replicated on a tp>1 mesh (an accidental everywhere-copy /
+          all-gather of weight bytes).
+  J005    no weight-scale int->f32 materialization outside the registered
+          dequant sites (ops/dequant_sites.py) — a rogue dequant is an 8x
+          HBM transient the memory model does not account for.
+  J006    shapes shard uniformly: ragged head/vocab/block bands would give
+          every rank a different program (one compile per rank) — reported
+          as findings instead of a mid-load traceback.
+
+Traces ride ``jax.make_jaxpr`` over abstract trees (ShapeDtypeStruct
+leaves), so even the 70B grid verifies in seconds. Run under
+JAX_PLATFORMS=cpu with an 8-device virtual mesh (the CLI forces it, like
+the contract head); ``tools/shardcheck.py`` emits the machine-readable
+JSON report that PARITY.md's footprint table is generated from.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from pathlib import Path
+
+from ..parallel.comm_stats import SCHEMES
+from .memory_model import (GIB, MemoryReport, device_footprint,
+                           live_interval_peak, sub_jaxprs)
+
+# avals at or above this many bytes count as "weight-shaped" for the J004
+# replication hazard and the J005 rogue-dequant detector (activation
+# vectors at decode shapes sit orders of magnitude below it)
+WEIGHT_BYTES_THRESHOLD = 1 << 18
+
+MODELS = ("7b", "13b", "70b")
+WEIGHT_TYPES = ("q40", "f16")
+
+# The declared support matrix: per (model, weights) x tp, does the config
+# fit a v5e chip (16 GiB, 10% headroom reserve)? Derived from the closed-
+# form footprint and pinned here so MODEL DRIFT IS LOUD: if the memory
+# model (or a spec dim) changes a verdict, shardcheck fails until this
+# table is consciously updated. The scheme does not move a verdict (both
+# schemes shard every matmul 1/tp; only the ~KB staging term differs).
+_EXPECT_FITS = {
+    ("7b", "q40"): {1: True, 2: True, 4: True, 8: True},
+    ("7b", "f16"): {1: False, 2: True, 4: True, 8: True},
+    ("13b", "q40"): {1: True, 2: True, 4: True, 8: True},
+    ("13b", "f16"): {1: False, 2: True, 4: True, 8: True},
+    ("70b", "q40"): {1: False, 2: False, 4: True, 8: True},
+    ("70b", "f16"): {1: False, 2: False, 4: False, 8: False},
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class MatrixEntry:
+    model: str
+    tp: int
+    scheme: str
+    wtype: str
+    expect_fits: bool
+
+    @property
+    def label(self) -> str:
+        return f"{self.model}-tp{self.tp}-{self.scheme}-{self.wtype}"
+
+
+SUPPORT_MATRIX = tuple(
+    MatrixEntry(m, tp, s, w, _EXPECT_FITS[(m, w)][tp])
+    for m in MODELS for tp in (1, 2, 4, 8)
+    for s in SCHEMES for w in WEIGHT_TYPES)
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardFinding:
+    rule: str     # J004 | J005 | J006 | HBM-BUDGET | TRACE
+    config: str
+    detail: str
+
+    def render(self) -> str:
+        return f"shardcheck: {self.config} FAIL {self.rule}: {self.detail}"
+
+
+@dataclasses.dataclass(frozen=True)
+class ConfigResult:
+    config: str
+    expect_fits: bool | None
+    report: MemoryReport | None
+    findings: tuple
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings
+
+
+def model_spec(model: str, wtype: str):
+    from ..models import synth
+    from ..ops.quants import FloatType
+
+    factory = {"7b": synth.llama2_7b_spec, "13b": synth.llama2_13b_spec,
+               "70b": synth.llama2_70b_spec}[model]
+    ft = {"q40": FloatType.Q40, "f16": FloatType.F16,
+          "f32": FloatType.F32}[wtype]
+    return factory(weights_float_type=ft)
+
+
+def abstract_model_params(spec):
+    """The param tree as avals for the spec's weights_float_type — Q40
+    leaves as codec-layout (qs, d16) pairs, dense leaves as f16/f32. Built
+    under eval_shape, so nothing is materialized at any scale."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..io.loader import Q40Weight
+    from ..models.synth import _build_tree
+    from ..ops.quants import QK, FloatType
+
+    ft = spec.weights_float_type
+
+    def t(*shape):
+        return jnp.zeros(shape, jnp.float32)
+
+    def mm(*shape):
+        if ft == FloatType.Q40:
+            *lead, d, n = shape
+            return Q40Weight(jnp.zeros((*lead, d, n // QK, 16), jnp.uint8),
+                             jnp.zeros((*lead, d, n // QK), jnp.float16))
+        dt = jnp.float16 if ft == FloatType.F16 else jnp.float32
+        return jnp.zeros(shape, dt)
+
+    return jax.eval_shape(lambda: _build_tree(spec, t, mm))
+
+
+# -- tracing ----------------------------------------------------------------
+
+
+def _find_shard_map(jaxpr):
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name == "shard_map":
+            return eqn
+        for sub in sub_jaxprs(eqn):  # incl. tuple-valued cond branches
+            found = _find_shard_map(sub)
+            if found is not None:
+                return found
+    return None
+
+
+def trace_tp_forward(spec, tp: int, scheme: str, forward_builder=None):
+    """make_jaxpr the real tp entry point (or a test-supplied builder of
+    the same signature) over abstract params/cache/token avals. Returns
+    (closed_jaxpr, abstract_params_tree)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..models.llama import init_cache
+    from ..parallel import make_mesh, make_sharded_forward
+
+    if len(jax.devices()) < tp:
+        raise RuntimeError(
+            f"needs {tp} devices, have {len(jax.devices())} — set "
+            f"--xla_force_host_platform_device_count (the CLI does)")
+    mesh = make_mesh(tp=tp, devices=jax.devices()[:tp])
+    builder = forward_builder or make_sharded_forward
+    fwd = builder(spec, mesh, scheme)
+    params = abstract_model_params(spec)
+    cache = jax.eval_shape(lambda: init_cache(spec, jnp.float32))
+    tokens = jax.ShapeDtypeStruct((1,), jnp.int32)
+    pos = jax.ShapeDtypeStruct((), jnp.int32)
+    closed = jax.make_jaxpr(fwd)(params, cache, tokens, pos)
+    return closed, params
+
+
+def mutant_replicated_forward(replicate=("wcls",)):
+    """A forward builder that OVERRIDES the named weights' partition spec
+    to fully replicated — the seeded J004 fixture (guards the checker
+    against rot; tests/test_shardcheck_repo.py). Only weights whose
+    replication is shape-silent downstream (e.g. wcls: the widened logits
+    gather has no later consumer) stay traceable."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel import tp as tp_mod
+    from ..utils.compat import shard_map as _shard_map
+
+    def build(spec, mesh, scheme):
+        n_slices = mesh.shape["tp"]
+        local_step = tp_mod.make_local_step(spec, n_slices, 1, scheme=scheme)
+
+        def wrap(params, cache, tokens, pos):
+            specs = tp_mod.param_specs(params, scheme)
+            for name in replicate:
+                specs[name] = P()  # fully replicated: the seeded hazard
+            in_specs = (specs, tp_mod.CACHE_SPEC, P(), P())
+            fn = _shard_map(local_step, mesh=mesh, in_specs=in_specs,
+                            out_specs=(P(), tp_mod.CACHE_SPEC))
+            return fn(params, cache, tokens, pos)
+
+        return jax.jit(wrap, donate_argnums=1)
+
+    build.replicated = tuple(replicate)
+    return build
+
+
+# -- the contract checks ----------------------------------------------------
+
+
+def _user_frames(eqn):
+    from jax._src import source_info_util
+
+    return list(source_info_util.user_frames(eqn.source_info))
+
+
+def _dequant_site_filter():
+    from ..ops.dequant_sites import frames_allowed
+
+    def allowed(eqn) -> bool:
+        try:
+            return frames_allowed(_user_frames(eqn))
+        except Exception:  # noqa: BLE001 - source info is best-effort
+            return False
+
+    return allowed
+
+
+def check_traced_sharding(closed_jaxpr, params, scheme: str, tp: int,
+                          config: str, expected=None) -> list[ShardFinding]:
+    """J004: shard_map's recorded in_names vs tp.expected_shard_names, plus
+    the replication hazard — a matmul-weight operand with no 'tp' axis on a
+    tp>1 mesh is an everywhere-copy the memory model never budgeted.
+    ``expected`` overrides the declared rows (mutation self-tests)."""
+    from ..parallel import tp as tp_mod
+
+    sm = _find_shard_map(closed_jaxpr.jaxpr)
+    if sm is None:
+        return [ShardFinding("J004", config,
+                             "no shard_map eqn in the traced forward — "
+                             "jaxpr structure changed?")]
+    rows = expected if expected is not None else \
+        tp_mod.expected_shard_names(params, scheme)
+    in_names = sm.params["in_names"]
+    if len(in_names) < len(rows):
+        return [ShardFinding("J004", config,
+                             f"{len(in_names)} traced operands < "
+                             f"{len(rows)} declared leaves")]
+    tail_names = in_names[-len(rows):]
+    tail_vars = sm.invars[-len(rows):]
+    matmul_keys = tp_mod.LAYER_KEYS[2:] + ("wcls",)  # wq..w3 + classifier
+    findings = []
+    # operands BEFORE the declared leaves are consts jax hoisted out of the
+    # body (closed-over values). They carry no declared spec and ride
+    # replicated — fine for iota/rope tables, but a weight-sized hoisted
+    # const is the silent-full-replication hazard J004 exists to catch
+    n_consts = len(in_names) - len(rows)
+    for var, names in zip(sm.invars[:n_consts], in_names[:n_consts]):
+        aval = getattr(var, "aval", None)
+        if aval is None or any("tp" in ax for ax in dict(names).values()):
+            continue
+        if tp > 1 and aval.size * aval.dtype.itemsize \
+                >= WEIGHT_BYTES_THRESHOLD:
+            findings.append(ShardFinding(
+                "J004", config,
+                f"const hoisted into shard_map: weight-shaped closed-over "
+                f"value ({tuple(aval.shape)} {aval.dtype}) is REPLICATED "
+                f"on a tp={tp} mesh — pass it through the params tree with "
+                f"a partition spec"))
+    for (name, want), got, var in zip(rows, tail_names, tail_vars):
+        got = {int(k): tuple(v) for k, v in dict(got).items()}
+        want = {int(k): tuple(v) for k, v in want.items()}
+        if got != want:
+            findings.append(ShardFinding(
+                "J004", config,
+                f"{name}: traced sharding {got} != declared {want} "
+                f"(tp.py param_specs drifted from the program)"))
+            continue
+        is_matmul = any(f"'{k}'" in name for k in matmul_keys)
+        aval = getattr(var, "aval", None)
+        big = aval is not None and aval.size * aval.dtype.itemsize \
+            >= WEIGHT_BYTES_THRESHOLD
+        sharded_over_tp = any("tp" in axes for axes in got.values())
+        if tp > 1 and is_matmul and big and not sharded_over_tp:
+            findings.append(ShardFinding(
+                "J004", config,
+                f"{name}: weight-shaped operand "
+                f"({tuple(aval.shape)} {aval.dtype}) is REPLICATED on a "
+                f"tp={tp} mesh — every chip pays full bytes (accidental "
+                f"all-gather)"))
+    return findings
+
+
+def check_dequant_sites(closed_jaxpr, config: str,
+                        threshold: int = WEIGHT_BYTES_THRESHOLD
+                        ) -> list[ShardFinding]:
+    """J005: every weight-scale int->float materialization must descend
+    from a registered dequant site (ops/dequant_sites.py)."""
+    from ..ops.dequant_sites import frames_allowed
+    from .jaxpr_contracts import walk_eqns
+
+    int_names = {"uint8", "int8", "int4", "uint4"}
+    findings = []
+    for eqn in walk_eqns(closed_jaxpr.jaxpr):
+        if eqn.primitive.name != "convert_element_type":
+            continue
+        iv, ov = eqn.invars[0].aval, eqn.outvars[0].aval
+        if ov.dtype.name not in ("float32", "bfloat16"):
+            continue
+        if iv.dtype.name not in int_names:
+            continue
+        if ov.size * ov.dtype.itemsize < threshold:
+            continue
+        try:
+            frames = _user_frames(eqn)
+        except Exception:  # noqa: BLE001 - no source info, cannot attribute
+            frames = []
+        if frames_allowed(frames):
+            continue
+        where = (f"{frames[0].file_name.rsplit('/', 1)[-1]}:"
+                 f"{frames[0].function_name}" if frames else "<unknown>")
+        findings.append(ShardFinding(
+            "J005", config,
+            f"{tuple(iv.shape)} {iv.dtype} -> {ov.dtype} materialization "
+            f"at {where}, outside the registered dequant sites "
+            f"(ops/dequant_sites.py)"))
+    return findings
+
+
+def check_uniform_shards(spec, tp: int, scheme: str,
+                         config: str) -> list[ShardFinding]:
+    """J006: ragged shards force per-rank shapes, hence one compile per
+    rank — the same constraints parallel/tp.validate_sharding raises on,
+    reported as findings plus the Q40-block granularity of the fused
+    scheme's input-sharded wo/w2."""
+    from ..ops.quants import QK, FloatType
+
+    findings = []
+
+    def ragged(value, what):
+        findings.append(ShardFinding(
+            "J006", config,
+            f"{what}={value} does not divide over tp={tp}: ranks get "
+            f"ragged shards (distinct shapes => one compile per rank)"))
+
+    for value, what in ((spec.n_heads, "n_heads"),
+                        (spec.n_kv_heads, "n_kv_heads"),
+                        (spec.hidden_dim, "hidden_dim"),
+                        (spec.vocab_size, "vocab_size")):
+        if value % tp:
+            ragged(value, what)
+    if scheme == "fused" and spec.weights_float_type == FloatType.Q40:
+        for value, what in ((spec.dim, "dim"),
+                            (spec.hidden_dim, "hidden_dim")):
+            if tp > 1 and value % tp == 0 and (value // tp) % QK:
+                findings.append(ShardFinding(
+                    "J006", config,
+                    f"fused scheme shards {what}={value} along the Q40 "
+                    f"input-block axis: {value}/{tp} must be a "
+                    f"{QK}-multiple"))
+    if spec.buffer_float_type == FloatType.Q80:
+        for value, what in ((spec.dim, "dim"), (spec.hidden_dim,
+                                                "hidden_dim")):
+            if value % tp == 0 and (value // tp) % QK:
+                findings.append(ShardFinding(
+                    "J006", config,
+                    f"Q80 buffers need {what}/tp to be a {QK}-multiple, "
+                    f"got {value}/{tp}"))
+    return findings
+
+
+# -- per-config driver ------------------------------------------------------
+
+
+def check_config(entry: MatrixEntry, device: str = "v5e",
+                 forward_builder=None, spec=None) -> ConfigResult:
+    """Run every check for one matrix entry. Trace failures become TRACE
+    findings (the CLI reports them and fails), not crashes. ``spec``
+    overrides the model lookup (synth-model mutation self-tests)."""
+    spec = spec if spec is not None else model_spec(entry.model, entry.wtype)
+    config = entry.label
+    findings = check_uniform_shards(spec, entry.tp, entry.scheme, config)
+    act_bytes = None
+    if not findings:
+        try:
+            closed, params = trace_tp_forward(spec, entry.tp, entry.scheme,
+                                              forward_builder)
+            sm = _find_shard_map(closed.jaxpr)
+            if sm is not None:
+                act_bytes = live_interval_peak(
+                    sm.params["jaxpr"], exclude_eqn=_dequant_site_filter())
+            findings += check_traced_sharding(closed, params, entry.scheme,
+                                              entry.tp, config)
+            findings += check_dequant_sites(closed, config)
+        except ValueError as e:
+            # validate_sharding raises on the same ragged shapes J006
+            # models — surface under the contract id, not as a crash
+            findings.append(ShardFinding("J006", config,
+                                         f"trace rejected the config: {e}"))
+        except Exception as e:  # noqa: BLE001 - report, don't crash the run
+            findings.append(ShardFinding(
+                "TRACE", config, f"raised {type(e).__name__}: {e}"))
+    report = device_footprint(spec, entry.tp, entry.scheme,
+                              model=entry.model,
+                              activation_bytes=act_bytes, device=device)
+    if report.fits != entry.expect_fits:
+        if entry.expect_fits:
+            findings.append(ShardFinding(
+                "HBM-BUDGET", config,
+                f"declared to fit but total "
+                f"{report.total_bytes / GIB:.2f} GiB exceeds the "
+                f"{report.budget_bytes / GIB:.2f} GiB usable budget by "
+                f"{-report.headroom_bytes / GIB:.2f} GiB"))
+        else:
+            findings.append(ShardFinding(
+                "HBM-BUDGET", config,
+                f"declared NOT to fit but total "
+                f"{report.total_bytes / GIB:.2f} GiB now leaves "
+                f"{report.headroom_bytes / GIB:.2f} GiB headroom — "
+                f"update the support matrix"))
+    return ConfigResult(config, entry.expect_fits, report, tuple(findings))
+
+
+def run_shardcheck(matrix=None, device: str = "v5e") -> list[ConfigResult]:
+    return [check_config(e, device=device)
+            for e in (matrix if matrix is not None else SUPPORT_MATRIX)]
+
+
+def load_matrix(path) -> tuple[MatrixEntry, ...]:
+    """A JSON support matrix override: a list of {model, tp, scheme,
+    wtype, expect_fits} objects (tools/shardcheck --matrix; also the
+    seeded-violation path of the CLI tests)."""
+    raw = json.loads(Path(path).read_text(encoding="utf-8"))
+    return tuple(MatrixEntry(e["model"], int(e["tp"]), e["scheme"],
+                             e["wtype"], bool(e["expect_fits"]))
+                 for e in raw)
+
+
+def report_json(results: list[ConfigResult], device: str = "v5e") -> dict:
+    """The machine-readable memory report (tools/shardcheck emits this;
+    PARITY.md's footprint table is generated from it)."""
+    return {
+        "device": device,
+        "n_configs": len(results),
+        "n_violations": sum(not r.ok for r in results),
+        "configs": [{
+            "config": r.config,
+            "expect_fits": r.expect_fits,
+            "ok": r.ok,
+            "findings": [{"rule": f.rule, "detail": f.detail}
+                         for f in r.findings],
+            "report": r.report.as_json() if r.report else None,
+        } for r in results],
+    }

@@ -262,18 +262,20 @@ def fuse_q40_layer_matmuls(params: dict) -> dict:
     out = dict(params)
 
     def fuse(dst, keys):
+        # host numpy tree by contract (runs after pack_q40_params, before
+        # device placement) — np.concatenate takes the leaves directly
         ws = [out.get(k) for k in keys]
         if all(isinstance(w, Q40Kernel) and w.qs_t.ndim == 4 for w in ws):
-            qs_t = np.concatenate([np.asarray(w.qs_t) for w in ws], axis=2)
-            scale = np.concatenate([np.asarray(w.scale) for w in ws], axis=1)
+            qs_t = np.concatenate([w.qs_t for w in ws], axis=2)
+            scale = np.concatenate([w.scale for w in ws], axis=1)
             if not kernel_supports(qs_t.shape[2], qs_t.shape[3] * 32):
                 return
             out[dst] = Q40Kernel(qs_t, scale)
         elif all(isinstance(w, Q40KernelNb) and w.qs_t.ndim == 4
                  for w in ws):
             # nb-major: the output dim d is MINOR — concat along it
-            qs_t = np.concatenate([np.asarray(w.qs_t) for w in ws], axis=3)
-            scale = np.concatenate([np.asarray(w.scale) for w in ws], axis=2)
+            qs_t = np.concatenate([w.qs_t for w in ws], axis=3)
+            scale = np.concatenate([w.scale for w in ws], axis=2)
             if _pick_rows_nb(qs_t.shape[3], qs_t.shape[2]) is None:
                 return
             out[dst] = Q40KernelNb(qs_t, scale)

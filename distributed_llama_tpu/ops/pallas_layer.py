@@ -478,10 +478,11 @@ def wo_block_perm(n_heads: int, head_size: int) -> np.ndarray:
 
 def permute_wo_blocks(wo: Q40Kernel, n_heads: int,
                       head_size: int) -> Q40Kernel:
-    """Reorder wo's column blocks by wo_block_perm (host side, at pack)."""
+    """Reorder wo's column blocks by wo_block_perm (host side, at pack —
+    the fancy index + ascontiguousarray is the one conversion point)."""
     sigma = wo_block_perm(n_heads, head_size)
-    return Q40Kernel(np.ascontiguousarray(np.asarray(wo.qs_t)[..., sigma]),
-                     np.ascontiguousarray(np.asarray(wo.scale)[..., sigma]))
+    return Q40Kernel(np.ascontiguousarray(wo.qs_t[..., sigma]),
+                     np.ascontiguousarray(wo.scale[..., sigma]))
 
 
 def _ao_to_planes(ao, n_heads: int, hs: int):
@@ -819,9 +820,8 @@ def prepare_mega_params(spec, params: dict) -> dict:
             and _mega_shapes_ok(spec)):
         return params
     out = dict(params)
-    wo = params["wo"]
-    wo = Q40Kernel(np.asarray(wo.qs_t), np.asarray(wo.scale))
-    out["wo_mega"] = permute_wo_blocks(wo, spec.n_heads, spec.head_size)
+    out["wo_mega"] = permute_wo_blocks(params["wo"], spec.n_heads,
+                                       spec.head_size)
     return out
 
 

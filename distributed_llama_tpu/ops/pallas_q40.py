@@ -339,9 +339,11 @@ def repack_i4_packed(tree):
     import numpy as np
 
     def pack(qs_t):
+        # qs_t is a host numpy plane stack (runs at load, after pack);
+        # the nibble ops above keep it numpy end to end
         lo = (qs_t & 0xF) ^ 0x8
         hi = (qs_t >> 4) ^ 0x8
-        pl = np.concatenate([np.asarray(lo), np.asarray(hi)], axis=-3)
+        pl = np.concatenate([lo, hi], axis=-3)
         return (pl[..., 0::2] | (pl[..., 1::2] << 4)).astype(np.uint8)
 
     def conv(v):
@@ -350,7 +352,7 @@ def repack_i4_packed(tree):
         # unpack on (rows, nb) tiles is pathological), while the nb-major
         # body is the probe's 701 GB/s winner. Q40Kernel leaves stay u8.
         if isinstance(v, Q40KernelNb) and v.qs_t.shape[-1] % 2 == 0:
-            return Q40KernelI4PackedNb(pack(np.asarray(v.qs_t)), v.scale)
+            return Q40KernelI4PackedNb(pack(v.qs_t), v.scale)
         return v
 
     return {k: conv(v) for k, v in tree.items()}

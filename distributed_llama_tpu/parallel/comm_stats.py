@@ -142,6 +142,42 @@ def tp_collective_budget(spec: TransformerSpec, n_slices: int,
          ("all_gather", 1, logits_bytes)))
 
 
+def collective_staging_bytes(spec: TransformerSpec, n_slices: int,
+                             scheme: str | None = None,
+                             t_len: int = 1) -> int:
+    """Per-chip HBM transiently held by the largest in-flight collective.
+
+    The footprint model (analysis/memory_model.py) charges collectives a
+    double-buffer bound: the full output payload of the single largest
+    collective in the schedule, twice (source shard staging + assembled
+    output live at once). Derived from the SAME cut points as
+    ``tp_collective_budget`` so the two cannot drift:
+
+      ref    gathers of dim- and hidden-wide vectors (buffer float type on
+             the wire) + the f32 logits gather;
+      fused  f32 psum / psum_scatter payloads of dim width (partial sums
+             never ride the wire quantized) + the f32 logits gather.
+
+    ``t_len`` scales the activation-vector cuts for prefill-shaped traffic
+    (decode is t_len=1). Zero when n_slices == 1 — no wire, no staging.
+    """
+    scheme = scheme or tp_scheme()
+    if scheme not in SCHEMES:
+        raise ValueError(f"unknown tp scheme {scheme!r}")
+    if n_slices <= 1:
+        return 0
+    ft = spec.buffer_float_type
+    logits = _vb(FloatType.F32, spec.vocab_size)
+    if scheme == "ref":
+        payloads = (t_len * _vb(ft, spec.dim),
+                    t_len * _vb(ft, spec.hidden_dim), logits)
+    else:
+        # fused: the combine payload is the full residual-width f32 vector
+        # on both the psum and the scatter+gather decomposition
+        payloads = (t_len * _vb(FloatType.F32, spec.dim), logits)
+    return 2 * max(payloads)
+
+
 def ici_all_gather_bytes(spec: TransformerSpec, n_slices: int,
                          scheme: str | None = None) -> CommStats:
     """Per-chip bytes/token of the active (or given) scheme's collectives.

@@ -152,6 +152,30 @@ def param_specs(params: dict[str, Any],
 CACHE_SPEC = KVCache(P(None, "sp", "tp", None), P(None, "sp", "tp", None))
 
 
+def expected_shard_names(params: dict[str, Any], scheme: str | None = None):
+    """The sharding contract as flat, machine-checkable rows: one
+    ``(leaf_name, {axis_index: (mesh_axis, ...)})`` per leaf of the
+    (params, cache, tokens, pos) argument tree of make_sharded_forward, in
+    tree-flatten order — exactly the ``in_names`` jax's shard_map records
+    per operand in the traced program. analysis/shardcheck.py verifies the
+    trace against THIS export (contract J004), so the declared layout and
+    the checked layout come from one place: the spec tables above.
+    ``params`` may be abstract (ShapeDtypeStruct leaves)."""
+    import jax
+
+    specs = (param_specs(params, scheme), CACHE_SPEC, P(), P())
+    is_p = lambda x: isinstance(x, P)  # noqa: E731 - local predicate
+    leaves_with_path, _ = jax.tree_util.tree_flatten_with_path(
+        specs, is_leaf=is_p)
+    rows = []
+    for path, spec in leaves_with_path:
+        name = jax.tree_util.keystr(path)
+        names = {i: tuple(ax) if isinstance(ax, (tuple, list)) else (ax,)
+                 for i, ax in enumerate(spec) if ax is not None}
+        rows.append((name, names))
+    return rows
+
+
 def shard_params(params: dict[str, Any], mesh: Mesh,
                  scheme: str | None = None) -> dict[str, Any]:
     """Place the param tree with the active scheme's shardings (ref:
@@ -187,10 +211,11 @@ def shard_params(params: dict[str, Any], mesh: Mesh,
     specs = param_specs(params, scheme)
 
     def put(a, s):
-        a = np.asarray(a)
+        # host tree by contract (loader/synth/pack all emit numpy): the
+        # callback's ascontiguousarray is the one conversion point
         sh = NamedSharding(mesh, s)
         return jax.make_array_from_callback(
-            a.shape, sh, lambda idx, a=a: np.ascontiguousarray(a[idx]))
+            np.shape(a), sh, lambda idx, a=a: np.ascontiguousarray(a[idx]))
 
     return jax.tree_util.tree_map(put, params, specs)
 

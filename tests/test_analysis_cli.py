@@ -1,0 +1,119 @@
+"""Exit-code coverage for the analysis CLI (analysis/__main__.py).
+
+Every head gets its zero AND non-zero path: --lint over the repo (clean)
+and over a seeded violation (1), --write-baseline round trip, --contracts
+(clean), --shardcheck over the full matrix (clean — the acceptance
+invocation), over a tiny matrix (fast path), and over a seeded-violation
+matrix declaring 70b-tp1 to fit (1). Usage errors exit 2
+(tests/test_dlint_repo.py covers the partial-scan refusal)."""
+
+from __future__ import annotations
+
+import json
+import textwrap
+
+from distributed_llama_tpu.analysis.__main__ import main
+
+
+def test_lint_head_clean_repo_exits_zero(capsys):
+    assert main(["--lint"]) == 0
+    assert "0 new finding(s)" in capsys.readouterr().out
+
+
+def test_lint_head_seeded_violation_exits_one(tmp_path, capsys):
+    bad = tmp_path / "runtime" / "hot.py"
+    bad.parent.mkdir(parents=True)
+    bad.write_text(textwrap.dedent("""
+        import numpy as np
+
+        def step(logits):
+            return np.asarray(logits)
+    """), encoding="utf-8")
+    assert main(["--lint", str(bad)]) == 1
+    assert "D001" in capsys.readouterr().out
+
+
+def test_write_baseline_round_trip(tmp_path, capsys):
+    target = tmp_path / "baseline.txt"
+    assert main(["--write-baseline", "--baseline", str(target)]) == 0
+    assert target.exists()
+    # the freshly written baseline suppresses exactly the current findings
+    assert main(["--lint", "--baseline", str(target)]) == 0
+    out = capsys.readouterr().out
+    assert "0 new finding(s)" in out
+
+
+def test_contracts_head_exits_zero(capsys):
+    assert main(["--contracts"]) == 0
+    out = capsys.readouterr().out
+    assert "J001" in out and "FAIL" not in out
+
+
+def test_shardcheck_full_matrix_exits_zero(capsys):
+    # the acceptance-criteria invocation
+    assert main(["--shardcheck"]) == 0
+    out = capsys.readouterr().out
+    assert "48 config(s), 0 violating" in out
+    assert "FAIL" not in out
+
+
+def _write_matrix(tmp_path, entries):
+    path = tmp_path / "matrix.json"
+    path.write_text(json.dumps(entries), encoding="utf-8")
+    return path
+
+
+def test_shardcheck_matrix_override(tmp_path, capsys):
+    path = _write_matrix(tmp_path, [
+        {"model": "7b", "tp": 4, "scheme": "fused", "wtype": "q40",
+         "expect_fits": True}])
+    assert main(["--shardcheck", "--shardcheck-matrix", str(path)]) == 0
+    assert "1 config(s), 0 violating" in capsys.readouterr().out
+
+
+def test_shardcheck_seeded_violation_exits_one(tmp_path, capsys):
+    # 70B Q40 unsharded cannot fit a 16 GiB chip: declaring it fit must
+    # fail with the named budget rule
+    path = _write_matrix(tmp_path, [
+        {"model": "70b", "tp": 1, "scheme": "ref", "wtype": "q40",
+         "expect_fits": True}])
+    assert main(["--shardcheck", "--shardcheck-matrix", str(path)]) == 1
+    out = capsys.readouterr().out
+    assert "HBM-BUDGET" in out and "1 violating" in out
+
+
+def test_shardcheck_matrix_alone_implies_the_head(tmp_path, capsys):
+    # a forgotten --shardcheck must not silently skip the drift gate the
+    # matrix encodes (mirrors --write-baseline implying --lint)
+    path = _write_matrix(tmp_path, [
+        {"model": "70b", "tp": 1, "scheme": "ref", "wtype": "q40",
+         "expect_fits": True}])
+    assert main(["--shardcheck-matrix", str(path)]) == 1
+    assert "HBM-BUDGET" in capsys.readouterr().out
+
+
+def test_tools_shardcheck_emits_json_report(tmp_path, capsys):
+    import tools.shardcheck as ts
+
+    out_path = tmp_path / "report.json"
+    matrix = _write_matrix(tmp_path, [
+        {"model": "70b", "tp": 8, "scheme": "fused", "wtype": "q40",
+         "expect_fits": True},
+        {"model": "70b", "tp": 1, "scheme": "ref", "wtype": "q40",
+         "expect_fits": False}])
+    rc = ts.main(["--matrix", str(matrix), "--json", str(out_path)])
+    assert rc == 0
+    rep = json.loads(out_path.read_text(encoding="utf-8"))
+    assert rep["n_configs"] == 2 and rep["n_violations"] == 0
+    by_cfg = {c["config"]: c for c in rep["configs"]}
+    assert by_cfg["70b-tp8-fused-q40"]["report"]["fits"] is True
+    assert by_cfg["70b-tp1-ref-q40"]["report"]["fits"] is False
+
+
+def test_tools_shardcheck_single_config_filter(capsys):
+    import tools.shardcheck as ts
+
+    assert ts.main(["--config", "70b-tp8-fused-q40"]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["n_configs"] == 1
+    assert ts.main(["--config", "no-such-config"]) == 2

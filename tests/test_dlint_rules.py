@@ -368,6 +368,81 @@ def test_d006_pragma_suppresses_with_reason(tmp_path):
     assert "D006" not in rules_fired(findings)
 
 
+# -- D007: implicit dtype promotion ------------------------------------------
+
+
+def test_d007_fires_on_bf16_times_f32_constant(tmp_path):
+    findings = run_on(tmp_path, "ops/fast.py", """
+        import jax.numpy as jnp
+
+        def tail(x, w):
+            xb = x.astype(jnp.bfloat16)
+            scale = jnp.float32(0.125)
+            return xb * scale          # silently upcasts the bf16 path
+    """)
+    assert rules_fired(findings) == {"D007"}
+
+
+def test_d007_fires_on_astype_free_mixing(tmp_path):
+    findings = run_on(tmp_path, "parallel/mix.py", """
+        import numpy as np
+        import jax.numpy as jnp
+
+        def combine(h, bias):
+            hb = h.astype(jnp.float16)
+            b32 = bias.astype(jnp.float32)
+            return hb + b32            # f16 + f32 -> f32, no visible cast
+    """)
+    assert rules_fired(findings) == {"D007"}
+    # a direct strong-typed numpy constructor is an f32 operand too
+    findings = run_on(tmp_path, "ops/fast.py", """
+        import numpy as np
+        import jax.numpy as jnp
+
+        def scale(x):
+            xb = x.astype(jnp.bfloat16)
+            return xb * np.float32(2.0)
+    """)
+    assert rules_fired(findings) == {"D007"}
+
+
+def test_d007_quiet_on_weak_scalars_and_matched_dtypes(tmp_path):
+    quiet = """
+        import jax.numpy as jnp
+
+        def tail(x, w):
+            xb = x.astype(jnp.bfloat16)
+            wb = w.astype(jnp.bfloat16)
+            y = xb * 0.5               # Python literal: weak, stays bf16
+            z = xb * wb                # both low: no promotion
+            f = x.astype(jnp.float32)
+            g = f * jnp.float32(3.0)   # both f32: nothing implicit
+            return y, z, g
+    """
+    assert run_on(tmp_path, "ops/fast.py", quiet) == []
+    # same mixing OUTSIDE ops//parallel/ is not this rule's beat
+    loud = """
+        import jax.numpy as jnp
+
+        def report(x):
+            xb = x.astype(jnp.bfloat16)
+            return xb + jnp.float32(1.0)
+    """
+    assert run_on(tmp_path, "frontend/cold.py", loud) == []
+
+
+def test_d007_pragma_suppresses_with_reason(tmp_path):
+    findings = run_on(tmp_path, "ops/fast.py", """
+        import jax.numpy as jnp
+
+        def tail(x):
+            xb = x.astype(jnp.bfloat16)
+            s = jnp.float32(2.0)
+            return xb * s  # dlint: allow[D007] f32 accumulate intended
+    """)
+    assert "D007" not in rules_fired(findings)
+
+
 # -- baseline round-trip ----------------------------------------------------
 
 

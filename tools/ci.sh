@@ -8,16 +8,42 @@
 # Static analysis runs FIRST: the dlint lint head (tools/dlint.py, also
 # `python -m distributed_llama_tpu.analysis`) fails the gate on any finding
 # not grandfathered in tools/dlint_baseline.txt — a new implicit sync or
-# retrace trap stops the build before 18 minutes of tests do — and the
-# jaxpr contract head verifies the program-structure contracts, including
-# J001 for BOTH tp collective schemes (ref and fused; a collective added
-# to the tp forward without its comm_stats term fails here). (The same
-# contracts also run inside the suite, tests/test_jaxpr_contracts.py;
-# tools/ probe scripts are outside the lint surface by design.)
+# retrace trap stops the build before 18 minutes of tests do — the jaxpr
+# contract head verifies the program-structure contracts (J001 for BOTH tp
+# collective schemes; a collective added to the tp forward without its
+# comm_stats term fails here), and the shardcheck head proves every
+# (model, tp, scheme, dtype) config of the support matrix shards as
+# declared and fits per-device HBM (J004/J005/J006 + budget). (The same
+# contracts also run inside the suite, tests/test_jaxpr_contracts.py and
+# tests/test_shardcheck_repo.py; tools/ probe scripts are outside the lint
+# surface by design.)
+#
+# C++ static analysis rides along when the toolchain exists: clang-tidy
+# over csrc/host.cpp (csrc/.clang-tidy) and an ASan/UBSan smoke run of
+# every extern-C entry point (csrc/sanitize_main.cpp). Both skip cleanly
+# on boxes without the tools — the Python suite never depends on them.
 #
 # Usage: tools/ci.sh [extra pytest args]
 set -eu
 cd "$(dirname "$0")/.."
 python -m distributed_llama_tpu.analysis --all
+if command -v clang-tidy >/dev/null 2>&1; then
+    make -C csrc tidy
+else
+    echo "ci: clang-tidy not found — skipping csrc tidy"
+fi
+# probe: the compiler existing is not enough — the ASan/UBSan RUNTIME
+# (libasan/libubsan) must link, or the make would abort the whole gate
+san_probe="$(mktemp /tmp/dllama_san_probe.XXXXXX)"
+if command -v "${CXX:-g++}" >/dev/null 2>&1 \
+        && echo 'int main(){return 0;}' | "${CXX:-g++}" -x c++ - \
+            -fsanitize=address,undefined -o "$san_probe" >/dev/null 2>&1; then
+    rm -f "$san_probe"
+    make -C csrc sanitize
+else
+    rm -f "$san_probe"
+    echo "ci: no C++ toolchain with sanitizer runtime — skipping csrc" \
+         "sanitizers"
+fi
 exec python -m pytest tests/ -q -n "${CI_SHARDS:-8}" \
     -m "slow or not slow" "$@"

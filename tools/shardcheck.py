@@ -1,0 +1,78 @@
+#!/usr/bin/env python
+"""shardcheck CLI: the machine-readable per-device HBM/sharding report.
+
+Runs the same verifier as ``python -m distributed_llama_tpu.analysis
+--shardcheck`` (analysis/shardcheck.py) and emits the JSON report —
+per-config weights/KV/activation/collective components, fits verdicts,
+headroom, and any J004/J005/J006/budget findings. bench.py's projection
+rows and PARITY.md's footprint table carry the same numbers (one model,
+three surfaces).
+
+    tools/shardcheck.py                  # full support matrix -> stdout
+    tools/shardcheck.py --json out.json  # write the report to a file
+    tools/shardcheck.py --matrix m.json  # custom support matrix
+    tools/shardcheck.py --config 70b-tp8-fused-q40   # one config
+
+Exit status: 0 = every config clean; 1 = violations (listed in the JSON).
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir))
+
+# the traced heads need the virtual CPU mesh BEFORE jax initializes (same
+# dance as the analysis CLI / tests/conftest.py)
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="shardcheck",
+        description="static sharding & HBM-footprint verifier (JSON)")
+    ap.add_argument("--json", type=str, default=None,
+                    help="write the report here (default: stdout)")
+    ap.add_argument("--matrix", type=str, default=None,
+                    help="JSON support-matrix override")
+    ap.add_argument("--config", type=str, default=None,
+                    help="run one config label, e.g. 70b-tp8-fused-q40")
+    ap.add_argument("--device", type=str, default="v5e",
+                    help="budget table row (default v5e)")
+    args = ap.parse_args(argv)
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from distributed_llama_tpu.analysis.shardcheck import (
+        SUPPORT_MATRIX, load_matrix, report_json, run_shardcheck)
+
+    matrix = load_matrix(args.matrix) if args.matrix else SUPPORT_MATRIX
+    if args.config:
+        matrix = tuple(e for e in matrix if e.label == args.config)
+        if not matrix:
+            print(f"shardcheck: no such config {args.config!r} in the "
+                  f"matrix", file=sys.stderr)
+            return 2
+    results = run_shardcheck(matrix, device=args.device)
+    report = report_json(results, device=args.device)
+    text = json.dumps(report, indent=2)
+    if args.json:
+        with open(args.json, "w", encoding="utf-8") as fh:
+            fh.write(text + "\n")
+        print(f"shardcheck: report -> {args.json} "
+              f"({report['n_configs']} configs, "
+              f"{report['n_violations']} violating)", file=sys.stderr)
+    else:
+        print(text)
+    return 1 if report["n_violations"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
