@@ -144,26 +144,13 @@ def _env_fingerprint() -> dict:
     ISSUE 3): the BASELINE note concedes ±5-8% drift across sessions on
     the tunneled runtime — pinning the jax/runtime versions, the chip
     kind, and the clock source makes rows from different sessions
-    comparable (or visibly not)."""
-    import jax
+    comparable (or visibly not). ONE copy, shared with the --log-json
+    stamp so log streams join against rows (utils/fingerprint)."""
+    import jax  # noqa: F401 - ensure the device fields are populated
 
-    try:
-        import importlib.metadata as _md
+    from distributed_llama_tpu.utils.fingerprint import env_fingerprint
 
-        jaxlib_v = _md.version("jaxlib")
-    except Exception:  # noqa: BLE001 - fingerprint is best-effort
-        jaxlib_v = getattr(jax.lib, "__version__", "")
-    d = jax.devices()[0]
-    clock = time.get_clock_info("perf_counter")
-    return {
-        "jax": jax.__version__,
-        "jaxlib": jaxlib_v,
-        "backend": d.platform,
-        "device_kind": getattr(d, "device_kind", ""),
-        "n_devices": len(jax.devices()),
-        "clock": clock.implementation,
-        "clock_resolution_s": clock.resolution,
-    }
+    return env_fingerprint()
 
 
 def _bench_trials() -> int:
@@ -218,7 +205,7 @@ def _bench(spec, params, samples: int, per_step: bool = False,
     # attribution/layout with attempt 3's timing
     for k in ("it_split", "op_ms_per_token", "q40_layout",
               "rank_layout_caveat", "startup_to_first_token_s",
-              "latency_ms", "trials"):
+              "latency_ms", "trials", "drift"):
         _STARTUP.pop(k, None)
 
     cache_dtype = (jnp.bfloat16 if os.environ.get("DLLAMA_BENCH_KV_BF16")
@@ -428,6 +415,20 @@ def _bench(spec, params, samples: int, per_step: bool = False,
                          "I=compute ops, T=collective ops (0 on one chip; "
                          "tp rows carry modeled ICI separately)"}
             _STARTUP["op_ms_per_token"] = per_tok
+            # drift columns (ISSUE 5): phase attribution + the measured-
+            # vs-modeled collective verdict from the SAME parsed trace
+            from distributed_llama_tpu.obs.drift import bench_drift_fields
+
+            _STARTUP["drift"] = bench_drift_fields(splits, spec, rank_tp,
+                                                   tokens=ran)
+            print(f"drift: {_STARTUP['drift']['verdict']} "
+                  f"(phase coverage "
+                  f"{_STARTUP['drift']['phase_coverage']:.0%}, collective "
+                  f"ms/token measured "
+                  f"{_STARTUP['drift']['collectives']['measured_ms_per_token']}"
+                  f" vs modeled "
+                  f"{_STARTUP['drift']['collectives']['modeled_ms_per_token']})",
+                  file=sys.stderr)
         except Exception as e:  # noqa: BLE001 - attribution is best-effort
             # the profiled chain is an EXTRA run: a trace hiccup (axon
             # profiler flake, disk) must not take down the timed rows below

@@ -16,6 +16,8 @@ at lint time:
   D006  tp collective issued outside parallel/tp.py's _ici_* helpers
   D007  implicit dtype promotion: a bf16/f16 value mixed with an explicit
         f32 operand silently upcasts the whole expression
+  D008  monotonic/perf_counter delta around device work with neither a
+        sync nor a span — invisible to the timeline, measures dispatch
 
 False-positive policy: rules stay *narrow* (better to miss a hazard than to
 train people to pragma reflexively); intentional sites carry
@@ -441,6 +443,90 @@ def d007_dtype_promotion(ctx: ModuleContext) -> Iterator[Finding]:
                 d007_dtype_promotion.hint)
 
 
+# the clocks the obs stack standardized on (D005 owns the time.time()
+# spelling); a delta of either around un-synced device work is the same
+# dispatch-vs-execution trap, PLUS a hole in the span timeline
+_D008_CLOCKS = frozenset(("time.monotonic", "time.perf_counter"))
+
+
+def _calls_span(ctx: ModuleContext, func: ast.AST) -> bool:
+    """Does this def open a span? Matches ``tracer.span(...)``,
+    ``self._spans.span(...)``, and guard helpers like ``self._span(...)``
+    — the final attribute segment, underscores stripped, is 'span'."""
+    for node in ast.walk(func):
+        if isinstance(node, ast.Call):
+            t = ctx.call_target(node)
+            if t is not None and t.rsplit(".", 1)[-1].lstrip("_") == "span":
+                return True
+    return False
+
+
+def _calls_blocking_asarray(ctx: ModuleContext, func: ast.AST) -> bool:
+    """np.asarray over a non-literal is a blocking transfer — the
+    sanctioned sync D005's docstring blesses (host literals don't sync)."""
+    for node in ast.walk(func):
+        if (isinstance(node, ast.Call)
+                and ctx.call_target(node) == "numpy.asarray" and node.args
+                and not isinstance(node.args[0], _HOST_LITERALS)):
+            return True
+    return False
+
+
+@rule("D008", "timed region wraps device work with neither a sync nor a span",
+      "open a span (obs/spans.SpanTracer; the timeline then owns the "
+      "region) or drain with block_until_ready / the "
+      "obs/trace.sync_device_timing gate — otherwise the interval "
+      "measures dispatch and /debug/timeline has a hole",
+      scope=("runtime/", "parallel/"))
+def d008_span_hygiene(ctx: ModuleContext) -> Iterator[Finding]:
+    """A ``time.monotonic()``/``time.perf_counter()`` delta in a function
+    that dispatches jax work but never syncs (block_until_ready, the
+    sync_device_timing gate, a blocking np.asarray) and never opens a
+    span. D005 catches the time.time() spelling of the dispatch trap;
+    this rule covers the monotonic clocks AND enforces that timed device
+    regions appear in the span timeline (ISSUE 5)."""
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if not ctx.function_calls_device(node):
+            continue
+        if (ctx.function_calls(node, "block_until_ready")
+                or ctx.function_calls(node, "sync_device_timing")
+                or _calls_span(ctx, node)
+                or _calls_blocking_asarray(ctx, node)):
+            continue
+        t_names: set[str] = set()
+        for sub in ast.walk(node):
+            if (isinstance(sub, ast.Assign)
+                    and ctx.enclosing_function(sub) is node
+                    and isinstance(sub.value, ast.Call)
+                    and ctx.call_target(sub.value) in _D008_CLOCKS):
+                for t in sub.targets:
+                    if isinstance(t, ast.Name):
+                        t_names.add(t.id)
+
+        def is_clock_side(expr):
+            if (isinstance(expr, ast.Call)
+                    and ctx.call_target(expr) in _D008_CLOCKS):
+                return True
+            return isinstance(expr, ast.Name) and expr.id in t_names
+
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.BinOp) or not isinstance(sub.op,
+                                                                ast.Sub):
+                continue
+            # deltas inside a nested def are that def's business (same
+            # ownership rule as D005)
+            if ctx.enclosing_function(sub) is not node:
+                continue
+            if is_clock_side(sub.left) or is_clock_side(sub.right):
+                yield _finding(
+                    ctx, sub, "D008",
+                    "monotonic/perf_counter interval around device work "
+                    "with no sync and no span",
+                    d008_span_hygiene.hint)
+
+
 RULES = (d001_implicit_sync, d002_retrace_trap, d003_jit_closure,
          d004_hot_loop_alloc, d005_bare_time, d006_unmodeled_collective,
-         d007_dtype_promotion)
+         d007_dtype_promotion, d008_span_hygiene)

@@ -614,7 +614,8 @@ def cmd_serve(argv: list[str]) -> int:
                              fast_prefill=args.fast_prefill,
                              metrics=args.metrics)
     endpoints = "POST /generate, GET /health" + (
-        ", GET /metrics, POST /profile" if args.metrics else "")
+        ", GET /metrics, GET /debug/timeline, POST /profile"
+        if args.metrics else "")
     print(f"🌐 serving on http://{args.host}:{server.port} "
           f"({args.slots} slots, {endpoints})")
     server.serve_forever()

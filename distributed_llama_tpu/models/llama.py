@@ -33,6 +33,10 @@ import jax.numpy as jnp
 
 from ..io.loader import (Q40Kernel, Q40KernelI4, Q40KernelI4PackedD,
                          Q40KernelI4PackedNb, Q40KernelNb, Q40KernelNbI4)
+# the single-chip forward emits the SAME canonical trace scopes as the tp
+# forward (parallel/tp.py), so a --profile capture of either program
+# attributes through one obs/xprof.py vocabulary
+from ..obs.spans import SCOPE_ATTN, SCOPE_EMBED, SCOPE_FFN, SCOPE_LOGITS
 from ..ops.linear import StackedQ40, fake_quant_q80, matmul, rmsnorm, silu
 from ..ops.quants import FloatType
 from .spec import TransformerSpec
@@ -264,18 +268,20 @@ def _qkv_proj(spec: TransformerSpec, lw: dict[str, Any], x: jax.Array,
 def _post_attention(spec: TransformerSpec, lw: dict[str, Any], x: jax.Array,
                     ao: jax.Array) -> jax.Array:
     """Shared layer tail: wo + residual, then the SwiGLU ffn sub-block."""
-    ao = _maybe_q80(spec, ao)
-    x = x + matmul(lw["wo"], ao)
-    xb = rmsnorm(x, lw["rms_ffn"])
-    xb = _maybe_q80(spec, xb)
-    if "w13" in lw:  # load-time fused kernel (ops/linear.fuse_q40_layer_matmuls)
-        h13 = matmul(lw["w13"], xb)
-        hid = h13.shape[-1] // 2
-        hb = silu(h13[..., :hid]) * h13[..., hid:]
-    else:
-        hb = silu(matmul(lw["w1"], xb)) * matmul(lw["w3"], xb)
-    hb = _maybe_q80(spec, hb)
-    return x + matmul(lw["w2"], hb)
+    with jax.named_scope(SCOPE_ATTN):
+        ao = _maybe_q80(spec, ao)
+        x = x + matmul(lw["wo"], ao)
+    with jax.named_scope(SCOPE_FFN):
+        xb = rmsnorm(x, lw["rms_ffn"])
+        xb = _maybe_q80(spec, xb)
+        if "w13" in lw:  # load-time fused kernel (linear.fuse_q40_layer_matmuls)
+            h13 = matmul(lw["w13"], xb)
+            hid = h13.shape[-1] // 2
+            hb = silu(h13[..., :hid]) * h13[..., hid:]
+        else:
+            hb = silu(matmul(lw["w1"], xb)) * matmul(lw["w3"], xb)
+        hb = _maybe_q80(spec, hb)
+        return x + matmul(lw["w2"], hb)
 
 
 def _layer(spec: TransformerSpec, x: jax.Array, lw: dict[str, Any],
@@ -285,27 +291,33 @@ def _layer(spec: TransformerSpec, x: jax.Array, lw: dict[str, Any],
     updated in place at layer ``idx``. This is the body `forward`'s layer
     scan runs (and what the golden-parity test drives with L=1)."""
     t_len = x.shape[0]
-    q, k, v = _qkv_proj(spec, lw, x, positions)
-    dt = k_all.dtype  # f32 parity default; bf16 halves cache HBM/memory
-    k_new = k.reshape(1, t_len, spec.n_kv_heads, spec.head_size).astype(dt)
-    v_new = v.reshape(1, t_len, spec.n_kv_heads, spec.head_size).astype(dt)
-    k_all = jax.lax.dynamic_update_slice(k_all, k_new, (idx, pos, 0, 0))
-    v_all = jax.lax.dynamic_update_slice(v_all, v_new, (idx, pos, 0, 0))
+    with jax.named_scope(SCOPE_ATTN):
+        q, k, v = _qkv_proj(spec, lw, x, positions)
+        dt = k_all.dtype  # f32 parity default; bf16 halves cache HBM
+        k_new = k.reshape(1, t_len, spec.n_kv_heads,
+                          spec.head_size).astype(dt)
+        v_new = v.reshape(1, t_len, spec.n_kv_heads,
+                          spec.head_size).astype(dt)
+        k_all = jax.lax.dynamic_update_slice(k_all, k_new, (idx, pos, 0, 0))
+        v_all = jax.lax.dynamic_update_slice(v_all, v_new, (idx, pos, 0, 0))
 
-    from ..ops.pallas_attention import maybe_flash_decode
+        from ..ops.pallas_attention import maybe_flash_decode
 
-    # flash-decode kernel: reads only the live chunks of the stacked cache
-    # (pos-proportional HBM traffic, like the reference's 0..pos attention
-    # loop) instead of the full static plane
-    ao = maybe_flash_decode(
-        q, k_all, v_all, idx, pos, seq_len=spec.seq_len,
-        head_size=spec.head_size, t_len=t_len, n_kv=spec.n_kv_heads,
-        kv_mul=spec.kv_mul)
-    if ao is None:
-        k_c = jax.lax.dynamic_index_in_dim(k_all, idx, 0, keepdims=False)
-        v_c = jax.lax.dynamic_index_in_dim(v_all, idx, 0, keepdims=False)
-        ao = attention(spec, q.reshape(t_len, spec.n_heads, spec.head_size),
-                       k_c, v_c, pos, t_len)
+        # flash-decode kernel: reads only the live chunks of the stacked
+        # cache (pos-proportional HBM traffic, like the reference's 0..pos
+        # attention loop) instead of the full static plane
+        ao = maybe_flash_decode(
+            q, k_all, v_all, idx, pos, seq_len=spec.seq_len,
+            head_size=spec.head_size, t_len=t_len, n_kv=spec.n_kv_heads,
+            kv_mul=spec.kv_mul)
+        if ao is None:
+            k_c = jax.lax.dynamic_index_in_dim(k_all, idx, 0,
+                                               keepdims=False)
+            v_c = jax.lax.dynamic_index_in_dim(v_all, idx, 0,
+                                               keepdims=False)
+            ao = attention(spec,
+                           q.reshape(t_len, spec.n_heads, spec.head_size),
+                           k_c, v_c, pos, t_len)
     x = _post_attention(spec, lw, x, ao)
     return x, k_all, v_all
 
@@ -421,7 +433,8 @@ def forward(spec: TransformerSpec, params: dict[str, Any], cache: KVCache,
                                                                    params):
             return _forward_fused(spec, params, cache, tokens, pos)
     positions = pos + jnp.arange(t_len)
-    x = params["tok_embedding"][tokens].astype(jnp.float32)  # (T, dim)
+    with jax.named_scope(SCOPE_EMBED):
+        x = params["tok_embedding"][tokens].astype(jnp.float32)  # (T, dim)
 
     stacked, scanned = split_layer_weights(params)
 
@@ -443,8 +456,9 @@ def forward(spec: TransformerSpec, params: dict[str, Any], cache: KVCache,
     (x, k_new, v_new), _ = jax.lax.scan(scan_body, (x, cache.k, cache.v),
                                         (idxs, scanned))
 
-    x = rmsnorm(x, params["rms_final"])
-    logits = matmul(params["wcls"], x)
+    with jax.named_scope(SCOPE_LOGITS):
+        x = rmsnorm(x, params["rms_final"])
+        logits = matmul(params["wcls"], x)
     return logits, KVCache(k_new, v_new)
 
 

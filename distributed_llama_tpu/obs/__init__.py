@@ -11,7 +11,14 @@ instrument is one end-of-run benchmark line, tokenizer.cpp:381):
 * ``obs.log`` — optional NDJSON event log (``DLLAMA_LOG_JSON=1``) behind
   the existing 🌐/⏩/🔶 print sites;
 * ``obs.profiler`` — guarded jax.profiler captures (``POST /profile``,
-  ``DLLAMA_PROFILE_DIR``).
+  ``DLLAMA_PROFILE_DIR``);
+* ``obs.spans`` — hierarchical span tracer (request → prefill/decode →
+  layer → phase) + the canonical jax.named_scope names the tp forward
+  emits; Chrome-trace/Perfetto + NDJSON exports (``GET /debug/timeline``);
+* ``obs.xprof`` — profiler-capture loader: device events bucketed by
+  named scope into per-phase ms/token and per-collective time/bytes;
+* ``obs.drift`` — the model-vs-measured reconciler behind
+  ``tools/tracecheck.py``, the bench drift columns, and CI's DRIFT gate.
 
 Collection is opt-in: hot paths hold a None handle when disabled and make
 zero registry calls (tests/test_obs.py pins this).
@@ -19,7 +26,9 @@ zero registry calls (tests/test_obs.py pins this).
 
 from .log import json_mode, log_event
 from .metrics import (Counter, Gauge, Histogram, Registry, summarize_values)
+from .spans import SpanTracer, spans_to_chrome, validate_chrome_trace
 from .trace import EngineMetrics
 
 __all__ = ["Counter", "Gauge", "Histogram", "Registry", "EngineMetrics",
+           "SpanTracer", "spans_to_chrome", "validate_chrome_trace",
            "json_mode", "log_event", "summarize_values"]

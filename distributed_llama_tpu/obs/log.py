@@ -9,6 +9,12 @@ runtime/server.py, runtime/generate.py, and io/stream.py call
 ``log_event(event, text, **fields)``: JSON mode emits
 ``{"ts", "event", **fields}``; text mode prints ``text`` verbatim (or
 nothing when text is None — a JSON-only event).
+
+Every NDJSON record additionally carries the run-config header
+(utils/fingerprint.run_stamp): ``tp_scheme``, the ``DLLAMA_Q40_BODY``
+policy, and the same ``env_fingerprint`` bench.py records per row — so a
+log stream is JOINABLE with BENCH_* rows and profiler captures by
+session basis. Explicit fields win over the stamp on key collision.
 """
 
 from __future__ import annotations
@@ -36,6 +42,12 @@ def log_event(event: str, text: str | None = None, *, file=None,
     out = sys.stdout if file is None else file
     if json_mode():
         rec = {"ts": round(time.time(), 6), "event": event}
+        try:
+            from ..utils.fingerprint import run_stamp
+
+            rec.update(run_stamp())
+        except Exception:  # noqa: BLE001 - the stamp must never kill a line
+            pass
         rec.update(fields)
         try:
             line = json.dumps(rec)

@@ -39,15 +39,34 @@ RATE_BUCKETS = (0.1, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0,
 COUNT_BUCKETS = (0.0, 1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0)
 
 
+def _render_labels(labels) -> str:
+    """``{k="v",...}`` suffix for a (key, value) pair tuple; "" when
+    unlabeled. Pairs render SORTED by key — the label set is the series
+    identity, so two call sites passing the same labels in different
+    order must land on one series (and one exposition line), not two
+    that Prometheus rejects as duplicate samples. Values are escaped per
+    the exposition format."""
+    if not labels:
+        return ""
+    inner = ",".join(
+        '{}="{}"'.format(k, str(v).replace("\\", r"\\").replace('"', r'\"'))
+        for k, v in sorted(labels))
+    return "{" + inner + "}"
+
+
 class Counter:
-    """Monotonic float counter."""
+    """Monotonic float counter. ``labels`` (a (key, value) pair tuple)
+    makes this one SERIES of the metric family ``name`` — exposition
+    renders ``name{k="v"} value`` and the Registry emits the family's
+    HELP/TYPE header once."""
 
     kind = "counter"
-    __slots__ = ("name", "help", "_lock", "_value")
+    __slots__ = ("name", "help", "labels", "_lock", "_value")
 
-    def __init__(self, name: str, help: str = "", lock=None):
+    def __init__(self, name: str, help: str = "", lock=None, labels=()):
         self.name = name
         self.help = help
+        self.labels = tuple(labels)
         self._lock = lock or threading.Lock()
         self._value = 0.0
 
@@ -63,18 +82,20 @@ class Counter:
             return self._value
 
     def expose(self) -> list[str]:
-        return [f"{self.name} {_fmt(self.value)}"]
+        return [f"{self.name}{_render_labels(self.labels)} "
+                f"{_fmt(self.value)}"]
 
 
 class Gauge:
-    """Instantaneous value (set/inc/dec)."""
+    """Instantaneous value (set/inc/dec); labeled like Counter."""
 
     kind = "gauge"
-    __slots__ = ("name", "help", "_lock", "_value")
+    __slots__ = ("name", "help", "labels", "_lock", "_value")
 
-    def __init__(self, name: str, help: str = "", lock=None):
+    def __init__(self, name: str, help: str = "", lock=None, labels=()):
         self.name = name
         self.help = help
+        self.labels = tuple(labels)
         self._lock = lock or threading.Lock()
         self._value = 0.0
 
@@ -96,7 +117,8 @@ class Gauge:
             return self._value
 
     def expose(self) -> list[str]:
-        return [f"{self.name} {_fmt(self.value)}"]
+        return [f"{self.name}{_render_labels(self.labels)} "
+                f"{_fmt(self.value)}"]
 
 
 class Histogram:
@@ -213,23 +235,35 @@ class Registry:
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._metrics: dict = {}  # name -> instrument, insertion-ordered
+        self._metrics: dict = {}  # series key -> instrument, insertion-ordered
+        self._family_kind: dict = {}  # family name -> kind string
 
-    def _get_or_create(self, cls, name: str, help: str, **kw):
+    def _get_or_create(self, cls, name: str, help: str, labels=(), **kw):
+        key = name + _render_labels(labels)
         with self._lock:
-            m = self._metrics.get(name)
+            # kind consistency is a FAMILY property, labels or not: a
+            # counter series and a gauge series under one name would
+            # expose the second under the first's TYPE header
+            have = self._family_kind.setdefault(name, cls.kind)
+            if have != cls.kind:
+                raise ValueError(f"metric family {name} already registered "
+                                 f"as {have}, requested {cls.kind}")
+            m = self._metrics.get(key)
             if m is not None:
                 if not isinstance(m, cls):
-                    raise ValueError(f"metric {name} already registered as "
+                    raise ValueError(f"metric {key} already registered as "
                                      f"{m.kind}, requested {cls.kind}")
                 want = kw.get("buckets")
                 if want is not None and tuple(
                         float(b) for b in want) != m.buckets:
-                    raise ValueError(f"histogram {name} already registered "
+                    raise ValueError(f"histogram {key} already registered "
                                      f"with different buckets")
                 return m
-            m = cls(name, help, **kw)
-            self._metrics[name] = m
+            if labels:
+                m = cls(name, help, labels=labels, **kw)
+            else:
+                m = cls(name, help, **kw)
+            self._metrics[key] = m
             return m
 
     def counter(self, name: str, help: str = "") -> Counter:
@@ -238,24 +272,47 @@ class Registry:
     def gauge(self, name: str, help: str = "") -> Gauge:
         return self._get_or_create(Gauge, name, help)
 
+    def labeled_counter(self, name: str, labels: dict,
+                        help: str = "") -> Counter:
+        """One labeled series of the counter family ``name`` (e.g.
+        dllama_ici_collectives_total{kind="psum",scheme="fused"})."""
+        return self._get_or_create(Counter, name, help,
+                                   labels=tuple(labels.items()))
+
+    def labeled_gauge(self, name: str, labels: dict,
+                      help: str = "") -> Gauge:
+        return self._get_or_create(Gauge, name, help,
+                                   labels=tuple(labels.items()))
+
     def histogram(self, name: str, help: str = "",
                   buckets: tuple = LATENCY_BUCKETS) -> Histogram:
         return self._get_or_create(Histogram, name, help, buckets=buckets)
 
     def get(self, name: str):
+        """Look up a series by its key: the bare name, or
+        ``name{k="v",...}`` for labeled series."""
         with self._lock:
             return self._metrics.get(name)
 
     def expose(self) -> str:
-        """Prometheus text exposition (format version 0.0.4)."""
+        """Prometheus text exposition (format version 0.0.4). All series
+        of a metric FAMILY are emitted as one group under a single
+        HELP/TYPE header (the exposition grouping rule — parsers split
+        interleaved families into duplicate, untyped ones), families in
+        first-registration order."""
         with self._lock:
             metrics = list(self._metrics.values())
-        lines: list[str] = []
+        families: dict = {}  # name -> [instruments], first-seen order
         for m in metrics:
-            if m.help:
-                lines.append(f"# HELP {m.name} {m.help}")
-            lines.append(f"# TYPE {m.name} {m.kind}")
-            lines.extend(m.expose())
+            families.setdefault(m.name, []).append(m)
+        lines: list[str] = []
+        for name, members in families.items():
+            first = members[0]
+            if first.help:
+                lines.append(f"# HELP {name} {first.help}")
+            lines.append(f"# TYPE {name} {first.kind}")
+            for m in members:
+                lines.extend(m.expose())
         return "\n".join(lines) + ("\n" if lines else "")
 
 

@@ -91,6 +91,31 @@ class EngineMetrics:
         self.cancelled = c(
             "dllama_requests_cancelled_total",
             "Requests retired because the consumer vanished")
+        # per-scheme collective series, bound by bind_collectives() when
+        # the engine runs sharded: [(launch counter, byte counter,
+        # launches/step, bytes/step)] — empty (and never touched) at tp=1
+        self._collectives: list = []
+
+    def bind_collectives(self, budget, scheme: str, rows: int = 1) -> None:
+        """Register the analytic collective budget as labeled series so
+        /metrics shows the exact schedule the drift gate checks against
+        (ISSUE 5): one {kind, scheme} series pair per budget entry,
+        incremented per device step. ``rows`` scales BYTES only — the
+        batched forward moves ``rows`` activation rows per collective
+        while the launch count stays the per-step schedule."""
+        self._collectives = [
+            (self.registry.labeled_counter(
+                "dllama_ici_collectives_total",
+                {"kind": kind, "scheme": scheme},
+                "Collective launches, analytic per-step schedule "
+                "(comm_stats.tp_collective_budget)"),
+             self.registry.labeled_counter(
+                "dllama_ici_bytes_total",
+                {"kind": kind, "scheme": scheme},
+                "Bytes moved per chip by the collective schedule "
+                "(ring-accounted, comm_stats)"),
+             count, moved_bytes * rows)
+            for kind, count, moved_bytes in budget.entries]
 
     def record_step(self, dt_s: float, active: int, steps: int = 1) -> None:
         """One scheduler iteration: ``steps`` device steps (1 for
@@ -99,6 +124,9 @@ class EngineMetrics:
         self.step_duration.observe(dt_s)
         self.occupancy.observe(active)
         self.active_slots.set(active)
+        for launches, moved, n, b in self._collectives:
+            launches.inc(n * steps)
+            moved.inc(b * steps)
 
     def record_retire(self, req, now: float) -> None:
         """Derive the lifecycle histograms at retirement. Cancelled and

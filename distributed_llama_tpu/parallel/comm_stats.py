@@ -94,6 +94,12 @@ class CollectiveBudget:
     def kind_counts(self) -> dict[str, int]:
         return {k: c for k, c, _ in self.entries}
 
+    def bytes_by_kind(self) -> dict[str, int]:
+        """kind -> moved bytes/chip/token — the per-kind join key the
+        drift reconciler (obs/drift.py reconcile) reads; same rows as
+        ``entries``, keyed like ``kind_counts``."""
+        return {k: b for k, _, b in self.entries}
+
 
 def tp_collective_budget(spec: TransformerSpec, n_slices: int,
                          scheme: str | None = None) -> CollectiveBudget:

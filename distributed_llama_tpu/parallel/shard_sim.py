@@ -199,6 +199,21 @@ V5E_ICI_GBPS_PER_DIRECTION = 90.0  # 2 links x 45 GB/s, 1-D ring axis
 ICI_COLLECTIVE_LATENCY_US = 1.0    # per all_gather launch+sync, per hop
 
 
+def modeled_ici_ms(spec: TransformerSpec, n_slices: int,
+                   scheme: str | None = None,
+                   gbps: float = V5E_ICI_GBPS_PER_DIRECTION,
+                   latency_us: float = ICI_COLLECTIVE_LATENCY_US,
+                   ) -> tuple[float, float]:
+    """(bandwidth_ms, latency_ms) per token for the scheme's collective
+    schedule — the ONE formula behind project_full_system's ICI columns
+    and the obs/drift time check, so the projection the bench prints and
+    the band the drift gate holds measurements to cannot diverge."""
+    budget = tp_collective_budget(spec, n_slices, scheme)
+    bw_ms = budget.moved_bytes / (gbps * 1e9) * 1e3
+    lat_ms = budget.n_collectives * (n_slices - 1) * latency_us / 1e3
+    return bw_ms, lat_ms
+
+
 @dataclasses.dataclass(frozen=True)
 class FullSystemProjection:
     """Measured shard compute + modeled ICI = projected full-system ms/token,
@@ -251,8 +266,7 @@ def project_full_system(spec: TransformerSpec, n_slices: int,
     scheme = scheme or tp_scheme()
     budget = tp_collective_budget(spec, n_slices, scheme)
     n_coll = budget.n_collectives
-    bw_ms = budget.moved_bytes / (gbps * 1e9) * 1e3
-    lat_ms = n_coll * (n_slices - 1) * latency_us / 1e3
+    bw_ms, lat_ms = modeled_ici_ms(spec, n_slices, scheme, gbps, latency_us)
     mem = device_footprint(spec, n_slices, scheme)
     return FullSystemProjection(shard_ms, bw_ms, lat_ms, n_slices,
                                 budget.moved_bytes, n_coll,

@@ -79,6 +79,12 @@ from ..models.llama import (KVCache, attention_core, batch_decode_attention,
                             causal_cache_mask, layer_view, rope_rotate,
                             split_layer_weights)
 from ..models.spec import TransformerSpec
+# canonical trace-scope names (obs/spans.py): every phase and collective
+# scope this forward emits is a name the xprof loader buckets by — the
+# attribution contract lives THERE, the emission lives HERE
+from ..obs.spans import (SCOPE_ATTN, SCOPE_EMBED, SCOPE_FFN, SCOPE_ICI_GATHER,
+                         SCOPE_ICI_PSUM, SCOPE_ICI_SCATTER, SCOPE_LAYER,
+                         SCOPE_LOGITS)
 from ..ops.linear import fake_quant_q80, matmul, rmsnorm, silu
 from ..ops.quants import FloatType, dequantize_q80_jax, quantize_q80_jax
 from ..utils.compat import shard_map as _shard_map
@@ -244,22 +250,29 @@ def _ici_gather(a: jax.Array, axis: int) -> jax.Array:
     _ici_gather/_ici_psum/_ici_scatter are the ONLY places the tp forward
     may issue a collective: comm_stats models exactly these, J001 pins the
     traced program to that model, and dlint D006 flags any jax.lax
-    collective in this module outside the three helpers."""
-    return jax.lax.all_gather(a, "tp", axis=axis, tiled=True)
+    collective in this module outside the three helpers. Each helper emits
+    its named scope (obs/spans.COLLECTIVE_SCOPE_KINDS), so a profiler
+    capture labels every collective with the budget kind it must
+    reconcile against — BOTH schemes are labeled at source."""
+    with jax.named_scope(SCOPE_ICI_GATHER):
+        return jax.lax.all_gather(a, "tp", axis=axis, tiled=True)
 
 
 def _ici_psum(a: jax.Array) -> jax.Array:
     """The fused scheme's f32 combine: ONE all_reduce of the row-parallel
     partial block outputs over tp (swappable like _ici_gather; shard_sim
     substitutes identity — the local partial already has the full shape)."""
-    return jax.lax.psum(a, "tp")
+    with jax.named_scope(SCOPE_ICI_PSUM):
+        return jax.lax.psum(a, "tp")
 
 
 def _ici_scatter(a: jax.Array, axis: int) -> jax.Array:
     """The fused scheme's Q80 reduce half: psum_scatter leaves each device
     the EXACT f32 sum of its band of ``axis`` (band order = shard order),
     which _wire_gather then moves as the packed Q80 payload."""
-    return jax.lax.psum_scatter(a, "tp", scatter_dimension=axis, tiled=True)
+    with jax.named_scope(SCOPE_ICI_SCATTER):
+        return jax.lax.psum_scatter(a, "tp", scatter_dimension=axis,
+                                    tiled=True)
 
 
 def _gather(x: jax.Array, gather_fn=_ici_gather) -> jax.Array:
@@ -372,27 +385,32 @@ def _tp_tail(spec: TransformerSpec, x, lw, ao, gather_fn=_ici_gather,
     local fake-quants (_wire) where no wire remains.
     """
     if scheme == "fused":
-        ao = _wire(spec, ao)                       # ⇄ quantizeMultiheadAtt
-        xb2 = matmul(lw["wo"], ao)                 # (T, dim) partial sums
-        x = x + _combine(spec, xb2, gather_fn, psum_fn,
-                         scatter_fn)               # ⇄ syncMultiheadAtt+syncAtt
+        with jax.named_scope(SCOPE_ATTN):
+            ao = _wire(spec, ao)                   # ⇄ quantizeMultiheadAtt
+            xb2 = matmul(lw["wo"], ao)             # (T, dim) partial sums
+            x = x + _combine(spec, xb2, gather_fn, psum_fn,
+                             scatter_fn)       # ⇄ syncMultiheadAtt+syncAtt
 
+        with jax.named_scope(SCOPE_FFN):
+            xb = rmsnorm(x, lw["rms_ffn"])
+            xb = _wire(spec, xb)                   # ⇄ quantizeRmfFfn
+            hb = _wire(spec, _swiglu_local(lw, xb))  # ⇄ quantizeFfnA (local)
+            xb2 = matmul(lw["w2"], hb)             # (T, dim) partial sums
+            return x + _combine(spec, xb2, gather_fn, psum_fn,
+                                scatter_fn)        # ⇄ syncFfnA/B+syncFfn2
+    with jax.named_scope(SCOPE_ATTN):
+        xb = _wire_gather(spec, ao, gather_fn)     # ⇄ syncMultiheadAtt
+        xb2 = matmul(lw["wo"], xb)                 # (T, dim/S)
+        x = x + _wire_gather(spec, xb2, gather_fn)  # ⇄ syncAtt + residual
+
+    with jax.named_scope(SCOPE_FFN):
         xb = rmsnorm(x, lw["rms_ffn"])
         xb = _wire(spec, xb)                       # ⇄ quantizeRmfFfn
-        hb = _wire(spec, _swiglu_local(lw, xb))    # ⇄ quantizeFfnA (local)
-        xb2 = matmul(lw["w2"], hb)                 # (T, dim) partial sums
-        return x + _combine(spec, xb2, gather_fn, psum_fn,
-                            scatter_fn)            # ⇄ syncFfnA/B+syncFfn2
-    xb = _wire_gather(spec, ao, gather_fn)         # ⇄ syncMultiheadAtt
-    xb2 = matmul(lw["wo"], xb)                     # (T, dim/S)
-    x = x + _wire_gather(spec, xb2, gather_fn)     # ⇄ syncAtt + residual
-
-    xb = rmsnorm(x, lw["rms_ffn"])
-    xb = _wire(spec, xb)                           # ⇄ quantizeRmfFfn
-    hb = _wire_gather(spec, _swiglu_local(lw, xb),
-                      gather_fn)                   # ⇄ syncFfnA+syncFfnB
-    xb2 = matmul(lw["w2"], hb)                     # (T, dim/S)
-    return x + _wire_gather(spec, xb2, gather_fn)  # ⇄ syncFfn2 + residual
+        hb = _wire_gather(spec, _swiglu_local(lw, xb),
+                          gather_fn)               # ⇄ syncFfnA+syncFfnB
+        xb2 = matmul(lw["w2"], hb)                 # (T, dim/S)
+        return x + _wire_gather(spec, xb2,
+                                gather_fn)         # ⇄ syncFfn2 + residual
 
 
 def _local_layer(spec: TransformerSpec, n_slices: int, n_sp: int, x, lw,
@@ -408,48 +426,55 @@ def _local_layer(spec: TransformerSpec, n_slices: int, n_sp: int, x, lw,
     kv_heads_loc = spec.n_kv_heads // n_slices
     seq_chunk = spec.seq_len // n_sp
 
-    q, k, v = _tp_qkv(spec, n_slices, lw, x, positions)
-    dt = k_all.dtype  # f32 parity default; bf16 halves cache HBM/memory
-    k_new = k.reshape(t_len, kv_heads_loc, spec.head_size).astype(dt)
-    v_new = v.reshape(t_len, kv_heads_loc, spec.head_size).astype(dt)
-    qh = q.reshape(t_len, heads_loc, spec.head_size)
+    # qkv + rope + cache write + attention core run under the `attn` trace
+    # scope; the layer tail scopes its own attn (wo/combine) and ffn halves
+    with jax.named_scope(SCOPE_ATTN):
+        q, k, v = _tp_qkv(spec, n_slices, lw, x, positions)
+        dt = k_all.dtype  # f32 parity default; bf16 halves cache HBM/memory
+        k_new = k.reshape(t_len, kv_heads_loc, spec.head_size).astype(dt)
+        v_new = v.reshape(t_len, kv_heads_loc, spec.head_size).astype(dt)
+        qh = q.reshape(t_len, heads_loc, spec.head_size)
 
-    if n_sp == 1:
-        k_all = jax.lax.dynamic_update_slice(k_all, k_new[None],
-                                             (idx, pos, 0, 0))
-        v_all = jax.lax.dynamic_update_slice(v_all, v_new[None],
-                                             (idx, pos, 0, 0))
+        if n_sp == 1:
+            k_all = jax.lax.dynamic_update_slice(k_all, k_new[None],
+                                                 (idx, pos, 0, 0))
+            v_all = jax.lax.dynamic_update_slice(v_all, v_new[None],
+                                                 (idx, pos, 0, 0))
 
-        from ..ops.pallas_attention import maybe_flash_decode
+            from ..ops.pallas_attention import maybe_flash_decode
 
-        # per-shard flash-decode over the LOCAL kv heads: contiguous bands
-        # keep h -> h//kvMul local, so the kernel's grouping applies
-        # unchanged at shard scope (live-chunk reads, like the single-chip
-        # path)
-        ao = maybe_flash_decode(
-            qh, k_all, v_all, idx, pos, seq_len=spec.seq_len,
-            head_size=spec.head_size, t_len=t_len, n_kv=kv_heads_loc,
-            kv_mul=spec.kv_mul)
-        if ao is None:
+            # per-shard flash-decode over the LOCAL kv heads: contiguous
+            # bands keep h -> h//kvMul local, so the kernel's grouping
+            # applies unchanged at shard scope (live-chunk reads, like the
+            # single-chip path)
+            ao = maybe_flash_decode(
+                qh, k_all, v_all, idx, pos, seq_len=spec.seq_len,
+                head_size=spec.head_size, t_len=t_len, n_kv=kv_heads_loc,
+                kv_mul=spec.kv_mul)
+            if ao is None:
+                k_c = jax.lax.dynamic_index_in_dim(k_all, idx, 0,
+                                                   keepdims=False)
+                v_c = jax.lax.dynamic_index_in_dim(v_all, idx, 0,
+                                                   keepdims=False)
+                # local-head attention (math of transformer-tasks.cpp:
+                # 206-278 per head)
+                ao = attention_core(
+                    spec.head_size, spec.kv_mul, qh, k_c, v_c,
+                    causal_cache_mask(spec.seq_len, pos, t_len))
+        else:
+            from .ring import sp_cache_attention, update_sp_cache
+
+            sp_index = jax.lax.axis_index("sp")
             k_c = jax.lax.dynamic_index_in_dim(k_all, idx, 0, keepdims=False)
-            v_c = jax.lax.dynamic_index_in_dim(v_all, idx, 0,
-                                               keepdims=False)
-            # local-head attention (math of transformer-tasks.cpp:206-278
-            # per head)
-            ao = attention_core(spec.head_size, spec.kv_mul, qh, k_c, v_c,
-                                causal_cache_mask(spec.seq_len, pos, t_len))
-    else:
-        from .ring import sp_cache_attention, update_sp_cache
-
-        sp_index = jax.lax.axis_index("sp")
-        k_c = jax.lax.dynamic_index_in_dim(k_all, idx, 0, keepdims=False)
-        v_c = jax.lax.dynamic_index_in_dim(v_all, idx, 0, keepdims=False)
-        k_c = update_sp_cache(k_c, k_new, pos, sp_index, seq_chunk)
-        v_c = update_sp_cache(v_c, v_new, pos, sp_index, seq_chunk)
-        k_all = jax.lax.dynamic_update_slice(k_all, k_c[None], (idx, 0, 0, 0))
-        v_all = jax.lax.dynamic_update_slice(v_all, v_c[None], (idx, 0, 0, 0))
-        ao = sp_cache_attention(spec.head_size, spec.kv_mul, seq_chunk,
-                                sp_index, qh, k_c, v_c, pos)
+            v_c = jax.lax.dynamic_index_in_dim(v_all, idx, 0, keepdims=False)
+            k_c = update_sp_cache(k_c, k_new, pos, sp_index, seq_chunk)
+            v_c = update_sp_cache(v_c, v_new, pos, sp_index, seq_chunk)
+            k_all = jax.lax.dynamic_update_slice(k_all, k_c[None],
+                                                 (idx, 0, 0, 0))
+            v_all = jax.lax.dynamic_update_slice(v_all, v_c[None],
+                                                 (idx, 0, 0, 0))
+            ao = sp_cache_attention(spec.head_size, spec.kv_mul, seq_chunk,
+                                    sp_index, qh, k_c, v_c, pos)
 
     x = _tp_tail(spec, x, lw, ao, gather_fn, scheme, psum_fn, scatter_fn)
     return x, k_all, v_all
@@ -500,26 +525,29 @@ def make_local_step(spec: TransformerSpec, n_slices: int, n_sp: int,
     def local_step(params, cache, tokens, pos):
         t_len = tokens.shape[0]
         positions = pos + jnp.arange(t_len)
-        x = params["tok_embedding"][tokens].astype(jnp.float32)
+        with jax.named_scope(SCOPE_EMBED):
+            x = params["tok_embedding"][tokens].astype(jnp.float32)
 
         stacked, scanned = split_layer_weights(params)
 
         def body(carry, per_layer):
             x, k_all, v_all = carry
             idx, lw_slice = per_layer
-            lw = layer_view(stacked, lw_slice, idx)
-            x, k_all, v_all = _local_layer(spec, n_slices, n_sp, x, lw,
-                                           k_all, v_all, idx, pos, positions,
-                                           gather_fn, scheme, psum_fn,
-                                           scatter_fn)
+            with jax.named_scope(SCOPE_LAYER):
+                lw = layer_view(stacked, lw_slice, idx)
+                x, k_all, v_all = _local_layer(spec, n_slices, n_sp, x, lw,
+                                               k_all, v_all, idx, pos,
+                                               positions, gather_fn, scheme,
+                                               psum_fn, scatter_fn)
             return (x, k_all, v_all), None
 
         idxs = jnp.arange(spec.n_layers, dtype=jnp.int32)
         (x, k_new, v_new), _ = jax.lax.scan(body, (x, cache.k, cache.v),
                                             (idxs, scanned))
-        x = rmsnorm(x, params["rms_final"])
-        # vocab bands -> full
-        logits = _gather(matmul(params["wcls"], x), gather_fn)
+        with jax.named_scope(SCOPE_LOGITS):
+            x = rmsnorm(x, params["rms_final"])
+            # vocab bands -> full
+            logits = _gather(matmul(params["wcls"], x), gather_fn)
         return logits, KVCache(k_new, v_new)
 
     return local_step
@@ -627,7 +655,8 @@ def make_sharded_forward_batch(spec: TransformerSpec, mesh: Mesh,
 
     def local_step(params, cache, tokens, pos):
         B = tokens.shape[0]
-        x = params["tok_embedding"][tokens].astype(jnp.float32)  # (B, dim)
+        with jax.named_scope(SCOPE_EMBED):
+            x = params["tok_embedding"][tokens].astype(jnp.float32)  # (B, d)
         positions = pos if jnp.ndim(pos) == 1 else jnp.full((B,), pos)
         # rank-4 (L*B, C, kv_loc, hs) carry view — same layout rationale as
         # forward_batch (row layer*B+b is a single-sequence cache plane)
@@ -638,23 +667,28 @@ def make_sharded_forward_batch(spec: TransformerSpec, mesh: Mesh,
         def body(carry, per_layer):
             x, k_all, v_all = carry
             idx, lw_slice = per_layer
-            lw = layer_view(stacked, lw_slice, idx)
-            q, k, v = _tp_qkv(spec, n_slices, lw, x, positions)
-            if n_sp == 1:
-                # shared with the single-chip batch path; the shard's cache
-                # holds kv_loc heads, read off the carry
-                ao, k_all, v_all = batch_decode_attention(
-                    hs, spec.kv_mul, S, q, k, v, k_all, v_all, idx, pos)
-            else:
-                ao, k_all, v_all = _batch_sp_attention(
-                    spec, C, q, k, v, k_all, v_all, idx, pos, kv_loc, hs)
-            x = _tp_tail(spec, x, lw, ao, scheme=scheme)
+            with jax.named_scope(SCOPE_LAYER):
+                lw = layer_view(stacked, lw_slice, idx)
+                with jax.named_scope(SCOPE_ATTN):
+                    q, k, v = _tp_qkv(spec, n_slices, lw, x, positions)
+                    if n_sp == 1:
+                        # shared with the single-chip batch path; the
+                        # shard's cache holds kv_loc heads, off the carry
+                        ao, k_all, v_all = batch_decode_attention(
+                            hs, spec.kv_mul, S, q, k, v, k_all, v_all, idx,
+                            pos)
+                    else:
+                        ao, k_all, v_all = _batch_sp_attention(
+                            spec, C, q, k, v, k_all, v_all, idx, pos,
+                            kv_loc, hs)
+                x = _tp_tail(spec, x, lw, ao, scheme=scheme)
             return (x, k_all, v_all), None
 
         idxs = jnp.arange(L, dtype=jnp.int32)
         (x, k4, v4), _ = jax.lax.scan(body, (x, k4, v4), (idxs, scanned))
-        x = rmsnorm(x, params["rms_final"])
-        logits = _gather(matmul(params["wcls"], x))
+        with jax.named_scope(SCOPE_LOGITS):
+            x = rmsnorm(x, params["rms_final"])
+            logits = _gather(matmul(params["wcls"], x))
         return logits, KVCache(k4.reshape(L, B, C, kv_loc, hs),
                                v4.reshape(L, B, C, kv_loc, hs))
 

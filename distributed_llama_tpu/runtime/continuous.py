@@ -23,6 +23,7 @@ output (the parity gate of tests/test_continuous.py).
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import threading
 import time
@@ -221,11 +222,26 @@ class ContinuousEngine:
         # site below is skipped — the hot path makes ZERO registry calls
         # (the off-unless-enabled contract, tests/test_obs.py)
         if metrics is not None:
+            from ..obs.spans import SpanTracer
             from ..obs.trace import EngineMetrics
 
             self._obs = EngineMetrics(metrics)
+            # the span timeline (GET /debug/timeline) rides the same
+            # opt-in: a disabled engine records nothing
+            self._spans = SpanTracer()
+            if mesh is not None and mesh.shape["tp"] > 1:
+                # export the analytic collective schedule as labeled
+                # /metrics series — the budget the drift gate (obs/drift)
+                # reconciles measurements against. Bytes scale by the slot
+                # count: every batched collective moves B rows.
+                from ..parallel.comm_stats import tp_collective_budget
+
+                self._obs.bind_collectives(
+                    tp_collective_budget(spec, mesh.shape["tp"], scheme),
+                    scheme, rows=slots)
         else:
             self._obs = None
+            self._spans = None
 
     def _chain(self, k: int, greedy_only: bool):
         """Build (and cache) the fused K-step device program: K ragged
@@ -332,23 +348,25 @@ class ContinuousEngine:
         n_active0 = int(active0.sum())
         run = self._chain(k, greedy_only=not st_f32[0].any())
         t0 = time.monotonic() if self._obs is not None else 0.0
-        cache, toks, acts = run(
-            self.params, self.cache, jnp.asarray(st_i32),
-            jnp.asarray(active0), jnp.asarray(forced), jnp.asarray(coins),
-            jnp.asarray(st_f32))
-        self.cache = cache
-        toks = np.asarray(toks)   # dlint: allow[D001] chain outputs drive
-        acts = np.asarray(acts)   # dlint: allow[D001] the host replay below
-        if self._obs is not None:
-            # toks/acts above already synced the chain's host outputs; the
-            # sync flag additionally drains the donated cache write so the
-            # histogram sees pure device time (obs/trace.sync_device_timing)
-            if self._obs.sync:
-                import jax
+        with self._span("chain", "decode", steps=k, active=n_active0):
+            cache, toks, acts = run(
+                self.params, self.cache, jnp.asarray(st_i32),
+                jnp.asarray(active0), jnp.asarray(forced),
+                jnp.asarray(coins), jnp.asarray(st_f32))
+            self.cache = cache
+            toks = np.asarray(toks)  # dlint: allow[D001] chain outputs drive
+            acts = np.asarray(acts)  # dlint: allow[D001] the host replay below
+            if self._obs is not None:
+                # toks/acts above already synced the chain's host outputs;
+                # the sync flag additionally drains the donated cache write
+                # so the histogram sees pure device time
+                # (obs/trace.sync_device_timing)
+                if self._obs.sync:
+                    import jax
 
-                jax.block_until_ready(self.cache)  # dlint: allow[D001] opt-in timing drain
-            self._obs.record_step(time.monotonic() - t0, n_active0,
-                                  steps=k)
+                    jax.block_until_ready(self.cache)  # dlint: allow[D001] opt-in timing drain
+                self._obs.record_step(time.monotonic() - t0, n_active0,
+                                      steps=k)
         self.stats.steps += k
         self.stats.max_active = max(self.stats.max_active, n_active0)
         # host replay: apply the recorded per-step outcomes with exactly
@@ -371,6 +389,14 @@ class ContinuousEngine:
                     break
         self._admit()
         return sum(not s.free for s in pool)
+
+    def _span(self, name: str, cat: str, **meta):
+        """A timeline span when tracing is on; a free nullcontext when the
+        engine runs dark (the zero-calls-when-disabled contract covers the
+        span tracer too)."""
+        if self._spans is None:
+            return contextlib.nullcontext()
+        return self._spans.span(name, cat, **meta)
 
     def submit(self, req: Request) -> Request:
         """Queue a request (thread-safe; HTTP handler threads call this while
@@ -402,20 +428,22 @@ class ContinuousEngine:
         for b, s in enumerate(pool):
             st[0, b] = s.token
             st[1, b] = s.pos
-        # one staged upload; the row splits are lazy device-side slices, so
-        # the shared step program keeps its (tokens, pos) signature
-        staged = jnp.asarray(st[:2])
-        logits, self.cache = self._step(self.params, self.cache, staged[0],
-                                        staged[1])
-        logits = np.asarray(logits)  # dlint: allow[D001] host sampler needs logits
-        if self._obs is not None:
-            # np.asarray synced the logits; the sync flag also drains the
-            # donated cache write (obs/trace.sync_device_timing)
-            if self._obs.sync:
-                import jax
+        with self._span("step", "decode", active=active0):
+            # one staged upload; the row splits are lazy device-side
+            # slices, so the shared step program keeps its (tokens, pos)
+            # signature
+            staged = jnp.asarray(st[:2])
+            logits, self.cache = self._step(self.params, self.cache,
+                                            staged[0], staged[1])
+            logits = np.asarray(logits)  # dlint: allow[D001] host sampler needs logits
+            if self._obs is not None:
+                # np.asarray synced the logits; the sync flag also drains
+                # the donated cache write (obs/trace.sync_device_timing)
+                if self._obs.sync:
+                    import jax
 
-                jax.block_until_ready(self.cache)  # dlint: allow[D001] opt-in timing drain
-            self._obs.record_step(time.monotonic() - t0, active0)
+                    jax.block_until_ready(self.cache)  # dlint: allow[D001] opt-in timing drain
+                self._obs.record_step(time.monotonic() - t0, active0)
         self.stats.steps += 1
         self.stats.max_active = max(self.stats.max_active, active0)
         for i, s in enumerate(pool):
@@ -510,17 +538,19 @@ class ContinuousEngine:
 
         t0 = time.monotonic() if self._obs is not None else 0.0
         jnp = self.jnp
-        cache_box = [self._scratch_cache()]
+        with self._span("prefill", "prefill", slot=slot_index,
+                        tokens=n_pre):
+            cache_box = [self._scratch_cache()]
 
-        def fwd(part, start):
-            _, cache_box[0] = self._prefill_fwd(
-                self.params, cache_box[0], jnp.asarray(part, jnp.int32),
-                jnp.int32(start))
+            def fwd(part, start):
+                _, cache_box[0] = self._prefill_fwd(
+                    self.params, cache_box[0], jnp.asarray(part, jnp.int32),
+                    jnp.int32(start))
 
-        run_chunked_prefill(fwd, tokens[:n_pre], 0, chunk,
-                            self.spec.seq_len)
-        self.cache = self._insert(self.cache, cache_box[0],
-                                  jnp.int32(slot_index))
+            run_chunked_prefill(fwd, tokens[:n_pre], 0, chunk,
+                                self.spec.seq_len)
+            self.cache = self._insert(self.cache, cache_box[0],
+                                      jnp.int32(slot_index))
         # echo the prefilled prompt tokens into the output AND the token
         # count (the step loop both appends forced tokens and counts them —
         # "Generated tokens" must not change meaning with the toggle)
@@ -552,6 +582,16 @@ class ContinuousEngine:
         s.req.t_finish = time.monotonic()
         if self._obs is not None:
             self._obs.record_retire(s.req, s.req.t_finish)
+        if self._spans is not None and s.req.t_admit:
+            # request lifecycle timestamps are time.monotonic; re-anchor the
+            # admit→finish window onto the tracer's perf_counter timeline
+            # (the two clocks share a rate, not necessarily an epoch)
+            dur = s.req.t_finish - s.req.t_admit
+            start = time.perf_counter() - (time.monotonic() - s.req.t_admit)
+            self._spans.add("request", "request", start, dur,
+                            index=s.req.index, tokens=len(s.req.out),
+                            sampled=s.req.n_sampled,
+                            cancelled=s.req.cancelled)
         s.req.done.set()
         s.req = None
         # park the freed slot at pos 0: a retired row's clock can equal

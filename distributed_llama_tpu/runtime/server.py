@@ -18,7 +18,11 @@ Endpoints:
                    "occupancy", and (metrics on) "ttft_s"/"token_latency_s"/
                    "queue_wait_s" p50/p95/p99 summaries}
   GET  /metrics -> Prometheus text exposition of the obs registry (request
-               lifecycle histograms, engine step/occupancy, counters)
+               lifecycle histograms, engine step/occupancy, counters, and
+               the per-scheme collective schedule series)
+  GET  /debug/timeline -> Chrome-trace/Perfetto JSON of the engine's recent
+               spans (request → prefill/decode windows, obs/spans.py);
+               ``?format=ndjson`` emits one span object per line instead
   POST /profile  {"seconds"?: float, "dir"?: str} -> starts a jax.profiler
                capture into dir for N seconds WHILE SERVING (409 if one is
                already running) — profile under real load
@@ -104,6 +108,8 @@ class InferenceServer:
                 self.wfile.write(body)
 
             def do_GET(self):
+                if self.path.split("?")[0] == "/debug/timeline":
+                    return self._timeline()
                 if self.path == "/metrics":
                     if server.registry is None:
                         return self._json(404, {"error": "metrics disabled "
@@ -144,6 +150,28 @@ class InferenceServer:
                         payload[key] = {k: round(v, 6) if k != "count"
                                         else v for k, v in s.items()}
                 self._json(200, payload)
+
+            def _timeline(self):
+                """GET /debug/timeline: the engine's recent span timeline
+                (request → prefill/decode windows, obs/spans.py).
+                Default: Chrome-trace JSON — save it and load it straight
+                into Perfetto / chrome://tracing; ?format=ndjson streams
+                one span object per line for log shippers."""
+                spans = server.engine._spans
+                if spans is None:
+                    return self._json(404, {"error": "timeline disabled "
+                                            "(--no-metrics)"})
+                if "format=ndjson" in self.path:
+                    body = spans.export_ndjson().encode()
+                    ctype = "application/x-ndjson"
+                else:
+                    body = json.dumps(spans.export_chrome()).encode()
+                    ctype = "application/json"
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
 
             def do_POST(self):
                 if self.path == "/profile":
@@ -187,6 +215,12 @@ class InferenceServer:
                     profiler.start_capture(trace_dir, seconds)
                 except RuntimeError as e:  # capture already in flight
                     return self._json(409, {"error": str(e)})
+                except OSError as e:
+                    # unwritable/uncreatable trace dir (bad
+                    # DLLAMA_PROFILE_DIR): a server-side env problem, and
+                    # the capture never started — the next request may
+                    # name a good dir
+                    return self._json(500, {"error": f"trace dir: {e}"})
                 except (ValueError, KeyError, TypeError) as e:
                     return self._json(400, {"error": str(e)})
                 self._json(200, {"dir": trace_dir, "seconds": seconds})

@@ -500,3 +500,91 @@ def test_line_number_is_not_part_of_identity_but_path_is(tmp_path):
     a = _mk("D001", "ops/a.py", "f", "np.asarray(x)")
     c = _mk("D001", "ops/b.py", "f", "np.asarray(x)")
     assert a.key() != c.key()
+
+
+# -- D008: span/named-scope hygiene around timed device regions -------------
+
+
+def test_d008_fires_on_unspanned_monotonic_and_perf_counter(tmp_path):
+    findings = run_on(tmp_path, "runtime/sched.py", """
+        import time
+        import jax.numpy as jnp
+
+        def step(params, cache, tok):
+            t0 = time.monotonic()
+            logits = jnp.dot(params, tok)
+            dt = time.monotonic() - t0          # un-synced, un-spanned
+            return logits, dt
+
+        def chain(params, tok):
+            t0 = time.perf_counter()
+            out = jnp.dot(params, tok)
+            return out, time.perf_counter() - t0  # direct-call delta
+    """)
+    d008 = [f for f in findings if f.rule == "D008"]
+    assert {f.context for f in d008} == {"step", "chain"}
+    assert len(d008) == 2
+
+
+def test_d008_quiet_with_span_sync_or_no_device_work(tmp_path):
+    quiet = """
+        import time
+        import numpy as np
+        import jax
+        import jax.numpy as jnp
+
+        def spanned(tracer, params, tok):
+            t0 = time.monotonic()
+            with tracer.span("step", "decode"):
+                out = jnp.dot(params, tok)
+            return out, time.monotonic() - t0
+
+        def guarded(self, params, tok):
+            t0 = time.perf_counter()
+            with self._span("chain", "decode"):   # engine guard helper
+                out = jnp.dot(params, tok)
+            return out, time.perf_counter() - t0
+
+        def synced(params, tok):
+            t0 = time.perf_counter()
+            out = jnp.dot(params, tok)
+            jax.block_until_ready(out)
+            return out, time.perf_counter() - t0
+
+        def drained(params, tok):
+            t0 = time.monotonic()
+            out = np.asarray(jnp.dot(params, tok))  # blocking transfer
+            return out, time.monotonic() - t0
+
+        def host_only(pool):
+            t0 = time.monotonic()
+            n = sum(1 for s in pool if s)
+            return n, time.monotonic() - t0
+    """
+    assert "D008" not in rules_fired(run_on(tmp_path, "runtime/q.py", quiet))
+    # same timed-device pattern OUTSIDE runtime//parallel/: out of scope
+    firing_elsewhere = """
+        import time
+        import jax.numpy as jnp
+
+        def step(params, tok):
+            t0 = time.monotonic()
+            out = jnp.dot(params, tok)
+            return out, time.monotonic() - t0
+    """
+    assert "D008" not in rules_fired(
+        run_on(tmp_path, "io/cold.py", firing_elsewhere))
+
+
+def test_d008_pragma_suppresses_with_reason(tmp_path):
+    findings = run_on(tmp_path, "parallel/p.py", """
+        import time
+        import jax.numpy as jnp
+
+        def probe(params, tok):
+            t0 = time.monotonic()
+            out = jnp.dot(params, tok)
+            dt = time.monotonic() - t0  # dlint: allow[D008] probe timing only
+            return out, dt
+    """)
+    assert "D008" not in rules_fired(findings)

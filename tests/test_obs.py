@@ -371,3 +371,263 @@ def test_explicit_flash_degrade_warns_once(monkeypatch, capsys):
     assert "blockwise" in err
     llama.attention(SPEC, q, k, v, jnp.int32(0), t_len)
     assert "DLLAMA_PREFILL_ATTN" not in capsys.readouterr().err  # once
+
+
+# --------------------------------------- labeled series + collective gauges
+
+
+def test_labeled_counter_exposition_and_family_grouping():
+    reg = Registry()
+    a = reg.labeled_counter("dllama_ici_collectives_total",
+                            {"kind": "psum", "scheme": "fused"}, "Launches")
+    b = reg.labeled_counter("dllama_ici_collectives_total",
+                            {"kind": "all_gather", "scheme": "fused"})
+    a.inc(3)
+    b.inc(1)
+    # same (name, labels) -> the same series; different labels -> distinct
+    assert reg.labeled_counter("dllama_ici_collectives_total",
+                               {"kind": "psum", "scheme": "fused"}) is a
+    assert a is not b
+    text = reg.expose()
+    assert text.count("# TYPE dllama_ici_collectives_total counter") == 1
+    assert ('dllama_ici_collectives_total{kind="psum",scheme="fused"} 3'
+            in text)
+    assert ('dllama_ici_collectives_total{kind="all_gather",scheme="fused"}'
+            ' 1' in text)
+    assert reg.get(
+        'dllama_ici_collectives_total{kind="psum",scheme="fused"}') is a
+
+
+def test_interleaved_registration_still_groups_families():
+    """bind_collectives registers (launches, bytes) PAIRWISE per kind;
+    the exposition must still emit each family as ONE contiguous group
+    under a single header (the Prometheus grouping rule — interleaved
+    families parse as duplicate untyped ones)."""
+    reg = Registry()
+    reg.labeled_counter("dllama_ici_collectives_total",
+                        {"kind": "psum"}, "launches").inc(2)
+    reg.labeled_counter("dllama_ici_bytes_total",
+                        {"kind": "psum"}, "bytes").inc(10)
+    reg.labeled_counter("dllama_ici_collectives_total",
+                        {"kind": "all_gather"}).inc(1)
+    reg.labeled_counter("dllama_ici_bytes_total",
+                        {"kind": "all_gather"}).inc(5)
+    lines = reg.expose().splitlines()
+    series_families = [ln.split("{")[0] for ln in lines
+                       if not ln.startswith("#")]
+    assert series_families == ["dllama_ici_collectives_total"] * 2 + \
+        ["dllama_ici_bytes_total"] * 2
+    assert lines[0].startswith("# HELP dllama_ici_collectives_total")
+
+
+def test_labeled_series_kind_mismatch_raises():
+    reg = Registry()
+    reg.labeled_counter("m", {"k": "v"})
+    with pytest.raises(ValueError):
+        reg.labeled_gauge("m", {"k": "v"})
+    # kind is a FAMILY property: a differently-labeled (or unlabeled)
+    # series cannot smuggle a second kind under the same name — it would
+    # expose under the wrong TYPE header
+    with pytest.raises(ValueError):
+        reg.labeled_gauge("m", {"k": "other"})
+    reg.counter("plain")
+    with pytest.raises(ValueError):
+        reg.labeled_gauge("plain", {"k": "v"})
+
+
+def test_label_order_does_not_split_series():
+    """The label SET is the series identity: two call sites passing the
+    same labels in different key order must land on one series (and one
+    exposition line — duplicates fail a Prometheus scrape)."""
+    reg = Registry()
+    a = reg.labeled_counter("m", {"kind": "psum", "scheme": "fused"})
+    b = reg.labeled_counter("m", {"scheme": "fused", "kind": "psum"})
+    assert a is b
+    a.inc(2)
+    assert reg.expose().count('m{kind="psum",scheme="fused"}') == 1
+
+
+def test_engine_metrics_collective_gauges_track_steps():
+    """bind_collectives turns the analytic schedule into labeled series:
+    N launches and rows*bytes per device step, per kind."""
+    from distributed_llama_tpu.models.synth import llama2_7b_spec
+    from distributed_llama_tpu.obs.trace import EngineMetrics
+    from distributed_llama_tpu.parallel.comm_stats import tp_collective_budget
+
+    reg = Registry()
+    em = EngineMetrics(reg)
+    budget = tp_collective_budget(llama2_7b_spec(), 8, "fused")
+    em.bind_collectives(budget, "fused", rows=4)
+    em.record_step(0.01, active=2, steps=3)
+    counts = budget.kind_counts()
+    by_kind = budget.bytes_by_kind()
+    for kind in counts:
+        launches = reg.get(f'dllama_ici_collectives_total'
+                           f'{{kind="{kind}",scheme="fused"}}')
+        moved = reg.get(f'dllama_ici_bytes_total'
+                        f'{{kind="{kind}",scheme="fused"}}')
+        assert launches.value == counts[kind] * 3
+        assert moved.value == by_kind[kind] * 4 * 3
+
+
+def test_sharded_engine_exports_collective_gauges(params):
+    """A tp>1 engine with metrics on exposes the budget series on its
+    registry — the /metrics surface the drift gate checks against."""
+    from distributed_llama_tpu.parallel import make_mesh
+    from distributed_llama_tpu.parallel.comm_stats import (
+        tp_collective_budget, tp_scheme)
+    from distributed_llama_tpu.runtime.continuous import ContinuousEngine
+
+    reg = Registry()
+    eng = ContinuousEngine(SPEC, params, slots=2, temperature=0.0,
+                           topp=0.9, seed=5, mesh=make_mesh(tp=2),
+                           metrics=reg)
+    eng.run([[1, 5, 9]], steps=6)
+    scheme = tp_scheme()
+    budget = tp_collective_budget(SPEC, 2, scheme)
+    assert budget.entries, "tp=2 must have a collective budget"
+    steps = reg.get("dllama_engine_steps_total").value
+    for kind, count, moved in budget.entries:
+        launches = reg.get(f'dllama_ici_collectives_total'
+                           f'{{kind="{kind}",scheme="{scheme}"}}')
+        assert launches is not None, f"missing series for {kind}"
+        assert launches.value == count * steps
+        moved_c = reg.get(f'dllama_ici_bytes_total'
+                          f'{{kind="{kind}",scheme="{scheme}"}}')
+        # bytes scale by the slot count: each batched collective moves
+        # B rows whether or not every slot is occupied
+        assert moved_c.value == moved * eng.slots * steps
+    assert 'dllama_ici_collectives_total{kind=' in reg.expose()
+
+
+def test_unsharded_engine_has_no_collective_series(params):
+    from distributed_llama_tpu.runtime.continuous import ContinuousEngine
+
+    reg = Registry()
+    ContinuousEngine(SPEC, params, slots=1, temperature=0.0, topp=0.9,
+                     seed=5, metrics=reg)
+    assert "dllama_ici_collectives_total" not in reg.expose()
+
+
+# --------------------------------------------------- NDJSON run stamp
+
+
+def test_log_json_records_carry_run_stamp(capsys, monkeypatch):
+    """Every NDJSON record carries tp_scheme + the Q40 body policy + the
+    bench env_fingerprint, so log streams join against BENCH_* rows."""
+    from distributed_llama_tpu.obs.log import log_event
+    from distributed_llama_tpu.utils import fingerprint
+
+    monkeypatch.setenv("DLLAMA_LOG_JSON", "1")
+    monkeypatch.setenv("DLLAMA_TP_SCHEME", "ref")
+    monkeypatch.setenv("DLLAMA_Q40_BODY", "i4-nb")
+    fingerprint.reset_stamp_cache()
+    try:
+        log_event("decode.token", None, pos=1)
+        rec = json.loads(capsys.readouterr().out)
+        assert rec["tp_scheme"] == "ref"
+        assert rec["q40_body"] == "i4-nb"
+        assert "clock" in rec["env_fingerprint"]
+        # jax is imported under the test env: the fingerprint pins the
+        # session basis the same way bench rows do
+        assert rec["env_fingerprint"]["backend"] == "cpu"
+        assert rec["pos"] == 1
+    finally:
+        fingerprint.reset_stamp_cache()  # drop the env-specific stamp
+
+
+def test_log_stamp_knobs_read_fresh_per_record(capsys, monkeypatch):
+    """A --model-from-root run logs BEFORE cli.py exports --tp-scheme:
+    the knob fields must track the env per record, never freeze at the
+    first event's values."""
+    from distributed_llama_tpu.obs.log import log_event
+
+    monkeypatch.setenv("DLLAMA_LOG_JSON", "1")
+    monkeypatch.delenv("DLLAMA_TP_SCHEME", raising=False)
+    log_event("weights.fetch_progress", None)  # early event, default env
+    first = json.loads(capsys.readouterr().out)
+    assert first["tp_scheme"] == "fused"
+    monkeypatch.setenv("DLLAMA_TP_SCHEME", "ref")  # cli.py applies the flag
+    log_event("decode.token", None)
+    assert json.loads(capsys.readouterr().out)["tp_scheme"] == "ref"
+
+
+def test_log_stamp_survives_bad_scheme_env(capsys, monkeypatch):
+    """A malformed DLLAMA_TP_SCHEME must degrade the stamp, not take the
+    log line (or its caller) down."""
+    from distributed_llama_tpu.obs.log import log_event
+    from distributed_llama_tpu.utils import fingerprint
+
+    monkeypatch.setenv("DLLAMA_LOG_JSON", "1")
+    monkeypatch.setenv("DLLAMA_TP_SCHEME", "bogus")
+    fingerprint.reset_stamp_cache()
+    try:
+        log_event("x", None, n=1)
+        rec = json.loads(capsys.readouterr().out)
+        assert rec["tp_scheme"] == "bogus"  # reported verbatim, not raised
+        assert rec["n"] == 1
+    finally:
+        fingerprint.reset_stamp_cache()
+
+
+def test_bench_fingerprint_is_the_shared_one():
+    """bench.py and the log stamp must report the SAME fingerprint dict —
+    joinability means one producer, not two drifting copies."""
+    import bench
+
+    from distributed_llama_tpu.utils.fingerprint import env_fingerprint
+
+    assert bench._env_fingerprint() == env_fingerprint()
+
+
+# ------------------------------------------- profiler error paths
+
+
+def test_profiler_unwritable_dir_fails_clean(tmp_path):
+    """An uncreatable trace dir raises BEFORE the capture starts: the
+    singleton stays free and a later capture into a good dir works."""
+    from distributed_llama_tpu.obs import profiler
+
+    blocker = tmp_path / "file"
+    blocker.write_text("not a dir")
+    bad = str(blocker / "sub")  # a path THROUGH a file: mkdir must fail
+    with pytest.raises(OSError):
+        profiler.start_capture(bad, 1.0)
+    assert profiler.capture_active() is None
+    good = str(tmp_path / "ok")
+    profiler.start_capture(good, 0.2)
+    assert profiler.capture_active() == good
+    assert profiler.wait_capture(30)
+
+
+def test_server_profile_409_and_500_paths(server, tmp_path):
+    """Deterministic overlap: start a capture directly, then POST — the
+    server must answer 409 while it runs and 500 for an unwritable
+    DLLAMA_PROFILE_DIR-style target, then recover."""
+    from distributed_llama_tpu.obs import profiler
+
+    d = str(tmp_path / "held")
+    profiler.start_capture(d, 0.5)
+    try:
+        _post(server.port, "/profile", {"seconds": 0.1,
+                                        "dir": str(tmp_path / "x")})
+        assert False, "expected 409"
+    except urllib.error.HTTPError as e:
+        assert e.code == 409
+    assert profiler.wait_capture(30)
+
+    blocker = tmp_path / "plainfile"
+    blocker.write_text("x")
+    try:
+        _post(server.port, "/profile", {"seconds": 0.1,
+                                        "dir": str(blocker / "sub")})
+        assert False, "expected 500"
+    except urllib.error.HTTPError as e:
+        assert e.code == 500
+        assert "trace dir" in json.loads(e.read())["error"]
+    # the failed request must not wedge the singleton
+    assert profiler.capture_active() is None
+    out = _post(server.port, "/profile",
+                {"seconds": 0.2, "dir": str(tmp_path / "after")})
+    assert out["seconds"] == 0.2
+    assert profiler.wait_capture(30)

@@ -27,6 +27,30 @@
 set -eu
 cd "$(dirname "$0")/.."
 python -m distributed_llama_tpu.analysis --all
+# drift observatory gate (ISSUE 5): tracecheck reconciles the checked-in
+# synthetic capture fixtures against the analytic collective model and
+# fails the build on any DRIFT verdict; the attribution Chrome traces are
+# archived under tools/ci_artifacts/ (gitignored) — load them in Perfetto
+mkdir -p tools/ci_artifacts
+for fixture in trace_7b_tp8_ref trace_7b_tp8_fused \
+               trace_13b_tp8_ref trace_13b_tp8_fused; do
+    python tools/tracecheck.py "tests/fixtures/traces/$fixture.json" \
+        --chrome-out "tools/ci_artifacts/$fixture.chrome.json"
+done
+# and the gate must still CATCH drift: the mutated fixture must exit with
+# status 1 EXACTLY (the DRIFT verdict) — status 2 is a usage error (e.g. a
+# renamed fixture) and would pass a naive non-zero check vacuously
+set +e
+python tools/tracecheck.py \
+    tests/fixtures/traces/trace_7b_tp8_ref_extra_collective.json \
+    > /dev/null 2>&1
+tracecheck_rc=$?
+set -e
+if [ "$tracecheck_rc" -ne 1 ]; then
+    echo "ci: tracecheck did not flag the mutated drift fixture" \
+         "(exit $tracecheck_rc, expected 1)" >&2
+    exit 1
+fi
 if command -v clang-tidy >/dev/null 2>&1; then
     make -C csrc tidy
 else
