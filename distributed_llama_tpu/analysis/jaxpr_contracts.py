@@ -278,6 +278,51 @@ def contract_decode_donation(spec=None, slots: int = 4) -> ContractResult:
         f"cache", hint)
 
 
+def contract_decode_donation_paged(spec=None, slots: int = 4,
+                                   page_size: int = 16) -> ContractResult:
+    """J002 under the PAGED cache layout: lower the paged decode step
+    exactly as the engine builds it (jit(forward_batch_paged,
+    donate_argnums=1), page-pool cache + int32 page table) and verify both
+    page-pool planes carry an input/output alias in the stablehlo. The
+    paged step's per-row dynamic_update_slice writes land at traced
+    (page, offset) starts — a lowering regression that stopped aliasing
+    the pool would cost a full pool copy per token, silently."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from ..models.llama import forward_batch_paged, init_cache_paged
+
+    name = "decode_kv_donation_paged"
+    hint = ("keep donate_argnums=1 on the paged decode step and keep the "
+            "page-pool planes' avals a fixed point (matching shape/dtype "
+            "in and out)")
+    spec = spec or _contract_spec()
+    max_pages = spec.seq_len // page_size
+    n_pages = slots * max_pages + 1  # + the scrap page, as the engine sizes
+    step = jax.jit(functools.partial(forward_batch_paged, spec, page_size),
+                   donate_argnums=1)
+    params = abstract_params(spec)
+    cache = jax.eval_shape(lambda: init_cache_paged(spec, n_pages,
+                                                    page_size, jnp.float32))
+    tokens = jax.ShapeDtypeStruct((slots,), jnp.int32)
+    pos = jax.ShapeDtypeStruct((slots,), jnp.int32)
+    table = jax.ShapeDtypeStruct((slots, max_pages), jnp.int32)
+    lowered = step.lower(params, cache, tokens, pos, table)
+    n_aliased = lowered.as_text().count("tf.aliasing_output")
+    n_cache_leaves = len(jax.tree_util.tree_leaves(cache))
+    if n_aliased < n_cache_leaves:
+        return ContractResult(
+            "J002", name, False,
+            f"only {n_aliased} of {n_cache_leaves} donated page-pool "
+            f"planes got an input/output alias in the lowering", hint)
+    return ContractResult(
+        "J002", name, True,
+        f"{n_aliased} aliased buffers cover the {n_cache_leaves}-plane "
+        f"page pool ({n_pages} pages x {page_size})", hint)
+
+
 # -- J003: decode-step shape stability -------------------------------------
 
 
@@ -335,12 +380,15 @@ contract_tp_collectives.contract_id = "J001"
 contract_tp_collectives_ref.contract_id = "J001"
 contract_tp_collectives_fused.contract_id = "J001"
 contract_decode_donation.contract_id = "J002"
+contract_decode_donation_paged.contract_id = "J002"
 contract_decode_shape_stability.contract_id = "J003"
 
 # J001 runs once per scheme: BOTH schedules stay pinned regardless of which
-# DLLAMA_TP_SCHEME the current process happens to run under
+# DLLAMA_TP_SCHEME the current process happens to run under; J002 runs once
+# per cache layout (contiguous + paged), for the same reason
 CONTRACTS = (contract_tp_collectives_ref, contract_tp_collectives_fused,
-             contract_decode_donation, contract_decode_shape_stability)
+             contract_decode_donation, contract_decode_donation_paged,
+             contract_decode_shape_stability)
 
 
 def run_contracts(spec=None) -> list[ContractResult]:

@@ -104,6 +104,41 @@ def kv_cache_device_bytes(spec: TransformerSpec, n_slices: int,
             * cache_itemsize)
 
 
+# The page size the documented tables/benches use (positions per page).
+# Small enough that a chat-sized request strands < page_size positions,
+# large enough that page-table gathers stay coarse; the engine knob
+# (--kv-page-size) accepts any divisor of seq_len.
+DEFAULT_PAGE_SIZE = 16
+
+
+def default_kv_pages(spec: TransformerSpec, batch: int,
+                     page_size: int = DEFAULT_PAGE_SIZE) -> int:
+    """The engine's default pool sizing: byte-parity with the contiguous
+    ``batch``-slot cache (runtime/continuous.ContinuousEngine)."""
+    return batch * (spec.seq_len // page_size)
+
+
+def kv_page_pool_bytes(spec: TransformerSpec, n_slices: int, n_pages: int,
+                       page_size: int = DEFAULT_PAGE_SIZE,
+                       cache_itemsize: int = 4,
+                       include_scrap: bool = True) -> int:
+    """Paged-pool K+V bytes: 2 x L x pages x page_size x n_kv/tp x hs.
+
+    The paged lever: ``n_pages`` is a FREE knob — contiguous slots charge
+    ``slots * seq_len`` positions whether requests use them or not, the
+    pool charges exactly what it holds. At the engine's default sizing
+    (default_kv_pages) the two layouts are byte-identical per position
+    (shardcheck pins that equivalence across the whole support matrix);
+    undersized pools trade eviction pressure for concurrency at equal
+    HBM (the continuous_bench columns). ``include_scrap`` charges the
+    reserved dead-write page 0 the engine actually allocates
+    (models/llama.init_cache_paged gets n_pages + 1)."""
+    pages = n_pages + (1 if include_scrap else 0)
+    return (2 * spec.n_layers * pages * page_size
+            * (spec.n_kv_heads // n_slices) * spec.head_size
+            * cache_itemsize)
+
+
 def activation_bytes_analytic(spec: TransformerSpec, n_slices: int,
                               t_len: int = 1) -> int:
     """No-trace activation bound for projection columns: the residual
@@ -302,19 +337,29 @@ class MemoryReport:
 def device_footprint(spec: TransformerSpec, n_slices: int, scheme: str,
                      model: str = "?", batch: int = 1,
                      activation_bytes: int | None = None,
-                     device: str = "v5e") -> MemoryReport:
+                     device: str = "v5e", kv_page_size: int = 0,
+                     kv_pages: int | None = None) -> MemoryReport:
     """Assemble the per-device report; ``activation_bytes`` overrides the
-    analytic bound with a traced live-interval peak when available."""
+    analytic bound with a traced live-interval peak when available.
+    ``kv_page_size > 0`` charges KV as the paged pool (default pool =
+    engine default: byte-parity with ``batch`` contiguous slots, plus the
+    scrap page) instead of ``batch`` contiguous max-seq stripes."""
     from ..parallel.comm_stats import collective_staging_bytes
 
     if activation_bytes is None:
         activation_bytes = activation_bytes_analytic(spec, n_slices)
+    if kv_page_size > 0:
+        pages = (kv_pages if kv_pages is not None
+                 else default_kv_pages(spec, batch, kv_page_size))
+        kv_bytes = kv_page_pool_bytes(spec, n_slices, pages, kv_page_size)
+    else:
+        kv_bytes = kv_cache_device_bytes(spec, n_slices, batch=batch)
     return MemoryReport(
         model=model, tp=n_slices, scheme=scheme,
         weights_float_type=FloatType(spec.weights_float_type).name,
         weights_bytes=weights_device_bytes(spec, n_slices),
         replicated_bytes=replicated_device_bytes(spec),
-        kv_cache_bytes=kv_cache_device_bytes(spec, n_slices, batch=batch),
+        kv_cache_bytes=kv_bytes,
         activation_bytes=int(activation_bytes),
         collective_bytes=collective_staging_bytes(spec, n_slices, scheme),
         budget_bytes=usable_hbm_bytes(device))

@@ -90,7 +90,7 @@ SUPPORT_MATRIX = tuple(
 
 @dataclasses.dataclass(frozen=True)
 class ShardFinding:
-    rule: str     # J004 | J005 | J006 | HBM-BUDGET | TRACE
+    rule: str     # J004 | J005 | J006 | HBM-BUDGET | KV-PAGED | TRACE
     config: str
     detail: str
 
@@ -382,6 +382,37 @@ def check_uniform_shards(spec, tp: int, scheme: str,
     return findings
 
 
+def check_paged_equivalence(spec, tp: int, config: str,
+                            contiguous_bytes: int) -> list[ShardFinding]:
+    """KV-PAGED: the paged pool at the engine's default sizing (one slot's
+    worth of pages, scrap excluded) must charge EXACTLY the bytes of the
+    contiguous max-seq stripe — the invariant that lets the support
+    matrix's HBM verdicts carry over to paged engines unchanged, and that
+    the --kv-pages oversubscription math rests on. Checked across the
+    whole matrix so a drifting page-size default or a pool formula edit
+    fails loudly (tests/test_shardcheck_repo.py mutation-tests it)."""
+    from .memory_model import (DEFAULT_PAGE_SIZE, default_kv_pages,
+                               kv_page_pool_bytes)
+
+    findings = []
+    ps = DEFAULT_PAGE_SIZE
+    if spec.seq_len % ps:
+        findings.append(ShardFinding(
+            "KV-PAGED", config,
+            f"seq_len={spec.seq_len} is not a multiple of the default "
+            f"page size {ps} — paged engines cannot run this config"))
+        return findings
+    paged = kv_page_pool_bytes(spec, tp, default_kv_pages(spec, 1, ps), ps,
+                               include_scrap=False)
+    if paged != contiguous_bytes:
+        findings.append(ShardFinding(
+            "KV-PAGED", config,
+            f"paged pool at default sizing charges {paged} B but the "
+            f"contiguous stripe charges {contiguous_bytes} B — the "
+            f"memory_model formulas drifted apart"))
+    return findings
+
+
 # -- per-config driver ------------------------------------------------------
 
 
@@ -416,6 +447,8 @@ def check_config(entry: MatrixEntry, device: str = "v5e",
     report = device_footprint(spec, entry.tp, entry.scheme,
                               model=entry.model,
                               activation_bytes=act_bytes, device=device)
+    findings += check_paged_equivalence(spec, entry.tp, config,
+                                        report.kv_cache_bytes)
     if report.fits != entry.expect_fits:
         if entry.expect_fits:
             findings.append(ShardFinding(

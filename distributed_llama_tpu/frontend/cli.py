@@ -228,6 +228,16 @@ def cmd_inference(argv: list[str], quiet: bool = False) -> int:
                     help="with --continuous: fuse K decode steps into one "
                          "device dispatch (admission/retirement at chain "
                          "boundaries; cuts host round-trips Kx)")
+    ap.add_argument("--kv-page-size", type=int, default=0, metavar="P",
+                    help="with --continuous: paged KV cache — slots map "
+                         "P-position pages from a shared pool through page "
+                         "tables, with radix-tree prefix sharing of common "
+                         "prompt prefixes (0 = contiguous per-slot cache)")
+    ap.add_argument("--kv-pages", type=int, default=0, metavar="N",
+                    help="paged-KV pool size in pages (default: "
+                         "slots * seq_len / page-size, byte-parity with "
+                         "the contiguous cache; fewer pages serve more "
+                         "slots at equal HBM)")
     ap.add_argument("--kv-cache-dtype", default="f32",
                     choices=("f32", "bf16"),
                     help="KV cache precision: f32 = reference parity "
@@ -393,6 +403,8 @@ def cmd_inference(argv: list[str], quiet: bool = False) -> int:
                                 # (see sampling.Sampler docstring)
                                 use_native_sampler=not args.coordinator,
                                 fast_prefill=args.fast_prefill,
+                                page_size=args.kv_page_size,
+                                kv_pages=args.kv_pages,
                                 metrics=reg)
             if reg is not None:
                 print(reg.expose(), file=sys.stderr, end="")
@@ -556,6 +568,16 @@ def cmd_serve(argv: list[str]) -> int:
                          "(admission + per-token streaming at chain "
                          "boundaries; cuts host round-trips Kx — set 8-16 "
                          "on remote/high-latency runtimes)")
+    ap.add_argument("--kv-page-size", type=int, default=0, metavar="P",
+                    help="paged KV cache: slots map P-position pages from "
+                         "a shared pool through page tables, with radix "
+                         "prefix sharing of common prompt prefixes — the "
+                         "shared-system-prompt serving win (0 = contiguous "
+                         "per-slot cache)")
+    ap.add_argument("--kv-pages", type=int, default=0, metavar="N",
+                    help="paged-KV pool size in pages (default: "
+                         "slots * seq_len / page-size; fewer pages serve "
+                         "more slots at equal HBM)")
     ap.add_argument("--fast-prefill", action="store_true",
                     help="bf16 matmul precision for admission prefill "
                          "(documented tolerance; decode untouched)")
@@ -612,7 +634,9 @@ def cmd_serve(argv: list[str]) -> int:
                              mesh=mesh, prefill_chunk=args.prefill_chunk,
                              block_steps=args.block_steps,
                              fast_prefill=args.fast_prefill,
-                             metrics=args.metrics)
+                             metrics=args.metrics,
+                             page_size=args.kv_page_size,
+                             kv_pages=args.kv_pages)
     endpoints = "POST /generate, GET /health" + (
         ", GET /metrics, GET /debug/timeline, POST /profile"
         if args.metrics else "")

@@ -521,6 +521,160 @@ def batch_decode_attention(head_size: int, kv_mul: int, seq_len: int,
     return ao.reshape(B, -1), k_all, v_all
 
 
+def init_cache_paged(spec: TransformerSpec, n_pages: int, page_size: int,
+                     dtype=jnp.float32) -> KVCache:
+    """Paged pool cache: (L, P, page_size, n_kv, hs) — physical page p of
+    layer l is the (page_size, n_kv, hs) plane at [l, p]. ``n_pages`` is
+    the TOTAL physical page count including the reserved scrap page 0
+    (runtime/paging.SCRAP_PAGE); slots map logical sequence pages onto
+    physical pages through an int32 page-table row, so the pool can be
+    sized far below slots * seq_len (the HBM lever of vLLM's
+    PagedAttention)."""
+    if spec.seq_len % page_size:
+        raise ValueError(f"page_size={page_size} must divide "
+                         f"seq_len={spec.seq_len}")
+    shape = (spec.n_layers, n_pages, page_size, spec.n_kv_heads,
+             spec.head_size)
+    return KVCache(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+
+
+def paged_decode_attention(head_size: int, kv_mul: int, page_size: int,
+                           n_pages: int, q: jax.Array, k: jax.Array,
+                           v: jax.Array, k_all: jax.Array, v_all: jax.Array,
+                           idx, pos: jax.Array, table: jax.Array):
+    """batch_decode_attention over the PAGED pool: write each row's k/v at
+    (physical page ``table[b, pos_b // page_size]``, offset
+    ``pos_b % page_size``) of the rank-4 (L*P, page_size, n_kv, hs) carry,
+    then attend over the row's gathered page sequence.
+
+    q (B, n_q*hs); k/v (B, n_kv*hs); ``table`` (B, max_pages) int32
+    physical page ids in logical order (entries beyond a row's live pages
+    point at the scrap page — their junk is masked below). The gathered
+    view lays pages out in logical order, so position p of the virtual
+    (B, S, n_kv, hs) plane holds exactly the value the contiguous cache
+    holds at column p — the ragged mask and attention_core are shared with
+    the contiguous path, making paged logits BITWISE equal to contiguous
+    logits (the parity gate of tests/test_paging.py). No flash-decode
+    kernel here: the Pallas walk assumes a contiguous row; the paged XLA
+    gather is the fallback on every backend until a paged kernel lands.
+    """
+    B = q.shape[0]
+    n_kv = k_all.shape[-2]
+    n_q = q.shape[-1] // head_size
+    dt = k_all.dtype
+    k_new = k.reshape(B, 1, n_kv, head_size).astype(dt)
+    v_new = v.reshape(B, 1, n_kv, head_size).astype(dt)
+    pos_b = jnp.broadcast_to(jnp.asarray(pos, jnp.int32), (B,))
+    page_b = jnp.take_along_axis(table, (pos_b // page_size)[:, None],
+                                 axis=1)[:, 0]
+    off_b = pos_b % page_size
+    # per-row writes, each in place on the carry (the same B-updates-not-
+    # scatter rationale as the ragged contiguous path, forward_batch)
+    for b in range(B):
+        row = idx * n_pages + page_b[b]
+        k_all = jax.lax.dynamic_update_slice(k_all, k_new[b:b + 1],
+                                             (row, off_b[b], 0, 0))
+        v_all = jax.lax.dynamic_update_slice(v_all, v_new[b:b + 1],
+                                             (row, off_b[b], 0, 0))
+    s_virt = table.shape[1] * page_size
+    rows = (idx * n_pages + table).reshape(-1)            # (B * max_pages,)
+    k_c = jnp.take(k_all, rows, axis=0).reshape(B, s_virt, n_kv, head_size)
+    v_c = jnp.take(v_all, rows, axis=0).reshape(B, s_virt, n_kv, head_size)
+    # (B, 1, S): row b sees virtual positions 0..pos[b] — same mask as the
+    # ragged contiguous path, so softmax sees identical live values and
+    # exact zeros for everything else
+    mask = jnp.arange(s_virt)[None, None, :] <= pos_b[:, None, None]
+    ao = attention_core(head_size, kv_mul, q.reshape(B, 1, n_q, head_size),
+                        k_c, v_c, mask)
+    return ao.reshape(B, -1), k_all, v_all
+
+
+def forward_batch_paged(spec: TransformerSpec, page_size: int,
+                        params: dict[str, Any], cache: KVCache,
+                        tokens: jax.Array, pos_vec: jax.Array,
+                        table: jax.Array) -> tuple[jax.Array, KVCache]:
+    """Decode one token per row against the PAGED page-pool cache.
+
+    forward_batch_ragged's twin for the paged layout: cache planes are
+    (L, P, page_size, n_kv, hs) pool pages (init_cache_paged), ``table``
+    (B, seq_len // page_size) int32 maps each row's logical pages to
+    physical ones (runtime/continuous.py stages it host-side, one upload
+    per step). Per-row math is identical to the contiguous path — shared
+    _qkv_proj/_post_attention, and paged_decode_attention reproduces
+    batch_decode_attention's virtual (B, S) plane exactly — so logits are
+    bitwise equal to forward_batch_ragged given the same history (the
+    pinned parity gate). jit with (spec, page_size) static and the cache
+    donated: the rank-4 page-plane view rides the scan carry in place, so
+    J002's zero-copy-per-token contract holds under paging too.
+    """
+    B = tokens.shape[0]
+    x = params["tok_embedding"][tokens].astype(jnp.float32)  # (B, dim)
+    positions = pos_vec if jnp.ndim(pos_vec) == 1 else jnp.full((B,),
+                                                                pos_vec)
+    n_kv, hs, kv_mul = spec.n_kv_heads, spec.head_size, spec.kv_mul
+    L, P = spec.n_layers, cache.k.shape[1]
+
+    # rank-4 (L*P, page_size, n_kv, hs) carry view — same layout rationale
+    # as forward_batch's (L*B, S, ...) merge: the rank-5 carry provokes a
+    # lane-padded normalization copy out of XLA's layout assignment
+    k4 = cache.k.reshape(L * P, page_size, n_kv, hs)
+    v4 = cache.v.reshape(L * P, page_size, n_kv, hs)
+
+    stacked, scanned = split_layer_weights(params)
+
+    def scan_body(carry, per_layer):
+        x, k_all, v_all = carry
+        idx, lw_slice = per_layer
+        lw = layer_view(stacked, lw_slice, idx)
+        q, k, v = _qkv_proj(spec, lw, x, positions)
+        ao, k_all, v_all = paged_decode_attention(
+            hs, kv_mul, page_size, P, q, k, v, k_all, v_all, idx, pos_vec,
+            table)
+        x = _post_attention(spec, lw, x, ao)
+        return (x, k_all, v_all), None
+
+    idxs = jnp.arange(L, dtype=jnp.int32)
+    (x, k4, v4), _ = jax.lax.scan(scan_body, (x, k4, v4), (idxs, scanned))
+    x = rmsnorm(x, params["rms_final"])
+    logits = matmul(params["wcls"], x)
+    return logits, KVCache(k4.reshape(L, P, page_size, n_kv, hs),
+                           v4.reshape(L, P, page_size, n_kv, hs))
+
+
+def gather_pages(cache: KVCache, table: jax.Array,
+                 page_size: int) -> KVCache:
+    """Materialize one slot's virtual (L, S, n_kv, hs) sequence cache from
+    its pool pages — the admission-prefill seed: chunked prefill of an
+    UNSHARED suffix must attend over the shared prefix k/v, and the
+    single-sequence prefill program expects a contiguous plane. ``table``
+    is the slot's full (max_pages,) logical->physical row; entries beyond
+    the live prefix gather scrap-page junk that prefill overwrites (its
+    chunk at position p writes p before any later chunk reads it)."""
+    def g(plane):
+        L = plane.shape[0]
+        got = jnp.take(plane, table, axis=1)  # (L, max_pages, ps, kv, hs)
+        return got.reshape(L, table.shape[0] * page_size, *plane.shape[3:])
+
+    return KVCache(g(cache.k), g(cache.v))
+
+
+def scatter_pages(cache: KVCache, seq_cache: KVCache, table: jax.Array,
+                  page_size: int) -> KVCache:
+    """Write a prefilled virtual sequence cache back into the pool at the
+    slot's physical pages — gather_pages' inverse (admission-prefill
+    insert). Shared prefix pages receive byte-identical content (the seed
+    copied them out and prefill never touches positions below its start),
+    and table entries parked on the scrap page absorb the junk tail.
+    jit with the POOL cache donated: the scatter updates in place."""
+    def s(plane, seq_plane):
+        L = plane.shape[0]
+        upd = seq_plane.reshape(L, table.shape[0], page_size,
+                                *plane.shape[3:])
+        return plane.at[:, table].set(upd)
+
+    return KVCache(s(cache.k, seq_cache.k), s(cache.v, seq_cache.v))
+
+
 def init_cache_batch(spec: TransformerSpec, batch: int,
                      dtype=jnp.float32) -> KVCache:
     """Batched cache: (L, B, S, n_kv, hs) — each (b, layer) row has the same

@@ -91,6 +91,21 @@ class EngineMetrics:
         self.cancelled = c(
             "dllama_requests_cancelled_total",
             "Requests retired because the consumer vanished")
+        # paged-KV instruments (page_size > 0 engines move them; contiguous
+        # engines expose them at zero — the scrape surface is layout-
+        # invariant, so dashboards survive the knob)
+        self.kv_pages_free = g(
+            "dllama_kv_pages_free",
+            "Free pages in the paged KV pool (0 until a paged engine "
+            "allocates)")
+        self.prefix_hits = c(
+            "dllama_prefix_hits_total",
+            "Admissions that mapped >= 1 shared prefix page from the "
+            "radix tree (copy-free prefill reuse)")
+        self.prefill_saved = c(
+            "dllama_prefill_tokens_saved_total",
+            "Prefill positions skipped because their pages were shared "
+            "from the radix tree")
         # per-scheme collective series, bound by bind_collectives() when
         # the engine runs sharded: [(launch counter, byte counter,
         # launches/step, bytes/step)] — empty (and never touched) at tp=1

@@ -1,4 +1,5 @@
 from .mesh import make_mesh  # noqa: F401
 from .tp import (make_sharded_forward, make_sharded_forward_batch,  # noqa: F401
-                 shard_params, shard_cache, shard_cache_batch,
+                 make_sharded_forward_batch_paged, shard_params,
+                 shard_cache, shard_cache_batch, shard_cache_paged,
                  validate_sharding)

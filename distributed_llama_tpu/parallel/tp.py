@@ -76,7 +76,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..io.loader import Q40Kernel, Q40Weight
 from ..models.llama import (KVCache, attention_core, batch_decode_attention,
-                            causal_cache_mask, layer_view, rope_rotate,
+                            causal_cache_mask, layer_view,
+                            paged_decode_attention, rope_rotate,
                             split_layer_weights)
 from ..models.spec import TransformerSpec
 # canonical trace-scope names (obs/spans.py): every phase and collective
@@ -626,6 +627,96 @@ def _batch_sp_attention(spec: TransformerSpec, seq_chunk: int, q, k, v,
 
     ao = jax.vmap(att)(q.reshape(B, 1, -1, hs), k_c, v_c, pos_b)  # (B, 1, d)
     return ao.reshape(B, -1), k_all, v_all
+
+
+# paged pool cache (L, P, page_size, n_kv, hs): kv heads over tp, the page
+# axis replicated (every chip holds all pages for its LOCAL kv heads — the
+# page table is pure host bookkeeping, identical on every chip). Paged KV
+# does not compose with sp: sequence chunking assumes contiguous position
+# strides, which a page table deliberately breaks.
+CACHE_SPEC_PAGED = KVCache(P(None, None, None, "tp", None),
+                           P(None, None, None, "tp", None))
+
+
+def shard_cache_paged(cache: KVCache, mesh: Mesh) -> KVCache:
+    return jax.tree_util.tree_map(
+        lambda a, s: jax.device_put(a, NamedSharding(mesh, s)),
+        cache, CACHE_SPEC_PAGED)
+
+
+def make_sharded_forward_batch_paged(spec: TransformerSpec, mesh: Mesh,
+                                     page_size: int,
+                                     scheme: str | None = None):
+    """Tensor-parallel paged decode step: make_sharded_forward_batch's twin
+    over the page-pool cache (models/llama.forward_batch_paged semantics,
+    per-shard over the LOCAL kv heads).
+
+    Returns fn(params, cache, tokens (B,), pos (B,), table (B, S/ps))
+    -> (logits (B, vocab), cache) with cache (L, P, ps, n_kv, hs)
+    kv-head-sharded over tp (CACHE_SPEC_PAGED) and the page table
+    replicated (host bookkeeping is chip-invariant). Works under BOTH
+    collective schemes — attention runs before the layer tail, so the
+    ref/fused schedule difference never sees the page table. sp > 1 is
+    rejected: pages break the contiguous position strides sequence
+    chunking slices by.
+    """
+    n_slices = mesh.shape["tp"]
+    n_sp = mesh.shape.get("sp", 1)
+    if n_sp > 1:
+        raise ValueError(f"paged KV cache requires sp=1, got sp={n_sp} "
+                         f"(page tables break contiguous sequence chunks)")
+    scheme = scheme or tp_scheme()
+    validate_sharding(spec, mesh)
+    if spec.seq_len % page_size:
+        raise ValueError(f"page_size={page_size} must divide "
+                         f"seq_len={spec.seq_len}")
+    kv_loc = spec.n_kv_heads // n_slices
+    L, hs = spec.n_layers, spec.head_size
+
+    def local_step(params, cache, tokens, pos, table):
+        B = tokens.shape[0]
+        with jax.named_scope(SCOPE_EMBED):
+            x = params["tok_embedding"][tokens].astype(jnp.float32)  # (B, d)
+        positions = pos if jnp.ndim(pos) == 1 else jnp.full((B,), pos)
+        n_pages = cache.k.shape[1]
+        # rank-4 (L*P, ps, kv_loc, hs) carry view — forward_batch_paged's
+        # layout rationale, per shard
+        k4 = cache.k.reshape(L * n_pages, page_size, kv_loc, hs)
+        v4 = cache.v.reshape(L * n_pages, page_size, kv_loc, hs)
+        stacked, scanned = split_layer_weights(params)
+
+        def body(carry, per_layer):
+            x, k_all, v_all = carry
+            idx, lw_slice = per_layer
+            with jax.named_scope(SCOPE_LAYER):
+                lw = layer_view(stacked, lw_slice, idx)
+                with jax.named_scope(SCOPE_ATTN):
+                    q, k, v = _tp_qkv(spec, n_slices, lw, x, positions)
+                    ao, k_all, v_all = paged_decode_attention(
+                        hs, spec.kv_mul, page_size, n_pages, q, k, v,
+                        k_all, v_all, idx, pos, table)
+                x = _tp_tail(spec, x, lw, ao, scheme=scheme)
+            return (x, k_all, v_all), None
+
+        idxs = jnp.arange(L, dtype=jnp.int32)
+        (x, k4, v4), _ = jax.lax.scan(body, (x, k4, v4), (idxs, scanned))
+        with jax.named_scope(SCOPE_LOGITS):
+            x = rmsnorm(x, params["rms_final"])
+            logits = _gather(matmul(params["wcls"], x))
+        n_pages_out = k4.shape[0] // L
+        return logits, KVCache(
+            k4.reshape(L, n_pages_out, page_size, kv_loc, hs),
+            v4.reshape(L, n_pages_out, page_size, kv_loc, hs))
+
+    def wrap(params, cache, tokens, pos, table):
+        in_specs = (param_specs(params, scheme), CACHE_SPEC_PAGED, P(), P(),
+                    P())
+        out_specs = (P(), CACHE_SPEC_PAGED)
+        fn = _shard_map(local_step, mesh=mesh, in_specs=in_specs,
+                        out_specs=out_specs)
+        return fn(params, cache, tokens, pos, table)
+
+    return jax.jit(wrap, donate_argnums=1)
 
 
 def make_sharded_forward_batch(spec: TransformerSpec, mesh: Mesh,

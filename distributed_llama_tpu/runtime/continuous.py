@@ -92,6 +92,12 @@ class _Slot:
     forced: list = dataclasses.field(default_factory=list)
     budget: int = 0              # max positions for this request
     sampler: Sampler | None = None
+    # paged KV mode only: physical page ids in logical order (position p
+    # lives in pages[p // page_size]); the first ``shared`` entries came
+    # from the radix tree (prefix sharing) — refcounted, never written by
+    # this slot (decode writes start at the page-aligned share boundary)
+    pages: list = dataclasses.field(default_factory=list)
+    shared: int = 0
 
     @property
     def free(self) -> bool:
@@ -104,10 +110,18 @@ class ContinuousStats:
     steps: int = 0           # device steps executed
     total_ms: float = 0.0
     max_active: int = 0
+    sum_active: int = 0      # sum of active slots over device steps
 
     @property
     def tokens_per_s(self) -> float:
         return self.tokens / max(self.total_ms / 1000, 1e-9)
+
+    @property
+    def avg_active(self) -> float:
+        """Sustained concurrency: mean active slots per device step (rows
+        entering a fused chain count for its whole span) — the
+        continuous_bench column paged KV exists to move."""
+        return self.sum_active / max(self.steps, 1)
 
 
 class ContinuousEngine:
@@ -121,14 +135,18 @@ class ContinuousEngine:
                  slots: int, temperature: float, topp: float, seed: int,
                  cache_dtype=None, mesh=None, prefill_chunk: int = 0,
                  block_steps: int = 1, use_native_sampler: bool = True,
-                 fast_prefill: bool = False, metrics=None):
+                 fast_prefill: bool = False, metrics=None,
+                 page_size: int = 0, kv_pages: int = 0,
+                 prefix_share: bool = True):
         import functools
 
         import jax
         import jax.numpy as jnp
 
-        from ..models.llama import (forward_batch_ragged, init_cache_batch,
-                                    params_to_device)
+        from ..models.llama import (forward_batch_paged,
+                                    forward_batch_ragged, gather_pages,
+                                    init_cache_batch, init_cache_paged,
+                                    params_to_device, scatter_pages)
 
         self.spec = spec
         self.slots = slots
@@ -137,6 +155,34 @@ class ContinuousEngine:
         self.seed = seed
         self.jnp = jnp
         self.prefill_chunk = prefill_chunk
+        # paged KV mode (page_size > 0): the cache becomes a fixed pool of
+        # (page_size)-position pages shared by all slots through per-slot
+        # page tables, with radix-tree prefix sharing on admission
+        # (runtime/paging.py). page_size == 0 keeps the contiguous
+        # slots x seq_len layout. ``kv_pages`` sizes the pool (default:
+        # slots * seq_len/page_size — byte-parity with contiguous; pass
+        # fewer pages to oversubscribe slots at equal HBM, the
+        # continuous_bench concurrency lever).
+        self.page_size = page_size
+        self._alloc = None
+        if kv_pages and page_size <= 0:
+            raise ValueError("kv_pages requires page_size > 0 (pass "
+                             "--kv-page-size with --kv-pages)")
+        if page_size > 0:
+            from .paging import PagedAllocator
+
+            if spec.seq_len % page_size:
+                raise ValueError(f"page_size={page_size} must divide "
+                                 f"seq_len={spec.seq_len}")
+            self._max_pages = spec.seq_len // page_size
+            n_pages = kv_pages or slots * self._max_pages
+            self._alloc = PagedAllocator(n_pages, page_size,
+                                         prefix_share=prefix_share)
+            # persistent page-table staging row block (dlint D004): one
+            # int32 (slots, max_pages) buffer, rewritten host-side per
+            # step and shipped as ONE upload; free/short rows park their
+            # tail on the scrap page
+            self._stage_tbl = np.zeros((slots, self._max_pages), np.int32)
         # multi-host SPMD runs MUST pin the numpy sampler: native and numpy
         # can differ by float ulps across libm builds (sampling.Sampler
         # docstring), and divergent hosts feed different tokens into the
@@ -164,8 +210,10 @@ class ContinuousEngine:
             # sharded step: same program as the lockstep batch path, driven
             # with a (B,) position vector
             from ..parallel import (make_sharded_forward,
-                                    make_sharded_forward_batch, shard_cache,
-                                    shard_cache_batch, shard_params,
+                                    make_sharded_forward_batch,
+                                    make_sharded_forward_batch_paged,
+                                    shard_cache, shard_cache_batch,
+                                    shard_cache_paged, shard_params,
                                     validate_sharding)
             from ..parallel.comm_stats import tp_scheme
 
@@ -173,10 +221,18 @@ class ContinuousEngine:
             #                       params all run the same schedule
             validate_sharding(spec, mesh)
             self.params = shard_params(params, mesh, scheme=scheme)
-            self.cache = shard_cache_batch(
-                init_cache_batch(spec, slots, dtype), mesh)
-            self._step = make_sharded_forward_batch(spec, mesh,
-                                                    scheme=scheme)
+            if self._alloc is not None:
+                # +1 physical page: the reserved scrap page 0
+                self._step = make_sharded_forward_batch_paged(
+                    spec, mesh, page_size, scheme=scheme)  # rejects sp>1
+                self.cache = shard_cache_paged(
+                    init_cache_paged(spec, self._alloc.n_pages + 1,
+                                     page_size, dtype), mesh)
+            else:
+                self.cache = shard_cache_batch(
+                    init_cache_batch(spec, slots, dtype), mesh)
+                self._step = make_sharded_forward_batch(spec, mesh,
+                                                        scheme=scheme)
             if prefill_chunk > 1:
                 # admission prefill: the sharded single-sequence forward
                 # (T=chunk under sp/tp) fills a sharded scratch cache
@@ -187,10 +243,17 @@ class ContinuousEngine:
                     init_cache(spec, dtype), mesh)
         else:
             self.params = params_to_device(params)
-            self.cache = init_cache_batch(spec, slots, dtype)
-            self._step = jax.jit(
-                functools.partial(forward_batch_ragged, spec),
-                donate_argnums=1)
+            if self._alloc is not None:
+                self.cache = init_cache_paged(
+                    spec, self._alloc.n_pages + 1, page_size, dtype)
+                self._step = jax.jit(
+                    functools.partial(forward_batch_paged, spec, page_size),
+                    donate_argnums=1)
+            else:
+                self.cache = init_cache_batch(spec, slots, dtype)
+                self._step = jax.jit(
+                    functools.partial(forward_batch_ragged, spec),
+                    donate_argnums=1)
             if prefill_chunk > 1:
                 # admission prefill: single-sequence T=chunk forward into a
                 # scratch cache + plane insert
@@ -202,6 +265,16 @@ class ContinuousEngine:
             # donate only the batched cache (updated in place); the scratch
             # sequence cache can't alias the rank-5 output
             self._insert = jax.jit(_insert, donate_argnums=0)
+            if self._alloc is not None:
+                # paged prefill plumbing: gather the slot's pages into a
+                # virtual contiguous sequence cache (shared prefix k/v
+                # included — suffix chunks must attend over it), prefill
+                # into that, scatter back into the pool in place
+                self._gather_pages = jax.jit(
+                    lambda c, t: gather_pages(c, t, page_size))
+                self._scatter_pages = jax.jit(
+                    lambda c, s, t: scatter_pages(c, s, t, page_size),
+                    donate_argnums=0)
         self._pool = [_Slot() for _ in range(slots)]
         # persistent host-side staging buffers (dlint D004): the per-step
         # pool scan writes rows here and each step ships ONE upload per
@@ -226,6 +299,10 @@ class ContinuousEngine:
             from ..obs.trace import EngineMetrics
 
             self._obs = EngineMetrics(metrics)
+            if self._alloc is not None:
+                # a fresh paged server must scrape as fully free, not as
+                # exhausted (the gauge default 0)
+                self._obs.kv_pages_free.set(self._alloc.n_free)
             # the span timeline (GET /debug/timeline) rides the same
             # opt-in: a disabled engine records nothing
             self._spans = SpanTracer()
@@ -242,6 +319,12 @@ class ContinuousEngine:
         else:
             self._obs = None
             self._spans = None
+
+    @property
+    def allocator(self):
+        """The paging.PagedAllocator when page_size > 0, else None — the
+        bench and server read pool occupancy / prefix-hit counters here."""
+        return self._alloc
 
     def _chain(self, k: int, greedy_only: bool):
         """Build (and cache) the fused K-step device program: K ragged
@@ -263,12 +346,17 @@ class ContinuousEngine:
         from .decode import sample_device_dynamic
 
         step = self._step
+        paged = self._alloc is not None
 
         def chain(params, cache, staged_i32, active, forced, coins,
-                  staged_f32):
+                  staged_f32, table):
             # staged_i32 (3, B) = token/pos/budget rows, staged_f32 (2, B)
             # = temp/topp rows — each ONE host->device upload per chain
-            # (dlint D004); the splits below are device-side slices
+            # (dlint D004); the splits below are device-side slices.
+            # ``table`` (B, max_pages) is the paged page-table block (a
+            # zero-width dummy in contiguous mode): constant across the K
+            # steps — step_many pre-allocates page coverage for the whole
+            # chain, so no page boundary can strand a mid-chain write
             tokens, pos, budget = (staged_i32[0], staged_i32[1],
                                    staged_i32[2])
             temps, topps = staged_f32[0], staged_f32[1]
@@ -276,7 +364,10 @@ class ContinuousEngine:
             def body(carry, xs):
                 tokens, pos, active, cache = carry
                 forced_i, coins_i = xs                      # (B,), (B,)
-                logits, cache = step(params, cache, tokens, pos)
+                if paged:
+                    logits, cache = step(params, cache, tokens, pos, table)
+                else:
+                    logits, cache = step(params, cache, tokens, pos)
                 if greedy_only:
                     sampled = jnp.argmax(logits, axis=-1).astype(jnp.int32)
                 else:
@@ -297,6 +388,68 @@ class ContinuousEngine:
         self._chains[key] = jax.jit(chain, donate_argnums=1)
         return self._chains[key]
 
+    # -- paged-KV bookkeeping (page_size > 0) -------------------------------
+
+    def _ensure_pages(self, s: _Slot, n_positions: int) -> bool:
+        """Grow a slot's page list to cover ``n_positions`` sequence
+        positions, evicting idle radix leaves when the free list is dry
+        (paging.PagedAllocator.alloc_page). False = the pool cannot cover
+        it even after eviction — the caller fails or requeues the
+        request. Never shrinks: pages free only at retire."""
+        need = self._alloc.pages_for(min(n_positions, self.spec.seq_len))
+        while len(s.pages) < need:
+            pid = self._alloc.alloc_page()
+            if pid is None:
+                return False
+            s.pages.append(pid)
+        return True
+
+    def _grow_pages(self, pool, k: int, quiet: bool) -> set:
+        """Pre-chain page coverage: every active slot gets pages for the
+        next ``k`` positions (ONE host round per chain — mid-chain writes
+        can then never cross into an unmapped page). A slot the pool
+        cannot serve yet is PAUSED for this chain (returned in the paused
+        set): it rides through the device step masked inactive — its dead
+        rewrite lands on the scrap page, its replay is skipped, and its
+        sampler consumes nothing, so the eventual stream is untouched —
+        and retries once a retirement frees pages. Only when EVERY active
+        slot is starved (a true deadlock: no retirement can ever free a
+        page) does the youngest request fail; preemption/swap-out is the
+        ROADMAP item-4 follow-up."""
+        while True:
+            paused = set()
+            active = 0
+            for b, s in enumerate(pool):
+                if s.free:
+                    continue
+                active += 1
+                if not self._ensure_pages(s, min(s.pos + k, s.budget)):
+                    paused.add(b)
+            if not paused or len(paused) < active:
+                return paused
+            victim = max(paused, key=lambda b: pool[b].req.index)
+            s = pool[victim]
+            s.req.error = (
+                f"kv page pool exhausted: {self._alloc.n_pages} pages of "
+                f"{self.page_size} positions, all pinned by concurrent "
+                f"requests (deadlock broken by failing the youngest)")
+            self._retire(s, quiet)  # frees its pages; survivors retry
+            #                        (record_retire counts the failure)
+
+    def _stage_tables(self):
+        """Rewrite the persistent page-table staging block from the pool
+        state and ship it as ONE int32 upload (dlint D004). Free slots and
+        unmapped tail entries park on the scrap page — their dead writes
+        and masked gathers land on page 0 by construction."""
+        from .paging import SCRAP_PAGE
+
+        tbl = self._stage_tbl
+        for b, s in enumerate(self._pool):
+            n = len(s.pages)
+            tbl[b, :n] = s.pages
+            tbl[b, n:] = SCRAP_PAGE
+        return self.jnp.asarray(tbl)
+
     def step_many(self, k: int, quiet: bool = True) -> int:
         """Like ``k`` step_once calls in ONE device dispatch. Per-request
         token streams are identical to the per-step path (the parity gate);
@@ -316,6 +469,8 @@ class ContinuousEngine:
         jnp = self.jnp
         self._admit()
         pool = self._pool
+        paused = (self._grow_pages(pool, k, quiet)
+                  if self._alloc is not None else ())
         if all(s.free for s in pool):
             return 0
         B = self.slots
@@ -324,7 +479,7 @@ class ContinuousEngine:
         forced = np.full((k, B), -1, dtype=np.int32)
         coins = np.zeros((k, B), dtype=np.float32)
         for b, s in enumerate(pool):
-            active0[b] = not s.free
+            active0[b] = not s.free and b not in paused
             st_i32[0, b] = s.token
             st_i32[1, b] = s.pos
             st_i32[2, b] = 0 if s.free else s.budget
@@ -346,13 +501,15 @@ class ContinuousEngine:
                         k - n_forced)
 
         n_active0 = int(active0.sum())
+        table = (self._stage_tables() if self._alloc is not None
+                 else jnp.zeros((B, 0), jnp.int32))
         run = self._chain(k, greedy_only=not st_f32[0].any())
         t0 = time.monotonic() if self._obs is not None else 0.0
         with self._span("chain", "decode", steps=k, active=n_active0):
             cache, toks, acts = run(
                 self.params, self.cache, jnp.asarray(st_i32),
                 jnp.asarray(active0), jnp.asarray(forced),
-                jnp.asarray(coins), jnp.asarray(st_f32))
+                jnp.asarray(coins), jnp.asarray(st_f32), table)
             self.cache = cache
             toks = np.asarray(toks)  # dlint: allow[D001] chain outputs drive
             acts = np.asarray(acts)  # dlint: allow[D001] the host replay below
@@ -367,15 +524,20 @@ class ContinuousEngine:
                     jax.block_until_ready(self.cache)  # dlint: allow[D001] opt-in timing drain
                 self._obs.record_step(time.monotonic() - t0, n_active0,
                                       steps=k)
+                if self._alloc is not None:
+                    self._obs.kv_pages_free.set(self._alloc.n_free)
         self.stats.steps += k
+        self.stats.sum_active += n_active0 * k
         self.stats.max_active = max(self.stats.max_active, n_active0)
         # host replay: apply the recorded per-step outcomes with exactly
         # step_once's bookkeeping (forced pops, RNG draws, BOS/budget stops)
         for b, s in enumerate(pool):
-            if not active0[b]:
+            if s.free:
                 continue
             if s.req.cancelled:  # consumer vanished during the chain
-                self._retire(s, quiet)
+                self._retire(s, quiet)  # paused rows free their pages too
+                continue
+            if not active0[b]:
                 continue
             for i in range(k):
                 if not acts[i, b]:
@@ -420,9 +582,14 @@ class ContinuousEngine:
         jnp = self.jnp
         self._admit()
         pool = self._pool
+        paused = (self._grow_pages(pool, 1, quiet)
+                  if self._alloc is not None else ())
         if all(s.free for s in pool):
             return 0
-        active0 = sum(not s.free for s in pool)
+        # paused (page-starved) rows make no progress this step — exclude
+        # them from occupancy exactly as step_many's active mask does
+        active0 = sum(not s.free and b not in paused
+                      for b, s in enumerate(pool))
         t0 = time.monotonic() if self._obs is not None else 0.0
         st = self._stage_i32
         for b, s in enumerate(pool):
@@ -433,8 +600,13 @@ class ContinuousEngine:
             # slices, so the shared step program keeps its (tokens, pos)
             # signature
             staged = jnp.asarray(st[:2])
-            logits, self.cache = self._step(self.params, self.cache,
-                                            staged[0], staged[1])
+            if self._alloc is not None:
+                logits, self.cache = self._step(
+                    self.params, self.cache, staged[0], staged[1],
+                    self._stage_tables())
+            else:
+                logits, self.cache = self._step(self.params, self.cache,
+                                                staged[0], staged[1])
             logits = np.asarray(logits)  # dlint: allow[D001] host sampler needs logits
             if self._obs is not None:
                 # np.asarray synced the logits; the sync flag also drains
@@ -444,13 +616,18 @@ class ContinuousEngine:
 
                     jax.block_until_ready(self.cache)  # dlint: allow[D001] opt-in timing drain
                 self._obs.record_step(time.monotonic() - t0, active0)
+                if self._alloc is not None:
+                    self._obs.kv_pages_free.set(self._alloc.n_free)
         self.stats.steps += 1
+        self.stats.sum_active += active0
         self.stats.max_active = max(self.stats.max_active, active0)
         for i, s in enumerate(pool):
             if s.free:
                 continue
             if s.req.cancelled:  # consumer gone: free the slot now
                 self._retire(s, quiet)
+                continue
+            if i in paused:  # starved of pages: frozen, retries next step
                 continue
             if s.forced:
                 nxt = s.forced.pop(0)
@@ -488,34 +665,115 @@ class ContinuousEngine:
             return True
         return False
 
+    def _pop_request(self) -> Request | None:
+        """Next live queued request (cancelled-before-admission ones are
+        completed and skipped), or None when the queue is empty."""
+        while True:
+            with self._lock:
+                if not self._queue:
+                    return None
+                req = self._queue.pop(0)
+                if self._obs is not None:
+                    self._obs.queued.set(len(self._queue))
+            if not req.cancelled:
+                return req
+            req.done.set()  # consumer gone before admission
+
+    def _requeue_front(self, s: _Slot) -> None:
+        """Undo an admission the page pool could not serve: release any
+        shared-prefix refs, park the slot free, and put the request back at
+        the HEAD of the queue (FCFS — later smaller requests do not jump
+        a starved one; preemption is the ROADMAP item-4 follow-up)."""
+        req = s.req
+        self._alloc.release_pages(s.pages)
+        s.pages, s.shared = [], 0
+        s.req, s.pos, s.token, s.forced, s.sampler = None, 0, 0, [], None
+        req.t_admit = 0.0
+        with self._lock:
+            self._queue.insert(0, req)
+            if self._obs is not None:
+                self._obs.queued.set(len(self._queue))
+
+    def _admit_paged(self, s: _Slot) -> str:
+        """Paged admission: walk the radix tree for a shared page-aligned
+        prompt prefix (copy-free: the slot's table maps the SAME physical
+        pages, refcounted), then allocate fresh pages covering the rest of
+        the prompt. Returns 'ok' or 'dry' (pool exhausted — requeue).
+
+        A shared prefix of m positions parks the row at pos m with exactly
+        the forced-echo bookkeeping the prefill path uses: the prompt
+        tokens it skips still land in ``out`` (output meaning is
+        toggle-invariant) and only tokens[m:] remain to process. The same
+        gates as admission prefill apply (short prompts, budget overruns,
+        mid-stream BOS) — sharing must never change a request's stream.
+        """
+        req = s.req
+        tokens = req.tokens
+        # the pool itself bounds a request's positions, exactly like the
+        # seq_len clamp above: a 3-page pool can hold 3 pages of history,
+        # so the budget caps there instead of letting the deadlock breaker
+        # kill the request mid-stream at the pool edge
+        s.budget = min(s.budget, self._alloc.n_pages * self.page_size)
+        n_pre = len(tokens) - 1
+        attempted = (self._alloc.prefix_share and n_pre >= 2
+                     and n_pre < s.budget and BOS not in tokens[1:])
+        if attempted:
+            s.pages = self._alloc.match_prefix(tokens[:n_pre])
+            s.shared = len(s.pages)
+        if not self._ensure_pages(s, min(len(tokens), s.budget)):
+            return "dry"
+        if attempted:
+            # counted only now that the admission sticks — a dry-pool
+            # requeue above re-matches on every retry and must not inflate
+            # the hit/saved figures (they are pinned equal to the
+            # Prometheus series by tests/test_obs.py)
+            self._alloc.record_admission(s.shared)
+        m = s.shared * self.page_size
+        if m:
+            s.pos = m
+            s.token = tokens[m]
+            s.forced = list(tokens[m + 1:])
+            req.out.extend(tokens[1:m + 1])
+            for t in tokens[1:m + 1]:
+                self._notify(req, t)
+            self.stats.tokens += m
+            if self._obs is not None:
+                self._obs.generated.inc(m)
+                self._obs.prefix_hits.inc()
+                self._obs.prefill_saved.inc(m)
+        return "ok"
+
     def _admit(self):
         spec = self.spec
         for slot_index, s in enumerate(self._pool):
-            if not s.free:
-                continue
-            req = None
-            while req is None:
-                with self._lock:
-                    if not self._queue:
+            while s.free:
+                req = self._pop_request()
+                if req is None:
+                    return
+                req.t_admit = time.monotonic()
+                s.req, s.pos = req, 0
+                s.token = req.tokens[0]
+                s.forced = list(req.tokens[1:])
+                s.budget = min(req.steps, spec.seq_len)
+                temp = (req.temperature if req.temperature is not None
+                        else self.temperature)
+                topp = req.topp if req.topp is not None else self.topp
+                seed = (req.seed if req.seed is not None
+                        else self.seed + req.index)
+                s.sampler = Sampler(spec.vocab_size, temp, topp, seed,
+                                    use_native=self.use_native_sampler)
+                if self._alloc is not None:
+                    if self._admit_paged(s) == "dry":
+                        self._requeue_front(s)
                         return
-                    req = self._queue.pop(0)
-                    if self._obs is not None:
-                        self._obs.queued.set(len(self._queue))
-                if req.cancelled:  # consumer gone before admission
-                    req.done.set()
-                    req = None
-            req.t_admit = time.monotonic()
-            s.req, s.pos = req, 0
-            s.token = req.tokens[0]
-            s.forced = list(req.tokens[1:])
-            s.budget = min(req.steps, spec.seq_len)
-            temp = (req.temperature if req.temperature is not None
-                    else self.temperature)
-            topp = req.topp if req.topp is not None else self.topp
-            seed = req.seed if req.seed is not None else self.seed + req.index
-            s.sampler = Sampler(spec.vocab_size, temp, topp, seed,
-                                use_native=self.use_native_sampler)
-            self._maybe_prefill_slot(slot_index, s)
+                self._maybe_prefill_slot(slot_index, s)
+                if s.req.cancelled:
+                    # consumer vanished during admission/prefill: free the
+                    # slot AND its pages NOW — a cancelled prefill must not
+                    # pin pool pages until the next chain boundary
+                    self._retire(s, quiet=True)
+                    continue
+                break  # slot filled
 
     def _maybe_prefill_slot(self, slot_index: int, s: _Slot):
         """Admission prefill: fill the slot's cache rows for the prompt
@@ -531,35 +789,59 @@ class ContinuousEngine:
         chunk = self.prefill_chunk
         tokens = s.req.tokens
         n_pre = len(tokens) - 1
+        start = s.pos  # 0, or the page-aligned prefix-share boundary
         if (getattr(self, "_prefill_fwd", None) is None or chunk <= 1
-                or n_pre < 2 or n_pre >= s.budget or BOS in tokens[1:]):
+                or n_pre - start < 2 or n_pre >= s.budget
+                or BOS in tokens[1:]):
             return
         from .generate import run_chunked_prefill
 
         t0 = time.monotonic() if self._obs is not None else 0.0
         jnp = self.jnp
+        paged = self._alloc is not None
         with self._span("prefill", "prefill", slot=slot_index,
-                        tokens=n_pre):
-            cache_box = [self._scratch_cache()]
+                        tokens=n_pre - start):
+            if paged:
+                # seed a virtual contiguous sequence cache from the slot's
+                # pages: the unshared-suffix chunks attend over the shared
+                # prefix k/v, positions start.. are written before any
+                # later chunk reads them, and the scatter puts everything
+                # back in place (shared pages get byte-identical content)
+                from .paging import SCRAP_PAGE
 
-            def fwd(part, start):
+                tbl = np.full((self._max_pages,), SCRAP_PAGE, np.int32)
+                tbl[:len(s.pages)] = s.pages
+                tbl_dev = jnp.asarray(tbl)
+                cache_box = [self._gather_pages(self.cache, tbl_dev)]
+            else:
+                cache_box = [self._scratch_cache()]
+
+            def fwd(part, start_pos):
                 _, cache_box[0] = self._prefill_fwd(
                     self.params, cache_box[0], jnp.asarray(part, jnp.int32),
-                    jnp.int32(start))
+                    jnp.int32(start_pos))
 
-            run_chunked_prefill(fwd, tokens[:n_pre], 0, chunk,
+            run_chunked_prefill(fwd, tokens[start:n_pre], start, chunk,
                                 self.spec.seq_len)
-            self.cache = self._insert(self.cache, cache_box[0],
-                                      jnp.int32(slot_index))
+            if paged:
+                self.cache = self._scatter_pages(self.cache, cache_box[0],
+                                                 tbl_dev)
+                # publish the freshly prefilled full prompt pages NOW (not
+                # just at retire): a same-system-prompt request admitted
+                # into the next slot this very round already shares them
+                self._alloc.insert_prefix(tokens[:n_pre], s.pages)
+            else:
+                self.cache = self._insert(self.cache, cache_box[0],
+                                          jnp.int32(slot_index))
         # echo the prefilled prompt tokens into the output AND the token
         # count (the step loop both appends forced tokens and counts them —
         # "Generated tokens" must not change meaning with the toggle)
-        s.req.out.extend(tokens[1:n_pre + 1])
-        for t in tokens[1:n_pre + 1]:
+        s.req.out.extend(tokens[start + 1:n_pre + 1])
+        for t in tokens[start + 1:n_pre + 1]:
             self._notify(s.req, t)
-        self.stats.tokens += n_pre
+        self.stats.tokens += n_pre - start
         if self._obs is not None:
-            self._obs.generated.inc(n_pre)
+            self._obs.generated.inc(n_pre - start)
             self._obs.prefill.observe(time.monotonic() - t0)
         s.pos = n_pre
         s.token = tokens[n_pre]
@@ -579,6 +861,19 @@ class ContinuousEngine:
         if not quiet:
             print(f"[{s.req.index}] done: {len(s.req.out)} tokens "
                   f"(pos {s.pos}/{s.budget})")
+        if self._alloc is not None and s.pages:
+            # publish the request's FULL prompt pages into the radix tree
+            # (positions 0..pos-1 hold prompt k/v up to min(pos, prompt));
+            # cancelled/failed requests publish nothing. Then drop this
+            # slot's refs — tree-held pages survive for prefix reuse until
+            # LRU eviction reclaims them.
+            if s.req.error is None and not s.req.cancelled:
+                n_ins = min(s.pos, len(s.req.tokens))
+                self._alloc.insert_prefix(s.req.tokens[:n_ins], s.pages)
+            self._alloc.release_pages(s.pages)
+            s.pages, s.shared = [], 0
+            if self._obs is not None:
+                self._obs.kv_pages_free.set(self._alloc.n_free)
         s.req.t_finish = time.monotonic()
         if self._obs is not None:
             self._obs.record_retire(s.req, s.req.t_finish)
@@ -618,6 +913,13 @@ class ContinuousEngine:
             if not s.free:
                 s.req.error = message
                 self._retire(s, quiet=True)
+        if self._alloc is not None:
+            # tear the radix tree down with the rest of the engine state:
+            # a post-fault serving loop restarts from an empty, fully-free
+            # pool instead of silently inheriting published prefixes
+            self._alloc.tree.clear()
+            if self._obs is not None:
+                self._obs.kv_pages_free.set(self._alloc.n_free)
 
     def run(self, requests: list[list[int]], steps: int,
             quiet: bool = True) -> tuple[list[list[int]], ContinuousStats]:
@@ -661,7 +963,8 @@ def generate_continuous(spec: TransformerSpec, params: dict[str, Any],
                         slots: int = 0, cache_dtype=None, mesh=None,
                         prefill_chunk: int = 0, block_steps: int = 1,
                         quiet: bool = False, use_native_sampler: bool = True,
-                        fast_prefill: bool = False, metrics=None):
+                        fast_prefill: bool = False, metrics=None,
+                        page_size: int = 0, kv_pages: int = 0):
     """CLI entry: encode prompts, stream them through a slot pool, print
     rows in the --prompts-file format ("[i] 'text'")."""
     reqs = [tokenizer.encode(p or "", bos=True, eos=False) for p in prompts]
@@ -671,7 +974,8 @@ def generate_continuous(spec: TransformerSpec, params: dict[str, Any],
                            prefill_chunk=prefill_chunk,
                            block_steps=block_steps,
                            use_native_sampler=use_native_sampler,
-                           fast_prefill=fast_prefill, metrics=metrics)
+                           fast_prefill=fast_prefill, metrics=metrics,
+                           page_size=page_size, kv_pages=kv_pages)
     outs, stats = eng.run(reqs, steps, quiet=quiet)
     for b, (req, row) in enumerate(zip(reqs, outs)):
         if not quiet:
@@ -682,4 +986,10 @@ def generate_continuous(spec: TransformerSpec, params: dict[str, Any],
         print(f"Avg generation time: "
               f"{stats.total_ms / max(1, stats.tokens):.2f} ms/token "
               f"({stats.tokens_per_s:.1f} tok/s)")
+        if eng.allocator is not None:
+            a = eng.allocator
+            print(f"Paged KV:            {a.n_pages} pages x "
+                  f"{a.page_size} positions, {a.n_free} free; prefix hit "
+                  f"rate {a.hit_rate:.0%}, {a.tokens_saved} prefill "
+                  f"tokens saved, {a.evictions} evictions")
     return outs, stats

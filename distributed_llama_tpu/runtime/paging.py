@@ -1,0 +1,298 @@
+"""Paged KV allocation + radix prefix sharing — the host half of paged KV.
+
+The continuous engine's contiguous layout charges every slot a full
+``seq_len`` KV stripe (analysis/memory_model.kv_cache_device_bytes), so a
+12-token chat request strands >99% of its stripe and the slot count — not
+compute — caps concurrency. This module manages the replacement: a fixed
+pool of fixed-size pages (vLLM's PagedAttention unit, Kwon et al. 2023)
+plus a prefix tree over full pages (SGLang's RadixAttention, Zheng et al.
+2023) so requests sharing a system prompt map the SAME physical prefill
+pages instead of recomputing them.
+
+Everything here is host-side bookkeeping over small Python ints — the
+device never sees this module. The device-visible artifacts are the page
+TABLE rows (int32 physical page ids per slot, staged by the engine into
+one persistent numpy buffer — dlint D004) that models/llama.
+forward_batch_paged walks, and the page-pool planes it indexes.
+
+Invariants the unit tests pin (tests/test_paging.py):
+
+* a page's refcount = (# slots mapping it) + (1 if the tree holds it);
+  it returns to the free list exactly when that count reaches zero;
+* page id 0 is RESERVED as the scrap page (parked/free slot rows write
+  their dead k/v there); the pool never hands it out;
+* the tree only shares FULL pages (``page_size`` tokens each): a
+  partially-filled tail page is private to its request, so decode writes
+  never land in a shared page;
+* eviction frees least-recently-used tree LEAVES whose pages no live slot
+  maps — interior nodes only become evictable once their children are
+  gone (a child is unreachable without its prefix chain).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+SCRAP_PAGE = 0  # physical page 0: dead-write target for parked slots
+
+
+class PagePool:
+    """Free-list + refcount accounting over physical page ids 1..n_pages.
+
+    ``alloc`` hands out the lowest free id (deterministic schedules make
+    the paged==contiguous parity tests reproducible); ``retain``/
+    ``release`` move the per-page refcount, and a page re-enters the free
+    list exactly at refcount zero.
+    """
+
+    def __init__(self, n_pages: int):
+        if n_pages < 1:
+            raise ValueError(f"page pool needs >= 1 page, got {n_pages}")
+        self.n_pages = n_pages
+        # lowest-id-first allocation order; ids 1..n_pages (0 = scrap)
+        self._free = list(range(n_pages, 0, -1))
+        self._ref: dict[int, int] = {}
+
+    @property
+    def n_free(self) -> int:
+        return len(self._free)
+
+    def alloc(self) -> int | None:
+        """One page at refcount 1, or None when the pool is dry (the
+        caller decides whether to evict or fail the request)."""
+        if not self._free:
+            return None
+        pid = self._free.pop()
+        self._ref[pid] = 1
+        return pid
+
+    def retain(self, pid: int) -> None:
+        if pid not in self._ref:
+            raise ValueError(f"retain of unallocated page {pid}")
+        self._ref[pid] += 1
+
+    def release(self, pid: int) -> None:
+        n = self._ref.get(pid)
+        if n is None:
+            raise ValueError(f"release of unallocated page {pid}")
+        if n == 1:
+            del self._ref[pid]
+            self._free.append(pid)
+            # keep lowest-first order without re-sorting the whole list on
+            # every release: append high, pop low via sort-on-alloc would be
+            # O(n log n) per step — a lazy sort only when order broke
+            if len(self._free) > 1 and self._free[-1] > self._free[-2]:
+                self._free.sort(reverse=True)
+        else:
+            self._ref[pid] = n - 1
+
+    def refcount(self, pid: int) -> int:
+        return self._ref.get(pid, 0)
+
+
+@dataclasses.dataclass
+class _Node:
+    """One FULL page of the prefix tree: ``key`` is its page_size-token
+    window, ``page`` the physical id the tree retains a ref on."""
+    key: tuple
+    page: int
+    parent: "_Node | None"
+    children: dict = dataclasses.field(default_factory=dict)
+    last_used: int = 0
+
+
+class PrefixTree:
+    """Page-granular radix tree over token ids.
+
+    Each node spans exactly one full page (``page_size`` token ids — the
+    radix alphabet is page windows, so depth = pages, not tokens), holding
+    one tree-owned reference on its physical page. ``match`` walks the
+    longest stored page-aligned prefix and RETAINS every matched page for
+    the caller; ``insert`` adopts a request's full prompt pages;
+    ``evict_lru`` frees idle leaves when the pool runs dry.
+    """
+
+    def __init__(self, pool: PagePool, page_size: int):
+        self.pool = pool
+        self.page_size = page_size
+        self._roots: dict[tuple, _Node] = {}
+        self._clock = 0
+        self._n_nodes = 0
+
+    def __len__(self) -> int:
+        return self._n_nodes
+
+    def _tick(self) -> int:
+        self._clock += 1
+        return self._clock
+
+    def _windows(self, tokens) -> list[tuple]:
+        ps = self.page_size
+        return [tuple(tokens[i:i + ps])
+                for i in range(0, (len(tokens) // ps) * ps, ps)]
+
+    def match(self, tokens) -> list[int]:
+        """Physical page ids of the longest stored page-aligned prefix of
+        ``tokens``; each returned page carries a NEW reference the caller
+        must eventually release (slot retire)."""
+        now = self._tick()
+        pages: list[int] = []
+        children = self._roots
+        for key in self._windows(tokens):
+            node = children.get(key)
+            if node is None:
+                break
+            node.last_used = now
+            self.pool.retain(node.page)
+            pages.append(node.page)
+            children = node.children
+        return pages
+
+    def insert(self, tokens, pages) -> int:
+        """Adopt the full pages of ``tokens`` (prompt positions only —
+        ``len(pages)`` pages covering ``len(pages) * page_size`` token
+        ids). The tree retains one ref per NEWLY adopted page; windows
+        already present just refresh recency (their pages stay whichever
+        physical id got there first — content is identical by the prefix
+        key). Returns the number of pages adopted."""
+        now = self._tick()
+        adopted = 0
+        children, parent = self._roots, None
+        for key, pid in zip(self._windows(tokens), pages):
+            node = children.get(key)
+            if node is None:
+                node = _Node(key=key, page=pid, parent=parent,
+                             last_used=now)
+                children[key] = node
+                self.pool.retain(pid)
+                self._n_nodes += 1
+                adopted += 1
+            else:
+                node.last_used = now
+            children, parent = node.children, node
+        return adopted
+
+    def _leaves(self):
+        stack = list(self._roots.values())
+        while stack:
+            node = stack.pop()
+            if node.children:
+                stack.extend(node.children.values())
+            else:
+                yield node
+
+    def evict_lru(self, n_pages: int) -> int:
+        """Drop up to ``n_pages`` least-recently-used leaf pages that no
+        live slot maps (pool refcount 1 = tree-only). Walks repeatedly so
+        an interior chain unwinds leaf by leaf. Returns pages freed."""
+        freed = 0
+        while freed < n_pages:
+            victims = [n for n in self._leaves()
+                       if self.pool.refcount(n.page) == 1]
+            if not victims:
+                break
+            node = min(victims, key=lambda n: n.last_used)
+            self._drop(node)
+            freed += 1
+        return freed
+
+    def _drop(self, node: _Node) -> None:
+        siblings = (node.parent.children if node.parent is not None
+                    else self._roots)
+        del siblings[node.key]
+        self._n_nodes -= 1
+        self.pool.release(node.page)
+
+    def clear(self) -> int:
+        """Release every tree-held page (engine shutdown / fail_all)."""
+        freed = 0
+        while self._n_nodes:
+            for node in list(self._leaves()):
+                self._drop(node)
+                freed += 1
+        return freed
+
+
+class PagedAllocator:
+    """The engine-facing facade: pool + tree + the share/evict policy.
+
+    ``alloc_page`` transparently evicts idle tree leaves when the free
+    list runs dry; ``match_prefix``/``insert_prefix`` are the admission
+    and retire hooks. Counters feed the engine's Prometheus series
+    (dllama_kv_pages_free / dllama_prefix_hits_total) and the bench's
+    prefix-hit columns.
+    """
+
+    def __init__(self, n_pages: int, page_size: int,
+                 prefix_share: bool = True):
+        if page_size < 1:
+            raise ValueError(f"page_size must be >= 1, got {page_size}")
+        self.page_size = page_size
+        self.n_pages = n_pages
+        self.prefix_share = prefix_share
+        self.pool = PagePool(n_pages)
+        self.tree = PrefixTree(self.pool, page_size)
+        self.prefix_hits = 0       # admissions that mapped >= 1 shared page
+        self.prefix_misses = 0     # admissions that mapped none
+        self.tokens_saved = 0      # prefill positions skipped via sharing
+        self.evictions = 0
+
+    @property
+    def n_free(self) -> int:
+        return self.pool.n_free
+
+    def pages_for(self, n_positions: int) -> int:
+        """Pages needed to cover ``n_positions`` sequence positions."""
+        return -(-n_positions // self.page_size)
+
+    def alloc_page(self) -> int | None:
+        pid = self.pool.alloc()
+        if pid is None and len(self.tree):
+            self.evictions += self.tree.evict_lru(1)
+            pid = self.pool.alloc()
+        return pid
+
+    def match_prefix(self, tokens) -> list[int]:
+        """Admission hook: shared FULL pages for the longest stored prefix
+        of ``tokens`` (refs retained for the caller). Counting is
+        deferred to ``record_admission`` — an admission the pool cannot
+        serve yet gets requeued and re-matches every retry, and counting
+        here would inflate the hit/saved figures by the retry count."""
+        if not self.prefix_share:
+            return []
+        return self.tree.match(tokens)
+
+    def record_admission(self, n_shared_pages: int) -> None:
+        """Count one STICKING admission that attempted prefix sharing —
+        called by the engine after pages are secured, exactly once per
+        admitted request, so hit_rate/tokens_saved match the Prometheus
+        series no matter how many dry-pool retries preceded it."""
+        if n_shared_pages > 0:
+            self.prefix_hits += 1
+            self.tokens_saved += n_shared_pages * self.page_size
+        else:
+            self.prefix_misses += 1
+
+    def insert_prefix(self, tokens, pages) -> int:
+        """Retire hook: publish a request's full prompt pages for reuse."""
+        if not self.prefix_share:
+            return 0
+        n_full = min(len(tokens) // self.page_size, len(pages))
+        return self.tree.insert(tokens[:n_full * self.page_size],
+                                pages[:n_full])
+
+    def release_pages(self, pages) -> None:
+        for pid in pages:
+            self.pool.release(pid)
+
+    def reset_counters(self) -> None:
+        """Zero the admission counters WITHOUT touching pool/tree state —
+        the bench's warm-up/timed-pass boundary: the timed pass then
+        reports the warm-tree steady state alone, not a blend."""
+        self.prefix_hits = self.prefix_misses = 0
+        self.tokens_saved = self.evictions = 0
+
+    @property
+    def hit_rate(self) -> float:
+        n = self.prefix_hits + self.prefix_misses
+        return self.prefix_hits / n if n else 0.0

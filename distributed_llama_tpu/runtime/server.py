@@ -59,7 +59,7 @@ class InferenceServer:
                  cache_dtype=None, mesh=None, prefill_chunk: int = 0,
                  block_steps: int = 1, quiet: bool = False,
                  fast_prefill: bool = False, metrics: bool = True,
-                 registry=None):
+                 registry=None, page_size: int = 0, kv_pages: int = 0):
         self.spec = spec
         self.tokenizer = tokenizer
         self.default_steps = steps
@@ -81,7 +81,9 @@ class InferenceServer:
                                        prefill_chunk=prefill_chunk,
                                        block_steps=block_steps,
                                        fast_prefill=fast_prefill,
-                                       metrics=self.registry)
+                                       metrics=self.registry,
+                                       page_size=page_size,
+                                       kv_pages=kv_pages)
         self._shutdown = threading.Event()
         server = self
 

@@ -219,6 +219,44 @@ def test_d004_fires_in_step_functions_and_loops(tmp_path):
     assert len(d004) == 3, findings
 
 
+def test_d004_fires_on_page_table_list_comp(tmp_path):
+    """ISSUE 6: a paged allocator that rebuilds the page-table upload from
+    per-slot Python lists inside the step loop is exactly the D004 hazard
+    — B boxed lists + a fresh host array per device step."""
+    findings = run_on(tmp_path, "runtime/paged.py", """
+        import jax.numpy as jnp
+
+        class Engine:
+            def step_once(self, pool):
+                table = jnp.asarray([s.pages for s in pool])   # per-step!
+                return table
+    """)
+    d004 = [f for f in findings if f.rule == "D004"]
+    assert len(d004) == 1, findings
+
+
+def test_d004_quiet_on_persistent_page_table_staging(tmp_path):
+    """The shipped pattern (continuous._stage_tables): rows written into
+    one persistent numpy block, ONE ndarray upload per step — no finding."""
+    findings = run_on(tmp_path, "runtime/paged.py", """
+        import numpy as np
+        import jax.numpy as jnp
+
+        class Engine:
+            def _stage_tables(self, pool):
+                tbl = self._stage_tbl
+                for b, s in enumerate(pool):
+                    n = len(s.pages)
+                    tbl[b, :n] = s.pages
+                    tbl[b, n:] = 0
+                return jnp.asarray(tbl)
+
+            def step_once(self, pool):
+                return self._stage_tables(pool)
+    """)
+    assert [f for f in findings if f.rule == "D004"] == []
+
+
 def test_d004_quiet_on_staged_upload_and_cold_functions(tmp_path):
     findings = run_on(tmp_path, "runtime/eng.py", """
         import numpy as np

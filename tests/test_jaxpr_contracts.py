@@ -8,8 +8,9 @@ could notice the regression the slow way."""
 from __future__ import annotations
 
 from distributed_llama_tpu.analysis.jaxpr_contracts import (
-    contract_decode_donation, contract_decode_shape_stability,
-    contract_tp_collectives, run_contracts, walk_fn_eqns)
+    contract_decode_donation, contract_decode_donation_paged,
+    contract_decode_shape_stability, contract_tp_collectives,
+    run_contracts, walk_fn_eqns)
 from distributed_llama_tpu.models.synth import small_bench_spec
 from distributed_llama_tpu.ops.quants import FloatType
 
@@ -44,6 +45,14 @@ def test_decode_step_kv_cache_donation_holds():
     assert "2 aliased" in r.detail  # both KV planes, not just one
 
 
+def test_decode_step_paged_kv_donation_holds():
+    # J002 must hold under the paged layout too: both page-pool planes
+    # aliased through the lowering, with the page table riding alongside
+    r = contract_decode_donation_paged(_spec(), slots=4, page_size=16)
+    assert r.ok, r.detail
+    assert "2 aliased" in r.detail
+
+
 def test_decode_step_shape_stability_holds():
     r = contract_decode_shape_stability(_spec(), slots=4)
     assert r.ok, r.detail
@@ -51,8 +60,10 @@ def test_decode_step_shape_stability_holds():
 
 def test_run_contracts_reports_all_and_passes():
     results = run_contracts(_spec())
-    # J001 runs once per scheme (ref + fused) — both schedules stay pinned
-    assert [r.contract for r in results] == ["J001", "J001", "J002", "J003"]
+    # J001 runs once per scheme (ref + fused), J002 once per cache
+    # layout (contiguous + paged) — every schedule/layout stays pinned
+    assert [r.contract for r in results] == ["J001", "J001", "J002",
+                                             "J002", "J003"]
     assert {r.name for r in results if r.contract == "J001"} == {
         "tp_collectives[ref]", "tp_collectives[fused]"}
     assert all(r.ok for r in results), [r.detail for r in results]
@@ -67,7 +78,8 @@ def test_contract_failure_becomes_finding_not_crash():
     assert any(not r.ok for r in results)
     # even on a raised error, results keep the documented J-ids (the CLI
     # and contract_findings key on them)
-    assert [r.contract for r in results] == ["J001", "J001", "J002", "J003"]
+    assert [r.contract for r in results] == ["J001", "J001", "J002",
+                                             "J002", "J003"]
 
 
 def test_walk_fn_eqns_shim_still_works():

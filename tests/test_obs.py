@@ -219,6 +219,48 @@ def test_engine_lifecycle_metrics_populated(params):
     assert reg.get("dllama_engine_queued_requests").value == 0
 
 
+def test_paged_engine_exports_page_and_prefix_series(params):
+    """ISSUE 6 satellite: a paged engine moves dllama_kv_pages_free and
+    dllama_prefix_hits_total, and both land in the Prometheus exposition
+    with their HELP/TYPE headers."""
+    from distributed_llama_tpu.runtime.continuous import ContinuousEngine
+
+    reg = Registry()
+    sys_p = [1] + list(range(20, 28))  # 2 full pages at page_size=4
+    reqs = [sys_p + [40 + i] for i in range(4)]
+    eng = ContinuousEngine(SPEC, params, slots=2, temperature=0.0,
+                           topp=0.9, seed=5, metrics=reg, page_size=4,
+                           prefill_chunk=4)
+    eng.run(reqs, steps=12)
+    a = eng.allocator
+    assert reg.get("dllama_prefix_hits_total").value == a.prefix_hits > 0
+    assert reg.get("dllama_prefill_tokens_saved_total").value \
+        == a.tokens_saved > 0
+    # after the drain: every page is free or idle in the radix tree
+    assert reg.get("dllama_kv_pages_free").value == a.n_free
+    text = reg.expose()
+    for family, kind in (("dllama_kv_pages_free", "gauge"),
+                         ("dllama_prefix_hits_total", "counter"),
+                         ("dllama_prefill_tokens_saved_total", "counter")):
+        assert f"# TYPE {family} {kind}" in text
+        assert f"# HELP {family} " in text
+
+
+def test_contiguous_engine_page_series_stay_zero(params):
+    """The paged instruments exist on every engine (layout-invariant
+    scrape surface) but a contiguous engine never moves them."""
+    from distributed_llama_tpu.runtime.continuous import ContinuousEngine
+
+    reg = Registry()
+    eng = ContinuousEngine(SPEC, params, slots=2, temperature=0.0,
+                           topp=0.9, seed=5, metrics=reg)
+    eng.run([[1, 5, 9], [1, 7]], steps=8)
+    assert eng.allocator is None
+    assert reg.get("dllama_kv_pages_free").value == 0
+    assert reg.get("dllama_prefix_hits_total").value == 0
+    assert "dllama_kv_pages_free 0" in reg.expose()
+
+
 def test_engine_compile_event_counter(params):
     """Fused-chain shape-cache misses count as compile events; reusing a
     chain shape does not."""

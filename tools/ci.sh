@@ -26,7 +26,17 @@
 # Usage: tools/ci.sh [extra pytest args]
 set -eu
 cd "$(dirname "$0")/.."
+# --all = dlint + jaxpr contracts (J002 now runs per cache LAYOUT:
+# contiguous + paged donation both pinned) + the full 48-config shardcheck
+# matrix re-run (which also pins the paged-pool footprint formula to the
+# contiguous stripe at equal capacity — the KV-PAGED check)
 python -m distributed_llama_tpu.analysis --all
+# paged-vs-contiguous equivalence gate (ISSUE 6): paged decode must stay
+# BITWISE equal to the contiguous cache and stream-invisible in the
+# engine, and the shared-prompt radix path must actually share — fail
+# fast here before the full suite (the same tests also run in tier-1)
+python -m pytest tests/test_paging.py -q -p no:cacheprovider \
+    -k "bitwise or streams_match or shared_system_prompt"
 # drift observatory gate (ISSUE 5): tracecheck reconciles the checked-in
 # synthetic capture fixtures against the analytic collective model and
 # fails the build on any DRIFT verdict; the attribution Chrome traces are

@@ -6,20 +6,107 @@ measurement behind BASELINE.md's continuous-batching rows. Runs one warm-up
 pass (compile) and times a second identical pass; stream equality between
 the two passes is asserted (the schedule is deterministic).
 
+A paged-KV comparison section (on by default) then drives a
+shared-system-prompt workload through (a) the contiguous engine at
+``--slots`` and (b) a paged engine holding the SAME modeled KV HBM
+(analysis/memory_model: pool pages = slots x seq_len/page_size) but
+``--oversub`` x the slots — the ISSUE-6 acceptance columns: sustained
+concurrency at equal HBM, prefix-hit rate, and prefill tokens saved.
+Streams must match the contiguous engine token for token (scheduling and
+paging stay invisible in outputs).
+
+The final stdout line is a JSON row stamped with utils/fingerprint.
+env_fingerprint (jax/jaxlib/device-kind/clock — the same drift defense as
+bench.py rows), so BENCH_* archives stay joinable across sessions.
+
 Usage:
   python tools/continuous_bench.py [--slots 4] [--block-steps 16]
       [--kv-cache-dtype f32|bf16] [--requests 6] [--steps 48] [--small]
+      [--page-size 16] [--oversub 4] [--no-paged-compare]
 
 On a remote/tunneled runtime, --block-steps 16 amortizes the per-dispatch
 round-trip; --block-steps 1 measures the per-step scheduling floor.
 """
 
 import argparse
+import json
 import os
 import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _shared_prompt_requests(page_size: int, n: int) -> list:
+    """A shared-system-prompt workload: every request opens with the same
+    2-full-page system prefix (page-aligned => radix-shareable) and ends
+    with a short unique tail — the millions-of-users chat shape."""
+    sys_prefix = [1] + [7 + (i % 90) for i in range(2 * page_size)]
+    return [sys_prefix + [3 + i % 100, 5 + (i * 7) % 100] for i in range(n)]
+
+
+def paged_compare(spec, params, args, dtype) -> dict:
+    """The equal-HBM concurrency section; returns the JSON sub-row."""
+    from distributed_llama_tpu.analysis.memory_model import (
+        kv_cache_device_bytes, kv_page_pool_bytes)
+    from distributed_llama_tpu.runtime.continuous import ContinuousEngine
+
+    ps = args.page_size
+    max_pages = spec.seq_len // ps
+    pool_pages = args.slots * max_pages   # byte-parity with --slots stripes
+    paged_slots = args.slots * args.oversub
+    reqs = _shared_prompt_requests(ps, args.requests)
+    steps = args.steps
+
+    def run(label, **kw):
+        eng = ContinuousEngine(spec, params, temperature=0.0, topp=0.9,
+                               seed=3, block_steps=args.block_steps,
+                               cache_dtype=dtype, prefill_chunk=ps, **kw)
+        eng.run(reqs, steps=steps)            # warm-up (compile)
+        if eng.allocator is not None:
+            # report the timed pass alone (warm-tree steady state), not a
+            # cold+warm blend accumulated across both passes
+            eng.allocator.reset_counters()
+        t0 = time.perf_counter()
+        outs, st = eng.run(reqs, steps=steps)
+        dt = time.perf_counter() - t0
+        print(f"{label}: {st.tokens} tokens {dt:.2f}s "
+              f"{st.tokens / dt:.1f} tok/s, sustained concurrency "
+              f"{st.avg_active:.2f} (max {st.max_active})", file=sys.stderr)
+        return eng, outs, st, dt
+
+    _, outs_c, st_c, dt_c = run(f"contiguous slots={args.slots}",
+                                slots=args.slots)
+    eng_p, outs_p, st_p, dt_p = run(
+        f"paged slots={paged_slots} pool={pool_pages}x{ps}",
+        slots=paged_slots, page_size=ps, kv_pages=pool_pages)
+    assert outs_p == outs_c, "paged scheduling changed a token stream?!"
+
+    a = eng_p.allocator
+    kv_contig = kv_cache_device_bytes(spec, 1, batch=args.slots)
+    kv_paged = kv_page_pool_bytes(spec, 1, pool_pages, ps,
+                                  include_scrap=False)
+    assert kv_paged == kv_contig, "equal-HBM sizing drifted"
+    row = {
+        "page_size": ps, "pool_pages": pool_pages,
+        "kv_hbm_bytes": kv_contig,
+        "contiguous": {"slots": args.slots, "tok_s": st_c.tokens / dt_c,
+                       "sustained_concurrency": st_c.avg_active,
+                       "steps": st_c.steps},
+        "paged": {"slots": paged_slots, "tok_s": st_p.tokens / dt_p,
+                  "sustained_concurrency": st_p.avg_active,
+                  "steps": st_p.steps},
+        "concurrency_ratio": st_p.avg_active / max(st_c.avg_active, 1e-9),
+        "prefix_hit_rate": a.hit_rate,
+        "prefill_tokens_saved": a.tokens_saved,
+        "evictions": a.evictions,
+    }
+    print(f"equal-HBM ({kv_contig / 2**20:.0f} MiB KV): concurrency "
+          f"{st_c.avg_active:.2f} -> {st_p.avg_active:.2f} "
+          f"({row['concurrency_ratio']:.2f}x), prefix hit rate "
+          f"{a.hit_rate:.0%}, {a.tokens_saved} prefill tokens saved",
+          file=sys.stderr)
+    return row
 
 
 def main():
@@ -32,6 +119,13 @@ def main():
     ap.add_argument("--steps", type=int, default=48)
     ap.add_argument("--small", action="store_true",
                     help="tiny config for CI/CPU smoke runs")
+    ap.add_argument("--page-size", type=int, default=16,
+                    help="paged-compare page size (positions per page)")
+    ap.add_argument("--oversub", type=int, default=4,
+                    help="paged-compare slot multiplier at equal KV HBM")
+    ap.add_argument("--paged-compare", action=argparse.BooleanOptionalAction,
+                    default=True,
+                    help="run the equal-HBM paged-vs-contiguous section")
     ap.add_argument("--profile", default=None, metavar="DIR",
                     help="trace the timed pass and print the per-step "
                          "op-time split by kernel family (the VERDICT r3 "
@@ -45,6 +139,7 @@ def main():
                                                     small_bench_spec,
                                                     synth_q40_fast)
     from distributed_llama_tpu.runtime.continuous import ContinuousEngine
+    from distributed_llama_tpu.utils.fingerprint import env_fingerprint
 
     print(f"backend: {jax.devices()[0].platform} x{len(jax.devices())}",
           file=sys.stderr)
@@ -76,6 +171,18 @@ def main():
           f"slots={args.slots}, block={args.block_steps}, "
           f"cache={args.kv_cache_dtype})")
 
+    timings = {"tok_s": st.tokens / dt, "ms_step": dt * 1000 / st.steps}
+    row = {
+        "tool": "continuous_bench",
+        "spec": "small" if args.small else "7b",
+        "slots": args.slots, "block_steps": args.block_steps,
+        "kv_cache_dtype": args.kv_cache_dtype,
+        "requests": args.requests, "steps": args.steps,
+        "timing": timings,
+    }
+    if args.paged_compare:
+        row["paged_equal_hbm"] = paged_compare(spec, params, args, dtype)
+
     if args.profile:
         from distributed_llama_tpu.utils.it_split import bucket_ops
 
@@ -93,6 +200,10 @@ def main():
               f"{dt3 * 1000 / st3.steps:.2f} ms/step -> "
               f"{dt3 * 1000 / st3.steps - op_total:.2f} ms/step of "
               f"dispatch/host gaps")
+
+    # the machine-readable row, fingerprint-stamped like bench.py's
+    row["env_fingerprint"] = env_fingerprint()
+    print(json.dumps(row))
 
 
 if __name__ == "__main__":
