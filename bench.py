@@ -523,6 +523,29 @@ def _project_tp(spec, rank_tp: int, ms: float, baseline: float) -> dict:
           f"q80 {lat10['q80_total_ms']:.3f} ms"
           + (" (bar: 48.4 ms)" if spec.n_layers == 80 else ""),
           file=sys.stderr)
+    # speculative decoding term (ISSUE 7): modeled ms/accepted-token when
+    # each dispatch verifies K positions at per-draft accept rate alpha —
+    # the collective-latency floor divides by the expected accepted span
+    # (shard_sim.FullSystemProjection.speculative). MODELED ONLY: the
+    # CPU rank-sim cannot measure the K-row shard cost (PARITY.md carries
+    # the honest N/A); the shard term is charged weight-bound-unchanged.
+    spec_rows = {}
+    for k in (2, 4, 8):
+        spec_rows[f"k{k}"] = {
+            f"alpha{a}": {
+                "expected_tokens_per_dispatch": sp.expected_tokens,
+                "ms_per_accepted_token": sp.ms_per_accepted_token,
+                "speedup_vs_spec_off": round(sp.speedup, 2),
+            }
+            for a in (0.5, 0.7, 0.9)
+            for sp in (proj.speculative(k, a),)}
+    mid = proj.speculative(4, 0.7)
+    print(f"speculative (modeled, {scheme} f32): K=4 alpha=0.7 -> "
+          f"{mid.expected_tokens:.2f} tok/dispatch, "
+          f"{mid.ms_per_accepted_token:.3f} ms/accepted token "
+          f"({mid.speedup:.2f}x vs {proj.total_ms:.3f}); latency floor "
+          f"{proj.ici_latency_ms:.3f} ms amortizes over the span "
+          f"(measured accept rate needs a TPU session)", file=sys.stderr)
 
     def row(p):
         return {
@@ -562,6 +585,7 @@ def _project_tp(spec, rank_tp: int, ms: float, baseline: float) -> dict:
         "buffer_modes": {"f32": row(proj), "q80_wire": row(proj80)},
         "schemes_f32": schemes_out,
         "ici_latency_sensitivity_10x": lat10,
+        "speculative_modeled": spec_rows,
     }
 
 

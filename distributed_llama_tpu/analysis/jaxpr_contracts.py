@@ -368,6 +368,94 @@ def contract_decode_shape_stability(spec=None,
         f"compile serves the whole decode", hint)
 
 
+def contract_verify_collectives(spec=None, tp: int = 4,
+                                scheme: str | None = None, k: int = 4,
+                                page_size: int = 16) -> ContractResult:
+    """J001 for the speculative K-query VERIFY dispatch (ISSUE 7): trace
+    tp.make_sharded_verify and pin its collective census to the decode
+    step's — same per-kind COUNTS as one token (the launch amortization
+    the whole feature rests on: K scored positions, one collective
+    schedule) with payload bytes scaled by exactly K
+    (comm_stats.tp_collective_budget(t_len=k)). A verify forward that
+    issued extra collectives — or silently widened a payload beyond the
+    K-row block — would erode the modeled speculative speedup without any
+    bench noticing; this census fails the build instead."""
+    import collections
+
+    import jax
+    import jax.numpy as jnp
+
+    from ..models.llama import init_cache_paged
+    from ..parallel import make_mesh, make_sharded_verify
+    from ..parallel.comm_stats import tp_collective_budget, tp_scheme
+
+    scheme = scheme or tp_scheme()
+    name = f"verify_collectives[{scheme}]"
+    hint = ("the K-query verify dispatch must issue EXACTLY one decode "
+            "step's collective schedule with K-row payloads — a collective "
+            "or payload change must land together with "
+            "parallel/comm_stats.py (tp_collective_budget t_len scaling)")
+    spec = spec or _contract_spec()
+    if len(jax.devices()) < tp:
+        return ContractResult(
+            "J001", name, False,
+            f"needs {tp} devices, have {len(jax.devices())} — set "
+            f"--xla_force_host_platform_device_count", hint)
+    mesh = make_mesh(tp=tp, devices=jax.devices()[:tp])
+    fwd = make_sharded_verify(spec, mesh, page_size, scheme=scheme)
+    params = abstract_params(spec)
+    max_pages = spec.seq_len // page_size
+    cache = jax.eval_shape(lambda: init_cache_paged(
+        spec, max_pages + 1, page_size, jnp.float32))
+    tokens = jax.ShapeDtypeStruct((1, k), jnp.int32)
+    pos = jax.ShapeDtypeStruct((1,), jnp.int32)
+    table = jax.ShapeDtypeStruct((1, max_pages), jnp.int32)
+    jaxpr = jax.make_jaxpr(fwd)(params, cache, tokens, pos, table).jaxpr
+    colls = collect_collectives(jaxpr)
+    if not colls:
+        return ContractResult("J001", name, False,
+                              "no collectives found — jaxpr walk or "
+                              "shard_map internals changed?", hint)
+    budget_1 = tp_collective_budget(spec, tp, scheme)
+    budget_k = tp_collective_budget(spec, tp, scheme, t_len=k)
+    got_counts = collections.Counter()
+    for prim, _, m in colls:
+        got_counts[_collective_kind(prim)] += m
+    unmodeled = sorted(set(got_counts) - set(budget_1.kind_counts()))
+    if unmodeled:
+        return ContractResult(
+            "J001", name, False,
+            f"collective kind(s) {unmodeled} in the verify forward have "
+            f"no comm_stats term for scheme {scheme!r}", hint)
+    if dict(got_counts) != budget_1.kind_counts():
+        return ContractResult(
+            "J001", name, False,
+            f"verify dispatch collective counts {dict(got_counts)} != one "
+            f"decode step's {budget_1.kind_counts()} — the launch "
+            f"amortization is broken", hint)
+    moved = sum(_moved_bytes(_collective_kind(prim), a, tp) * m
+                for prim, a, m in colls)
+    if moved != budget_k.moved_bytes:
+        return ContractResult(
+            "J001", name, False,
+            f"traced verify payload {moved} B/dispatch != analytic "
+            f"{budget_k.moved_bytes} B (= {k} x the per-token budget)",
+            hint)
+    return ContractResult(
+        "J001", name, True,
+        f"{sum(got_counts.values())} collectives ({dict(got_counts)}) — "
+        f"one decode step's schedule for {k} scored positions, payload "
+        f"{moved} B = {k}x per-token (tp={tp}, scheme={scheme})", hint)
+
+
+def contract_verify_collectives_ref(spec=None) -> ContractResult:
+    return contract_verify_collectives(spec, scheme="ref")
+
+
+def contract_verify_collectives_fused(spec=None) -> ContractResult:
+    return contract_verify_collectives(spec, scheme="fused")
+
+
 def contract_tp_collectives_ref(spec=None) -> ContractResult:
     return contract_tp_collectives(spec, scheme="ref")
 
@@ -379,14 +467,20 @@ def contract_tp_collectives_fused(spec=None) -> ContractResult:
 contract_tp_collectives.contract_id = "J001"
 contract_tp_collectives_ref.contract_id = "J001"
 contract_tp_collectives_fused.contract_id = "J001"
+contract_verify_collectives.contract_id = "J001"
+contract_verify_collectives_ref.contract_id = "J001"
+contract_verify_collectives_fused.contract_id = "J001"
 contract_decode_donation.contract_id = "J002"
 contract_decode_donation_paged.contract_id = "J002"
 contract_decode_shape_stability.contract_id = "J003"
 
 # J001 runs once per scheme: BOTH schedules stay pinned regardless of which
-# DLLAMA_TP_SCHEME the current process happens to run under; J002 runs once
-# per cache layout (contiguous + paged), for the same reason
+# DLLAMA_TP_SCHEME the current process happens to run under — for the
+# decode forward AND the speculative K-query verify dispatch; J002 runs
+# once per cache layout (contiguous + paged), for the same reason
 CONTRACTS = (contract_tp_collectives_ref, contract_tp_collectives_fused,
+             contract_verify_collectives_ref,
+             contract_verify_collectives_fused,
              contract_decode_donation, contract_decode_donation_paged,
              contract_decode_shape_stability)
 

@@ -338,16 +338,24 @@ def device_footprint(spec: TransformerSpec, n_slices: int, scheme: str,
                      model: str = "?", batch: int = 1,
                      activation_bytes: int | None = None,
                      device: str = "v5e", kv_page_size: int = 0,
-                     kv_pages: int | None = None) -> MemoryReport:
+                     kv_pages: int | None = None,
+                     spec_k: int = 0) -> MemoryReport:
     """Assemble the per-device report; ``activation_bytes`` overrides the
     analytic bound with a traced live-interval peak when available.
     ``kv_page_size > 0`` charges KV as the paged pool (default pool =
     engine default: byte-parity with ``batch`` contiguous slots, plus the
-    scrap page) instead of ``batch`` contiguous max-seq stripes."""
+    scrap page) instead of ``batch`` contiguous max-seq stripes.
+    ``spec_k > 0`` charges activations and collective staging at the
+    K-query verify width (the speculative dispatch runs batch * spec_k
+    activation rows through every layer — ISSUE 7); weights and KV are
+    unchanged, which is exactly why the verify dispatch is nearly free in
+    HBM terms."""
     from ..parallel.comm_stats import collective_staging_bytes
 
+    t_len = max(1, spec_k)
     if activation_bytes is None:
-        activation_bytes = activation_bytes_analytic(spec, n_slices)
+        activation_bytes = activation_bytes_analytic(spec, n_slices,
+                                                     t_len=t_len)
     if kv_page_size > 0:
         pages = (kv_pages if kv_pages is not None
                  else default_kv_pages(spec, batch, kv_page_size))
@@ -361,5 +369,6 @@ def device_footprint(spec: TransformerSpec, n_slices: int, scheme: str,
         replicated_bytes=replicated_device_bytes(spec),
         kv_cache_bytes=kv_bytes,
         activation_bytes=int(activation_bytes),
-        collective_bytes=collective_staging_bytes(spec, n_slices, scheme),
+        collective_bytes=collective_staging_bytes(spec, n_slices, scheme,
+                                                  t_len=t_len),
         budget_bytes=usable_hbm_bytes(device))

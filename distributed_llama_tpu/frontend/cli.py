@@ -238,6 +238,19 @@ def cmd_inference(argv: list[str], quiet: bool = False) -> int:
                          "slots * seq_len / page-size, byte-parity with "
                          "the contiguous cache; fewer pages serve more "
                          "slots at equal HBM)")
+    ap.add_argument("--spec-k", type=int, default=0, metavar="K",
+                    help="with --continuous and --kv-page-size: "
+                         "self-speculative decoding — draft up to K-1 "
+                         "tokens per row (n-gram prompt lookup, no second "
+                         "model) and verify them with the current token "
+                         "in ONE K-query dispatch; lossless (greedy "
+                         "streams bitwise identical, sampled rows keep "
+                         "the sampler's distribution via rejection "
+                         "sampling). Supersedes --block-steps (0 = off)")
+    ap.add_argument("--spec-ngram", type=int, default=3, metavar="N",
+                    help="longest n-gram the speculative drafter matches "
+                         "against the emitted stream (falls back to "
+                         "shorter n-grams down to 1)")
     ap.add_argument("--kv-cache-dtype", default="f32",
                     choices=("f32", "bf16"),
                     help="KV cache precision: f32 = reference parity "
@@ -405,6 +418,8 @@ def cmd_inference(argv: list[str], quiet: bool = False) -> int:
                                 fast_prefill=args.fast_prefill,
                                 page_size=args.kv_page_size,
                                 kv_pages=args.kv_pages,
+                                spec_k=args.spec_k,
+                                spec_ngram=args.spec_ngram,
                                 metrics=reg)
             if reg is not None:
                 print(reg.expose(), file=sys.stderr, end="")
@@ -418,6 +433,12 @@ def cmd_inference(argv: list[str], quiet: bool = False) -> int:
             print("--metrics has nothing to collect on the lockstep batch "
                   "path; use --continuous for request-lifecycle metrics",
                   file=sys.stderr)
+        if args.spec_k:
+            # same precedent: speculative decoding is a continuous-engine
+            # mode — a silently-dropped flag would read as "no speedup"
+            print("--spec-k only applies to the continuous engine; use "
+                  "--continuous (with --kv-page-size) for speculative "
+                  "decoding", file=sys.stderr)
         generate_batch(spec, params, tokenizer, prompts, args.steps,
                        args.temperature, args.topp, seed,
                        cache_dtype=cache_dtype, mesh=mesh, quiet=quiet)
@@ -578,6 +599,13 @@ def cmd_serve(argv: list[str]) -> int:
                     help="paged-KV pool size in pages (default: "
                          "slots * seq_len / page-size; fewer pages serve "
                          "more slots at equal HBM)")
+    ap.add_argument("--spec-k", type=int, default=0, metavar="K",
+                    help="self-speculative decoding (needs "
+                         "--kv-page-size): n-gram drafts verified K "
+                         "positions per dispatch, lossless; accept rate "
+                         "surfaces in /health and /metrics (0 = off)")
+    ap.add_argument("--spec-ngram", type=int, default=3, metavar="N",
+                    help="longest drafter n-gram (falls back to 1)")
     ap.add_argument("--fast-prefill", action="store_true",
                     help="bf16 matmul precision for admission prefill "
                          "(documented tolerance; decode untouched)")
@@ -636,7 +664,8 @@ def cmd_serve(argv: list[str]) -> int:
                              fast_prefill=args.fast_prefill,
                              metrics=args.metrics,
                              page_size=args.kv_page_size,
-                             kv_pages=args.kv_pages)
+                             kv_pages=args.kv_pages, spec_k=args.spec_k,
+                             spec_ngram=args.spec_ngram)
     endpoints = "POST /generate, GET /health" + (
         ", GET /metrics, GET /debug/timeline, POST /profile"
         if args.metrics else "")

@@ -641,6 +641,119 @@ def forward_batch_paged(spec: TransformerSpec, page_size: int,
                            v4.reshape(L, P, page_size, n_kv, hs))
 
 
+def spec_verify_attention(head_size: int, kv_mul: int, page_size: int,
+                          n_pages: int, q: jax.Array, k: jax.Array,
+                          v: jax.Array, k_all: jax.Array, v_all: jax.Array,
+                          idx, pos: jax.Array, table: jax.Array):
+    """paged_decode_attention widened to K queries per row — the
+    speculative-verify attention (ISSUE 7): row b scores its current token
+    plus K-1 drafted tokens at positions pos_b..pos_b+K-1 in ONE pass,
+    with query i seeing virtual positions 0..pos_b+i (the causal window
+    sequential decode would have seen at that step), so each position's
+    output is BITWISE what K single-token decode steps would produce given
+    the same inputs — the losslessness anchor of runtime/speculative.py.
+
+    q (B, K, n_q*hs); k/v (B, K, n_kv*hs); ``table`` as in
+    paged_decode_attention. K/V writes land per (row, offset-in-window) at
+    the page-table-mapped physical slot; a window position at or past the
+    virtual plane (a row decoding at the budget edge) routes its dead
+    write to the scrap page instead of clamping onto live pages — the same
+    junk-is-invisible contract parked rows rely on. Returns
+    (ao (B, K, n_q*hs), k_all, v_all)."""
+    B, t_len = q.shape[0], q.shape[1]
+    n_kv = k_all.shape[-2]
+    n_q = q.shape[-1] // head_size
+    dt = k_all.dtype
+    k_new = k.reshape(B, t_len, n_kv, head_size).astype(dt)
+    v_new = v.reshape(B, t_len, n_kv, head_size).astype(dt)
+    pos_b = jnp.broadcast_to(jnp.asarray(pos, jnp.int32), (B,))
+    max_pages = table.shape[1]
+    s_virt = max_pages * page_size
+    from ..runtime.paging import SCRAP_PAGE
+
+    # per-(row, window-offset) writes, each in place on the carry — the
+    # same B-updates-not-scatter rationale as paged_decode_attention (B and
+    # K are static, so the loop unrolls at trace time)
+    for b in range(B):
+        for i in range(t_len):
+            p = pos_b[b] + i
+            logical = jnp.minimum(p // page_size, max_pages - 1)
+            page = jnp.where(p < s_virt,
+                             jnp.take(table[b], logical), SCRAP_PAGE)
+            row = idx * n_pages + page
+            k_all = jax.lax.dynamic_update_slice(
+                k_all, k_new[b, i][None, None], (row, p % page_size, 0, 0))
+            v_all = jax.lax.dynamic_update_slice(
+                v_all, v_new[b, i][None, None], (row, p % page_size, 0, 0))
+    rows = (idx * n_pages + table).reshape(-1)            # (B * max_pages,)
+    k_c = jnp.take(k_all, rows, axis=0).reshape(B, s_virt, n_kv, head_size)
+    v_c = jnp.take(v_all, rows, axis=0).reshape(B, s_virt, n_kv, head_size)
+    # (B, K, S): query i of row b sees virtual positions 0..pos_b+i — the
+    # per-step causal windows of sequential decode, stacked
+    q_pos = pos_b[:, None] + jnp.arange(t_len)[None, :]   # (B, K)
+    mask = jnp.arange(s_virt)[None, None, :] <= q_pos[:, :, None]
+    ao = attention_core(head_size, kv_mul,
+                        q.reshape(B, t_len, n_q, head_size), k_c, v_c, mask)
+    return ao, k_all, v_all
+
+
+def forward_batch_spec_paged(spec: TransformerSpec, page_size: int,
+                             params: dict[str, Any], cache: KVCache,
+                             tokens: jax.Array, pos_vec: jax.Array,
+                             table: jax.Array) -> tuple[jax.Array, KVCache]:
+    """The K-query speculative VERIFY step over the paged pool cache.
+
+    forward_batch_paged's sibling for draft verification (ISSUE 7): row b
+    feeds its current token plus K-1 drafted tokens ``tokens[b]`` at
+    positions pos_vec[b]..pos_vec[b]+K-1 and gets ALL K next-token logit
+    rows from ONE dispatch — the collective-latency amortization lever (a
+    dispatch pays the per-layer collective schedule once whether it scores
+    1 or K positions; comm_stats.tp_collective_budget(t_len=K) models it).
+
+    tokens (B, K) int32; pos_vec (B,); returns (logits (B, K, vocab), cache).
+    Everything except attention treats the B*K query rows as a flat batch
+    through the SAME _qkv_proj/_post_attention blocks as decode, so logits
+    at position i are bitwise the single-token decode logits given the
+    same history — rejected-suffix KV lands beyond the accepted rollback
+    point and is masked/overwritten, never read (runtime/continuous.py
+    truncates the page table back to the accepted length host-side).
+    jit with (spec, page_size) static and the cache donated (J002 holds:
+    the rank-4 page-plane view rides the scan carry in place).
+    """
+    B, K = tokens.shape
+    x = params["tok_embedding"][tokens.reshape(-1)].astype(jnp.float32)
+    pos_b = jnp.broadcast_to(jnp.asarray(pos_vec, jnp.int32), (B,))
+    positions = (pos_b[:, None]
+                 + jnp.arange(K, dtype=jnp.int32)[None, :]).reshape(-1)
+    n_kv, hs, kv_mul = spec.n_kv_heads, spec.head_size, spec.kv_mul
+    L, P = spec.n_layers, cache.k.shape[1]
+
+    k4 = cache.k.reshape(L * P, page_size, n_kv, hs)
+    v4 = cache.v.reshape(L * P, page_size, n_kv, hs)
+
+    stacked, scanned = split_layer_weights(params)
+
+    def scan_body(carry, per_layer):
+        x, k_all, v_all = carry
+        idx, lw_slice = per_layer
+        lw = layer_view(stacked, lw_slice, idx)
+        q, k, v = _qkv_proj(spec, lw, x, positions)        # (B*K, ...)
+        ao, k_all, v_all = spec_verify_attention(
+            hs, kv_mul, page_size, P, q.reshape(B, K, -1),
+            k.reshape(B, K, -1), v.reshape(B, K, -1), k_all, v_all, idx,
+            pos_b, table)
+        x = _post_attention(spec, lw, x, ao.reshape(B * K, -1))
+        return (x, k_all, v_all), None
+
+    idxs = jnp.arange(L, dtype=jnp.int32)
+    (x, k4, v4), _ = jax.lax.scan(scan_body, (x, k4, v4), (idxs, scanned))
+    x = rmsnorm(x, params["rms_final"])
+    logits = matmul(params["wcls"], x)                     # (B*K, vocab)
+    return (logits.reshape(B, K, -1),
+            KVCache(k4.reshape(L, P, page_size, n_kv, hs),
+                    v4.reshape(L, P, page_size, n_kv, hs)))
+
+
 def gather_pages(cache: KVCache, table: jax.Array,
                  page_size: int) -> KVCache:
     """Materialize one slot's virtual (L, S, n_kv, hs) sequence cache from

@@ -106,6 +106,17 @@ class EngineMetrics:
             "dllama_prefill_tokens_saved_total",
             "Prefill positions skipped because their pages were shared "
             "from the radix tree")
+        # speculative-decoding instruments (spec_k > 0 engines move them;
+        # plain engines expose them at zero — layout-invariant scrape
+        # surface, same contract as the paged-KV series above)
+        self.spec_proposed = c(
+            "dllama_spec_proposed_total",
+            "Draft tokens proposed by the n-gram self-drafter and fed to "
+            "a verify dispatch (runtime/speculative.py)")
+        self.spec_accepted = c(
+            "dllama_spec_accepted_total",
+            "Draft tokens the verify forward accepted (greedy exact "
+            "match, or the rejection-sampling accept at temperature > 0)")
         # per-scheme collective series, bound by bind_collectives() when
         # the engine runs sharded: [(launch counter, byte counter,
         # launches/step, bytes/step)] — empty (and never touched) at tp=1

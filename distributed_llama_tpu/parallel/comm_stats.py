@@ -102,7 +102,8 @@ class CollectiveBudget:
 
 
 def tp_collective_budget(spec: TransformerSpec, n_slices: int,
-                         scheme: str | None = None) -> CollectiveBudget:
+                         scheme: str | None = None,
+                         t_len: int = 1) -> CollectiveBudget:
     """Per-chip/token collective schedule of the tp forward, per scheme.
 
     Ring accounting (S = n_slices, b = per-shard payload bytes):
@@ -119,6 +120,17 @@ def tp_collective_budget(spec: TransformerSpec, n_slices: int,
     (int8 codes + f16 deltas, tp._wire_gather); reduce halves stay f32 —
     partial sums cannot ride the wire quantized without compounding each
     shard's rounding error into the total.
+
+    ``t_len`` widens every activation payload to t_len query rows while
+    the COUNTS stay the one-step schedule — the speculative K-query verify
+    dispatch (models/llama.forward_batch_spec_paged / tp.
+    make_sharded_verify): every cut moves a (t_len, width) block through
+    the same per-layer collectives one decode step issues, so bytes scale
+    by exactly t_len (the logits gather included) and launches do not.
+    That launches-don't-scale property IS the speculative amortization
+    (shard_sim.FullSystemProjection.speculative), and J001's verify
+    census (analysis/jaxpr_contracts.contract_verify_collectives) pins
+    the traced program to this scaling.
     """
     scheme = scheme or tp_scheme()
     if scheme not in SCHEMES:
@@ -126,23 +138,23 @@ def tp_collective_budget(spec: TransformerSpec, n_slices: int,
     if n_slices <= 1:
         return CollectiveBudget(())
     ft = spec.buffer_float_type
-    s, L = n_slices, spec.n_layers
-    logits_bytes = (s - 1) * _vb(FloatType.F32, spec.vocab_size // s)
+    s, L, t = n_slices, spec.n_layers, t_len
+    logits_bytes = t * (s - 1) * _vb(FloatType.F32, spec.vocab_size // s)
     if scheme == "ref":
-        per_layer = (s - 1) * (3 * _vb(ft, spec.dim // s)
-                               + _vb(ft, spec.hidden_dim // s))
+        per_layer = t * (s - 1) * (3 * _vb(ft, spec.dim // s)
+                                   + _vb(ft, spec.hidden_dim // s))
         return CollectiveBudget(
             (("all_gather", 4 * L + 1, L * per_layer + logits_bytes),))
     # fused: wo/w2 row-parallel — one combine per block, 2 blocks/layer,
     # both of width dim (attention out and ffn out are residual-stream
     # vectors; hidden_dim never crosses the wire in this scheme)
     if ft == FloatType.Q80:
-        rs_bytes = 2 * L * (s - 1) * (spec.dim // s) * 4
-        ag_bytes = 2 * L * (s - 1) * _vb(FloatType.Q80, spec.dim // s)
+        rs_bytes = t * 2 * L * (s - 1) * (spec.dim // s) * 4
+        ag_bytes = t * 2 * L * (s - 1) * _vb(FloatType.Q80, spec.dim // s)
         return CollectiveBudget(
             (("reduce_scatter", 2 * L, rs_bytes),
              ("all_gather", 2 * L + 1, ag_bytes + logits_bytes)))
-    psum_bytes = 2 * L * 2 * (s - 1) * (spec.dim // s) * 4
+    psum_bytes = t * 2 * L * 2 * (s - 1) * (spec.dim // s) * 4
     return CollectiveBudget(
         (("psum", 2 * L, psum_bytes),
          ("all_gather", 1, logits_bytes)))
@@ -164,8 +176,11 @@ def collective_staging_bytes(spec: TransformerSpec, n_slices: int,
       fused  f32 psum / psum_scatter payloads of dim width (partial sums
              never ride the wire quantized) + the f32 logits gather.
 
-    ``t_len`` scales the activation-vector cuts for prefill-shaped traffic
-    (decode is t_len=1). Zero when n_slices == 1 — no wire, no staging.
+    ``t_len`` scales every payload — the activation-vector cuts AND the
+    logits gather — for multi-query traffic: prefill chunks and the
+    speculative K-query verify dispatch both assemble (t_len, width)
+    blocks at each cut (decode is t_len=1). Zero when n_slices == 1 — no
+    wire, no staging.
     """
     scheme = scheme or tp_scheme()
     if scheme not in SCHEMES:
@@ -173,7 +188,7 @@ def collective_staging_bytes(spec: TransformerSpec, n_slices: int,
     if n_slices <= 1:
         return 0
     ft = spec.buffer_float_type
-    logits = _vb(FloatType.F32, spec.vocab_size)
+    logits = t_len * _vb(FloatType.F32, spec.vocab_size)
     if scheme == "ref":
         payloads = (t_len * _vb(ft, spec.dim),
                     t_len * _vb(ft, spec.hidden_dim), logits)

@@ -78,7 +78,7 @@ from ..io.loader import Q40Kernel, Q40Weight
 from ..models.llama import (KVCache, attention_core, batch_decode_attention,
                             causal_cache_mask, layer_view,
                             paged_decode_attention, rope_rotate,
-                            split_layer_weights)
+                            spec_verify_attention, split_layer_weights)
 from ..models.spec import TransformerSpec
 # canonical trace-scope names (obs/spans.py): every phase and collective
 # scope this forward emits is a name the xprof loader buckets by — the
@@ -707,6 +707,85 @@ def make_sharded_forward_batch_paged(spec: TransformerSpec, mesh: Mesh,
         return logits, KVCache(
             k4.reshape(L, n_pages_out, page_size, kv_loc, hs),
             v4.reshape(L, n_pages_out, page_size, kv_loc, hs))
+
+    def wrap(params, cache, tokens, pos, table):
+        in_specs = (param_specs(params, scheme), CACHE_SPEC_PAGED, P(), P(),
+                    P())
+        out_specs = (P(), CACHE_SPEC_PAGED)
+        fn = _shard_map(local_step, mesh=mesh, in_specs=in_specs,
+                        out_specs=out_specs)
+        return fn(params, cache, tokens, pos, table)
+
+    return jax.jit(wrap, donate_argnums=1)
+
+
+def make_sharded_verify(spec: TransformerSpec, mesh: Mesh, page_size: int,
+                        scheme: str | None = None):
+    """Tensor-parallel K-query speculative VERIFY step (ISSUE 7):
+    make_sharded_forward_batch_paged's sibling scoring each row's current
+    token plus K-1 drafts in ONE dispatch (models/llama.
+    forward_batch_spec_paged semantics, per-shard over the LOCAL kv heads).
+
+    Returns fn(params, cache, tokens (B, K), pos (B,), table (B, S/ps))
+    -> (logits (B, K, vocab), cache). Works under BOTH collective schemes:
+    the B*K query rows ride the layer tail as a flat activation batch, so
+    the dispatch issues EXACTLY one decode step's per-layer collective
+    schedule (the J001 verify census, contract_verify_collectives) with
+    K-times the activation payload — per-collective launch latency, the
+    dominant multi-chip term, is paid once for K scored positions. sp > 1
+    is rejected as in the paged decode factory.
+    """
+    n_slices = mesh.shape["tp"]
+    n_sp = mesh.shape.get("sp", 1)
+    if n_sp > 1:
+        raise ValueError(f"speculative verify requires sp=1, got sp={n_sp} "
+                         f"(page tables break contiguous sequence chunks)")
+    scheme = scheme or tp_scheme()
+    validate_sharding(spec, mesh)
+    if spec.seq_len % page_size:
+        raise ValueError(f"page_size={page_size} must divide "
+                         f"seq_len={spec.seq_len}")
+    kv_loc = spec.n_kv_heads // n_slices
+    L, hs = spec.n_layers, spec.head_size
+
+    def local_step(params, cache, tokens, pos, table):
+        B, K = tokens.shape
+        with jax.named_scope(SCOPE_EMBED):
+            x = params["tok_embedding"][
+                tokens.reshape(-1)].astype(jnp.float32)       # (B*K, d)
+        pos_b = jnp.broadcast_to(jnp.asarray(pos, jnp.int32), (B,))
+        positions = (pos_b[:, None]
+                     + jnp.arange(K, dtype=jnp.int32)[None, :]).reshape(-1)
+        n_pages = cache.k.shape[1]
+        k4 = cache.k.reshape(L * n_pages, page_size, kv_loc, hs)
+        v4 = cache.v.reshape(L * n_pages, page_size, kv_loc, hs)
+        stacked, scanned = split_layer_weights(params)
+
+        def body(carry, per_layer):
+            x, k_all, v_all = carry
+            idx, lw_slice = per_layer
+            with jax.named_scope(SCOPE_LAYER):
+                lw = layer_view(stacked, lw_slice, idx)
+                with jax.named_scope(SCOPE_ATTN):
+                    q, k, v = _tp_qkv(spec, n_slices, lw, x, positions)
+                    ao, k_all, v_all = spec_verify_attention(
+                        hs, spec.kv_mul, page_size, n_pages,
+                        q.reshape(B, K, -1), k.reshape(B, K, -1),
+                        v.reshape(B, K, -1), k_all, v_all, idx, pos_b,
+                        table)
+                x = _tp_tail(spec, x, lw, ao.reshape(B * K, -1),
+                             scheme=scheme)
+            return (x, k_all, v_all), None
+
+        idxs = jnp.arange(L, dtype=jnp.int32)
+        (x, k4, v4), _ = jax.lax.scan(body, (x, k4, v4), (idxs, scanned))
+        with jax.named_scope(SCOPE_LOGITS):
+            x = rmsnorm(x, params["rms_final"])
+            logits = _gather(matmul(params["wcls"], x))       # (B*K, V)
+        n_pages_out = k4.shape[0] // L
+        return (logits.reshape(B, K, -1), KVCache(
+            k4.reshape(L, n_pages_out, page_size, kv_loc, hs),
+            v4.reshape(L, n_pages_out, page_size, kv_loc, hs)))
 
     def wrap(params, cache, tokens, pos, table):
         in_specs = (param_specs(params, scheme), CACHE_SPEC_PAGED, P(), P(),

@@ -111,6 +111,11 @@ class ContinuousStats:
     total_ms: float = 0.0
     max_active: int = 0
     sum_active: int = 0      # sum of active slots over device steps
+    # speculative decoding (spec_k > 0): drafter proposals fed to verify
+    # dispatches and how many the model accepted — the accept-rate /
+    # ms-per-accepted-token bench columns (ISSUE 7)
+    spec_proposed: int = 0
+    spec_accepted: int = 0
 
     @property
     def tokens_per_s(self) -> float:
@@ -122,6 +127,11 @@ class ContinuousStats:
         entering a fused chain count for its whole span) — the
         continuous_bench column paged KV exists to move."""
         return self.sum_active / max(self.steps, 1)
+
+    @property
+    def spec_accept_rate(self) -> float:
+        """Accepted / proposed drafts (0.0 before any proposal)."""
+        return self.spec_accepted / max(self.spec_proposed, 1)
 
 
 class ContinuousEngine:
@@ -137,14 +147,16 @@ class ContinuousEngine:
                  block_steps: int = 1, use_native_sampler: bool = True,
                  fast_prefill: bool = False, metrics=None,
                  page_size: int = 0, kv_pages: int = 0,
-                 prefix_share: bool = True):
+                 prefix_share: bool = True, spec_k: int = 0,
+                 spec_ngram: int = 3):
         import functools
 
         import jax
         import jax.numpy as jnp
 
         from ..models.llama import (forward_batch_paged,
-                                    forward_batch_ragged, gather_pages,
+                                    forward_batch_ragged,
+                                    forward_batch_spec_paged, gather_pages,
                                     init_cache_batch, init_cache_paged,
                                     params_to_device, scatter_pages)
 
@@ -183,6 +195,28 @@ class ContinuousEngine:
             # step and shipped as ONE upload; free/short rows park their
             # tail on the scrap page
             self._stage_tbl = np.zeros((slots, self._max_pages), np.int32)
+        # self-speculative decoding (ISSUE 7): each scheduler iteration
+        # drafts up to spec_k - 1 tokens per row (runtime/speculative.py
+        # n-gram lookup) and verifies them with current-token + drafts in
+        # ONE K-query dispatch — the per-dispatch collective schedule is
+        # paid once for up to spec_k emitted tokens. Needs the paged cache:
+        # rejected-suffix KV rolls back by truncating the page table.
+        self.spec_k = spec_k
+        self.spec_ngram = spec_ngram
+        if spec_k:
+            if spec_k < 2:
+                raise ValueError(f"spec_k={spec_k}: the verify window is "
+                                 f"current token + K-1 drafts, so K >= 2 "
+                                 f"(K=0 disables)")
+            if page_size <= 0:
+                raise ValueError(
+                    "spec_k requires the paged KV cache (pass "
+                    "--kv-page-size with --spec-k): acceptance rollback "
+                    "truncates the page-table logical length")
+            # persistent (slots, K) verify-window staging block: the K
+            # input tokens per row ride ONE int32 upload per dispatch
+            # (dlint D004), exactly like the chain's staged_i32 rows
+            self._stage_spec = np.zeros((slots, spec_k), np.int32)
         # multi-host SPMD runs MUST pin the numpy sampler: native and numpy
         # can differ by float ulps across libm builds (sampling.Sampler
         # docstring), and divergent hosts feed different tokens into the
@@ -212,9 +246,9 @@ class ContinuousEngine:
             from ..parallel import (make_sharded_forward,
                                     make_sharded_forward_batch,
                                     make_sharded_forward_batch_paged,
-                                    shard_cache, shard_cache_batch,
-                                    shard_cache_paged, shard_params,
-                                    validate_sharding)
+                                    make_sharded_verify, shard_cache,
+                                    shard_cache_batch, shard_cache_paged,
+                                    shard_params, validate_sharding)
             from ..parallel.comm_stats import tp_scheme
 
             scheme = tp_scheme()  # one resolution: decode + prefill +
@@ -225,6 +259,9 @@ class ContinuousEngine:
                 # +1 physical page: the reserved scrap page 0
                 self._step = make_sharded_forward_batch_paged(
                     spec, mesh, page_size, scheme=scheme)  # rejects sp>1
+                if spec_k:
+                    self._verify_base = make_sharded_verify(
+                        spec, mesh, page_size, scheme=scheme)
                 self.cache = shard_cache_paged(
                     init_cache_paged(spec, self._alloc.n_pages + 1,
                                      page_size, dtype), mesh)
@@ -249,6 +286,10 @@ class ContinuousEngine:
                 self._step = jax.jit(
                     functools.partial(forward_batch_paged, spec, page_size),
                     donate_argnums=1)
+                if spec_k:
+                    self._verify_base = jax.jit(
+                        functools.partial(forward_batch_spec_paged, spec,
+                                          page_size), donate_argnums=1)
             else:
                 self.cache = init_cache_batch(spec, slots, dtype)
                 self._step = jax.jit(
@@ -388,6 +429,183 @@ class ContinuousEngine:
         self._chains[key] = jax.jit(chain, donate_argnums=1)
         return self._chains[key]
 
+    # -- speculative decoding (spec_k > 0) ----------------------------------
+
+    def _verify_program(self, greedy_only: bool):
+        """The jitted K-query verify dispatch (built once per variant).
+        The base program scores all K window positions; when EVERY active
+        row is greedy the wrapper argmaxes ON DEVICE and ships a (B, K)
+        int32 block instead of the f32 logit cube (decode.
+        greedy_verify_tokens) — the same transfer cut the fused chain's
+        greedy_only branch makes. Mixed/sampled pools ship full logits:
+        rejection-sampling acceptance needs whole distributions with the
+        host Sampler's exact semantics."""
+        import jax
+
+        key = ("spec", greedy_only)
+        if key in self._chains:
+            return self._chains[key]
+        if self._obs is not None:  # verify-shape cache miss: a new trace
+            self._obs.compile_events.inc()
+        base = self._verify_base
+
+        from .decode import greedy_verify_tokens
+
+        def run(params, cache, tokens, pos, table):
+            logits, cache = base(params, cache, tokens, pos, table)
+            out = greedy_verify_tokens(logits) if greedy_only else logits
+            return out, cache
+
+        self._chains[key] = jax.jit(run, donate_argnums=1)
+        return self._chains[key]
+
+    def step_spec(self, quiet: bool = True) -> int:
+        """One draft → verify → accept iteration over the pool (ISSUE 7).
+
+        Each active row feeds [current token | window] where the window is
+        its pending FORCED tokens first (prompt replay — guaranteed to
+        match, so the dispatch doubles as K-wide prompt chunking), then up
+        to K-1 n-gram drafts (runtime/speculative.draft_tokens). The
+        K-query verify forward scores every window position in ONE
+        dispatch; the host replay applies exactly step_once's bookkeeping
+        per position (forced pops, sampler/argmax, BOS + budget stops via
+        _advance) and stops at the first position whose outcome differs
+        from the fed input — later logits were conditioned on a wrong
+        token. Greedy rows accept drafts by exact argmax match, so the
+        emitted stream is BITWISE the spec-off stream; sampled rows run
+        Leviathan rejection sampling (speculative.accept_or_resample) —
+        coin-stream alignment: each resolved draft position draws its
+        accept coin (plus one residual-resample coin on rejection), and
+        positions never reached consume NO coin, so a seeded engine
+        replays deterministically. Rejected-suffix KV is discarded by
+        rolling the page table back to the accepted length (_trim_pages)
+        — pages whose only content was rejected tokens return to the
+        pool. Returns active slots after the iteration."""
+        jnp = self.jnp
+        K = self.spec_k
+        from .speculative import accept_or_resample, draft_tokens
+
+        self._admit()
+        pool = self._pool
+        paused = self._grow_pages(pool, K, quiet)
+        if all(s.free for s in pool):
+            return 0
+        st = self._stage_spec
+        st_pos = self._stage_i32  # row 1 = per-slot positions, as ever
+        active0 = self._stage_active
+        kinds: list = [()] * self.slots  # window entry i (= input i+1):
+        #                                   'f' forced | 'd' drafted
+        greedy_only = True
+        for b, s in enumerate(pool):
+            active0[b] = not s.free and b not in paused
+            st[b, 0] = s.token
+            st[b, 1:] = 0
+            st_pos[1, b] = s.pos
+            if not active0[b]:
+                continue
+            if s.sampler.temperature != 0.0:
+                greedy_only = False
+            window = list(s.forced[:K - 1])
+            row_kinds = ["f"] * len(window)
+            room = K - 1 - len(window)
+            if room > 0 and not s.forced[K - 1:]:
+                # drafting starts only past the forced prompt; the lookup
+                # history is the emitted stream plus the forced tokens fed
+                # ahead of the drafts in THIS window
+                history = [s.req.tokens[0]] + s.req.out + window
+                drafts = draft_tokens(history, room, max_n=self.spec_ngram)
+                self.stats.spec_proposed += len(drafts)
+                if self._obs is not None:
+                    self._obs.spec_proposed.inc(len(drafts))
+                window += [int(t) for t in drafts]
+                row_kinds += ["d"] * len(drafts)
+            for i, t in enumerate(window):
+                st[b, 1 + i] = t
+            kinds[b] = tuple(row_kinds)
+        n_active0 = int(active0.sum())
+        table = self._stage_tables()
+        run = self._verify_program(greedy_only)
+        t0 = time.monotonic() if self._obs is not None else 0.0
+        with self._span("verify", "decode", k=K, active=n_active0):
+            out, cache = run(self.params, self.cache, jnp.asarray(st),
+                             jnp.asarray(st_pos[1]), table)
+            self.cache = cache
+            out = np.asarray(out)  # dlint: allow[D001] host replay reads ids/logits
+            if self._obs is not None:
+                # the sync flag additionally drains the donated cache
+                # write (obs/trace.sync_device_timing)
+                if self._obs.sync:
+                    import jax
+
+                    jax.block_until_ready(self.cache)  # dlint: allow[D001] opt-in timing drain
+                self._obs.record_step(time.monotonic() - t0, n_active0)
+                if self._alloc is not None:
+                    self._obs.kv_pages_free.set(self._alloc.n_free)
+        self.stats.steps += 1
+        self.stats.sum_active += n_active0
+        self.stats.max_active = max(self.stats.max_active, n_active0)
+        # host replay: exactly step_once's per-position bookkeeping over
+        # the accepted prefix of each row's window
+        for b, s in enumerate(pool):
+            if s.free:
+                continue
+            if s.req.cancelled:  # consumer vanished during the dispatch
+                self._retire(s, quiet)
+                continue
+            if not active0[b]:
+                continue
+            row_kinds = kinds[b]
+            retired = False
+            for i in range(K):
+                accepted_draft = False
+                if s.forced:
+                    nxt, sampled = s.forced.pop(0), False
+                elif s.sampler.temperature == 0.0:
+                    nxt = (int(out[b, i]) if greedy_only
+                           else int(np.argmax(
+                               out[b, i][:self.spec.vocab_size])))
+                    sampled = True
+                    accepted_draft = (i < len(row_kinds)
+                                      and row_kinds[i] == "d"
+                                      and nxt == int(st[b, i + 1]))
+                elif i < len(row_kinds) and row_kinds[i] == "d":
+                    nxt, accepted_draft = accept_or_resample(
+                        out[b, i], int(st[b, i + 1]), s.sampler)
+                    sampled = True
+                else:  # no draft fed here: the plain sampler path
+                    nxt, sampled = int(s.sampler.sample(out[b, i])), True
+                if accepted_draft:
+                    self.stats.spec_accepted += 1
+                    if self._obs is not None:
+                        self._obs.spec_accepted.inc()
+                if self._advance(s, nxt, quiet, sampled=sampled):
+                    retired = True
+                    break
+                if (i + 1 >= K or i >= len(row_kinds)
+                        or nxt != int(st[b, i + 1])):
+                    break  # window exhausted, or the fed input was wrong —
+                #            logits[i+1] were conditioned on a bad token
+            if not retired:
+                self._trim_pages(s)
+        self._admit()
+        return sum(not s.free for s in pool)
+
+    def _trim_pages(self, s: _Slot) -> None:
+        """Speculative rollback: drop a slot's trailing pages past the
+        accepted position. After a verify dispatch, positions >= s.pos may
+        hold rejected-draft KV; positions 0..s.pos-1 are live and position
+        s.pos is rewritten by the next dispatch before anything reads it,
+        so pages covering ONLY positions >= s.pos return to the pool
+        (refcounted: a page the radix tree also holds just drops this
+        slot's ref). The shared prefix always survives — s.pos never
+        rolls below the share boundary."""
+        keep = max(self._alloc.pages_for(s.pos), s.shared)
+        if len(s.pages) > keep:
+            self._alloc.release_pages(s.pages[keep:])
+            del s.pages[keep:]
+            if self._obs is not None:
+                self._obs.kv_pages_free.set(self._alloc.n_free)
+
     # -- paged-KV bookkeeping (page_size > 0) -------------------------------
 
     def _ensure_pages(self, s: _Slot, n_positions: int) -> bool:
@@ -395,7 +613,9 @@ class ContinuousEngine:
         positions, evicting idle radix leaves when the free list is dry
         (paging.PagedAllocator.alloc_page). False = the pool cannot cover
         it even after eviction — the caller fails or requeues the
-        request. Never shrinks: pages free only at retire."""
+        request. Never shrinks here: pages free at retire, or via the
+        speculative rollback (_trim_pages) when a verify dispatch rejects
+        a drafted suffix."""
         need = self._alloc.pages_for(min(n_positions, self.spec.seq_len))
         while len(s.pages) < need:
             pid = self._alloc.alloc_page()
@@ -464,6 +684,12 @@ class ContinuousEngine:
         shipped configs, but an XLA or libm change could flip a
         knife-edge coin. temperature == 0 (argmax) is exact by
         construction."""
+        if self.spec_k:
+            # speculative mode: every scheduler iteration IS a fused
+            # multi-position dispatch (draft → one K-query verify), so the
+            # spec path supersedes block-step chaining — chaining verifies
+            # would stack drafts on unverified drafts
+            return self.step_spec(quiet=quiet)
         if k <= 1:
             return self.step_once(quiet=quiet)
         jnp = self.jnp
@@ -964,7 +1190,8 @@ def generate_continuous(spec: TransformerSpec, params: dict[str, Any],
                         prefill_chunk: int = 0, block_steps: int = 1,
                         quiet: bool = False, use_native_sampler: bool = True,
                         fast_prefill: bool = False, metrics=None,
-                        page_size: int = 0, kv_pages: int = 0):
+                        page_size: int = 0, kv_pages: int = 0,
+                        spec_k: int = 0, spec_ngram: int = 3):
     """CLI entry: encode prompts, stream them through a slot pool, print
     rows in the --prompts-file format ("[i] 'text'")."""
     reqs = [tokenizer.encode(p or "", bos=True, eos=False) for p in prompts]
@@ -975,7 +1202,8 @@ def generate_continuous(spec: TransformerSpec, params: dict[str, Any],
                            block_steps=block_steps,
                            use_native_sampler=use_native_sampler,
                            fast_prefill=fast_prefill, metrics=metrics,
-                           page_size=page_size, kv_pages=kv_pages)
+                           page_size=page_size, kv_pages=kv_pages,
+                           spec_k=spec_k, spec_ngram=spec_ngram)
     outs, stats = eng.run(reqs, steps, quiet=quiet)
     for b, (req, row) in enumerate(zip(reqs, outs)):
         if not quiet:
@@ -992,4 +1220,10 @@ def generate_continuous(spec: TransformerSpec, params: dict[str, Any],
                   f"{a.page_size} positions, {a.n_free} free; prefix hit "
                   f"rate {a.hit_rate:.0%}, {a.tokens_saved} prefill "
                   f"tokens saved, {a.evictions} evictions")
+        if eng.spec_k:
+            print(f"Speculative:         K={eng.spec_k}, "
+                  f"{stats.spec_accepted}/{stats.spec_proposed} drafts "
+                  f"accepted ({stats.spec_accept_rate:.0%}); "
+                  f"{stats.total_ms / max(1, stats.tokens):.2f} "
+                  f"ms/accepted token over {stats.steps} verify dispatches")
     return outs, stats

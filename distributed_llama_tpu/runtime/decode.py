@@ -102,6 +102,17 @@ def sample_device_dynamic(logits: jax.Array, coin: jax.Array,
                                _mult_walk(probs, coin)))
 
 
+def greedy_verify_tokens(logits: jax.Array) -> jax.Array:
+    """Device-side argmax over a (B, K, V) speculative-verify logit block
+    (runtime/continuous.step_spec): when EVERY active row is greedy the
+    host replay needs only the argmax ids, so the chain ships a (B, K)
+    int32 block instead of the full f32 logit cube — the same transfer cut
+    the fused chain's greedy_only branch makes. Ties break lowest-index,
+    matching np.argmax in the host sampler (sample_argmax), so the greedy
+    bitwise-parity contract is unchanged."""
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+
 def _make_decode_run(step_fn: StepFn, max_steps: int, temperature: float,
                      topp: float):
     """Build run(params, cache, prompt_padded, first_token, coins,

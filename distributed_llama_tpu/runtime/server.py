@@ -15,8 +15,10 @@ Endpoints:
                decodes, then a final {"done": true, "text": ..., "steps": N}
   GET  /health -> {"active": int, "queued": int, "slots": int,
                    "steps": int, "generated_tokens": int, "uptime_s",
-                   "occupancy", and (metrics on) "ttft_s"/"token_latency_s"/
-                   "queue_wait_s" p50/p95/p99 summaries}
+                   "occupancy", (--spec-k on) a "speculative" block with
+                   proposed/accepted/accept_rate, and (metrics on)
+                   "ttft_s"/"token_latency_s"/"queue_wait_s" p50/p95/p99
+                   summaries}
   GET  /metrics -> Prometheus text exposition of the obs registry (request
                lifecycle histograms, engine step/occupancy, counters, and
                the per-scheme collective schedule series)
@@ -59,7 +61,8 @@ class InferenceServer:
                  cache_dtype=None, mesh=None, prefill_chunk: int = 0,
                  block_steps: int = 1, quiet: bool = False,
                  fast_prefill: bool = False, metrics: bool = True,
-                 registry=None, page_size: int = 0, kv_pages: int = 0):
+                 registry=None, page_size: int = 0, kv_pages: int = 0,
+                 spec_k: int = 0, spec_ngram: int = 3):
         self.spec = spec
         self.tokenizer = tokenizer
         self.default_steps = steps
@@ -83,7 +86,8 @@ class InferenceServer:
                                        fast_prefill=fast_prefill,
                                        metrics=self.registry,
                                        page_size=page_size,
-                                       kv_pages=kv_pages)
+                                       kv_pages=kv_pages, spec_k=spec_k,
+                                       spec_ngram=spec_ngram)
         self._shutdown = threading.Event()
         server = self
 
@@ -140,6 +144,15 @@ class InferenceServer:
                     "uptime_s": round(time.monotonic() - server._t_start, 3),
                     "occupancy": round(active / eng.slots, 4),
                 }
+                if eng.spec_k:
+                    # speculative decoding health (ISSUE 7): proposal
+                    # volume + accept rate of the n-gram self-drafter
+                    payload["speculative"] = {
+                        "k": eng.spec_k,
+                        "proposed": eng.stats.spec_proposed,
+                        "accepted": eng.stats.spec_accepted,
+                        "accept_rate": round(eng.stats.spec_accept_rate, 4),
+                    }
                 if server.registry is not None:
                     for key, name in (
                             ("ttft_s", "dllama_request_ttft_seconds"),

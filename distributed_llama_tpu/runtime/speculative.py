@@ -1,0 +1,153 @@
+"""Self-speculative decoding: n-gram drafter + lossless acceptance rules.
+
+BENCH_r05 pinned a hard per-token collective-latency floor the fused tp
+scheme cannot remove (13b-tp8: 1.13 ms/token of all-gather hop latency
+across 161 collectives — 15% of the projection, dominant on worse
+interconnects). Speculative decoding (Leviathan et al. 2023) amortizes it:
+draft K-1 cheap guesses, score current-token + drafts in ONE K-query
+dispatch (models/llama.forward_batch_spec_paged), keep the longest prefix
+the real model agrees with — each dispatch pays the per-layer collective
+schedule once for up to K emitted tokens.
+
+The drafter is prompt-lookup / n-gram self-drafting (Saxena 2023): the
+proposal for "what comes next" is whatever followed the most recent earlier
+occurrence of the stream's final n-gram. No second model, no extra
+weights — exactly right for a reproduction that ships one checkpoint, and
+strong on the repetitive structure real decodes (and the reference's
+greedy loops) exhibit.
+
+Losslessness contract (the tier-1 gate of tests/test_speculative.py):
+
+* greedy rows (temperature 0): a draft is accepted iff it equals the
+  verified argmax — the emitted stream is BITWISE the spec-off stream by
+  construction (the verify forward reproduces decode logits bitwise);
+* sampled rows (temperature > 0): Leviathan-style rejection sampling
+  against the sampler's EFFECTIVE distribution (temperature softmax +
+  the reference's nucleus filter, ``effective_probs``). The n-gram
+  drafter is a point mass q = one-hot(draft), so accept with probability
+  p(draft); on rejection resample from the residual norm(max(0, p - q)) =
+  p with the draft zeroed, renormalized. Combined law: P(x = draft) =
+  p(draft), P(x = y) = (1 - p(draft)) * p(y)/(1 - p(draft)) = p(y) — the
+  output DISTRIBUTION is provably the baseline sampler's (the coin
+  stream realization necessarily differs; temperature-0 keeps bitwise
+  stream parity).
+
+Everything here is host-side numpy over one row's logits — the device half
+is the K-query verify forward; the engine half (draft window assembly,
+replay, page-table rollback) lives in runtime/continuous.step_spec.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .sampling import Sampler, sample_mult, softmax_f32
+
+
+def draft_tokens(history, k: int, max_n: int = 3, min_n: int = 1) -> list:
+    """Prompt-lookup proposal: up to ``k`` tokens copied from after an
+    earlier occurrence of the stream's final n-gram.
+
+    Tries the longest n-gram first (``max_n`` down to ``min_n``) — longer
+    context matches give higher-precision continuations; the n=1 fallback
+    keeps the drafter productive on short histories. Among matches of one
+    n-gram length, the NEAREST one whose continuation fills the whole
+    window wins (recency = relevance), falling back to the longest
+    continuation available — matches near the stream's end truncate, so
+    a short-period repetition (the greedy-loop shape) would otherwise
+    never fill the window. Returns [] when no earlier occurrence exists
+    (the verify dispatch then scores only real positions).
+    O(len(history) * n) per candidate length via a backwards scan —
+    histories are bounded by seq_len, and this runs once per dispatch,
+    not per token.
+    """
+    if k <= 0:
+        return []
+    h = list(history)
+    for n in range(max_n, min_n - 1, -1):
+        if len(h) <= n:
+            continue
+        tail = h[-n:]
+        best: list = []
+        # windows equal to the tail, ending BEFORE the stream's end —
+        # j + n <= len(h) - 1, so a match's continuation is never empty
+        for j in range(len(h) - n - 1, -1, -1):
+            if h[j:j + n] == tail:
+                cont = h[j + n:j + n + k]
+                if len(cont) == k:
+                    return cont
+                if len(cont) > len(best):
+                    best = cont
+        if best:
+            return best
+    return []
+
+
+def effective_probs(logits: np.ndarray, temperature: float,
+                    topp: float) -> np.ndarray:
+    """The baseline sampler's EFFECTIVE distribution over the vocab — the
+    per-step law Sampler.sample realizes with one uniform coin, as an
+    explicit (V,) f32 vector the rejection test can evaluate.
+
+    Mirrors runtime/sampling.py exactly: softmax(logits/temperature) in
+    f32; topp outside (0,1) keeps the full multinomial; otherwise the
+    reference nucleus filter — (1-p)/(n-1) cutoff pre-filter, stable
+    descending sort, cut at cumulative > topp — restricted and
+    renormalized by the kept prefix's f32-accumulated mass (the same
+    running sum sample_topp scales its coin by). The degenerate nucleus
+    (cutoff keeps nothing) collapses to the argmax point mass, matching
+    the host sampler's fallback.
+    """
+    # dlint: allow[D001] host acceptance math — logits are host by contract
+    probs = softmax_f32(np.asarray(logits, np.float32)
+                        / np.float32(temperature))
+    n = len(probs)
+    if topp <= 0 or topp >= 1 or n == 1:
+        return probs
+    cutoff = np.float32(1.0 - topp) / np.float32(n - 1)
+    idx = np.nonzero(probs >= cutoff)[0]
+    out = np.zeros_like(probs)
+    if len(idx) == 0:
+        out[int(np.argmax(probs))] = 1.0
+        return out
+    order = idx[np.argsort(-probs[idx], kind="stable")]
+    p_sorted = probs[order].astype(np.float32)
+    cum = np.float32(0.0)
+    last = len(order) - 1
+    for i, p in enumerate(p_sorted):
+        cum += p
+        if cum > topp:
+            last = i
+            break
+    kept = order[:last + 1]
+    out[kept] = probs[kept] / cum
+    return out
+
+
+def accept_or_resample(logits: np.ndarray, draft: int,
+                       sampler: Sampler) -> tuple[int, bool]:
+    """One Leviathan rejection-sampling step for a point-mass drafter.
+
+    Returns (next_token, accepted). Accept the draft with probability
+    p_eff(draft) (one coin from the row's xorshift stream); on rejection
+    draw ONE more coin and CDF-walk the residual distribution — p_eff with
+    the draft zeroed, renormalized — so the emitted token's law is exactly
+    p_eff (module docstring). Draft positions never reached by the replay
+    consume no coin at all: the stream advances only for decisions
+    actually made, keeping reruns of a seeded engine deterministic.
+    """
+    # dlint: allow[D001] host acceptance math — logits are host by contract
+    p = effective_probs(np.asarray(logits, np.float32)[:sampler.vocab_size],
+                        sampler.temperature, sampler.topp)
+    coin = sampler.rng.f32()
+    if coin < p[draft]:
+        return int(draft), True
+    residual = p.copy()
+    residual[draft] = 0.0
+    total = np.float32(residual.sum(dtype=np.float32))
+    if total <= 0.0:
+        # p_eff was a point mass on the draft yet the coin landed outside
+        # [0, 1) float mass — unreachable for xorshift f32 coins, but a
+        # deterministic fallback beats a crash
+        return int(np.argmax(p)), False
+    return int(sample_mult(residual / total, sampler.rng.f32())), False

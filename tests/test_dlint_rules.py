@@ -235,6 +235,44 @@ def test_d004_fires_on_page_table_list_comp(tmp_path):
     assert len(d004) == 1, findings
 
 
+def test_d004_fires_on_per_draft_token_list_comp(tmp_path):
+    """ISSUE 7: a speculative engine that boxes each row's draft window
+    into a fresh Python list fed to jnp.asarray inside the step loop is
+    exactly the D004 hazard the persistent (slots, K) staging block
+    exists to avoid — B list uploads per verify dispatch."""
+    findings = run_on(tmp_path, "runtime/spec.py", """
+        import jax.numpy as jnp
+
+        class Engine:
+            def step_spec(self, pool, drafts):
+                toks = jnp.asarray(
+                    [[s.token] + drafts[b] for b, s in enumerate(pool)])
+                return toks
+    """)
+    d004 = [f for f in findings if f.rule == "D004"]
+    assert len(d004) == 1, findings
+
+
+def test_d004_quiet_on_persistent_spec_staging_block(tmp_path):
+    """The shipped pattern (continuous.step_spec): draft windows written
+    into the persistent (slots, K) numpy block, ONE ndarray upload per
+    verify dispatch — no finding."""
+    findings = run_on(tmp_path, "runtime/spec.py", """
+        import numpy as np
+        import jax.numpy as jnp
+
+        class Engine:
+            def step_spec(self, pool, drafts):
+                st = self._stage_spec
+                for b, s in enumerate(pool):
+                    st[b, 0] = s.token
+                    for i, t in enumerate(drafts[b]):
+                        st[b, 1 + i] = t
+                return jnp.asarray(st)
+    """)
+    assert [f for f in findings if f.rule == "D004"] == []
+
+
 def test_d004_quiet_on_persistent_page_table_staging(tmp_path):
     """The shipped pattern (continuous._stage_tables): rows written into
     one persistent numpy block, ONE ndarray upload per step — no finding."""

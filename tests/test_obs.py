@@ -261,6 +261,67 @@ def test_contiguous_engine_page_series_stay_zero(params):
     assert "dllama_kv_pages_free 0" in reg.expose()
 
 
+def test_spec_engine_exports_proposed_and_accepted_series(params):
+    """ISSUE 7 satellite: a speculative engine moves
+    dllama_spec_proposed_total / dllama_spec_accepted_total, pinned equal
+    to the engine's own stats counters, and both land in the exposition
+    with HELP/TYPE headers."""
+    from distributed_llama_tpu.runtime.continuous import ContinuousEngine
+
+    reg = Registry()
+    eng = ContinuousEngine(SPEC, params, slots=2, temperature=0.0,
+                           topp=0.9, seed=5, metrics=reg, page_size=4,
+                           spec_k=4)
+    _, st = eng.run([[1, 5, 9], [1, 22], [1, 7, 33]], steps=10)
+    assert reg.get("dllama_spec_proposed_total").value \
+        == st.spec_proposed > 0
+    assert reg.get("dllama_spec_accepted_total").value == st.spec_accepted
+    assert st.spec_accepted <= st.spec_proposed
+    text = reg.expose()
+    for family in ("dllama_spec_proposed_total",
+                   "dllama_spec_accepted_total"):
+        assert f"# TYPE {family} counter" in text
+        assert f"# HELP {family} " in text
+
+
+def test_plain_engine_spec_series_stay_zero(params):
+    """Spec instruments exist on every engine but never move when
+    spec_k == 0 — dashboards survive the knob."""
+    from distributed_llama_tpu.runtime.continuous import ContinuousEngine
+
+    reg = Registry()
+    eng = ContinuousEngine(SPEC, params, slots=2, temperature=0.0,
+                           topp=0.9, seed=5, metrics=reg, page_size=4)
+    _, st = eng.run([[1, 5, 9]], steps=8)
+    assert eng.spec_k == 0 and st.spec_proposed == 0
+    assert reg.get("dllama_spec_proposed_total").value == 0
+    assert "dllama_spec_proposed_total 0" in reg.expose()
+
+
+def test_server_health_reports_spec_accept_rate(params):
+    """ISSUE 7 satellite: /health carries the speculative block (k,
+    proposed, accepted, accept_rate) when --spec-k is on."""
+    from distributed_llama_tpu.runtime.server import InferenceServer
+
+    srv = InferenceServer(SPEC, params, _IdTokenizer(), "127.0.0.1", 0,
+                          slots=2, steps=8, temperature=0.0, topp=0.9,
+                          seed=5, quiet=True, page_size=4, spec_k=4)
+    srv.start()
+    try:
+        _post(srv.port, "/generate", {"prompt": "xyx", "steps": 6})
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/health", timeout=30) as r:
+            h = json.loads(r.read())
+        sp = h["speculative"]
+        assert sp["k"] == 4
+        assert sp["accepted"] <= sp["proposed"]
+        assert 0.0 <= sp["accept_rate"] <= 1.0
+        assert sp["accept_rate"] == round(
+            sp["accepted"] / max(sp["proposed"], 1), 4)
+    finally:
+        srv.stop()
+
+
 def test_engine_compile_event_counter(params):
     """Fused-chain shape-cache misses count as compile events; reusing a
     chain shape does not."""

@@ -1,0 +1,441 @@
+"""Self-speculative decoding (ISSUE 7).
+
+Four layers of gates:
+
+* pure-host units: the n-gram prompt-lookup drafter, the sampler's
+  effective distribution, and the Leviathan rejection-sampling step with
+  the residual resample (seeded distribution pin — the output LAW must be
+  the baseline sampler's exactly);
+* device parity: the K-query verify forward is BITWISE equal to K
+  sequential paged decode steps — logits AND cache — across weight
+  codecs, with budget-edge writes routed to the scrap page (the property
+  the losslessness contract rests on);
+* engine behavior: greedy spec-on token streams are bitwise the spec-off
+  streams across Q40/F16 × ref/fused × the paged cache; sampled rows
+  complete; rejected-suffix pages return to the pool step by step with
+  refcount/prefix-tree invariants held;
+* analytic lockstep: the J001 verify census (one decode step's collective
+  counts, K-times bytes) per scheme, the comm_stats t_len scaling, the
+  shard_sim speculative term, and the memory-model K-wide activation
+  charge.
+"""
+
+import functools
+
+import numpy as np
+import pytest
+
+from distributed_llama_tpu.models.spec import TransformerSpec
+from distributed_llama_tpu.models.synth import synth_params
+from distributed_llama_tpu.runtime.speculative import (accept_or_resample,
+                                                       draft_tokens,
+                                                       effective_probs)
+
+# hidden_dim divisible by 64 so fused-scheme Q40 w2 input bands stay
+# 32-multiples at tp=2 (tp.shard_params constraint)
+SPEC = TransformerSpec(dim=64, hidden_dim=128, n_layers=2, n_heads=4,
+                       n_kv_heads=2, vocab_size=128, seq_len=32)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return synth_params(SPEC, q40=False, seed=4, scale=0.3)
+
+
+# -- drafter ----------------------------------------------------------------
+
+
+def test_draft_copies_continuation_of_most_recent_ngram_match():
+    #             match v           tail v
+    h = [5, 1, 2, 3, 9, 9, 1, 2, 3]
+    assert draft_tokens(h, 4, max_n=3) == [9, 9, 1, 2]  # what followed it
+    assert draft_tokens(h, 1, max_n=3) == [9]           # span capped at k
+
+
+def test_draft_prefers_longest_ngram():
+    # 3-gram [1,2,3] matches at index 1 (-> 7); the 1-gram [3] would match
+    # at index 5 (-> 8) — the longer context wins
+    h = [0, 1, 2, 3, 7, 3, 8, 1, 2, 3]
+    assert draft_tokens(h, 2, max_n=3) == [7, 3]
+
+
+def test_draft_falls_back_to_shorter_ngrams_and_handles_no_match():
+    # n=3 impossible (len 3), n=2 matches [4,4] at j=0 -> one token follows
+    assert draft_tokens([4, 4, 4], 2, max_n=3) == [4]
+    # longer history, still only the tokens that actually follow the match
+    assert draft_tokens([9, 4, 4, 4, 4], 3, max_n=3) == [4]
+    assert draft_tokens([4, 4, 4, 4, 4, 4], 3, max_n=3) == [4, 4, 4]
+    assert draft_tokens([1, 2, 3, 4], 2, max_n=3) == []   # nothing repeats
+    assert draft_tokens([7], 3) == []                     # too short
+    assert draft_tokens([1, 2, 1], 0) == []               # no room
+
+
+# -- acceptance rule / distribution pin -------------------------------------
+
+
+def _freqs(samples, v):
+    c = np.bincount(np.asarray(samples), minlength=v)
+    return c / len(samples)
+
+
+def test_effective_probs_is_the_sampler_law():
+    """effective_probs must be the distribution Sampler.sample realizes:
+    empirical frequencies over many seeded coins match it, and tokens the
+    nucleus filter drops are never sampled."""
+    from distributed_llama_tpu.runtime.sampling import Sampler
+
+    rng = np.random.default_rng(11)
+    logits = rng.standard_normal(32).astype(np.float32) * 2.0
+    temp, topp = 0.8, 0.9
+    p = effective_probs(logits, temp, topp)
+    assert p.shape == (32,)
+    assert abs(float(p.sum()) - 1.0) < 1e-5
+    smp = Sampler(32, temp, topp, seed=7, use_native=False)
+    samples = [smp.sample(logits.copy()) for _ in range(4000)]
+    assert set(samples) <= set(np.nonzero(p)[0].tolist())
+    assert np.abs(_freqs(samples, 32) - p).max() < 0.03
+
+
+def test_rejection_sampling_preserves_the_distribution():
+    """The seeded rejection-sampling pin: with a point-mass drafter the
+    combined accept-or-resample law must equal the baseline distribution
+    — P(draft) = p(draft) via acceptance, P(other) = p(other) via the
+    residual resample (runtime/speculative.py docstring derivation)."""
+    from distributed_llama_tpu.runtime.sampling import Sampler
+
+    rng = np.random.default_rng(3)
+    logits = rng.standard_normal(16).astype(np.float32) * 1.5
+    temp, topp = 1.0, 0.85
+    p = effective_probs(logits, temp, topp)
+    draft = int(np.argmax(p))  # a plausible drafter proposes the mode
+    smp = Sampler(16, temp, topp, seed=13, use_native=False)
+    out, acc = [], 0
+    for _ in range(6000):
+        tok, accepted = accept_or_resample(logits, draft, smp)
+        out.append(tok)
+        acc += accepted
+    assert np.abs(_freqs(out, 16) - p).max() < 0.03
+    # acceptance frequency is p(draft) itself (point-mass drafter)
+    assert abs(acc / len(out) - float(p[draft])) < 0.03
+
+
+def test_rejection_never_emits_draft_on_rejection_path():
+    from distributed_llama_tpu.runtime.sampling import Sampler
+
+    rng = np.random.default_rng(5)
+    logits = rng.standard_normal(16).astype(np.float32)
+    smp = Sampler(16, 1.0, 0.0, seed=2, use_native=False)  # multinomial
+    draft = 3
+    for _ in range(500):
+        tok, accepted = accept_or_resample(logits, draft, smp)
+        if not accepted:
+            assert tok != draft
+
+
+def test_effective_probs_degenerate_nucleus_is_argmax_point_mass():
+    logits = np.zeros(8, np.float32)  # uniform probs, tiny topp
+    p = effective_probs(logits, 1.0, 1e-4)
+    assert p[0] == 1.0 and p[1:].sum() == 0.0
+
+
+# -- device parity: verify forward == K sequential decode steps -------------
+
+
+@pytest.mark.parametrize("wtype", ["f32", "q40", "f16"])
+def test_verify_forward_bitwise_equal_sequential_decode(wtype):
+    """The keystone: ONE K-query verify dispatch produces bitwise the
+    logits AND cache of K sequential paged decode steps given the same
+    inputs — on scrambled physical pages, across weight codecs."""
+    import jax.numpy as jnp
+
+    from distributed_llama_tpu.models.llama import (forward_batch_paged,
+                                                    forward_batch_spec_paged,
+                                                    init_cache_paged,
+                                                    params_to_device)
+    import jax
+
+    tree = synth_params(SPEC, q40=(wtype == "q40"), seed=4, scale=0.3)
+    if wtype == "f16":
+        for k in ("wq", "wk", "wv", "wo", "w1", "w2", "w3", "wcls"):
+            tree[k] = tree[k].astype(np.float16)
+    params_dev = params_to_device(tree)
+    ps, B, K = 4, 2, 3
+    max_pages = SPEC.seq_len // ps
+    cache_a = init_cache_paged(SPEC, B * max_pages + 1, ps)
+    cache_b = init_cache_paged(SPEC, B * max_pages + 1, ps)
+    table = np.zeros((B, max_pages), np.int32)
+    for b in range(B):  # scrambled physical layout, like test_paging's
+        table[b] = 1 + np.arange(max_pages) * B + b
+    step = jax.jit(functools.partial(forward_batch_paged, SPEC, ps),
+                   donate_argnums=1)
+    verify = jax.jit(functools.partial(forward_batch_spec_paged, SPEC, ps),
+                     donate_argnums=1)
+    rng = np.random.default_rng(7)
+    pos = np.array([0, 5], np.int32)
+    toks = rng.integers(2, 100, (B, K)).astype(np.int32)
+    seq_logits = []
+    p = pos.copy()
+    for i in range(K):
+        lg, cache_a = step(params_dev, cache_a, jnp.asarray(toks[:, i]),
+                           jnp.asarray(p), jnp.asarray(table))
+        seq_logits.append(np.asarray(lg))
+        p = p + 1
+    vg, cache_b = verify(params_dev, cache_b, jnp.asarray(toks),
+                         jnp.asarray(pos), jnp.asarray(table))
+    vg = np.asarray(vg)
+    for i in range(K):
+        np.testing.assert_array_equal(seq_logits[i], vg[:, i])
+    np.testing.assert_array_equal(np.asarray(cache_a.k),
+                                  np.asarray(cache_b.k))
+    np.testing.assert_array_equal(np.asarray(cache_a.v),
+                                  np.asarray(cache_b.v))
+
+
+def test_verify_budget_edge_writes_route_to_scrap(params):
+    """A row verifying at the virtual-plane edge must dead-write positions
+    past seq_len onto the scrap page — never clamp onto live pages."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_llama_tpu.models.llama import (forward_batch_spec_paged,
+                                                    init_cache_paged,
+                                                    params_to_device)
+    from distributed_llama_tpu.runtime.paging import SCRAP_PAGE
+
+    params_dev = params_to_device(params)
+    ps, B, K = 4, 1, 4
+    max_pages = SPEC.seq_len // ps
+    cache = init_cache_paged(SPEC, max_pages + 1, ps)
+    table = np.arange(1, max_pages + 1, dtype=np.int32)[None, :]
+    verify = jax.jit(functools.partial(forward_batch_spec_paged, SPEC, ps),
+                     donate_argnums=1)
+    snap = np.asarray(cache.k).copy()
+    pos = np.array([SPEC.seq_len - 1], np.int32)  # window runs 31,32,33,34
+    toks = np.full((B, K), 5, np.int32)
+    _, cache = verify(params_dev, cache, jnp.asarray(toks),
+                      jnp.asarray(pos), jnp.asarray(table))
+    got = np.asarray(cache.k)
+    changed = {int(pg) for _, pg in
+               np.argwhere((got != snap).any(axis=(2, 3, 4)))}
+    # only the scrap page and the row's REAL last page may change
+    assert changed <= {SCRAP_PAGE, int(table[0, -1])}
+
+
+# -- engine behavior: losslessness + rollback -------------------------------
+
+
+REQS = [[1, 5, 9], [1, 22], [1, 7, 33, 2], [1, 60], [1, 90, 14]]
+
+
+def _run(tree, reqs, steps, **kw):
+    from distributed_llama_tpu.runtime.continuous import ContinuousEngine
+
+    eng = ContinuousEngine(SPEC, tree, slots=kw.pop("slots", 2),
+                           temperature=kw.pop("temperature", 0.0),
+                           topp=0.9, seed=3, **kw)
+    outs, stats = eng.run(reqs, steps)
+    return eng, outs, stats
+
+
+@pytest.mark.parametrize("wtype", ["q40", "f16"])
+def test_spec_streams_bitwise_equal_spec_off(wtype):
+    """ISSUE 7 acceptance: greedy spec-on token streams are bitwise the
+    spec-off streams on the paged cache, per weight codec."""
+    tree = synth_params(SPEC, q40=(wtype == "q40"), seed=4, scale=0.3)
+    if wtype == "f16":
+        for k in ("wq", "wk", "wv", "wo", "w1", "w2", "w3", "wcls"):
+            tree[k] = tree[k].astype(np.float16)
+    _, ref, _ = _run(tree, REQS, 12, page_size=4)
+    _, got, st = _run(tree, REQS, 12, page_size=4, spec_k=4)
+    assert got == ref
+    assert st.spec_accepted <= st.spec_proposed
+
+
+@pytest.mark.parametrize("kw", [
+    dict(spec_k=2), dict(spec_k=4, prefill_chunk=2),
+    dict(spec_k=8, slots=3),
+])
+def test_spec_streams_match_across_engine_configs(params, kw):
+    _, ref, _ = _run(params, REQS, 10, page_size=4)
+    _, got, _ = _run(params, REQS, 10, page_size=4, **kw)
+    assert got == ref
+
+
+@pytest.mark.parametrize("scheme", ["ref", "fused"])
+def test_spec_streams_bitwise_over_tp_mesh(scheme, monkeypatch):
+    """Both tp collective schemes: the sharded K-query verify dispatch
+    (tp.make_sharded_verify) keeps greedy streams bitwise equal to the
+    single-chip spec-off engine."""
+    from distributed_llama_tpu.parallel import make_mesh
+
+    tree = synth_params(SPEC, q40=True, seed=4, scale=0.3)
+    _, ref, _ = _run(tree, REQS[:3], 10, page_size=4)
+    monkeypatch.setenv("DLLAMA_TP_SCHEME", scheme)
+    _, got, st = _run(tree, REQS[:3], 10, mesh=make_mesh(tp=2),
+                      page_size=4, spec_k=4)
+    assert got == ref
+    assert st.spec_proposed > 0
+
+
+def test_spec_sampled_rows_complete_and_consume_pool_cleanly(params):
+    """temperature > 0: rejection sampling drives the rows to completion
+    (distribution-level contract — the stream realization legitimately
+    differs from spec-off) and the pool/tree invariants hold after."""
+    eng, outs, st = _run(params, REQS, 10, page_size=4, spec_k=4,
+                         temperature=0.9)
+    assert all(len(o) > 0 for o in outs)
+    assert all(s.free for s in eng._pool)
+    a = eng.allocator
+    assert a.n_pages - a.n_free == len(a.tree)  # only tree-held pages out
+
+
+def test_spec_accept_rate_on_repetitive_stream():
+    """The CPU smoke acceptance bar (ISSUE 7): on the bench's synthetic
+    7B-shaped-small config greedy decode collapses into repetition, the
+    n-gram drafter locks on — accept rate >= 0.5 — and verify dispatches
+    undercut the spec-off device-step count, with streams identical."""
+    from distributed_llama_tpu.models.synth import (small_bench_spec,
+                                                    synth_q40_fast)
+    from distributed_llama_tpu.runtime.continuous import ContinuousEngine
+
+    spec = small_bench_spec()
+    tree = synth_q40_fast(spec)
+    reqs = [[1, 5, 9], [1, 22, 7]]
+
+    def run(**kw):
+        eng = ContinuousEngine(spec, tree, slots=2, temperature=0.0,
+                               topp=0.9, seed=3, page_size=16, **kw)
+        return eng.run(reqs, 32)
+
+    outs_off, st_off = run()
+    outs_on, st_on = run(spec_k=4)
+    assert outs_on == outs_off
+    assert st_on.spec_proposed > 0
+    assert st_on.spec_accept_rate >= 0.5
+    assert st_on.steps < st_off.steps
+
+
+def test_spec_rollback_trims_pages_to_accepted_length(params):
+    """The rejected-suffix rollback property, step by step: after every
+    verify dispatch each live slot holds exactly the pages covering its
+    accepted positions (plus shared-prefix floor) — pages whose only
+    content was rejected tokens are back in the pool, and every page's
+    refcount equals its holders."""
+    from distributed_llama_tpu.runtime.continuous import (ContinuousEngine,
+                                                          Request)
+
+    eng = ContinuousEngine(SPEC, params, slots=2, temperature=0.0, topp=0.9,
+                           seed=3, page_size=4, spec_k=4)
+    for r in REQS[:3]:
+        eng.submit(Request(tokens=list(r), steps=14))
+    a = eng.allocator
+    while eng.step_many(1):
+        held = {}
+        for s in eng._pool:
+            if s.free:
+                assert not s.pages
+                continue
+            if s.pos == 0:  # freshly admitted this round: prompt coverage
+                expect = a.pages_for(min(len(s.req.tokens), s.budget))
+            else:  # replayed: trimmed to the accepted length
+                expect = max(a.pages_for(s.pos), s.shared)
+            assert len(s.pages) == expect, \
+                f"slot holds {len(s.pages)} pages at pos {s.pos}"
+            for pid in s.pages:
+                held[pid] = held.get(pid, 0) + 1
+        # refcount accounting: slots + one tree ref per held node
+        for pid, n_slots in held.items():
+            assert a.pool.refcount(pid) >= n_slots
+        distinct = set(held)
+        assert a.n_free >= a.n_pages - len(distinct) - len(a.tree)
+    assert all(s.free for s in eng._pool)
+    assert a.n_pages - a.n_free == len(a.tree)
+
+
+def test_spec_requires_paged_cache_and_sane_k(params):
+    from distributed_llama_tpu.runtime.continuous import ContinuousEngine
+
+    with pytest.raises(ValueError, match="kv-page-size"):
+        ContinuousEngine(SPEC, params, slots=2, temperature=0.0, topp=0.9,
+                         seed=3, spec_k=4)
+    with pytest.raises(ValueError, match="K >= 2"):
+        ContinuousEngine(SPEC, params, slots=2, temperature=0.0, topp=0.9,
+                         seed=3, page_size=4, spec_k=1)
+
+
+def test_spec_engine_reuse_replays_identical_streams(params):
+    """Seeded determinism: a reused spec engine (warm radix tree, warm
+    programs) replays the identical streams run after run — rejected
+    positions consuming no coins is load-bearing here."""
+    eng, first, _ = _run(params, REQS[:3], 10, page_size=4, spec_k=4,
+                         temperature=0.9)
+    again, _ = eng.run([list(r) for r in REQS[:3]], 10)
+    assert again == first
+
+
+# -- analytic lockstep ------------------------------------------------------
+
+
+def test_verify_collective_census_per_scheme():
+    """J001 for the K-query verify dispatch: one decode step's collective
+    counts, K-times the bytes — both schemes (the CI gate's contract)."""
+    from distributed_llama_tpu.analysis.jaxpr_contracts import (
+        contract_verify_collectives)
+
+    for scheme in ("ref", "fused"):
+        res = contract_verify_collectives(scheme=scheme)
+        assert res.ok, f"{scheme}: {res.detail}"
+
+
+def test_budget_t_len_scales_bytes_not_counts():
+    from distributed_llama_tpu.models.synth import llama2_13b_spec
+    from distributed_llama_tpu.parallel.comm_stats import (
+        tp_collective_budget)
+
+    spec = llama2_13b_spec()
+    for scheme in ("ref", "fused"):
+        b1 = tp_collective_budget(spec, 8, scheme)
+        b4 = tp_collective_budget(spec, 8, scheme, t_len=4)
+        assert b4.kind_counts() == b1.kind_counts()
+        assert b4.moved_bytes == 4 * b1.moved_bytes
+
+
+def test_expected_accepted_span_and_speculative_projection():
+    from distributed_llama_tpu.models.synth import llama2_13b_spec
+    from distributed_llama_tpu.parallel.shard_sim import (
+        expected_accepted_span, project_full_system)
+
+    assert expected_accepted_span(0.0, 4) == 1.0   # drafts never land
+    assert expected_accepted_span(1.0, 4) == 4.0   # every draft lands
+    a = expected_accepted_span(0.7, 4)
+    assert abs(a - (1 - 0.7 ** 4) / (1 - 0.7)) < 1e-9
+    with pytest.raises(ValueError):
+        expected_accepted_span(1.5, 4)
+
+    proj = project_full_system(llama2_13b_spec(), 8, 6.245, scheme="fused")
+    sp = proj.speculative(4, 0.7)
+    assert sp.baseline_ms_per_token == round(proj.total_ms, 3)
+    # the latency floor amortizes: ms/accepted strictly below baseline,
+    # and monotonically better with higher accept rate
+    assert sp.ms_per_accepted_token < proj.total_ms
+    assert (proj.speculative(4, 0.9).ms_per_accepted_token
+            < sp.ms_per_accepted_token)
+    # dispatch cost = shard (weight-bound, x1) + K x bandwidth + latency x1
+    assert sp.dispatch_ms == round(proj.shard_ms
+                                   + 4 * proj.ici_bandwidth_ms
+                                   + proj.ici_latency_ms, 3)
+    assert sp.speedup > 1.0
+
+
+def test_memory_model_charges_k_wide_verify_activations():
+    from distributed_llama_tpu.analysis.memory_model import device_footprint
+    from distributed_llama_tpu.models.synth import llama2_13b_spec
+
+    spec = llama2_13b_spec()
+    base = device_footprint(spec, 8, "fused", kv_page_size=16)
+    wide = device_footprint(spec, 8, "fused", kv_page_size=16, spec_k=8)
+    assert wide.activation_bytes > base.activation_bytes
+    assert wide.collective_bytes >= base.collective_bytes
+    # weights and KV are untouched — the verify dispatch is activation-only
+    assert wide.weights_bytes == base.weights_bytes
+    assert wide.kv_cache_bytes == base.kv_cache_bytes

@@ -37,6 +37,14 @@ python -m distributed_llama_tpu.analysis --all
 # fast here before the full suite (the same tests also run in tier-1)
 python -m pytest tests/test_paging.py -q -p no:cacheprovider \
     -k "bitwise or streams_match or shared_system_prompt"
+# speculative losslessness gate (ISSUE 7): greedy spec-on token streams
+# must be BITWISE the spec-off streams (across codecs, both tp schemes,
+# paged cache) and rejected-suffix pages must return to the pool. The
+# J001 verify-forward collective census per scheme runs in the --all
+# contracts above — a collective added to the K-query verify dispatch
+# without its comm_stats t_len term fails there.
+python -m pytest tests/test_speculative.py -q -p no:cacheprovider \
+    -k "bitwise or streams or rollback"
 # drift observatory gate (ISSUE 5): tracecheck reconciles the checked-in
 # synthetic capture fixtures against the analytic collective model and
 # fails the build on any DRIFT verdict; the attribution Chrome traces are

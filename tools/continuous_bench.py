@@ -15,6 +15,14 @@ concurrency at equal HBM, prefix-hit rate, and prefill tokens saved.
 Streams must match the contiguous engine token for token (scheduling and
 paging stay invisible in outputs).
 
+A speculative-decoding section (ISSUE 7, on by default) then runs the SAME
+paged workload spec-off and spec-on at equal HBM (identical pool), both at
+one device dispatch per scheduler iteration — the dispatch-for-dispatch
+comparison speculative decoding exists to win: spec-on emits up to K
+tokens per dispatch where spec-off emits one. Columns: accept rate and
+ms/accepted-token, with the greedy streams asserted token-identical
+(losslessness is not a tolerance).
+
 The final stdout line is a JSON row stamped with utils/fingerprint.
 env_fingerprint (jax/jaxlib/device-kind/clock — the same drift defense as
 bench.py rows), so BENCH_* archives stay joinable across sessions.
@@ -23,6 +31,7 @@ Usage:
   python tools/continuous_bench.py [--slots 4] [--block-steps 16]
       [--kv-cache-dtype f32|bf16] [--requests 6] [--steps 48] [--small]
       [--page-size 16] [--oversub 4] [--no-paged-compare]
+      [--spec-k 4] [--no-spec-compare]
 
 On a remote/tunneled runtime, --block-steps 16 amortizes the per-dispatch
 round-trip; --block-steps 1 measures the per-step scheduling floor.
@@ -109,6 +118,62 @@ def paged_compare(spec, params, args, dtype) -> dict:
     return row
 
 
+def spec_compare(spec, params, args, dtype) -> dict:
+    """The spec-on vs spec-off section at equal HBM; returns the JSON
+    sub-row. Both arms run the paged cache with the SAME pool (identical
+    modeled KV HBM — the verify dispatch adds only K-wide activations,
+    analysis/memory_model device_footprint(spec_k=K)) and ONE device
+    dispatch per scheduler iteration, so the ms/accepted-token column
+    isolates exactly what speculation amortizes: per-dispatch overhead
+    (host round-trip + launch here; the collective-latency floor on a
+    real mesh)."""
+    from distributed_llama_tpu.runtime.continuous import ContinuousEngine
+
+    ps = args.page_size
+    pool_pages = args.slots * (spec.seq_len // ps)
+    reqs = _shared_prompt_requests(ps, args.requests)
+
+    def run(label, **kw):
+        eng = ContinuousEngine(spec, params, slots=args.slots,
+                               temperature=0.0, topp=0.9, seed=3,
+                               cache_dtype=dtype, page_size=ps,
+                               kv_pages=pool_pages, **kw)
+        eng.run(reqs, steps=args.steps)       # warm-up (compile)
+        t0 = time.perf_counter()
+        outs, st = eng.run(reqs, steps=args.steps)
+        dt = time.perf_counter() - t0
+        print(f"{label}: {st.tokens} tokens {st.steps} dispatches "
+              f"{dt:.2f}s -> {dt * 1000 / st.tokens:.2f} ms/token",
+              file=sys.stderr)
+        return outs, st, dt
+
+    outs_off, st_off, dt_off = run("spec-off (1 tok/dispatch)")
+    outs_on, st_on, dt_on = run(f"spec-on  (K={args.spec_k})",
+                                spec_k=args.spec_k)
+    assert outs_on == outs_off, \
+        "speculative decoding changed a greedy token stream?!"
+    ms_off = dt_off * 1000 / max(1, st_off.tokens)
+    ms_on = dt_on * 1000 / max(1, st_on.tokens)
+    row = {
+        "k": args.spec_k,
+        "accept_rate": round(st_on.spec_accept_rate, 4),
+        "drafts_proposed": st_on.spec_proposed,
+        "drafts_accepted": st_on.spec_accepted,
+        "dispatches_off": st_off.steps, "dispatches_on": st_on.steps,
+        "ms_per_accepted_token_off": round(ms_off, 3),
+        "ms_per_accepted_token_on": round(ms_on, 3),
+        "speedup": round(ms_off / max(ms_on, 1e-9), 3),
+        "streams_identical": True,
+    }
+    print(f"speculative K={args.spec_k}: accept rate "
+          f"{st_on.spec_accept_rate:.0%} "
+          f"({st_on.spec_accepted}/{st_on.spec_proposed}), "
+          f"{ms_off:.2f} -> {ms_on:.2f} ms/accepted token "
+          f"({row['speedup']:.2f}x, {st_off.steps} -> {st_on.steps} "
+          f"dispatches), streams identical", file=sys.stderr)
+    return row
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--slots", type=int, default=4)
@@ -126,6 +191,13 @@ def main():
     ap.add_argument("--paged-compare", action=argparse.BooleanOptionalAction,
                     default=True,
                     help="run the equal-HBM paged-vs-contiguous section")
+    ap.add_argument("--spec-k", type=int, default=4,
+                    help="speculative verify window for the spec section")
+    ap.add_argument("--spec-compare", action=argparse.BooleanOptionalAction,
+                    default=True,
+                    help="run the spec-on vs spec-off section (equal HBM, "
+                         "one dispatch per iteration, streams asserted "
+                         "identical)")
     ap.add_argument("--profile", default=None, metavar="DIR",
                     help="trace the timed pass and print the per-step "
                          "op-time split by kernel family (the VERDICT r3 "
@@ -182,6 +254,8 @@ def main():
     }
     if args.paged_compare:
         row["paged_equal_hbm"] = paged_compare(spec, params, args, dtype)
+    if args.spec_compare:
+        row["speculative"] = spec_compare(spec, params, args, dtype)
 
     if args.profile:
         from distributed_llama_tpu.utils.it_split import bucket_ops
