@@ -616,6 +616,21 @@ def cmd_serve(argv: list[str]) -> int:
                          "per-token latency) + engine step metrics; "
                          "--no-metrics turns collection fully off the "
                          "decode hot path")
+    ap.add_argument("--slo", default=None, metavar="SPEC",
+                    help="SLO policy: name:ttft_ms:token_ms[,...], first "
+                         "class is the default (e.g. "
+                         "'interactive:1000:100,batch:60000:5000'); "
+                         "requests pick a class with the \"class\" field, "
+                         "verdicts land in /health's \"slo\" block and "
+                         "dllama_slo_requests_total{class,verdict}. "
+                         "Default: the built-in interactive/batch policy; "
+                         "--slo off disables tracking")
+    ap.add_argument("--chaos", default=None, metavar="SPEC",
+                    help="ARM DETERMINISTIC FAULT INJECTION (drills only — "
+                         "never in front of real traffic): "
+                         "key=value[,...] with step_delay_every, "
+                         "step_delay_ms, deny_pages, leak_on_cancel "
+                         "(runtime/chaos.ChaosMonkey)")
     _obs_flags(ap)
     args = ap.parse_args(argv)
     _apply_log_json(args)
@@ -626,6 +641,20 @@ def cmd_serve(argv: list[str]) -> int:
         print("--fast-prefill only affects admission prefill; pass "
               "--prefill-chunk N (N > 1)", file=sys.stderr)
         return 2
+    from ..obs.slo import SLOPolicy
+    from ..runtime.chaos import ChaosMonkey
+
+    try:
+        slo = (None if args.slo == "off"
+               else SLOPolicy.parse(args.slo) if args.slo
+               else SLOPolicy.serving_default())
+        chaos = ChaosMonkey.parse(args.chaos) if args.chaos else None
+    except ValueError as e:
+        print(f"serve: {e}", file=sys.stderr)
+        return 2
+    if chaos is not None:
+        print("🔶 CHAOS ARMED: deterministic fault injection is live "
+              f"({args.chaos}) — drill traffic only", file=sys.stderr)
 
     import jax.numpy as jnp
 
@@ -665,7 +694,8 @@ def cmd_serve(argv: list[str]) -> int:
                              metrics=args.metrics,
                              page_size=args.kv_page_size,
                              kv_pages=args.kv_pages, spec_k=args.spec_k,
-                             spec_ngram=args.spec_ngram)
+                             spec_ngram=args.spec_ngram, slo=slo,
+                             chaos=chaos)
     endpoints = "POST /generate, GET /health" + (
         ", GET /metrics, GET /debug/timeline, POST /profile"
         if args.metrics else "")

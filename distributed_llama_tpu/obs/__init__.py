@@ -18,7 +18,11 @@ instrument is one end-of-run benchmark line, tokenizer.cpp:381):
 * ``obs.xprof`` — profiler-capture loader: device events bucketed by
   named scope into per-phase ms/token and per-collective time/bytes;
 * ``obs.drift`` — the model-vs-measured reconciler behind
-  ``tools/tracecheck.py``, the bench drift columns, and CI's DRIFT gate.
+  ``tools/tracecheck.py``, the bench drift columns, and CI's DRIFT gate;
+* ``obs.slo`` — declarative SLO policies (priority classes with TTFT +
+  per-token budgets) and the per-request verdict tracker behind
+  ``dllama_slo_requests_total{class,verdict}`` / goodput accounting and
+  the /health "slo" block (tools/loadcheck.py's gate).
 
 Collection is opt-in: hot paths hold a None handle when disabled and make
 zero registry calls (tests/test_obs.py pins this).
@@ -26,9 +30,11 @@ zero registry calls (tests/test_obs.py pins this).
 
 from .log import json_mode, log_event
 from .metrics import (Counter, Gauge, Histogram, Registry, summarize_values)
+from .slo import SLOClass, SLOPolicy, SLOTracker
 from .spans import SpanTracer, spans_to_chrome, validate_chrome_trace
 from .trace import EngineMetrics
 
 __all__ = ["Counter", "Gauge", "Histogram", "Registry", "EngineMetrics",
+           "SLOClass", "SLOPolicy", "SLOTracker",
            "SpanTracer", "spans_to_chrome", "validate_chrome_trace",
            "json_mode", "log_event", "summarize_values"]

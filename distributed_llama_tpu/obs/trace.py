@@ -74,6 +74,13 @@ class EngineMetrics:
             "dllama_engine_active_slots", "Active slots right now")
         self.queued = g(
             "dllama_engine_queued_requests", "Requests waiting for a slot")
+        # ISSUE-8 canonical queue-depth name: the same value as
+        # dllama_engine_queued_requests (kept for dashboard compat), both
+        # written through set_queue_depth so they can never diverge
+        self.queue_depth = g(
+            "dllama_queue_depth",
+            "Requests waiting for a slot (canonical SLO-observatory "
+            "name; equals dllama_engine_queued_requests)")
         self.generated = c(
             "dllama_generated_tokens_total",
             "Tokens emitted into request outputs (prompt echoes included, "
@@ -91,6 +98,22 @@ class EngineMetrics:
         self.cancelled = c(
             "dllama_requests_cancelled_total",
             "Requests retired because the consumer vanished")
+        # admission-pressure instruments (ISSUE 8): every reason is
+        # pre-registered so a fresh scrape shows the full matrix at zero.
+        # pool_dry = paged admission requeued at the queue head; deadlock
+        # = the all-slots-starved breaker failed the youngest request;
+        # oversized / bad_request = the server refused the request before
+        # it ever reached the engine queue.
+        self.pauses = c(
+            "dllama_slot_pauses_total",
+            "Page-starved slot pauses: a slot rode one device dispatch "
+            "masked inactive waiting for pool pages to free")
+        self._rejected = {
+            reason: self.registry.labeled_counter(
+                "dllama_admission_rejected_total", {"reason": reason},
+                "Requests refused or pushed back at admission, by reason")
+            for reason in ("pool_dry", "deadlock", "oversized",
+                           "bad_request")}
         # paged-KV instruments (page_size > 0 engines move them; contiguous
         # engines expose them at zero — the scrape surface is layout-
         # invariant, so dashboards survive the knob)
@@ -121,6 +144,28 @@ class EngineMetrics:
         # the engine runs sharded: [(launch counter, byte counter,
         # launches/step, bytes/step)] — empty (and never touched) at tp=1
         self._collectives: list = []
+
+    def set_queue_depth(self, n: int) -> None:
+        """Write BOTH queue gauges (legacy + canonical) in one place."""
+        self.queued.set(n)
+        self.queue_depth.set(n)
+
+    def reject(self, reason: str) -> None:
+        """Count one admission rejection; unknown reasons get their own
+        series on first use (the fixed set above stays visible at
+        zero)."""
+        counter = self._rejected.get(reason)
+        if counter is None:
+            counter = self.registry.labeled_counter(
+                "dllama_admission_rejected_total", {"reason": reason},
+                "Requests refused or pushed back at admission, by reason")
+            self._rejected[reason] = counter
+        counter.inc()
+
+    def rejected_total(self) -> dict:
+        """{reason: count} for /health (zero series included)."""
+        return {reason: int(c.value)
+                for reason, c in sorted(self._rejected.items())}
 
     def bind_collectives(self, budget, scheme: str, rows: int = 1) -> None:
         """Register the analytic collective budget as labeled series so

@@ -1,0 +1,395 @@
+"""Deterministic fault injection + chaos drills for the continuous engine.
+
+The telemetry stack (obs/) can SHOW a leak or a wedged pool; nothing before
+this module ever CAUSED one on purpose. Each drill here drives a fresh
+engine through one failure mode the serving layer must absorb — pool
+exhaustion, transient page starvation, oversized prompts, mid-stream client
+disconnects, injected step-latency spikes, a profiler capture under load —
+and then asserts the post-drill invariants that define "absorbed":
+
+* no leaked pages or slots: every allocated page's refcount is explained
+  by a live slot mapping or a radix-tree node (paging.PagedAllocator.audit
+  — the introspection hooks exist for exactly this), the pool drains to
+  free + tree-held == capacity, and every slot is free;
+* metrics still scrapeable: the registry's Prometheus exposition parses;
+* the engine still admits: a probe request runs to completion afterwards.
+
+Injection is DETERMINISTIC — counters, not coin flips: "delay every Nth
+dispatch", "deny the first N page allocations". A drill that fails
+reproduces identically under the same config, which is the property that
+makes tools/loadcheck.py a CI gate rather than a flake source. The
+``ChaosMonkey`` hooks are consulted by the engine at three points
+(pre-dispatch, page allocation, cancelled-retire release) and by
+``serve --chaos`` for operator-driven drills against a live server.
+
+``leak_on_cancel`` is the gate's MUTATION arm (ISSUE 8 satellite): it
+makes the engine deliberately drop one page on every cancelled-request
+release, which the disconnect drill's audit must flag — proving the red
+path fires (tools/ci.sh asserts loadcheck exits 1 under it).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+
+@dataclasses.dataclass
+class ChaosMonkey:
+    """Deterministic fault-injection state, registered on an engine (the
+    ``chaos=`` constructor knob) and/or a server. All knobs default OFF;
+    counters record what actually fired so drills can assert injection
+    happened.
+
+    * ``step_delay_every``/``step_delay_s`` — sleep before every Nth
+      device dispatch (a step-latency spike: a preempted host, a slow
+      interconnect);
+    * ``deny_pages`` — fail the first N page allocations (transient pool
+      pressure without filling the pool);
+    * ``leak_on_cancel`` — drop one page from every cancelled request's
+      release (the seeded fault the invariant audit must catch).
+    """
+
+    step_delay_every: int = 0
+    step_delay_s: float = 0.0
+    deny_pages: int = 0
+    leak_on_cancel: bool = False
+    # injection counters (read by drills / surfaced in loadcheck rows)
+    injected_delays: int = 0
+    denied_allocs: int = 0
+    leaked_pages: list = dataclasses.field(default_factory=list)
+    _dispatches: int = 0
+
+    def on_dispatch(self) -> None:
+        """Engine hook: called once per device dispatch, before launch."""
+        self._dispatches += 1
+        if (self.step_delay_every > 0 and self.step_delay_s > 0
+                and self._dispatches % self.step_delay_every == 0):
+            self.injected_delays += 1
+            time.sleep(self.step_delay_s)
+
+    def deny_page(self) -> bool:
+        """Engine hook: True = this page allocation must fail (the engine
+        then takes its real dry-pool path: pause, requeue, breaker)."""
+        if self.denied_allocs < self.deny_pages:
+            self.denied_allocs += 1
+            return True
+        return False
+
+    def filter_release(self, pages: list) -> list:
+        """Engine hook on a cancelled request's page release: with
+        ``leak_on_cancel`` armed, steal one page so it is never released —
+        the deliberate leak the audit must then report."""
+        if self.leak_on_cancel and pages:
+            self.leaked_pages.append(pages.pop())
+        return pages
+
+    def injection_summary(self) -> dict:
+        return {"dispatches": self._dispatches,
+                "injected_delays": self.injected_delays,
+                "denied_allocs": self.denied_allocs,
+                "leaked_pages": len(self.leaked_pages)}
+
+    @classmethod
+    def parse(cls, text: str) -> "ChaosMonkey":
+        """``key=value[,key=value...]`` (the --chaos CLI format): keys
+        step_delay_every, step_delay_ms, deny_pages, leak_on_cancel."""
+        kw: dict = {}
+        for part in text.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            if "=" not in part:
+                raise ValueError(f"bad chaos knob {part!r}: want key=value")
+            key, val = part.split("=", 1)
+            key = key.strip()
+            if key == "step_delay_ms":
+                kw["step_delay_s"] = float(val) / 1e3
+            elif key in ("step_delay_every", "deny_pages"):
+                kw[key] = int(val)
+            elif key == "leak_on_cancel":
+                kw[key] = val.strip().lower() not in ("0", "false", "")
+            else:
+                raise ValueError(
+                    f"unknown chaos knob {key!r} (have step_delay_every, "
+                    f"step_delay_ms, deny_pages, leak_on_cancel)")
+        return cls(**kw)
+
+
+@dataclasses.dataclass
+class DrillResult:
+    """One drill's verdict: ``passed`` is the gate bit; ``violations``
+    lists every failed invariant (empty when passed); ``details`` carries
+    the drill's observed counters for the loadcheck JSON row."""
+
+    name: str
+    passed: bool
+    violations: list
+    details: dict
+
+    def to_json(self) -> dict:
+        return {"name": self.name, "passed": self.passed,
+                "violations": list(self.violations),
+                "details": dict(self.details)}
+
+
+def scrape_problems(registry) -> list[str]:
+    """Parse the registry's Prometheus exposition; any unparseable sample
+    line is a violation (a drill must not leave /metrics broken)."""
+    if registry is None:
+        return []
+    try:
+        text = registry.expose()
+    except Exception as e:  # noqa: BLE001 - a raising scrape IS the finding
+        return [f"/metrics exposition raised {type(e).__name__}: {e}"]
+    problems = []
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        name, _, value = line.rpartition(" ")
+        try:
+            float(value)
+        except ValueError:
+            problems.append(f"unparseable exposition line: {line!r}")
+        if not name:
+            problems.append(f"sample line without a name: {line!r}")
+    return problems
+
+
+def check_invariants(eng, expect_drained: bool = True) -> list[str]:
+    """The shared post-drill gate (module docstring): drained pool, page
+    accounting clean, metrics scrapeable, engine still admitting."""
+    problems: list[str] = []
+    active = sum(not s.free for s in eng._pool)
+    with eng._lock:
+        queued = len(eng._queue)
+    if expect_drained and (active or queued):
+        problems.append(f"engine not drained: {active} active slots, "
+                        f"{queued} queued requests")
+    problems += [f"page audit: {p}" for p in eng.audit_pages()]
+    if eng.allocator is not None:
+        alloc = eng.allocator
+        tree_held = sum(1 for _ in alloc.tree.nodes())
+        slot_held = sum(len(s.pages) for s in eng._pool)
+        # only decisive once slots drained: a shared-prefix page is held
+        # by a slot AND the tree at once (the audit covers the live case)
+        if (slot_held == 0
+                and alloc.n_free + tree_held != alloc.n_pages):
+            problems.append(
+                f"page leak: {alloc.n_free} free + {tree_held} tree-held "
+                f"!= {alloc.n_pages} pool pages with all slots drained")
+    registry = eng._obs.registry if eng._obs is not None else None
+    problems += scrape_problems(registry)
+    # the engine must still admit and finish new work after the drill
+    probe = [1, 7, 9]
+    try:
+        outs, _ = eng.run([probe], steps=3, quiet=True)
+        if not outs[0]:
+            problems.append("post-drill probe request produced no tokens")
+    except Exception as e:  # noqa: BLE001 - a raising engine IS the finding
+        problems.append(f"post-drill probe raised {type(e).__name__}: {e}")
+    return problems
+
+
+def _drain(eng, max_iters: int = 10_000) -> int:
+    """Step until idle; returns iterations. Bounded — a scheduler that
+    never drains is itself a drill failure (the caller sees active>0)."""
+    it = 0
+    while eng.step_many(eng.block_steps, quiet=True) and it < max_iters:
+        it += 1
+    return it
+
+
+def _result(name: str, eng, chaos, extra_violations=(), **details):
+    violations = list(extra_violations) + check_invariants(eng)
+    if chaos is not None:
+        details.update(chaos.injection_summary())
+    return DrillResult(name=name, passed=not violations,
+                       violations=violations, details=details)
+
+
+def drill_pool_exhaustion(make_engine) -> DrillResult:
+    """Oversubscribe the page pool: more concurrent demand than pages, so
+    slots PAUSE for pages and admissions requeue — the engine must serve
+    everything (or fail loudly via the deadlock breaker), then account
+    for every page."""
+    eng = make_engine()
+    ps, pool = eng.page_size, eng.allocator.n_pages
+    seq = eng.spec.seq_len
+    # each request wants ~seq positions; enough requests that total demand
+    # is several times the pool
+    n_req = max(4, (3 * pool * ps) // seq)
+    reqs = [[1] + [5 + (i * 3 + j) % 90 for j in range(3)]
+            for i in range(n_req)]
+    outs, stats = eng.run(reqs, steps=seq, quiet=True)
+    empty = sum(1 for o in outs if not o)
+    return _result("pool_exhaustion", eng, None,
+                   extra_violations=(
+                       [f"{empty} requests produced no output"]
+                       if empty else []),
+                   requests=n_req, pauses=stats.pauses,
+                   tokens=stats.tokens)
+
+
+def drill_transient_starvation(make_engine) -> DrillResult:
+    """Deny the first N page allocations (ChaosMonkey.deny_pages): the
+    engine's dry-pool paths (pause / head-of-queue requeue) must retry and
+    complete every request once the denials run out."""
+    chaos = ChaosMonkey(deny_pages=6)
+    eng = make_engine(chaos=chaos)
+    reqs = [[1] + [5 + (i * 7 + j) % 90 for j in range(4)]
+            for i in range(4)]
+    outs, stats = eng.run(reqs, steps=8, quiet=True)
+    violations = []
+    if chaos.denied_allocs != 6:
+        violations.append(f"expected 6 denied allocations, got "
+                          f"{chaos.denied_allocs}")
+    if any(not o for o in outs):
+        violations.append("a request starved permanently under transient "
+                          "denial")
+    return _result("transient_starvation", eng, chaos,
+                   extra_violations=violations, pauses=stats.pauses)
+
+
+def drill_oversized_prompt(make_engine) -> DrillResult:
+    """Prompts longer than the position budget (and than seq_len): the
+    engine must clamp to its budget, retire cleanly, and reject empty
+    prompts with a clean error — never wedge or leak."""
+    eng = make_engine()
+    seq = eng.spec.seq_len
+    huge = [1] + [5 + (j % 90) for j in range(2 * seq)]
+    outs, _ = eng.run([huge, [1, 9, 9]], steps=seq, quiet=True)
+    violations = []
+    if len(outs[0]) > seq:
+        violations.append(f"oversized prompt emitted {len(outs[0])} "
+                          f"tokens past the {seq}-position budget")
+    try:
+        eng.run([[]], steps=4, quiet=True)
+        violations.append("empty prompt was accepted")
+    except ValueError:
+        pass
+    return _result("oversized_prompt", eng, None,
+                   extra_violations=violations, echoed=len(outs[0]))
+
+
+def drill_disconnect(make_engine) -> DrillResult:
+    """Mid-flight client disconnects: cancel requests while they hold KV
+    pages; every page must return to the pool (cancelled requests publish
+    nothing to the radix tree), and kv_pages_free must round-trip."""
+    from .continuous import Request
+
+    eng = make_engine()
+    free_before = eng.allocator.n_free
+    seq = eng.spec.seq_len
+    reqs = [Request(tokens=[1] + [5 + (i * 11 + j) % 90 for j in range(3)],
+                    steps=seq) for i in range(3)]
+    for r in reqs:
+        eng.submit(r)
+    for _ in range(3):  # get them decoding (pages held)
+        eng.step_many(eng.block_steps, quiet=True)
+    held = sum(len(s.pages) for s in eng._pool)
+    for r in reqs:
+        eng.cancel(r)
+    iters = _drain(eng)
+    violations = []
+    if held == 0:
+        violations.append("drill never put pages at risk (no slot held "
+                          "pages at cancel time)")
+    if not all(r.done.is_set() for r in reqs):
+        violations.append("a cancelled request never completed")
+    free_after = eng.allocator.n_free
+    if free_after != free_before:
+        violations.append(
+            f"kv_pages_free did not round-trip: {free_before} before, "
+            f"{free_after} after cancel+drain")
+    return _result("disconnect", eng, getattr(eng, "_chaos", None),
+                   extra_violations=violations, pages_at_risk=held,
+                   drain_iters=iters)
+
+
+def drill_latency_spike(make_engine) -> DrillResult:
+    """Inject step-latency spikes (sleep before every 2nd dispatch): the
+    engine must finish the workload, and the step-duration histogram must
+    have recorded through the spikes."""
+    chaos = ChaosMonkey(step_delay_every=2, step_delay_s=0.002)
+    eng = make_engine(chaos=chaos)
+    reqs = [[1] + [5 + (i * 5 + j) % 90 for j in range(3)]
+            for i in range(3)]
+    outs, _ = eng.run(reqs, steps=6, quiet=True)
+    violations = []
+    if chaos.injected_delays == 0:
+        violations.append("no latency spikes were injected")
+    if any(not o for o in outs):
+        violations.append("a request produced no output under spikes")
+    if eng._obs is not None and eng._obs.step_duration.count == 0:
+        violations.append("step-duration histogram recorded nothing")
+    return _result("latency_spike", eng, chaos, extra_violations=violations)
+
+
+def drill_profiler_under_load(make_engine) -> DrillResult:
+    """Start a jax.profiler capture WHILE the engine serves: serving must
+    not stall, and the capture must start and stop cleanly (the
+    POST /profile contract, exercised under load instead of idle)."""
+    import tempfile
+
+    from ..obs import profiler
+
+    eng = make_engine()
+    violations = []
+    trace_dir = tempfile.mkdtemp(prefix="dllama-chaos-profile-")
+    reqs = [[1] + [5 + (i * 7 + j) % 90 for j in range(3)]
+            for i in range(3)]
+    try:
+        profiler.start_capture(trace_dir, seconds=0.2)
+    except RuntimeError as e:
+        violations.append(f"capture would not start: {e}")
+    outs, _ = eng.run(reqs, steps=6, quiet=True)
+    if any(not o for o in outs):
+        violations.append("a request produced no output under capture")
+    if not profiler.wait_capture(timeout=30.0):
+        violations.append("profiler capture never stopped")
+    return _result("profiler_under_load", eng, None,
+                   extra_violations=violations, trace_dir=trace_dir)
+
+
+DRILLS = (
+    ("pool_exhaustion", drill_pool_exhaustion),
+    ("transient_starvation", drill_transient_starvation),
+    ("oversized_prompt", drill_oversized_prompt),
+    ("disconnect", drill_disconnect),
+    ("latency_spike", drill_latency_spike),
+    ("profiler_under_load", drill_profiler_under_load),
+)
+
+
+def run_drills(make_engine, which=None) -> list[DrillResult]:
+    """Run the drill suite against fresh engines from ``make_engine``
+    (a callable accepting ``chaos=`` plus engine-constructor overrides;
+    every drill gets its own engine — faults must not bleed). ``which``
+    filters by drill name. A drill that RAISES is converted into a failed
+    result — the gate must report, not crash."""
+    results = []
+    for name, fn in DRILLS:
+        if which is not None and name not in which:
+            continue
+        try:
+            results.append(fn(make_engine))
+        except Exception as e:  # noqa: BLE001 - report, never crash the gate
+            results.append(DrillResult(
+                name=name, passed=False,
+                violations=[f"drill raised {type(e).__name__}: {e}"],
+                details={}))
+    return results
+
+
+def render_drill_table(results) -> str:
+    """The human verdict table (tracecheck-style)."""
+    lines = [f"{'drill':<24} {'verdict':<8} detail"]
+    for r in results:
+        detail = ("; ".join(r.violations) if r.violations
+                  else ", ".join(f"{k}={v}" for k, v in
+                                 sorted(r.details.items())
+                                 if not isinstance(v, str)))
+        lines.append(f"{r.name:<24} {'OK' if r.passed else 'FAIL':<8} "
+                     f"{detail}")
+    return "\n".join(lines)

@@ -56,6 +56,10 @@ class Request:
     index: int = -1  # submission order; assigned by submit()
     error: str | None = None  # set (before done) if the engine failed it
     cancelled: bool = False  # consumer gone: retire at the next step
+    # SLO priority class (obs/slo.py): None = the policy's default class;
+    # the tracker resolves it at retire. Ignored on engines without a
+    # policy.
+    slo_class: str | None = None
     # streaming hook: called from the scheduler thread with each token as it
     # lands in ``out`` (prompt echoes included, prefill echoes in one burst);
     # must be fast and must not raise — it runs inside the decode loop
@@ -116,6 +120,12 @@ class ContinuousStats:
     # ms-per-accepted-token bench columns (ISSUE 7)
     spec_proposed: int = 0
     spec_accepted: int = 0
+    # admission-pressure accounting (ISSUE 8): page-starved slot pauses
+    # (a slot rode one dispatch masked inactive) and head-of-queue
+    # requeues (paged admission found the pool dry) — kept on stats so
+    # metric-less engines (the loadgen driver) still see them
+    pauses: int = 0
+    requeues: int = 0
 
     @property
     def tokens_per_s(self) -> float:
@@ -148,7 +158,7 @@ class ContinuousEngine:
                  fast_prefill: bool = False, metrics=None,
                  page_size: int = 0, kv_pages: int = 0,
                  prefix_share: bool = True, spec_k: int = 0,
-                 spec_ngram: int = 3):
+                 spec_ngram: int = 3, slo=None, chaos=None):
         import functools
 
         import jax
@@ -167,6 +177,11 @@ class ContinuousEngine:
         self.seed = seed
         self.jnp = jnp
         self.prefill_chunk = prefill_chunk
+        # deterministic fault injection (runtime/chaos.py ChaosMonkey):
+        # consulted pre-dispatch (latency spikes), at page allocation
+        # (transient starvation), and on cancelled-release (the seeded
+        # leak mutation). None = zero overhead, like the metrics handle.
+        self._chaos = chaos
         # paged KV mode (page_size > 0): the cache becomes a fixed pool of
         # (page_size)-position pages shared by all slots through per-slot
         # page tables, with radix-tree prefix sharing on admission
@@ -360,6 +375,31 @@ class ContinuousEngine:
         else:
             self._obs = None
             self._spans = None
+        # SLO verdict tracking (obs/slo.py, ISSUE 8): independent of the
+        # metrics toggle — a policy without a registry still tallies
+        # (loadcheck's virtual-clock engines), a registry without a
+        # policy exposes no SLO series. The tracker is written only at
+        # retire, off the per-token hot path.
+        if slo is not None:
+            from ..obs.slo import SLOTracker
+
+            self._slo = SLOTracker(slo, metrics)
+        else:
+            self._slo = None
+
+    @property
+    def slo_tracker(self):
+        """The obs.slo.SLOTracker when a policy was configured, else None
+        — the server's /health "slo" block reads snapshot() here."""
+        return self._slo
+
+    def audit_pages(self) -> list[str]:
+        """Page-accounting invariant check (paging.PagedAllocator.audit
+        over the live slot tables) — the chaos-drill oracle; [] on
+        contiguous engines and clean pools."""
+        if self._alloc is None:
+            return []
+        return self._alloc.audit([s.pages for s in self._pool])
 
     @property
     def allocator(self):
@@ -485,11 +525,12 @@ class ContinuousEngine:
         K = self.spec_k
         from .speculative import accept_or_resample, draft_tokens
 
+        self._sweep_cancelled()
         self._admit()
         pool = self._pool
         paused = self._grow_pages(pool, K, quiet)
         if all(s.free for s in pool):
-            return 0
+            return self._n_outstanding()
         st = self._stage_spec
         st_pos = self._stage_i32  # row 1 = per-slot positions, as ever
         active0 = self._stage_active
@@ -525,6 +566,8 @@ class ContinuousEngine:
         n_active0 = int(active0.sum())
         table = self._stage_tables()
         run = self._verify_program(greedy_only)
+        if self._chaos is not None:
+            self._chaos.on_dispatch()
         t0 = time.monotonic() if self._obs is not None else 0.0
         with self._span("verify", "decode", k=K, active=n_active0):
             out, cache = run(self.params, self.cache, jnp.asarray(st),
@@ -588,7 +631,7 @@ class ContinuousEngine:
             if not retired:
                 self._trim_pages(s)
         self._admit()
-        return sum(not s.free for s in pool)
+        return self._n_outstanding()
 
     def _trim_pages(self, s: _Slot) -> None:
         """Speculative rollback: drop a slot's trailing pages past the
@@ -618,6 +661,8 @@ class ContinuousEngine:
         a drafted suffix."""
         need = self._alloc.pages_for(min(n_positions, self.spec.seq_len))
         while len(s.pages) < need:
+            if self._chaos is not None and self._chaos.deny_page():
+                return False  # injected transient starvation (chaos drill)
             pid = self._alloc.alloc_page()
             if pid is None:
                 return False
@@ -646,9 +691,15 @@ class ContinuousEngine:
                 if not self._ensure_pages(s, min(s.pos + k, s.budget)):
                     paused.add(b)
             if not paused or len(paused) < active:
+                if paused:
+                    self.stats.pauses += len(paused)
+                    if self._obs is not None:
+                        self._obs.pauses.inc(len(paused))
                 return paused
             victim = max(paused, key=lambda b: pool[b].req.index)
             s = pool[victim]
+            if self._obs is not None:
+                self._obs.reject("deadlock")
             s.req.error = (
                 f"kv page pool exhausted: {self._alloc.n_pages} pages of "
                 f"{self.page_size} positions, all pinned by concurrent "
@@ -693,12 +744,13 @@ class ContinuousEngine:
         if k <= 1:
             return self.step_once(quiet=quiet)
         jnp = self.jnp
+        self._sweep_cancelled()
         self._admit()
         pool = self._pool
         paused = (self._grow_pages(pool, k, quiet)
                   if self._alloc is not None else ())
         if all(s.free for s in pool):
-            return 0
+            return self._n_outstanding()
         B = self.slots
         st_i32, st_f32 = self._stage_i32, self._stage_f32
         active0 = self._stage_active
@@ -730,6 +782,8 @@ class ContinuousEngine:
         table = (self._stage_tables() if self._alloc is not None
                  else jnp.zeros((B, 0), jnp.int32))
         run = self._chain(k, greedy_only=not st_f32[0].any())
+        if self._chaos is not None:
+            self._chaos.on_dispatch()
         t0 = time.monotonic() if self._obs is not None else 0.0
         with self._span("chain", "decode", steps=k, active=n_active0):
             cache, toks, acts = run(
@@ -776,7 +830,7 @@ class ContinuousEngine:
                 if self._advance(s, int(toks[i, b]), quiet, sampled=sampled):
                     break
         self._admit()
-        return sum(not s.free for s in pool)
+        return self._n_outstanding()
 
     def _span(self, name: str, cat: str, **meta):
         """A timeline span when tracing is on; a free nullcontext when the
@@ -797,8 +851,49 @@ class ContinuousEngine:
             self._submitted += 1
             self._queue.append(req)
             if self._obs is not None:
-                self._obs.queued.set(len(self._queue))
+                self._obs.set_queue_depth(len(self._queue))
         return req
+
+    def cancel(self, req: Request) -> None:
+        """Cancel a request NOW, from any thread (the server's
+        mid-stream-disconnect path). A still-queued request is removed
+        and completed immediately; an in-flight one is marked and the
+        scheduler's pre-dispatch sweep (_sweep_cancelled) retires it —
+        freeing its slot AND its KV pages — before the next chain
+        launches, instead of letting a long fused chain decode its whole
+        span for a consumer that is gone."""
+        req.on_token = None
+        req.cancelled = True
+        with self._lock:
+            if req in self._queue:
+                self._queue.remove(req)
+                if self._obs is not None:
+                    self._obs.set_queue_depth(len(self._queue))
+            else:
+                return  # in flight (or already done): the sweep owns it
+        if self._obs is not None:
+            self._obs.cancelled.inc()
+        req.done.set()
+
+    def _sweep_cancelled(self) -> None:
+        """Retire every cancelled in-flight request BEFORE the next
+        dispatch (scheduler thread only): pages and slots free at the
+        sweep, not after another full chain. The post-dispatch checks in
+        the step paths still catch cancellations that land mid-chain."""
+        for s in self._pool:
+            if not s.free and s.req.cancelled:
+                self._retire(s, quiet=True)
+
+    def _n_outstanding(self) -> int:
+        """Active slots + queued requests — the step functions' return
+        value. Counting the QUEUE matters when admission could not place
+        anything (dry pool / injected starvation) while the pool sits
+        empty: a bare active count would read 0 and the caller's drive
+        loop (run(), the server scheduler) would stop with work still
+        waiting."""
+        with self._lock:
+            queued = len(self._queue)
+        return sum(not s.free for s in self._pool) + queued
 
     def step_once(self, quiet: bool = True) -> int:
         """Admit queued requests, run ONE device step over the pool, and
@@ -806,12 +901,13 @@ class ContinuousEngine:
         step (0 = idle: nothing queued, nothing in flight). Must be called
         from a single scheduler thread; submit() may race freely."""
         jnp = self.jnp
+        self._sweep_cancelled()
         self._admit()
         pool = self._pool
         paused = (self._grow_pages(pool, 1, quiet)
                   if self._alloc is not None else ())
         if all(s.free for s in pool):
-            return 0
+            return self._n_outstanding()
         # paused (page-starved) rows make no progress this step — exclude
         # them from occupancy exactly as step_many's active mask does
         active0 = sum(not s.free and b not in paused
@@ -821,6 +917,8 @@ class ContinuousEngine:
         for b, s in enumerate(pool):
             st[0, b] = s.token
             st[1, b] = s.pos
+        if self._chaos is not None:
+            self._chaos.on_dispatch()
         with self._span("step", "decode", active=active0):
             # one staged upload; the row splits are lazy device-side
             # slices, so the shared step program keeps its (tokens, pos)
@@ -862,7 +960,7 @@ class ContinuousEngine:
                 nxt = int(s.sampler.sample(logits[i]))
                 self._advance(s, nxt, quiet, sampled=True)
         self._admit()
-        return sum(not s.free for s in pool)
+        return self._n_outstanding()
 
     def _advance(self, s: _Slot, nxt: int, quiet: bool,
                  sampled: bool = False) -> bool:
@@ -900,7 +998,7 @@ class ContinuousEngine:
                     return None
                 req = self._queue.pop(0)
                 if self._obs is not None:
-                    self._obs.queued.set(len(self._queue))
+                    self._obs.set_queue_depth(len(self._queue))
             if not req.cancelled:
                 return req
             req.done.set()  # consumer gone before admission
@@ -915,10 +1013,13 @@ class ContinuousEngine:
         s.pages, s.shared = [], 0
         s.req, s.pos, s.token, s.forced, s.sampler = None, 0, 0, [], None
         req.t_admit = 0.0
+        self.stats.requeues += 1
+        if self._obs is not None:
+            self._obs.reject("pool_dry")
         with self._lock:
             self._queue.insert(0, req)
             if self._obs is not None:
-                self._obs.queued.set(len(self._queue))
+                self._obs.set_queue_depth(len(self._queue))
 
     def _admit_paged(self, s: _Slot) -> str:
         """Paged admission: walk the radix tree for a shared page-aligned
@@ -1096,6 +1197,10 @@ class ContinuousEngine:
             if s.req.error is None and not s.req.cancelled:
                 n_ins = min(s.pos, len(s.req.tokens))
                 self._alloc.insert_prefix(s.req.tokens[:n_ins], s.pages)
+            elif self._chaos is not None and s.req.cancelled:
+                # chaos mutation arm (leak_on_cancel): deliberately drop a
+                # page from the release so the drill audit must flag it
+                s.pages = self._chaos.filter_release(s.pages)
             self._alloc.release_pages(s.pages)
             s.pages, s.shared = [], 0
             if self._obs is not None:
@@ -1103,6 +1208,11 @@ class ContinuousEngine:
         s.req.t_finish = time.monotonic()
         if self._obs is not None:
             self._obs.record_retire(s.req, s.req.t_finish)
+        if self._slo is not None:
+            # verdict at retire (obs/slo.py): met/violated from the wall
+            # lifecycle stamps, failed on engine error; cancelled
+            # requests record nothing (client-side, not a serving SLO)
+            self._slo.observe_request(s.req, s.req.t_finish)
         if self._spans is not None and s.req.t_admit:
             # request lifecycle timestamps are time.monotonic; re-anchor the
             # admit→finish window onto the tracer's perf_counter timeline
@@ -1129,11 +1239,16 @@ class ContinuousEngine:
             pending = self._queue
             self._queue = []
             if self._obs is not None:
-                self._obs.queued.set(0)
+                self._obs.set_queue_depth(0)
         for req in pending:
             req.error = message
             if self._obs is not None:
                 self._obs.failed.inc()
+            if self._slo is not None:
+                # never admitted, but attempted: a failed attempt in its
+                # class (queue-killed work is an SLO event)
+                self._slo.observe(req.slo_class, None, None, 0,
+                                  failed=True)
             req.done.set()
         for s in self._pool:
             if not s.free:

@@ -89,6 +89,15 @@ class PagePool:
     def refcount(self, pid: int) -> int:
         return self._ref.get(pid, 0)
 
+    def refcounts(self) -> dict[int, int]:
+        """Copy of the live refcount table — the chaos-drill audit's view
+        (runtime/chaos.py); mutating the copy touches nothing."""
+        return dict(self._ref)
+
+    def free_ids(self) -> list[int]:
+        """Copy of the free list (drill introspection)."""
+        return list(self._free)
+
 
 @dataclasses.dataclass
 class _Node:
@@ -180,6 +189,15 @@ class PrefixTree:
                 stack.extend(node.children.values())
             else:
                 yield node
+
+    def nodes(self):
+        """Every node (drill introspection: each holds ONE tree ref on
+        ``node.page``)."""
+        stack = list(self._roots.values())
+        while stack:
+            node = stack.pop()
+            stack.extend(node.children.values())
+            yield node
 
     def evict_lru(self, n_pages: int) -> int:
         """Drop up to ``n_pages`` least-recently-used leaf pages that no
@@ -284,6 +302,56 @@ class PagedAllocator:
     def release_pages(self, pages) -> None:
         for pid in pages:
             self.pool.release(pid)
+
+    def audit(self, slot_page_lists) -> list[str]:
+        """Full-accounting invariant check — the post-drill gate
+        (runtime/chaos.py) and the ISSUE-8 "no leaked pages" oracle.
+
+        ``slot_page_lists`` is every live slot's page list (the engine
+        passes ``[s.pages for s in pool]``). For every physical page the
+        pool thinks is allocated, its refcount must equal (# slot-list
+        occurrences) + (1 if a tree node holds it) — a page with a
+        refcount nothing explains is a LEAK, a mapped page with no
+        refcount is a use-after-free in waiting. Also checks: the scrap
+        page is never allocated or mapped, the free list has no
+        duplicates and no allocated ids, and free + allocated covers the
+        whole pool. Returns human-readable violations ([] = clean)."""
+        problems: list[str] = []
+        expected: dict[int, int] = {}
+        for pages in slot_page_lists:
+            for pid in pages:
+                expected[pid] = expected.get(pid, 0) + 1
+        tree_pages = [n.page for n in self.tree.nodes()]
+        for pid in tree_pages:
+            expected[pid] = expected.get(pid, 0) + 1
+        refs = self.pool.refcounts()
+        for pid, want in sorted(expected.items()):
+            if pid == SCRAP_PAGE:
+                problems.append(f"scrap page {SCRAP_PAGE} is mapped by a "
+                                f"slot or the tree")
+                continue
+            have = refs.get(pid, 0)
+            if have != want:
+                problems.append(
+                    f"page {pid}: refcount {have} != {want} expected "
+                    f"(slots+tree)")
+        for pid, have in sorted(refs.items()):
+            if pid not in expected:
+                problems.append(f"page {pid}: leaked (refcount {have} but "
+                                f"no slot or tree node maps it)")
+        free = self.pool.free_ids()
+        if len(set(free)) != len(free):
+            problems.append("free list contains duplicate page ids")
+        if SCRAP_PAGE in free or SCRAP_PAGE in refs:
+            problems.append(f"scrap page {SCRAP_PAGE} entered the pool")
+        for pid in free:
+            if pid in refs:
+                problems.append(f"page {pid} is both free and allocated")
+        if len(free) + len(refs) != self.n_pages:
+            problems.append(
+                f"pool accounting: {len(free)} free + {len(refs)} "
+                f"allocated != {self.n_pages} pages")
+        return problems
 
     def reset_counters(self) -> None:
         """Zero the admission counters WITHOUT touching pool/tree state —

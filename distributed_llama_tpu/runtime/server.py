@@ -8,7 +8,8 @@ request never waits for a long one to finish.
 
 Endpoints:
   POST /generate  {"prompt": str, "steps"?: int, "temperature"?: float,
-                   "topp"?: float, "seed"?: int, "stream"?: bool}
+                   "topp"?: float, "seed"?: int, "stream"?: bool,
+                   "class"?: str  (SLO priority class, --slo policy)}
                -> {"text": str, "tokens": [int], "steps": int}
                or, with "stream": true, chunked newline-delimited JSON:
                one {"token": int, "piece": str} line per token as it
@@ -52,6 +53,12 @@ from .continuous import ContinuousEngine, Request
 _IDLE_SLEEP_S = 0.002
 
 
+class OversizedRequest(ValueError):
+    """A request the model literally cannot serve (prompt or steps beyond
+    seq_len) — its own 400 + ``admission_rejected{reason="oversized"}``
+    series, distinct from malformed-payload bad_request."""
+
+
 class InferenceServer:
     """Owns the engine, the HTTP listener, and the scheduler thread."""
 
@@ -62,11 +69,16 @@ class InferenceServer:
                  block_steps: int = 1, quiet: bool = False,
                  fast_prefill: bool = False, metrics: bool = True,
                  registry=None, page_size: int = 0, kv_pages: int = 0,
-                 spec_k: int = 0, spec_ngram: int = 3):
+                 spec_k: int = 0, spec_ngram: int = 3, slo=None,
+                 chaos=None):
         self.spec = spec
         self.tokenizer = tokenizer
         self.default_steps = steps
         self.quiet = quiet
+        # SLO policy (obs/slo.SLOPolicy) — verdicts per priority class in
+        # /health + /metrics; ``chaos`` (runtime/chaos.ChaosMonkey) arms
+        # deterministic fault injection for operator drills (--chaos)
+        self.slo_policy = slo
         # metrics default ON for the server (it IS the observability
         # surface); --no-metrics turns collection off, and /metrics then
         # 404s. Each server gets its OWN registry unless one is injected —
@@ -87,7 +99,8 @@ class InferenceServer:
                                        metrics=self.registry,
                                        page_size=page_size,
                                        kv_pages=kv_pages, spec_k=spec_k,
-                                       spec_ngram=spec_ngram)
+                                       spec_ngram=spec_ngram, slo=slo,
+                                       chaos=chaos)
         self._shutdown = threading.Event()
         server = self
 
@@ -138,12 +151,24 @@ class InferenceServer:
                 payload = {
                     "active": active,
                     "queued": queued,
+                    "queue_depth": queued,
                     "slots": eng.slots,
                     "steps": eng.stats.steps,
                     "generated_tokens": eng.stats.tokens,
                     "uptime_s": round(time.monotonic() - server._t_start, 3),
                     "occupancy": round(active / eng.slots, 4),
+                    # admission-pressure counters (ISSUE 8): page-starved
+                    # slot pauses and dry-pool head-of-queue requeues
+                    "pauses": eng.stats.pauses,
+                    "requeues": eng.stats.requeues,
                 }
+                if eng.slo_tracker is not None:
+                    # per-class attempted/met/violated/failed + attainment
+                    # + goodput (obs/slo.SLOTracker.snapshot)
+                    payload["slo"] = eng.slo_tracker.snapshot()
+                if eng._obs is not None:
+                    payload["admission_rejected"] = \
+                        eng._obs.rejected_total()
                 if eng.spec_k:
                     # speculative decoding health (ISSUE 7): proposal
                     # volume + accept rate of the n-gram self-drafter
@@ -196,9 +221,15 @@ class InferenceServer:
                 try:
                     n = int(self.headers.get("Content-Length", "0"))
                     payload = json.loads(self.rfile.read(n) or b"{}")
+                    if not isinstance(payload, dict):
+                        raise ValueError("body must be a JSON object")
                     stream = bool(payload.get("stream", False))
                     req = server.make_request(payload)
+                except OversizedRequest as e:
+                    server.count_reject("oversized")
+                    return self._json(400, {"error": str(e)})
                 except (ValueError, KeyError, TypeError) as e:
+                    server.count_reject("bad_request")
                     return self._json(400, {"error": str(e)})
                 if stream:
                     return self._stream(req)
@@ -288,11 +319,12 @@ class InferenceServer:
                     self.wfile.write(b"0\r\n\r\n")
                     self.wfile.flush()
                 except OSError:
-                    # client went away mid-stream: stop notifying and tell
-                    # the scheduler to free the slot instead of decoding
-                    # the rest of the budget for nobody
-                    req.on_token = None
-                    req.cancelled = True
+                    # client went away mid-stream: cancel in the ENGINE —
+                    # a queued request completes now, an in-flight one is
+                    # swept before the next dispatch, freeing its slot and
+                    # KV pages immediately instead of decoding the rest of
+                    # the budget (or another whole fused chain) for nobody
+                    server.engine.cancel(req)
 
         self.httpd = ThreadingHTTPServer((host, port), Handler)
         self._threads: list[threading.Thread] = []
@@ -301,6 +333,11 @@ class InferenceServer:
     def port(self) -> int:
         return self.httpd.server_address[1]
 
+    def count_reject(self, reason: str) -> None:
+        """Feed the admission_rejected{reason} series (no-op dark)."""
+        if self.engine._obs is not None:
+            self.engine._obs.reject(reason)
+
     def make_request(self, payload: dict) -> Request:
         if not isinstance(payload, dict):
             raise ValueError("body must be a JSON object")
@@ -308,17 +345,34 @@ class InferenceServer:
         if not isinstance(prompt, str):
             raise ValueError("prompt must be a string")
         steps = int(payload.get("steps", self.default_steps))
-        if not 0 < steps <= self.spec.seq_len:
-            raise ValueError(
+        if steps < 1:
+            raise ValueError(f"steps must be >= 1, got {steps}")
+        if steps > self.spec.seq_len:
+            raise OversizedRequest(
                 f"steps must be in 1..{self.spec.seq_len}, got {steps}")
         temp = payload.get("temperature")
         topp = payload.get("topp")
         seed = payload.get("seed")
+        slo_class = payload.get("class")
+        if slo_class is not None:
+            if self.slo_policy is None:
+                raise ValueError(
+                    "request names an SLO class but the server has no "
+                    "--slo policy")
+            self.slo_policy.resolve(str(slo_class))  # unknown -> 400
+            slo_class = str(slo_class)
         tokens = self.tokenizer.encode(prompt, bos=True, eos=False)
+        if len(tokens) > self.spec.seq_len:
+            # the model literally cannot hold this prompt; truncating
+            # silently would return an answer to a question never asked
+            raise OversizedRequest(
+                f"prompt encodes to {len(tokens)} positions, over the "
+                f"model's seq_len {self.spec.seq_len}")
         return Request(tokens=tokens, steps=steps,
                        temperature=None if temp is None else float(temp),
                        topp=None if topp is None else float(topp),
-                       seed=None if seed is None else int(seed))
+                       seed=None if seed is None else int(seed),
+                       slo_class=slo_class)
 
     def decode(self, req: Request) -> str:
         from .continuous import decode_stream

@@ -298,6 +298,85 @@ def test_plain_engine_spec_series_stay_zero(params):
     assert "dllama_spec_proposed_total 0" in reg.expose()
 
 
+def test_admission_pressure_series_exposed_at_zero(params):
+    """ISSUE 8 satellite: dllama_queue_depth, dllama_slot_pauses_total,
+    and the full dllama_admission_rejected_total{reason} matrix are
+    registered at engine creation — a fresh scrape shows them all at
+    zero, one HELP/TYPE header per family."""
+    from distributed_llama_tpu.runtime.continuous import ContinuousEngine
+
+    reg = Registry()
+    ContinuousEngine(SPEC, params, slots=2, temperature=0.0, topp=0.9,
+                     seed=5, metrics=reg)
+    text = reg.expose()
+    assert "# TYPE dllama_queue_depth gauge" in text
+    assert "dllama_queue_depth 0" in text
+    assert "# TYPE dllama_slot_pauses_total counter" in text
+    assert "dllama_slot_pauses_total 0" in text
+    assert text.count("# TYPE dllama_admission_rejected_total counter") == 1
+    for reason in ("pool_dry", "deadlock", "oversized", "bad_request"):
+        assert (f'dllama_admission_rejected_total{{reason="{reason}"}} 0'
+                in text)
+
+
+def test_queue_depth_tracks_legacy_gauge(params):
+    """dllama_queue_depth (the ISSUE-8 canonical name) and the legacy
+    dllama_engine_queued_requests are written together and can never
+    diverge."""
+    from distributed_llama_tpu.runtime.continuous import (ContinuousEngine,
+                                                          Request)
+
+    reg = Registry()
+    eng = ContinuousEngine(SPEC, params, slots=1, temperature=0.0,
+                           topp=0.9, seed=5, metrics=reg)
+    eng.submit(Request(tokens=[1, 5], steps=4))
+    eng.submit(Request(tokens=[1, 7], steps=4))
+    assert reg.get("dllama_queue_depth").value == 2
+    assert reg.get("dllama_engine_queued_requests").value == 2
+    while eng.step_once():
+        pass
+    assert reg.get("dllama_queue_depth").value == 0
+    assert reg.get("dllama_engine_queued_requests").value == 0
+
+
+def test_pool_dry_requeue_moves_reject_counter_and_pauses(params):
+    """Transient page starvation (chaos denial) exercises the dry-pool
+    admission path: the head-of-queue requeue counts under
+    admission_rejected{reason="pool_dry"}, pinned to stats.requeues."""
+    from distributed_llama_tpu.runtime.chaos import ChaosMonkey
+    from distributed_llama_tpu.runtime.continuous import ContinuousEngine
+
+    reg = Registry()
+    eng = ContinuousEngine(SPEC, params, slots=2, temperature=0.0,
+                           topp=0.9, seed=5, metrics=reg, page_size=4,
+                           chaos=ChaosMonkey(deny_pages=2))
+    outs, st = eng.run([[1, 5, 9]], steps=8)
+    assert outs[0]  # the request completed once the denials ran out
+    assert st.requeues >= 1
+    assert reg.get('dllama_admission_rejected_total'
+                   '{reason="pool_dry"}').value == st.requeues
+
+
+def test_page_starved_slot_pause_counts(params):
+    """A slot pausing for pages (pool oversubscribed, other slots still
+    runnable) moves dllama_slot_pauses_total in step with stats.pauses."""
+    from distributed_llama_tpu.runtime.continuous import ContinuousEngine
+
+    # 3 slots over a 5-page pool at page_size 4: the third request's
+    # growth finds the pool dry while the other two keep decoding, so it
+    # pauses (not a deadlock — len(paused) < active) until a retirement
+    # frees pages
+    reg = Registry()
+    eng = ContinuousEngine(SPEC, params, slots=3, temperature=0.0,
+                           topp=0.9, seed=5, metrics=reg, page_size=4,
+                           kv_pages=5, prefix_share=False)
+    reqs = [[1, 5, 9], [1, 7, 11], [1, 6, 13]]
+    outs, st = eng.run(reqs, steps=12)
+    assert all(outs)
+    assert st.pauses > 0
+    assert reg.get("dllama_slot_pauses_total").value == st.pauses
+
+
 def test_server_health_reports_spec_accept_rate(params):
     """ISSUE 7 satellite: /health carries the speculative block (k,
     proposed, accepted, accept_rate) when --spec-k is on."""

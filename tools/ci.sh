@@ -69,6 +69,28 @@ if [ "$tracecheck_rc" -ne 1 ]; then
          "(exit $tracecheck_rc, expected 1)" >&2
     exit 1
 fi
+# SLO observatory gate (ISSUE 8): a small deterministic loadcheck run —
+# the virtual-clock offered-load sweep held to the checked-in CPU goodput
+# band (tools/loadcheck_baseline.json) plus the FULL chaos-drill suite
+# (pool exhaustion, transient starvation, oversized prompts, disconnect,
+# latency spikes, profiler-under-load; every drill asserts no leaked
+# pages/slots, scrapeable metrics, and a still-admitting engine). The row
+# is archived next to the tracecheck artifacts.
+python tools/loadcheck.py --json > tools/ci_artifacts/loadcheck.json
+# and the gate must still CATCH a fault: with the seeded
+# leak-on-cancel mutation armed (a page deliberately dropped on every
+# cancelled-request release) the disconnect drill must exit 1 EXACTLY —
+# 2 is a usage error and would pass a naive non-zero check vacuously
+set +e
+python tools/loadcheck.py --drills-only --inject leak-on-cancel \
+    --json > /dev/null 2>&1
+loadcheck_rc=$?
+set -e
+if [ "$loadcheck_rc" -ne 1 ]; then
+    echo "ci: loadcheck did not flag the seeded page leak" \
+         "(exit $loadcheck_rc, expected 1)" >&2
+    exit 1
+fi
 if command -v clang-tidy >/dev/null 2>&1; then
     make -C csrc tidy
 else

@@ -1,0 +1,300 @@
+"""loadcheck: offered-load sweep + chaos drills, gated like tracecheck.
+
+The SLO observatory's CLI (ISSUE 8). Builds a small synthetic-weight
+engine on the current backend, replays a seeded loadgen workload at each
+point of an offered-load sweep up to saturation, and reports the curve
+serving systems are actually judged by: GOODPUT (sampled tokens of
+SLO-met requests per time unit) and per-class attainment vs offered load.
+Then runs the full runtime/chaos.py drill suite — every drill asserts the
+post-fault invariants (no leaked pages/slots, scrapeable metrics, engine
+still admitting).
+
+The sweep runs on loadgen's VIRTUAL clock (one device step = one time
+unit), so the curve is a pure function of the scheduler + model stream —
+deterministic on any box — and can be held to the checked-in CPU baseline
+band (tools/loadcheck_baseline.json) the way tracecheck holds collective
+drift. Exit 0 = curve within band and every drill passed; 1 = regression
+or drill failure; 2 = usage/baseline error.
+
+The final stdout line is one JSON row stamped with
+``utils/fingerprint.run_stamp`` (env fingerprint + tp_scheme/q40_body)
+plus the active engine config (page_size, kv_pages, spec_k, slots,
+block_steps) so rows stay joinable across the BENCH_* trajectory.
+
+``--inject leak-on-cancel`` arms the seeded mutation (a page leaked on
+every cancelled-request release): the disconnect drill MUST go red —
+tools/ci.sh runs this to prove the gate can fail.
+
+Usage:
+  python tools/loadcheck.py [--sweep R1,R2,...] [--requests N] [--seed N]
+      [--slots N] [--page-size P] [--kv-pages N] [--spec-k K]
+      [--block-steps K] [--baseline PATH] [--write-baseline]
+      [--sweep-only | --drills-only] [--inject leak-on-cancel]
+      [--trace-out DIR] [--json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "loadcheck_baseline.json")
+
+# the sweep's model: the test-suite small transformer shape, enlarged to
+# seq 32 so paging has room to matter
+SPEC_KW = dict(dim=64, hidden_dim=160, n_layers=2, n_heads=4, n_kv_heads=2,
+               vocab_size=128, seq_len=32)
+
+
+def _policy():
+    """The gate's SLO policy, in VIRTUAL seconds (1.0 = one device step):
+    interactive wants a first token within 12 steps of ARRIVAL (queue
+    wait counts — that is the point) and a mean token latency under 3
+    steps; batch tolerates 10x. Chosen so the default sweep's low rates
+    attain ~1.0 and the top rates visibly break — the curve must show
+    the saturation knee, or it gates nothing."""
+    from distributed_llama_tpu.obs.slo import SLOClass, SLOPolicy
+
+    return SLOPolicy((SLOClass("interactive", 12.0, 3.0),
+                      SLOClass("batch", 120.0, 30.0)))
+
+
+def _load_spec(rate: float, args):
+    from loadgen import LoadSpec
+
+    return LoadSpec(
+        rate=rate, n_requests=args.requests, arrivals=args.arrivals,
+        prompt_lens=(4, 8, 12), out_lens=(4, 8),
+        shared_prefix_rate=0.5, shared_prefix_len=2 * args.page_size,
+        n_shared_prefixes=2, classes=("interactive", "batch"),
+        class_weights=(3, 1), vocab=SPEC_KW["vocab_size"],
+        seq_len=SPEC_KW["seq_len"])
+
+
+def build_engine_factory(args, inject_leak: bool = False):
+    """A fresh-engine factory (the chaos drill contract: every drill gets
+    its own engine; faults must not bleed). With ``inject_leak`` the
+    factory arms leak_on_cancel on whatever monkey the drill brings —
+    the mutation the CI gate proves catchable."""
+    from distributed_llama_tpu.models.spec import TransformerSpec
+    from distributed_llama_tpu.models.synth import synth_params
+    from distributed_llama_tpu.obs.metrics import Registry
+    from distributed_llama_tpu.runtime.chaos import ChaosMonkey
+    from distributed_llama_tpu.runtime.continuous import ContinuousEngine
+
+    spec = TransformerSpec(**SPEC_KW)
+    params = synth_params(spec, q40=False, seed=4, scale=0.3)
+
+    def make_engine(chaos=None, **overrides):
+        if inject_leak:
+            if chaos is None:
+                chaos = ChaosMonkey(leak_on_cancel=True)
+            else:
+                chaos.leak_on_cancel = True
+        kw = dict(slots=args.slots, temperature=0.0, topp=0.9,
+                  seed=args.seed, metrics=Registry(),
+                  prefill_chunk=args.page_size,
+                  block_steps=args.block_steps,
+                  page_size=args.page_size, kv_pages=args.kv_pages,
+                  spec_k=args.spec_k)
+        kw.update(overrides)
+        return ContinuousEngine(spec, params, chaos=chaos, **kw)
+
+    return make_engine
+
+
+def run_sweep(args, make_engine) -> list[dict]:
+    """One LoadResult row per offered rate (fresh engine + fresh trace
+    per point, same seed — points differ only in arrival rate)."""
+    from loadgen import drive_engine, generate_trace, save_trace
+
+    policy = _policy()
+    rows = []
+    for rate in args.sweep:
+        trace = generate_trace(_load_spec(rate, args), args.seed)
+        if args.trace_out:
+            os.makedirs(args.trace_out, exist_ok=True)
+            save_trace(trace, os.path.join(
+                args.trace_out, f"trace_rate{rate:g}.json"))
+        eng = make_engine()
+        res = drive_engine(eng, trace, policy,
+                           step_cost_s=args.step_cost)
+        row = {"rate": rate, **res.to_json()}
+        rows.append(row)
+        if not args.json:
+            att = " ".join(f"{c}={a:.2f}"
+                           for c, a in res.attainment.items())
+            print(f"rate {rate:<6g} goodput {res.goodput_tps:7.3f} "
+                  f"tok/step  attainment {att}  pauses "
+                  f"{res.engine.get('pauses', 0)}")
+    return rows
+
+
+def check_baseline(rows: list[dict], path: str,
+                   write: bool) -> tuple[list[str], dict | None]:
+    """Hold each sweep point's goodput to the checked-in band. Returns
+    (failures, baseline_doc). ``write`` regenerates the band at +-10%
+    around the measured curve instead of checking."""
+    if write:
+        doc = {"kind": "loadcheck-baseline",
+               "note": "CPU virtual-clock goodput band; regenerate with "
+                       "tools/loadcheck.py --write-baseline",
+               "points": [{"rate": r["rate"],
+                           "goodput_tps": r["goodput_tps"],
+                           "band": [round(r["goodput_tps"] * 0.9, 6),
+                                    round(r["goodput_tps"] * 1.1, 6)]}
+                          for r in rows]}
+        with open(path, "w", encoding="utf-8") as fh:
+            json.dump(doc, fh, indent=1)
+            fh.write("\n")
+        return [], doc
+    if not os.path.exists(path):
+        return [f"baseline {path} missing (run --write-baseline)"], None
+    with open(path, encoding="utf-8") as fh:
+        doc = json.load(fh)
+    by_rate = {p["rate"]: p for p in doc.get("points", [])}
+    failures = []
+    for row in rows:
+        point = by_rate.get(row["rate"])
+        if point is None:
+            failures.append(f"rate {row['rate']}: no baseline point "
+                            f"(--write-baseline after changing the sweep)")
+            continue
+        lo, hi = point["band"]
+        got = row["goodput_tps"]
+        if got < lo:
+            failures.append(
+                f"rate {row['rate']}: goodput {got:.3f} below the "
+                f"baseline band [{lo:.3f}, {hi:.3f}] — a goodput "
+                f"regression")
+        elif got > hi:
+            # better-than-band is progress, not a failure; say so loudly
+            # so the band gets re-pinned
+            print(f"loadcheck: rate {row['rate']}: goodput {got:.3f} "
+                  f"ABOVE band [{lo:.3f}, {hi:.3f}] — consider "
+                  f"--write-baseline", file=sys.stderr)
+    return failures, doc
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="loadcheck",
+        description="offered-load sweep (goodput vs SLO) + chaos drills "
+                    "with a baseline-band CI gate")
+    ap.add_argument("--sweep", default="0.05,0.1,0.2,0.4,0.8,1.6",
+                    help="offered rates (requests per virtual step), "
+                         "comma-separated; >= 4 points for a curve")
+    ap.add_argument("--requests", type=int, default=24,
+                    help="requests per sweep point")
+    ap.add_argument("--arrivals", default="bursty",
+                    choices=("poisson", "bursty"))
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--slots", type=int, default=4)
+    ap.add_argument("--page-size", type=int, default=4)
+    ap.add_argument("--kv-pages", type=int, default=20,
+                    help="pool pages (default oversubscribes 4 slots x 8 "
+                         "max pages = 32 down to 20 so admission pressure "
+                         "is part of the gate)")
+    ap.add_argument("--spec-k", type=int, default=0)
+    ap.add_argument("--block-steps", type=int, default=1)
+    ap.add_argument("--step-cost", type=float, default=1.0,
+                    help="virtual seconds per device step")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE)
+    ap.add_argument("--write-baseline", action="store_true")
+    ap.add_argument("--sweep-only", action="store_true")
+    ap.add_argument("--drills-only", action="store_true")
+    ap.add_argument("--drills", default=None, metavar="NAMES",
+                    help="run only these drills (comma-separated names "
+                         "from runtime/chaos.DRILLS)")
+    ap.add_argument("--inject", default=None,
+                    choices=("leak-on-cancel",),
+                    help="arm the seeded mutation; the drill suite MUST "
+                         "go red (the CI gate's self-test)")
+    ap.add_argument("--trace-out", default=None,
+                    help="also save each sweep point's trace (replayable "
+                         "schedule archive)")
+    ap.add_argument("--json", action="store_true",
+                    help="suppress the tables; still prints the one "
+                         "final JSON row")
+    args = ap.parse_args(argv)
+    try:
+        args.sweep = [float(r) for r in str(args.sweep).split(",") if r]
+    except ValueError as e:
+        print(f"loadcheck: bad --sweep: {e}", file=sys.stderr)
+        return 2
+    if not args.drills_only and len(args.sweep) < 4:
+        print(f"loadcheck: a goodput curve needs >= 4 load points, got "
+              f"{len(args.sweep)}", file=sys.stderr)
+        return 2
+    if args.sweep_only and args.drills_only:
+        print("loadcheck: --sweep-only and --drills-only are exclusive",
+              file=sys.stderr)
+        return 2
+
+    from distributed_llama_tpu.models.spec import TransformerSpec
+    from distributed_llama_tpu.runtime.chaos import DRILLS, \
+        render_drill_table, run_drills
+    from distributed_llama_tpu.utils.fingerprint import run_stamp
+
+    make_engine = build_engine_factory(
+        args, inject_leak=args.inject == "leak-on-cancel")
+    failures: list[str] = []
+    rows: list[dict] = []
+    drill_rows: list[dict] = []
+
+    if not args.drills_only:
+        rows = run_sweep(args, make_engine)
+        base_failures, _ = check_baseline(rows, args.baseline,
+                                          args.write_baseline)
+        failures += base_failures
+
+    if not args.sweep_only:
+        which = (set(args.drills.split(",")) if args.drills else None)
+        if which is not None:
+            # a typo'd drill name must be a usage error, not a vacuous
+            # green gate with zero drills run
+            known = {name for name, _ in DRILLS}
+            unknown = sorted(which - known)
+            if unknown:
+                print(f"loadcheck: unknown drill(s) {', '.join(unknown)} "
+                      f"(have: {', '.join(sorted(known))})",
+                      file=sys.stderr)
+                return 2
+        results = run_drills(make_engine, which=which)
+        drill_rows = [r.to_json() for r in results]
+        if not args.json:
+            print(render_drill_table(results))
+        failures += [f"drill {r.name}: {'; '.join(r.violations)}"
+                     for r in results if not r.passed]
+
+    policy = _policy()
+    row = {
+        "kind": "loadcheck",
+        **run_stamp(),  # env_fingerprint + tp_scheme + q40_body
+        "config": {"slots": args.slots, "page_size": args.page_size,
+                   "kv_pages": args.kv_pages, "spec_k": args.spec_k,
+                   "block_steps": args.block_steps,
+                   "step_cost_s": args.step_cost, "seed": args.seed,
+                   "requests": args.requests, "arrivals": args.arrivals,
+                   "model": dataclasses.asdict(
+                       TransformerSpec(**SPEC_KW))},
+        "slo": [{"class": c.name, "ttft_budget_s": c.ttft_budget_s,
+                 "token_budget_s": c.token_budget_s}
+                for c in policy.classes],
+        "sweep": rows,
+        "drills": drill_rows,
+        "gate": {"verdict": "RED" if failures else "OK",
+                 "failures": failures},
+    }
+    print(json.dumps(row))
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
