@@ -631,8 +631,48 @@ def cmd_serve(argv: list[str]) -> int:
                          "key=value[,...] with step_delay_every, "
                          "step_delay_ms, deny_pages, leak_on_cancel "
                          "(runtime/chaos.ChaosMonkey)")
+    ap.add_argument("--journal", default=None, metavar="PATH",
+                    help="write-ahead request journal (runtime/journal.py): "
+                         "every admission/sampled token/retirement appends "
+                         "a record; on restart the server re-admits "
+                         "incomplete requests and their continued streams "
+                         "are bitwise the uninterrupted run's (journaled "
+                         "per-request seeds + coin cursors)")
+    ap.add_argument("--journal-fsync", default="batch",
+                    choices=("always", "batch", "off"),
+                    help="journal durability: 'always' fsyncs every record "
+                         "(power-loss safe, slowest), 'batch' fsyncs once "
+                         "per scheduler step (default; at most one "
+                         "dispatch's tokens at risk), 'off' leaves "
+                         "flushing to the OS (process-crash safe only)")
+    ap.add_argument("--watchdog-ms", type=float, default=0.0, metavar="MS",
+                    help="step watchdog (runtime/supervisor.py): a device "
+                         "dispatch exceeding this deadline marks /health "
+                         "degraded and logs — hung-device detection "
+                         "(0 = off)")
+    ap.add_argument("--drain-s", type=float, default=10.0, metavar="S",
+                    help="graceful-drain budget on SIGTERM: stop admission "
+                         "(503), let in-flight requests finish for up to S "
+                         "seconds, journal the remainder, exit 0")
+    ap.add_argument("--supervise", action="store_true",
+                    help="run serve under the crash-loop supervisor: "
+                         "respawn on non-zero exits with exponential "
+                         "backoff, forward SIGTERM for exactly-once "
+                         "graceful drain (pair with --journal so the "
+                         "respawned child recovers in-flight work)")
+    ap.add_argument("--max-restarts", type=int, default=None, metavar="N",
+                    help="(--supervise) give up after N respawns "
+                         "(default: unbounded)")
     _obs_flags(ap)
     args = ap.parse_args(argv)
+    if args.supervise:
+        # re-exec THIS serve command (supervision flags stripped) under the
+        # crash-loop wrapper — before any model load: the supervisor
+        # process must stay tiny and device-free
+        from ..runtime.supervisor import serve_child_cmd, supervise
+
+        return supervise(serve_child_cmd(argv),
+                         max_restarts=args.max_restarts)
     _apply_log_json(args)
     if args.slots < 1:
         print(f"--slots must be positive, got {args.slots}", file=sys.stderr)
@@ -655,6 +695,20 @@ def cmd_serve(argv: list[str]) -> int:
     if chaos is not None:
         print("🔶 CHAOS ARMED: deterministic fault injection is live "
               f"({args.chaos}) — drill traffic only", file=sys.stderr)
+    journal = None
+    if args.journal:
+        from ..runtime.journal import JournalCorruption, RequestJournal
+
+        try:
+            journal = RequestJournal(args.journal,
+                                     fsync=args.journal_fsync)
+        except JournalCorruption as e:
+            # non-tail damage: recovering from an untrusted history would
+            # serve wrong bytes — refuse to start, operator decides
+            print(f"serve: journal {args.journal} is corrupt: {e}\n"
+                  f"       (move it aside to start fresh, or restore a "
+                  f"good copy to recover)", file=sys.stderr)
+            return 1
 
     import jax.numpy as jnp
 
@@ -695,12 +749,17 @@ def cmd_serve(argv: list[str]) -> int:
                              page_size=args.kv_page_size,
                              kv_pages=args.kv_pages, spec_k=args.spec_k,
                              spec_ngram=args.spec_ngram, slo=slo,
-                             chaos=chaos)
+                             chaos=chaos, journal=journal,
+                             watchdog_s=args.watchdog_ms / 1e3,
+                             drain_s=args.drain_s)
     endpoints = "POST /generate, GET /health" + (
         ", GET /metrics, GET /debug/timeline, POST /profile"
         if args.metrics else "")
     print(f"🌐 serving on http://{args.host}:{server.port} "
           f"({args.slots} slots, {endpoints})")
+    if server.recovered:
+        print(f"🌐 recovered {server.recovered} journaled requests "
+              f"from {args.journal}")
     server.serve_forever()
     return 0
 

@@ -33,16 +33,31 @@ Two fetch granularities:
   host->rank assumption fails loudly instead of computing on zeros.
   Whole-file fetch remains the fallback for any topology the rank
   arithmetic can't describe.
+
+Crash safety (ISSUE 9): the ``.slices`` sidecar records a CRC32 per
+resident range, computed by reading the file BACK after the fetch — the
+sidecar vouches for what actually landed on disk, and the next fetch
+verifies each range before trusting it (a failed range re-fetches; torn
+writes and crash residue never load as weights). A connection dropped
+mid-transfer resumes through the same range machinery: progress is
+persisted to the sidecar, the socket reconnects (exponential backoff),
+and only the still-missing ranges re-fetch. ``_connect_with_retry``
+retries only TRANSIENT failures — a DNS failure or invalid address
+raises immediately instead of burning the whole connect window.
 """
 
 from __future__ import annotations
 
+import errno
+import json
 import os
 import socket
 import socketserver
 import struct
+import sys
 import threading
 import time
+import zlib
 
 from ..obs.log import log_event
 
@@ -125,19 +140,65 @@ def _recv_exact(sock: socket.socket, n: int, into=None) -> bytes | None:
     return None if into is not None else bytes(buf)
 
 
+# errno values worth retrying: the server has not bound yet, the network
+# hiccuped, or a half-open connection died. Anything else (bad address,
+# DNS failure, permission) is a configuration error — retrying it for the
+# whole connect window just delays the real diagnosis.
+_TRANSIENT_ERRNOS = frozenset(
+    getattr(errno, name) for name in (
+        "ECONNREFUSED", "ECONNRESET", "ECONNABORTED", "ETIMEDOUT",
+        "EHOSTUNREACH", "ENETUNREACH", "EHOSTDOWN", "ENETDOWN", "EPIPE",
+        "EAGAIN", "EINTR") if hasattr(errno, name))
+
+
+def _is_transient(exc: BaseException) -> bool:
+    if isinstance(exc, (ConnectionError, TimeoutError, socket.timeout)):
+        return True
+    if isinstance(exc, socket.gaierror):
+        # EAI_AGAIN is the resolver saying "not yet" (container boots
+        # before DNS is ready) — retry it; every other resolution
+        # failure is a typo retrying will not fix
+        return exc.errno == socket.EAI_AGAIN
+    return isinstance(exc, OSError) and exc.errno in _TRANSIENT_ERRNOS
+
+
 def _connect_with_retry(host: str, port: int, timeout: float,
                         connect_window: float) -> socket.socket:
-    """Retry connection-refused for up to ``connect_window`` seconds: the
+    """Retry transient connect failures for up to ``connect_window``
+    seconds with exponential backoff (50 ms doubling to a 2 s cap): the
     worker may legitimately start before the root's server binds (the
-    reference's worker likewise sits in accept() waiting for the root)."""
-    deadline = time.time() + connect_window
+    reference's worker likewise sits in accept() waiting for the root).
+    NON-transient failures — DNS errors, invalid addresses — raise
+    immediately instead of spinning out the window."""
+    deadline = time.monotonic() + connect_window
+    delay = 0.05
     while True:
         try:
             return socket.create_connection((host, port), timeout=timeout)
-        except (ConnectionRefusedError, socket.timeout, OSError):
-            if time.time() >= deadline:
+        except OSError as e:
+            if not _is_transient(e) or time.monotonic() >= deadline:
                 raise
-            time.sleep(0.25)
+            time.sleep(min(delay, max(0.0, deadline - time.monotonic())))
+            delay = min(delay * 2.0, 2.0)
+
+
+def _connect_spec(host: str, port: int, timeout: float,
+                  connect_window: float) -> tuple[socket.socket, int]:
+    """Connect and run the SPEC handshake: returns (socket, served file
+    size). A protocol-magic mismatch raises immediately — the endpoint is
+    the WRONG SERVER, which no amount of retrying fixes."""
+    s = _connect_with_retry(host, port, timeout, connect_window)
+    try:
+        s.sendall(b"SPEC\n")
+        head = _recv_exact(s, len(_MAGIC) + 8)
+    except BaseException:
+        s.close()
+        raise
+    if head[:len(_MAGIC)] != _MAGIC:
+        s.close()
+        raise ValueError("weight server protocol mismatch "
+                         f"(got {head[:len(_MAGIC)]!r})")
+    return s, struct.unpack("<q", head[len(_MAGIC):])[0]
 
 
 def merge_ranges(ranges: list[tuple[int, int]]) -> list[tuple[int, int]]:
@@ -205,49 +266,123 @@ def _sidecar_path(cache_path: str) -> str:
     return cache_path + ".slices"
 
 
-def _read_sidecar(cache_path: str, size: int) -> list[tuple[int, int]] | None:
-    """Fetched ranges of an existing sparse file; None = not a sparse file."""
-    import json
+def _crc_file_range(fh, off: int, ln: int) -> int | None:
+    """CRC32 of ``ln`` bytes at ``off`` of an open binary file; None when
+    the file is too short to cover the range."""
+    fh.seek(off)
+    crc = 0
+    remaining = ln
+    while remaining:
+        chunk = fh.read(min(_CHUNK, remaining))
+        if not chunk:
+            return None
+        crc = zlib.crc32(chunk, crc)
+        remaining -= len(chunk)
+    return crc
 
+
+def _write_sidecar(cache_path: str, size: int, ranges,
+                   crc: bool = True) -> None:
+    """Persist the sparse file's resident ranges with a CRC32 per range,
+    computed by READING THE FILE BACK — the sidecar vouches for bytes that
+    actually landed on disk, not bytes a buffer once held. Atomic (temp +
+    ``os.replace``): a kill mid-write leaves the previous sidecar, whose
+    ranges still verify. ``crc=False`` writes checksum-less (legacy
+    two-field) ranges — the mid-transfer RESUME checkpoint uses it so a
+    flaky multi-GB fetch does not re-read its whole progress on every
+    disconnect; the fetch's final sidecar always carries CRCs."""
+    entries = []
+    merged = merge_ranges(list(ranges))
+    if merged and not crc:
+        entries = [[off, ln] for off, ln in merged]
+    elif merged:
+        with open(cache_path, "rb") as fh:
+            for off, ln in merged:
+                rc = _crc_file_range(fh, off, ln)
+                if rc is None:
+                    raise ValueError(
+                        f"{cache_path} shorter than its recorded range "
+                        f"[{off}, {off + ln})")
+                entries.append([off, ln, rc])
+    tmp = _sidecar_path(cache_path) + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump({"size": size, "ranges": entries}, fh)
+    os.replace(tmp, _sidecar_path(cache_path))
+
+
+def _read_sidecar(cache_path: str, size: int) -> list[tuple[int, int]] | None:
+    """Fetched ranges of an existing sparse file; None = not a sparse file.
+
+    Ranges carrying a CRC32 (the third field) are VERIFIED against the
+    data file before being trusted — a mismatched range is dropped, so the
+    caller's range subtraction re-fetches exactly the damaged bytes.
+    Legacy two-field ranges (pre-checksum sidecars) pass through. A
+    corrupt or wrong-size sidecar yields [] — nothing usable, full
+    re-fetch of the needed ranges."""
     try:
         with open(_sidecar_path(cache_path)) as fh:
             meta = json.load(fh)
-        if meta.get("size") != size:
-            return []  # different model: nothing usable
-        return [(int(o), int(l)) for o, l in meta.get("ranges", [])]
     except FileNotFoundError:
         return None
-    except (ValueError, KeyError):
+    except ValueError:
+        return []
+    try:
+        if meta.get("size") != size:
+            return []  # different model: nothing usable
+        out: list[tuple[int, int]] = []
+        with open(cache_path, "rb") as data:
+            for entry in meta.get("ranges", []):
+                off, ln = int(entry[0]), int(entry[1])
+                if len(entry) > 2:
+                    crc = _crc_file_range(data, off, ln)
+                    if crc != int(entry[2]):
+                        # stderr: fires regardless of ``quiet`` (damage
+                        # must never pass silently) so it must not
+                        # pollute machine-readable stdout
+                        log_event(
+                            "weights.crc_mismatch",
+                            f"🔶 weight cache range [{off}, {off + ln}) "
+                            f"of {cache_path} failed its CRC — "
+                            f"re-fetching it",
+                            file=sys.stderr, path=cache_path, offset=off,
+                            length=ln)
+                        continue
+                out.append((off, ln))
+        return out
+    except (ValueError, KeyError, IndexError, TypeError, OSError):
         return []
 
 
 def fetch_model_slices(addr: str, cache_path: str, weights_float_type,
                        tp: int, ranks: set[int], quiet: bool = False,
                        timeout: float = 600.0,
-                       connect_window: float = 60.0) -> str:
+                       connect_window: float = 60.0,
+                       max_resumes: int = 8,
+                       chunk_bytes: int = _CHUNK) -> str:
     """Fetch ONLY the ranges a host with tp ranks ``ranks`` needs.
 
     The header is fetched first and parsed into the spec (the byte layout
     depends on ``weights_float_type``, which the caller knows from its own
     CLI flags — the file format itself does not encode it). The result is a
     full-size sparse file; a ``.slices`` sidecar records which ranges hold
-    real bytes, so re-runs with the same or fewer ranks skip the fetch, a
-    wider rank set tops up only the missing ranges, and a full-file cache
-    (no sidecar, right size) is always a hit. One fetcher per cache_path at
-    a time (hosts have distinct paths; the sidecar is written after the
-    data, so a killed fetch re-fetches rather than trusting holes).
+    real bytes (with a CRC32 per range, verified before re-use), so re-runs
+    with the same or fewer ranks skip the fetch, a wider rank set tops up
+    only the missing ranges, and a full-file cache (no sidecar, right size,
+    HEADER matching the served bytes) is a hit. One fetcher per cache_path
+    at a time (hosts have distinct paths; the empty sidecar is written
+    before the first data byte, so a killed fetch re-fetches rather than
+    trusting holes). A connection dropped mid-transfer resumes up to
+    ``max_resumes`` times: progress persists to the sidecar, the socket
+    reconnects, and only the still-missing ranges re-fetch —
+    ``chunk_bytes`` is the resume granularity (small files in drills
+    shrink it so a cut connection still leaves completed chunks behind).
     """
     from ..models.spec import HEADER_BYTES, TransformerSpec
 
-    host, port = addr.rsplit(":", 1)
-    with _connect_with_retry(host, int(port), timeout, connect_window) as s:
-        s.sendall(b"SPEC\n")
-        head = _recv_exact(s, len(_MAGIC) + 8)
-        if head[:len(_MAGIC)] != _MAGIC:
-            raise ValueError("weight server protocol mismatch "
-                             f"(got {head[:len(_MAGIC)]!r})")
-        size = struct.unpack("<q", head[len(_MAGIC):])[0]
-
+    host, port_s = addr.rsplit(":", 1)
+    port = int(port_s)
+    s, size = _connect_spec(host, port, timeout, connect_window)
+    try:
         s.sendall(f"GET 0 {HEADER_BYTES}\n".encode())
         raw = _recv_exact(s, HEADER_BYTES)
         spec = TransformerSpec.from_header(raw, weights_float_type,
@@ -260,16 +395,31 @@ def fetch_model_slices(addr: str, cache_path: str, weights_float_type,
         need = needed_byte_ranges(spec, tp, ranks)
 
         have = None
-        if os.path.exists(cache_path) and os.path.getsize(cache_path) == size:
+        existing = (os.path.exists(cache_path)
+                    and os.path.getsize(cache_path) == size)
+        if existing:
             have = _read_sidecar(cache_path, size)
-            if have is None:  # full file, no sidecar: everything is real
-                s.sendall(b"DONE\n")
-                if not quiet:
-                    log_event("weights.cache_hit",
-                              f"⏩ weight cache hit: {cache_path} "
-                              f"({size} bytes)",
-                              path=cache_path, bytes=size)
-                return cache_path
+            if have is None:
+                # right size, NO sidecar: claimed full file. Verify the
+                # claim against the served header before trusting it — a
+                # killed fetch that left data without a sidecar (or a
+                # hand-truncated hole file) reads as zeros here and gets
+                # re-fetched instead of loaded as weights
+                with open(cache_path, "rb") as fh:
+                    if fh.read(HEADER_BYTES) == raw:
+                        s.sendall(b"DONE\n")
+                        if not quiet:
+                            log_event("weights.cache_hit",
+                                      f"⏩ weight cache hit: {cache_path} "
+                                      f"({size} bytes)",
+                                      path=cache_path, bytes=size)
+                        return cache_path
+                log_event("weights.cache_suspect",
+                          f"🔶 {cache_path} is full-size but its header "
+                          f"does not match the served file — treating as "
+                          f"crash residue and re-fetching",
+                          file=sys.stderr, path=cache_path)
+                have = []
         missing = subtract_ranges(need, have or [])
         if not missing:
             s.sendall(b"DONE\n")
@@ -287,38 +437,90 @@ def fetch_model_slices(addr: str, cache_path: str, weights_float_type,
         dst_dir = os.path.dirname(os.path.abspath(cache_path))
         os.makedirs(dst_dir, exist_ok=True)
         done = 0
-        import json
-
-        if have is None:
+        if not have:
             # claim sparse-ness BEFORE the file can reach full size: a fetch
             # killed mid-way must leave a sidecar with no ranges, so the next
             # run re-fetches instead of misreading a right-sized holey file
             # as a complete full-file cache
-            with open(_sidecar_path(cache_path), "w") as fh:
+            tmp = _sidecar_path(cache_path) + ".tmp"
+            with open(tmp, "w") as fh:
                 json.dump({"size": size, "ranges": []}, fh)
-        with open(cache_path, "r+b" if have is not None else "wb") as out:
+            os.replace(tmp, _sidecar_path(cache_path))
+        # ``got`` grows one entry per chunk that reached the file — on a
+        # mid-transfer disconnect it IS the resume state: persist it to
+        # the sidecar, reconnect, and subtract it from ``need`` again
+        got: list[tuple[int, int]] = list(have or [])
+        resumes = 0
+        with open(cache_path, "r+b" if existing else "wb") as out:
             out.truncate(size)
-            buf = bytearray(_CHUNK)
-            for off, ln in missing:
-                out.seek(off)
-                cur = 0
-                while cur < ln:
-                    step = min(_CHUNK, ln - cur)
-                    s.sendall(f"GET {off + cur} {step}\n".encode())
-                    _recv_exact(s, step, into=memoryview(buf)[:step])
-                    out.write(memoryview(buf)[:step])
-                    cur += step
-                    done += step
-                    if not quiet and done % (256 << 20) < _CHUNK:
-                        kbs = done / 1024 / max(time.time() - t0, 1e-9)
-                        log_event("weights.fetch_progress",
-                                  f"⏩ fetched {done >> 20}/{total >> 20} "
-                                  f"MB of slices ({kbs:.0f} kB/s)",
-                                  done_bytes=done, total_bytes=total,
-                                  kb_per_s=round(kbs))
-        with open(_sidecar_path(cache_path), "w") as fh:
-            json.dump({"size": size,
-                       "ranges": merge_ranges((have or []) + need)}, fh)
+            buf = bytearray(chunk_bytes)
+            while True:
+                todo = subtract_ranges(need, got)
+                if not todo:
+                    break
+                try:
+                    for off, ln in todo:
+                        out.seek(off)
+                        cur = 0
+                        while cur < ln:
+                            step = min(chunk_bytes, ln - cur)
+                            s.sendall(f"GET {off + cur} {step}\n".encode())
+                            _recv_exact(s, step,
+                                        into=memoryview(buf)[:step])
+                            out.write(memoryview(buf)[:step])
+                            got.append((off + cur, step))
+                            cur += step
+                            done += step
+                            if not quiet and done % (256 << 20) < _CHUNK:
+                                kbs = (done / 1024
+                                       / max(time.time() - t0, 1e-9))
+                                log_event(
+                                    "weights.fetch_progress",
+                                    f"⏩ fetched {done >> 20}/"
+                                    f"{total >> 20} MB of slices "
+                                    f"({kbs:.0f} kB/s)",
+                                    done_bytes=done, total_bytes=total,
+                                    kb_per_s=round(kbs))
+                except OSError as e:
+                    if not _is_transient(e):
+                        raise  # a LOCAL fault (disk full, I/O error):
+                        #   reconnecting the socket cannot fix it
+                    resumes += 1
+                    if resumes > max_resumes:
+                        raise
+                    # mid-transfer disconnect: persist progress, reconnect,
+                    # and let the range subtraction resume where the wire
+                    # dropped — never refetch bytes already on disk.
+                    # crc=False: this is a checkpoint, not the final
+                    # sidecar — re-CRCing every resident byte per drop
+                    # would cost a full disk pass exactly when the
+                    # transfer is already degraded. The fsync is what
+                    # lets the checksum-less checkpoint vouch for its
+                    # ranges: the data must be ON DISK before the sidecar
+                    # rename can claim it (power loss between the two
+                    # would otherwise load holes as weights)
+                    out.flush()
+                    os.fsync(out.fileno())
+                    _write_sidecar(cache_path, size, got, crc=False)
+                    try:
+                        s.close()
+                    except OSError:
+                        pass
+                    log_event("weights.stream_resume",
+                              f"🔶 weight stream dropped mid-transfer "
+                              f"({type(e).__name__}: {e}); resuming "
+                              f"({resumes}/{max_resumes}) from "
+                              f"{done >> 20} MB",
+                              file=sys.stderr,
+                              error=f"{type(e).__name__}: {e}",
+                              resume=resumes, done_bytes=done)
+                    s, size2 = _connect_spec(host, port, timeout,
+                                             connect_window)
+                    if size2 != size:
+                        raise ValueError(
+                            f"served file changed size mid-fetch "
+                            f"({size} -> {size2} bytes)")
+        _write_sidecar(cache_path, size, got)
         s.sendall(b"DONE\n")
         if not quiet:
             kbs = total / 1024 / max(time.time() - t0, 1e-9)
@@ -331,6 +533,11 @@ def fetch_model_slices(addr: str, cache_path: str, weights_float_type,
                       tp_ranks=sorted(ranks),
                       seconds=round(time.time() - t0, 1),
                       kb_per_s=round(kbs))
+    finally:
+        try:
+            s.close()
+        except OSError:
+            pass
     return cache_path
 
 
@@ -346,13 +553,8 @@ def fetch_model(addr: str, cache_path: str, quiet: bool = False,
     callers should invoke it unconditionally.
     """
     host, port = addr.rsplit(":", 1)
-    with _connect_with_retry(host, int(port), timeout, connect_window) as s:
-        s.sendall(b"SPEC\n")
-        head = _recv_exact(s, len(_MAGIC) + 8)
-        if head[:len(_MAGIC)] != _MAGIC:
-            raise ValueError("weight server protocol mismatch "
-                             f"(got {head[:len(_MAGIC)]!r})")
-        size = struct.unpack("<q", head[len(_MAGIC):])[0]
+    s, size = _connect_spec(host, int(port), timeout, connect_window)
+    with s:
         if (os.path.exists(cache_path)
                 and os.path.getsize(cache_path) == size
                 # a .slices sidecar marks a SPARSE file (fetch_model_slices):

@@ -129,6 +129,18 @@ class EngineMetrics:
             "dllama_prefill_tokens_saved_total",
             "Prefill positions skipped because their pages were shared "
             "from the radix tree")
+        # crash-safety instruments (ISSUE 9): journal append volume and
+        # journal-replayed re-admissions. Pre-registered at zero like the
+        # rest — a journal-less engine still exposes them, so dashboards
+        # survive the --journal knob.
+        self.journal_records = c(
+            "dllama_journal_records_total",
+            "Write-ahead journal records appended (admit + sampled-token "
+            "+ retire lines, runtime/journal.py)")
+        self.recoveries = c(
+            "dllama_recoveries_total",
+            "Requests re-admitted from the journal by "
+            "ContinuousEngine.recover after a crash or drain")
         # speculative-decoding instruments (spec_k > 0 engines move them;
         # plain engines expose them at zero — layout-invariant scrape
         # surface, same contract as the paged-KV series above)

@@ -352,6 +352,441 @@ def drill_profiler_under_load(make_engine) -> DrillResult:
                    extra_violations=violations, trace_dir=trace_dir)
 
 
+# ------------------------------------------------------------- recovery
+# Crash-safety drills (ISSUE 9). The kill-mid-decode drill spawns a REAL
+# subprocess child, SIGKILLs it mid-decode, and proves the recovered
+# continuation is bitwise the uninterrupted run — so the parent and the
+# child must construct the SAME engine and requests from these fixed
+# constants (a factory closure cannot cross the process boundary).
+
+_RECOVERY_SPEC_KW = dict(dim=64, hidden_dim=160, n_layers=2, n_heads=4,
+                         n_kv_heads=2, vocab_size=128, seq_len=32)
+# (tokens, steps, temperature, topp, seed): one greedy, one seeded-sampled
+# — recovery must replay BOTH bitwise. Seeds chosen so neither stream hits
+# BOS before its budget (the drill needs requests that are genuinely
+# mid-decode at kill time).
+_RECOVERY_REQS = (
+    ([1, 9, 17, 25], 24, 0.0, 0.9, 501),
+    ([1, 9, 17, 42], 24, 0.9, 0.9, 502),
+)
+
+
+def _recovery_engine(journal=None, chaos=None, watchdog=None):
+    from ..models.spec import TransformerSpec
+    from ..models.synth import synth_params
+    from ..obs.metrics import Registry
+    from .continuous import ContinuousEngine
+
+    spec = TransformerSpec(**_RECOVERY_SPEC_KW)
+    params = synth_params(spec, q40=False, seed=4, scale=0.3)
+    return ContinuousEngine(spec, params, slots=2, temperature=0.8,
+                            topp=0.9, seed=11, metrics=Registry(),
+                            prefill_chunk=4, page_size=4, kv_pages=24,
+                            chaos=chaos, journal=journal, watchdog=watchdog)
+
+
+def _submit_recovery_requests(eng) -> list:
+    from .continuous import Request
+
+    reqs = []
+    for tokens, steps, temp, topp, seed in _RECOVERY_REQS:
+        r = Request(tokens=list(tokens), steps=steps, temperature=temp,
+                    topp=topp, seed=seed)
+        eng.submit(r)
+        reqs.append(r)
+    return reqs
+
+
+def recovery_child(journal_path: str) -> None:
+    """Subprocess body for the kill-mid-decode drill: serve the fixed
+    recovery workload against a write-ahead journal (fsync=always: every
+    record durable before the next dispatch) with an injected per-dispatch
+    stall widening the kill window — then spin until the parent SIGKILLs
+    us. Deliberately NEVER exits: finishing early would leave nothing to
+    recover, which the parent reports as a drill failure."""
+    from .journal import RequestJournal
+
+    journal = RequestJournal(journal_path, fsync="always")
+    eng = _recovery_engine(
+        journal=journal, chaos=ChaosMonkey(step_delay_every=1,
+                                           step_delay_s=0.05))
+    _submit_recovery_requests(eng)
+    while True:
+        eng.step_many(eng.block_steps, quiet=True)
+        time.sleep(0.01)
+
+
+def drill_kill_mid_decode(make_engine, inject=frozenset()) -> DrillResult:
+    """THE crash-safety acceptance drill: SIGKILL a journaling child
+    process mid-decode, recover its journal into a fresh engine, and
+    require the continued streams to be BITWISE identical to an
+    uninterrupted reference run — greedy trivially, seeded-sampled via
+    coin-cursor replay — with a clean page audit afterwards.
+
+    ``inject={"corrupt-journal"}`` is the gate's mutation arm: a byte
+    smashed MID-file (not the torn tail, which is legal damage) before
+    recovery — loading must raise JournalCorruption, turning the drill
+    red (tools/ci.sh asserts loadcheck exits 1 under it)."""
+    import os
+    import signal
+    import subprocess
+    import sys
+    import tempfile
+
+    from .journal import RequestJournal, load_journal
+
+    violations: list = []
+    tmp = tempfile.mkdtemp(prefix="dllama-chaos-recovery-")
+    jpath = os.path.join(tmp, "requests.journal")
+    pkg_root = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    child = subprocess.Popen(
+        [sys.executable, "-c",
+         "from distributed_llama_tpu.runtime.chaos import recovery_child; "
+         f"recovery_child({jpath!r})"],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.PIPE)
+    # wait until the journal PROVES both requests are mid-decode (>= 2
+    # durable sampled tokens each, neither retired), then kill -9
+    deadline = time.time() + 240.0
+    ready = False
+    while time.time() < deadline and child.poll() is None:
+        try:
+            entries = [e for e in load_journal(jpath) if e.status is None]
+        except Exception:  # noqa: BLE001 - not created yet / torn reads
+            entries = []
+        if (len(entries) == len(_RECOVERY_REQS)
+                and all(len(e.sampled) >= 2 for e in entries)):
+            ready = True
+            break
+        time.sleep(0.005)
+    if child.poll() is not None:
+        err = (child.stderr.read() or b"").decode("utf-8", "replace")
+        violations.append(f"child exited rc={child.returncode} before the "
+                          f"kill: {err[-300:]}")
+    else:
+        if not ready:
+            violations.append("journal never showed both requests "
+                              "mid-decode within the window")
+        child.send_signal(signal.SIGKILL)
+    child.wait()
+    if child.stderr is not None:
+        child.stderr.close()
+
+    if "corrupt-journal" in inject:
+        # seeded mutation: damage a byte INSIDE the second record — deep
+        # enough that torn-tail repair cannot explain it away
+        with open(jpath, "rb") as fh:
+            data = fh.read()
+        pos = data.index(b"\n") + 2
+        with open(jpath, "r+b") as fh:
+            fh.seek(pos)
+            fh.write(b"\xff")
+
+    # uninterrupted reference: same engine recipe, same requests, no crash
+    ref_eng = _recovery_engine()
+    ref_reqs = _submit_recovery_requests(ref_eng)
+    _drain(ref_eng)
+    ref_outs = [r.out for r in ref_reqs]
+
+    # recovery: reopen the journal (torn-tail repair happens here; any
+    # deeper corruption raises and the gate goes red), re-admit, drain
+    journal = RequestJournal(jpath)
+    replayed = sum(len(e.sampled) for e in journal.incomplete())
+    eng = _recovery_engine(journal=journal)
+    n_recovered = eng.recover()
+    with eng._lock:
+        recovered = list(eng._queue)
+    _drain(eng)
+    if n_recovered != len(_RECOVERY_REQS):
+        violations.append(f"expected {len(_RECOVERY_REQS)} journaled "
+                          f"requests to recover, got {n_recovered}")
+    for i, req in enumerate(recovered):
+        if req.out != ref_outs[i]:
+            violations.append(
+                f"recovered stream {i} diverged from the uninterrupted "
+                f"reference (first {min(len(req.out), len(ref_outs[i]))} "
+                f"positions compared)")
+    res = _result("kill_mid_decode", eng, None,
+                  extra_violations=violations,
+                  recovered=n_recovered, replayed_tokens=replayed)
+    journal.close()
+    return res
+
+
+def drill_journal_wal(make_engine) -> DrillResult:
+    """The write-ahead journal's durability contract under an engine:
+    retired requests leave no live entries, compaction drops them from the
+    file, a TORN TAIL (crash mid-append) repairs by truncation, and
+    mid-file damage fails LOUDLY (JournalCorruption) instead of recovering
+    untrusted state."""
+    import os
+    import tempfile
+
+    from .journal import JournalCorruption, RequestJournal
+
+    tmp = tempfile.mkdtemp(prefix="dllama-chaos-journal-")
+    path = os.path.join(tmp, "requests.journal")
+    journal = RequestJournal(path, fsync="batch", compact_every=2)
+    eng = make_engine(journal=journal)
+    reqs = [[1] + [5 + (i * 7 + j) % 90 for j in range(3)]
+            for i in range(3)]
+    outs, _ = eng.run(reqs, steps=6, quiet=True)
+    journal.sync(force=True)
+    violations = []
+    if any(not o for o in outs):
+        violations.append("a journaled request produced no output")
+    if journal.incomplete():
+        violations.append("retired requests still live in the journal")
+    size_before = os.path.getsize(path)
+    # torn tail: a crash mid-append leaves a partial line — reopening must
+    # physically truncate it back to the last valid record
+    with open(path, "ab") as fh:
+        fh.write(b'{"t":"tok","id"')
+    reopened = RequestJournal(path)
+    reopened.close()
+    if os.path.getsize(path) != size_before:
+        violations.append(
+            f"torn tail not repaired: {os.path.getsize(path)} bytes after "
+            f"reopen, expected {size_before}")
+    # mid-file damage: smash a byte of the FIRST record with more records
+    # after it — this history cannot be trusted and must raise
+    corrupt = os.path.join(tmp, "corrupt.journal")
+    with open(corrupt, "wb") as fh:
+        fh.write(b'{"t":"journal","v":1}\n'
+                 b'{"t":"admit","id":0,"tokens":[1,5],"steps":4,'
+                 b'"temperature":0.0,"topp":0.9,"seed":7,"slo":null,'
+                 b'"cursor":0}\n'
+                 b'{"t":"tok","id":0,"tok":9,"cursor":0}\n')
+    with open(corrupt, "r+b") as fh:
+        fh.seek(30)
+        fh.write(b"\xff")
+    try:
+        RequestJournal(corrupt)
+        violations.append("mid-file journal corruption was silently "
+                          "accepted")
+    except JournalCorruption:
+        pass
+    res = _result("journal_wal", eng, None, extra_violations=violations,
+                  records=journal.records_total)
+    journal.close()
+    return res
+
+
+def drill_hung_dispatch(make_engine) -> DrillResult:
+    """A wedged device dispatch (injected stall far past the watchdog
+    deadline): the StepWatchdog must TRIP and degrade health while the
+    dispatch hangs, and — because this stall eventually resolves — the
+    workload must still complete and health recover to serving."""
+    from .supervisor import HealthMonitor, StepWatchdog
+
+    health = HealthMonitor()
+    health.to("serving")
+    chaos = ChaosMonkey(step_delay_every=2, step_delay_s=0.25)
+    watchdog = StepWatchdog(0.05, on_hang=lambda el: health.to("degraded"))
+    eng = make_engine(chaos=chaos, watchdog=watchdog)
+    try:
+        reqs = [[1] + [5 + (i * 5 + j) % 90 for j in range(3)]
+                for i in range(3)]
+        outs, _ = eng.run(reqs, steps=6, quiet=True)
+    finally:
+        watchdog.close()
+    violations = []
+    if watchdog.trips == 0:
+        violations.append("watchdog never tripped under an injected stall")
+    if any(not o for o in outs):
+        violations.append("a request produced no output under the stall")
+    if health.state != "degraded":
+        violations.append(f"the hang did not degrade health "
+                          f"(state {health.state!r})")
+    elif not health.to("serving"):
+        violations.append("health would not recover to serving")
+    return _result("hung_dispatch", eng, chaos,
+                   extra_violations=violations, trips=watchdog.trips)
+
+
+class _FlakyProxy:
+    """Deterministic mid-transfer disconnect injector for the
+    weight-stream drill: a TCP proxy relaying to an upstream WeightServer
+    that hard-closes the client connection after relaying ``cut_after``
+    upstream bytes — for the first ``cuts`` connections; later ones relay
+    cleanly, so a resuming fetch always finishes. ``drops`` counts cuts
+    actually injected."""
+
+    def __init__(self, upstream_host: str, upstream_port: int,
+                 cut_after: int, cuts: int = 2):
+        import socket
+        import threading
+
+        self._socket, self._threading = socket, threading
+        self.upstream = (upstream_host, upstream_port)
+        self.cut_after = cut_after
+        self.cuts = cuts
+        self.drops = 0
+        self._conns = 0
+        self._lock = threading.Lock()
+        self._listen = socket.socket()
+        self._listen.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listen.bind(("127.0.0.1", 0))
+        self._listen.listen(8)
+        self.port = self._listen.getsockname()[1]
+        threading.Thread(target=self._accept_loop, daemon=True).start()
+
+    def _accept_loop(self):
+        while True:
+            try:
+                client, _ = self._listen.accept()
+            except OSError:
+                return  # listener closed
+            with self._lock:
+                flaky = self._conns < self.cuts
+                self._conns += 1
+            self._threading.Thread(target=self._relay,
+                                   args=(client, flaky),
+                                   daemon=True).start()
+
+    def _relay(self, client, flaky: bool):
+        socket = self._socket
+        try:
+            up = socket.create_connection(self.upstream, timeout=30)
+        except OSError:
+            client.close()
+            return
+
+        def pump_requests():
+            try:
+                while True:
+                    d = client.recv(65536)
+                    if not d:
+                        break
+                    up.sendall(d)
+            except OSError:
+                pass
+
+        self._threading.Thread(target=pump_requests, daemon=True).start()
+        relayed = 0
+        try:
+            while True:
+                d = up.recv(65536)
+                if not d:
+                    break
+                if flaky and relayed + len(d) >= self.cut_after:
+                    client.sendall(d[:self.cut_after - relayed])
+                    with self._lock:
+                        self.drops += 1
+                    break  # the mid-transfer cut
+                client.sendall(d)
+                relayed += len(d)
+        except OSError:
+            pass
+        finally:
+            for sk in (client, up):
+                # shutdown BEFORE close: the pump thread's in-flight recv
+                # holds a kernel reference to the socket, so a bare close
+                # would not emit the FIN until that recv returns — the
+                # fetch client would stall on its own timeout instead of
+                # seeing the disconnect immediately
+                try:
+                    sk.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                try:
+                    sk.close()
+                except OSError:
+                    pass
+
+    def close(self):
+        try:
+            self._listen.close()
+        except OSError:
+            pass
+
+
+def drill_weight_stream_disconnect(make_engine) -> DrillResult:
+    """Mid-transfer disconnects + cache corruption on the weight stream:
+    the slice fetch must RESUME through the range machinery (reconnect,
+    re-fetch only the missing chunks) and end byte-identical to an
+    uninterrupted reference fetch; then a corrupted resident byte must
+    fail its sidecar CRC on the next fetch and be repaired."""
+    import os
+    import tempfile
+
+    import numpy as np
+
+    from ..io.loader import write_model
+    from ..io.stream import WeightServer, fetch_model_slices
+    from ..models.spec import TransformerSpec
+    from ..ops.quants import FloatType
+
+    tmp = tempfile.mkdtemp(prefix="dllama-chaos-stream-")
+    spec = TransformerSpec(dim=64, hidden_dim=160, n_layers=2, n_heads=4,
+                           n_kv_heads=2, vocab_size=300, seq_len=32,
+                           weights_float_type=FloatType.Q40)
+    rng = np.random.default_rng(5)
+
+    def t(*shape):
+        return (rng.standard_normal(shape) * 0.1).astype(np.float32)
+
+    tensors = {"tok_embedding": t(spec.vocab_size, spec.dim),
+               "rms_att": 1 + t(spec.n_layers, spec.dim),
+               "rms_ffn": 1 + t(spec.n_layers, spec.dim),
+               "rms_final": 1 + t(spec.dim),
+               "wcls": t(spec.vocab_size, spec.dim)}
+    for name, shape in spec.layer_matmul_shapes():
+        tensors[name] = t(spec.n_layers, *shape)
+    src = os.path.join(tmp, "model.bin")
+    write_model(src, spec, tensors)
+    violations: list = []
+    details: dict = {}
+    server = WeightServer(src, host="127.0.0.1")
+    proxy = _FlakyProxy("127.0.0.1", server.port, cut_after=64 << 10,
+                        cuts=2)
+    try:
+        flaky_dst = os.path.join(tmp, "flaky", "model.bin")
+        fetch_model_slices(f"127.0.0.1:{proxy.port}", flaky_dst,
+                           FloatType.Q40, 1, {0}, quiet=True,
+                           connect_window=20, max_resumes=8,
+                           chunk_bytes=16 << 10)
+        ref_dst = os.path.join(tmp, "ref", "model.bin")
+        fetch_model_slices(f"127.0.0.1:{server.port}", ref_dst,
+                           FloatType.Q40, 1, {0}, quiet=True)
+        details["drops"] = proxy.drops
+        if proxy.drops == 0:
+            violations.append("the proxy never cut a connection — the "
+                              "drill injected nothing")
+        with open(flaky_dst, "rb") as a, open(ref_dst, "rb") as b:
+            if a.read() != b.read():
+                violations.append("resumed fetch is not byte-identical to "
+                                  "the uninterrupted reference fetch")
+        # corruption arm: flip one resident byte; the sidecar CRC must
+        # catch it on the next fetch and re-fetch exactly that range
+        size = os.path.getsize(src)
+        with open(flaky_dst, "r+b") as fh:
+            fh.seek(size // 2)
+            byte = fh.read(1)
+            fh.seek(size // 2)
+            fh.write(bytes([byte[0] ^ 0xFF]))
+        fetch_model_slices(f"127.0.0.1:{server.port}", flaky_dst,
+                           FloatType.Q40, 1, {0}, quiet=True)
+        with open(flaky_dst, "rb") as a, open(ref_dst, "rb") as b:
+            if a.read() != b.read():
+                violations.append("CRC verification did not repair the "
+                                  "corrupted cache range")
+    finally:
+        proxy.close()
+        server.close()
+    return DrillResult(name="weight_stream_disconnect",
+                       passed=not violations, violations=violations,
+                       details=details)
+
+
+# drill names that make up the ISSUE 9 recovery gate (loadcheck surfaces
+# their verdicts as dedicated columns in its JSON row)
+RECOVERY_DRILLS = ("journal_wal", "kill_mid_decode", "hung_dispatch",
+                   "weight_stream_disconnect")
+
 DRILLS = (
     ("pool_exhaustion", drill_pool_exhaustion),
     ("transient_starvation", drill_transient_starvation),
@@ -359,21 +794,32 @@ DRILLS = (
     ("disconnect", drill_disconnect),
     ("latency_spike", drill_latency_spike),
     ("profiler_under_load", drill_profiler_under_load),
+    ("journal_wal", drill_journal_wal),
+    ("kill_mid_decode", drill_kill_mid_decode),
+    ("hung_dispatch", drill_hung_dispatch),
+    ("weight_stream_disconnect", drill_weight_stream_disconnect),
 )
 
 
-def run_drills(make_engine, which=None) -> list[DrillResult]:
+def run_drills(make_engine, which=None, inject=None) -> list[DrillResult]:
     """Run the drill suite against fresh engines from ``make_engine``
     (a callable accepting ``chaos=`` plus engine-constructor overrides;
     every drill gets its own engine — faults must not bleed). ``which``
-    filters by drill name. A drill that RAISES is converted into a failed
-    result — the gate must report, not crash."""
+    filters by drill name; ``inject`` names seeded mutations forwarded to
+    drills that accept them (the gate's self-test arms). A drill that
+    RAISES is converted into a failed result — the gate must report, not
+    crash."""
+    import inspect
+
+    inject = frozenset(inject or ())
     results = []
     for name, fn in DRILLS:
         if which is not None and name not in which:
             continue
+        kwargs = ({"inject": inject}
+                  if "inject" in inspect.signature(fn).parameters else {})
         try:
-            results.append(fn(make_engine))
+            results.append(fn(make_engine, **kwargs))
         except Exception as e:  # noqa: BLE001 - report, never crash the gate
             results.append(DrillResult(
                 name=name, passed=False,

@@ -60,6 +60,16 @@ class Request:
     # the tracker resolves it at retire. Ignored on engines without a
     # policy.
     slo_class: str | None = None
+    # crash recovery (runtime/journal.py): coins the request's sampler
+    # already consumed in a previous life — admission fast-forwards the
+    # xorshift stream by exactly this many draws so the continuation is
+    # bitwise the uninterrupted stream. 0 for fresh requests.
+    coin_cursor: int = 0
+    # journal id of the previous life this request replays (recover()
+    # sets it): the admit record carries it as ``recovers`` so ONE
+    # append atomically opens the new life and closes the old — a crash
+    # can never leave both live. None for fresh requests.
+    recovered_from: int | None = None
     # streaming hook: called from the scheduler thread with each token as it
     # lands in ``out`` (prompt echoes included, prefill echoes in one burst);
     # must be fast and must not raise — it runs inside the decode loop
@@ -158,7 +168,8 @@ class ContinuousEngine:
                  fast_prefill: bool = False, metrics=None,
                  page_size: int = 0, kv_pages: int = 0,
                  prefix_share: bool = True, spec_k: int = 0,
-                 spec_ngram: int = 3, slo=None, chaos=None):
+                 spec_ngram: int = 3, slo=None, chaos=None,
+                 journal=None, watchdog=None):
         import functools
 
         import jax
@@ -331,6 +342,17 @@ class ContinuousEngine:
                 self._scatter_pages = jax.jit(
                     lambda c, s, t: scatter_pages(c, s, t, page_size),
                     donate_argnums=0)
+        # write-ahead request journal (runtime/journal.py, ISSUE 9): every
+        # submit/sampled-token/retire appends a record; recover() replays
+        # incomplete requests after a crash. None = zero overhead, like
+        # the chaos and metrics handles. New request ids start past the
+        # journal's highest so appended records never alias old requests.
+        self._journal = journal
+        self._suspending = False  # drain: retire without journaling
+        # per-dispatch hang detection (runtime/supervisor.StepWatchdog):
+        # armed around every device call — decode steps, fused chains,
+        # verify dispatches, and admission prefill
+        self._watchdog = watchdog
         self._pool = [_Slot() for _ in range(slots)]
         # persistent host-side staging buffers (dlint D004): the per-step
         # pool scan writes rows here and each step ships ONE upload per
@@ -343,7 +365,7 @@ class ContinuousEngine:
         self._stage_active = np.zeros((slots,), np.bool_)
         self._queue: list[Request] = []
         self._lock = threading.Lock()
-        self._submitted = 0
+        self._submitted = 0 if journal is None else journal.next_id
         self._chains: dict = {}  # (k, greedy_only) -> fused chain program
         self.stats = ContinuousStats()
         # telemetry is opt-in: ``metrics`` is an obs.metrics.Registry; when
@@ -375,6 +397,8 @@ class ContinuousEngine:
         else:
             self._obs = None
             self._spans = None
+        if journal is not None and self._obs is not None:
+            journal.bind_metrics(self._obs.journal_records)
         # SLO verdict tracking (obs/slo.py, ISSUE 8): independent of the
         # metrics toggle — a policy without a registry still tallies
         # (loadcheck's virtual-clock engines), a registry without a
@@ -530,6 +554,7 @@ class ContinuousEngine:
         pool = self._pool
         paused = self._grow_pages(pool, K, quiet)
         if all(s.free for s in pool):
+            self._journal_sync()  # cover sweep/admit records this iteration
             return self._n_outstanding()
         st = self._stage_spec
         st_pos = self._stage_i32  # row 1 = per-slot positions, as ever
@@ -566,10 +591,13 @@ class ContinuousEngine:
         n_active0 = int(active0.sum())
         table = self._stage_tables()
         run = self._verify_program(greedy_only)
-        if self._chaos is not None:
-            self._chaos.on_dispatch()
         t0 = time.monotonic() if self._obs is not None else 0.0
-        with self._span("verify", "decode", k=K, active=n_active0):
+        with self._span("verify", "decode", k=K, active=n_active0), \
+                self._watch():
+            if self._chaos is not None:
+                self._chaos.on_dispatch()  # inside the armed window: an
+                #   injected stall is device work as far as the watchdog
+                #   can tell — exactly the hang it must detect
             out, cache = run(self.params, self.cache, jnp.asarray(st),
                              jnp.asarray(st_pos[1]), table)
             self.cache = cache
@@ -631,6 +659,7 @@ class ContinuousEngine:
             if not retired:
                 self._trim_pages(s)
         self._admit()
+        self._journal_sync()
         return self._n_outstanding()
 
     def _trim_pages(self, s: _Slot) -> None:
@@ -750,6 +779,7 @@ class ContinuousEngine:
         paused = (self._grow_pages(pool, k, quiet)
                   if self._alloc is not None else ())
         if all(s.free for s in pool):
+            self._journal_sync()  # cover sweep/admit records this iteration
             return self._n_outstanding()
         B = self.slots
         st_i32, st_f32 = self._stage_i32, self._stage_f32
@@ -782,10 +812,12 @@ class ContinuousEngine:
         table = (self._stage_tables() if self._alloc is not None
                  else jnp.zeros((B, 0), jnp.int32))
         run = self._chain(k, greedy_only=not st_f32[0].any())
-        if self._chaos is not None:
-            self._chaos.on_dispatch()
         t0 = time.monotonic() if self._obs is not None else 0.0
-        with self._span("chain", "decode", steps=k, active=n_active0):
+        with self._span("chain", "decode", steps=k, active=n_active0), \
+                self._watch():
+            if self._chaos is not None:
+                self._chaos.on_dispatch()  # inside the armed window (the
+                #   injected stall IS the hang the watchdog must detect)
             cache, toks, acts = run(
                 self.params, self.cache, jnp.asarray(st_i32),
                 jnp.asarray(active0), jnp.asarray(forced),
@@ -830,6 +862,7 @@ class ContinuousEngine:
                 if self._advance(s, int(toks[i, b]), quiet, sampled=sampled):
                     break
         self._admit()
+        self._journal_sync()
         return self._n_outstanding()
 
     def _span(self, name: str, cat: str, **meta):
@@ -840,6 +873,22 @@ class ContinuousEngine:
             return contextlib.nullcontext()
         return self._spans.span(name, cat, **meta)
 
+    def _watch(self):
+        """Arm the step watchdog around a device dispatch (supervisor.
+        StepWatchdog context manager); free when no watchdog is set."""
+        if self._watchdog is None:
+            return contextlib.nullcontext()
+        return self._watchdog
+
+    def _journal_sync(self) -> None:
+        """Step-boundary journal durability point: one fsync covering the
+        iteration's records (batch policy), plus the compaction rotation
+        check. Called at the end of every step path."""
+        if self._journal is None:
+            return
+        self._journal.sync()
+        self._journal.maybe_compact()
+
     def submit(self, req: Request) -> Request:
         """Queue a request (thread-safe; HTTP handler threads call this while
         the scheduler thread steps). ``req.done`` fires when it retires."""
@@ -849,6 +898,26 @@ class ContinuousEngine:
         with self._lock:
             req.index = self._submitted
             self._submitted += 1
+        if self._journal is not None:
+            # write-AHEAD means ahead of the SCHEDULER ever seeing the
+            # request: the admit record (with the RESOLVED sampler config
+            # — the engine-default seed is `seed + index`, which a
+            # restarted process would re-derive differently) must be
+            # journaled before the queue insert below, or a fast
+            # scheduler could sample a token for an id the journal has
+            # never admitted. Outside the engine lock: fsync=always
+            # blocks on disk here, and the id counter above already
+            # reserved our index.
+            self._journal.admit(
+                req.index, req.tokens, steps=req.steps,
+                temperature=(req.temperature if req.temperature is not None
+                             else self.temperature),
+                topp=req.topp if req.topp is not None else self.topp,
+                seed=(req.seed if req.seed is not None
+                      else self.seed + req.index),
+                slo=req.slo_class, cursor=req.coin_cursor,
+                recovers=req.recovered_from)
+        with self._lock:
             self._queue.append(req)
             if self._obs is not None:
                 self._obs.set_queue_depth(len(self._queue))
@@ -871,9 +940,69 @@ class ContinuousEngine:
                     self._obs.set_queue_depth(len(self._queue))
             else:
                 return  # in flight (or already done): the sweep owns it
+        if self._journal is not None:
+            self._journal.retire(req.index, "cancelled")
         if self._obs is not None:
             self._obs.cancelled.inc()
         req.done.set()
+
+    def recover(self, quiet: bool = True) -> int:
+        """Re-admit every incomplete journaled request (crash recovery,
+        ISSUE 9). Each entry re-enters through the NORMAL submit path as a
+        fresh request whose prompt is the original prompt PLUS the tokens
+        already sampled in the previous life: they ride the forced-token
+        window (the PR 7 prompt-chunking path), so prefill re-derives
+        their KV — mostly through the radix tree once siblings re-admit —
+        and the sampler fast-forwards to the journaled coin cursor
+        (_admit), making the continued stream BITWISE the uninterrupted
+        run's. The new admit record carries ``recovers=<old rid>``, so
+        ONE atomic append opens the new life and retires the old — a
+        crash at any point (mid-recovery included) replays exactly one
+        live entry per request. Returns the number of requests
+        re-admitted."""
+        journal = self._journal
+        if journal is None:
+            raise ValueError("recover() needs a journal (construct the "
+                             "engine with journal=...): the atomic "
+                             "old-life handoff must land in the journal "
+                             "new records are written to")
+        entries = journal.incomplete()
+        for e in entries:
+            req = Request(tokens=e.replay_tokens, steps=e.steps,
+                          temperature=e.temperature, topp=e.topp,
+                          seed=e.seed, slo_class=e.slo,
+                          coin_cursor=e.cursor, recovered_from=e.rid)
+            self.submit(req)
+            if self._obs is not None:
+                self._obs.recoveries.inc()
+            if not quiet:
+                print(f"[recover] request {e.rid} -> {req.index}: "
+                      f"{len(e.tokens)} prompt + {len(e.sampled)} sampled "
+                      f"tokens, coin cursor {e.cursor}")
+        journal.sync(force=True)
+        return len(entries)
+
+    def suspend(self, message: str = "draining: request journaled for "
+                                     "recovery") -> int:
+        """Graceful-drain wrap-up (runtime/server.py SIGTERM path): give
+        up on every still-outstanding request WITHOUT retiring it in the
+        journal — their admit + token records stay live, so the next
+        process recovers them with recover(). Waiters wake with ``error``
+        set (the stream handler ends the response; the client retries or
+        reconnects after restart). Requires a journal: suspending without
+        one would silently drop work — that is fail_all's job, and it
+        says "failed". Returns the number of requests left journaled."""
+        if self._journal is None:
+            raise ValueError("suspend() without a journal would drop "
+                             "in-flight work on the floor; use fail_all")
+        n = self._n_outstanding()
+        self._suspending = True
+        try:
+            self.fail_all(message)
+        finally:
+            self._suspending = False
+        self._journal.sync(force=True)
+        return n
 
     def _sweep_cancelled(self) -> None:
         """Retire every cancelled in-flight request BEFORE the next
@@ -907,6 +1036,7 @@ class ContinuousEngine:
         paused = (self._grow_pages(pool, 1, quiet)
                   if self._alloc is not None else ())
         if all(s.free for s in pool):
+            self._journal_sync()  # cover sweep/admit records this iteration
             return self._n_outstanding()
         # paused (page-starved) rows make no progress this step — exclude
         # them from occupancy exactly as step_many's active mask does
@@ -917,9 +1047,10 @@ class ContinuousEngine:
         for b, s in enumerate(pool):
             st[0, b] = s.token
             st[1, b] = s.pos
-        if self._chaos is not None:
-            self._chaos.on_dispatch()
-        with self._span("step", "decode", active=active0):
+        with self._span("step", "decode", active=active0), self._watch():
+            if self._chaos is not None:
+                self._chaos.on_dispatch()  # inside the armed window (the
+                #   injected stall IS the hang the watchdog must detect)
             # one staged upload; the row splits are lazy device-side
             # slices, so the shared step program keeps its (tokens, pos)
             # signature
@@ -960,6 +1091,7 @@ class ContinuousEngine:
                 nxt = int(s.sampler.sample(logits[i]))
                 self._advance(s, nxt, quiet, sampled=True)
         self._admit()
+        self._journal_sync()
         return self._n_outstanding()
 
     def _advance(self, s: _Slot, nxt: int, quiet: bool,
@@ -979,6 +1111,13 @@ class ContinuousEngine:
             self._retire(s, quiet)
             return True
         s.req.out.append(nxt)
+        if sampled and self._journal is not None:
+            # journal SAMPLED tokens only (forced echoes re-derive from
+            # the admit record) with the cumulative coin cursor — the
+            # sampler drew its coins before _advance ran, so rng.draws is
+            # already the post-token cursor (speculative accept/resample
+            # double-draws included)
+            self._journal.token(s.req.index, nxt, s.sampler.rng.draws)
         self._notify(s.req, nxt)
         self.stats.tokens += 1
         if self._obs is not None:
@@ -1001,6 +1140,8 @@ class ContinuousEngine:
                     self._obs.set_queue_depth(len(self._queue))
             if not req.cancelled:
                 return req
+            if self._journal is not None:
+                self._journal.retire(req.index, "cancelled")
             req.done.set()  # consumer gone before admission
 
     def _requeue_front(self, s: _Slot) -> None:
@@ -1089,6 +1230,13 @@ class ContinuousEngine:
                         else self.seed + req.index)
                 s.sampler = Sampler(spec.vocab_size, temp, topp, seed,
                                     use_native=self.use_native_sampler)
+                if req.coin_cursor:
+                    # journal recovery: fast-forward the xorshift stream
+                    # past the coins a previous life already consumed —
+                    # the already-sampled tokens ride the forced window
+                    # (no draws), so the first NEW sample uses exactly
+                    # the coin the uninterrupted run would have
+                    s.sampler.rng.skip(req.coin_cursor)
                 if self._alloc is not None:
                     if self._admit_paged(s) == "dry":
                         self._requeue_front(s)
@@ -1206,6 +1354,13 @@ class ContinuousEngine:
             if self._obs is not None:
                 self._obs.kv_pages_free.set(self._alloc.n_free)
         s.req.t_finish = time.monotonic()
+        if self._journal is not None and not self._suspending:
+            # a drain-suspended request writes NO retirement: its admit +
+            # token records stay live, so the next process recovers it
+            self._journal.retire(
+                s.req.index,
+                "cancelled" if s.req.cancelled
+                else "failed" if s.req.error is not None else "done")
         if self._obs is not None:
             self._obs.record_retire(s.req, s.req.t_finish)
         if self._slo is not None:
@@ -1242,6 +1397,8 @@ class ContinuousEngine:
                 self._obs.set_queue_depth(0)
         for req in pending:
             req.error = message
+            if self._journal is not None and not self._suspending:
+                self._journal.retire(req.index, "failed")
             if self._obs is not None:
                 self._obs.failed.inc()
             if self._slo is not None:
@@ -1275,8 +1432,11 @@ class ContinuousEngine:
             # per-run request indices: request i samples from seed + i, so a
             # re-used engine reproduces the same streams run after run (the
             # solo-parity contract in the module docstring); the counter
-            # keeps advancing monotonically only in online mode (server)
-            self._submitted = 0
+            # keeps advancing monotonically in online mode (server) and
+            # whenever a journal is bound — resetting would alias new
+            # journal records onto already-journaled request ids
+            if self._journal is None:
+                self._submitted = 0
         reqs = [self.submit(Request(tokens=list(r), steps=steps))
                 for r in requests]
         t0 = time.perf_counter()

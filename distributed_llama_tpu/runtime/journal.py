@@ -1,0 +1,400 @@
+"""Write-ahead request journal: crash-safe serving state (ISSUE 9).
+
+The reference is a single-shot process — a crash loses everything. The
+continuous engine already has the two properties that make real
+crash-safety CHEAP here: seeded coin-replay determinism (a request's
+token stream is a pure function of its prompt, sampler config, and coin
+cursor — rejected speculative positions and forced steps consume no
+coins), and radix prefix sharing (re-prefilling a recovered request
+mostly hits the tree once its siblings re-admit). This module adds the
+missing piece: a durable, append-only record of every request's inputs
+and progress, from which ``ContinuousEngine.recover`` re-derives the
+exact in-flight state.
+
+Format: NDJSON, one record per line, four record types —
+
+* ``{"t": "journal", "v": 1}`` — the header, always line 1;
+* ``{"t": "admit", "id", "tokens", "steps", "temperature", "topp",
+  "seed", "slo", "cursor"[, "recovers"]}`` — written at ``submit()``
+  time (write-AHEAD of the scheduler ever seeing the request). ``seed``
+  is the RESOLVED per-request seed (the engine's ``seed + index``
+  default is process-local and would not survive a restart) and
+  ``cursor`` the coin draws already consumed (non-zero only for
+  re-journaled recovered requests). ``recovers`` names the previous
+  life's id on a recovery re-admission: the ONE record opens the new
+  life and retires the old atomically;
+* ``{"t": "tok", "id", "tok", "cursor"}`` — one per SAMPLED token, with
+  the cumulative coin cursor AFTER sampling it (forced prompt echoes are
+  derivable from the admit record and are not journaled);
+* ``{"t": "retire", "id", "status"}`` — ``done`` / ``cancelled`` /
+  ``failed`` / ``recovered``; a request with a retire record (or whose
+  id a later admit ``recovers``) needs no recovery.
+
+Durability policy (``fsync=``): ``always`` fsyncs every record (survives
+power loss, slowest), ``batch`` fsyncs once per scheduler step — the
+engine calls ``sync()`` at each step boundary, so at most one dispatch's
+tokens are at risk (the default), ``off`` leaves flushing to the OS
+(process-crash-safe only). Every append is a single ``write()`` of one
+complete line either way, so a torn record can only be the file's tail.
+
+Corruption contract: a torn TAIL record (a crash mid-append) is expected
+damage — loading truncates the file at the last valid line and reports
+it. Anything else — garbage mid-file, an unknown record type, a record
+referencing an unadmitted id, a missing header — raises
+``JournalCorruption``: silently "recovering" from a journal whose
+history cannot be trusted would serve wrong bytes with a straight face.
+
+Compaction: retired requests' records are dead weight. ``compact()``
+atomically rewrites the journal as one MERGED admit record per live
+request (prompt + sampled-so-far as the token list, cursor carried
+forward — exactly the reconstruction ``recover`` performs), dropping
+everything retired. ``maybe_compact()`` applies the rotation policy
+(``compact_every`` retirements); the engine calls it at step boundaries.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+
+_HEADER = {"t": "journal", "v": 1}
+FSYNC_POLICIES = ("always", "batch", "off")
+
+
+class JournalCorruption(RuntimeError):
+    """The journal's history cannot be trusted (non-tail damage) — fail
+    loudly instead of recovering wrong state."""
+
+
+@dataclasses.dataclass
+class JournalEntry:
+    """One request's journaled state: the admit record plus every sampled
+    token appended since. ``replay_tokens`` is what recovery re-admits:
+    the prompt with the already-sampled suffix riding the forced-token
+    window, and ``cursor`` the coin draws the recovered sampler must
+    fast-forward past."""
+
+    rid: int
+    tokens: list
+    steps: int
+    temperature: float
+    topp: float
+    seed: int
+    slo: str | None = None
+    cursor: int = 0
+    sampled: list = dataclasses.field(default_factory=list)
+    status: str | None = None  # None = incomplete (needs recovery)
+
+    @property
+    def replay_tokens(self) -> list:
+        return list(self.tokens) + list(self.sampled)
+
+
+class RequestJournal:
+    """Append-side handle over one journal file (engine-owned).
+
+    Opening an existing journal loads its state (so compaction knows the
+    live set), REPAIRS a torn tail by physically truncating it, and
+    raises ``JournalCorruption`` on any deeper damage. Appends are
+    thread-safe (submit runs on handler threads, tokens on the
+    scheduler thread).
+    """
+
+    def __init__(self, path: str, fsync: str = "batch",
+                 compact_every: int = 256):
+        if fsync not in FSYNC_POLICIES:
+            raise ValueError(f"fsync policy {fsync!r} not in "
+                             f"{FSYNC_POLICIES}")
+        self.path = path
+        self.fsync = fsync
+        self.compact_every = compact_every
+        # RLock: admit/token/retire mutate ``_entries`` AND append under
+        # one critical section (submit runs on handler threads while
+        # compact() rebuilds the dict on the scheduler thread — an
+        # unlocked dict-set could vanish into the pre-compaction dict and
+        # leave a journaled request the in-memory state no longer knows)
+        self._lock = threading.RLock()
+        self._metric = None  # obs counter (.inc) — bind_metrics
+        self.records_total = 0  # appended by THIS handle
+        self._dirty = False     # unsynced appends (batch policy)
+        self._entries: dict[int, JournalEntry] = {}
+        self._n_retired = 0
+        existing = os.path.exists(path) and os.path.getsize(path) > 0
+        if existing:
+            state, valid_bytes = _load_file(path)
+            if valid_bytes < os.path.getsize(path):
+                # torn tail: a crash mid-append left a partial last line —
+                # truncate to the last valid record before appending, or
+                # the next load would see garbage MID-file and refuse
+                with open(path, "r+b") as fh:
+                    fh.truncate(valid_bytes)
+            existing = valid_bytes > 0  # fully-torn file: start fresh
+            self._entries = state
+            self._n_retired = sum(1 for e in state.values()
+                                  if e.status is not None)
+        parent = os.path.dirname(os.path.abspath(path))
+        os.makedirs(parent, exist_ok=True)
+        self._fh = open(path, "ab")
+        if not existing:
+            self._append(_HEADER)
+            self.sync(force=True)
+
+    # ------------------------------------------------------------ state
+
+    def bind_metrics(self, counter) -> None:
+        """Attach an obs counter (``dllama_journal_records_total``)."""
+        self._metric = counter
+
+    def incomplete(self) -> list[JournalEntry]:
+        """Entries with no retire record, in admission (rid) order — the
+        recovery set."""
+        with self._lock:
+            return sorted((e for e in self._entries.values()
+                           if e.status is None), key=lambda e: e.rid)
+
+    @property
+    def next_id(self) -> int:
+        """One past the highest journaled request id — a fresh engine
+        appending to this journal must start numbering here, or new
+        records would alias old requests."""
+        with self._lock:
+            return max(self._entries, default=-1) + 1
+
+    # ----------------------------------------------------------- append
+
+    def _append(self, obj: dict) -> None:
+        line = (json.dumps(obj, separators=(",", ":")) + "\n").encode()
+        with self._lock:
+            self._fh.write(line)
+            self.records_total += 1
+            if self.fsync == "always":
+                self._fh.flush()
+                os.fsync(self._fh.fileno())
+            else:
+                self._dirty = True
+        if self._metric is not None:
+            self._metric.inc()
+
+    def admit(self, rid: int, tokens, steps: int, temperature: float,
+              topp: float, seed: int, slo: str | None = None,
+              cursor: int = 0, recovers: int | None = None) -> None:
+        """Journal a request's admission. ``recovers`` names the PREVIOUS
+        life's rid when this admit is a recovery re-admission: the one
+        appended record atomically opens the new life AND retires the old
+        (status ``recovered``) — a crash on either side of a two-record
+        handoff would otherwise leave zero or two live entries for the
+        same request."""
+        entry = JournalEntry(rid=rid, tokens=list(tokens), steps=steps,
+                             temperature=temperature, topp=topp, seed=seed,
+                             slo=slo, cursor=cursor)
+        rec = {"t": "admit", "id": rid, "tokens": entry.tokens,
+               "steps": steps, "temperature": temperature,
+               "topp": topp, "seed": seed, "slo": slo, "cursor": cursor}
+        if recovers is not None:
+            rec["recovers"] = int(recovers)
+        with self._lock:
+            self._entries[rid] = entry
+            if recovers is not None:
+                old = self._entries.get(recovers)
+                if old is not None and old.status is None:
+                    old.status = "recovered"
+                    self._n_retired += 1
+            self._append(rec)
+
+    def token(self, rid: int, tok: int, cursor: int) -> None:
+        with self._lock:
+            e = self._entries[rid]
+            e.sampled.append(int(tok))
+            e.cursor = int(cursor)
+            self._append({"t": "tok", "id": rid, "tok": int(tok),
+                          "cursor": int(cursor)})
+
+    def retire(self, rid: int, status: str = "done") -> None:
+        with self._lock:
+            e = self._entries.get(rid)
+            if e is None or e.status is not None:
+                return  # already retired (or never journaled): idempotent
+            e.status = status
+            self._n_retired += 1
+            self._append({"t": "retire", "id": rid, "status": status})
+
+    def sync(self, force: bool = False) -> None:
+        """Step-boundary durability point (batch policy): one flush+fsync
+        covering every record since the last sync. No-op when nothing is
+        dirty or the policy already synced per record."""
+        with self._lock:
+            if not (self._dirty or force):
+                return
+            self._fh.flush()
+            if self.fsync != "off" or force:
+                os.fsync(self._fh.fileno())
+            self._dirty = False
+
+    def close(self) -> None:
+        self.sync(force=True)
+        with self._lock:
+            self._fh.close()
+
+    # ------------------------------------------------------- compaction
+
+    def compact(self) -> int:
+        """Atomically rewrite the journal as merged admit records of the
+        LIVE requests only (module docstring), dropping retired ones.
+        Crash-safe: the new content lands in a sibling temp file, is
+        fsynced, and replaces the journal in one ``os.replace`` — at any
+        kill point exactly one complete journal exists. Returns the
+        number of retired requests dropped."""
+        with self._lock:
+            live = sorted((e for e in self._entries.values()
+                           if e.status is None), key=lambda e: e.rid)
+            dropped = self._n_retired
+            tmp = self.path + ".compact"
+            with open(tmp, "wb") as fh:
+                fh.write((json.dumps(_HEADER, separators=(",", ":"))
+                          + "\n").encode())
+                for e in live:
+                    fh.write((json.dumps(
+                        {"t": "admit", "id": e.rid,
+                         "tokens": e.replay_tokens, "steps": e.steps,
+                         "temperature": e.temperature, "topp": e.topp,
+                         "seed": e.seed, "slo": e.slo,
+                         "cursor": e.cursor},
+                        separators=(",", ":")) + "\n").encode())
+                fh.flush()
+                os.fsync(fh.fileno())
+            self._fh.close()
+            os.replace(tmp, self.path)
+            self._fh = open(self.path, "ab")
+            self._entries = {
+                e.rid: JournalEntry(
+                    rid=e.rid, tokens=e.replay_tokens, steps=e.steps,
+                    temperature=e.temperature, topp=e.topp, seed=e.seed,
+                    slo=e.slo, cursor=e.cursor)
+                for e in live}
+            self._n_retired = 0
+            self._dirty = False
+        return dropped
+
+    def maybe_compact(self) -> int:
+        """The rotation policy: compact once ``compact_every`` retired
+        requests have accumulated. Called at step boundaries."""
+        if self._n_retired >= self.compact_every:
+            return self.compact()
+        return 0
+
+
+def _parse_record(obj, entries: dict[int, JournalEntry],
+                  lineno: int) -> None:
+    """Apply one parsed record to the state; JournalCorruption on any
+    schema violation."""
+    if not isinstance(obj, dict) or not isinstance(obj.get("t"), str):
+        raise JournalCorruption(f"line {lineno}: not a journal record")
+    t = obj["t"]
+    try:
+        if t == "admit":
+            rid = int(obj["id"])
+            if rid in entries:
+                raise JournalCorruption(
+                    f"line {lineno}: duplicate admit for request {rid}")
+            tokens = obj["tokens"]
+            if not isinstance(tokens, list) or not tokens:
+                raise JournalCorruption(
+                    f"line {lineno}: admit {rid} has no prompt tokens")
+            entries[rid] = JournalEntry(
+                rid=rid, tokens=[int(x) for x in tokens],
+                steps=int(obj["steps"]),
+                temperature=float(obj["temperature"]),
+                topp=float(obj["topp"]), seed=int(obj["seed"]),
+                slo=obj.get("slo"), cursor=int(obj.get("cursor", 0)))
+            if obj.get("recovers") is not None:
+                # recovery re-admission: this one record also closes the
+                # previous life (see RequestJournal.admit)
+                old = entries.get(int(obj["recovers"]))
+                if old is not None and old.status is None:
+                    old.status = "recovered"
+        elif t == "tok":
+            rid = int(obj["id"])
+            e = entries.get(rid)
+            if e is None:
+                raise JournalCorruption(
+                    f"line {lineno}: token for unadmitted request {rid}")
+            if e.status is not None:
+                raise JournalCorruption(
+                    f"line {lineno}: token for retired request {rid}")
+            e.sampled.append(int(obj["tok"]))
+            e.cursor = int(obj["cursor"])
+        elif t == "retire":
+            rid = int(obj["id"])
+            e = entries.get(rid)
+            if e is None:
+                raise JournalCorruption(
+                    f"line {lineno}: retire for unadmitted request {rid}")
+            status = obj.get("status")
+            if status not in ("done", "cancelled", "failed", "recovered"):
+                raise JournalCorruption(
+                    f"line {lineno}: retire status {status!r}")
+            e.status = status
+        else:
+            raise JournalCorruption(
+                f"line {lineno}: unknown record type {t!r}")
+    except (KeyError, TypeError, ValueError) as exc:
+        raise JournalCorruption(
+            f"line {lineno}: malformed {t!r} record: {exc}") from exc
+
+
+def _load_file(path: str) -> tuple[dict[int, JournalEntry], int]:
+    """Parse a journal file. Returns (entries, valid_bytes) where
+    valid_bytes is the offset just past the last VALID record — shorter
+    than the file only for a torn tail. Raises JournalCorruption for any
+    non-tail damage (module docstring)."""
+    entries: dict[int, JournalEntry] = {}
+    with open(path, "rb") as fh:
+        data = fh.read()
+    lines = data.split(b"\n")
+    # data ending in \n splits to a trailing b"" — complete final record;
+    # anything else in the last slot is a torn (unterminated) tail
+    torn = lines.pop() if lines else b""
+    offset = 0
+    saw_header = False
+    for i, raw in enumerate(lines):
+        try:
+            obj = json.loads(raw)
+        except ValueError as exc:
+            if i == len(lines) - 1 and not torn:
+                # newline-terminated but unparsable LAST line: a torn
+                # record whose tail bytes happened to include the \n —
+                # same truncate-and-report treatment
+                return entries, offset
+            raise JournalCorruption(
+                f"line {i + 1}: unparseable record "
+                f"{raw[:64]!r}") from exc
+        if i == 0:
+            if (not isinstance(obj, dict) or obj.get("t") != "journal"
+                    or obj.get("v") != 1):
+                raise JournalCorruption(
+                    "missing or wrong journal header (line 1)")
+            saw_header = True
+        else:
+            try:
+                _parse_record(obj, entries, i + 1)
+            except JournalCorruption:
+                if i == len(lines) - 1 and not torn:
+                    # schema-torn tail (e.g. a short but valid-JSON
+                    # fragment): truncate like any other torn tail
+                    return entries, offset
+                raise
+        offset += len(raw) + 1
+    # no complete line at all (killed mid-header-write): fully torn —
+    # truncate to zero and start fresh rather than refusing a journal
+    # that never recorded anything
+    del saw_header
+    return entries, offset
+
+
+def load_journal(path: str) -> list[JournalEntry]:
+    """Read-only load: every entry (retired included), rid-sorted. The
+    torn-tail rule applies; the file is not modified."""
+    entries, _ = _load_file(path)
+    return sorted(entries.values(), key=lambda e: e.rid)

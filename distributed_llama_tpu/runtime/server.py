@@ -35,11 +35,22 @@ on its own thread; handlers only encode, submit (thread-safe), and wait on
 the request's done event. ONE scheduler thread owns the device loop
 (ContinuousEngine.step_once), sleeping briefly when idle — the JAX step and
 all slot state stay single-threaded.
+
+Crash safety (ISSUE 9): with a write-ahead journal (``journal=``,
+runtime/journal.py) the server recovers journaled in-flight requests at
+construction, a step watchdog (``watchdog_s``, runtime/supervisor.py)
+detects hung dispatches and degrades health, SIGTERM triggers a graceful
+drain — stop admission (503), finish in-flight work within ``drain_s``,
+journal the remainder, exit 0 — and the health state machine
+(starting/serving/degraded/draining/stopped) is surfaced in ``/health``
+and the ``dllama_health_state`` gauge.
 """
 
 from __future__ import annotations
 
 import json
+import signal
+import sys
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -49,6 +60,7 @@ from ..io.tokenizer import Tokenizer
 from ..models.spec import TransformerSpec
 from ..obs.log import log_event
 from .continuous import ContinuousEngine, Request
+from .supervisor import HealthMonitor, StepWatchdog
 
 _IDLE_SLEEP_S = 0.002
 
@@ -70,11 +82,13 @@ class InferenceServer:
                  fast_prefill: bool = False, metrics: bool = True,
                  registry=None, page_size: int = 0, kv_pages: int = 0,
                  spec_k: int = 0, spec_ngram: int = 3, slo=None,
-                 chaos=None):
+                 chaos=None, journal=None, watchdog_s: float = 0.0,
+                 drain_s: float = 10.0):
         self.spec = spec
         self.tokenizer = tokenizer
         self.default_steps = steps
         self.quiet = quiet
+        self.drain_s = drain_s
         # SLO policy (obs/slo.SLOPolicy) — verdicts per priority class in
         # /health + /metrics; ``chaos`` (runtime/chaos.ChaosMonkey) arms
         # deterministic fault injection for operator drills (--chaos)
@@ -90,6 +104,17 @@ class InferenceServer:
         else:
             self.registry = None
         self._t_start = time.monotonic()
+        # crash-safety surface (ISSUE 9): the health state machine is
+        # always on (a journal-less server still reports starting/serving/
+        # draining/stopped); the watchdog and journal are opt-in knobs
+        self.health = HealthMonitor(self.registry)
+        self.journal = journal
+        self._watchdog = (StepWatchdog(watchdog_s, on_hang=self._on_hang)
+                          if watchdog_s > 0 else None)
+        self._drain_hist = (self.registry.histogram(
+            "dllama_drain_seconds",
+            "Graceful-drain duration: SIGTERM to in-flight work finished "
+            "or journaled") if self.registry is not None else None)
         self.engine = ContinuousEngine(spec, params, slots, temperature,
                                        topp, seed, cache_dtype=cache_dtype,
                                        mesh=mesh,
@@ -100,8 +125,19 @@ class InferenceServer:
                                        page_size=page_size,
                                        kv_pages=kv_pages, spec_k=spec_k,
                                        spec_ngram=spec_ngram, slo=slo,
-                                       chaos=chaos)
+                                       chaos=chaos, journal=journal,
+                                       watchdog=self._watchdog)
+        # replay the previous life's unfinished requests BEFORE the
+        # listener opens: recovered work re-queues first, so a restarted
+        # server continues exactly where the crash cut it off
+        self.recovered = (self.engine.recover(quiet=quiet)
+                          if journal is not None else 0)
         self._shutdown = threading.Event()
+        self._stopped = threading.Event()  # stop() ran to completion
+        # live streaming-handler threads (the _stream loop): stop() joins
+        # these AFTER waking their requests — a blocked q.get/done.wait
+        # must not outlive the server (the thread-leak satellite)
+        self._streams: set = set()
         server = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -149,6 +185,7 @@ class InferenceServer:
                     queued = len(eng._queue)
                 active = sum(not s.free for s in eng._pool)
                 payload = {
+                    "state": server.health.state,
                     "active": active,
                     "queued": queued,
                     "queue_depth": queued,
@@ -162,6 +199,20 @@ class InferenceServer:
                     "pauses": eng.stats.pauses,
                     "requeues": eng.stats.requeues,
                 }
+                if server.journal is not None:
+                    # recovery bookkeeping: requests replayed from the
+                    # journal at startup + append volume since
+                    payload["journal"] = {
+                        "path": server.journal.path,
+                        "fsync": server.journal.fsync,
+                        "recovered": server.recovered,
+                        "records": server.journal.records_total,
+                    }
+                if server._watchdog is not None:
+                    payload["watchdog"] = {
+                        "timeout_s": server._watchdog.timeout_s,
+                        "trips": server._watchdog.trips,
+                    }
                 if eng.slo_tracker is not None:
                     # per-class attempted/met/violated/failed + attainment
                     # + goodput (obs/slo.SLOTracker.snapshot)
@@ -218,6 +269,12 @@ class InferenceServer:
                     return self._profile()
                 if self.path != "/generate":
                     return self._json(404, {"error": "unknown path"})
+                if server.health.state in ("draining", "stopped"):
+                    # drain contract: admission stops FIRST; clients get a
+                    # clean retryable refusal, never a dropped request
+                    server.count_reject("draining")
+                    return self._json(503, {"error": "server is draining; "
+                                            "retry after restart"})
                 try:
                     n = int(self.headers.get("Content-Length", "0"))
                     payload = json.loads(self.rfile.read(n) or b"{}")
@@ -294,6 +351,10 @@ class InferenceServer:
                                      + b"\r\n")
                     self.wfile.flush()
 
+                # register with the server so stop() can join this thread
+                # once the request is woken — without the registry a
+                # handler blocked in q.get outlives the server silently
+                server._streams.add(threading.current_thread())
                 server.engine.submit(req)
                 prev = req.tokens[0]
                 sent = 0
@@ -325,6 +386,8 @@ class InferenceServer:
                     # KV pages immediately instead of decoding the rest of
                     # the budget (or another whole fused chain) for nobody
                     server.engine.cancel(req)
+                finally:
+                    server._streams.discard(threading.current_thread())
 
         self.httpd = ThreadingHTTPServer((host, port), Handler)
         self._threads: list[threading.Thread] = []
@@ -379,6 +442,15 @@ class InferenceServer:
 
         return decode_stream(self.tokenizer, req.tokens[0], req.out)
 
+    def _on_hang(self, elapsed_s: float):
+        """Watchdog trip (monitor thread): a dispatch overran its deadline.
+        Detection only — mark the server degraded; the scheduler flips it
+        back to serving once dispatches complete on time again."""
+        try:
+            self.health.to("degraded")
+        except ValueError:
+            pass  # already draining/stopped: the drain verdict wins
+
     def _scheduler(self):
         while not self._shutdown.is_set():
             try:
@@ -399,8 +471,40 @@ class InferenceServer:
                 self.engine.fail_all(f"{type(e).__name__}: {e}")
                 time.sleep(0.1)
                 continue
+            if (self.health.state == "degraded"
+                    and self._watchdog is not None
+                    and not self._watchdog.overdue):
+                # the hang resolved: dispatches are landing again (never
+                # flip back while an armed dispatch is still overrunning)
+                try:
+                    self.health.to("serving")
+                except ValueError:
+                    pass  # drain/stop raced us: their state wins
             if active == 0:
                 time.sleep(_IDLE_SLEEP_S)
+
+    def _outstanding(self) -> int:
+        with self.engine._lock:
+            queued = len(self.engine._queue)
+        return queued + sum(not s.free for s in self.engine._pool)
+
+    def _scheduler_stopped(self, timeout: float) -> bool:
+        """Join the scheduler thread (started first); True once it is no
+        longer running. suspend()/fail_all() walk the slot pool, so the
+        shutdown paths must never run them concurrently with a live
+        scheduler step — when this times out (a wedged dispatch, the
+        watchdog's scenario) the caller SKIPS them: journaled work stays
+        live for the next process, which is the safe outcome."""
+        for t in self._threads[:1]:
+            t.join(timeout=timeout)
+            if t.is_alive():
+                log_event("server.scheduler_wedged",
+                          f"🔶 scheduler did not stop within {timeout:.0f}s "
+                          f"(wedged dispatch?) — leaving in-flight work "
+                          f"journaled instead of racing a live step",
+                          file=sys.stderr, timeout_s=timeout)
+                return False
+        return True
 
     def start(self):
         """Start the scheduler + HTTP threads and return (non-blocking)."""
@@ -408,20 +512,113 @@ class InferenceServer:
             t = threading.Thread(target=target, daemon=True)
             t.start()
             self._threads.append(t)
+        self.health.to("serving")
 
     def serve_forever(self):
+        """Blocking entry (cmd_serve): serve until SIGTERM or Ctrl-C, then
+        drain gracefully — stop admission, finish in-flight work within the
+        drain budget, journal whatever remains — and return (exit 0)."""
         self.start()
+        stop_requested = threading.Event()
+        prev_handler = None
         try:
-            while True:
-                time.sleep(1)
+            prev_handler = signal.signal(
+                signal.SIGTERM, lambda signum, frame: stop_requested.set())
+        except ValueError:
+            pass  # not the main thread (tests): rely on stop()/drain()
+        try:
+            while not stop_requested.is_set():
+                time.sleep(0.2)
         except KeyboardInterrupt:
             pass
         finally:
-            self.stop()
+            if prev_handler is not None:
+                signal.signal(signal.SIGTERM, prev_handler)
+            self.drain()
+
+    def drain(self, budget_s: float | None = None) -> int:
+        """Graceful shutdown: stop admission (handlers 503), let the
+        scheduler finish in-flight work for up to ``budget_s`` seconds,
+        then journal whatever is still outstanding (suspend) — or fail it
+        loudly when there is no journal — and stop. Returns the number of
+        requests left journaled for the next process."""
+        budget = self.drain_s if budget_s is None else budget_s
+        t0 = time.monotonic()
+        try:
+            self.health.to("draining")
+        except ValueError:
+            return 0  # already stopped
+        log_event("server.drain",
+                  f"🌐 draining: admission stopped, "
+                  f"{self._outstanding()} requests in flight, "
+                  f"budget {budget:.1f}s",
+                  outstanding=self._outstanding(), budget_s=budget)
+        deadline = t0 + budget
+        while self._outstanding() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        # scheduler off BEFORE suspending: a step racing a retire-less
+        # suspend could double-process a request's slot
+        self._shutdown.set()
+        sched_ok = self._scheduler_stopped(30)
+        remainder = self._outstanding()
+        if remainder and sched_ok:
+            if self.journal is not None:
+                self.engine.suspend()
+            else:
+                self.engine.fail_all("server draining: request dropped "
+                                     "(no --journal to recover from)")
+        drain_s = time.monotonic() - t0
+        if self._drain_hist is not None:
+            self._drain_hist.observe(drain_s)
+        journaled = remainder if self.journal is not None else 0
+        if not remainder:
+            msg = (f"🌐 drained in {drain_s:.2f}s: all in-flight work "
+                   f"completed")
+        elif self.journal is not None:
+            msg = (f"🌐 drained in {drain_s:.2f}s: {remainder} requests "
+                   f"journaled for recovery")
+        else:
+            msg = (f"🔶 drained in {drain_s:.2f}s: {remainder} requests "
+                   f"DROPPED (no --journal to carry them over)")
+        log_event("server.drained", msg, seconds=round(drain_s, 3),
+                  journaled=journaled, dropped=remainder - journaled)
+        self.stop()
+        return remainder
 
     def stop(self):
-        self.httpd.shutdown()
+        """Tear down every thread the server owns. Idempotent; safe from
+        any thread. Requests still outstanding are failed (use drain() for
+        the graceful path) so no handler stays blocked on done.wait or the
+        stream queue — then the streaming handler threads are JOINED, not
+        abandoned."""
+        if self._stopped.is_set():
+            return
+        self._stopped.set()
         self._shutdown.set()
-        for t in self._threads:
+        self.httpd.shutdown()
+        sched_ok = self._scheduler_stopped(30)
+        for t in self._threads[1:]:
+            t.join(timeout=5)
+        if self._outstanding() and sched_ok:
+            # stop() without drain(): wake every waiter NOW — handlers
+            # answer 500/stream-error and their threads exit. With a
+            # journal the interrupted work is suspended (recoverable),
+            # without one it is failed loudly. Skipped when the
+            # scheduler would not stop (_scheduler_stopped): walking the
+            # pool under a live step risks double-frees — the journal
+            # carries the work instead.
+            if self.journal is not None:
+                self.engine.suspend()
+            else:
+                self.engine.fail_all("server stopped")
+        for t in list(self._streams):
             t.join(timeout=5)
         self.httpd.server_close()
+        if self._watchdog is not None:
+            self._watchdog.close()
+        if self.journal is not None:
+            self.journal.close()
+        try:
+            self.health.to("stopped")
+        except ValueError:
+            pass

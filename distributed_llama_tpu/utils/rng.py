@@ -30,10 +30,19 @@ def random_f32(state: int) -> tuple[int, float]:
 
 
 class Xorshift64:
-    """Stateful wrapper used by the sampler and by test-input generation."""
+    """Stateful wrapper used by the sampler and by test-input generation.
+
+    ``draws`` counts samples produced since construction — the request
+    journal's COIN CURSOR (runtime/journal.py): a recovered request's
+    sampler fast-forwards its stream by exactly the journaled cursor, so
+    the continued token stream replays bitwise (rejected speculative
+    positions, forced prompt steps, and never-reached draft slots all
+    consume no draws, and the counter reflects that for free).
+    """
 
     def __init__(self, seed: int):
         self.state = seed & _MASK64
+        self.draws = 0
 
     def clone(self) -> "Xorshift64":
         """Throwaway copy at the current stream position — for pre-drawing
@@ -41,15 +50,32 @@ class Xorshift64:
         actually consumed (generate_fast, continuous.step_many)."""
         c = Xorshift64(0)
         c.state = self.state
+        c.draws = self.draws
         return c
 
     def u32(self) -> int:
         self.state, u = random_u32(self.state)
+        self.draws += 1
         return u
 
     def f32(self) -> float:
         self.state, f = random_f32(self.state)
+        self.draws += 1
         return f
+
+    def skip(self, n: int) -> None:
+        """Fast-forward ``n`` draws without producing samples — journal
+        recovery restores a request's sampler to its journaled coin
+        cursor so the continuation draws exactly the coins the
+        uninterrupted run would have (every sample kind advances the
+        xorshift state by one step, so skipping is kind-agnostic)."""
+        if n < 0:
+            raise ValueError(f"cannot skip {n} draws")
+        s = self.state
+        for _ in range(n):
+            s, _u = random_u32(s)
+        self.state = s
+        self.draws += n
 
     def f32_array(self, n: int) -> np.ndarray:
         """Vectorized stream of n f32 samples (same sequence as n f32() calls).
@@ -64,4 +90,5 @@ class Xorshift64:
             s, u = random_u32(s)
             out[i] = u
         self.state = s
+        self.draws += n
         return ((out >> np.uint32(8)).astype(np.float32) / np.float32(16777216.0))

@@ -55,6 +55,9 @@ SLOW_TESTS = {
     "test_forward_batch_matches_singles",
     "test_generate_prefill_on_sharded_engine",
     "test_fast_resume_crosses_loops",
+    # recovery drills that spawn a fresh jax subprocess (ISSUE 9)
+    "test_kill_mid_decode_drill_recovers_bitwise",
+    "test_corrupt_journal_turns_kill_drill_red",
 }
 
 

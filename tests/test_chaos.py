@@ -167,10 +167,18 @@ def test_cancel_in_flight_frees_pages_at_next_sweep(params):
 
 
 def test_all_drills_pass_on_healthy_engine(make_engine):
-    results = run_drills(make_engine)
-    assert [r.name for r in results] == [
+    from distributed_llama_tpu.runtime.chaos import DRILLS
+
+    assert [name for name, _ in DRILLS] == [
         "pool_exhaustion", "transient_starvation", "oversized_prompt",
-        "disconnect", "latency_spike", "profiler_under_load"]
+        "disconnect", "latency_spike", "profiler_under_load",
+        "journal_wal", "kill_mid_decode", "hung_dispatch",
+        "weight_stream_disconnect"]
+    # kill_mid_decode spawns a jax subprocess — its own slow-marked test
+    # below; everything else runs here
+    which = {name for name, _ in DRILLS} - {"kill_mid_decode"}
+    results = run_drills(make_engine, which=which)
+    assert len(results) == len(which)
     assert all(r.passed for r in results), [
         (r.name, r.violations) for r in results if not r.passed]
     # the drills actually exercised their faults
@@ -179,6 +187,31 @@ def test_all_drills_pass_on_healthy_engine(make_engine):
     assert by_name["transient_starvation"].details["denied_allocs"] == 6
     assert by_name["latency_spike"].details["injected_delays"] > 0
     assert by_name["disconnect"].details["pages_at_risk"] > 0
+    assert by_name["hung_dispatch"].details["trips"] > 0
+    assert by_name["weight_stream_disconnect"].details["drops"] > 0
+
+
+def test_kill_mid_decode_drill_recovers_bitwise(make_engine):
+    """The crash-safety acceptance drill (ISSUE 9): SIGKILL a journaling
+    subprocess mid-decode; the recovered continuation must be bitwise the
+    uninterrupted reference for greedy AND seeded-sampled requests, with
+    a clean page audit."""
+    results = run_drills(make_engine, which={"kill_mid_decode"})
+    assert len(results) == 1
+    r = results[0]
+    assert r.passed, r.violations
+    assert r.details["recovered"] == 2
+    assert r.details["replayed_tokens"] >= 4
+
+
+def test_corrupt_journal_turns_kill_drill_red(make_engine):
+    """The recovery gate's mutation arm: a byte smashed MID-journal before
+    recovery must raise JournalCorruption and fail the drill — proving
+    tools/ci.sh's exit-1 assertion can actually fire."""
+    results = run_drills(make_engine, which={"kill_mid_decode"},
+                         inject={"corrupt-journal"})
+    assert len(results) == 1 and not results[0].passed
+    assert any("JournalCorruption" in v for v in results[0].violations)
 
 
 def test_seeded_leak_turns_disconnect_drill_red(make_engine):

@@ -1,0 +1,242 @@
+"""Write-ahead request journal (runtime/journal.py, ISSUE 9): append/load
+round-trips, the torn-tail repair vs fail-loud corruption contract, fsync
+policies, and crash-safe compaction."""
+
+import json
+import os
+
+import pytest
+
+from distributed_llama_tpu.runtime.journal import (FSYNC_POLICIES,
+                                                   JournalCorruption,
+                                                   RequestJournal,
+                                                   load_journal)
+
+
+def _path(tmp_path):
+    return str(tmp_path / "requests.journal")
+
+
+def _fill(j):
+    """One retired request, one mid-decode, one queued (admit only)."""
+    j.admit(0, [1, 5, 9], steps=8, temperature=0.0, topp=0.9, seed=100)
+    j.admit(1, [1, 7], steps=8, temperature=0.9, topp=0.9, seed=101)
+    j.admit(2, [1, 2, 3], steps=4, temperature=0.0, topp=0.9, seed=102)
+    j.token(0, 42, cursor=0)
+    j.token(1, 17, cursor=1)
+    j.token(1, 33, cursor=2)
+    j.retire(0, "done")
+    j.sync(force=True)
+
+
+def test_round_trip_and_incomplete_set(tmp_path):
+    j = RequestJournal(_path(tmp_path))
+    _fill(j)
+    j.close()
+    entries = {e.rid: e for e in load_journal(_path(tmp_path))}
+    assert entries[0].status == "done"
+    assert entries[1].status is None
+    assert entries[1].sampled == [17, 33] and entries[1].cursor == 2
+    assert entries[1].replay_tokens == [1, 7, 17, 33]
+    assert entries[2].sampled == [] and entries[2].status is None
+    # reopening exposes exactly the incomplete set, rid-ordered
+    j2 = RequestJournal(_path(tmp_path))
+    assert [e.rid for e in j2.incomplete()] == [1, 2]
+    # a fresh engine must number past every journaled request
+    assert j2.next_id == 3
+    j2.close()
+
+
+def test_torn_tail_truncated_and_reported(tmp_path):
+    p = _path(tmp_path)
+    j = RequestJournal(p)
+    _fill(j)
+    j.close()
+    good = os.path.getsize(p)
+    with open(p, "ab") as fh:
+        fh.write(b'{"t":"tok","id":1,"to')  # crash mid-append
+    j2 = RequestJournal(p)  # repairs: physically truncates the tail
+    assert os.path.getsize(p) == good
+    assert [e.rid for e in j2.incomplete()] == [1, 2]
+    j2.close()
+
+
+def test_torn_tail_with_newline_truncated(tmp_path):
+    """A torn record whose garbage happens to include the terminating
+    newline is still tail damage — truncate, don't refuse."""
+    p = _path(tmp_path)
+    j = RequestJournal(p)
+    _fill(j)
+    j.close()
+    good = os.path.getsize(p)
+    with open(p, "ab") as fh:
+        fh.write(b'{"t":"tok","id\n')
+    j2 = RequestJournal(p)
+    assert os.path.getsize(p) == good
+    j2.close()
+
+
+def test_mid_file_corruption_fails_loudly(tmp_path):
+    p = _path(tmp_path)
+    j = RequestJournal(p)
+    _fill(j)
+    j.close()
+    with open(p, "r+b") as fh:
+        fh.seek(30)  # inside the first admit record, records follow
+        fh.write(b"\xff")
+    with pytest.raises(JournalCorruption):
+        RequestJournal(p)
+    with pytest.raises(JournalCorruption):
+        load_journal(p)
+
+
+@pytest.mark.parametrize("damage", [
+    b'{"t":"zap","id":0}\n',                       # unknown record type
+    b'{"t":"tok","id":99,"tok":1,"cursor":0}\n',   # unadmitted id
+    b'{"t":"retire","id":99,"status":"done"}\n',   # retire unadmitted
+    b'{"t":"retire","id":1,"status":"maybe"}\n',   # unknown status
+    b'{"t":"admit","id":1,"tokens":[],"steps":1,"temperature":0,'
+    b'"topp":0.9,"seed":1,"slo":null,"cursor":0}\n',  # duplicate + empty
+])
+def test_schema_violations_fail_loudly(tmp_path, damage):
+    p = _path(tmp_path)
+    j = RequestJournal(p)
+    _fill(j)
+    j.close()
+    with open(p, "ab") as fh:
+        fh.write(damage)
+        fh.write(b'{"t":"retire","id":2,"status":"done"}\n')  # not a tail
+    with pytest.raises(JournalCorruption):
+        load_journal(p)
+
+
+def test_missing_header_fails_loudly(tmp_path):
+    p = _path(tmp_path)
+    with open(p, "wb") as fh:
+        fh.write(b'{"t":"admit","id":0,"tokens":[1],"steps":1,'
+                 b'"temperature":0,"topp":0.9,"seed":1,"slo":null,'
+                 b'"cursor":0}\n')
+    with pytest.raises(JournalCorruption):
+        load_journal(p)
+
+
+def test_fully_torn_file_starts_fresh(tmp_path):
+    """Killed mid-header-write: no complete line at all — truncate to
+    zero and start fresh rather than refusing an empty history."""
+    p = _path(tmp_path)
+    with open(p, "wb") as fh:
+        fh.write(b'{"t":"jour')
+    j = RequestJournal(p)
+    assert j.incomplete() == []
+    j.admit(0, [1, 2], steps=4, temperature=0.0, topp=0.9, seed=5)
+    j.close()
+    assert [e.rid for e in load_journal(p)] == [0]
+
+
+def test_compaction_drops_retired_merges_live(tmp_path):
+    p = _path(tmp_path)
+    j = RequestJournal(p, compact_every=2)
+    _fill(j)
+    j.retire(2, "cancelled")
+    assert j.maybe_compact() == 2  # 2 retired >= compact_every
+    j.close()
+    entries = load_journal(p)
+    # only the live request survives, as ONE merged admit record carrying
+    # prompt + sampled-so-far and the coin cursor
+    assert [e.rid for e in entries] == [1]
+    e = entries[0]
+    assert e.tokens == [1, 7, 17, 33] and e.sampled == []
+    assert e.cursor == 2 and e.seed == 101
+    with open(p, "rb") as fh:
+        lines = fh.read().splitlines()
+    assert len(lines) == 2  # header + one merged admit
+    assert not os.path.exists(p + ".compact")
+
+
+def test_compaction_then_append_then_reload(tmp_path):
+    p = _path(tmp_path)
+    j = RequestJournal(p, compact_every=1)
+    _fill(j)
+    j.compact()
+    j.token(1, 55, cursor=3)  # appends continue on the compacted file
+    j.retire(1, "done")
+    j.close()
+    entries = {e.rid: e for e in load_journal(p)}
+    assert entries[1].status == "done"
+    assert entries[1].sampled == [55]
+
+
+def test_admit_recovers_atomically_closes_previous_life(tmp_path):
+    """A recovery re-admission is ONE record: the new admit's
+    ``recovers`` field retires the old life — a crash can never land
+    between an open and a close and leave two live entries."""
+    path = _path(tmp_path)
+    j = RequestJournal(path)
+    j.admit(0, [1, 5], steps=4, temperature=0.0, topp=0.9, seed=7)
+    j.token(0, 9, cursor=0)
+    before = j.records_total
+    j.admit(1, [1, 5, 9], steps=4, temperature=0.0, topp=0.9, seed=7,
+            recovers=0)
+    assert j.records_total == before + 1  # no separate retire append
+    j.close()
+    entries = {e.rid: e for e in load_journal(path)}
+    assert entries[0].status == "recovered"
+    assert entries[1].status is None
+    j2 = RequestJournal(path)  # append-side reload agrees
+    assert [e.rid for e in j2.incomplete()] == [1]
+    j2.close()
+
+
+def test_retire_is_idempotent_and_unknown_safe(tmp_path):
+    j = RequestJournal(_path(tmp_path))
+    j.admit(0, [1, 2], steps=4, temperature=0.0, topp=0.9, seed=5)
+    j.retire(0, "done")
+    before = j.records_total
+    j.retire(0, "failed")   # already retired: no second record
+    j.retire(99, "done")    # never journaled: no record
+    assert j.records_total == before
+    j.close()
+
+
+def test_fsync_policies(tmp_path):
+    with pytest.raises(ValueError):
+        RequestJournal(_path(tmp_path), fsync="sometimes")
+    for policy in FSYNC_POLICIES:
+        p = str(tmp_path / f"j-{policy}.journal")
+        j = RequestJournal(p, fsync=policy)
+        j.admit(0, [1, 2], steps=4, temperature=0.0, topp=0.9, seed=5)
+        j.sync()
+        j.close()
+        assert [e.rid for e in load_journal(p)] == [0]
+
+
+def test_sidecar_metrics_binding(tmp_path):
+    from distributed_llama_tpu.obs.metrics import Registry
+
+    reg = Registry()
+    c = reg.counter("dllama_journal_records_total", "test")
+    j = RequestJournal(_path(tmp_path))
+    j.bind_metrics(c)
+    j.admit(0, [1, 2], steps=4, temperature=0.0, topp=0.9, seed=5)
+    j.token(0, 9, cursor=0)
+    j.retire(0, "done")
+    j.close()
+    assert c.value == 3
+
+
+def test_wrong_slo_and_cursor_survive_round_trip(tmp_path):
+    p = _path(tmp_path)
+    j = RequestJournal(p)
+    j.admit(0, [1, 2], steps=4, temperature=0.7, topp=0.8, seed=5,
+            slo="interactive", cursor=7)
+    j.close()
+    e = load_journal(p)[0]
+    assert e.slo == "interactive" and e.cursor == 7
+    assert e.temperature == 0.7 and e.topp == 0.8
+
+
+def test_header_line_is_versioned(tmp_path):
+    p = _path(tmp_path)
+    RequestJournal(p).close()
+    with open(p) as fh:
+        assert json.loads(fh.readline()) == {"t": "journal", "v": 1}

@@ -1,0 +1,220 @@
+"""Crash recovery (ISSUE 9): ContinuousEngine.recover must replay
+journaled requests BITWISE — the continued stream equals the
+uninterrupted run's, greedy trivially and sampled via coin-cursor replay
+— across cache layouts (contiguous, paged, speculative), double crashes,
+and the graceful-drain suspend path."""
+
+import os
+
+import pytest
+
+from distributed_llama_tpu.models.spec import TransformerSpec
+from distributed_llama_tpu.models.synth import synth_params
+from distributed_llama_tpu.obs.metrics import Registry
+from distributed_llama_tpu.runtime.continuous import (ContinuousEngine,
+                                                      Request)
+from distributed_llama_tpu.runtime.journal import (RequestJournal,
+                                                   load_journal)
+
+SPEC = TransformerSpec(dim=64, hidden_dim=160, n_layers=2, n_heads=4,
+                       n_kv_heads=2, vocab_size=128, seq_len=32)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return synth_params(SPEC, q40=False, seed=4, scale=0.3)
+
+
+def _make(params, journal=None, **overrides):
+    kw = dict(slots=2, temperature=0.8, topp=0.9, seed=11,
+              metrics=Registry(), prefill_chunk=4, page_size=4,
+              kv_pages=24)
+    kw.update(overrides)
+    return ContinuousEngine(SPEC, params, journal=journal, **kw)
+
+
+def _reqs():
+    """One greedy, one seeded-sampled — both must replay bitwise."""
+    return [Request(tokens=[1, 9, 17, 25], steps=24, temperature=0.0,
+                    topp=0.9, seed=501),
+            Request(tokens=[1, 9, 17, 42], steps=24, temperature=0.9,
+                    topp=0.9, seed=502)]
+
+
+def _drain(eng):
+    while eng.step_many(eng.block_steps, quiet=True):
+        pass
+
+
+def _reference(params, **overrides):
+    eng = _make(params, **overrides)
+    reqs = _reqs()
+    for r in reqs:
+        eng.submit(r)
+    _drain(eng)
+    return [r.out for r in reqs]
+
+
+def _interrupted(params, path, n_iters=9, **overrides):
+    """Simulated SIGKILL: journal + engine, step a few times, abandon the
+    process state (no close, no retire) — only the journal survives."""
+    journal = RequestJournal(path)
+    eng = _make(params, journal=journal, **overrides)
+    reqs = _reqs()
+    for r in reqs:
+        eng.submit(r)
+    for _ in range(n_iters):
+        eng.step_many(eng.block_steps, quiet=True)
+    assert all(not r.done.is_set() for r in reqs), \
+        "interrupt point too late: nothing left to recover"
+    assert all(r.n_sampled >= 2 for r in reqs)
+    return journal
+
+
+def _recover_and_finish(params, path, **overrides):
+    journal = RequestJournal(path)
+    eng = _make(params, journal=journal, **overrides)
+    n = eng.recover()
+    with eng._lock:
+        recovered = list(eng._queue)
+    _drain(eng)
+    return eng, n, [r.out for r in recovered]
+
+
+@pytest.mark.parametrize("layout", ["paged", "contiguous", "speculative"])
+def test_recovered_streams_bitwise_identical(params, tmp_path, layout):
+    overrides = {"paged": {},
+                 "contiguous": {"page_size": 0, "kv_pages": 0},
+                 "speculative": {"spec_k": 3}}[layout]
+    ref = _reference(params, **overrides)
+    path = str(tmp_path / "j.journal")
+    _interrupted(params, path, **overrides)
+    eng, n, outs = _recover_and_finish(params, path, **overrides)
+    assert n == 2
+    assert outs[0] == ref[0]  # greedy
+    assert outs[1] == ref[1]  # seeded-sampled: coin-cursor replay
+    assert eng.audit_pages() == []
+    if eng._obs is not None:
+        assert eng._obs.recoveries.value == 2
+
+
+def test_double_crash_replays_exactly_one_life_per_request(params,
+                                                          tmp_path):
+    """Crash, recover, crash AGAIN mid-replay, recover: every recovery
+    closes the previous life with a `recovered` retire and re-admits one
+    fresh entry, so the third process still sees exactly two live
+    requests and still converges on the reference streams."""
+    ref = _reference(params)
+    path = str(tmp_path / "j.journal")
+    _interrupted(params, path)
+    # second life: recover, then die mid-replay
+    j2 = RequestJournal(path)
+    eng2 = _make(params, journal=j2)
+    assert eng2.recover() == 2
+    for _ in range(3):
+        eng2.step_many(eng2.block_steps, quiet=True)
+    # third life: exactly two live entries (old lives retired 'recovered')
+    j3 = RequestJournal(path)
+    assert len(j3.incomplete()) == 2
+    eng3 = _make(params, journal=j3)
+    assert eng3.recover() == 2
+    with eng3._lock:
+        recovered = list(eng3._queue)
+    _drain(eng3)
+    assert [r.out for r in recovered] == ref
+    assert eng3.audit_pages() == []
+
+
+def test_crash_immediately_after_recover_leaves_no_duplicates(params,
+                                                              tmp_path):
+    """Die the instant recover() returns — before a single step or a
+    clean close: the recovers-carrying admits already closed the old
+    lives, so the next process sees exactly one live entry per request
+    (not a duplicate pair per request)."""
+    ref = _reference(params)
+    path = str(tmp_path / "j.journal")
+    _interrupted(params, path)
+    j2 = RequestJournal(path)
+    eng2 = _make(params, journal=j2)
+    assert eng2.recover() == 2  # and "crash": no steps, no close
+    j3 = RequestJournal(path)
+    assert len(j3.incomplete()) == 2
+    eng3 = _make(params, journal=j3)
+    assert eng3.recover() == 2
+    with eng3._lock:
+        recovered = list(eng3._queue)
+    _drain(eng3)
+    assert [r.out for r in recovered] == ref
+    assert eng3.audit_pages() == []
+
+
+def test_suspend_journals_remainder_for_recovery(params, tmp_path):
+    """The graceful-drain wrap-up: suspend() wakes waiters with an error
+    but writes NO retirement — the journal carries the work to the next
+    process, which continues bitwise."""
+    ref = _reference(params)
+    path = str(tmp_path / "j.journal")
+    journal = RequestJournal(path)
+    eng = _make(params, journal=journal)
+    reqs = _reqs()
+    for r in reqs:
+        eng.submit(r)
+    for _ in range(6):
+        eng.step_many(eng.block_steps, quiet=True)
+    n = eng.suspend()
+    assert n == 2
+    assert all(r.done.is_set() and r.error is not None for r in reqs)
+    assert eng.audit_pages() == []
+    journal.close()
+    assert len([e for e in load_journal(path) if e.status is None]) == 2
+    _, n2, outs = _recover_and_finish(params, path)
+    assert n2 == 2 and outs == ref
+
+
+def test_suspend_without_journal_refuses(params):
+    eng = _make(params)
+    with pytest.raises(ValueError, match="journal"):
+        eng.suspend()
+    with pytest.raises(ValueError, match="journal"):
+        eng.recover()
+
+
+def test_post_recovery_ids_do_not_alias(params, tmp_path):
+    """A recovered engine numbers new requests past every journaled id —
+    new records must never alias an old request's history."""
+    path = str(tmp_path / "j.journal")
+    _interrupted(params, path)
+    journal = RequestJournal(path)
+    eng = _make(params, journal=journal)
+    eng.recover()
+    extra = Request(tokens=[1, 3, 5], steps=6, temperature=0.0)
+    eng.submit(extra)
+    _drain(eng)
+    journal.close()
+    rids = [e.rid for e in load_journal(path)]
+    assert len(rids) == len(set(rids))
+    assert extra.index == max(rids)
+    assert extra.out  # the fresh request actually ran
+
+
+def test_recovery_rides_prefix_tree(params, tmp_path):
+    """Recovered prompts re-derive their KV through admission prefill and
+    the radix tree — the first recovered sibling publishes its prefix,
+    later ones share it (the property that makes recovery cheap)."""
+    path = str(tmp_path / "j.journal")
+    journal = RequestJournal(path)
+    eng = _make(params, journal=journal, slots=4)
+    shared = [1, 7, 7, 7, 7, 7, 7, 7, 7]  # two full pages of prefix
+    reqs = [Request(tokens=shared + [20 + i], steps=20, temperature=0.0)
+            for i in range(3)]
+    for r in reqs:
+        eng.submit(r)
+    for _ in range(5):
+        eng.step_many(eng.block_steps, quiet=True)
+    journal2 = RequestJournal(path)
+    eng2 = _make(params, journal=journal2, slots=4)
+    assert eng2.recover() == 3
+    _drain(eng2)
+    # the recovered siblings shared prompt pages through the tree
+    assert eng2.allocator.prefix_hits >= 1
+    assert eng2.audit_pages() == []

@@ -277,6 +277,146 @@ def test_server_many_concurrent_mixed_clients(params):
         srv.stop()
 
 
+def test_server_stop_with_open_stream_leaves_no_threads(params, tmp_path):
+    """stop() with a client mid-stream must wake the blocked handler (its
+    q.get would otherwise outlive the server) and JOIN it — no leaked
+    threads — while the journal keeps the interrupted request recoverable
+    (ISSUE 9 satellite)."""
+    import time
+
+    from distributed_llama_tpu.runtime.chaos import ChaosMonkey
+    from distributed_llama_tpu.runtime.journal import (RequestJournal,
+                                                       load_journal)
+    from distributed_llama_tpu.runtime.server import InferenceServer
+
+    before = set(threading.enumerate())
+    jpath = str(tmp_path / "j.journal")
+    srv = InferenceServer(
+        SPEC, params, _IdTokenizer(), "127.0.0.1", 0, slots=2, steps=8,
+        temperature=0.0, topp=0.9, seed=5, quiet=True,
+        journal=RequestJournal(jpath), page_size=4, kv_pages=24,
+        # slow every dispatch so the stream is reliably OPEN at stop()
+        chaos=ChaosMonkey(step_delay_every=1, step_delay_s=0.2))
+    srv.start()
+    got: dict = {}
+
+    def client():
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/generate",
+            data=json.dumps({"prompt": "hello", "steps": 8,
+                             "stream": True}).encode())
+        try:
+            with urllib.request.urlopen(req, timeout=60) as r:
+                got["lines"] = [json.loads(ln) for ln in r if ln.strip()]
+        except Exception as e:  # noqa: BLE001 - surfaced in the asserts
+            got["error"] = e
+
+    t = threading.Thread(target=client)
+    t.start()
+    deadline = time.time() + 30
+    while time.time() < deadline and not srv._streams:
+        time.sleep(0.01)
+    assert srv._streams, "stream handler never registered"
+    srv.stop()
+    t.join(timeout=30)
+    assert not t.is_alive()
+    # the stream ended with the suspend error, not a hang or a crash
+    if "lines" in got:
+        assert got["lines"][-1].get("error")
+    # every server-owned thread is joined: scheduler, listener, handlers
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        leaked = [th for th in set(threading.enumerate()) - before
+                  if th.is_alive() and th is not t]
+        if not leaked:
+            break
+        time.sleep(0.05)
+    assert not leaked, leaked
+    assert srv.health.state == "stopped"
+    # the interrupted request survived in the journal (no retire record)
+    assert len([e for e in load_journal(jpath) if e.status is None]) == 1
+
+
+def test_server_drain_journals_remainder_and_refuses_admission(params,
+                                                               tmp_path):
+    """The graceful-drain contract (ISSUE 9): draining refuses new work
+    with a retryable 503, in-flight requests get the drain budget, and
+    whatever remains is journaled — recoverable, pages audited clean."""
+    import time
+
+    from distributed_llama_tpu.runtime.chaos import ChaosMonkey
+    from distributed_llama_tpu.runtime.journal import (RequestJournal,
+                                                       load_journal)
+    from distributed_llama_tpu.runtime.server import InferenceServer
+
+    jpath = str(tmp_path / "j.journal")
+    srv = InferenceServer(
+        SPEC, params, _IdTokenizer(), "127.0.0.1", 0, slots=2, steps=8,
+        temperature=0.0, topp=0.9, seed=5, quiet=True,
+        journal=RequestJournal(jpath), page_size=4, kv_pages=24,
+        chaos=ChaosMonkey(step_delay_every=1, step_delay_s=0.2))
+    srv.start()
+    got: dict = {}
+
+    def client():
+        try:
+            got["resp"] = _post(srv.port, {"prompt": "hello", "steps": 8})
+        except urllib.error.HTTPError as e:
+            got["code"] = e.code
+    t = threading.Thread(target=client)
+    t.start()
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        with srv.engine._lock:
+            queued = len(srv.engine._queue)
+        if queued or any(not s.free for s in srv.engine._pool):
+            break
+        time.sleep(0.01)
+    remainder = srv.drain(budget_s=0.05)  # budget far below the request
+    assert remainder == 1
+    t.join(timeout=30)
+    assert got.get("code") == 500  # waiter woken with the suspend error
+    assert srv.health.state == "stopped"
+    assert srv.engine.audit_pages() == []
+    # the journaled remainder is live (no retire record): the next
+    # process recovers it
+    assert len([e for e in load_journal(jpath) if e.status is None]) == 1
+
+
+def test_server_drain_finishes_fast_work_without_journaling(params):
+    """A drain whose in-flight work completes within the budget journals
+    NOTHING and reports zero remainder — the healthy-shutdown path."""
+    from distributed_llama_tpu.runtime.server import InferenceServer
+
+    srv = InferenceServer(SPEC, params, _IdTokenizer(), "127.0.0.1", 0,
+                          slots=2, steps=4, temperature=0.0, topp=0.9,
+                          seed=5, quiet=True)
+    srv.start()
+    resp = _post(srv.port, {"prompt": "ab", "steps": 4})
+    assert resp["tokens"]
+    assert srv.drain(budget_s=10.0) == 0
+    assert srv.health.state == "stopped"
+    # draining a stopped server is a no-op, not an error
+    assert srv.drain() == 0
+
+
+def test_server_draining_refuses_new_requests_with_503(params):
+    from distributed_llama_tpu.runtime.server import InferenceServer
+
+    srv = InferenceServer(SPEC, params, _IdTokenizer(), "127.0.0.1", 0,
+                          slots=2, steps=4, temperature=0.0, topp=0.9,
+                          seed=5, quiet=True)
+    srv.start()
+    try:
+        srv.health.to("draining")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(srv.port, {"prompt": "ab", "steps": 4})
+        assert ei.value.code == 503
+        assert "retry" in json.loads(ei.value.read())["error"]
+    finally:
+        srv.stop()
+
+
 def test_server_health_and_errors(server):
     with urllib.request.urlopen(
             f"http://127.0.0.1:{server.port}/health", timeout=30) as r:

@@ -1,0 +1,235 @@
+"""Serving supervision (runtime/supervisor.py, ISSUE 9): health state
+machine legality, step-watchdog arm/trip/disarm semantics, crash-loop
+backoff, and the serve --supervise respawn wrapper (faked Popen)."""
+
+import threading
+import time
+
+import pytest
+
+from distributed_llama_tpu.runtime.supervisor import (HEALTH_CODES,
+                                                      CrashLoopBackoff,
+                                                      HealthMonitor,
+                                                      StepWatchdog,
+                                                      serve_child_cmd,
+                                                      supervise)
+
+# ------------------------------------------------------------- health
+
+
+def test_health_normal_lifecycle_and_gauge():
+    from distributed_llama_tpu.obs.metrics import Registry
+
+    reg = Registry()
+    h = HealthMonitor(reg)
+    gauge = reg.get("dllama_health_state")
+    assert h.state == "starting" and gauge.value == 0
+    assert h.to("serving") is True
+    assert h.to("serving") is False  # same-state: no-op
+    assert h.to("degraded") and h.to("serving")
+    assert h.to("draining") and gauge.value == HEALTH_CODES["draining"]
+    assert h.to("stopped") and gauge.value == HEALTH_CODES["stopped"]
+
+
+def test_health_illegal_transitions_raise():
+    h = HealthMonitor()
+    h.to("serving")
+    h.to("draining")
+    with pytest.raises(ValueError):
+        h.to("serving")  # draining only moves to stopped
+    with pytest.raises(ValueError):
+        # a watchdog trip mid-drain must not bounce the server through
+        # degraded (whence -> serving would reopen admission mid-drain)
+        h.to("degraded")
+    h.to("stopped")
+    for state in ("serving", "draining", "degraded", "starting"):
+        with pytest.raises(ValueError):
+            h.to(state)  # a stopped server never comes back
+    with pytest.raises(ValueError):
+        HealthMonitor().to("zombie")
+
+
+def test_health_fault_states_enterable_from_any_live_state():
+    """Fault paths must never crash on bookkeeping: degraded and stopped
+    are reachable from every live state."""
+    h = HealthMonitor()
+    assert h.to("degraded")  # even from starting
+    assert h.to("stopped")
+
+
+# ----------------------------------------------------------- watchdog
+
+
+def test_watchdog_trips_once_per_overrun_and_recovers():
+    trips = []
+    wd = StepWatchdog(0.03, on_hang=trips.append)
+    try:
+        with wd:  # fast dispatch: no trip
+            pass
+        time.sleep(0.08)
+        assert wd.trips == 0 and not trips
+        with wd:  # hung dispatch: exactly one trip, however long it runs
+            time.sleep(0.1)
+            assert wd.overdue
+        assert wd.trips == 1 and len(trips) == 1
+        assert trips[0] >= 0.03
+        assert not wd.overdue  # disarmed
+        with wd:
+            time.sleep(0.1)
+        assert wd.trips == 2  # re-arming re-enables the deadline
+    finally:
+        wd.close()
+
+
+def test_watchdog_requires_positive_timeout():
+    with pytest.raises(ValueError):
+        StepWatchdog(0.0)
+
+
+def test_watchdog_broken_callback_does_not_kill_monitor():
+    def boom(elapsed):
+        raise RuntimeError("broken callback")
+
+    wd = StepWatchdog(0.02, on_hang=boom)
+    try:
+        with wd:
+            time.sleep(0.06)
+        with wd:
+            time.sleep(0.06)
+        assert wd.trips == 2  # monitor survived the first raise
+    finally:
+        wd.close()
+
+
+def test_watchdog_close_joins_monitor():
+    wd = StepWatchdog(10.0)
+    wd.close()
+    assert not wd._thread.is_alive()
+
+
+# ------------------------------------------------------------ backoff
+
+
+def test_crash_loop_backoff_doubles_and_resets():
+    b = CrashLoopBackoff(initial_s=1.0, max_s=8.0, healthy_s=30.0)
+    assert b.next_delay(0.1) == 1.0
+    assert b.next_delay(0.1) == 2.0
+    assert b.next_delay(0.1) == 4.0
+    assert b.next_delay(0.1) == 8.0
+    assert b.next_delay(0.1) == 8.0  # capped
+    assert b.next_delay(31.0) == 1.0  # healthy child resets the loop
+    assert b.next_delay(0.1) == 2.0
+
+
+# ---------------------------------------------------------- supervise
+
+
+class _FakeProc:
+    def __init__(self, rc):
+        self.rc = rc
+        self.pid = 4242
+        self.signals = []
+
+    def wait(self):
+        return self.rc
+
+    def poll(self):
+        return self.rc
+
+    def send_signal(self, sig):
+        self.signals.append(sig)
+
+
+def test_supervise_restarts_until_clean_exit():
+    rcs = iter([1, 1, 0])
+    spawned = []
+
+    def popen(cmd):
+        p = _FakeProc(next(rcs))
+        spawned.append(p)
+        return p
+
+    sleeps = []
+    rc = supervise(["child"], backoff=CrashLoopBackoff(initial_s=0.01),
+                   sleep=sleeps.append, popen=popen,
+                   install_signals=False)
+    assert rc == 0 and len(spawned) == 3
+    assert sleeps == [0.01, 0.02]
+
+
+def test_supervise_respects_restart_budget():
+    def popen(cmd):
+        return _FakeProc(3)
+
+    rc = supervise(["child"], max_restarts=2,
+                   backoff=CrashLoopBackoff(initial_s=0.0),
+                   sleep=lambda s: None, popen=popen,
+                   install_signals=False)
+    assert rc == 3  # gave up with the child's exit code
+
+
+def test_supervise_sigterm_forwards_and_does_not_respawn():
+    """SIGTERM forwards to the child exactly once; when the child then
+    exits non-zero (drain raced the kill), the supervisor still treats it
+    as termination, not a crash loop."""
+    import signal as _signal
+
+    procs = []
+
+    class _SlowProc(_FakeProc):
+        def __init__(self):
+            super().__init__(1)
+            self._rc = None
+
+        def wait(self):
+            while self._rc is None:
+                time.sleep(0.005)
+            return self._rc
+
+        def poll(self):
+            return self._rc
+
+        def send_signal(self, sig):
+            self.signals.append(sig)
+            self._rc = 1  # dies to the forwarded signal
+
+    def popen(cmd):
+        p = _SlowProc()
+        procs.append(p)
+        return p
+
+    # supervise installs its handler on the MAIN thread (this one); a
+    # helper delivers the handler directly once the child is up —
+    # simulating the signal without kill()
+    def trigger():
+        while not procs:
+            time.sleep(0.005)
+        _signal.getsignal(_signal.SIGTERM)(_signal.SIGTERM, None)
+
+    helper = threading.Thread(target=trigger)
+    helper.start()
+    prev = _signal.getsignal(_signal.SIGTERM)
+    try:
+        rc = supervise(["child"], popen=popen, install_signals=True,
+                       sleep=lambda s: None)
+    finally:
+        helper.join(timeout=10)
+        _signal.signal(_signal.SIGTERM, prev)
+    assert rc == 1 and len(procs) == 1  # no respawn after SIGTERM
+    assert procs[0].signals == [_signal.SIGTERM]
+
+
+def test_serve_child_cmd_strips_supervision_flags():
+    import sys
+
+    argv = ["--model", "m.bin", "--supervise", "--max-restarts", "3",
+            "--journal", "j.ndjson", "--max-restarts=5", "--port", "0"]
+    cmd = serve_child_cmd(argv)
+    assert cmd[:4] == [sys.executable, "-m", "distributed_llama_tpu",
+                       "serve"]
+    rest = cmd[4:]
+    assert "--supervise" not in rest
+    assert not any(a.startswith("--max-restarts") for a in rest)
+    assert "3" not in rest  # the flag's VALUE went with it
+    assert rest == ["--model", "m.bin", "--journal", "j.ndjson",
+                    "--port", "0"]

@@ -246,6 +246,168 @@ def test_fetch_model_slices_e2e(tmp_path):
         server.close()
 
 
+def _served_slices(tmp_path):
+    """(server, addr, src, dst) for a tiny served model + a worker dst."""
+    spec = _tiny_spec()
+    src = str(tmp_path / "model.bin")
+    _write_tiny_model(src, spec)
+    server = WeightServer(src, host="127.0.0.1")
+    return server, f"127.0.0.1:{server.port}", src, str(
+        tmp_path / "w" / "model.bin")
+
+
+def test_corrupt_sidecar_triggers_full_refetch(tmp_path):
+    """A sidecar that no longer parses vouches for NOTHING: the fetch must
+    ignore it, re-fetch every needed range, and leave a repaired sidecar
+    (ISSUE 9 satellite — sidecar edge cases)."""
+    import json
+
+    from distributed_llama_tpu.io.stream import fetch_model_slices
+    from distributed_llama_tpu.ops.quants import FloatType
+
+    server, addr, src, dst = _served_slices(tmp_path)
+    try:
+        fetch_model_slices(addr, dst, FloatType.Q40, 1, {0}, quiet=True)
+        good = open(dst, "rb").read()
+        # corrupt the sidecar AND zero the data: only a real re-fetch can
+        # restore the bytes (a trusted-sidecar skip would keep the zeros)
+        with open(dst + ".slices", "w") as fh:
+            fh.write('{"size": 12, "ran')  # torn/garbage JSON
+        with open(dst, "r+b") as fh:
+            fh.write(b"\0" * 4096)
+        fetch_model_slices(addr, dst, FloatType.Q40, 1, {0}, quiet=True)
+        assert open(dst, "rb").read() == good
+        with open(dst + ".slices") as fh:
+            assert json.load(fh)["ranges"]  # repaired, real ranges again
+    finally:
+        server.close()
+
+
+def test_wrong_size_sidecar_ignored(tmp_path):
+    """A sidecar whose recorded size disagrees with the served file
+    describes a DIFFERENT model: nothing in it is usable — the fetch
+    starts from zero ranges instead of trusting stale offsets."""
+    import json
+
+    from distributed_llama_tpu.io.stream import fetch_model_slices
+    from distributed_llama_tpu.ops.quants import FloatType
+
+    server, addr, src, dst = _served_slices(tmp_path)
+    try:
+        fetch_model_slices(addr, dst, FloatType.Q40, 1, {0}, quiet=True)
+        good = open(dst, "rb").read()
+        size = os.path.getsize(src)
+        with open(dst, "r+b") as fh:  # damage the data the stale sidecar
+            fh.write(b"\0" * 4096)    # would have vouched for
+        with open(dst + ".slices", "w") as fh:
+            json.dump({"size": size + 1, "ranges": [[0, size]]}, fh)
+        fetch_model_slices(addr, dst, FloatType.Q40, 1, {0}, quiet=True)
+        assert open(dst, "rb").read() == good
+    finally:
+        server.close()
+
+
+def test_killed_fetch_residue_refetched_not_trusted(tmp_path):
+    """Killed-fetch residue — data written to full size but the sidecar
+    GONE — must re-fetch: a right-sized file without a sidecar is only a
+    cache hit when its header matches the served bytes (holes read as
+    zeros and fail that check)."""
+    from distributed_llama_tpu.io.stream import fetch_model_slices
+    from distributed_llama_tpu.ops.quants import FloatType
+
+    server, addr, src, dst = _served_slices(tmp_path)
+    try:
+        # full-size file of zeros, no sidecar: the pre-ISSUE-9 code took
+        # this as a complete whole-file cache and served zeros as weights
+        os.makedirs(os.path.dirname(dst))
+        with open(dst, "wb") as fh:
+            fh.truncate(os.path.getsize(src))
+        fetch_model_slices(addr, dst, FloatType.Q40, 1, {0}, quiet=True)
+        ref = str(tmp_path / "ref" / "model.bin")
+        fetch_model_slices(addr, ref, FloatType.Q40, 1, {0}, quiet=True)
+        assert open(dst, "rb").read() == open(ref, "rb").read()
+    finally:
+        server.close()
+
+
+def test_crc_mismatch_refetches_damaged_range(tmp_path):
+    """Sidecar CRCs vouch for on-disk bytes: flip one resident byte and
+    the next fetch must fail that range's CRC and repair exactly it."""
+    from distributed_llama_tpu.io.stream import fetch_model_slices
+    from distributed_llama_tpu.ops.quants import FloatType
+
+    server, addr, src, dst = _served_slices(tmp_path)
+    try:
+        fetch_model_slices(addr, dst, FloatType.Q40, 1, {0}, quiet=True)
+        good = open(dst, "rb").read()
+        pos = os.path.getsize(src) // 2
+        with open(dst, "r+b") as fh:
+            fh.seek(pos)
+            byte = fh.read(1)
+            fh.seek(pos)
+            fh.write(bytes([byte[0] ^ 0xFF]))
+        before = os.path.getmtime(dst)
+        fetch_model_slices(addr, dst, FloatType.Q40, 1, {0}, quiet=True)
+        assert open(dst, "rb").read() == good
+        assert os.path.getmtime(dst) != before  # it actually re-fetched
+    finally:
+        server.close()
+
+
+def test_connect_nontransient_raises_immediately(monkeypatch):
+    """A non-transient connect failure (bad address, permission) must
+    raise on the FIRST attempt instead of burning the connect window —
+    only transient errno values retry (ISSUE 9 satellite)."""
+    import errno
+    import time as _time
+
+    from distributed_llama_tpu.io import stream as stream_mod
+    from distributed_llama_tpu.io.stream import _connect_with_retry
+
+    attempts = {"n": 0}
+
+    def denied(addr, timeout=None):
+        attempts["n"] += 1
+        raise OSError(errno.EACCES, "permission denied")
+
+    monkeypatch.setattr(stream_mod.socket, "create_connection", denied)
+    slept: list[float] = []
+    monkeypatch.setattr(_time, "sleep", lambda d: slept.append(d))
+    with pytest.raises(OSError):
+        _connect_with_retry("127.0.0.1", 1, timeout=1, connect_window=30)
+    assert attempts["n"] == 1 and not slept
+
+
+def test_connect_backoff_grows_exponentially(monkeypatch):
+    """Transient refusals back off exponentially (50 ms doubling), not a
+    fixed 0.25 s spin."""
+    import socket as _socket
+    import time as _time
+
+    from distributed_llama_tpu.io.stream import (_connect_with_retry,
+                                                 _is_transient)
+
+    assert _is_transient(ConnectionRefusedError())
+    assert _is_transient(_socket.timeout())
+    assert not _is_transient(_socket.gaierror())
+    # resolver-not-ready (container boot race) IS transient; a bad name
+    # is not
+    assert _is_transient(_socket.gaierror(_socket.EAI_AGAIN, "try again"))
+    assert not _is_transient(_socket.gaierror(_socket.EAI_NONAME, "nope"))
+    assert not _is_transient(OSError(28, "No space left on device"))
+
+    with _socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    # nothing listens on ``port`` now: every connect is refused (transient)
+    delays: list[float] = []
+    monkeypatch.setattr(_time, "sleep", lambda d: delays.append(d))
+    with pytest.raises(OSError):
+        _connect_with_retry("127.0.0.1", port, timeout=1,
+                            connect_window=0.3)
+    assert delays[:3] == [0.05, 0.1, 0.2]
+
+
 def test_sparse_file_never_mistaken_for_full(tmp_path):
     """Crash-safety of the slice cache protocol (review findings): (1) a
     fetch killed before any range lands must leave a sidecar claiming ZERO

@@ -69,13 +69,19 @@ if [ "$tracecheck_rc" -ne 1 ]; then
          "(exit $tracecheck_rc, expected 1)" >&2
     exit 1
 fi
-# SLO observatory gate (ISSUE 8): a small deterministic loadcheck run —
-# the virtual-clock offered-load sweep held to the checked-in CPU goodput
-# band (tools/loadcheck_baseline.json) plus the FULL chaos-drill suite
-# (pool exhaustion, transient starvation, oversized prompts, disconnect,
-# latency spikes, profiler-under-load; every drill asserts no leaked
-# pages/slots, scrapeable metrics, and a still-admitting engine). The row
-# is archived next to the tracecheck artifacts.
+# SLO observatory gate (ISSUE 8) + crash-safety recovery gate (ISSUE 9):
+# a small deterministic loadcheck run — the virtual-clock offered-load
+# sweep held to the checked-in CPU goodput band
+# (tools/loadcheck_baseline.json) plus the FULL chaos-drill suite:
+# pool exhaustion, transient starvation, oversized prompts, disconnect,
+# latency spikes, profiler-under-load, AND the recovery drills (journal
+# WAL torn-tail/corruption contract, subprocess kill-mid-decode with
+# bitwise stream-parity recovery, hung-dispatch watchdog trip,
+# weight-stream disconnect+resume with CRC repair). Every drill asserts
+# no leaked pages/slots, scrapeable metrics, and a still-admitting
+# engine; the baseline's recovery_drills list makes a silently-skipped
+# recovery drill a gate failure. The row is archived next to the
+# tracecheck artifacts.
 python tools/loadcheck.py --json > tools/ci_artifacts/loadcheck.json
 # and the gate must still CATCH a fault: with the seeded
 # leak-on-cancel mutation armed (a page deliberately dropped on every
@@ -89,6 +95,20 @@ set -e
 if [ "$loadcheck_rc" -ne 1 ]; then
     echo "ci: loadcheck did not flag the seeded page leak" \
          "(exit $loadcheck_rc, expected 1)" >&2
+    exit 1
+fi
+# ... and the RECOVERY gate must still catch a corrupt journal: with a
+# byte smashed mid-file before recovery, loading must raise
+# JournalCorruption and the kill-mid-decode drill must exit 1 EXACTLY —
+# 2 is a usage error and would pass a naive non-zero check vacuously
+set +e
+python tools/loadcheck.py --drills-only --drills kill_mid_decode \
+    --inject corrupt-journal --json > /dev/null 2>&1
+recovery_rc=$?
+set -e
+if [ "$recovery_rc" -ne 1 ]; then
+    echo "ci: loadcheck did not flag the corrupted request journal" \
+         "(exit $recovery_rc, expected 1)" >&2
     exit 1
 fi
 if command -v clang-tidy >/dev/null 2>&1; then

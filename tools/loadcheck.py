@@ -23,13 +23,23 @@ block_steps) so rows stay joinable across the BENCH_* trajectory.
 
 ``--inject leak-on-cancel`` arms the seeded mutation (a page leaked on
 every cancelled-request release): the disconnect drill MUST go red —
-tools/ci.sh runs this to prove the gate can fail.
+tools/ci.sh runs this to prove the gate can fail. ``--inject
+corrupt-journal`` (ISSUE 9) smashes a byte mid-file in the kill-mid-decode
+drill's journal before recovery: loading must raise JournalCorruption and
+the drill must go red — the recovery gate's self-test.
+
+The recovery drills (runtime/chaos.RECOVERY_DRILLS: journal_wal,
+kill_mid_decode, hung_dispatch, weight_stream_disconnect) get dedicated
+verdict columns in the JSON row (``"recovery"``), and the baseline band
+file names them in ``"recovery_drills"`` — a drill silently missing from
+a full run fails the gate, the same way a missing sweep point would.
 
 Usage:
   python tools/loadcheck.py [--sweep R1,R2,...] [--requests N] [--seed N]
       [--slots N] [--page-size P] [--kv-pages N] [--spec-k K]
       [--block-steps K] [--baseline PATH] [--write-baseline]
-      [--sweep-only | --drills-only] [--inject leak-on-cancel]
+      [--sweep-only | --drills-only] [--drills NAMES]
+      [--inject leak-on-cancel|corrupt-journal]
       [--trace-out DIR] [--json]
 """
 
@@ -142,9 +152,15 @@ def check_baseline(rows: list[dict], path: str,
     (failures, baseline_doc). ``write`` regenerates the band at +-10%
     around the measured curve instead of checking."""
     if write:
+        from distributed_llama_tpu.runtime.chaos import RECOVERY_DRILLS
+
         doc = {"kind": "loadcheck-baseline",
                "note": "CPU virtual-clock goodput band; regenerate with "
                        "tools/loadcheck.py --write-baseline",
+               # recovery-drill coverage contract (ISSUE 9): a full drill
+               # run must include these, or the gate fails — a renamed or
+               # dropped drill cannot silently shrink the recovery gate
+               "recovery_drills": list(RECOVERY_DRILLS),
                "points": [{"rate": r["rate"],
                            "goodput_tps": r["goodput_tps"],
                            "band": [round(r["goodput_tps"] * 0.9, 6),
@@ -213,9 +229,13 @@ def main(argv=None) -> int:
                     help="run only these drills (comma-separated names "
                          "from runtime/chaos.DRILLS)")
     ap.add_argument("--inject", default=None,
-                    choices=("leak-on-cancel",),
-                    help="arm the seeded mutation; the drill suite MUST "
-                         "go red (the CI gate's self-test)")
+                    choices=("leak-on-cancel", "corrupt-journal"),
+                    help="arm a seeded mutation; the drill suite MUST "
+                         "go red (the CI gate's self-test): "
+                         "leak-on-cancel leaks a page per cancelled "
+                         "release (disconnect drill), corrupt-journal "
+                         "smashes a mid-file journal byte before "
+                         "recovery (kill_mid_decode drill)")
     ap.add_argument("--trace-out", default=None,
                     help="also save each sweep point's trace (replayable "
                          "schedule archive)")
@@ -239,7 +259,7 @@ def main(argv=None) -> int:
 
     from distributed_llama_tpu.models.spec import TransformerSpec
     from distributed_llama_tpu.runtime.chaos import DRILLS, \
-        render_drill_table, run_drills
+        RECOVERY_DRILLS, render_drill_table, run_drills
     from distributed_llama_tpu.utils.fingerprint import run_stamp
 
     make_engine = build_engine_factory(
@@ -266,12 +286,30 @@ def main(argv=None) -> int:
                       f"(have: {', '.join(sorted(known))})",
                       file=sys.stderr)
                 return 2
-        results = run_drills(make_engine, which=which)
+        results = run_drills(
+            make_engine, which=which,
+            inject={args.inject} if args.inject == "corrupt-journal"
+            else None)
         drill_rows = [r.to_json() for r in results]
         if not args.json:
             print(render_drill_table(results))
         failures += [f"drill {r.name}: {'; '.join(r.violations)}"
                      for r in results if not r.passed]
+        if which is None:
+            # the recovery gate must not pass VACUOUSLY: on a full drill
+            # run, every recovery drill the baseline names must have run
+            # (the band file is where the expected-coverage contract
+            # lives, next to the goodput bands)
+            expected = RECOVERY_DRILLS
+            if os.path.exists(args.baseline):
+                with open(args.baseline, encoding="utf-8") as fh:
+                    expected = json.load(fh).get("recovery_drills",
+                                                 RECOVERY_DRILLS)
+            ran = {r.name for r in results}
+            for name in expected:
+                if name not in ran:
+                    failures.append(f"recovery drill {name} named in the "
+                                    f"baseline never ran")
 
     policy = _policy()
     row = {
@@ -289,6 +327,11 @@ def main(argv=None) -> int:
                 for c in policy.classes],
         "sweep": rows,
         "drills": drill_rows,
+        # dedicated recovery-gate verdict columns (ISSUE 9): the crash-
+        # safety drills' pass/fail at a glance, joinable across rows
+        "recovery": {r["name"]: ("OK" if r["passed"] else "FAIL")
+                     for r in drill_rows
+                     if r["name"] in RECOVERY_DRILLS},
         "gate": {"verdict": "RED" if failures else "OK",
                  "failures": failures},
     }
