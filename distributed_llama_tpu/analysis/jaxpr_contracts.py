@@ -138,7 +138,10 @@ def _aval_trees_equal(a, b) -> str | None:
 def _collective_kind(primitive_name: str) -> str:
     """Normalize a collective primitive name to the comm_stats kind
     vocabulary (psum lowers as psum/psum2/psum_invariant across jax
-    versions; all_gather may carry suffixes)."""
+    versions; all_gather may carry suffixes; ppermute may lower as
+    ppermute/collective_permute)."""
+    if primitive_name.startswith(("ppermute", "collective_permute")):
+        return "ppermute"
     for kind in ("all_gather", "reduce_scatter", "psum"):
         if primitive_name.startswith(kind):
             return kind
@@ -158,6 +161,8 @@ def _moved_bytes(kind: str, aval, tp: int) -> int:
         return (tp - 1) * b // tp    # input is the full per-chip payload
     if kind == "psum":
         return 2 * (tp - 1) * b // tp
+    if kind == "ppermute":
+        return b                     # one send + one receive of the payload
     raise ValueError(f"no ring model for collective kind {kind!r}")
 
 
@@ -167,10 +172,12 @@ def contract_tp_collectives(spec=None, tp: int = 4,
     DLLAMA_TP_SCHEME) and pin the collective schedule to the analytic
     model: per-kind counts AND ring-accounted bytes equal
     comm_stats.tp_collective_budget — ref: 4*n_layers+1 all_gathers;
-    fused: 2*n_layers psums + the logits gather. Any traced collective
-    kind without a budget term fails (so a collective added to tp.py
-    without its comm_stats term cannot land — dlint D006 flags the same
-    drift at source level). (F32 buffer mode; the Q80 wire packing
+    fused: 2*n_layers psums + the logits gather; overlap:
+    2*n_layers*(tp-1) ppermutes + 2*n_layers+1 all_gathers. Any traced
+    collective kind without a budget term fails (so a collective added to
+    tp.py without its comm_stats term cannot land — dlint D006 flags the
+    same drift at source level); a ppermute appearing in a ref/fused
+    trace is exactly such an unmodeled kind. (F32 buffer mode; the Q80 wire packing
     variants are pinned at model scale by tests/test_collective_pinning.py.)
     """
     import collections
@@ -456,6 +463,10 @@ def contract_verify_collectives_fused(spec=None) -> ContractResult:
     return contract_verify_collectives(spec, scheme="fused")
 
 
+def contract_verify_collectives_overlap(spec=None) -> ContractResult:
+    return contract_verify_collectives(spec, scheme="overlap")
+
+
 def contract_tp_collectives_ref(spec=None) -> ContractResult:
     return contract_tp_collectives(spec, scheme="ref")
 
@@ -464,23 +475,31 @@ def contract_tp_collectives_fused(spec=None) -> ContractResult:
     return contract_tp_collectives(spec, scheme="fused")
 
 
+def contract_tp_collectives_overlap(spec=None) -> ContractResult:
+    return contract_tp_collectives(spec, scheme="overlap")
+
+
 contract_tp_collectives.contract_id = "J001"
 contract_tp_collectives_ref.contract_id = "J001"
 contract_tp_collectives_fused.contract_id = "J001"
+contract_tp_collectives_overlap.contract_id = "J001"
 contract_verify_collectives.contract_id = "J001"
 contract_verify_collectives_ref.contract_id = "J001"
 contract_verify_collectives_fused.contract_id = "J001"
+contract_verify_collectives_overlap.contract_id = "J001"
 contract_decode_donation.contract_id = "J002"
 contract_decode_donation_paged.contract_id = "J002"
 contract_decode_shape_stability.contract_id = "J003"
 
-# J001 runs once per scheme: BOTH schedules stay pinned regardless of which
+# J001 runs once per scheme: ALL schedules stay pinned regardless of which
 # DLLAMA_TP_SCHEME the current process happens to run under — for the
 # decode forward AND the speculative K-query verify dispatch; J002 runs
 # once per cache layout (contiguous + paged), for the same reason
 CONTRACTS = (contract_tp_collectives_ref, contract_tp_collectives_fused,
+             contract_tp_collectives_overlap,
              contract_verify_collectives_ref,
              contract_verify_collectives_fused,
+             contract_verify_collectives_overlap,
              contract_decode_donation, contract_decode_donation_paged,
              contract_decode_shape_stability)
 

@@ -328,8 +328,12 @@ _COLLECTIVE_CALLS = frozenset(
      "pmax", "pmin", "pmean", "reduce_scatter"))
 # the blessed sites: the ONLY functions in parallel/tp.py allowed to bind a
 # collective — comm_stats.tp_collective_budget models exactly what flows
-# through these three, and the J001 contract pins the traced program to it
-_TP_COMM_HELPERS = frozenset(("_ici_gather", "_ici_psum", "_ici_scatter"))
+# through these, and the J001 contract pins the traced program to it.
+# _ici_ppermute is the overlap scheme's ring hop; _ici_ring_reduce builds
+# the ring but binds its collective THROUGH _ici_ppermute (blessed here so
+# a future inline ppermute refactor stays inside the family).
+_TP_COMM_HELPERS = frozenset(("_ici_gather", "_ici_psum", "_ici_scatter",
+                              "_ici_ppermute", "_ici_ring_reduce"))
 
 
 @rule("D006", "tp collective outside the comm-model helpers",

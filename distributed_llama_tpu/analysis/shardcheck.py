@@ -3,8 +3,8 @@
 Third analysis head, beside the AST lint (rules.py) and the jaxpr
 contracts (jaxpr_contracts.py). For every config in the declared support
 matrix — model in {7B, 13B, 70B} x tp in {1,2,4,8} x scheme in
-{ref, fused} x weights in {Q40, F16} — it proves, statically, on CPU,
-with zero weight bytes materialized:
+{ref, fused, overlap} x weights in {Q40, F16}, 72 configs — it proves,
+statically, on CPU, with zero weight bytes materialized:
 
   HBM     the per-device footprint (analysis/memory_model.py: weight
           shards, replicated tensors, KV cache at max sequence, traced
@@ -362,15 +362,21 @@ def check_uniform_shards(spec, tp: int, scheme: str,
                         (spec.vocab_size, "vocab_size")):
         if value % tp:
             ragged(value, what)
-    if scheme == "fused" and spec.weights_float_type == FloatType.Q40:
+    if scheme in ("fused", "overlap") \
+            and spec.weights_float_type == FloatType.Q40:
         for value, what in ((spec.dim, "dim"),
                             (spec.hidden_dim, "hidden_dim")):
             if tp > 1 and value % tp == 0 and (value // tp) % QK:
                 findings.append(ShardFinding(
                     "J006", config,
-                    f"fused scheme shards {what}={value} along the Q40 "
+                    f"{scheme} scheme shards {what}={value} along the Q40 "
                     f"input-block axis: {value}/{tp} must be a "
                     f"{QK}-multiple"))
+    if scheme == "overlap" and tp > 1 and spec.dim % tp:
+        findings.append(ShardFinding(
+            "J006", config,
+            f"overlap scheme ring-chunks the residual width: "
+            f"dim={spec.dim} does not divide over tp={tp}"))
     if spec.buffer_float_type == FloatType.Q80:
         for value, what in ((spec.dim, "dim"), (spec.hidden_dim,
                                                 "hidden_dim")):

@@ -188,10 +188,14 @@ def cmd_inference(argv: list[str], quiet: bool = False) -> int:
     ap.add_argument("--seed", type=int, default=None)
     ap.add_argument("--tp", type=int, default=None,
                     help="tensor-parallel ways (default: all local devices)")
-    ap.add_argument("--tp-scheme", default=None, choices=("ref", "fused"),
+    ap.add_argument("--tp-scheme", default=None,
+                    choices=("ref", "fused", "overlap"),
                     help="tp collective schedule (= DLLAMA_TP_SCHEME): "
                          "'fused' (default) pairs column/row-parallel "
                          "matmuls Megatron-style — 2 collectives/layer; "
+                         "'overlap' ring-decomposes the fused combines "
+                         "into ppermute hops hidden behind compute "
+                         "(bitwise equal to fused; requires --sp 1); "
                          "'ref' keeps the reference's 4-gather MatmulSlice "
                          "schedule, the bit-parity anchor")
     ap.add_argument("--sp", type=int, default=1,
@@ -280,6 +284,22 @@ def cmd_inference(argv: list[str], quiet: bool = False) -> int:
     _add_common(ap)
     args = ap.parse_args(argv)
     _apply_log_json(args)
+    if args.tp_scheme:
+        os.environ["DLLAMA_TP_SCHEME"] = args.tp_scheme
+    from ..parallel.comm_stats import tp_scheme
+
+    scheme = tp_scheme()  # validate (env or flag) at argparse time
+    if args.spec_k and args.kv_page_size <= 0:
+        # fail HERE, not deep in ContinuousEngine construction after a
+        # multi-GB model load: rollback truncates page tables
+        print("--spec-k needs the paged KV cache: add --kv-page-size P "
+              "(with --continuous)", file=sys.stderr)
+        return 2
+    if scheme == "overlap" and args.sp > 1:
+        print("--tp-scheme overlap needs --sp 1: the ring-decomposed "
+              "combines assume un-chunked sequences (use --tp-scheme "
+              "fused with sp>1)", file=sys.stderr)
+        return 2
     if args.profile is None:  # one-shot env hook (obs/profiler.py)
         from ..obs.profiler import env_profile_dir
 
@@ -331,11 +351,6 @@ def cmd_inference(argv: list[str], quiet: bool = False) -> int:
             print("prompts file is empty", file=sys.stderr)
             return 2
 
-    if args.tp_scheme:
-        os.environ["DLLAMA_TP_SCHEME"] = args.tp_scheme
-    from ..parallel.comm_stats import tp_scheme
-
-    scheme = tp_scheme()  # validate (env or flag) before the model load
     wft = _FT[args.weights_float_type]
     bft = _FT[args.buffer_float_type]
     n_dev = len(jax.devices())
@@ -576,7 +591,8 @@ def cmd_serve(argv: list[str]) -> int:
     ap.add_argument("--seed", type=int, default=None)
     ap.add_argument("--tp", type=int, default=None,
                     help="tensor-parallel ways (default: single chip)")
-    ap.add_argument("--tp-scheme", default=None, choices=("ref", "fused"),
+    ap.add_argument("--tp-scheme", default=None,
+                    choices=("ref", "fused", "overlap"),
                     help="tp collective schedule (= DLLAMA_TP_SCHEME; see "
                          "'inference --help')")
     ap.add_argument("--kv-cache-dtype", default="f32",
@@ -681,6 +697,12 @@ def cmd_serve(argv: list[str]) -> int:
         print("--fast-prefill only affects admission prefill; pass "
               "--prefill-chunk N (N > 1)", file=sys.stderr)
         return 2
+    if args.spec_k and args.kv_page_size <= 0:
+        # same argparse-time gate as inference: never surface this from
+        # engine construction after the model load
+        print("--spec-k needs the paged KV cache: add --kv-page-size P",
+              file=sys.stderr)
+        return 2
     from ..obs.slo import SLOPolicy
     from ..runtime.chaos import ChaosMonkey
 
@@ -700,11 +722,15 @@ def cmd_serve(argv: list[str]) -> int:
         from ..runtime.journal import JournalCorruption, RequestJournal
 
         try:
+            # open BEFORE the model load: non-tail damage must refuse in
+            # milliseconds, not after minutes of weight streaming; the
+            # config fingerprint (which needs the loaded spec) attaches
+            # below via set_config
             journal = RequestJournal(args.journal,
                                      fsync=args.journal_fsync)
         except JournalCorruption as e:
-            # non-tail damage: recovering from an untrusted history would
-            # serve wrong bytes — refuse to start, operator decides
+            # recovering from an untrusted history would serve wrong
+            # bytes — refuse to start, operator decides
             print(f"serve: journal {args.journal} is corrupt: {e}\n"
                   f"       (move it aside to start fresh, or restore a "
                   f"good copy to recover)", file=sys.stderr)
@@ -738,20 +764,51 @@ def cmd_serve(argv: list[str]) -> int:
     tokenizer = Tokenizer(args.tokenizer, spec.vocab_size)
     mesh = make_mesh(tp=args.tp) if args.tp and args.tp > 1 else None
     seed = args.seed if args.seed is not None else int(time.time())
+    if journal is not None:
+        from ..runtime.journal import config_fingerprint, weight_file_digest
+
+        # the WAL header records what a bitwise replay depends on: model
+        # dims + quant types (spec), the tp collective scheme (tp=1 runs
+        # one scheme-independent program — recorded as 'single' so a
+        # scheme-env change cannot strand single-chip journals), the
+        # sampler SEED POLICY ('explicit:<seed>' only when --seed is
+        # pinned — the time-derived default passes across restarts:
+        # replay reads journaled per-request seeds, never the base), and
+        # a weight-file digest prefix. ContinuousEngine.recover refuses
+        # on mismatch when the journal holds live work.
+        seed_policy = (f"explicit:{args.seed}" if args.seed is not None
+                       else "time")
+        journal.set_config(config_fingerprint(
+            spec, tp_scheme() if sharded else "single", seed_policy,
+            weights_digest=weight_file_digest(args.model)))
     cache_dtype = jnp.bfloat16 if args.kv_cache_dtype == "bf16" else None
-    server = InferenceServer(spec, params, tokenizer, args.host, args.port,
-                             args.slots, args.steps, args.temperature,
-                             args.topp, seed, cache_dtype=cache_dtype,
-                             mesh=mesh, prefill_chunk=args.prefill_chunk,
-                             block_steps=args.block_steps,
-                             fast_prefill=args.fast_prefill,
-                             metrics=args.metrics,
-                             page_size=args.kv_page_size,
-                             kv_pages=args.kv_pages, spec_k=args.spec_k,
-                             spec_ngram=args.spec_ngram, slo=slo,
-                             chaos=chaos, journal=journal,
-                             watchdog_s=args.watchdog_ms / 1e3,
-                             drain_s=args.drain_s)
+    try:
+        server = InferenceServer(spec, params, tokenizer, args.host,
+                                 args.port, args.slots, args.steps,
+                                 args.temperature, args.topp, seed,
+                                 cache_dtype=cache_dtype, mesh=mesh,
+                                 prefill_chunk=args.prefill_chunk,
+                                 block_steps=args.block_steps,
+                                 fast_prefill=args.fast_prefill,
+                                 metrics=args.metrics,
+                                 page_size=args.kv_page_size,
+                                 kv_pages=args.kv_pages,
+                                 spec_k=args.spec_k,
+                                 spec_ngram=args.spec_ngram, slo=slo,
+                                 chaos=chaos, journal=journal,
+                                 watchdog_s=args.watchdog_ms / 1e3,
+                                 drain_s=args.drain_s)
+    except Exception as e:
+        from ..runtime.journal import JournalConfigMismatch
+
+        if not isinstance(e, JournalConfigMismatch):
+            raise
+        # recovery refused: the journal's recorded config fingerprint does
+        # not match this serving config — never silently replay wrong
+        # bytes; the operator restores the original config or moves the
+        # journal aside
+        print(f"serve: {e}", file=sys.stderr)
+        return 1
     endpoints = "POST /generate, GET /health" + (
         ", GET /metrics, GET /debug/timeline, POST /profile"
         if args.metrics else "")

@@ -28,6 +28,14 @@ Checks and thresholds (module constants, printed in every table):
 * **coverage** — ≥ ``COVERAGE_MIN`` of device op time attributed to named
   phases; below that, per-phase conclusions are built on a minority of
   the step.
+* **overlap** — overlap scheme only: the ring hops' latency-hiding must
+  be REAL, not modeled — ≥ ``OVERLAP_MIN`` of measured ppermute time
+  covered by concurrent compute (CollectiveMeasure.overlap_ms; fixtures
+  carry it as per-event ``overlap_ns``, capture formats without
+  per-event timestamps SKIP honestly). A serialized schedule — every
+  hop exposed — is exactly the regression the overlap scheme's
+  projection advertises away, caught here from measurement (the
+  mutated ``serialized-overlap`` fixture pins the gate in CI).
 
 Surfaced by ``tools/tracecheck.py`` (CLI + CI gate), ``bench.py`` drift
 columns, and the PARITY.md measured-vs-modeled table.
@@ -43,6 +51,7 @@ COUNT_RTOL = 0.10    # real-capture count tolerance (fixtures: exact)
 BYTES_RTOL = 0.01    # byte accounting is closed-form; 1% is generous
 TIME_BAND = 4.0      # measured/modeled collective time band (x either way)
 COVERAGE_MIN = 0.95  # phase-attribution floor
+OVERLAP_MIN = 0.60   # overlap scheme: ppermute time covered by compute
 
 
 @dataclasses.dataclass(frozen=True)
@@ -183,6 +192,25 @@ def reconcile(att: Attribution, spec, n_slices: int, scheme: str,
             f"{TIME_BAND}x band", "OK" if time_ok else "DRIFT",
             "" if time_ok else "collective time escaped the modeled "
                                "bandwidth+latency band"))
+
+    if scheme == "overlap" and "ppermute" in modeled:
+        m = att.collectives.get("ppermute")
+        frac = m.overlap_fraction if m is not None else None
+        if frac is None:
+            rows.append(DriftRow(
+                "overlap", "ppermute", 0.0, OVERLAP_MIN,
+                f">={OVERLAP_MIN:.0%}", "SKIP",
+                "capture carries no per-event overlap timing — cannot "
+                "judge latency hiding from durations alone"))
+        else:
+            ov_ok = frac >= OVERLAP_MIN
+            rows.append(DriftRow(
+                "overlap", "ppermute", round(frac, 4), OVERLAP_MIN,
+                f">={OVERLAP_MIN:.0%}", "OK" if ov_ok else "DRIFT",
+                "" if ov_ok else "ring hops ran SERIALIZED against "
+                                 "compute — the overlap scheme's "
+                                 "latency-hiding claim does not hold on "
+                                 "this capture"))
 
     cov_ok = att.coverage >= COVERAGE_MIN
     rows.append(DriftRow(

@@ -47,10 +47,12 @@ ICI_SCOPE_PREFIX = "ici_"
 SCOPE_ICI_GATHER = "ici_all_gather"
 SCOPE_ICI_PSUM = "ici_psum"
 SCOPE_ICI_SCATTER = "ici_psum_scatter"
+SCOPE_ICI_PPERMUTE = "ici_ppermute"
 COLLECTIVE_SCOPE_KINDS = {
     SCOPE_ICI_GATHER: "all_gather",
     SCOPE_ICI_PSUM: "psum",
     SCOPE_ICI_SCATTER: "reduce_scatter",
+    SCOPE_ICI_PPERMUTE: "ppermute",
 }
 
 

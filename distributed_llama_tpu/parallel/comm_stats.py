@@ -12,15 +12,26 @@ Two tp collective schemes exist (selected by ``DLLAMA_TP_SCHEME``, see
 budget function (``tp_collective_budget``) so the runtime print, the bench
 projection, and the dlint J001 jaxpr contract all read the same numbers:
 
-  ref    the reference's all-output-sliced MatmulSlice port: 4 all_gathers
-         per layer + the logits gather (parallel/tp.py ref branch) — the
-         bit-parity anchor against the reference binaries.
-  fused  Megatron-style pairing (Shoeybi et al. 2019; Pope et al. 2022):
-         wo/w2 are INPUT-dim sharded, so attention-out and ffn-out are
-         row-parallel partial sums combined with ONE psum per block under
-         f32 buffers (2 collectives/layer), or a psum_scatter + Q80-packed
-         all_gather pair under Q80 buffers (the wire-quantization cut point
-         is preserved on the gather half).
+  ref      the reference's all-output-sliced MatmulSlice port: 4 all_gathers
+           per layer + the logits gather (parallel/tp.py ref branch) — the
+           bit-parity anchor against the reference binaries.
+  fused    Megatron-style pairing (Shoeybi et al. 2019; Pope et al. 2022):
+           wo/w2 are INPUT-dim sharded, so attention-out and ffn-out are
+           row-parallel partial sums combined with ONE psum per block under
+           f32 buffers (2 collectives/layer), or a psum_scatter + Q80-packed
+           all_gather pair under Q80 buffers (the wire-quantization cut
+           point is preserved on the gather half).
+  overlap  the fused layout with each block combine RING-DECOMPOSED
+           (Wang et al., ASPLOS '23 collective-matmul lineage): the psum /
+           psum_scatter reduce half becomes tp-1 chunked ``ppermute`` hops
+           (1 ICI hop each, schedulable concurrently with the combine's
+           remaining chunk work) feeding a deterministic rank-order f32
+           fold, followed by the SAME gather half as fused; the ffn
+           combine's gather is double-buffered — issued at the bottom of
+           layer N, consumed at the top of layer N+1 — so it too hides
+           behind compute. Counts go UP (2(S-1) ppermutes + 2 gathers per
+           layer) but almost all of the collective time is hideable; see
+           shard_sim.project_full_system's overlap term.
 
 Validated against the published tables (README.md:58-69) in
 tests/test_comm_stats.py; pinned to the traced program in
@@ -35,16 +46,28 @@ import os
 from ..models.spec import TransformerSpec
 from ..ops.quants import FloatType, batch_bytes
 
-SCHEMES = ("ref", "fused")
+SCHEMES = ("ref", "fused", "overlap")
+
+# ICI hops one collective launch of each kind serializes on: a ppermute is
+# one neighbor hop (shift-by-k permutes pipeline through the ring and the
+# launch itself costs one hop of sync); every ring-collective walks the
+# whole ring. The latency term of shard_sim.modeled_ici_ms multiplies the
+# per-kind launch count by this hop count.
+def collective_hops(kind: str, n_slices: int) -> int:
+    return 1 if kind == "ppermute" else max(n_slices - 1, 1)
 
 
 def tp_scheme() -> str:
-    """The active tp collective scheme: DLLAMA_TP_SCHEME=ref|fused.
+    """The active tp collective scheme: DLLAMA_TP_SCHEME=ref|fused|overlap.
 
-    Default ``fused`` — the fastest policy (half the per-layer collective
-    launches, the dominant term of the multi-chip latency budget; ISSUE 3 /
-    BENCH_r05). ``ref`` keeps the reference's 4-gather MatmulSlice schedule
-    and remains the bit-parity anchor against the reference binaries.
+    Default ``fused`` — the fastest *serialized* policy (half the per-layer
+    collective launches, the dominant term of the multi-chip latency
+    budget; ISSUE 3 / BENCH_r05). ``overlap`` (ISSUE 10) ring-decomposes
+    the fused combines so the remaining collectives hide behind compute —
+    bitwise equal to ``fused``, modeled faster on real meshes, pending a
+    TPU session to graduate to default. ``ref`` keeps the reference's
+    4-gather MatmulSlice schedule and remains the bit-parity anchor
+    against the reference binaries.
     """
     s = os.environ.get("DLLAMA_TP_SCHEME", "fused")
     if s not in SCHEMES:
@@ -145,6 +168,21 @@ def tp_collective_budget(spec: TransformerSpec, n_slices: int,
                                    + _vb(ft, spec.hidden_dim // s))
         return CollectiveBudget(
             (("all_gather", 4 * L + 1, L * per_layer + logits_bytes),))
+    if scheme == "overlap":
+        # ring-decomposed fused combines: the reduce half of each of the
+        # 2 per-layer combines is S-1 chunked ppermute hops (each moving
+        # one f32 dim/S chunk — partial sums never ride the wire
+        # quantized, same rule as the fused scatter half), and the gather
+        # half is the SAME per-combine all_gather the fused Q80 path
+        # issues (packed Q80 band under Q80 buffers; f32 band under f32 —
+        # the decomposition of the fused psum). Per-chip ppermute bytes
+        # equal the fused reduce_scatter's (S-1)/S of the payload exactly.
+        pp_bytes = t * 2 * L * (s - 1) * (spec.dim // s) * 4
+        band = (FloatType.Q80 if ft == FloatType.Q80 else FloatType.F32)
+        ag_bytes = t * 2 * L * (s - 1) * _vb(band, spec.dim // s)
+        return CollectiveBudget(
+            (("ppermute", 2 * L * (s - 1), pp_bytes),
+             ("all_gather", 2 * L + 1, ag_bytes + logits_bytes)))
     # fused: wo/w2 row-parallel — one combine per block, 2 blocks/layer,
     # both of width dim (attention out and ffn out are residual-stream
     # vectors; hidden_dim never crosses the wire in this scheme)
@@ -193,10 +231,20 @@ def collective_staging_bytes(spec: TransformerSpec, n_slices: int,
         payloads = (t_len * _vb(ft, spec.dim),
                     t_len * _vb(ft, spec.hidden_dim), logits)
     else:
-        # fused: the combine payload is the full residual-width f32 vector
-        # on both the psum and the scatter+gather decomposition
+        # fused/overlap: the combine payload is the full residual-width f32
+        # vector on the psum, the scatter+gather decomposition, and the
+        # overlap ring's (S, T, dim/S) chunk-term stash alike
         payloads = (t_len * _vb(FloatType.F32, spec.dim), logits)
-    return 2 * max(payloads)
+    base = 2 * max(payloads)
+    if scheme == "overlap":
+        # chunked-staging charge: the deferred ffn gather is double-
+        # buffered — the layer-N output buffer is still live while layer
+        # N+1's is being gathered — so the wire payload (packed Q80 band
+        # concat under Q80 buffers, f32 vector under f32) is held twice
+        # ON TOP of the in-flight-collective bound above.
+        band = (FloatType.Q80 if ft == FloatType.Q80 else FloatType.F32)
+        base += 2 * t_len * _vb(band, spec.dim)
+    return base
 
 
 def ici_all_gather_bytes(spec: TransformerSpec, n_slices: int,

@@ -77,6 +77,22 @@ def make_tile_scatter(n_slices: int):
     return scatter
 
 
+def _sim_permute(a, shift, n_slices):
+    """ppermute stand-in (tp._ici_ppermute signature) for the one-rank sim:
+    identity — same chunk shape lands in the ring stash, same slice/update/
+    fold memory traffic, zero ICI. (Every 'received' chunk is this rank's
+    own send, so values are garbage by construction, like the tile
+    gather's.)"""
+    return a
+
+
+def _sim_rank():
+    """tp._tp_rank stand-in: the sim runs outside any mesh axis, so the
+    one simulated rank is rank 0 (chunk indices stay in-range; which rank
+    the sim 'is' cannot matter — values are garbage anyway)."""
+    return 0
+
+
 def synth_rank_q40(spec: TransformerSpec, n_slices: int, seed: int = 0,
                    embed_dtype=None,
                    scheme: str | None = None) -> dict[str, Any]:
@@ -98,11 +114,11 @@ def synth_rank_q40(spec: TransformerSpec, n_slices: int, seed: int = 0,
     if spec.n_heads % n_slices or spec.n_kv_heads % n_slices:
         raise ValueError(f"tp={n_slices} does not divide heads "
                          f"{spec.n_heads}/{spec.n_kv_heads}")
-    if scheme == "fused":
+    if scheme in ("fused", "overlap"):  # overlap shares the fused layout
         for name, n_in in (("wo", spec.dim), ("w2", spec.hidden_dim)):
             if (n_in // n_slices) % 32:
                 raise ValueError(
-                    f"fused tp scheme slices {name}'s Q40 input dim: "
+                    f"{scheme} tp scheme slices {name}'s Q40 input dim: "
                     f"{n_in}/{n_slices} must be a 32-multiple")
     rng = np.random.default_rng(seed)
 
@@ -124,7 +140,7 @@ def synth_rank_q40(spec: TransformerSpec, n_slices: int, seed: int = 0,
          "rms_ffn": t(spec.n_layers, spec.dim).astype(np.float32),
          "wcls": mm(spec.vocab_size // S, spec.dim)}
     for name, (d, n) in spec.layer_matmul_shapes():
-        if scheme == "fused" and name in ("wo", "w2"):
+        if scheme in ("fused", "overlap") and name in ("wo", "w2"):
             p[name] = mm(spec.n_layers, d, n // S)  # input-dim band
         else:
             p[name] = mm(spec.n_layers, d // S, n)
@@ -135,15 +151,19 @@ def make_rank_step(spec: TransformerSpec, n_slices: int,
                    scheme: str | None = None):
     """One rank's raw (traceable) step fn — feed this to the fused decode
     loop (runtime/decode.make_decode_loop) so the whole chain is one device
-    program, like the flagship bench path. All three collective hooks get
-    local stand-ins (tile gather / identity psum / band-slice scatter), so
-    the sim runs whichever scheme's exact compute program with zero ICI."""
+    program, like the flagship bench path. All the collective hooks get
+    local stand-ins (tile gather / identity psum / band-slice scatter /
+    identity ppermute + rank-0 index for the overlap ring), so the sim
+    runs whichever scheme's exact compute program — chunk slices, ring
+    stash updates, rank-order fold, deferred-gather carry included — with
+    zero ICI."""
     from .tp import make_local_step
 
     return make_local_step(spec, n_slices, 1,
                            gather_fn=make_tile_gather(n_slices),
                            scheme=scheme, psum_fn=_sim_psum,
-                           scatter_fn=make_tile_scatter(n_slices))
+                           scatter_fn=make_tile_scatter(n_slices),
+                           permute_fn=_sim_permute, rank_fn=_sim_rank)
 
 
 def make_rank_forward(spec: TransformerSpec, n_slices: int,
@@ -207,11 +227,77 @@ def modeled_ici_ms(spec: TransformerSpec, n_slices: int,
     """(bandwidth_ms, latency_ms) per token for the scheme's collective
     schedule — the ONE formula behind project_full_system's ICI columns
     and the obs/drift time check, so the projection the bench prints and
-    the band the drift gate holds measurements to cannot diverge."""
+    the band the drift gate holds measurements to cannot diverge. This is
+    TOTAL collective time (what a profiler capture measures); the overlap
+    scheme's hidden share is modeled separately
+    (modeled_overlap_hidden_ms) and only project_full_system subtracts it.
+    Hop accounting is per kind (comm_stats.collective_hops): a ring
+    collective walks all S-1 hops per launch, a shift-by-k ppermute
+    launch costs one."""
+    from .comm_stats import collective_hops
+
     budget = tp_collective_budget(spec, n_slices, scheme)
     bw_ms = budget.moved_bytes / (gbps * 1e9) * 1e3
-    lat_ms = budget.n_collectives * (n_slices - 1) * latency_us / 1e3
+    lat_ms = sum(count * collective_hops(kind, n_slices) * latency_us
+                 for kind, count, _ in budget.entries) / 1e3
     return bw_ms, lat_ms
+
+
+def _weight_frac(spec: TransformerSpec, names) -> float:
+    """Fraction of one decode step's weight-streaming bytes owed to the
+    named per-layer matmuls — the weight-bound shard-time attribution the
+    speculative model already leans on (batch-1 decode streams every
+    weight once per token, so time shares track byte shares)."""
+    per_layer = {name: d * n for name, (d, n) in spec.layer_matmul_shapes()}
+    total = (spec.n_layers * sum(per_layer.values())
+             + spec.vocab_size * spec.dim)  # + wcls
+    return spec.n_layers * sum(per_layer[n] for n in names) / total
+
+
+def modeled_overlap_hidden_ms(spec: TransformerSpec, n_slices: int,
+                              shard_ms: float,
+                              gbps: float = V5E_ICI_GBPS_PER_DIRECTION,
+                              latency_us: float = ICI_COLLECTIVE_LATENCY_US,
+                              ) -> float:
+    """Collective time the overlap scheme hides behind compute (ISSUE 10).
+
+    Two hideable terms, each min'd against the compute available to hide
+    behind — per ring step the exposed cost is max(compute_chunk,
+    ring_hop), i.e. the hop is free exactly while chunk compute covers it:
+
+    * the ring hops (2L*(S-1) ppermutes): overlap the combines' chunked
+      wo/w2 work — capacity = the wo+w2 share of the measured shard time
+      (weight-streaming-bound decode: time shares track weight-byte
+      shares), scaled by (S-1)/S (the first chunk has no hop in flight);
+    * the deferred ffn gathers (L of the 2L+1 all_gathers): consumed at
+      the top of layer N+1, so they hide behind everything up to the next
+      ffn — capacity = the non-wo/w2 compute share.
+
+    The attention gathers and the logits gather are consumed immediately
+    and stay exposed — they are the ~0.29 ms/token floor the projected
+    13b-tp8 row keeps (vs the fused scheme's 0.600). Returns 0 for
+    schemes without a ring (callers guard) and for tp=1.
+    """
+    if n_slices <= 1:
+        return 0.0
+    budget = tp_collective_budget(spec, n_slices, "overlap")
+    by_kind = {k: (c, b) for k, c, b in budget.entries}
+    pp_count, pp_bytes = by_kind.get("ppermute", (0, 0))
+    ag_count, ag_bytes = by_kind.get("all_gather", (0, 0))
+    ring_ms = (pp_bytes / (gbps * 1e9) * 1e3
+               + pp_count * latency_us / 1e3)
+    # the deferred (ffn) gathers are L of the 2L+1; charge them their
+    # launch latency + a proportional bytes share
+    L = spec.n_layers
+    defer_frac = L / max(ag_count, 1)
+    defer_ms = (ag_bytes / (gbps * 1e9) * 1e3 * defer_frac
+                + L * (n_slices - 1) * latency_us / 1e3)
+    combine_ms = shard_ms * _weight_frac(spec, ("wo", "w2"))
+    other_ms = max(shard_ms - combine_ms, 0.0)
+    s = n_slices
+    hidden = (min(ring_ms, combine_ms * (s - 1) / s)
+              + min(defer_ms, other_ms))
+    return hidden
 
 
 def expected_accepted_span(alpha: float, k: int) -> float:
@@ -269,11 +355,20 @@ class FullSystemProjection:
     hbm_per_device_gib: float = 0.0
     hbm_headroom_gib: float = 0.0
     hbm_fits: bool = True
+    # overlap scheme only: modeled collective time hidden behind compute
+    # (modeled_overlap_hidden_ms — the max(compute_chunk, ring_hop) term);
+    # 0 for ref/fused, whose projection stays the conservative no-overlap
+    # straight sum
+    ici_hidden_ms: float = 0.0
+    scheme: str = ""
 
     @property
     def total_ms(self) -> float:
-        # conservative straight sum: no compute/collective overlap assumed
-        return self.shard_ms + self.ici_bandwidth_ms + self.ici_latency_ms
+        # conservative straight sum for serialized schemes; the overlap
+        # scheme subtracts its modeled hidden share (never below the
+        # compute floor: hidden is capped by the ICI total by construction)
+        return (self.shard_ms + self.ici_bandwidth_ms + self.ici_latency_ms
+                - self.ici_hidden_ms)
 
     def speculative(self, k: int, alpha: float) -> SpeculativeProjection:
         """The speculative term (ISSUE 7): modeled ms/accepted-token when
@@ -285,7 +380,7 @@ class FullSystemProjection:
         headline projection cannot drift apart."""
         e = expected_accepted_span(alpha, k)
         dispatch_ms = (self.shard_ms + k * self.ici_bandwidth_ms
-                       + self.ici_latency_ms)
+                       + self.ici_latency_ms - self.ici_hidden_ms)
         return SpeculativeProjection(
             k=k, alpha=alpha, expected_tokens=round(e, 3),
             dispatch_ms=round(dispatch_ms, 3),
@@ -321,6 +416,16 @@ def project_full_system(spec: TransformerSpec, n_slices: int,
     budget = tp_collective_budget(spec, n_slices, scheme)
     n_coll = budget.n_collectives
     bw_ms, lat_ms = modeled_ici_ms(spec, n_slices, scheme, gbps, latency_us)
+    hidden_ms = 0.0
+    if scheme == "overlap":
+        # the overlap term (ISSUE 10): ring hops and deferred ffn gathers
+        # hide behind compute — per step max(compute_chunk, ring_hop)
+        # replaces compute + collective. Capped by the collective total so
+        # total_ms can never dip below the measured compute floor.
+        hidden_ms = min(
+            modeled_overlap_hidden_ms(spec, n_slices, shard_ms, gbps,
+                                      latency_us),
+            bw_ms + lat_ms)
     mem = device_footprint(spec, n_slices, scheme)
     return FullSystemProjection(shard_ms, bw_ms, lat_ms, n_slices,
                                 budget.moved_bytes, n_coll,
@@ -328,4 +433,6 @@ def project_full_system(spec: TransformerSpec, n_slices: int,
                                     mem.total_bytes / GIB, 3),
                                 hbm_headroom_gib=round(
                                     mem.headroom_bytes / GIB, 3),
-                                hbm_fits=mem.fits)
+                                hbm_fits=mem.fits,
+                                ici_hidden_ms=round(hidden_ms, 6),
+                                scheme=scheme)

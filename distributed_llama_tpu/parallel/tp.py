@@ -39,6 +39,40 @@ sums cannot ride the wire quantized without compounding per-shard rounding)
 + the SAME packed-Q80 ``_wire_gather`` the ref scheme uses, so the wire-
 quantization cut point of the reference is preserved on the gather half.
 
+**overlap** — the fused layout with latency-hiding collectives (ISSUE 10;
+the collective-matmul decomposition lineage of Wang et al., ASPLOS '23).
+Param layout, matmuls, and quantization cut points are EXACTLY the fused
+scheme's; only the combines change shape:
+
+* each block combine's reduce half is RING-DECOMPOSED (``_ici_ring_reduce``):
+  the full-width row-parallel partial splits into tp chunks, and chunk
+  ``k``'s shift-by-k ``ppermute`` hop (1 ICI hop; ``_ici_ppermute``) carries
+  it straight to its owner rank while the combine's remaining chunk sends
+  and the surrounding wo/w2/next-block matmuls proceed — the hops have no
+  data dependency on each other, so the XLA latency-hiding scheduler can
+  run them all concurrently with compute. Received chunks land in a
+  rank-indexed stash summed in ASCENDING RANK ORDER — the same
+  deterministic left-fold XLA's all_reduce applies — so the overlap scheme
+  is BITWISE equal to the fused scheme (pinned by
+  tests/test_overlap_scheme.py across f32/Q80/Q40 and
+  contiguous/paged/speculative layouts);
+* the ffn combine's gather half is DOUBLE-BUFFERED: layer N issues the
+  gather (packed Q80 wire bytes, or the f32 band concat) and carries the
+  un-consumed buffer through the scan; layer N+1 dequantizes and applies
+  the residual add at its top, so the gather overlaps layer N+1's qkv
+  matmuls. Two staging buffers are live at once (the carried layer-N
+  output and the in-flight layer-N+1 gather) — the chunked-staging HBM
+  charge in comm_stats.collective_staging_bytes. The attention combine's
+  gather is consumed in-layer (the ffn rmsnorm needs x immediately) and
+  stays on the critical path — the exposed remainder
+  shard_sim.project_full_system's overlap term models.
+
+Collective census per layer: 2*(tp-1) ppermutes + 2 all_gathers (vs the
+fused scheme's 2 psums f32 / 2 scatter+gather pairs Q80) — MORE launches,
+but each ppermute is one ring hop hidden behind compute, which is what
+obs/drift's overlap-coverage gate verifies on captures. Requires dim/tp to
+divide (the ring chunks the residual width) and sp == 1.
+
 In both schemes the reference's syncRmsAtt broadcast (:161) disappears: x is
 replicated, every device computes the (cheap) rmsnorm itself. Attention runs
 fully head-parallel with the KV cache sharded over kv heads — the idiomatic
@@ -84,8 +118,8 @@ from ..models.spec import TransformerSpec
 # scope this forward emits is a name the xprof loader buckets by — the
 # attribution contract lives THERE, the emission lives HERE
 from ..obs.spans import (SCOPE_ATTN, SCOPE_EMBED, SCOPE_FFN, SCOPE_ICI_GATHER,
-                         SCOPE_ICI_PSUM, SCOPE_ICI_SCATTER, SCOPE_LAYER,
-                         SCOPE_LOGITS)
+                         SCOPE_ICI_PPERMUTE, SCOPE_ICI_PSUM,
+                         SCOPE_ICI_SCATTER, SCOPE_LAYER, SCOPE_LOGITS)
 from ..ops.linear import fake_quant_q80, matmul, rmsnorm, silu
 from ..ops.quants import FloatType, dequantize_q80_jax, quantize_q80_jax
 from ..utils.compat import shard_map as _shard_map
@@ -108,13 +142,17 @@ _MATMUL_SPECS = {
 _REPL_SPECS = {
     "tok_embedding": P(), "rms_att": P(), "rms_ffn": P(), "rms_final": P(),
 }
-# fused scheme: wo/w2 re-shard along their INPUT dim (axis 2 of the stacked
-# (L, d_out, n_in) array) — row-parallel matmuls whose outputs are partial
-# sums, combined by _combine. For Q40 leaves the input axis is the nb block
-# axis, so n_in/tp must stay a 32-multiple (checked in shard_params).
+# fused/overlap schemes: wo/w2 re-shard along their INPUT dim (axis 2 of the
+# stacked (L, d_out, n_in) array) — row-parallel matmuls whose outputs are
+# partial sums, combined by _combine (fused) or _ici_ring_reduce + gather
+# (overlap; same layout, ring-decomposed combine). For Q40 leaves the input
+# axis is the nb block axis, so n_in/tp must stay a 32-multiple (checked in
+# shard_params).
 _FUSED_OVERRIDES = {"wo": P(None, None, "tp"), "w2": P(None, None, "tp")}
 # the keys pack_q40_params must judge on shard-LOCAL input width (fused)
 FUSED_INPUT_SHARDED = frozenset(_FUSED_OVERRIDES)
+# schemes sharing the fused wo/w2 input-band layout
+_INPUT_SHARDED_SCHEMES = ("fused", "overlap")
 
 
 def param_specs(params: dict[str, Any],
@@ -123,7 +161,7 @@ def param_specs(params: dict[str, Any],
     specs: dict[str, Any] = {}
     for name, val in params.items():
         spec = _MATMUL_SPECS.get(name) or _REPL_SPECS.get(name)
-        if scheme == "fused":
+        if scheme in _INPUT_SHARDED_SCHEMES:
             spec = _FUSED_OVERRIDES.get(name, spec)
         if spec is None:
             raise KeyError(f"unknown param {name}")
@@ -202,19 +240,20 @@ def shard_params(params: dict[str, Any], mesh: Mesh,
 
     scheme = scheme or tp_scheme()
     n_tp = mesh.shape["tp"]
-    if scheme == "fused" and n_tp > 1:
+    if scheme in _INPUT_SHARDED_SCHEMES and n_tp > 1:
         # quantized wo/w2 shard along their nb block axis: fail with the
         # clear constraint here, not a sharding traceback mid-device_put
         for name in FUSED_INPUT_SHARDED:
             v = params.get(name)
             if isinstance(v, Q40Weight) and v.qs.shape[-2] % n_tp:
                 raise ValueError(
-                    f"{name}: fused tp scheme shards the input dim, but "
+                    f"{name}: {scheme} tp scheme shards the input dim, but "
                     f"{v.qs.shape[-2]} Q40 blocks do not divide over "
                     f"tp={n_tp} (need input_dim/tp to be a 32-multiple)")
     params = pack_q40_params(
         params, tp=n_tp,
-        input_sharded=FUSED_INPUT_SHARDED if scheme == "fused" else ())
+        input_sharded=(FUSED_INPUT_SHARDED
+                       if scheme in _INPUT_SHARDED_SCHEMES else ()))
     specs = param_specs(params, scheme)
 
     def put(a, s):
@@ -276,6 +315,67 @@ def _ici_scatter(a: jax.Array, axis: int) -> jax.Array:
                                     tiled=True)
 
 
+def _ici_ppermute(a: jax.Array, shift: int, n_slices: int) -> jax.Array:
+    """The overlap scheme's ring hop: a shift-by-``shift`` collective
+    permute over the tp axis (rank i -> rank (i+shift) mod S). ONE launch
+    per hop, no reduction — the cheapest collective the mesh has, and the
+    only one with no serialization against compute (comm_stats charges it
+    1 hop of latency; the ring budget kind is 'ppermute'). Swappable like
+    the other _ici_* hooks so shard_sim can run the overlap program with
+    an identity stand-in."""
+    perm = [(i, (i + shift) % n_slices) for i in range(n_slices)]
+    with jax.named_scope(SCOPE_ICI_PPERMUTE):
+        return jax.lax.ppermute(a, "tp", perm)
+
+
+def _tp_rank():
+    """This shard's tp coordinate (swappable: shard_sim substitutes a
+    constant 0 — the sim runs outside any mesh axis)."""
+    return jax.lax.axis_index("tp")
+
+
+def _ici_ring_reduce(part: jax.Array, n_slices: int,
+                     permute_fn=_ici_ppermute,
+                     rank_fn=_tp_rank) -> jax.Array:
+    """The overlap scheme's block-combine reduce: decompose the full-width
+    row-parallel partial ``part`` (..., W) into ``n_slices`` chunks and
+    ring them home — rank d sends chunk (d+k) mod S via a shift-by-k
+    ppermute at step k, so every chunk makes exactly ONE launch straight
+    to its owner while the later chunks' sends (and the surrounding
+    matmuls — nothing here depends on them) overlap it. Each rank
+    collects the S partial terms of ITS chunk into a rank-indexed stash
+    and sums them in ASCENDING RANK ORDER — the deterministic f32
+    left-fold XLA's all_reduce/reduce_scatter applies — so the returned
+    (..., W/S) band is BITWISE the fused scheme's psum_scatter band (and
+    the band-concat equals the fused psum output bit for bit; pinned by
+    tests/test_overlap_scheme.py).
+
+    Per-chip bytes: S-1 chunk payloads sent = (S-1)/S of the full payload
+    — exactly the fused reduce_scatter's ring accounting
+    (comm_stats.tp_collective_budget, 'ppermute' entry). Only sanctioned
+    collective site: the ppermute binds inside _ici_ppermute (dlint D006
+    blesses the _ici_* family and nothing else)."""
+    s = n_slices
+    if s == 1:
+        return part
+    chunk = part.shape[-1] // s
+    d = rank_fn()
+    own = jax.lax.dynamic_slice_in_dim(part, d * chunk, chunk, axis=-1)
+    stash = jnp.zeros((s, *own.shape), own.dtype)
+    stash = jax.lax.dynamic_update_slice_in_dim(stash, own[None],
+                                                jnp.mod(d, s), axis=0)
+    for k in range(1, s):
+        send = jax.lax.dynamic_slice_in_dim(
+            part, jnp.mod(d + k, s) * chunk, chunk, axis=-1)
+        recv = permute_fn(send, k, s)  # arrives from rank (d - k) mod S
+        stash = jax.lax.dynamic_update_slice_in_dim(
+            stash, recv[None], jnp.mod(d - k, s), axis=0)
+    acc = stash[0]
+    for j in range(1, s):  # rank-order left fold — the determinism pin
+        acc = acc + stash[j]
+    return acc
+
+
 def _gather(x: jax.Array, gather_fn=_ici_gather) -> jax.Array:
     """Concatenate the tp bands along the feature axis (device-order bands =
     MatmulSlice's contiguous row bands)."""
@@ -301,6 +401,16 @@ def _wire_gather(spec: TransformerSpec, x: jax.Array,
     to a 32-block multiple), so tp parity gates are unchanged. comm_stats
     reports these same byte counts — what actually crosses ICI.
     """
+    return _wire_unpack(spec, _gather(_wire_pack(spec, x), gather_fn))
+
+
+def _wire_pack(spec: TransformerSpec, x: jax.Array) -> jax.Array:
+    """The quantize+pack half of the wire cut: Q80 buffers pack ``x`` into
+    the reference's contiguous 34-byte block layout (int8 codes + f16
+    delta per 32 values — _wire_gather docstring); f32 buffers pass
+    through. Split out so the overlap scheme can gather the packed bytes
+    in layer N and defer _wire_unpack to layer N+1 (the double-buffered
+    gather) without duplicating the byte layout."""
     if spec.buffer_float_type == FloatType.Q80:
         qs, d = quantize_q80_jax(x)  # (..., nb, 32) int8, (..., nb) f16
         nb = qs.shape[-2]
@@ -308,15 +418,23 @@ def _wire_gather(spec: TransformerSpec, x: jax.Array,
             [jax.lax.bitcast_convert_type(qs, jnp.uint8),       # (..., nb, 32)
              jax.lax.bitcast_convert_type(d, jnp.uint8)],       # (..., nb, 2)
             axis=-1)                                            # (..., nb, 34)
-        flat = blocks.reshape(*blocks.shape[:-2], nb * 34)
-        wire = gather_fn(flat, flat.ndim - 1)          # (..., S*nb*34) uint8
-        n_slices = wire.shape[-1] // (nb * 34)
-        shards = wire.reshape(*wire.shape[:-1], n_slices, nb, 34)
-        qs_g = jax.lax.bitcast_convert_type(shards[..., :32], jnp.int8)
-        d_g = jax.lax.bitcast_convert_type(shards[..., 32:], jnp.float16)
-        vals = dequantize_q80_jax(qs_g, d_g)           # (..., S, nb*32)
-        return vals.reshape(*vals.shape[:-2], n_slices * nb * 32)
-    return _gather(x, gather_fn)
+        return blocks.reshape(*blocks.shape[:-2], nb * 34)
+    return x
+
+
+def _wire_unpack(spec: TransformerSpec, wire: jax.Array) -> jax.Array:
+    """Invert _wire_pack (after any gather/concat of packed shards: 34-byte
+    blocks concatenate cleanly, so shard order is value order). Lossless
+    bitcasts + the same dequantize the in-line path applies — values are
+    identical wherever the unpack runs, which is what lets the overlap
+    scheme defer it across the layer boundary."""
+    if spec.buffer_float_type == FloatType.Q80:
+        nb = wire.shape[-1] // 34
+        blocks = wire.reshape(*wire.shape[:-1], nb, 34)
+        qs = jax.lax.bitcast_convert_type(blocks[..., :32], jnp.int8)
+        d = jax.lax.bitcast_convert_type(blocks[..., 32:], jnp.float16)
+        return dequantize_q80_jax(qs, d)               # (..., nb*32)
+    return wire
 
 
 def _tp_qkv(spec: TransformerSpec, n_slices: int, lw, x, positions):
@@ -371,9 +489,31 @@ def _swiglu_local(lw, xb):
     return silu(matmul(lw["w1"], xb)) * matmul(lw["w3"], xb)
 
 
+def _deferred_init(spec: TransformerSpec, t_len: int):
+    """The overlap scheme's dummy layer-(-1) pending buffer: the carried
+    ffn-combine gather output shape — packed Q80 wire bytes or the f32
+    vector. Layer 0 never consumes it (_consume_deferred selects the raw
+    carry there), so the zeros are schedule filler, not values."""
+    if spec.buffer_float_type == FloatType.Q80:
+        return jnp.zeros((t_len, (spec.dim // 32) * 34), jnp.uint8)
+    return jnp.zeros((t_len, spec.dim), jnp.float32)
+
+
+def _consume_deferred(spec: TransformerSpec, x, pending, idx):
+    """Top-of-layer consumption of the PREVIOUS layer's deferred ffn
+    combine (overlap scheme): unpack the carried gather buffer and apply
+    the residual add layer N deferred — the same two operands, the same
+    add, just moved past the gather so the wire time hides behind this
+    layer's matmuls. Layer 0 has no previous combine: the select returns
+    the raw carry bitwise (never `x + 0`, which would flip -0.0)."""
+    with jax.named_scope(SCOPE_FFN):
+        return jnp.where(idx == 0, x, x + _wire_unpack(spec, pending))
+
+
 def _tp_tail(spec: TransformerSpec, x, lw, ao, gather_fn=_ici_gather,
              scheme: str = "ref", psum_fn=_ici_psum,
-             scatter_fn=_ici_scatter):
+             scatter_fn=_ici_scatter, n_slices: int = 1,
+             permute_fn=_ici_ppermute, rank_fn=_tp_rank):
     """Shard-local layer tail: attention output -> wo -> residual -> ffn.
 
     ref scheme: the four all_gathers here are THE per-layer tp collectives
@@ -384,7 +524,29 @@ def _tp_tail(spec: TransformerSpec, x, lw, ao, gather_fn=_ici_gather,
     attention out / hb, so the only per-layer collectives are the two block
     combines (_combine). The reference's quantize cut points survive as
     local fake-quants (_wire) where no wire remains.
+
+    overlap scheme: the fused matmuls verbatim, with each combine's reduce
+    ring-decomposed (_ici_ring_reduce) and the ffn combine's gather left
+    UN-CONSUMED — returned as ``(x_pre_residual, pending)`` for the scan
+    carry; the next layer's _consume_deferred applies the residual add.
     """
+    if scheme == "overlap":
+        with jax.named_scope(SCOPE_ATTN):
+            ao = _wire(spec, ao)                   # ⇄ quantizeMultiheadAtt
+            part = matmul(lw["wo"], ao)            # (T, dim) partial sums
+            band = _ici_ring_reduce(part, n_slices, permute_fn, rank_fn)
+            # attention combine consumed in-layer: ffn's rmsnorm needs x
+            x = x + _wire_gather(spec, band, gather_fn)
+        with jax.named_scope(SCOPE_FFN):
+            xb = rmsnorm(x, lw["rms_ffn"])
+            xb = _wire(spec, xb)                   # ⇄ quantizeRmfFfn
+            hb = _wire(spec, _swiglu_local(lw, xb))  # ⇄ quantizeFfnA (local)
+            part = matmul(lw["w2"], hb)            # (T, dim) partial sums
+            band = _ici_ring_reduce(part, n_slices, permute_fn, rank_fn)
+            # gather issued HERE, consumed at the top of the next layer
+            # (_consume_deferred) — the double-buffered wire cut
+            pending = _gather(_wire_pack(spec, band), gather_fn)
+        return x, pending
     if scheme == "fused":
         with jax.named_scope(SCOPE_ATTN):
             ao = _wire(spec, ao)                   # ⇄ quantizeMultiheadAtt
@@ -417,11 +579,18 @@ def _tp_tail(spec: TransformerSpec, x, lw, ao, gather_fn=_ici_gather,
 def _local_layer(spec: TransformerSpec, n_slices: int, n_sp: int, x, lw,
                  k_all, v_all, idx, pos, positions, gather_fn=_ici_gather,
                  scheme: str = "ref", psum_fn=_ici_psum,
-                 scatter_fn=_ici_scatter):
+                 scatter_fn=_ici_scatter, permute_fn=_ici_ppermute,
+                 rank_fn=_tp_rank, pending=None):
     """Per-device layer body. x replicated (T, dim); lw holds local tp bands;
     k/v_all hold this device's STACKED (L, sp-chunk, tp-kv-heads, hs) cache
     shard — updated in place at layer ``idx`` (see models/llama.forward on
-    why the stack rides in the carry)."""
+    why the stack rides in the carry). Returns (x, k_all, v_all, pending);
+    ``pending`` is the overlap scheme's deferred ffn-combine buffer (None
+    for ref/fused — their carries never grow)."""
+    if scheme == "overlap":
+        # apply the PREVIOUS layer's deferred ffn combine before anything
+        # reads x (layer 0 selects the raw carry)
+        x = _consume_deferred(spec, x, pending, idx)
     t_len = x.shape[0]
     heads_loc = spec.n_heads // n_slices
     kv_heads_loc = spec.n_kv_heads // n_slices
@@ -477,23 +646,33 @@ def _local_layer(spec: TransformerSpec, n_slices: int, n_sp: int, x, lw,
             ao = sp_cache_attention(spec.head_size, spec.kv_mul, seq_chunk,
                                     sp_index, qh, k_c, v_c, pos)
 
+    if scheme == "overlap":
+        x, pending = _tp_tail(spec, x, lw, ao, gather_fn, scheme, psum_fn,
+                              scatter_fn, n_slices, permute_fn, rank_fn)
+        return x, k_all, v_all, pending
     x = _tp_tail(spec, x, lw, ao, gather_fn, scheme, psum_fn, scatter_fn)
-    return x, k_all, v_all
+    return x, k_all, v_all, None
 
 
 LAYER_KEYS = ("rms_att", "rms_ffn", "wq", "wk", "wv", "wo", "w1", "w2", "w3")
 
 
-def validate_sharding(spec: TransformerSpec, mesh: Mesh) -> None:
+def validate_sharding(spec: TransformerSpec, mesh: Mesh,
+                      scheme: str | None = None) -> None:
     """Check the spec divides onto the mesh — BEFORE any device_put, so
     callers get one clear error instead of a sharding traceback mid-load.
 
     The reference's analogous constraint is `assert(d % nSlices == 0)`
     (transformer.cpp:15) plus the implicit 2^n-nodes rule (README.md:20);
-    ours is head-granular because attention is head-sharded (tp.py docstring).
+    ours is head-granular because attention is head-sharded (tp.py
+    docstring). ``scheme`` (default: the active DLLAMA_TP_SCHEME) adds the
+    overlap scheme's constraints: the ring chunks the residual width, so
+    dim/tp must divide, and the double-buffered carry assumes whole
+    sequences — sp must be 1.
     """
     n_slices = mesh.shape["tp"]
     n_sp = mesh.shape.get("sp", 1)
+    scheme = scheme or tp_scheme()
     for req, name in ((spec.n_heads, "n_heads"),
                       (spec.n_kv_heads, "n_kv_heads"),
                       (spec.hidden_dim, "hidden_dim"),
@@ -502,6 +681,17 @@ def validate_sharding(spec: TransformerSpec, mesh: Mesh) -> None:
             raise ValueError(f"{name}={req} not divisible by tp={n_slices}")
     if spec.seq_len % n_sp != 0:
         raise ValueError(f"seq_len={spec.seq_len} not divisible by sp={n_sp}")
+    if scheme == "overlap" and n_slices > 1:
+        if n_sp > 1:
+            raise ValueError(
+                f"overlap tp scheme requires sp=1, got sp={n_sp} (the "
+                f"ring-decomposed combines and the deferred ffn gather "
+                f"assume un-chunked sequences; use --tp-scheme fused "
+                f"with sp>1)")
+        if spec.dim % n_slices:
+            raise ValueError(
+                f"overlap tp scheme ring-chunks the residual width: "
+                f"dim={spec.dim} must divide by tp={n_slices}")
     if spec.buffer_float_type == FloatType.Q80:
         for req, name in ((spec.dim, "dim"), (spec.hidden_dim, "hidden_dim")):
             if (req // n_slices) % 32 != 0:
@@ -510,18 +700,32 @@ def validate_sharding(spec: TransformerSpec, mesh: Mesh) -> None:
                     f"{req}/{n_slices}")
 
 
+def _effective_scheme(scheme: str | None, n_slices: int) -> str:
+    """Resolve the scheme a program is BUILT with: at tp=1 the overlap
+    scheme has no wire to hide (the ring/gather degenerate), so it builds
+    the fused program — same math, no dead pending plumbing."""
+    scheme = scheme or tp_scheme()
+    if scheme == "overlap" and n_slices == 1:
+        return "fused"
+    return scheme
+
+
 def make_local_step(spec: TransformerSpec, n_slices: int, n_sp: int,
                     gather_fn=_ici_gather, scheme: str | None = None,
-                    psum_fn=_ici_psum, scatter_fn=_ici_scatter):
+                    psum_fn=_ici_psum, scatter_fn=_ici_scatter,
+                    permute_fn=_ici_ppermute, rank_fn=_tp_rank):
     """ONE tp-rank's single-sequence step program (embed -> scanned layers ->
     final norm -> vocab-band logits). This is the function shard_map runs on
     every chip (make_sharded_forward); parallel/shard_sim.py runs the same
     function on a single chip with tiling/identity collective stand-ins
-    (``gather_fn``/``psum_fn``/``scatter_fn``) to measure the per-chip cost
-    of shapes too big to run whole (70B tp=8). ``scheme`` picks the
-    collective schedule (module docstring); default = the active
-    DLLAMA_TP_SCHEME."""
-    scheme = scheme or tp_scheme()
+    (``gather_fn``/``psum_fn``/``scatter_fn``/``permute_fn``/``rank_fn``)
+    to measure the per-chip cost of shapes too big to run whole (70B tp=8).
+    ``scheme`` picks the collective schedule (module docstring); default =
+    the active DLLAMA_TP_SCHEME. Under the overlap scheme the scan carry
+    additionally threads the deferred ffn-combine buffer (two staging
+    buffers in flight — the double-buffered wire cut)."""
+    scheme = _effective_scheme(scheme, n_slices)
+    overlap = scheme == "overlap"
 
     def local_step(params, cache, tokens, pos):
         t_len = tokens.shape[0]
@@ -532,19 +736,33 @@ def make_local_step(spec: TransformerSpec, n_slices: int, n_sp: int,
         stacked, scanned = split_layer_weights(params)
 
         def body(carry, per_layer):
-            x, k_all, v_all = carry
+            if overlap:
+                x, k_all, v_all, pending = carry
+            else:
+                (x, k_all, v_all), pending = carry, None
             idx, lw_slice = per_layer
             with jax.named_scope(SCOPE_LAYER):
                 lw = layer_view(stacked, lw_slice, idx)
-                x, k_all, v_all = _local_layer(spec, n_slices, n_sp, x, lw,
-                                               k_all, v_all, idx, pos,
-                                               positions, gather_fn, scheme,
-                                               psum_fn, scatter_fn)
-            return (x, k_all, v_all), None
+                x, k_all, v_all, pending = _local_layer(
+                    spec, n_slices, n_sp, x, lw, k_all, v_all, idx, pos,
+                    positions, gather_fn, scheme, psum_fn, scatter_fn,
+                    permute_fn, rank_fn, pending)
+            out = ((x, k_all, v_all, pending) if overlap
+                   else (x, k_all, v_all))
+            return out, None
 
         idxs = jnp.arange(spec.n_layers, dtype=jnp.int32)
-        (x, k_new, v_new), _ = jax.lax.scan(body, (x, cache.k, cache.v),
-                                            (idxs, scanned))
+        init = (x, cache.k, cache.v)
+        if overlap:
+            init += (_deferred_init(spec, t_len),)
+        carry, _ = jax.lax.scan(body, init, (idxs, scanned))
+        if overlap:
+            x, k_new, v_new, pending = carry
+            with jax.named_scope(SCOPE_FFN):
+                # the LAST layer's deferred combine lands before the norm
+                x = x + _wire_unpack(spec, pending)
+        else:
+            x, k_new, v_new = carry
         with jax.named_scope(SCOPE_LOGITS):
             x = rmsnorm(x, params["rms_final"])
             # vocab bands -> full
@@ -566,8 +784,8 @@ def make_sharded_forward(spec: TransformerSpec, mesh: Mesh,
     """
     n_slices = mesh.shape["tp"]
     n_sp = mesh.shape.get("sp", 1)
-    scheme = scheme or tp_scheme()
-    validate_sharding(spec, mesh)
+    scheme = _effective_scheme(scheme, n_slices)
+    validate_sharding(spec, mesh, scheme)
     local_step = make_local_step(spec, n_slices, n_sp, scheme=scheme)
 
     def wrap(params, cache, tokens, pos):
@@ -665,13 +883,14 @@ def make_sharded_forward_batch_paged(spec: TransformerSpec, mesh: Mesh,
     if n_sp > 1:
         raise ValueError(f"paged KV cache requires sp=1, got sp={n_sp} "
                          f"(page tables break contiguous sequence chunks)")
-    scheme = scheme or tp_scheme()
-    validate_sharding(spec, mesh)
+    scheme = _effective_scheme(scheme, n_slices)
+    validate_sharding(spec, mesh, scheme)
     if spec.seq_len % page_size:
         raise ValueError(f"page_size={page_size} must divide "
                          f"seq_len={spec.seq_len}")
     kv_loc = spec.n_kv_heads // n_slices
     L, hs = spec.n_layers, spec.head_size
+    overlap = scheme == "overlap"
 
     def local_step(params, cache, tokens, pos, table):
         B = tokens.shape[0]
@@ -686,20 +905,38 @@ def make_sharded_forward_batch_paged(spec: TransformerSpec, mesh: Mesh,
         stacked, scanned = split_layer_weights(params)
 
         def body(carry, per_layer):
-            x, k_all, v_all = carry
+            if overlap:
+                x, k_all, v_all, pending = carry
+            else:
+                (x, k_all, v_all), pending = carry, None
             idx, lw_slice = per_layer
             with jax.named_scope(SCOPE_LAYER):
+                if overlap:
+                    x = _consume_deferred(spec, x, pending, idx)
                 lw = layer_view(stacked, lw_slice, idx)
                 with jax.named_scope(SCOPE_ATTN):
                     q, k, v = _tp_qkv(spec, n_slices, lw, x, positions)
                     ao, k_all, v_all = paged_decode_attention(
                         hs, spec.kv_mul, page_size, n_pages, q, k, v,
                         k_all, v_all, idx, pos, table)
+                if overlap:
+                    x, pending = _tp_tail(spec, x, lw, ao, scheme=scheme,
+                                          n_slices=n_slices)
+                    return (x, k_all, v_all, pending), None
                 x = _tp_tail(spec, x, lw, ao, scheme=scheme)
             return (x, k_all, v_all), None
 
         idxs = jnp.arange(L, dtype=jnp.int32)
-        (x, k4, v4), _ = jax.lax.scan(body, (x, k4, v4), (idxs, scanned))
+        init = (x, k4, v4)
+        if overlap:
+            init += (_deferred_init(spec, B),)
+        carry, _ = jax.lax.scan(body, init, (idxs, scanned))
+        if overlap:
+            x, k4, v4, pending = carry
+            with jax.named_scope(SCOPE_FFN):
+                x = x + _wire_unpack(spec, pending)
+        else:
+            x, k4, v4 = carry
         with jax.named_scope(SCOPE_LOGITS):
             x = rmsnorm(x, params["rms_final"])
             logits = _gather(matmul(params["wcls"], x))
@@ -740,13 +977,14 @@ def make_sharded_verify(spec: TransformerSpec, mesh: Mesh, page_size: int,
     if n_sp > 1:
         raise ValueError(f"speculative verify requires sp=1, got sp={n_sp} "
                          f"(page tables break contiguous sequence chunks)")
-    scheme = scheme or tp_scheme()
-    validate_sharding(spec, mesh)
+    scheme = _effective_scheme(scheme, n_slices)
+    validate_sharding(spec, mesh, scheme)
     if spec.seq_len % page_size:
         raise ValueError(f"page_size={page_size} must divide "
                          f"seq_len={spec.seq_len}")
     kv_loc = spec.n_kv_heads // n_slices
     L, hs = spec.n_layers, spec.head_size
+    overlap = scheme == "overlap"
 
     def local_step(params, cache, tokens, pos, table):
         B, K = tokens.shape
@@ -762,9 +1000,14 @@ def make_sharded_verify(spec: TransformerSpec, mesh: Mesh, page_size: int,
         stacked, scanned = split_layer_weights(params)
 
         def body(carry, per_layer):
-            x, k_all, v_all = carry
+            if overlap:
+                x, k_all, v_all, pending = carry
+            else:
+                (x, k_all, v_all), pending = carry, None
             idx, lw_slice = per_layer
             with jax.named_scope(SCOPE_LAYER):
+                if overlap:
+                    x = _consume_deferred(spec, x, pending, idx)
                 lw = layer_view(stacked, lw_slice, idx)
                 with jax.named_scope(SCOPE_ATTN):
                     q, k, v = _tp_qkv(spec, n_slices, lw, x, positions)
@@ -773,12 +1016,26 @@ def make_sharded_verify(spec: TransformerSpec, mesh: Mesh, page_size: int,
                         q.reshape(B, K, -1), k.reshape(B, K, -1),
                         v.reshape(B, K, -1), k_all, v_all, idx, pos_b,
                         table)
+                if overlap:
+                    x, pending = _tp_tail(spec, x, lw,
+                                          ao.reshape(B * K, -1),
+                                          scheme=scheme, n_slices=n_slices)
+                    return (x, k_all, v_all, pending), None
                 x = _tp_tail(spec, x, lw, ao.reshape(B * K, -1),
                              scheme=scheme)
             return (x, k_all, v_all), None
 
         idxs = jnp.arange(L, dtype=jnp.int32)
-        (x, k4, v4), _ = jax.lax.scan(body, (x, k4, v4), (idxs, scanned))
+        init = (x, k4, v4)
+        if overlap:
+            init += (_deferred_init(spec, B * K),)
+        carry, _ = jax.lax.scan(body, init, (idxs, scanned))
+        if overlap:
+            x, k4, v4, pending = carry
+            with jax.named_scope(SCOPE_FFN):
+                x = x + _wire_unpack(spec, pending)
+        else:
+            x, k4, v4 = carry
         with jax.named_scope(SCOPE_LOGITS):
             x = rmsnorm(x, params["rms_final"])
             logits = _gather(matmul(params["wcls"], x))       # (B*K, V)
@@ -817,11 +1074,12 @@ def make_sharded_forward_batch(spec: TransformerSpec, mesh: Mesh,
     """
     n_slices = mesh.shape["tp"]
     n_sp = mesh.shape.get("sp", 1)
-    scheme = scheme or tp_scheme()
-    validate_sharding(spec, mesh)
+    scheme = _effective_scheme(scheme, n_slices)
+    validate_sharding(spec, mesh, scheme)
     kv_loc = spec.n_kv_heads // n_slices
     L, S, hs = spec.n_layers, spec.seq_len, spec.head_size
     C = S // n_sp  # sp-local sequence chunk
+    overlap = scheme == "overlap"
 
     def local_step(params, cache, tokens, pos):
         B = tokens.shape[0]
@@ -835,9 +1093,14 @@ def make_sharded_forward_batch(spec: TransformerSpec, mesh: Mesh,
         stacked, scanned = split_layer_weights(params)
 
         def body(carry, per_layer):
-            x, k_all, v_all = carry
+            if overlap:
+                x, k_all, v_all, pending = carry
+            else:
+                (x, k_all, v_all), pending = carry, None
             idx, lw_slice = per_layer
             with jax.named_scope(SCOPE_LAYER):
+                if overlap:
+                    x = _consume_deferred(spec, x, pending, idx)
                 lw = layer_view(stacked, lw_slice, idx)
                 with jax.named_scope(SCOPE_ATTN):
                     q, k, v = _tp_qkv(spec, n_slices, lw, x, positions)
@@ -851,11 +1114,24 @@ def make_sharded_forward_batch(spec: TransformerSpec, mesh: Mesh,
                         ao, k_all, v_all = _batch_sp_attention(
                             spec, C, q, k, v, k_all, v_all, idx, pos,
                             kv_loc, hs)
+                if overlap:
+                    x, pending = _tp_tail(spec, x, lw, ao, scheme=scheme,
+                                          n_slices=n_slices)
+                    return (x, k_all, v_all, pending), None
                 x = _tp_tail(spec, x, lw, ao, scheme=scheme)
             return (x, k_all, v_all), None
 
         idxs = jnp.arange(L, dtype=jnp.int32)
-        (x, k4, v4), _ = jax.lax.scan(body, (x, k4, v4), (idxs, scanned))
+        init = (x, k4, v4)
+        if overlap:
+            init += (_deferred_init(spec, B),)
+        carry, _ = jax.lax.scan(body, init, (idxs, scanned))
+        if overlap:
+            x, k4, v4, pending = carry
+            with jax.named_scope(SCOPE_FFN):
+                x = x + _wire_unpack(spec, pending)
+        else:
+            x, k4, v4 = carry
         with jax.named_scope(SCOPE_LOGITS):
             x = rmsnorm(x, params["rms_final"])
             logits = _gather(matmul(params["wcls"], x))

@@ -966,7 +966,19 @@ class ContinuousEngine:
                              "engine with journal=...): the atomic "
                              "old-life handoff must land in the journal "
                              "new records are written to")
+        # config guard (PR 10): a journal recorded under different model
+        # dims / quant types / tp scheme / seed policy / weights would
+        # replay bitwise-DETERMINISTIC but bitwise-WRONG streams — refuse
+        # before re-admitting anything (JournalConfigMismatch; legacy
+        # headers without a fingerprint recover unchecked). With NOTHING
+        # live there is nothing a config change could corrupt: adopt the
+        # serving config instead of stranding the deployment on an
+        # upgrade (e.g. a tp-scheme switch over a fully-retired journal).
         entries = journal.incomplete()
+        if entries:
+            journal.check_config()
+        else:
+            journal.adopt_config()
         for e in entries:
             req = Request(tokens=e.replay_tokens, steps=e.steps,
                           temperature=e.temperature, topp=e.topp,

@@ -13,7 +13,21 @@ exact in-flight state.
 
 Format: NDJSON, one record per line, four record types —
 
-* ``{"t": "journal", "v": 1}`` — the header, always line 1;
+* ``{"t": "journal", "v": 1[, "config": {...}]}`` — the header, always
+  line 1. ``config`` (PR 10) is the model-config FINGERPRINT of the
+  process that created the journal (``config_fingerprint``: model dims,
+  quant types, tp scheme, the sampler seed policy, a weight-file digest
+  prefix). Replay determinism is only as good as the config it replays
+  under — the same coin cursor against different weights, a different
+  buffer float type, or a different pinned seed produces confidently
+  WRONG bytes — so ``ContinuousEngine.recover`` refuses (raising
+  ``JournalConfigMismatch``) when the serving config's fingerprint
+  differs from the journaled one AND the journal holds live work; with
+  nothing incomplete the journal adopts the new config instead
+  (``adopt_config`` — a config upgrade over a fully-retired journal has
+  nothing to corrupt). Legacy headers (pre-fingerprint journals) carry
+  no config and recover without the check — the operator kept them on
+  purpose;
 * ``{"t": "admit", "id", "tokens", "steps", "temperature", "topp",
   "seed", "slo", "cursor"[, "recovers"]}`` — written at ``submit()``
   time (write-AHEAD of the scheduler ever seeing the request). ``seed``
@@ -68,6 +82,59 @@ class JournalCorruption(RuntimeError):
     loudly instead of recovering wrong state."""
 
 
+class JournalConfigMismatch(RuntimeError):
+    """The journal was written under a different serving config (model
+    dims / quant types / tp scheme / seed policy / weight file) — a
+    bitwise replay against it would be silently wrong, so recovery
+    refuses. Move the journal aside to drop the in-flight work, or
+    restart with the original config to recover it."""
+
+
+def config_fingerprint(spec, scheme: str, seed_policy: str,
+                       weights_digest: str | None = None) -> dict:
+    """The serving-config fingerprint the WAL header records: everything a
+    bitwise replay depends on — model dims, weight/buffer quant types,
+    the tp collective scheme (schemes are bitwise-distinct only across
+    the ref boundary, but the scheme also gates which program replays),
+    the sampler SEED POLICY, and a weight-file digest prefix
+    (``weight_file_digest``). Plain JSON-able dict so == is the whole
+    comparison.
+
+    ``seed_policy`` is ``"explicit:<seed>"`` when the operator pinned
+    --seed (a restart under a different pinned seed changes every NEW
+    request's stream — refuse) or ``"time"`` for the time-derived
+    default (restarts under the default always pass: REPLAY never reads
+    the base seed — admit records carry each request's RESOLVED seed —
+    and new-request streams were already restart-variant by
+    construction)."""
+    return {
+        "dim": spec.dim, "hidden_dim": spec.hidden_dim,
+        "n_layers": spec.n_layers, "n_heads": spec.n_heads,
+        "n_kv_heads": spec.n_kv_heads, "vocab_size": spec.vocab_size,
+        "seq_len": spec.seq_len,
+        "weights_ftype": int(spec.weights_float_type),
+        "buffer_ftype": int(spec.buffer_float_type),
+        "tp_scheme": scheme, "seed_policy": str(seed_policy),
+        "weights_digest": weights_digest,
+    }
+
+
+def weight_file_digest(path: str, head_bytes: int = 1 << 20) -> str:
+    """A cheap weight-file identity: sha256 over (file size || first MiB),
+    16 hex chars. Full-file hashing of a multi-GB model would stall every
+    serve start; the header + first tensors + the size catch every
+    practical swap (different model, different quantization, truncation).
+    """
+    import hashlib
+
+    h = hashlib.sha256()
+    size = os.path.getsize(path)
+    h.update(str(size).encode())
+    with open(path, "rb") as fh:
+        h.update(fh.read(head_bytes))
+    return h.hexdigest()[:16]
+
+
 @dataclasses.dataclass
 class JournalEntry:
     """One request's journaled state: the admit record plus every sampled
@@ -103,13 +170,18 @@ class RequestJournal:
     """
 
     def __init__(self, path: str, fsync: str = "batch",
-                 compact_every: int = 256):
+                 compact_every: int = 256, config: dict | None = None):
         if fsync not in FSYNC_POLICIES:
             raise ValueError(f"fsync policy {fsync!r} not in "
                              f"{FSYNC_POLICIES}")
         self.path = path
         self.fsync = fsync
         self.compact_every = compact_every
+        # the SERVING config's fingerprint (config_fingerprint); written
+        # into fresh headers and compared against header_config (the
+        # journaled one) by check_config / ContinuousEngine.recover
+        self.config = config
+        self.header_config: dict | None = None
         # RLock: admit/token/retire mutate ``_entries`` AND append under
         # one critical section (submit runs on handler threads while
         # compact() rebuilds the dict on the scheduler thread — an
@@ -122,8 +194,9 @@ class RequestJournal:
         self._entries: dict[int, JournalEntry] = {}
         self._n_retired = 0
         existing = os.path.exists(path) and os.path.getsize(path) > 0
+        self._fresh = not existing
         if existing:
-            state, valid_bytes = _load_file(path)
+            state, valid_bytes, header_cfg = _load_file(path)
             if valid_bytes < os.path.getsize(path):
                 # torn tail: a crash mid-append left a partial last line —
                 # truncate to the last valid record before appending, or
@@ -131,15 +204,78 @@ class RequestJournal:
                 with open(path, "r+b") as fh:
                     fh.truncate(valid_bytes)
             existing = valid_bytes > 0  # fully-torn file: start fresh
+            self._fresh = not existing
             self._entries = state
+            self.header_config = header_cfg
             self._n_retired = sum(1 for e in state.values()
                                   if e.status is not None)
         parent = os.path.dirname(os.path.abspath(path))
         os.makedirs(parent, exist_ok=True)
         self._fh = open(path, "ab")
         if not existing:
-            self._append(_HEADER)
+            self._append(self._header_record())
+            self.header_config = config
             self.sync(force=True)
+
+    def _header_record(self) -> dict:
+        rec = dict(_HEADER)
+        if self.config is not None:
+            rec["config"] = self.config
+        return rec
+
+    def set_config(self, config: dict) -> None:
+        """Attach the serving-config fingerprint AFTER construction — the
+        CLI opens the journal before the model load (corruption must
+        fail fast, in milliseconds, not after minutes of weight
+        streaming) and only then knows the spec the fingerprint needs. A
+        freshly created journal rewrites its header to carry the config
+        (the header was written config-less at open); existing journals
+        keep their recorded header for check_config to compare."""
+        with self._lock:
+            self.config = config
+            if self._fresh and self.header_config is None:
+                # the just-written header lacks the config: rewrite in
+                # place (compact() emits self.config into the header and
+                # preserves any entries admitted in between)
+                self.compact()
+                self.header_config = config
+
+    def adopt_config(self) -> None:
+        """Re-stamp the journal with the CURRENT serving config — only
+        legal when nothing is live (ContinuousEngine.recover calls this
+        when ``incomplete()`` is empty): with no in-flight work there is
+        nothing a config change could replay wrongly, and refusing would
+        strand every journaling deployment on a scheme/config upgrade.
+        The compaction rewrite drops retired records and writes the new
+        fingerprint, so the NEXT crash compares against the config its
+        requests actually ran under."""
+        with self._lock:
+            if self.config is None or self.header_config == self.config:
+                return
+            assert not any(e.status is None for e in
+                           self._entries.values()), \
+                "adopt_config with live entries — recover() gates this"
+            self.compact()
+            self.header_config = self.config
+
+    def check_config(self) -> None:
+        """Refuse a journal whose recorded config fingerprint differs from
+        the serving one (JournalConfigMismatch, listing the drifted keys).
+        Legacy journals (no recorded config) and config-less handles pass
+        — there is nothing trustworthy to compare."""
+        old, new = self.header_config, self.config
+        if old is None or new is None or old == new:
+            return
+        drifted = sorted(k for k in set(old) | set(new)
+                         if old.get(k) != new.get(k))
+        detail = ", ".join(
+            f"{k}: journaled {old.get(k)!r} != serving {new.get(k)!r}"
+            for k in drifted)
+        raise JournalConfigMismatch(
+            f"journal {self.path} was written under a different serving "
+            f"config ({detail}) — a bitwise replay against it would be "
+            f"silently wrong. Move the journal aside to drop its "
+            f"in-flight work, or restart with the original config.")
 
     # ------------------------------------------------------------ state
 
@@ -251,8 +387,15 @@ class RequestJournal:
                            if e.status is None), key=lambda e: e.rid)
             dropped = self._n_retired
             tmp = self.path + ".compact"
+            # preserve the journal's recorded config across rotation (a
+            # handle opened without one must not strip the fingerprint)
+            head = dict(_HEADER)
+            cfg = self.config if self.config is not None \
+                else self.header_config
+            if cfg is not None:
+                head["config"] = cfg
             with open(tmp, "wb") as fh:
-                fh.write((json.dumps(_HEADER, separators=(",", ":"))
+                fh.write((json.dumps(head, separators=(",", ":"))
                           + "\n").encode())
                 for e in live:
                     fh.write((json.dumps(
@@ -344,12 +487,15 @@ def _parse_record(obj, entries: dict[int, JournalEntry],
             f"line {lineno}: malformed {t!r} record: {exc}") from exc
 
 
-def _load_file(path: str) -> tuple[dict[int, JournalEntry], int]:
-    """Parse a journal file. Returns (entries, valid_bytes) where
-    valid_bytes is the offset just past the last VALID record — shorter
-    than the file only for a torn tail. Raises JournalCorruption for any
-    non-tail damage (module docstring)."""
+def _load_file(path: str) -> tuple[dict[int, JournalEntry], int,
+                                   dict | None]:
+    """Parse a journal file. Returns (entries, valid_bytes, header_config)
+    where valid_bytes is the offset just past the last VALID record —
+    shorter than the file only for a torn tail — and header_config the
+    config fingerprint the header recorded (None on legacy headers).
+    Raises JournalCorruption for any non-tail damage (module docstring)."""
     entries: dict[int, JournalEntry] = {}
+    header_cfg: dict | None = None
     with open(path, "rb") as fh:
         data = fh.read()
     lines = data.split(b"\n")
@@ -357,7 +503,6 @@ def _load_file(path: str) -> tuple[dict[int, JournalEntry], int]:
     # anything else in the last slot is a torn (unterminated) tail
     torn = lines.pop() if lines else b""
     offset = 0
-    saw_header = False
     for i, raw in enumerate(lines):
         try:
             obj = json.loads(raw)
@@ -366,7 +511,7 @@ def _load_file(path: str) -> tuple[dict[int, JournalEntry], int]:
                 # newline-terminated but unparsable LAST line: a torn
                 # record whose tail bytes happened to include the \n —
                 # same truncate-and-report treatment
-                return entries, offset
+                return entries, offset, header_cfg
             raise JournalCorruption(
                 f"line {i + 1}: unparseable record "
                 f"{raw[:64]!r}") from exc
@@ -375,7 +520,11 @@ def _load_file(path: str) -> tuple[dict[int, JournalEntry], int]:
                     or obj.get("v") != 1):
                 raise JournalCorruption(
                     "missing or wrong journal header (line 1)")
-            saw_header = True
+            cfg = obj.get("config")
+            if cfg is not None and not isinstance(cfg, dict):
+                raise JournalCorruption(
+                    "header config fingerprint is not an object")
+            header_cfg = cfg
         else:
             try:
                 _parse_record(obj, entries, i + 1)
@@ -383,18 +532,17 @@ def _load_file(path: str) -> tuple[dict[int, JournalEntry], int]:
                 if i == len(lines) - 1 and not torn:
                     # schema-torn tail (e.g. a short but valid-JSON
                     # fragment): truncate like any other torn tail
-                    return entries, offset
+                    return entries, offset, header_cfg
                 raise
         offset += len(raw) + 1
     # no complete line at all (killed mid-header-write): fully torn —
     # truncate to zero and start fresh rather than refusing a journal
     # that never recorded anything
-    del saw_header
-    return entries, offset
+    return entries, offset, header_cfg
 
 
 def load_journal(path: str) -> list[JournalEntry]:
     """Read-only load: every entry (retired included), rid-sorted. The
     torn-tail rule applies; the file is not modified."""
-    entries, _ = _load_file(path)
+    entries, _, _ = _load_file(path)
     return sorted(entries.values(), key=lambda e: e.rid)

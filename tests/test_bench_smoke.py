@@ -171,7 +171,7 @@ def test_project_tp_reports_both_schemes(monkeypatch):
     out = bench._project_tp(llama2_13b_spec(), 8, 6.245, 848.19)
     assert out["tp_scheme"] == "fused"
     sch = out["schemes_f32"]
-    assert set(sch) == {"ref", "fused"}
+    assert set(sch) == {"ref", "fused", "overlap"}
     assert "parity anchor" in sch["ref"]["note"]
     L = llama2_13b_spec().n_layers
     assert sch["ref"]["n_collectives_per_token"] == 4 * L + 1
@@ -181,6 +181,13 @@ def test_project_tp_reports_both_schemes(monkeypatch):
     # the headline (active scheme) total beats the recorded ref total
     assert out["value"] == sch["fused"]["total_ms"] < 7.419
     assert sch["ref"]["total_ms"] == 7.419  # the BENCH_r05 anchor
+    # the overlap row (ISSUE 10): 2L(S-1) ppermutes + 2L+1 gathers, with
+    # the hidden term carried and subtracted — modeled strictly faster
+    # than fused at 13b-tp8 (the acceptance criterion)
+    assert sch["overlap"]["n_collectives_per_token"] == \
+        2 * L * 7 + 2 * L + 1
+    assert sch["overlap"]["ici_hidden_ms_modeled"] > 0
+    assert sch["overlap"]["total_ms"] < sch["fused"]["total_ms"]
 
     # under DLLAMA_TP_SCHEME=ref the headline IS the anchor row
     monkeypatch.setenv("DLLAMA_TP_SCHEME", "ref")

@@ -72,8 +72,63 @@ def test_ici_scheme_moves_less_than_star():
                 assert (fused.sent_bytes + fused.recv_bytes) < star_total
 
 
+def test_overlap_budget_analytic_pins():
+    """Pin the overlap scheme's analytic count AND bytes (ISSUE 10): per
+    layer, the two ring-decomposed combines issue 2*(S-1) single-hop
+    ppermutes — per-chip bytes exactly the fused reduce_scatter's
+    (S-1)/S of the f32 payload — plus 2 band gathers (packed Q80 bytes
+    under the Q80 wire, f32 under f32), plus the logits gather. Total
+    bytes EQUAL the fused scheme's (the decomposition moves the same
+    data); only the launch structure changes — which is the point: each
+    launch is a hideable hop."""
+    from distributed_llama_tpu.parallel.comm_stats import collective_hops
+
+    spec = _spec(L7B, FloatType.F32)
+    s, L, dim = 8, spec.n_layers, spec.dim
+    b = tp_collective_budget(spec, s, "overlap")
+    assert b.kind_counts() == {"ppermute": 2 * L * (s - 1),
+                               "all_gather": 2 * L + 1}
+    pp_bytes = 2 * L * (s - 1) * (dim // s) * 4
+    ag_bytes = 2 * L * (s - 1) * (dim // s) * 4
+    logits_bytes = (s - 1) * (spec.vocab_size // s) * 4
+    assert b.moved_bytes == pp_bytes + ag_bytes + logits_bytes
+    assert b.moved_bytes == tp_collective_budget(spec, s,
+                                                 "fused").moved_bytes
+
+    spec80 = _spec(L7B, FloatType.Q80)
+    b80 = tp_collective_budget(spec80, s, "overlap")
+    assert b80.kind_counts() == {"ppermute": 2 * L * (s - 1),
+                                 "all_gather": 2 * L + 1}
+    ag80 = 2 * L * (s - 1) * batch_bytes(FloatType.Q80, dim // s)
+    assert b80.moved_bytes == pp_bytes + ag80 + logits_bytes
+    assert b80.moved_bytes == tp_collective_budget(spec80, s,
+                                                   "fused").moved_bytes
+
+    # hop accounting: a ppermute launch is ONE hop, ring collectives S-1
+    assert collective_hops("ppermute", s) == 1
+    assert collective_hops("all_gather", s) == s - 1
+    assert collective_hops("psum", s) == s - 1
+
+
+def test_overlap_staging_adds_double_buffer_charge():
+    """The chunked-staging HBM term: overlap = the fused in-flight bound
+    PLUS two deferred-gather buffers (the double-buffered wire cut)."""
+    from distributed_llama_tpu.parallel.comm_stats import (
+        collective_staging_bytes)
+
+    for ft in (FloatType.F32, FloatType.Q80):
+        spec = _spec(L7B, ft)
+        fused = collective_staging_bytes(spec, 8, "fused")
+        over = collective_staging_bytes(spec, 8, "overlap")
+        pend = batch_bytes(ft if ft == FloatType.Q80 else FloatType.F32,
+                           spec.dim)
+        assert over == fused + 2 * pend
+    assert collective_staging_bytes(_spec(L7B, FloatType.F32), 1,
+                                    "overlap") == 0
+
+
 def test_single_slice_no_comm():
-    for scheme in ("ref", "fused"):
+    for scheme in ("ref", "fused", "overlap"):
         st = ici_all_gather_bytes(_spec(L7B, FloatType.F32), 1, scheme)
         assert st.sent_bytes == 0 and st.recv_bytes == 0
         assert tp_collective_budget(_spec(L7B, FloatType.F32), 1,

@@ -407,10 +407,29 @@ def test_d006_fires_on_inline_collective_in_tp(tmp_path):
     assert len(d006) == 2, findings
 
 
+def test_d006_fires_on_inline_ppermute_in_tp(tmp_path):
+    # the overlap scheme's ring hop outside the _ici_* family: an inline
+    # ppermute is an un-modeled ICI hop exactly like an inline psum
+    findings = run_on(tmp_path, "parallel/tp.py", """
+        import jax
+
+        def _ring_reduce_rogue(part, s):
+            acc = part
+            for k in range(1, s):
+                acc = acc + jax.lax.ppermute(
+                    part, "tp", [(i, (i + k) % s) for i in range(s)])
+            return acc
+    """)
+    d006 = [f for f in findings if f.rule == "D006"]
+    assert len(d006) == 1, findings
+    assert "ppermute" in d006[0].message
+
+
 def test_d006_quiet_in_helpers_and_outside_tp(tmp_path):
-    # the three blessed helpers may bind collectives; other files (even in
-    # parallel/) are out of scope — ring.py's sp collectives have their own
-    # comm_stats term (sp_lse_bytes) and schedule
+    # the blessed _ici_* helpers may bind collectives (the ppermute ring
+    # hop included); other files (even in parallel/) are out of scope —
+    # ring.py's sp collectives have their own comm_stats term
+    # (sp_lse_bytes) and schedule
     quiet = run_on(tmp_path, "parallel/tp.py", """
         import jax
 
@@ -423,6 +442,11 @@ def test_d006_quiet_in_helpers_and_outside_tp(tmp_path):
         def _ici_scatter(a, axis):
             return jax.lax.psum_scatter(a, "tp", scatter_dimension=axis,
                                         tiled=True)
+
+        def _ici_ppermute(a, shift, n_slices):
+            perm = [(i, (i + shift) % n_slices)
+                    for i in range(n_slices)]
+            return jax.lax.ppermute(a, "tp", perm)
     """)
     assert "D006" not in rules_fired(quiet)
     ring = run_on(tmp_path, "parallel/ring.py", """

@@ -147,6 +147,45 @@ def test_cli_serve_rejects_bad_slots(model_files):
                  "--slots", "-2"]) == 2
 
 
+def test_cli_spec_k_requires_page_size_at_argparse_time(model_files,
+                                                        tmp_path, capsys):
+    """--spec-k without --kv-page-size fails BEFORE the model load with
+    the one-line actionable error, on BOTH inference and serve (ISSUE 10
+    small fix: this used to surface deep in engine construction)."""
+    from distributed_llama_tpu.frontend.cli import main
+
+    model, tokp = model_files
+    empty = tmp_path / "prompts.txt"
+    empty.write_text("")
+    assert main(["inference", "--model", model, "--tokenizer", tokp,
+                 "--prompts-file", str(empty), "--continuous",
+                 "--spec-k", "4"]) == 2
+    assert "--kv-page-size" in capsys.readouterr().err
+    assert main(["serve", "--model", model, "--tokenizer", tokp,
+                 "--spec-k", "4"]) == 2
+    assert "--kv-page-size" in capsys.readouterr().err
+    # the valid pairing proceeds past the gate and fails later, on the
+    # empty prompts file — proving the gate ran (and passed) first
+    rc = main(["inference", "--model", model, "--tokenizer", tokp,
+               "--prompts-file", str(empty), "--continuous",
+               "--spec-k", "4", "--kv-page-size", "4"])
+    err = capsys.readouterr().err
+    assert rc == 2 and "empty" in err and "--kv-page-size" not in err
+
+
+def test_cli_overlap_scheme_rejects_sp_at_argparse_time(model_files,
+                                                        capsys):
+    """--tp-scheme overlap with --sp > 1 fails at argparse time: the
+    ring-decomposed combines assume un-chunked sequences."""
+    from distributed_llama_tpu.frontend.cli import main
+
+    model, tokp = model_files
+    assert main(["inference", "--model", model, "--tokenizer", tokp,
+                 "--tp-scheme", "overlap", "--sp", "2"]) == 2
+    err = capsys.readouterr().err
+    assert "overlap" in err and "--sp 1" in err
+
+
 def test_cli_batch_prompts_file(model_files, tmp_path, capsys):
     """--prompts-file decodes B prompts in one lockstep batch; greedy rows
     must equal the corresponding single-prompt runs."""

@@ -1,0 +1,308 @@
+"""Overlap tp scheme (ISSUE 10): ring-decomposed combines + deferred ffn
+gather must be BITWISE the fused scheme — latency hiding is a schedule
+property, never a numerics change.
+
+The load-bearing identities this file pins:
+
+* the ring's rank-order left fold == XLA's all_reduce/reduce_scatter fold,
+  so overlap logits are bit-for-bit fused logits (f32 weights, Q40
+  weights, the Q80 wire) across tp in {2, 4, 8};
+* the deferred (double-buffered) ffn gather moves WHERE the residual add
+  happens, not what it computes — pinned at the scan boundaries (a
+  1-layer model exercises first==last; multi-layer exercises the carry);
+* the decomposition holds under every cache layout the engine serves:
+  contiguous batch, paged, and the speculative K-query verify dispatch;
+* constraint errors (sp > 1, ragged ring chunks) fire loudly and early.
+"""
+
+import numpy as np
+import pytest
+
+from distributed_llama_tpu.models.spec import TransformerSpec
+from distributed_llama_tpu.ops.quants import FloatType
+
+# dims that satisfy every scheme's constraints up to tp=8 with Q40/Q80:
+# dim/8 = 32 and hidden/8 = 64 are whole 32-blocks
+SPEC = TransformerSpec(dim=256, hidden_dim=512, n_layers=2, n_heads=8,
+                       n_kv_heads=8, vocab_size=96, seq_len=16)
+SPEC80 = TransformerSpec(**{**SPEC.__dict__,
+                            "buffer_float_type": FloatType.Q80})
+SPEC_1L = TransformerSpec(**{**SPEC.__dict__, "n_layers": 1})
+SPEC_3L = TransformerSpec(**{**SPEC.__dict__, "n_layers": 3})
+
+
+def _params(spec, seed=11, scale=0.1, q40=False):
+    from distributed_llama_tpu.models.synth import synth_params
+
+    return synth_params(spec, q40=q40, seed=seed, scale=scale)
+
+
+def _forward_logits(spec, p, scheme, tp, tokens, decode_token=3):
+    """(prefill logits, decode-T=1 logits) under one scheme on a tp mesh."""
+    import jax.numpy as jnp
+
+    from distributed_llama_tpu.models.llama import init_cache
+    from distributed_llama_tpu.parallel import (make_mesh,
+                                                make_sharded_forward,
+                                                shard_cache, shard_params)
+
+    mesh = make_mesh(tp=tp)
+    fwd = make_sharded_forward(spec, mesh, scheme=scheme)
+    got, cache = fwd(shard_params(p, mesh, scheme=scheme),
+                     shard_cache(init_cache(spec), mesh),
+                     jnp.asarray(tokens, jnp.int32), jnp.int32(0))
+    got2, _ = fwd(shard_params(p, mesh, scheme=scheme), cache,
+                  jnp.asarray([decode_token], jnp.int32),
+                  jnp.int32(len(tokens)))
+    return np.asarray(got), np.asarray(got2)
+
+
+@pytest.mark.parametrize("tp", [2, 4, 8])
+def test_overlap_bitwise_equals_fused_f32(tp):
+    """The acceptance identity: f32 decode logits bitwise equal to fused
+    (prefill T>1 AND the T=1 decode step), tolerance-equal to ref."""
+    p = _params(SPEC)
+    tokens = [4, 8, 2, 61]
+    fused = _forward_logits(SPEC, p, "fused", tp, tokens)
+    over = _forward_logits(SPEC, p, "overlap", tp, tokens)
+    ref = _forward_logits(SPEC, p, "ref", tp, tokens)
+    np.testing.assert_array_equal(over[0], fused[0])
+    np.testing.assert_array_equal(over[1], fused[1])
+    np.testing.assert_allclose(over[0], ref[0], rtol=0, atol=2e-5)
+    np.testing.assert_allclose(over[1], ref[1], rtol=0, atol=2e-5)
+
+
+@pytest.mark.parametrize("tp", [2, 4, 8])
+def test_overlap_bitwise_equals_fused_q40_weights(tp):
+    """Q40 weights: the chunk slicing never touches the quantized input
+    blocks (output rows slice freely), so bitwise holds through the
+    codec path too."""
+    p = _params(SPEC, q40=True, seed=7, scale=0.3)
+    fused = _forward_logits(SPEC, p, "fused", tp, [4, 8])
+    over = _forward_logits(SPEC, p, "overlap", tp, [4, 8])
+    np.testing.assert_array_equal(over[0], fused[0])
+    np.testing.assert_array_equal(over[1], fused[1])
+
+
+@pytest.mark.parametrize("tp", [2, 4])
+def test_overlap_q80_wire_bitwise_and_tolerance(tp):
+    """The Q80 wire path: the ring's band == psum_scatter's band bitwise,
+    so the SAME packed int8+f16 payload crosses the wire and the overlap
+    logits equal fused exactly; both stay within the compounded quant
+    tolerance of the f32 reference."""
+    p = _params(SPEC, seed=31)
+    fused = _forward_logits(SPEC80, p, "fused", tp, [4, 8, 61])
+    over = _forward_logits(SPEC80, p, "overlap", tp, [4, 8, 61])
+    ref32 = _forward_logits(SPEC, p, "ref", tp, [4, 8, 61])
+    np.testing.assert_array_equal(over[0], fused[0])
+    np.testing.assert_array_equal(over[1], fused[1])
+    assert np.abs(over[0] - ref32[0]).max() < 0.15
+
+
+@pytest.mark.parametrize("spec", [SPEC_1L, SPEC_3L],
+                         ids=["one-layer", "three-layer"])
+def test_overlap_double_buffer_scan_boundaries(spec):
+    """The deferred-gather carry's boundary cases: a 1-layer scan (the
+    first layer IS the last — its pending must be consumed after the
+    scan, and the dummy layer-(-1) buffer must be select-skipped without
+    perturbing x) and a multi-layer scan (mid-carry handoff)."""
+    p = _params(spec, seed=5)
+    fused = _forward_logits(spec, p, "fused", 2, [4, 8, 2])
+    over = _forward_logits(spec, p, "overlap", 2, [4, 8, 2])
+    np.testing.assert_array_equal(over[0], fused[0])
+    np.testing.assert_array_equal(over[1], fused[1])
+
+
+def test_overlap_batch_paged_and_verify_bitwise():
+    """The other sharded entry points (contiguous batch decode, paged
+    decode, speculative K-query verify) under overlap == fused bitwise:
+    the combine decomposition rides every layer tail identically."""
+    import jax.numpy as jnp
+
+    from distributed_llama_tpu.models.llama import (init_cache_batch,
+                                                    init_cache_paged)
+    from distributed_llama_tpu.parallel import (
+        make_mesh, make_sharded_forward_batch,
+        make_sharded_forward_batch_paged, make_sharded_verify,
+        shard_cache_batch, shard_cache_paged, shard_params)
+
+    p = _params(SPEC, seed=13)
+    mesh = make_mesh(tp=2)
+    B, ps = 2, 4
+    toks = jnp.asarray([5, 9], jnp.int32)
+    pos = jnp.asarray([0, 0], jnp.int32)
+
+    outs = {}
+    for scheme in ("fused", "overlap"):
+        sp = shard_params(p, mesh, scheme=scheme)
+        fwd = make_sharded_forward_batch(SPEC, mesh, scheme=scheme)
+        cache = shard_cache_batch(init_cache_batch(SPEC, B), mesh)
+        lg, _ = fwd(sp, cache, toks, pos)
+
+        n_pages = B * (SPEC.seq_len // ps) + 1
+        table = jnp.asarray(
+            [[1, 2, 3, 4], [5, 6, 7, 8]], jnp.int32)
+        fwd_p = make_sharded_forward_batch_paged(SPEC, mesh, ps,
+                                                 scheme=scheme)
+        cache_p = shard_cache_paged(
+            init_cache_paged(SPEC, n_pages, ps), mesh)
+        lg_p, _ = fwd_p(sp, cache_p, toks, pos, table)
+
+        fwd_v = make_sharded_verify(SPEC, mesh, ps, scheme=scheme)
+        cache_v = shard_cache_paged(
+            init_cache_paged(SPEC, n_pages, ps), mesh)
+        lg_v, _ = fwd_v(sp, cache_v,
+                        jnp.asarray([[5, 7, 9, 2], [9, 1, 4, 6]],
+                                    jnp.int32), pos, table)
+        outs[scheme] = (np.asarray(lg), np.asarray(lg_p), np.asarray(lg_v))
+
+    for a, b in zip(outs["overlap"], outs["fused"]):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_overlap_rejects_sp_and_every_factory_guards():
+    """Constraint errors fire at factory/validate time with the clear
+    message, not as a mid-trace shape error. (The ring-chunk width
+    dim/tp always divides whenever the head constraint holds — dim =
+    n_heads * head_size — so the sp gate is the overlap-specific error a
+    user can actually hit; the dim check in validate_sharding is
+    defensive.)"""
+    from distributed_llama_tpu.parallel import (
+        make_mesh, make_sharded_forward, make_sharded_forward_batch)
+
+    with pytest.raises(ValueError, match="sp=1"):
+        make_sharded_forward(SPEC, make_mesh(sp=2, tp=2), scheme="overlap")
+    with pytest.raises(ValueError, match="sp=1"):
+        make_sharded_forward_batch(SPEC, make_mesh(sp=2, tp=2),
+                                   scheme="overlap")
+
+
+def test_overlap_tp1_builds_the_fused_program():
+    """At tp=1 there is no wire to hide: the overlap scheme builds the
+    fused program (no ring, no pending carry) — same logits, and the
+    traced program carries no ppermute."""
+    import jax.numpy as jnp
+
+    from distributed_llama_tpu.analysis.jaxpr_contracts import walk_fn_eqns
+    from distributed_llama_tpu.models.llama import init_cache
+    from distributed_llama_tpu.parallel import (make_mesh,
+                                                make_sharded_forward,
+                                                shard_cache, shard_params)
+
+    p = _params(SPEC)
+    mesh = make_mesh(tp=1)
+    fwd_o = make_sharded_forward(SPEC, mesh, scheme="overlap")
+    fwd_f = make_sharded_forward(SPEC, mesh, scheme="fused")
+    toks = jnp.asarray([4, 8], jnp.int32)
+    a, _ = fwd_o(shard_params(p, mesh, scheme="overlap"),
+                 shard_cache(init_cache(SPEC), mesh), toks, jnp.int32(0))
+    b, _ = fwd_f(shard_params(p, mesh, scheme="fused"),
+                 shard_cache(init_cache(SPEC), mesh), toks, jnp.int32(0))
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    eqns = walk_fn_eqns(fwd_o, shard_params(p, mesh, scheme="overlap"),
+                        shard_cache(init_cache(SPEC), mesh), toks,
+                        jnp.int32(0))
+    assert not any(e.primitive.name.startswith("ppermute") for e in eqns)
+
+
+def test_overlap_rank_sim_runs_the_decomposed_program():
+    """shard_sim stand-ins (identity permute, rank-0 index) run the
+    overlap rank program on one chip: finite logits, and the traced sim
+    carries the same matmul inventory as the fused sim — the ring is
+    value movement, not extra matmul work (Plan: the full-width partial
+    feeds the ring, so dot shapes are scheme-invariant)."""
+    import jax.numpy as jnp
+
+    from distributed_llama_tpu.analysis.jaxpr_contracts import walk_fn_eqns
+    from distributed_llama_tpu.parallel import shard_sim
+
+    bands = shard_sim.synth_rank_q40(SPEC, 2, scheme="overlap")
+    dev = shard_sim.rank_params_to_device(bands)
+    fwd = shard_sim.make_rank_forward(SPEC, 2, scheme="overlap")
+    toks = jnp.asarray([3, 11], jnp.int32)
+    got, _ = fwd(dev, shard_sim.init_rank_cache(SPEC, 2), toks,
+                 jnp.int32(0))
+    assert np.isfinite(np.asarray(got)).all()
+
+    def dots(scheme):
+        f = shard_sim.make_rank_step(SPEC, 2, scheme=scheme)
+        bands2 = shard_sim.synth_rank_q40(SPEC, 2, scheme=scheme)
+        from distributed_llama_tpu.ops.linear import dequantize_weight
+
+        dense = {k: (np.asarray(dequantize_weight(v))
+                     if hasattr(v, "qs") else v)
+                 for k, v in bands2.items()}
+        dense = shard_sim.rank_params_to_device(dense)
+        return sorted(
+            tuple(tuple(v.aval.shape) for v in e.invars)
+            for e in walk_fn_eqns(f, dense,
+                                  shard_sim.init_rank_cache(SPEC, 2),
+                                  toks, jnp.int32(0))
+            if e.primitive.name in ("dot_general", "einsum"))
+
+    assert dots("overlap") == dots("fused")
+
+
+def test_overlap_engine_streams_match_fused(monkeypatch):
+    """End to end on a tp=2 mesh: the continuous engine's token streams
+    under DLLAMA_TP_SCHEME=overlap equal the fused engine's and the
+    single-chip engine's — scheduling, paging, and the deferred-gather
+    carry all invisible in outputs."""
+    from distributed_llama_tpu.parallel import make_mesh
+    from distributed_llama_tpu.runtime.continuous import ContinuousEngine
+
+    p = _params(SPEC, seed=3)
+    reqs = [[1, 5, 9, 2], [1, 7], [1, 4, 4]]
+
+    def run(scheme=None, mesh=None):
+        if scheme is not None:
+            monkeypatch.setenv("DLLAMA_TP_SCHEME", scheme)
+        eng = ContinuousEngine(SPEC, p, slots=2, temperature=0.0, topp=0.9,
+                               seed=3, mesh=mesh)
+        outs, _ = eng.run(reqs, steps=8)
+        return outs
+
+    single = run()
+    fused = run("fused", make_mesh(tp=2))
+    over = run("overlap", make_mesh(tp=2))
+    assert over == fused == single
+
+
+def test_rogue_ppermute_fails_j001_for_serialized_schemes():
+    """The any-kind guard extended to the new kind: a ppermute traced in
+    a ref/fused forward has NO budget term and must fail J001 loudly —
+    never a crash, never a silent pass."""
+    from distributed_llama_tpu.analysis.jaxpr_contracts import (
+        _collective_kind, _moved_bytes, contract_tp_collectives)
+    import jax.numpy as jnp
+
+    # the kind normalizer + ring model speak 'ppermute'
+    assert _collective_kind("ppermute") == "ppermute"
+    assert _collective_kind("collective_permute") == "ppermute"
+    aval = jnp.zeros((4,), jnp.float32)
+    assert _moved_bytes("ppermute", aval, 4) == 16
+
+    import jax
+
+    import distributed_llama_tpu.parallel.tp as tp_mod
+
+    def psum_with_rogue_hop(a):
+        hopped = jax.lax.ppermute(  # the seeded unmodeled collective
+            a, "tp", [(i, (i + 1) % 4) for i in range(4)])
+        return tp_mod._ici_psum(a) + 0 * hopped
+
+    # the _ici_* defaults bind at def time, so patch the local-step
+    # factory make_sharded_forward looks up by name instead
+    orig_mls = tp_mod.make_local_step
+
+    def mls(spec, n_slices, n_sp, **kw):
+        kw["psum_fn"] = psum_with_rogue_hop
+        return orig_mls(spec, n_slices, n_sp, **kw)
+
+    tp_mod.make_local_step = mls
+    try:
+        res = contract_tp_collectives(scheme="fused")
+    finally:
+        tp_mod.make_local_step = orig_mls
+    assert not res.ok
+    assert "ppermute" in res.detail and "no comm_stats term" in res.detail

@@ -302,11 +302,12 @@ def test_paged_streams_match_contiguous(params, kw):
     assert got == ref
 
 
-@pytest.mark.parametrize("scheme", ["ref", "fused"])
+@pytest.mark.parametrize("scheme", ["ref", "fused", "overlap"])
 def test_paged_streams_match_over_tp_mesh(params, scheme, monkeypatch):
-    """Paged decode under BOTH tp collective schemes: attention runs
-    before the layer tail, so the ref/fused schedule difference never
-    sees the page table — streams match the single-chip engine."""
+    """Paged decode under ALL THREE tp collective schemes: attention runs
+    before the layer tail, so the scheme's schedule (ref gathers, fused
+    combines, overlap's ring + deferred gather carry) never sees the
+    page table — streams match the single-chip engine."""
     from distributed_llama_tpu.parallel import make_mesh
 
     _, ref, _ = _run(params, REQS[:3], 8)

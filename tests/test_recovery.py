@@ -218,3 +218,117 @@ def test_recovery_rides_prefix_tree(params, tmp_path):
     # the recovered siblings shared prompt pages through the tree
     assert eng2.allocator.prefix_hits >= 1
     assert eng2.audit_pages() == []
+
+
+# ---------------------------------------- config fingerprint guard (PR 10)
+
+
+def _fingerprint(seed_policy="explicit:11", scheme="single", **over):
+    import dataclasses
+
+    from distributed_llama_tpu.runtime.journal import config_fingerprint
+
+    spec = dataclasses.replace(SPEC, **{k: v for k, v in over.items()
+                                        if hasattr(SPEC, k)}) \
+        if over else SPEC
+    return config_fingerprint(spec, scheme, seed_policy,
+                              weights_digest="abcd1234deadbeef")
+
+
+def test_recover_matching_config_proceeds(params, tmp_path):
+    """The WAL header records the serving-config fingerprint; a restart
+    under the SAME config recovers normally."""
+    path = str(tmp_path / "j")
+    j = RequestJournal(path, config=_fingerprint())
+    eng = _make(params, journal=j)
+    eng.submit(_reqs()[0])
+    eng.step_many(1, quiet=True)
+    # simulated crash; same config on restart
+    j2 = RequestJournal(path, config=_fingerprint())
+    assert j2.header_config == _fingerprint()
+    eng2 = _make(params, journal=j2)
+    assert eng2.recover() == 1
+    _drain(eng2)
+
+
+def test_recover_refuses_mismatched_config(params, tmp_path):
+    """A journal with LIVE work recorded under a different config (pinned
+    seed, scheme, weight digest, dims...) must REFUSE recovery with the
+    drifted keys named — no more silently-wrong bitwise replays across
+    config changes."""
+    from distributed_llama_tpu.runtime.journal import JournalConfigMismatch
+
+    path = str(tmp_path / "j")
+    j = RequestJournal(path, config=_fingerprint("explicit:11"))
+    eng = _make(params, journal=j)
+    eng.submit(_reqs()[0])
+    eng.step_many(1, quiet=True)
+    # restart pinned to a different seed: every NEW request's stream
+    # would re-derive differently
+    j2 = RequestJournal(path, config=_fingerprint("explicit:99"))
+    eng2 = _make(params, journal=j2)
+    with pytest.raises(JournalConfigMismatch, match="seed_policy"):
+        eng2.recover()
+    # a scheme change refuses too, naming the key
+    j3 = RequestJournal(path, config=_fingerprint(scheme="overlap"))
+    eng3 = _make(params, journal=j3)
+    with pytest.raises(JournalConfigMismatch, match="tp_scheme"):
+        eng3.recover()
+
+
+def test_recover_adopts_config_when_nothing_live(params, tmp_path):
+    """A config change over a journal with NOTHING incomplete has nothing
+    to corrupt: recover() adopts the serving config (header re-stamped)
+    instead of stranding the deployment — the advertised-bitwise
+    fused→overlap upgrade must not require deleting journals."""
+    path = str(tmp_path / "j")
+    j = RequestJournal(path, config=_fingerprint(scheme="fused"))
+    eng = _make(params, journal=j)
+    req = _reqs()[0]
+    eng.submit(req)
+    _drain(eng)
+    assert req.done.is_set()
+    j.close()
+    # restart under a new scheme: zero live entries -> adopt, recover 0
+    new_cfg = _fingerprint(scheme="overlap")
+    j2 = RequestJournal(path, config=new_cfg)
+    eng2 = _make(params, journal=j2)
+    assert eng2.recover() == 0
+    assert j2.header_config == new_cfg
+    j2.close()
+    # the adopted header survives reopen: the NEXT crash compares
+    # against the config its requests actually ran under
+    j3 = RequestJournal(path)
+    assert j3.header_config == new_cfg
+    j3.close()
+
+
+def test_recover_legacy_header_unchecked(params, tmp_path):
+    """Pre-fingerprint journals (no config in the header) recover without
+    the guard — refusing every existing journal on upgrade would drop
+    in-flight work the operator kept on purpose."""
+    path = str(tmp_path / "j")
+    j = RequestJournal(path)  # legacy: no config recorded
+    eng = _make(params, journal=j)
+    eng.submit(_reqs()[0])
+    eng.step_many(1, quiet=True)
+    j2 = RequestJournal(path, config=_fingerprint())
+    assert j2.header_config is None  # the header stays legacy
+    eng2 = _make(params, journal=j2)
+    assert eng2.recover() == 1
+    _drain(eng2)
+
+
+def test_compaction_preserves_recorded_config(params, tmp_path):
+    """The compaction rewrite must carry the fingerprint forward — a
+    rotated journal that silently dropped its config would skip the
+    guard on the next restart."""
+    path = str(tmp_path / "j")
+    j = RequestJournal(path, config=_fingerprint())
+    j.admit(0, [1, 5], steps=4, temperature=0.0, topp=0.9, seed=100)
+    j.retire(0, "done")
+    j.compact()
+    j.close()
+    j2 = RequestJournal(path)
+    assert j2.header_config == _fingerprint()
+    j2.close()

@@ -35,7 +35,7 @@ def matrix_results():
 
 
 def test_full_support_matrix_is_clean(matrix_results):
-    assert len(matrix_results) == len(sc.SUPPORT_MATRIX) == 48
+    assert len(matrix_results) == len(sc.SUPPORT_MATRIX) == 72
     bad = [f.render() for r in matrix_results for f in r.findings]
     assert not bad, "\n".join(bad)
 
@@ -44,7 +44,7 @@ def test_matrix_covers_the_declared_grid():
     labels = {e.label for e in sc.SUPPORT_MATRIX}
     for m in ("7b", "13b", "70b"):
         for tp in (1, 2, 4, 8):
-            for s in ("ref", "fused"):
+            for s in ("ref", "fused", "overlap"):
                 for w in ("q40", "f16"):
                     assert f"{m}-tp{tp}-{s}-{w}" in labels
 
@@ -279,7 +279,7 @@ def test_projection_carries_hbm_verdict():
 
 def test_report_json_is_machine_readable(matrix_results):
     rep = sc.report_json(matrix_results)
-    assert rep["n_configs"] == 48 and rep["n_violations"] == 0
+    assert rep["n_configs"] == 72 and rep["n_violations"] == 0
     row = rep["configs"][0]
     assert set(row) >= {"config", "ok", "findings", "report"}
     comp = row["report"]["components_gib"]

@@ -153,7 +153,7 @@ def _name_stacks(jaxpr, out=None):
     return out
 
 
-@pytest.mark.parametrize("scheme", ["ref", "fused"])
+@pytest.mark.parametrize("scheme", ["ref", "fused", "overlap"])
 def test_tp_forward_carries_phase_and_collective_scopes(scheme):
     """The traced tp forward must label every phase and every collective
     at source — the attribution contract obs/xprof.py buckets by."""
@@ -178,7 +178,8 @@ def test_tp_forward_carries_phase_and_collective_scopes(scheme):
     for scope in PHASE_SCOPES:
         assert scope in blob, f"phase scope {scope!r} missing from trace"
     expected_coll = {"ref": ["ici_all_gather"],
-                     "fused": ["ici_all_gather", "ici_psum"]}[scheme]
+                     "fused": ["ici_all_gather", "ici_psum"],
+                     "overlap": ["ici_all_gather", "ici_ppermute"]}[scheme]
     for scope in expected_coll:
         assert scope in blob, f"collective scope {scope!r} missing"
 

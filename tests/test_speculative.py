@@ -261,11 +261,12 @@ def test_spec_streams_match_across_engine_configs(params, kw):
     assert got == ref
 
 
-@pytest.mark.parametrize("scheme", ["ref", "fused"])
+@pytest.mark.parametrize("scheme", ["ref", "fused", "overlap"])
 def test_spec_streams_bitwise_over_tp_mesh(scheme, monkeypatch):
-    """Both tp collective schemes: the sharded K-query verify dispatch
-    (tp.make_sharded_verify) keeps greedy streams bitwise equal to the
-    single-chip spec-off engine."""
+    """All three tp collective schemes: the sharded K-query verify
+    dispatch (tp.make_sharded_verify) keeps greedy streams bitwise equal
+    to the single-chip spec-off engine — for overlap that includes the
+    B*K-row ring combines and the deferred ffn-gather carry."""
     from distributed_llama_tpu.parallel import make_mesh
 
     tree = synth_params(SPEC, q40=True, seed=4, scale=0.3)
@@ -382,7 +383,7 @@ def test_verify_collective_census_per_scheme():
     from distributed_llama_tpu.analysis.jaxpr_contracts import (
         contract_verify_collectives)
 
-    for scheme in ("ref", "fused"):
+    for scheme in ("ref", "fused", "overlap"):
         res = contract_verify_collectives(scheme=scheme)
         assert res.ok, f"{scheme}: {res.detail}"
 
@@ -393,7 +394,7 @@ def test_budget_t_len_scales_bytes_not_counts():
         tp_collective_budget)
 
     spec = llama2_13b_spec()
-    for scheme in ("ref", "fused"):
+    for scheme in ("ref", "fused", "overlap"):
         b1 = tp_collective_budget(spec, 8, scheme)
         b4 = tp_collective_budget(spec, 8, scheme, t_len=4)
         assert b4.kind_counts() == b1.kind_counts()

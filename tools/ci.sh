@@ -9,11 +9,12 @@
 # `python -m distributed_llama_tpu.analysis`) fails the gate on any finding
 # not grandfathered in tools/dlint_baseline.txt — a new implicit sync or
 # retrace trap stops the build before 18 minutes of tests do — the jaxpr
-# contract head verifies the program-structure contracts (J001 for BOTH tp
-# collective schemes; a collective added to the tp forward without its
-# comm_stats term fails here), and the shardcheck head proves every
-# (model, tp, scheme, dtype) config of the support matrix shards as
-# declared and fits per-device HBM (J004/J005/J006 + budget). (The same
+# contract head verifies the program-structure contracts (J001 for ALL
+# THREE tp collective schemes, ref/fused/overlap; a collective added to
+# the tp forward without its comm_stats term fails here), and the
+# shardcheck head proves every (model, tp, scheme, dtype) config of the
+# 72-config support matrix shards as declared and fits per-device HBM
+# (J004/J005/J006 + budget). (The same
 # contracts also run inside the suite, tests/test_jaxpr_contracts.py and
 # tests/test_shardcheck_repo.py; tools/ probe scripts are outside the lint
 # surface by design.)
@@ -45,13 +46,15 @@ python -m pytest tests/test_paging.py -q -p no:cacheprovider \
 # without its comm_stats t_len term fails there.
 python -m pytest tests/test_speculative.py -q -p no:cacheprovider \
     -k "bitwise or streams or rollback"
-# drift observatory gate (ISSUE 5): tracecheck reconciles the checked-in
-# synthetic capture fixtures against the analytic collective model and
-# fails the build on any DRIFT verdict; the attribution Chrome traces are
-# archived under tools/ci_artifacts/ (gitignored) — load them in Perfetto
+# drift observatory gate (ISSUE 5 + 10): tracecheck reconciles the
+# checked-in synthetic capture fixtures — ALL THREE tp schemes — against
+# the analytic collective model and fails the build on any DRIFT verdict;
+# the attribution Chrome traces are archived under tools/ci_artifacts/
+# (gitignored) — load them in Perfetto
 mkdir -p tools/ci_artifacts
-for fixture in trace_7b_tp8_ref trace_7b_tp8_fused \
-               trace_13b_tp8_ref trace_13b_tp8_fused; do
+for fixture in trace_7b_tp8_ref trace_7b_tp8_fused trace_7b_tp8_overlap \
+               trace_13b_tp8_ref trace_13b_tp8_fused \
+               trace_13b_tp8_overlap; do
     python tools/tracecheck.py "tests/fixtures/traces/$fixture.json" \
         --chrome-out "tools/ci_artifacts/$fixture.chrome.json"
 done
@@ -67,6 +70,21 @@ set -e
 if [ "$tracecheck_rc" -ne 1 ]; then
     echo "ci: tracecheck did not flag the mutated drift fixture" \
          "(exit $tracecheck_rc, expected 1)" >&2
+    exit 1
+fi
+# ... and the overlap-scheme gate must still catch a SERIALIZED schedule:
+# the mutated fixture (ppermute hops with zero concurrent-compute
+# coverage) must exit 1 EXACTLY — latency hiding is the overlap scheme's
+# whole claim, and a capture that shows none of it is a DRIFT, not noise
+set +e
+python tools/tracecheck.py \
+    tests/fixtures/traces/trace_7b_tp8_overlap_serialized.json \
+    > /dev/null 2>&1
+overlap_rc=$?
+set -e
+if [ "$overlap_rc" -ne 1 ]; then
+    echo "ci: tracecheck did not flag the serialized-overlap fixture" \
+         "(exit $overlap_rc, expected 1)" >&2
     exit 1
 fi
 # SLO observatory gate (ISSUE 8) + crash-safety recovery gate (ISSUE 9):

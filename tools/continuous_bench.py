@@ -252,6 +252,32 @@ def main():
         "requests": args.requests, "steps": args.steps,
         "timing": timings,
     }
+    # per-scheme modeled tp rows (ISSUE 10): this tool measures a
+    # single-chip engine, so the tp collective side is MODELED — the same
+    # one-source budget bench.py projects from — for all three schemes at
+    # tp=8, so continuous rows archived next to BENCH_* stay joinable on
+    # the scheme axis. Bytes scale by the slot count (batched collectives
+    # move B rows per launch).
+    from distributed_llama_tpu.parallel.comm_stats import (
+        SCHEMES, tp_collective_budget)
+    from distributed_llama_tpu.parallel.shard_sim import modeled_ici_ms
+
+    schemes_row = {}
+    for scheme in SCHEMES:
+        b = tp_collective_budget(spec, 8, scheme)
+        bw_ms, lat_ms = modeled_ici_ms(spec, 8, scheme)
+        schemes_row[scheme] = {
+            "n_collectives_per_dispatch": b.n_collectives,
+            "kb_per_chip_per_row": round(b.moved_bytes / 1024, 1),
+            "modeled_ici_ms_total": round(bw_ms + lat_ms, 3),
+        }
+    row["tp_schemes_modeled"] = {
+        "tp": 8, "note": ("single-chip measurement; ICI modeled from "
+                          "comm_stats per scheme — overlap's hidden "
+                          "share needs a rank measurement (bench.py "
+                          "projection rows)"),
+        "schemes": schemes_row,
+    }
     if args.paged_compare:
         row["paged_equal_hbm"] = paged_compare(spec, params, args, dtype)
     if args.spec_compare:

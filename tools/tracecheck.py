@@ -18,7 +18,7 @@ sequentially per token) — CI archives it next to the gate run.
 
 Usage:
   python tools/tracecheck.py CAPTURE [--model 7b|13b|70b|small] [--tp N]
-      [--scheme ref|fused] [--buffer f32|q80] [--tokens N]
+      [--scheme ref|fused|overlap] [--buffer f32|q80] [--tokens N]
       [--chrome-out PATH] [--json]
 """
 
@@ -70,7 +70,8 @@ def main(argv=None) -> int:
     ap.add_argument("--model", default=None,
                     choices=("7b", "13b", "70b", "small"))
     ap.add_argument("--tp", type=int, default=None)
-    ap.add_argument("--scheme", default=None, choices=("ref", "fused"))
+    ap.add_argument("--scheme", default=None,
+                    choices=("ref", "fused", "overlap"))
     ap.add_argument("--buffer", default=None, choices=("f32", "q80"))
     ap.add_argument("--tokens", type=int, default=0,
                     help="tokens decoded under the capture (fixtures "
