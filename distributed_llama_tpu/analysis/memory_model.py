@@ -118,11 +118,49 @@ def default_kv_pages(spec: TransformerSpec, batch: int,
     return batch * (spec.seq_len // page_size)
 
 
+def kv_position_bytes(spec: TransformerSpec, n_slices: int,
+                      cache_itemsize: int = 4,
+                      kv_quant: str = "f32") -> int:
+    """K+V bytes of ONE sequence position on one device (all layers).
+
+    f32/bf16: ``cache_itemsize`` per value. q8 (ISSUE 11): the Q80 wire
+    layout from ops/quants.py — 1 int8 code per value plus one f16 delta
+    per 32-value block of the flattened (n_kv/tp, hs) row
+    (models/llama.PagedKVQ8), i.e. 34 bytes per 32 values: a 32/34 ≈
+    3.76x cut vs f32 (1.88x vs bf16). Exact, not approximate — the
+    equal-HBM page multiplier the engine/bench use is derived from this
+    number, and the shardcheck KV-quant column pins it."""
+    kv_dim = (spec.n_kv_heads // n_slices) * spec.head_size
+    if kv_quant == "q8":
+        per = kv_dim + 2 * (kv_dim // QK)   # int8 codes + f16 deltas
+    elif kv_quant == "f32":
+        per = kv_dim * cache_itemsize
+    else:
+        raise ValueError(f"no KV byte model for kv_quant={kv_quant!r}")
+    return 2 * spec.n_layers * per
+
+
+def equal_hbm_kv_pages(spec: TransformerSpec, n_slices: int,
+                       n_pages_f32: int,
+                       page_size: int = DEFAULT_PAGE_SIZE,
+                       cache_itemsize: int = 4) -> int:
+    """How many q8 pages the HBM of ``n_pages_f32`` f32 pages holds — the
+    capacity lever the continuous_bench equal-HBM section drives (~3.76x
+    at f32 baseline, ~1.88x at bf16)."""
+    f32_bytes = n_pages_f32 * page_size * kv_position_bytes(
+        spec, n_slices, cache_itemsize, "f32")
+    page_q8 = page_size * kv_position_bytes(spec, n_slices, kv_quant="q8")
+    return f32_bytes // page_q8
+
+
 def kv_page_pool_bytes(spec: TransformerSpec, n_slices: int, n_pages: int,
                        page_size: int = DEFAULT_PAGE_SIZE,
                        cache_itemsize: int = 4,
-                       include_scrap: bool = True) -> int:
-    """Paged-pool K+V bytes: 2 x L x pages x page_size x n_kv/tp x hs.
+                       include_scrap: bool = True,
+                       kv_quant: str = "f32") -> int:
+    """Paged-pool K+V bytes: 2 x L x pages x page_size x n_kv/tp x hs
+    (per-position pricing via ``kv_position_bytes`` — q8 pages charge the
+    Q80 codes + f16 block deltas exactly).
 
     The paged lever: ``n_pages`` is a FREE knob — contiguous slots charge
     ``slots * seq_len`` positions whether requests use them or not, the
@@ -134,9 +172,8 @@ def kv_page_pool_bytes(spec: TransformerSpec, n_slices: int, n_pages: int,
     reserved dead-write page 0 the engine actually allocates
     (models/llama.init_cache_paged gets n_pages + 1)."""
     pages = n_pages + (1 if include_scrap else 0)
-    return (2 * spec.n_layers * pages * page_size
-            * (spec.n_kv_heads // n_slices) * spec.head_size
-            * cache_itemsize)
+    return pages * page_size * kv_position_bytes(spec, n_slices,
+                                                 cache_itemsize, kv_quant)
 
 
 def activation_bytes_analytic(spec: TransformerSpec, n_slices: int,
@@ -339,7 +376,7 @@ def device_footprint(spec: TransformerSpec, n_slices: int, scheme: str,
                      activation_bytes: int | None = None,
                      device: str = "v5e", kv_page_size: int = 0,
                      kv_pages: int | None = None,
-                     spec_k: int = 0) -> MemoryReport:
+                     spec_k: int = 0, kv_quant: str = "f32") -> MemoryReport:
     """Assemble the per-device report; ``activation_bytes`` overrides the
     analytic bound with a traced live-interval peak when available.
     ``kv_page_size > 0`` charges KV as the paged pool (default pool =
@@ -349,17 +386,22 @@ def device_footprint(spec: TransformerSpec, n_slices: int, scheme: str,
     K-query verify width (the speculative dispatch runs batch * spec_k
     activation rows through every layer — ISSUE 7); weights and KV are
     unchanged, which is exactly why the verify dispatch is nearly free in
-    HBM terms."""
+    HBM terms. ``kv_quant='q8'`` (paged only) prices the pool at the Q80
+    codes+deltas byte rate (kv_position_bytes)."""
     from ..parallel.comm_stats import collective_staging_bytes
 
     t_len = max(1, spec_k)
+    if kv_quant != "f32" and kv_page_size <= 0:
+        raise ValueError(f"kv_quant={kv_quant!r} prices PAGE planes; "
+                         f"pass kv_page_size > 0")
     if activation_bytes is None:
         activation_bytes = activation_bytes_analytic(spec, n_slices,
                                                      t_len=t_len)
     if kv_page_size > 0:
         pages = (kv_pages if kv_pages is not None
                  else default_kv_pages(spec, batch, kv_page_size))
-        kv_bytes = kv_page_pool_bytes(spec, n_slices, pages, kv_page_size)
+        kv_bytes = kv_page_pool_bytes(spec, n_slices, pages, kv_page_size,
+                                      kv_quant=kv_quant)
     else:
         kv_bytes = kv_cache_device_bytes(spec, n_slices, batch=batch)
     return MemoryReport(

@@ -76,16 +76,34 @@ class MatrixEntry:
     scheme: str
     wtype: str
     expect_fits: bool
+    # KV page quantization column (ISSUE 11): 'f32' prices the contiguous
+    # max-seq KV stripe (the historical verdicts); 'q8' prices the paged
+    # pool at the engine's default page count in the Q80 codes+deltas
+    # layout (memory_model.kv_position_bytes) — a SMALLER KV term, so a
+    # config can only gain headroom, never lose it, and the declared
+    # verdict must still agree (an undeclared/stale q8 verdict fails
+    # exactly like the PR 4 stale-matrix case).
+    kv_quant: str = "f32"
 
     @property
     def label(self) -> str:
-        return f"{self.model}-tp{self.tp}-{self.scheme}-{self.wtype}"
+        base = f"{self.model}-tp{self.tp}-{self.scheme}-{self.wtype}"
+        return base if self.kv_quant == "f32" else f"{base}-{self.kv_quant}"
 
 
 SUPPORT_MATRIX = tuple(
     MatrixEntry(m, tp, s, w, _EXPECT_FITS[(m, w)][tp])
     for m in MODELS for tp in (1, 2, 4, 8)
-    for s in SCHEMES for w in WEIGHT_TYPES)
+    for s in SCHEMES for w in WEIGHT_TYPES) + tuple(
+    # the q8 KV-quant column: the serving codec (q40 weights) across the
+    # tp grid under the fused scheme (KV pricing is scheme-invariant;
+    # one scheme keeps the matrix's trace cost flat). q8 KV only SHRINKS
+    # the footprint, and none of the q40 verdicts sits within one KV
+    # stripe of its budget edge, so the verdict column matches f32 —
+    # pinned here so a memory-model edit that flips one fails loudly.
+    MatrixEntry(m, tp, "fused", "q40", _EXPECT_FITS[(m, "q40")][tp],
+                kv_quant="q8")
+    for m in MODELS for tp in (1, 2, 4, 8))
 
 
 @dataclasses.dataclass(frozen=True)
@@ -104,6 +122,7 @@ class ConfigResult:
     expect_fits: bool | None
     report: MemoryReport | None
     findings: tuple
+    kv_quant: str = "f32"  # the matrix entry's KV-quant column, verbatim
 
     @property
     def ok(self) -> bool:
@@ -388,6 +407,43 @@ def check_uniform_shards(spec, tp: int, scheme: str,
     return findings
 
 
+def check_kv_quant_pricing(spec, tp: int, config: str) -> list[ShardFinding]:
+    """KV-QUANT: the q8 page-byte formula must price the Q80 wire layout
+    EXACTLY — per position, kv_dim int8 codes + one f16 delta per 32-value
+    block of the flattened shard-local row (34 bytes per 32 values) — and
+    the equal-HBM page multiplier vs f32 must clear 2x (it is 32*4/34 ≈
+    3.76x at f32 pages; the acceptance floor is the ~2x capacity claim).
+    Recomputed here from first principles so a memory_model edit cannot
+    silently drift the capacity math the engine and bench rely on."""
+    from ..ops.quants import QK
+    from .memory_model import (DEFAULT_PAGE_SIZE, default_kv_pages,
+                               equal_hbm_kv_pages, kv_position_bytes)
+
+    findings = []
+    kv_loc = (spec.n_kv_heads // tp) * spec.head_size
+    if kv_loc % QK:
+        findings.append(ShardFinding(
+            "KV-QUANT", config,
+            f"shard-local kv width {kv_loc} does not divide into "
+            f"{QK}-value Q80 blocks — q8 KV pages cannot run this config"))
+        return findings
+    want = 2 * spec.n_layers * (kv_loc + 2 * (kv_loc // QK))
+    got = kv_position_bytes(spec, tp, kv_quant="q8")
+    if got != want:
+        findings.append(ShardFinding(
+            "KV-QUANT", config,
+            f"q8 position bytes {got} != {want} (Q80 codes+deltas) — the "
+            f"memory_model q8 formula drifted from the wire layout"))
+    pages = default_kv_pages(spec, 1, DEFAULT_PAGE_SIZE)
+    q8_pages = equal_hbm_kv_pages(spec, tp, pages, DEFAULT_PAGE_SIZE)
+    if q8_pages < 2 * pages:
+        findings.append(ShardFinding(
+            "KV-QUANT", config,
+            f"equal-HBM q8 pool holds {q8_pages} pages for {pages} f32 "
+            f"pages — below the 2x capacity floor the q8 column claims"))
+    return findings
+
+
 def check_paged_equivalence(spec, tp: int, config: str,
                             contiguous_bytes: int) -> list[ShardFinding]:
     """KV-PAGED: the paged pool at the engine's default sizing (one slot's
@@ -429,9 +485,26 @@ def check_config(entry: MatrixEntry, device: str = "v5e",
     overrides the model lookup (synth-model mutation self-tests)."""
     spec = spec if spec is not None else model_spec(entry.model, entry.wtype)
     config = entry.label
+    kv_quant = getattr(entry, "kv_quant", "f32")
+    if kv_quant not in ("f32", "q8"):
+        return ConfigResult(config, entry.expect_fits, None, (ShardFinding(
+            "KV-QUANT", config,
+            f"unknown kv_quant {kv_quant!r} (expected f32|q8) — the "
+            f"matrix declares a column the memory model cannot price"),
+        ), kv_quant=kv_quant)
     findings = check_uniform_shards(spec, entry.tp, entry.scheme, config)
     act_bytes = None
-    if not findings:
+    if not findings and kv_quant == "q8":
+        # the q8 column prices KV only: its (spec, tp, scheme, wtype)
+        # twin in the f32 matrix already traced this exact forward
+        # (J004/J005 and the activation peak are kv-quant-invariant —
+        # the trace carries no KV-quant dimension), so re-tracing 12
+        # identical programs would just slow every --all run. The
+        # footprint uses the analytic activation bound, which lands
+        # within a few MB of the traced peak at decode shapes
+        # (memory_model.activation_bytes_analytic).
+        pass
+    elif not findings:
         try:
             closed, params = trace_tp_forward(spec, entry.tp, entry.scheme,
                                               forward_builder)
@@ -450,11 +523,26 @@ def check_config(entry: MatrixEntry, device: str = "v5e",
         except Exception as e:  # noqa: BLE001 - report, don't crash the run
             findings.append(ShardFinding(
                 "TRACE", config, f"raised {type(e).__name__}: {e}"))
-    report = device_footprint(spec, entry.tp, entry.scheme,
-                              model=entry.model,
-                              activation_bytes=act_bytes, device=device)
-    findings += check_paged_equivalence(spec, entry.tp, config,
-                                        report.kv_cache_bytes)
+    if kv_quant == "q8":
+        # the q8 column prices the paged pool at the ENGINE default page
+        # count in the Q80 layout; the pricing check pins the formula and
+        # the 2x equal-HBM capacity floor
+        from .memory_model import DEFAULT_PAGE_SIZE
+
+        report = device_footprint(spec, entry.tp, entry.scheme,
+                                  model=entry.model,
+                                  activation_bytes=act_bytes,
+                                  device=device,
+                                  kv_page_size=DEFAULT_PAGE_SIZE,
+                                  kv_quant="q8")
+        findings += check_kv_quant_pricing(spec, entry.tp, config)
+    else:
+        report = device_footprint(spec, entry.tp, entry.scheme,
+                                  model=entry.model,
+                                  activation_bytes=act_bytes,
+                                  device=device)
+        findings += check_paged_equivalence(spec, entry.tp, config,
+                                            report.kv_cache_bytes)
     if report.fits != entry.expect_fits:
         if entry.expect_fits:
             findings.append(ShardFinding(
@@ -470,7 +558,8 @@ def check_config(entry: MatrixEntry, device: str = "v5e",
                 f"{report.total_bytes / GIB:.2f} GiB now leaves "
                 f"{report.headroom_bytes / GIB:.2f} GiB headroom — "
                 f"update the support matrix"))
-    return ConfigResult(config, entry.expect_fits, report, tuple(findings))
+    return ConfigResult(config, entry.expect_fits, report, tuple(findings),
+                        kv_quant=kv_quant)
 
 
 def run_shardcheck(matrix=None, device: str = "v5e") -> list[ConfigResult]:
@@ -484,7 +573,8 @@ def load_matrix(path) -> tuple[MatrixEntry, ...]:
     seeded-violation path of the CLI tests)."""
     raw = json.loads(Path(path).read_text(encoding="utf-8"))
     return tuple(MatrixEntry(e["model"], int(e["tp"]), e["scheme"],
-                             e["wtype"], bool(e["expect_fits"]))
+                             e["wtype"], bool(e["expect_fits"]),
+                             kv_quant=e.get("kv_quant", "f32"))
                  for e in raw)
 
 
@@ -497,6 +587,7 @@ def report_json(results: list[ConfigResult], device: str = "v5e") -> dict:
         "n_violations": sum(not r.ok for r in results),
         "configs": [{
             "config": r.config,
+            "kv_quant": r.kv_quant,
             "expect_fits": r.expect_fits,
             "ok": r.ok,
             "findings": [{"rule": f.rule, "detail": f.detail}

@@ -260,6 +260,16 @@ def cmd_inference(argv: list[str], quiet: bool = False) -> int:
                     help="KV cache precision: f32 = reference parity "
                          "(transformer.cpp:198-199), bf16 halves cache "
                          "memory and attention HBM traffic")
+    ap.add_argument("--kv-quant", default=None, choices=("f32", "q8"),
+                    help="KV PAGE quantization (= DLLAMA_KV_QUANT; needs "
+                         "--kv-page-size): q8 stores pool pages in the "
+                         "Q80 int8+scale wire layout — ~1/3.8 of f32 "
+                         "page bytes, so the same HBM holds ~3.8x pages "
+                         "(~1.9x vs bf16); decode quantizes on write, "
+                         "attention dequantizes on read. Greedy streams "
+                         "stay deterministic; logits move to the "
+                         "documented quantization tolerance (f32 = "
+                         "exact parity)")
     ap.add_argument("--prefill-chunk", type=int, default=0, metavar="N",
                     help="process the prompt prefix in T=N chunked forward "
                          "passes instead of one token at a time (same "
@@ -286,14 +296,25 @@ def cmd_inference(argv: list[str], quiet: bool = False) -> int:
     _apply_log_json(args)
     if args.tp_scheme:
         os.environ["DLLAMA_TP_SCHEME"] = args.tp_scheme
+    if args.kv_quant:
+        os.environ["DLLAMA_KV_QUANT"] = args.kv_quant
+    from ..ops.pallas_paged_attention import kv_quant_mode
     from ..parallel.comm_stats import tp_scheme
 
     scheme = tp_scheme()  # validate (env or flag) at argparse time
+    args.kv_quant = kv_quant_mode()  # same pattern for DLLAMA_KV_QUANT
     if args.spec_k and args.kv_page_size <= 0:
         # fail HERE, not deep in ContinuousEngine construction after a
         # multi-GB model load: rollback truncates page tables
         print("--spec-k needs the paged KV cache: add --kv-page-size P "
               "(with --continuous)", file=sys.stderr)
+        return 2
+    if args.kv_quant == "q8" and args.kv_page_size <= 0:
+        # same argparse-time contract as --spec-k: q8 quantizes PAGE
+        # planes, so it is meaningless without the paged pool — refuse
+        # before the multi-GB model load
+        print("--kv-quant q8 quantizes paged KV pages: add "
+              "--kv-page-size P (with --continuous)", file=sys.stderr)
         return 2
     if scheme == "overlap" and args.sp > 1:
         print("--tp-scheme overlap needs --sp 1: the ring-decomposed "
@@ -435,6 +456,7 @@ def cmd_inference(argv: list[str], quiet: bool = False) -> int:
                                 kv_pages=args.kv_pages,
                                 spec_k=args.spec_k,
                                 spec_ngram=args.spec_ngram,
+                                kv_quant=args.kv_quant,
                                 metrics=reg)
             if reg is not None:
                 print(reg.expose(), file=sys.stderr, end="")
@@ -454,6 +476,14 @@ def cmd_inference(argv: list[str], quiet: bool = False) -> int:
             print("--spec-k only applies to the continuous engine; use "
                   "--continuous (with --kv-page-size) for speculative "
                   "decoding", file=sys.stderr)
+        if args.kv_page_size or args.kv_quant != "f32":
+            # paged KV (and therefore q8 pages) is a continuous-engine
+            # mode too — the lockstep batch runs the contiguous f32
+            # cache, and a silently-dropped --kv-quant q8 would read as
+            # "no capacity win"
+            print("--kv-page-size/--kv-quant only apply to the "
+                  "continuous engine; add --continuous for the paged "
+                  "(and quantized) KV pool", file=sys.stderr)
         generate_batch(spec, params, tokenizer, prompts, args.steps,
                        args.temperature, args.topp, seed,
                        cache_dtype=cache_dtype, mesh=mesh, quiet=quiet)
@@ -615,6 +645,13 @@ def cmd_serve(argv: list[str]) -> int:
                     help="paged-KV pool size in pages (default: "
                          "slots * seq_len / page-size; fewer pages serve "
                          "more slots at equal HBM)")
+    ap.add_argument("--kv-quant", default=None, choices=("f32", "q8"),
+                    help="KV page quantization (= DLLAMA_KV_QUANT; needs "
+                         "--kv-page-size): q8 halves-and-more the page "
+                         "bytes (Q80 int8+scale planes, ~1/3.8 of f32) "
+                         "so the same HBM serves ~3.8x pool pages; "
+                         "surfaces in /health paged_kv and "
+                         "dllama_kv_quant_info")
     ap.add_argument("--spec-k", type=int, default=0, metavar="K",
                     help="self-speculative decoding (needs "
                          "--kv-page-size): n-gram drafts verified K "
@@ -690,6 +727,12 @@ def cmd_serve(argv: list[str]) -> int:
         return supervise(serve_child_cmd(argv),
                          max_restarts=args.max_restarts)
     _apply_log_json(args)
+    if args.kv_quant:
+        os.environ["DLLAMA_KV_QUANT"] = args.kv_quant
+    from ..ops.pallas_paged_attention import kv_quant_mode
+
+    args.kv_quant = kv_quant_mode()  # env or flag, validated HERE —
+    #                                  before any gate or model load
     if args.slots < 1:
         print(f"--slots must be positive, got {args.slots}", file=sys.stderr)
         return 2
@@ -702,6 +745,12 @@ def cmd_serve(argv: list[str]) -> int:
         # engine construction after the model load
         print("--spec-k needs the paged KV cache: add --kv-page-size P",
               file=sys.stderr)
+        return 2
+    if args.kv_quant == "q8" and args.kv_page_size <= 0:
+        # q8 quantizes PAGE planes — meaningless without the pool; fail
+        # before the model load, exactly like the inference-mode gate
+        print("--kv-quant q8 quantizes paged KV pages: add "
+              "--kv-page-size P", file=sys.stderr)
         return 2
     from ..obs.slo import SLOPolicy
     from ..runtime.chaos import ChaosMonkey
@@ -780,7 +829,9 @@ def cmd_serve(argv: list[str]) -> int:
                        else "time")
         journal.set_config(config_fingerprint(
             spec, tp_scheme() if sharded else "single", seed_policy,
-            weights_digest=weight_file_digest(args.model)))
+            weights_digest=weight_file_digest(args.model),
+            kv_quant=args.kv_quant,
+            kv_cache_dtype=args.kv_cache_dtype))
     cache_dtype = jnp.bfloat16 if args.kv_cache_dtype == "bf16" else None
     try:
         server = InferenceServer(spec, params, tokenizer, args.host,
@@ -797,7 +848,8 @@ def cmd_serve(argv: list[str]) -> int:
                                  spec_ngram=args.spec_ngram, slo=slo,
                                  chaos=chaos, journal=journal,
                                  watchdog_s=args.watchdog_ms / 1e3,
-                                 drain_s=args.drain_s)
+                                 drain_s=args.drain_s,
+                                 kv_quant=args.kv_quant)
     except Exception as e:
         from ..runtime.journal import JournalConfigMismatch
 

@@ -538,6 +538,171 @@ def init_cache_paged(spec: TransformerSpec, n_pages: int, page_size: int,
     return KVCache(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
 
 
+class PagedKVQ8(NamedTuple):
+    """Q8-quantized page pool (ISSUE 11): the Q80 wire layout from
+    ops/quants.py laid out plane-wise per pool page. ``kq``/``vq`` are the
+    int8 code planes with EXACTLY the f32 pool's (L, P, page_size, n_kv,
+    hs) geometry (every index computation — page tables, scrap parking,
+    rollback truncation — carries over unchanged); ``kd``/``vd`` are the
+    f16 block deltas, one per QK values of a position's flattened
+    (n_kv * hs) row: (L, P, page_size, n_kv * hs // QK). Per position
+    that is kv_dim + 2*kv_dim/QK bytes against the f32 pool's 4*kv_dim —
+    a ~3.8x page-byte cut (~1.9x vs bf16), which
+    analysis/memory_model.kv_page_pool_bytes prices exactly and the
+    engine turns into ~2-4x pool pages at equal HBM."""
+
+    kq: jax.Array  # (L, P, page_size, n_kv, hs) int8 Q80 codes
+    kd: jax.Array  # (L, P, page_size, n_kv*hs//QK) f16 block deltas
+    vq: jax.Array
+    vd: jax.Array
+
+
+def init_cache_paged_q8(spec: TransformerSpec, n_pages: int,
+                        page_size: int) -> PagedKVQ8:
+    """Q8 page pool: init_cache_paged's quantized twin. The flattened
+    per-position row (n_kv * hs values) must divide into Q80 blocks —
+    callers shard kv heads over tp first, so the constraint is on the
+    LOCAL width (parallel/tp.py validates the sharded case)."""
+    from ..ops.quants import QK
+
+    if spec.seq_len % page_size:
+        raise ValueError(f"page_size={page_size} must divide "
+                         f"seq_len={spec.seq_len}")
+    kv_dim = spec.n_kv_heads * spec.head_size
+    if kv_dim % QK:
+        raise ValueError(
+            f"q8 KV pages quantize the flattened (n_kv, hs) position row "
+            f"in {QK}-value Q80 blocks: kv_dim={kv_dim} must divide by "
+            f"{QK}")
+    codes = (spec.n_layers, n_pages, page_size, spec.n_kv_heads,
+             spec.head_size)
+    deltas = (spec.n_layers, n_pages, page_size, kv_dim // QK)
+    return PagedKVQ8(jnp.zeros(codes, jnp.int8),
+                     jnp.zeros(deltas, jnp.float16),
+                     jnp.zeros(codes, jnp.int8),
+                     jnp.zeros(deltas, jnp.float16))
+
+
+def paged_cache_planes(cache):
+    """Flatten a paged pool cache — KVCache (f32/bf16) or PagedKVQ8 —
+    into its rank-4 (L*P, page_size, ...) scan-carry views (the
+    lane-friendly merge rationale of forward_batch_paged). THE one
+    implementation shared by both single-chip paged forwards and both
+    tp factories, so a plane-layout change cannot drift between the
+    four scan bodies. Returns (planes tuple, n_pages)."""
+    if isinstance(cache, PagedKVQ8):
+        L, P, ps, n_kv, hs = cache.kq.shape
+        nb = cache.kd.shape[-1]
+        return (cache.kq.reshape(L * P, ps, n_kv, hs),
+                cache.kd.reshape(L * P, ps, nb),
+                cache.vq.reshape(L * P, ps, n_kv, hs),
+                cache.vd.reshape(L * P, ps, nb)), P
+    L, P, ps, n_kv, hs = cache.k.shape
+    return (cache.k.reshape(L * P, ps, n_kv, hs),
+            cache.v.reshape(L * P, ps, n_kv, hs)), P
+
+
+def rebuild_paged_cache(planes, n_layers: int):
+    """paged_cache_planes' inverse: reassemble the scan-carry views into
+    the rank-5 pool cache (2 planes -> KVCache, 4 -> PagedKVQ8)."""
+    L = n_layers
+    if len(planes) == 4:
+        kq4, kd4, vq4, vd4 = planes
+        LP, ps, n_kv, hs = kq4.shape
+        P = LP // L
+        nb = kd4.shape[-1]
+        return PagedKVQ8(kq4.reshape(L, P, ps, n_kv, hs),
+                         kd4.reshape(L, P, ps, nb),
+                         vq4.reshape(L, P, ps, n_kv, hs),
+                         vd4.reshape(L, P, ps, nb))
+    k4, v4 = planes
+    LP, ps, n_kv, hs = k4.shape
+    P = LP // L
+    return KVCache(k4.reshape(L, P, ps, n_kv, hs),
+                   v4.reshape(L, P, ps, n_kv, hs))
+
+
+def paged_attention_q8(head_size: int, kv_mul: int, page_size: int,
+                       n_pages: int, q: jax.Array, k: jax.Array,
+                       v: jax.Array, kq_all, kd_all, vq_all, vd_all,
+                       idx, pos: jax.Array, table: jax.Array):
+    """Q8-page twin of paged_decode_attention AND spec_verify_attention in
+    one function: T=1 is the decode step, T=K the speculative-verify
+    window (the location/mask math is spec_verify_attention's, which
+    reduces to the decode case at T=1).
+
+    Quantize-on-write: each (row, window-offset) position Q80-encodes its
+    flattened (n_kv*hs) k/v row — int8 codes into the code plane at the
+    page-table-mapped (physical page, offset), f16 block deltas into the
+    delta plane at the same coordinates. Dequantize-on-read happens
+    inside the paged flash kernel's page loop, or in the XLA gather
+    fallback below — SAME value map (codes.astype(f32) * d.astype(f32)),
+    so both routes agree and quantization error is paid exactly once per
+    written position. q (B, T, n_q*hs); k/v (B, T, n_kv*hs) f32. Returns
+    (ao (B, T, n_q*hs), kq_all, kd_all, vq_all, vd_all)."""
+    from ..ops.quants import QK, quantize_q80_jax
+    from ..runtime.paging import SCRAP_PAGE
+
+    B, t_len = q.shape[0], q.shape[1]
+    n_kv = kq_all.shape[-2]
+    n_q = q.shape[-1] // head_size
+    nb = (n_kv * head_size) // QK
+    pos_b = jnp.broadcast_to(jnp.asarray(pos, jnp.int32), (B,))
+    max_pages = table.shape[1]
+    s_virt = max_pages * page_size
+    k_qs, k_d = quantize_q80_jax(k)   # (B,T,nb,QK) int8, (B,T,nb) f16
+    v_qs, v_d = quantize_q80_jax(v)
+    k_codes = k_qs.reshape(B, t_len, n_kv, head_size)
+    v_codes = v_qs.reshape(B, t_len, n_kv, head_size)
+    # per-(row, window-offset) writes, in place on the carries — the same
+    # B-updates-not-scatter rationale (and the same scrap-page overflow
+    # routing) as spec_verify_attention
+    for b in range(B):
+        for i in range(t_len):
+            p = pos_b[b] + i
+            logical = jnp.minimum(p // page_size, max_pages - 1)
+            page = jnp.where(p < s_virt,
+                             jnp.take(table[b], logical), SCRAP_PAGE)
+            row = idx * n_pages + page
+            off = p % page_size
+            kq_all = jax.lax.dynamic_update_slice(
+                kq_all, k_codes[b, i][None, None], (row, off, 0, 0))
+            kd_all = jax.lax.dynamic_update_slice(
+                kd_all, k_d[b, i][None, None], (row, off, 0))
+            vq_all = jax.lax.dynamic_update_slice(
+                vq_all, v_codes[b, i][None, None], (row, off, 0, 0))
+            vd_all = jax.lax.dynamic_update_slice(
+                vd_all, v_d[b, i][None, None], (row, off, 0))
+
+    from ..ops.pallas_paged_attention import maybe_paged_flash_decode
+
+    ao = maybe_paged_flash_decode(
+        q, (kq_all, kd_all, vq_all, vd_all), idx, pos_b, table,
+        page_size=page_size, n_pages=n_pages, head_size=head_size,
+        t_len=t_len, n_kv=n_kv, kv_mul=kv_mul, kv_quant="q8")
+    if ao is None:
+        # XLA fallback: gather the code/delta rows, dequantize (the ONE
+        # shared value map, quants.dequantize_q80_planes), and run the
+        # shared attention core over the virtual plane — the same mask
+        # contract as the f32 paged paths
+        from ..ops.quants import dequantize_q80_planes
+
+        rows = (idx * n_pages + table).reshape(-1)
+        kq_c = jnp.take(kq_all, rows, axis=0).reshape(B, s_virt, n_kv,
+                                                      head_size)
+        kd_c = jnp.take(kd_all, rows, axis=0).reshape(B, s_virt, nb)
+        vq_c = jnp.take(vq_all, rows, axis=0).reshape(B, s_virt, n_kv,
+                                                      head_size)
+        vd_c = jnp.take(vd_all, rows, axis=0).reshape(B, s_virt, nb)
+        q_pos = pos_b[:, None] + jnp.arange(t_len)[None, :]
+        mask = jnp.arange(s_virt)[None, None, :] <= q_pos[:, :, None]
+        ao = attention_core(head_size, kv_mul,
+                            q.reshape(B, t_len, n_q, head_size),
+                            dequantize_q80_planes(kq_c, kd_c),
+                            dequantize_q80_planes(vq_c, vd_c), mask)
+    return ao, kq_all, kd_all, vq_all, vd_all
+
+
 def paged_decode_attention(head_size: int, kv_mul: int, page_size: int,
                            n_pages: int, q: jax.Array, k: jax.Array,
                            v: jax.Array, k_all: jax.Array, v_all: jax.Array,
@@ -553,10 +718,13 @@ def paged_decode_attention(head_size: int, kv_mul: int, page_size: int,
     view lays pages out in logical order, so position p of the virtual
     (B, S, n_kv, hs) plane holds exactly the value the contiguous cache
     holds at column p — the ragged mask and attention_core are shared with
-    the contiguous path, making paged logits BITWISE equal to contiguous
-    logits (the parity gate of tests/test_paging.py). No flash-decode
-    kernel here: the Pallas walk assumes a contiguous row; the paged XLA
-    gather is the fallback on every backend until a paged kernel lands.
+    the contiguous path, making the XLA route's paged logits BITWISE equal
+    to contiguous logits (the parity gate of tests/test_paging.py, and
+    what CPU engines run). On TPU the paged flash-decode Pallas kernel
+    (ops/pallas_paged_attention.py, ISSUE 11) takes over via the routing
+    gate below: the DMA loop walks the page table directly — live pages
+    only, no gather copy — at the documented flash reassociation
+    tolerance vs this XLA route.
     """
     B = q.shape[0]
     n_kv = k_all.shape[-2]
@@ -576,6 +744,19 @@ def paged_decode_attention(head_size: int, kv_mul: int, page_size: int,
                                              (row, off_b[b], 0, 0))
         v_all = jax.lax.dynamic_update_slice(v_all, v_new[b:b + 1],
                                              (row, off_b[b], 0, 0))
+    from ..ops.pallas_paged_attention import maybe_paged_flash_decode
+
+    # paged flash kernel (ISSUE 11): the DMA loop walks the page table
+    # directly — live pages only, no gather copy. One routing gate shared
+    # with the verify shape and both tp factories; None = XLA fallback
+    # (CPU engines and unsupported shapes), which stays BITWISE equal to
+    # the contiguous path (the PR 6 parity gate).
+    ao = maybe_paged_flash_decode(
+        q.reshape(B, 1, -1), (k_all, v_all), idx, pos_b, table,
+        page_size=page_size, n_pages=n_pages, head_size=head_size,
+        t_len=1, n_kv=n_kv, kv_mul=kv_mul)
+    if ao is not None:
+        return ao.reshape(B, -1), k_all, v_all
     s_virt = table.shape[1] * page_size
     rows = (idx * n_pages + table).reshape(-1)            # (B * max_pages,)
     k_c = jnp.take(k_all, rows, axis=0).reshape(B, s_virt, n_kv, head_size)
@@ -590,9 +771,9 @@ def paged_decode_attention(head_size: int, kv_mul: int, page_size: int,
 
 
 def forward_batch_paged(spec: TransformerSpec, page_size: int,
-                        params: dict[str, Any], cache: KVCache,
+                        params: dict[str, Any], cache,
                         tokens: jax.Array, pos_vec: jax.Array,
-                        table: jax.Array) -> tuple[jax.Array, KVCache]:
+                        table: jax.Array, *, kv_quant: str = "f32"):
     """Decode one token per row against the PAGED page-pool cache.
 
     forward_batch_ragged's twin for the paged layout: cache planes are
@@ -606,39 +787,49 @@ def forward_batch_paged(spec: TransformerSpec, page_size: int,
     pinned parity gate). jit with (spec, page_size) static and the cache
     donated: the rank-4 page-plane view rides the scan carry in place, so
     J002's zero-copy-per-token contract holds under paging too.
+
+    ``kv_quant='q8'`` (ISSUE 11) swaps the pool for the Q80-quantized
+    PagedKVQ8 planes: decode quantizes each position's k/v row on write
+    and the attention path dequantizes on read (paged_attention_q8) —
+    parity against f32 moves to distribution-pinned tolerance gates, the
+    documented quantization contract.
     """
     B = tokens.shape[0]
     x = params["tok_embedding"][tokens].astype(jnp.float32)  # (B, dim)
     positions = pos_vec if jnp.ndim(pos_vec) == 1 else jnp.full((B,),
                                                                 pos_vec)
-    n_kv, hs, kv_mul = spec.n_kv_heads, spec.head_size, spec.kv_mul
-    L, P = spec.n_layers, cache.k.shape[1]
-
-    # rank-4 (L*P, page_size, n_kv, hs) carry view — same layout rationale
+    hs, kv_mul = spec.head_size, spec.kv_mul
+    q8 = kv_quant == "q8"
+    L = spec.n_layers
+    # rank-4 (L*P, page_size, ...) carry views — same layout rationale
     # as forward_batch's (L*B, S, ...) merge: the rank-5 carry provokes a
     # lane-padded normalization copy out of XLA's layout assignment
-    k4 = cache.k.reshape(L * P, page_size, n_kv, hs)
-    v4 = cache.v.reshape(L * P, page_size, n_kv, hs)
+    planes, P = paged_cache_planes(cache)
 
     stacked, scanned = split_layer_weights(params)
 
     def scan_body(carry, per_layer):
-        x, k_all, v_all = carry
+        x, *kv = carry
         idx, lw_slice = per_layer
         lw = layer_view(stacked, lw_slice, idx)
         q, k, v = _qkv_proj(spec, lw, x, positions)
-        ao, k_all, v_all = paged_decode_attention(
-            hs, kv_mul, page_size, P, q, k, v, k_all, v_all, idx, pos_vec,
-            table)
+        if q8:
+            ao, *kv = paged_attention_q8(
+                hs, kv_mul, page_size, P, q[:, None], k[:, None],
+                v[:, None], *kv, idx, pos_vec, table)
+            ao = ao.reshape(B, -1)
+        else:
+            ao, *kv = paged_decode_attention(
+                hs, kv_mul, page_size, P, q, k, v, *kv, idx, pos_vec,
+                table)
         x = _post_attention(spec, lw, x, ao)
-        return (x, k_all, v_all), None
+        return (x, *kv), None
 
     idxs = jnp.arange(L, dtype=jnp.int32)
-    (x, k4, v4), _ = jax.lax.scan(scan_body, (x, k4, v4), (idxs, scanned))
+    (x, *kv), _ = jax.lax.scan(scan_body, (x, *planes), (idxs, scanned))
     x = rmsnorm(x, params["rms_final"])
     logits = matmul(params["wcls"], x)
-    return logits, KVCache(k4.reshape(L, P, page_size, n_kv, hs),
-                           v4.reshape(L, P, page_size, n_kv, hs))
+    return logits, rebuild_paged_cache(tuple(kv), L)
 
 
 def spec_verify_attention(head_size: int, kv_mul: int, page_size: int,
@@ -685,6 +876,16 @@ def spec_verify_attention(head_size: int, kv_mul: int, page_size: int,
                 k_all, k_new[b, i][None, None], (row, p % page_size, 0, 0))
             v_all = jax.lax.dynamic_update_slice(
                 v_all, v_new[b, i][None, None], (row, p % page_size, 0, 0))
+    from ..ops.pallas_paged_attention import maybe_paged_flash_decode
+
+    # the K-query verify shape rides the SAME paged flash kernel (t_len=K
+    # stacked causal windows) through the same routing gate as decode
+    ao = maybe_paged_flash_decode(
+        q, (k_all, v_all), idx, pos_b, table, page_size=page_size,
+        n_pages=n_pages, head_size=head_size, t_len=t_len, n_kv=n_kv,
+        kv_mul=kv_mul)
+    if ao is not None:
+        return ao, k_all, v_all
     rows = (idx * n_pages + table).reshape(-1)            # (B * max_pages,)
     k_c = jnp.take(k_all, rows, axis=0).reshape(B, s_virt, n_kv, head_size)
     v_c = jnp.take(v_all, rows, axis=0).reshape(B, s_virt, n_kv, head_size)
@@ -698,9 +899,9 @@ def spec_verify_attention(head_size: int, kv_mul: int, page_size: int,
 
 
 def forward_batch_spec_paged(spec: TransformerSpec, page_size: int,
-                             params: dict[str, Any], cache: KVCache,
+                             params: dict[str, Any], cache,
                              tokens: jax.Array, pos_vec: jax.Array,
-                             table: jax.Array) -> tuple[jax.Array, KVCache]:
+                             table: jax.Array, *, kv_quant: str = "f32"):
     """The K-query speculative VERIFY step over the paged pool cache.
 
     forward_batch_paged's sibling for draft verification (ISSUE 7): row b
@@ -725,33 +926,36 @@ def forward_batch_spec_paged(spec: TransformerSpec, page_size: int,
     pos_b = jnp.broadcast_to(jnp.asarray(pos_vec, jnp.int32), (B,))
     positions = (pos_b[:, None]
                  + jnp.arange(K, dtype=jnp.int32)[None, :]).reshape(-1)
-    n_kv, hs, kv_mul = spec.n_kv_heads, spec.head_size, spec.kv_mul
-    L, P = spec.n_layers, cache.k.shape[1]
-
-    k4 = cache.k.reshape(L * P, page_size, n_kv, hs)
-    v4 = cache.v.reshape(L * P, page_size, n_kv, hs)
+    hs, kv_mul = spec.head_size, spec.kv_mul
+    q8 = kv_quant == "q8"
+    L = spec.n_layers
+    planes, P = paged_cache_planes(cache)
 
     stacked, scanned = split_layer_weights(params)
 
     def scan_body(carry, per_layer):
-        x, k_all, v_all = carry
+        x, *kv = carry
         idx, lw_slice = per_layer
         lw = layer_view(stacked, lw_slice, idx)
         q, k, v = _qkv_proj(spec, lw, x, positions)        # (B*K, ...)
-        ao, k_all, v_all = spec_verify_attention(
-            hs, kv_mul, page_size, P, q.reshape(B, K, -1),
-            k.reshape(B, K, -1), v.reshape(B, K, -1), k_all, v_all, idx,
-            pos_b, table)
+        if q8:
+            ao, *kv = paged_attention_q8(
+                hs, kv_mul, page_size, P, q.reshape(B, K, -1),
+                k.reshape(B, K, -1), v.reshape(B, K, -1), *kv, idx,
+                pos_b, table)
+        else:
+            ao, *kv = spec_verify_attention(
+                hs, kv_mul, page_size, P, q.reshape(B, K, -1),
+                k.reshape(B, K, -1), v.reshape(B, K, -1), *kv, idx,
+                pos_b, table)
         x = _post_attention(spec, lw, x, ao.reshape(B * K, -1))
-        return (x, k_all, v_all), None
+        return (x, *kv), None
 
     idxs = jnp.arange(L, dtype=jnp.int32)
-    (x, k4, v4), _ = jax.lax.scan(scan_body, (x, k4, v4), (idxs, scanned))
+    (x, *kv), _ = jax.lax.scan(scan_body, (x, *planes), (idxs, scanned))
     x = rmsnorm(x, params["rms_final"])
     logits = matmul(params["wcls"], x)                     # (B*K, vocab)
-    return (logits.reshape(B, K, -1),
-            KVCache(k4.reshape(L, P, page_size, n_kv, hs),
-                    v4.reshape(L, P, page_size, n_kv, hs)))
+    return logits.reshape(B, K, -1), rebuild_paged_cache(tuple(kv), L)
 
 
 def gather_pages(cache: KVCache, table: jax.Array,
@@ -786,6 +990,55 @@ def scatter_pages(cache: KVCache, seq_cache: KVCache, table: jax.Array,
         return plane.at[:, table].set(upd)
 
     return KVCache(s(cache.k, seq_cache.k), s(cache.v, seq_cache.v))
+
+
+def gather_pages_q8(cache: PagedKVQ8, table: jax.Array,
+                    page_size: int) -> KVCache:
+    """gather_pages' Q8 twin: materialize one slot's virtual (L, S, n_kv,
+    hs) sequence cache FROM the quantized pool, dequantized to f32 — the
+    admission-prefill seed (the single-sequence prefill program computes
+    in f32 and must attend over the shared prefix's dequantized k/v, the
+    same values decode reads)."""
+    from ..ops.quants import QK, dequantize_q80_planes
+
+    L, _, ps, n_kv, hs = cache.kq.shape
+    nb = n_kv * hs // QK
+    S = table.shape[0] * page_size
+
+    def g(codes, d):
+        qc = jnp.take(codes, table, axis=1).reshape(L, S, n_kv, hs)
+        dc = jnp.take(d, table, axis=1).reshape(L, S, nb)
+        return dequantize_q80_planes(qc, dc)
+
+    return KVCache(g(cache.kq, cache.kd), g(cache.vq, cache.vd))
+
+
+def scatter_pages_q8(cache: PagedKVQ8, seq_cache: KVCache,
+                     table: jax.Array, page_size: int) -> PagedKVQ8:
+    """scatter_pages' Q8 twin: Q80-quantize the prefilled virtual plane
+    per position and write codes + block deltas back into the pool at the
+    slot's physical pages. UNLIKE the f32 scatter, re-writing a SHARED
+    prefix page is not byte-idempotent (quantize∘dequantize moves codes
+    whose block max shrank), so the engine passes a table whose shared
+    entries are redirected to the scrap page — shared pages keep the
+    bytes their first prefiller wrote, and every reader sees one
+    deterministic encoding. jit with the POOL cache donated."""
+    from ..ops.quants import QK, quantize_q80_jax
+
+    L, _, ps, n_kv, hs = cache.kq.shape
+    nb = n_kv * hs // QK
+    n_pages_tbl = table.shape[0]
+
+    def s(codes_plane, d_plane, seq_plane):
+        qs, d = quantize_q80_jax(seq_plane.reshape(L, -1, n_kv * hs))
+        codes = qs.reshape(L, n_pages_tbl, page_size, n_kv, hs)
+        deltas = d.reshape(L, n_pages_tbl, page_size, nb)
+        return (codes_plane.at[:, table].set(codes),
+                d_plane.at[:, table].set(deltas))
+
+    kq, kd = s(cache.kq, cache.kd, seq_cache.k)
+    vq, vd = s(cache.vq, cache.vd, seq_cache.v)
+    return PagedKVQ8(kq, kd, vq, vd)
 
 
 def init_cache_batch(spec: TransformerSpec, batch: int,

@@ -157,6 +157,33 @@ class EngineMetrics:
         # launches/step, bytes/step)] — empty (and never touched) at tp=1
         self._collectives: list = []
 
+    def bind_kv_pool(self, kv_quant: str, pool_bytes: int,
+                     n_pages: int) -> None:
+        """Register the paged-pool capacity series (ISSUE 11): an info
+        gauge naming the KV page quantization in play
+        (dllama_kv_quant_info{kv_quant=...} = 1 — the Prometheus *_info
+        idiom) plus the pool's GLOBAL logical bytes and per-page bytes,
+        so the equal-HBM capacity claim (q8 pages cost ~1/3.8 of f32)
+        is provable from a scrape. The byte gauges are whole-pool
+        totals across all tp shards (divide by tp for per-device HBM —
+        the kv-head axis shards evenly). Called once by paged engines at
+        construction; contiguous engines never touch it."""
+        self.registry.labeled_gauge(
+            "dllama_kv_quant_info", {"kv_quant": kv_quant},
+            "KV page quantization in effect (value is always 1; the "
+            "label carries the mode)").set(1)
+        self.registry.gauge(
+            "dllama_kv_page_pool_bytes",
+            "Logical bytes of the allocated KV page-pool planes, whole "
+            "pool across all tp shards (all layers, K+V, codes+scales "
+            "for q8, scrap page included; divide by tp for "
+            "per-device)").set(pool_bytes)
+        self.registry.gauge(
+            "dllama_kv_page_bytes",
+            "Logical bytes of ONE physical page across all layers and "
+            "tp shards (pool bytes / physical pages)").set(
+                pool_bytes // max(n_pages, 1))
+
     def set_queue_depth(self, n: int) -> None:
         """Write BOTH queue gauges (legacy + canonical) in one place."""
         self.queued.set(n)

@@ -34,6 +34,29 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = float("-inf")
 
 
+def _flash_walk(n_chunks, start_dma, wait_dma, update, init):
+    """THE double-buffered flash DMA loop, shared by the contiguous kernels
+    here and the paged kernels (ops/pallas_paged_attention.py): start chunk
+    0, then per iteration prefetch chunk i+1 into the other slot while
+    chunk i is reduced into the carry. ``start_dma(slot, i)`` issues the
+    copies for chunk i, ``wait_dma(slot, i)`` blocks on them, and
+    ``update(i, slot, carry)`` folds the landed chunk into the running
+    (m, l, o) state."""
+    start_dma(0, 0)
+
+    def body(i, carry):
+        slot = jax.lax.rem(i, 2)
+
+        @pl.when(i + 1 < n_chunks)
+        def _():
+            start_dma(jax.lax.rem(i + 1, 2), i + 1)
+
+        wait_dma(slot, i)
+        return update(i, slot, carry)
+
+    return jax.lax.fori_loop(0, n_chunks, body, init)
+
+
 def _flash_over_row(row, pos, q, k_hbm, v_hbm, k_buf, v_buf, sems, *,
                     chunk: int, kv_mul: int):
     """Shared flash loop: walk the live chunks of cache row ``row`` (an index
@@ -55,21 +78,17 @@ def _flash_over_row(row, pos, q, k_hbm, v_hbm, k_buf, v_buf, sems, *,
             v_hbm.at[row, pl.ds(i * chunk, chunk)], v_buf.at[slot],
             sems.at[slot, 1])
 
-    k_dma(0, 0).start()
-    v_dma(0, 0).start()
-    scale = 1.0 / jnp.sqrt(jnp.float32(hs))
+    def start_dma(slot, i):
+        k_dma(slot, i).start()
+        v_dma(slot, i).start()
 
-    def body(i, carry):
-        slot = jax.lax.rem(i, 2)
-
-        @pl.when(i + 1 < n_chunks)
-        def _():
-            nxt = jax.lax.rem(i + 1, 2)
-            k_dma(nxt, i + 1).start()
-            v_dma(nxt, i + 1).start()
-
+    def wait_dma(slot, i):
         k_dma(slot, i).wait()
         v_dma(slot, i).wait()
+
+    scale = 1.0 / jnp.sqrt(jnp.float32(hs))
+
+    def update(i, slot, carry):
         k = k_buf[slot]                              # (chunk, n_kv, hs)
         v = v_buf[slot]
 
@@ -96,7 +115,7 @@ def _flash_over_row(row, pos, q, k_hbm, v_hbm, k_buf, v_buf, sems, *,
                   jnp.zeros((1, n_kv), jnp.float32),
                   jnp.zeros((n_kv, hs), jnp.float32))
                  for _ in range(kv_mul))
-    return jax.lax.fori_loop(0, n_chunks, body, init)
+    return _flash_walk(n_chunks, start_dma, wait_dma, update, init)
 
 
 def _kernel(layer_ref, pos_ref, q_ref, k_hbm, v_hbm, out_ref,

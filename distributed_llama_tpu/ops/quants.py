@@ -208,3 +208,17 @@ def dequantize_q80_jax(qs, d):
 
     y = qs.astype(jnp.float32) * d.astype(jnp.float32)[..., None]
     return y.reshape(*qs.shape[:-2], qs.shape[-2] * QK)
+
+
+def dequantize_q80_planes(codes, d):
+    """Q80 decode for PLANE-shaped codes (..., n_kv, hs) with per-block
+    deltas (..., nb = n_kv*hs/QK) — the q8 KV-page layout (ISSUE 11).
+
+    THE one value map every q8 KV read route shares (the paged Pallas
+    kernel's page loop, the XLA gather fallback, and the prefill
+    gather): blocks run over the flattened head-major (n_kv, hs) row, so
+    all routes see identical f32 values and the kernel/fallback parity
+    contract reduces to reduction order alone."""
+    *lead, n_kv, hs = codes.shape
+    y = dequantize_q80_jax(codes.reshape(*lead, n_kv * hs // QK, QK), d)
+    return y.reshape(*lead, n_kv, hs)

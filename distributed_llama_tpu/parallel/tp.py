@@ -109,9 +109,11 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..io.loader import Q40Kernel, Q40Weight
-from ..models.llama import (KVCache, attention_core, batch_decode_attention,
-                            causal_cache_mask, layer_view,
-                            paged_decode_attention, rope_rotate,
+from ..models.llama import (KVCache, PagedKVQ8, attention_core,
+                            batch_decode_attention, causal_cache_mask,
+                            layer_view, paged_attention_q8,
+                            paged_cache_planes, paged_decode_attention,
+                            rebuild_paged_cache, rope_rotate,
                             spec_verify_attention, split_layer_weights)
 from ..models.spec import TransformerSpec
 # canonical trace-scope names (obs/spans.py): every phase and collective
@@ -121,7 +123,8 @@ from ..obs.spans import (SCOPE_ATTN, SCOPE_EMBED, SCOPE_FFN, SCOPE_ICI_GATHER,
                          SCOPE_ICI_PPERMUTE, SCOPE_ICI_PSUM,
                          SCOPE_ICI_SCATTER, SCOPE_LAYER, SCOPE_LOGITS)
 from ..ops.linear import fake_quant_q80, matmul, rmsnorm, silu
-from ..ops.quants import FloatType, dequantize_q80_jax, quantize_q80_jax
+from ..ops.quants import (QK, FloatType, dequantize_q80_jax,
+                          quantize_q80_jax)
 from ..utils.compat import shard_map as _shard_map
 from .comm_stats import tp_scheme
 
@@ -855,16 +858,48 @@ def _batch_sp_attention(spec: TransformerSpec, seq_chunk: int, q, k, v,
 CACHE_SPEC_PAGED = KVCache(P(None, None, None, "tp", None),
                            P(None, None, None, "tp", None))
 
+# Q8 page pool (models/llama.PagedKVQ8): code planes shard the kv-head
+# axis like the f32 pool; delta planes (L, P, ps, nb) shard the BLOCK
+# axis — the flattened (n_kv, hs) row is head-major, so a rank's delta
+# band is exactly its head band's blocks (validate_kv_quant pins the
+# (n_kv/tp * hs) % 32 == 0 granularity this alignment needs).
+CACHE_SPEC_PAGED_Q8 = PagedKVQ8(P(None, None, None, "tp", None),
+                                P(None, None, None, "tp"),
+                                P(None, None, None, "tp", None),
+                                P(None, None, None, "tp"))
 
-def shard_cache_paged(cache: KVCache, mesh: Mesh) -> KVCache:
+
+def shard_cache_paged(cache, mesh: Mesh):
+    spec = (CACHE_SPEC_PAGED_Q8 if isinstance(cache, PagedKVQ8)
+            else CACHE_SPEC_PAGED)
     return jax.tree_util.tree_map(
         lambda a, s: jax.device_put(a, NamedSharding(mesh, s)),
-        cache, CACHE_SPEC_PAGED)
+        cache, spec)
+
+
+def validate_kv_quant(spec: TransformerSpec, n_slices: int,
+                      kv_quant: str) -> None:
+    """Q8 KV pages quantize each position's flattened shard-LOCAL
+    (n_kv/tp, hs) row in 32-value Q80 blocks — blocks must not straddle
+    the shard boundary, or per-shard quantization would disagree with the
+    single-chip encoding. Checked BEFORE any device_put, like
+    validate_sharding."""
+    if kv_quant not in ("f32", "q8"):
+        raise ValueError(f"kv_quant={kv_quant!r}: expected f32|q8")
+    if kv_quant == "q8":
+        kv_loc = (spec.n_kv_heads // n_slices) * spec.head_size
+        if kv_loc % QK:
+            raise ValueError(
+                f"q8 KV pages need the shard-local kv width to divide "
+                f"into {QK}-value Q80 blocks: n_kv_heads/tp * head_size "
+                f"= {spec.n_kv_heads}/{n_slices} * {spec.head_size} = "
+                f"{kv_loc} is not a {QK}-multiple")
 
 
 def make_sharded_forward_batch_paged(spec: TransformerSpec, mesh: Mesh,
                                      page_size: int,
-                                     scheme: str | None = None):
+                                     scheme: str | None = None,
+                                     kv_quant: str = "f32"):
     """Tensor-parallel paged decode step: make_sharded_forward_batch's twin
     over the page-pool cache (models/llama.forward_batch_paged semantics,
     per-shard over the LOCAL kv heads).
@@ -877,6 +912,12 @@ def make_sharded_forward_batch_paged(spec: TransformerSpec, mesh: Mesh,
     ref/fused schedule difference never sees the page table. sp > 1 is
     rejected: pages break the contiguous position strides sequence
     chunking slices by.
+
+    ``kv_quant='q8'`` (ISSUE 11) runs the Q80-quantized page pool
+    (models/llama.PagedKVQ8, kv-head-sharded like the f32 pool with the
+    delta planes on the aligned block bands) — quantize-on-write /
+    dequantize-on-read is per-shard-local and block-aligned, so the
+    sharded encoding is exactly the single-chip encoding sliced.
     """
     n_slices = mesh.shape["tp"]
     n_sp = mesh.shape.get("sp", 1)
@@ -885,30 +926,30 @@ def make_sharded_forward_batch_paged(spec: TransformerSpec, mesh: Mesh,
                          f"(page tables break contiguous sequence chunks)")
     scheme = _effective_scheme(scheme, n_slices)
     validate_sharding(spec, mesh, scheme)
+    validate_kv_quant(spec, n_slices, kv_quant)
     if spec.seq_len % page_size:
         raise ValueError(f"page_size={page_size} must divide "
                          f"seq_len={spec.seq_len}")
-    kv_loc = spec.n_kv_heads // n_slices
     L, hs = spec.n_layers, spec.head_size
     overlap = scheme == "overlap"
+    q8 = kv_quant == "q8"
+    cache_spec = CACHE_SPEC_PAGED_Q8 if q8 else CACHE_SPEC_PAGED
 
     def local_step(params, cache, tokens, pos, table):
         B = tokens.shape[0]
         with jax.named_scope(SCOPE_EMBED):
             x = params["tok_embedding"][tokens].astype(jnp.float32)  # (B, d)
         positions = pos if jnp.ndim(pos) == 1 else jnp.full((B,), pos)
-        n_pages = cache.k.shape[1]
-        # rank-4 (L*P, ps, kv_loc, hs) carry view — forward_batch_paged's
-        # layout rationale, per shard
-        k4 = cache.k.reshape(L * n_pages, page_size, kv_loc, hs)
-        v4 = cache.v.reshape(L * n_pages, page_size, kv_loc, hs)
+        # rank-4 (L*P, ps, kv_loc, hs) carry views — forward_batch_paged's
+        # layout rationale, per shard (the shared plane pack)
+        planes, n_pages = paged_cache_planes(cache)
         stacked, scanned = split_layer_weights(params)
 
         def body(carry, per_layer):
             if overlap:
-                x, k_all, v_all, pending = carry
+                x, *kv, pending = carry
             else:
-                (x, k_all, v_all), pending = carry, None
+                (x, *kv), pending = carry, None
             idx, lw_slice = per_layer
             with jax.named_scope(SCOPE_LAYER):
                 if overlap:
@@ -916,39 +957,43 @@ def make_sharded_forward_batch_paged(spec: TransformerSpec, mesh: Mesh,
                 lw = layer_view(stacked, lw_slice, idx)
                 with jax.named_scope(SCOPE_ATTN):
                     q, k, v = _tp_qkv(spec, n_slices, lw, x, positions)
-                    ao, k_all, v_all = paged_decode_attention(
-                        hs, spec.kv_mul, page_size, n_pages, q, k, v,
-                        k_all, v_all, idx, pos, table)
+                    if q8:
+                        ao, *kv = paged_attention_q8(
+                            hs, spec.kv_mul, page_size, n_pages,
+                            q[:, None], k[:, None], v[:, None], *kv, idx,
+                            pos, table)
+                        ao = ao.reshape(B, -1)
+                    else:
+                        ao, *kv = paged_decode_attention(
+                            hs, spec.kv_mul, page_size, n_pages, q, k, v,
+                            *kv, idx, pos, table)
                 if overlap:
                     x, pending = _tp_tail(spec, x, lw, ao, scheme=scheme,
                                           n_slices=n_slices)
-                    return (x, k_all, v_all, pending), None
+                    return (x, *kv, pending), None
                 x = _tp_tail(spec, x, lw, ao, scheme=scheme)
-            return (x, k_all, v_all), None
+            return (x, *kv), None
 
         idxs = jnp.arange(L, dtype=jnp.int32)
-        init = (x, k4, v4)
+        init = (x, *planes)
         if overlap:
             init += (_deferred_init(spec, B),)
         carry, _ = jax.lax.scan(body, init, (idxs, scanned))
         if overlap:
-            x, k4, v4, pending = carry
+            x, *kv, pending = carry
             with jax.named_scope(SCOPE_FFN):
                 x = x + _wire_unpack(spec, pending)
         else:
-            x, k4, v4 = carry
+            x, *kv = carry
         with jax.named_scope(SCOPE_LOGITS):
             x = rmsnorm(x, params["rms_final"])
             logits = _gather(matmul(params["wcls"], x))
-        n_pages_out = k4.shape[0] // L
-        return logits, KVCache(
-            k4.reshape(L, n_pages_out, page_size, kv_loc, hs),
-            v4.reshape(L, n_pages_out, page_size, kv_loc, hs))
+        return logits, rebuild_paged_cache(tuple(kv), L)
 
     def wrap(params, cache, tokens, pos, table):
-        in_specs = (param_specs(params, scheme), CACHE_SPEC_PAGED, P(), P(),
+        in_specs = (param_specs(params, scheme), cache_spec, P(), P(),
                     P())
-        out_specs = (P(), CACHE_SPEC_PAGED)
+        out_specs = (P(), cache_spec)
         fn = _shard_map(local_step, mesh=mesh, in_specs=in_specs,
                         out_specs=out_specs)
         return fn(params, cache, tokens, pos, table)
@@ -957,7 +1002,8 @@ def make_sharded_forward_batch_paged(spec: TransformerSpec, mesh: Mesh,
 
 
 def make_sharded_verify(spec: TransformerSpec, mesh: Mesh, page_size: int,
-                        scheme: str | None = None):
+                        scheme: str | None = None,
+                        kv_quant: str = "f32"):
     """Tensor-parallel K-query speculative VERIFY step (ISSUE 7):
     make_sharded_forward_batch_paged's sibling scoring each row's current
     token plus K-1 drafts in ONE dispatch (models/llama.
@@ -979,12 +1025,14 @@ def make_sharded_verify(spec: TransformerSpec, mesh: Mesh, page_size: int,
                          f"(page tables break contiguous sequence chunks)")
     scheme = _effective_scheme(scheme, n_slices)
     validate_sharding(spec, mesh, scheme)
+    validate_kv_quant(spec, n_slices, kv_quant)
     if spec.seq_len % page_size:
         raise ValueError(f"page_size={page_size} must divide "
                          f"seq_len={spec.seq_len}")
-    kv_loc = spec.n_kv_heads // n_slices
     L, hs = spec.n_layers, spec.head_size
     overlap = scheme == "overlap"
+    q8 = kv_quant == "q8"
+    cache_spec = CACHE_SPEC_PAGED_Q8 if q8 else CACHE_SPEC_PAGED
 
     def local_step(params, cache, tokens, pos, table):
         B, K = tokens.shape
@@ -994,16 +1042,14 @@ def make_sharded_verify(spec: TransformerSpec, mesh: Mesh, page_size: int,
         pos_b = jnp.broadcast_to(jnp.asarray(pos, jnp.int32), (B,))
         positions = (pos_b[:, None]
                      + jnp.arange(K, dtype=jnp.int32)[None, :]).reshape(-1)
-        n_pages = cache.k.shape[1]
-        k4 = cache.k.reshape(L * n_pages, page_size, kv_loc, hs)
-        v4 = cache.v.reshape(L * n_pages, page_size, kv_loc, hs)
+        planes, n_pages = paged_cache_planes(cache)
         stacked, scanned = split_layer_weights(params)
 
         def body(carry, per_layer):
             if overlap:
-                x, k_all, v_all, pending = carry
+                x, *kv, pending = carry
             else:
-                (x, k_all, v_all), pending = carry, None
+                (x, *kv), pending = carry, None
             idx, lw_slice = per_layer
             with jax.named_scope(SCOPE_LAYER):
                 if overlap:
@@ -1011,43 +1057,42 @@ def make_sharded_verify(spec: TransformerSpec, mesh: Mesh, page_size: int,
                 lw = layer_view(stacked, lw_slice, idx)
                 with jax.named_scope(SCOPE_ATTN):
                     q, k, v = _tp_qkv(spec, n_slices, lw, x, positions)
-                    ao, k_all, v_all = spec_verify_attention(
+                    attend = paged_attention_q8 if q8 \
+                        else spec_verify_attention
+                    ao, *kv = attend(
                         hs, spec.kv_mul, page_size, n_pages,
                         q.reshape(B, K, -1), k.reshape(B, K, -1),
-                        v.reshape(B, K, -1), k_all, v_all, idx, pos_b,
-                        table)
+                        v.reshape(B, K, -1), *kv, idx, pos_b, table)
                 if overlap:
                     x, pending = _tp_tail(spec, x, lw,
                                           ao.reshape(B * K, -1),
                                           scheme=scheme, n_slices=n_slices)
-                    return (x, k_all, v_all, pending), None
+                    return (x, *kv, pending), None
                 x = _tp_tail(spec, x, lw, ao.reshape(B * K, -1),
                              scheme=scheme)
-            return (x, k_all, v_all), None
+            return (x, *kv), None
 
         idxs = jnp.arange(L, dtype=jnp.int32)
-        init = (x, k4, v4)
+        init = (x, *planes)
         if overlap:
             init += (_deferred_init(spec, B * K),)
         carry, _ = jax.lax.scan(body, init, (idxs, scanned))
         if overlap:
-            x, k4, v4, pending = carry
+            x, *kv, pending = carry
             with jax.named_scope(SCOPE_FFN):
                 x = x + _wire_unpack(spec, pending)
         else:
-            x, k4, v4 = carry
+            x, *kv = carry
         with jax.named_scope(SCOPE_LOGITS):
             x = rmsnorm(x, params["rms_final"])
             logits = _gather(matmul(params["wcls"], x))       # (B*K, V)
-        n_pages_out = k4.shape[0] // L
-        return (logits.reshape(B, K, -1), KVCache(
-            k4.reshape(L, n_pages_out, page_size, kv_loc, hs),
-            v4.reshape(L, n_pages_out, page_size, kv_loc, hs)))
+        return (logits.reshape(B, K, -1),
+                rebuild_paged_cache(tuple(kv), L))
 
     def wrap(params, cache, tokens, pos, table):
-        in_specs = (param_specs(params, scheme), CACHE_SPEC_PAGED, P(), P(),
+        in_specs = (param_specs(params, scheme), cache_spec, P(), P(),
                     P())
-        out_specs = (P(), CACHE_SPEC_PAGED)
+        out_specs = (P(), cache_spec)
         fn = _shard_map(local_step, mesh=mesh, in_specs=in_specs,
                         out_specs=out_specs)
         return fn(params, cache, tokens, pos, table)

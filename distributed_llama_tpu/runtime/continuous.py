@@ -98,6 +98,46 @@ def _maybe_bf16(fn, enable: bool, jax_mod, jit: bool = False):
     return jax_mod.jit(fn, donate_argnums=1) if jit else fn
 
 
+_q8_fallback_warned = False
+
+
+def _warn_q8_xla_fallback(spec: TransformerSpec, page_size: int,
+                          n_slices: int) -> None:
+    """One-time loud note when --kv-quant q8 is requested but the paged
+    flash kernel cannot take this layout for the DECODE shape (t_len=1,
+    the per-token hot path), so attention runs the XLA gather fallback
+    (which dequantizes the WHOLE gathered plane per step). Mirrors the
+    explicit prefill-flash degrade warning: the fallback computes the
+    same attention, just slower — a warning, not a raise. Silent on
+    CPU/interpret engines (kernel mode 'xla' is the documented default
+    there, not a degrade). A spec_k window past the kernel's bound only
+    degrades the verify dispatch, not decode — that case stays quiet."""
+    global _q8_fallback_warned
+    if _q8_fallback_warned:
+        return
+    from ..ops.pallas_attention import attn_kernel_mode
+    from ..ops.pallas_paged_attention import would_use_paged_kernel
+
+    kv_loc = spec.n_kv_heads // n_slices
+    if (attn_kernel_mode() != "pallas"
+            or would_use_paged_kernel(page_size, kv_loc, spec.head_size,
+                                      1, itemsize=1, q8=True)):
+        return
+    _q8_fallback_warned = True
+    import sys
+
+    print(f"⚠️  --kv-quant q8 requested but the paged flash-decode Pallas "
+          f"kernel does not apply to this layout (page_size {page_size}, "
+          f"n_kv/tp {kv_loc}, head_size {spec.head_size}); decode "
+          f"attention takes the XLA gather fallback, which dequantizes "
+          f"the whole gathered plane every step — the HBM saving stands "
+          f"but the per-token attention cost does not improve. Use a "
+          f"head_size multiple of 128 and a page size whose K/V planes "
+          f"fit the kernel's VMEM scratch budget "
+          f"(ops/pallas_paged_attention.supports_paged).",
+          file=sys.stderr)
+
+
 @dataclasses.dataclass
 class _Slot:
     req: Request | None = None   # None = free
@@ -169,7 +209,7 @@ class ContinuousEngine:
                  page_size: int = 0, kv_pages: int = 0,
                  prefix_share: bool = True, spec_k: int = 0,
                  spec_ngram: int = 3, slo=None, chaos=None,
-                 journal=None, watchdog=None):
+                 journal=None, watchdog=None, kv_quant: str = "f32"):
         import functools
 
         import jax
@@ -178,8 +218,10 @@ class ContinuousEngine:
         from ..models.llama import (forward_batch_paged,
                                     forward_batch_ragged,
                                     forward_batch_spec_paged, gather_pages,
-                                    init_cache_batch, init_cache_paged,
-                                    params_to_device, scatter_pages)
+                                    gather_pages_q8, init_cache_batch,
+                                    init_cache_paged, init_cache_paged_q8,
+                                    params_to_device, scatter_pages,
+                                    scatter_pages_q8)
 
         self.spec = spec
         self.slots = slots
@@ -206,6 +248,26 @@ class ContinuousEngine:
         if kv_pages and page_size <= 0:
             raise ValueError("kv_pages requires page_size > 0 (pass "
                              "--kv-page-size with --kv-pages)")
+        # KV page quantization (ISSUE 11): 'q8' stores pool pages in the
+        # Q80 int8+scale wire layout (models/llama.PagedKVQ8) — ~1/3.8 of
+        # the f32 page bytes, so the same HBM holds ~3.8x pages. Decode
+        # quantizes on write; attention dequantizes on read (inside the
+        # paged flash kernel's page loop, or in the XLA gather fallback).
+        self.kv_quant = kv_quant
+        if kv_quant not in ("f32", "q8"):
+            raise ValueError(f"kv_quant={kv_quant!r}: expected f32|q8")
+        if kv_quant == "q8" and page_size <= 0:
+            raise ValueError("kv_quant='q8' quantizes PAGE planes; pass "
+                             "page_size > 0 (--kv-page-size with "
+                             "--kv-quant q8)")
+        if kv_quant == "q8":
+            from ..parallel.tp import validate_kv_quant
+
+            validate_kv_quant(spec, (mesh.shape["tp"] if mesh is not None
+                                     else 1), kv_quant)
+            _warn_q8_xla_fallback(spec, page_size,
+                                  mesh.shape["tp"] if mesh is not None
+                                  else 1)
         if page_size > 0:
             from .paging import PagedAllocator
 
@@ -284,11 +346,16 @@ class ContinuousEngine:
             if self._alloc is not None:
                 # +1 physical page: the reserved scrap page 0
                 self._step = make_sharded_forward_batch_paged(
-                    spec, mesh, page_size, scheme=scheme)  # rejects sp>1
+                    spec, mesh, page_size, scheme=scheme,
+                    kv_quant=kv_quant)  # rejects sp>1
                 if spec_k:
                     self._verify_base = make_sharded_verify(
-                        spec, mesh, page_size, scheme=scheme)
+                        spec, mesh, page_size, scheme=scheme,
+                        kv_quant=kv_quant)
                 self.cache = shard_cache_paged(
+                    init_cache_paged_q8(spec, self._alloc.n_pages + 1,
+                                        page_size)
+                    if kv_quant == "q8" else
                     init_cache_paged(spec, self._alloc.n_pages + 1,
                                      page_size, dtype), mesh)
             else:
@@ -307,15 +374,21 @@ class ContinuousEngine:
         else:
             self.params = params_to_device(params)
             if self._alloc is not None:
-                self.cache = init_cache_paged(
-                    spec, self._alloc.n_pages + 1, page_size, dtype)
+                self.cache = (
+                    init_cache_paged_q8(spec, self._alloc.n_pages + 1,
+                                        page_size)
+                    if kv_quant == "q8" else
+                    init_cache_paged(spec, self._alloc.n_pages + 1,
+                                     page_size, dtype))
                 self._step = jax.jit(
-                    functools.partial(forward_batch_paged, spec, page_size),
+                    functools.partial(forward_batch_paged, spec, page_size,
+                                      kv_quant=kv_quant),
                     donate_argnums=1)
                 if spec_k:
                     self._verify_base = jax.jit(
                         functools.partial(forward_batch_spec_paged, spec,
-                                          page_size), donate_argnums=1)
+                                          page_size, kv_quant=kv_quant),
+                        donate_argnums=1)
             else:
                 self.cache = init_cache_batch(spec, slots, dtype)
                 self._step = jax.jit(
@@ -336,11 +409,19 @@ class ContinuousEngine:
                 # paged prefill plumbing: gather the slot's pages into a
                 # virtual contiguous sequence cache (shared prefix k/v
                 # included — suffix chunks must attend over it), prefill
-                # into that, scatter back into the pool in place
+                # into that, scatter back into the pool in place. Q8
+                # pools dequantize on gather and re-quantize on scatter
+                # (the engine redirects SHARED entries of the scatter
+                # table to the scrap page — quantize∘dequantize is not
+                # byte-idempotent, and a shared page must keep the bytes
+                # its first prefiller published).
+                gp = gather_pages_q8 if kv_quant == "q8" else gather_pages
+                sp_ = (scatter_pages_q8 if kv_quant == "q8"
+                       else scatter_pages)
                 self._gather_pages = jax.jit(
-                    lambda c, t: gather_pages(c, t, page_size))
+                    lambda c, t, gp=gp: gp(c, t, page_size))
                 self._scatter_pages = jax.jit(
-                    lambda c, s, t: scatter_pages(c, s, t, page_size),
+                    lambda c, s, t, sp_=sp_: sp_(c, s, t, page_size),
                     donate_argnums=0)
         # write-ahead request journal (runtime/journal.py, ISSUE 9): every
         # submit/sampled-token/retire appends a record; recover() replays
@@ -381,6 +462,14 @@ class ContinuousEngine:
                 # a fresh paged server must scrape as fully free, not as
                 # exhausted (the gauge default 0)
                 self._obs.kv_pages_free.set(self._alloc.n_free)
+                # pool byte accounting (ISSUE 11): the GLOBAL logical
+                # bytes of the allocated page planes (scrap included;
+                # whole pool across tp shards — per-device is /tp) +
+                # the KV-quant info series, so a dashboard can prove the
+                # equal-HBM capacity claim from the scrape alone
+                pool_bytes = sum(int(a.nbytes) for a in self.cache)
+                self._obs.bind_kv_pool(kv_quant, pool_bytes,
+                                       self._alloc.n_pages + 1)
             # the span timeline (GET /debug/timeline) rides the same
             # opt-in: a disabled engine records nothing
             self._spans = SpanTracer()
@@ -1299,6 +1388,18 @@ class ContinuousEngine:
                 tbl = np.full((self._max_pages,), SCRAP_PAGE, np.int32)
                 tbl[:len(s.pages)] = s.pages
                 tbl_dev = jnp.asarray(tbl)
+                if self.kv_quant == "q8":
+                    # q8 scatter must NOT re-quantize shared prefix pages
+                    # (quantize∘dequantize moves bytes; a shared page
+                    # keeps its first publisher's encoding) — their
+                    # scatter entries park on the scrap page. The gather
+                    # above still reads them: suffix chunks attend over
+                    # the dequantized shared prefix.
+                    tbl_sc = tbl.copy()
+                    tbl_sc[:s.shared] = SCRAP_PAGE
+                    tbl_scatter = jnp.asarray(tbl_sc)
+                else:
+                    tbl_scatter = tbl_dev
                 cache_box = [self._gather_pages(self.cache, tbl_dev)]
             else:
                 cache_box = [self._scratch_cache()]
@@ -1312,7 +1413,7 @@ class ContinuousEngine:
                                 self.spec.seq_len)
             if paged:
                 self.cache = self._scatter_pages(self.cache, cache_box[0],
-                                                 tbl_dev)
+                                                 tbl_scatter)
                 # publish the freshly prefilled full prompt pages NOW (not
                 # just at retire): a same-system-prompt request admitted
                 # into the next slot this very round already shares them
@@ -1478,7 +1579,8 @@ def generate_continuous(spec: TransformerSpec, params: dict[str, Any],
                         quiet: bool = False, use_native_sampler: bool = True,
                         fast_prefill: bool = False, metrics=None,
                         page_size: int = 0, kv_pages: int = 0,
-                        spec_k: int = 0, spec_ngram: int = 3):
+                        spec_k: int = 0, spec_ngram: int = 3,
+                        kv_quant: str = "f32"):
     """CLI entry: encode prompts, stream them through a slot pool, print
     rows in the --prompts-file format ("[i] 'text'")."""
     reqs = [tokenizer.encode(p or "", bos=True, eos=False) for p in prompts]
@@ -1490,7 +1592,8 @@ def generate_continuous(spec: TransformerSpec, params: dict[str, Any],
                            use_native_sampler=use_native_sampler,
                            fast_prefill=fast_prefill, metrics=metrics,
                            page_size=page_size, kv_pages=kv_pages,
-                           spec_k=spec_k, spec_ngram=spec_ngram)
+                           spec_k=spec_k, spec_ngram=spec_ngram,
+                           kv_quant=kv_quant)
     outs, stats = eng.run(reqs, steps, quiet=quiet)
     for b, (req, row) in enumerate(zip(reqs, outs)):
         if not quiet:
@@ -1504,7 +1607,8 @@ def generate_continuous(spec: TransformerSpec, params: dict[str, Any],
         if eng.allocator is not None:
             a = eng.allocator
             print(f"Paged KV:            {a.n_pages} pages x "
-                  f"{a.page_size} positions, {a.n_free} free; prefix hit "
+                  f"{a.page_size} positions ({eng.kv_quant}), "
+                  f"{a.n_free} free; prefix hit "
                   f"rate {a.hit_rate:.0%}, {a.tokens_saved} prefill "
                   f"tokens saved, {a.evictions} evictions")
         if eng.spec_k:

@@ -91,7 +91,9 @@ class JournalConfigMismatch(RuntimeError):
 
 
 def config_fingerprint(spec, scheme: str, seed_policy: str,
-                       weights_digest: str | None = None) -> dict:
+                       weights_digest: str | None = None,
+                       kv_quant: str = "f32",
+                       kv_cache_dtype: str = "f32") -> dict:
     """The serving-config fingerprint the WAL header records: everything a
     bitwise replay depends on — model dims, weight/buffer quant types,
     the tp collective scheme (schemes are bitwise-distinct only across
@@ -106,8 +108,16 @@ def config_fingerprint(spec, scheme: str, seed_policy: str,
     default (restarts under the default always pass: REPLAY never reads
     the base seed — admit records carry each request's RESOLVED seed —
     and new-request streams were already restart-variant by
-    construction)."""
-    return {
+    construction).
+
+    ``kv_quant`` / ``kv_cache_dtype`` (ISSUE 11): Q8 KV pages — and a
+    bf16 cache dtype — change every logit past the first position
+    (quantized/narrowed K/V feed attention), so a replay across either
+    KV-dtype change would be deterministic-but-wrong — the fingerprint
+    refuses it. Both keys are recorded only when != 'f32' so pre-PR-11
+    journals (no key) keep recovering under f32 serving, while any
+    f32↔q8 or f32↔bf16 flip mismatches in BOTH directions."""
+    fp = {
         "dim": spec.dim, "hidden_dim": spec.hidden_dim,
         "n_layers": spec.n_layers, "n_heads": spec.n_heads,
         "n_kv_heads": spec.n_kv_heads, "vocab_size": spec.vocab_size,
@@ -117,6 +127,11 @@ def config_fingerprint(spec, scheme: str, seed_policy: str,
         "tp_scheme": scheme, "seed_policy": str(seed_policy),
         "weights_digest": weights_digest,
     }
+    if kv_quant != "f32":
+        fp["kv_quant"] = kv_quant
+    if kv_cache_dtype != "f32":
+        fp["kv_cache_dtype"] = kv_cache_dtype
+    return fp
 
 
 def weight_file_digest(path: str, head_bytes: int = 1 << 20) -> str:

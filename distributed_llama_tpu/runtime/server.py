@@ -83,7 +83,7 @@ class InferenceServer:
                  registry=None, page_size: int = 0, kv_pages: int = 0,
                  spec_k: int = 0, spec_ngram: int = 3, slo=None,
                  chaos=None, journal=None, watchdog_s: float = 0.0,
-                 drain_s: float = 10.0):
+                 drain_s: float = 10.0, kv_quant: str = "f32"):
         self.spec = spec
         self.tokenizer = tokenizer
         self.default_steps = steps
@@ -126,7 +126,8 @@ class InferenceServer:
                                        kv_pages=kv_pages, spec_k=spec_k,
                                        spec_ngram=spec_ngram, slo=slo,
                                        chaos=chaos, journal=journal,
-                                       watchdog=self._watchdog)
+                                       watchdog=self._watchdog,
+                                       kv_quant=kv_quant)
         # replay the previous life's unfinished requests BEFORE the
         # listener opens: recovered work re-queues first, so a restarted
         # server continues exactly where the crash cut it off
@@ -199,6 +200,25 @@ class InferenceServer:
                     "pauses": eng.stats.pauses,
                     "requeues": eng.stats.requeues,
                 }
+                if eng.allocator is not None:
+                    # paged-KV capacity surface (ISSUE 11): pool shape,
+                    # occupancy, the KV quantization in play, and the
+                    # pool planes' GLOBAL logical bytes (whole pool
+                    # across tp shards; per-device is /tp) — the
+                    # /metrics dllama_kv_quant_info / page-pool gauges'
+                    # JSON twin
+                    a = eng.allocator
+                    payload["paged_kv"] = {
+                        "page_size": a.page_size,
+                        "pages": a.n_pages,
+                        "pages_free": a.n_free,
+                        "kv_quant": eng.kv_quant,
+                        "pool_bytes": sum(int(x.nbytes)
+                                          for x in eng.cache),
+                        "prefix_hit_rate": round(a.hit_rate, 4),
+                        "prefill_tokens_saved": a.tokens_saved,
+                        "evictions": a.evictions,
+                    }
                 if server.journal is not None:
                     # recovery bookkeeping: requests replayed from the
                     # journal at startup + append volume since

@@ -58,6 +58,11 @@ SLOW_TESTS = {
     # recovery drills that spawn a fresh jax subprocess (ISSUE 9)
     "test_kill_mid_decode_drill_recovers_bitwise",
     "test_corrupt_journal_turns_kill_drill_red",
+    # the full tp x scheme x kv-quant paged-kernel routing grid (ISSUE 11):
+    # 18 sharded-forward traces; the fast suite keeps the single-chip
+    # routing cases (test_paged_kernel_routing_single_chip) and ci.sh runs
+    # the grid explicitly
+    "test_paged_kernel_routing_tp_scheme_grid",
 }
 
 

@@ -53,7 +53,7 @@ def test_shardcheck_full_matrix_exits_zero(capsys):
     # the acceptance-criteria invocation
     assert main(["--shardcheck"]) == 0
     out = capsys.readouterr().out
-    assert "72 config(s), 0 violating" in out
+    assert "84 config(s), 0 violating" in out
     assert "FAIL" not in out
 
 

@@ -173,6 +173,51 @@ def test_cli_spec_k_requires_page_size_at_argparse_time(model_files,
     assert rc == 2 and "empty" in err and "--kv-page-size" not in err
 
 
+def test_cli_kv_quant_requires_page_size_at_argparse_time(model_files,
+                                                          tmp_path,
+                                                          capsys):
+    """--kv-quant q8 without --kv-page-size fails BEFORE the model load
+    with the one-line actionable error, on BOTH inference and serve
+    (ISSUE 11: q8 quantizes PAGE planes — meaningless without the paged
+    pool), exactly like the --spec-k gate above."""
+    from distributed_llama_tpu.frontend.cli import main
+
+    model, tokp = model_files
+    empty = tmp_path / "prompts.txt"
+    empty.write_text("")
+    # the CLI flag sets DLLAMA_KV_QUANT (the DLLAMA_TP_SCHEME pattern) —
+    # scrub it on exit so later CLI tests see the default
+    try:
+        assert main(["inference", "--model", model, "--tokenizer", tokp,
+                     "--prompts-file", str(empty), "--continuous",
+                     "--kv-quant", "q8"]) == 2
+        assert "--kv-page-size" in capsys.readouterr().err
+        assert main(["serve", "--model", model, "--tokenizer", tokp,
+                     "--kv-quant", "q8"]) == 2
+        assert "--kv-page-size" in capsys.readouterr().err
+        # an unknown quant mode is an argparse error (SystemExit 2)
+        with pytest.raises(SystemExit):
+            main(["serve", "--model", model, "--tokenizer", tokp,
+                  "--kv-quant", "int4"])
+        capsys.readouterr()
+        # the DLLAMA_KV_QUANT env knob alone resolves through the same
+        # gate (flag sets env; env without the flag also works)
+        os.environ["DLLAMA_KV_QUANT"] = "q8"
+        assert main(["serve", "--model", model, "--tokenizer",
+                     tokp]) == 2
+        assert "--kv-page-size" in capsys.readouterr().err
+        # the valid pairing proceeds past the gate and fails later, on
+        # the empty prompts file — proving the gate ran (and passed)
+        # first (env still q8 from above)
+        rc = main(["inference", "--model", model, "--tokenizer", tokp,
+                   "--prompts-file", str(empty), "--continuous",
+                   "--kv-quant", "q8", "--kv-page-size", "4"])
+        err = capsys.readouterr().err
+        assert rc == 2 and "empty" in err and "--kv-page-size" not in err
+    finally:
+        os.environ.pop("DLLAMA_KV_QUANT", None)
+
+
 def test_cli_overlap_scheme_rejects_sp_at_argparse_time(model_files,
                                                         capsys):
     """--tp-scheme overlap with --sp > 1 fails at argparse time: the

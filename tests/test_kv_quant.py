@@ -1,0 +1,307 @@
+"""Q8-quantized KV pages (ISSUE 11): engine streams, prefill/sharing,
+speculative rollback, memory-model pricing, and the serving surfaces.
+
+Quantization genuinely changes logits, so the f32 bitwise gates move to
+DISTRIBUTION-PINNED properties here: greedy q8 streams are deterministic
+and scheduler-invisible (identical across per-step, fused-chain,
+speculative, and prefill drivers, and across tp meshes), pinned stable
+at Q8 vs the f32 streams on the CPU smoke model; pool accounting stays
+leak-free under the same audit oracle as f32 paging.
+"""
+
+import numpy as np
+import pytest
+
+from distributed_llama_tpu.models.spec import TransformerSpec
+from distributed_llama_tpu.models.synth import synth_params
+
+# q8 needs (n_kv/tp * head_size) % 32 == 0: head_size 32, n_kv 2 covers
+# tp in {1, 2}
+SPEC = TransformerSpec(dim=128, hidden_dim=160, n_layers=2, n_heads=4,
+                       n_kv_heads=2, vocab_size=128, seq_len=16)
+REQS = [[1, 5, 9], [1, 5, 7, 11], [1, 3], [1, 5, 9, 2]]
+
+
+@pytest.fixture(scope="module")
+def params():
+    return synth_params(SPEC, q40=False, seed=4, scale=0.3)
+
+
+def _run(params, steps=10, reqs=None, **kw):
+    from distributed_llama_tpu.runtime.continuous import ContinuousEngine
+
+    eng = ContinuousEngine(SPEC, params, slots=kw.pop("slots", 2),
+                           temperature=kw.pop("temperature", 0.0),
+                           topp=0.9, seed=3, page_size=kw.pop("page_size", 4),
+                           kv_quant="q8", **kw)
+    outs, st = eng.run(list(reqs or REQS), steps=steps)
+    assert eng.audit_pages() == [], eng.audit_pages()
+    return eng, outs, st
+
+
+def test_q8_streams_scheduler_invisible_and_pinned_vs_f32(params):
+    """Greedy q8 streams are identical across every scheduler driver
+    (per-step, fused chains, speculative verify, admission prefill,
+    slot-count changes) — scheduling and paging stay invisible — and on
+    the CPU smoke model they are pinned equal to the f32 greedy streams
+    (quantization noise below the greedy argmax margin here; the pin is
+    the distribution-stability gate, not a universal claim)."""
+    from distributed_llama_tpu.runtime.continuous import ContinuousEngine
+
+    _, base, _ = _run(params)
+    for kw in ({"block_steps": 3}, {"spec_k": 3}, {"prefill_chunk": 2},
+               {"slots": 4}):
+        _, outs, _ = _run(params, **kw)
+        assert outs == base, f"q8 stream drifted under {kw}"
+    f32 = ContinuousEngine(SPEC, params, slots=2, temperature=0.0,
+                           topp=0.9, seed=3, page_size=4)
+    f32_outs, _ = f32.run(list(REQS), steps=10)
+    assert base == f32_outs
+
+
+def test_q8_streams_match_over_tp_mesh(params):
+    """tp=2 q8 streams equal the single-chip q8 streams: per-shard
+    quantization blocks are head-band aligned, so the sharded encoding
+    is the single-chip encoding sliced."""
+    import jax
+
+    from distributed_llama_tpu.parallel import make_mesh
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 devices")
+    _, base, _ = _run(params)
+    mesh = make_mesh(tp=2, devices=jax.devices()[:2])
+    _, outs, _ = _run(params, mesh=mesh)
+    assert outs == base
+
+
+def test_q8_sampled_streams_deterministic(params):
+    """Seeded sampled q8 streams replay exactly (the crash-recovery
+    anchor: replay determinism is a function of (prompt, sampler, coin
+    cursor, kv_quant) — the fingerprint pins the last one)."""
+    _, a, _ = _run(params, temperature=0.8)
+    _, b, _ = _run(params, temperature=0.8)
+    assert a == b
+
+
+def test_q8_prefix_sharing_and_prefill_share_pages(params):
+    """A shared page-aligned system prompt hits the radix tree under q8
+    exactly like f32 (the tree shares PAGES; their encoding is
+    quantized but position-deterministic), and admission prefill's
+    scatter does not disturb shared pages (they are scrap-redirected,
+    so the first publisher's bytes survive)."""
+    ps = 4
+    sys_prefix = [1] + [7 + (i % 9) for i in range(2 * ps)]
+    reqs = [sys_prefix + [3 + i, 5 + i] for i in range(4)]
+    eng, outs, _ = _run(params, steps=14, reqs=reqs, page_size=ps,
+                        prefill_chunk=ps, slots=2)
+    a = eng.allocator
+    assert a.prefix_hits >= 1
+    assert a.tokens_saved >= 2 * ps
+    # all rows share the prefix: identical prompts -> identical prefixes
+    # of output (forced echo), and the engine replays deterministically
+    eng2, outs2, _ = _run(params, steps=14, reqs=reqs, page_size=ps,
+                          prefill_chunk=ps, slots=2)
+    assert outs == outs2
+
+
+def test_q8_speculative_rollback_returns_pages(params):
+    """Speculative q8: rejected-draft pages return to the pool (the
+    audit oracle runs inside _run) and the stream equals the spec-off
+    q8 stream — losslessness holds relative to the q8 engine."""
+    _, base, _ = _run(params, steps=12)
+    _, outs, st = _run(params, steps=12, spec_k=4)
+    assert outs == base
+    assert st.steps <= 12 * len(REQS)  # verify dispatches, not per-token
+
+
+def test_q8_requires_page_size(params):
+    from distributed_llama_tpu.runtime.continuous import ContinuousEngine
+
+    with pytest.raises(ValueError, match="kv-page-size|page_size"):
+        ContinuousEngine(SPEC, params, slots=2, temperature=0.0, topp=0.9,
+                         seed=3, kv_quant="q8")
+    with pytest.raises(ValueError, match="f32|q8"):
+        ContinuousEngine(SPEC, params, slots=2, temperature=0.0, topp=0.9,
+                         seed=3, page_size=4, kv_quant="int4")
+
+
+def test_q8_rejects_unaligned_kv_width(params):
+    """(n_kv/tp * head_size) % 32 != 0 must refuse at construction with
+    the block-granularity constraint named (tp factories and the
+    single-chip init share the rule)."""
+    from distributed_llama_tpu.models.llama import init_cache_paged_q8
+    from distributed_llama_tpu.parallel.tp import validate_kv_quant
+
+    bad = TransformerSpec(dim=64, hidden_dim=160, n_layers=2, n_heads=4,
+                          n_kv_heads=2, vocab_size=128, seq_len=16)
+    assert bad.head_size * bad.n_kv_heads == 32  # tp=1 fine...
+    validate_kv_quant(bad, 1, "q8")
+    with pytest.raises(ValueError, match="Q80 block"):
+        validate_kv_quant(bad, 2, "q8")          # ...tp=2 straddles
+    with pytest.raises(ValueError, match=r"\b32\b"):
+        init_cache_paged_q8(
+            TransformerSpec(dim=48, hidden_dim=96, n_layers=1, n_heads=4,
+                            n_kv_heads=1, vocab_size=32, seq_len=8), 4, 4)
+
+
+def test_q8_fallback_warning_fires_once(params, monkeypatch, capsys):
+    """--kv-quant q8 with a layout the paged kernel cannot take under an
+    ACTIVE pallas mode warns loudly on stderr, once per process (mirrors
+    the prefill-flash degrade warning); the default CPU 'xla' mode stays
+    silent."""
+    from distributed_llama_tpu.runtime import continuous as cont
+
+    # head_size 32 is sub-lane: the kernel never applies to SPEC
+    monkeypatch.setattr(cont, "_q8_fallback_warned", False)
+    monkeypatch.delenv("DLLAMA_ATTN_KERNEL", raising=False)
+    _run(params, steps=2, reqs=[[1, 3]])
+    assert "kv-quant" not in capsys.readouterr().err  # xla mode: silent
+    monkeypatch.setenv("DLLAMA_ATTN_KERNEL", "pallas")
+    _run(params, steps=2, reqs=[[1, 3]])
+    err = capsys.readouterr().err
+    assert "--kv-quant q8" in err and "XLA gather fallback" in err
+    _run(params, steps=2, reqs=[[1, 3]])
+    assert "--kv-quant q8" not in capsys.readouterr().err  # once only
+
+
+def test_q8_metrics_and_pool_gauges(params):
+    """The serving surfaces: dllama_kv_quant_info{kv_quant="q8"} = 1 and
+    the page-pool byte gauges match the actual device planes."""
+    from distributed_llama_tpu.obs.metrics import Registry
+
+    reg = Registry()
+    eng, _, _ = _run(params, metrics=reg)
+    text = reg.expose()
+    assert 'dllama_kv_quant_info{kv_quant="q8"} 1' in text
+    pool = reg.get("dllama_kv_page_pool_bytes")
+    assert pool is not None
+    assert pool.value == sum(int(a.nbytes) for a in eng.cache)
+    assert reg.get("dllama_kv_page_bytes").value > 0
+
+
+def test_q8_cache_halves_page_bytes(params):
+    """The capacity claim, measured on the actual device buffers: the q8
+    pool's bytes are under half the f32 pool's at the same page count
+    (exactly (1 + 2/32) / 4 ≈ 0.266x)."""
+    from distributed_llama_tpu.models.llama import (init_cache_paged,
+                                                    init_cache_paged_q8)
+
+    f32 = init_cache_paged(SPEC, 9, 4)
+    q8 = init_cache_paged_q8(SPEC, 9, 4)
+    b_f32 = sum(int(a.nbytes) for a in f32)
+    b_q8 = sum(int(a.nbytes) for a in q8)
+    assert b_q8 * 2 < b_f32
+    kv_dim = SPEC.n_kv_heads * SPEC.head_size
+    assert b_q8 == b_f32 // 4 // kv_dim * (kv_dim + 2 * (kv_dim // 32))
+
+
+# ---------------------------------------------------------------- pricing
+
+
+def test_memory_model_q8_pricing_and_equal_hbm_pages():
+    from distributed_llama_tpu.analysis.memory_model import (
+        equal_hbm_kv_pages, kv_page_pool_bytes, kv_position_bytes)
+    from distributed_llama_tpu.models.synth import llama2_7b_spec
+
+    spec = llama2_7b_spec()
+    kv_dim = spec.n_kv_heads * spec.head_size
+    per_f32 = kv_position_bytes(spec, 1)
+    per_q8 = kv_position_bytes(spec, 1, kv_quant="q8")
+    assert per_f32 == 2 * spec.n_layers * kv_dim * 4
+    assert per_q8 == 2 * spec.n_layers * (kv_dim + 2 * (kv_dim // 32))
+    # pool formula = pages x page_size x position bytes (+ scrap)
+    assert (kv_page_pool_bytes(spec, 1, 100, 16, include_scrap=False,
+                               kv_quant="q8")
+            == 100 * 16 * per_q8)
+    # the equal-HBM multiplier: 32*4/34 = 3.76x, comfortably over the 2x
+    # acceptance floor
+    pages = equal_hbm_kv_pages(spec, 1, 1000, 16)
+    assert 2 * 1000 <= pages == (1000 * 16 * per_f32) // (16 * per_q8)
+    with pytest.raises(ValueError):
+        kv_position_bytes(spec, 1, kv_quant="int4")
+
+
+def test_device_footprint_q8_term():
+    from distributed_llama_tpu.analysis.memory_model import device_footprint
+    from distributed_llama_tpu.models.synth import llama2_7b_spec
+
+    spec = llama2_7b_spec()
+    f32 = device_footprint(spec, 1, "fused", kv_page_size=16)
+    q8 = device_footprint(spec, 1, "fused", kv_page_size=16,
+                          kv_quant="q8")
+    assert q8.kv_cache_bytes * 2 < f32.kv_cache_bytes
+    assert q8.weights_bytes == f32.weights_bytes
+    with pytest.raises(ValueError, match="kv_page_size"):
+        device_footprint(spec, 1, "fused", kv_quant="q8")
+
+
+def test_shardcheck_q8_column_clean_and_catches_stale_verdict():
+    """The support matrix's KV-quant column: the declared q8 rows verify
+    clean, and a stale q8 verdict (declared not-to-fit but fits) fails
+    with the HBM-BUDGET finding — exactly the PR 4 stale-matrix
+    contract. An unknown kv_quant value is refused as KV-QUANT."""
+    import jax
+
+    from distributed_llama_tpu.analysis.shardcheck import (MatrixEntry,
+                                                           check_config)
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs an 8-device virtual mesh (tests/conftest.py "
+                    "forces it unless XLA_FLAGS overrides)")
+    ok = check_config(MatrixEntry("7b", 8, "fused", "q40", True,
+                                  kv_quant="q8"))
+    assert ok.ok, [f.render() for f in ok.findings]
+    stale = check_config(MatrixEntry("7b", 8, "fused", "q40", False,
+                                     kv_quant="q8"))
+    assert any(f.rule == "HBM-BUDGET" for f in stale.findings)
+    unknown = check_config(MatrixEntry("7b", 8, "fused", "q40", True,
+                                       kv_quant="int4"))
+    assert any(f.rule == "KV-QUANT" for f in unknown.findings)
+
+
+def test_journal_fingerprint_refuses_kv_quant_change(params, tmp_path):
+    """The recovery guard (satellite 1): a journal with LIVE work written
+    under f32 KV must refuse recovery under q8 serving (and vice versa)
+    with the drifted key named — a q8 replay of f32-journaled coins
+    would be deterministic-but-wrong. Pre-PR-11 journals (no kv_quant
+    key) keep recovering under f32."""
+    from distributed_llama_tpu.runtime.continuous import (ContinuousEngine,
+                                                          Request)
+    from distributed_llama_tpu.runtime.journal import (
+        JournalConfigMismatch, RequestJournal, config_fingerprint)
+
+    def fp(kv_quant):
+        return config_fingerprint(SPEC, "single", "explicit:11",
+                                  weights_digest="abcd", kv_quant=kv_quant)
+
+    assert "kv_quant" not in fp("f32")   # pre-PR-11 journals stay valid
+    assert fp("q8")["kv_quant"] == "q8"
+    # the cache-dtype sibling key: a bf16 cache flip refuses too, with
+    # the same omitted-at-f32 legacy compatibility
+    bf16 = config_fingerprint(SPEC, "single", "explicit:11",
+                              weights_digest="abcd",
+                              kv_cache_dtype="bf16")
+    assert bf16["kv_cache_dtype"] == "bf16"
+    assert "kv_cache_dtype" not in fp("f32")
+
+    path = str(tmp_path / "j")
+    j = RequestJournal(path, config=fp("f32"))
+    eng = ContinuousEngine(SPEC, params, slots=2, temperature=0.0,
+                           topp=0.9, seed=3, page_size=4, journal=j)
+    eng.submit(Request(tokens=[1, 5, 9], steps=8))
+    eng.step_many(1, quiet=True)         # live work in the journal
+
+    j2 = RequestJournal(path, config=fp("q8"))
+    eng2 = ContinuousEngine(SPEC, params, slots=2, temperature=0.0,
+                            topp=0.9, seed=3, page_size=4, kv_quant="q8",
+                            journal=j2)
+    with pytest.raises(JournalConfigMismatch, match="kv_quant"):
+        eng2.recover()
+    # same config recovers fine
+    j3 = RequestJournal(path, config=fp("f32"))
+    eng3 = ContinuousEngine(SPEC, params, slots=2, temperature=0.0,
+                            topp=0.9, seed=3, page_size=4, journal=j3)
+    assert eng3.recover() == 1
+    while eng3.step_many(1, quiet=True):
+        pass

@@ -276,6 +276,34 @@ def test_recover_refuses_mismatched_config(params, tmp_path):
         eng3.recover()
 
 
+def test_recover_refuses_kv_quant_change(params, tmp_path):
+    """The ISSUE 11 fingerprint key: a KV-dtype change (f32 journal under
+    q8 serving, or the reverse) flips every logit past position 0, so
+    recovery refuses with ``kv_quant`` named. The key is omitted at f32,
+    so pre-PR-11 journals keep recovering under f32 serving (the legacy
+    compatibility contract); the full engine-level drill — live q8
+    engine included — runs in tests/test_kv_quant.py."""
+    from distributed_llama_tpu.runtime.journal import JournalConfigMismatch
+
+    assert "kv_quant" not in _fingerprint()  # f32 = legacy-compatible
+    path = str(tmp_path / "j")
+    j = RequestJournal(path, config=_fingerprint())
+    eng = _make(params, journal=j)
+    eng.submit(_reqs()[0])
+    eng.step_many(1, quiet=True)
+    # restart with q8 KV pages: same dims, same seed policy, different
+    # cache numerics — refuse, naming the key
+    from distributed_llama_tpu.runtime.journal import config_fingerprint
+
+    q8_cfg = config_fingerprint(SPEC, "single", "explicit:11",
+                                weights_digest="abcd1234deadbeef",
+                                kv_quant="q8")
+    j2 = RequestJournal(path, config=q8_cfg)
+    eng2 = _make(params, journal=j2)
+    with pytest.raises(JournalConfigMismatch, match="kv_quant"):
+        eng2.recover()
+
+
 def test_recover_adopts_config_when_nothing_live(params, tmp_path):
     """A config change over a journal with NOTHING incomplete has nothing
     to corrupt: recover() adopts the serving config (header re-stamped)

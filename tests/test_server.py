@@ -171,6 +171,39 @@ def test_server_stream_disconnect_frees_slot(server):
     assert h["active"] == 0 and h["queued"] == 0, h
 
 
+def test_server_health_paged_kv_block_and_q8(params):
+    """/health on a q8-paged server exposes the paged_kv capacity block
+    (ISSUE 11) and /metrics carries the kv-quant info + pool-byte
+    gauges; generation works end to end over the quantized pool."""
+    import urllib.request
+
+    from distributed_llama_tpu.runtime.server import InferenceServer
+
+    srv = InferenceServer(SPEC, params, _IdTokenizer(), "127.0.0.1", 0,
+                          slots=2, steps=8, temperature=0.0, topp=0.9,
+                          seed=5, quiet=True, page_size=4, kv_pages=24,
+                          kv_quant="q8")
+    srv.start()
+    try:
+        out = _post(srv.port, {"prompt": "hello", "steps": 4})
+        assert out["tokens"]
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/health", timeout=30) as r:
+            h = json.loads(r.read())
+        pk = h["paged_kv"]
+        assert pk["kv_quant"] == "q8"
+        assert pk["page_size"] == 4 and pk["pages"] == 24
+        assert 0 < pk["pages_free"] <= 24
+        assert pk["pool_bytes"] > 0
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/metrics", timeout=30) as r:
+            text = r.read().decode()
+        assert 'dllama_kv_quant_info{kv_quant="q8"} 1' in text
+        assert "dllama_kv_page_pool_bytes" in text
+    finally:
+        srv.stop()
+
+
 def test_server_scheduler_failure_returns_500(params):
     """A device-step exception must fail pending requests with a 500, not
     leave clients blocked forever on done.wait()."""

@@ -35,7 +35,8 @@ def matrix_results():
 
 
 def test_full_support_matrix_is_clean(matrix_results):
-    assert len(matrix_results) == len(sc.SUPPORT_MATRIX) == 72
+    # 72 f32 configs + the 12-entry q8 KV-quant column (ISSUE 11)
+    assert len(matrix_results) == len(sc.SUPPORT_MATRIX) == 84
     bad = [f.render() for r in matrix_results for f in r.findings]
     assert not bad, "\n".join(bad)
 
@@ -47,6 +48,9 @@ def test_matrix_covers_the_declared_grid():
             for s in ("ref", "fused", "overlap"):
                 for w in ("q40", "f16"):
                     assert f"{m}-tp{tp}-{s}-{w}" in labels
+            # the q8 KV-quant column rides the serving codec (q40) under
+            # the fused scheme across the whole tp grid
+            assert f"{m}-tp{tp}-fused-q40-q8" in labels
 
 
 # -- closed-form hand calculations (independent arithmetic) -----------------
@@ -279,7 +283,8 @@ def test_projection_carries_hbm_verdict():
 
 def test_report_json_is_machine_readable(matrix_results):
     rep = sc.report_json(matrix_results)
-    assert rep["n_configs"] == 72 and rep["n_violations"] == 0
+    assert rep["n_configs"] == 84 and rep["n_violations"] == 0
+    assert sum(r["kv_quant"] == "q8" for r in rep["configs"]) == 12
     row = rep["configs"][0]
     assert set(row) >= {"config", "ok", "findings", "report"}
     comp = row["report"]["components_gib"]

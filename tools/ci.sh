@@ -12,9 +12,9 @@
 # contract head verifies the program-structure contracts (J001 for ALL
 # THREE tp collective schemes, ref/fused/overlap; a collective added to
 # the tp forward without its comm_stats term fails here), and the
-# shardcheck head proves every (model, tp, scheme, dtype) config of the
-# 72-config support matrix shards as declared and fits per-device HBM
-# (J004/J005/J006 + budget). (The same
+# shardcheck head proves every (model, tp, scheme, dtype, kv-quant)
+# config of the 84-config support matrix shards as declared and fits
+# per-device HBM (J004/J005/J006 + budget + KV-PAGED/KV-QUANT). (The same
 # contracts also run inside the suite, tests/test_jaxpr_contracts.py and
 # tests/test_shardcheck_repo.py; tools/ probe scripts are outside the lint
 # surface by design.)
@@ -28,9 +28,10 @@
 set -eu
 cd "$(dirname "$0")/.."
 # --all = dlint + jaxpr contracts (J002 now runs per cache LAYOUT:
-# contiguous + paged donation both pinned) + the full 48-config shardcheck
+# contiguous + paged donation both pinned) + the full 84-config shardcheck
 # matrix re-run (which also pins the paged-pool footprint formula to the
-# contiguous stripe at equal capacity — the KV-PAGED check)
+# contiguous stripe at equal capacity — the KV-PAGED check — and the q8
+# KV-quant column's byte formula + 2x capacity floor — KV-QUANT)
 python -m distributed_llama_tpu.analysis --all
 # paged-vs-contiguous equivalence gate (ISSUE 6): paged decode must stay
 # BITWISE equal to the contiguous cache and stream-invisible in the
@@ -38,6 +39,34 @@ python -m distributed_llama_tpu.analysis --all
 # fast here before the full suite (the same tests also run in tier-1)
 python -m pytest tests/test_paging.py -q -p no:cacheprovider \
     -k "bitwise or streams_match or shared_system_prompt"
+# paged flash-decode kernel gate (ISSUE 11): the Pallas page-table walk
+# must agree with the XLA gather path at the documented flash tolerance
+# on both hot shapes (decode + K-query verify), be BITWISE invariant to
+# physical page placement, and the q8 page path must match its own XLA
+# dequant fallback; the q8 engine streams must be deterministic across
+# every scheduler and pinned stable on the CPU smoke model. The full
+# tp x scheme x kv-quant routing grid is slow-marked (the fast suite
+# keeps the single-chip routing cases) — include it here
+python -m pytest tests/test_pallas_paged_attention.py -q \
+    -p no:cacheprovider -m "slow or not slow"
+# ... and the shardcheck KV-quant column must still CATCH a stale q8
+# verdict: a matrix declaring a q8 config NOT to fit that fits must exit
+# 1 EXACTLY (the PR 4 stale-matrix contract; 2 is a usage error and
+# would pass a naive non-zero check vacuously)
+mkdir -p tools/ci_artifacts
+python -c "import json; json.dump([{'model': '7b', 'tp': 8, 'scheme': \
+'fused', 'wtype': 'q40', 'expect_fits': False, 'kv_quant': 'q8'}], \
+open('tools/ci_artifacts/stale_q8_matrix.json', 'w'))"
+set +e
+python tools/shardcheck.py --matrix tools/ci_artifacts/stale_q8_matrix.json \
+    > /dev/null 2>&1
+kvquant_rc=$?
+set -e
+if [ "$kvquant_rc" -ne 1 ]; then
+    echo "ci: shardcheck did not flag the stale q8 matrix verdict" \
+         "(exit $kvquant_rc, expected 1)" >&2
+    exit 1
+fi
 # speculative losslessness gate (ISSUE 7): greedy spec-on token streams
 # must be BITWISE the spec-off streams (across codecs, both tp schemes,
 # paged cache) and rejected-suffix pages must return to the pool. The

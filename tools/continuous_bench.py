@@ -23,6 +23,12 @@ tokens per dispatch where spec-off emits one. Columns: accept rate and
 ms/accepted-token, with the greedy streams asserted token-identical
 (losslessness is not a tolerance).
 
+A KV-quant comparison section (ISSUE 11, on by default) runs the paged
+workload twice at EQUAL modeled KV HBM: f32 pages vs Q8 pages holding
+~3.76x the page count (memory_model.equal_hbm_kv_pages), with
+sustained-concurrency and tokens/s columns in the fingerprinted row —
+the capacity half of the paged-kernel + quantized-pages PR.
+
 The final stdout line is a JSON row stamped with utils/fingerprint.
 env_fingerprint (jax/jaxlib/device-kind/clock — the same drift defense as
 bench.py rows), so BENCH_* archives stay joinable across sessions.
@@ -118,6 +124,88 @@ def paged_compare(spec, params, args, dtype) -> dict:
     return row
 
 
+def kv_quant_compare(spec, params, args, dtype) -> dict:
+    """The equal-HBM q8-vs-f32 section (ISSUE 11): both arms run the paged
+    engine over the SAME shared-system-prompt workload, but the q8 arm's
+    pool holds the pages the f32 arm's KV HBM buys at the Q80 byte rate
+    (memory_model.equal_hbm_kv_pages — ~3.76x pages at f32 baseline) and
+    scales its slot count by the same multiplier. Columns: sustained
+    concurrency + tokens/s per arm — the two wins of this PR compound on
+    this row: the paged kernel makes each token cheaper (on TPU), the q8
+    pool admits more concurrent sessions at equal HBM. Greedy q8 streams
+    are asserted DETERMINISTIC (pass-identical); q8-vs-f32 equality is a
+    distribution-tolerance property, not a bitwise one, and is pinned by
+    the engine tests on the CPU smoke model instead."""
+    from distributed_llama_tpu.analysis.memory_model import (
+        equal_hbm_kv_pages, kv_page_pool_bytes)
+    from distributed_llama_tpu.runtime.continuous import ContinuousEngine
+
+    ps = args.page_size
+    max_pages = spec.seq_len // ps
+    pool_f32 = args.slots * max_pages
+    # price the baseline arm at its ACTUAL page byte rate (bf16 pages
+    # halve it), so "equal HBM" means the bytes this run's pool holds
+    base_itemsize = 2 if args.kv_cache_dtype == "bf16" else 4
+    pool_q8 = equal_hbm_kv_pages(spec, 1, pool_f32, ps,
+                                 cache_itemsize=base_itemsize)
+    factor = pool_q8 / pool_f32
+    slots_f32 = args.slots * args.oversub
+    slots_q8 = min(max(slots_f32, int(args.slots * args.oversub * factor)),
+                   max(args.requests, 1))
+    reqs = _shared_prompt_requests(ps, args.requests)
+
+    def run(label, slots, pool, kv_quant):
+        eng = ContinuousEngine(spec, params, slots=slots, temperature=0.0,
+                               topp=0.9, seed=3, cache_dtype=dtype,
+                               block_steps=args.block_steps,
+                               prefill_chunk=ps, page_size=ps,
+                               kv_pages=pool, kv_quant=kv_quant)
+        eng.run(reqs, steps=args.steps)       # warm-up (compile)
+        t0 = time.perf_counter()
+        outs, st = eng.run(reqs, steps=args.steps)
+        dt = time.perf_counter() - t0
+        outs2, _ = eng.run(reqs, steps=args.steps)
+        assert outs2 == outs, f"{label}: non-deterministic streams?!"
+        print(f"{label}: {st.tokens} tokens {dt:.2f}s "
+              f"{st.tokens / dt:.1f} tok/s, sustained concurrency "
+              f"{st.avg_active:.2f} (max {st.max_active})", file=sys.stderr)
+        return outs, st, dt
+
+    _, st_f, dt_f = run(
+        f"kv {args.kv_cache_dtype} slots={slots_f32} pool={pool_f32}x{ps}",
+        slots_f32, pool_f32, "f32")
+    _, st_q, dt_q = run(f"kv q8  slots={slots_q8} pool={pool_q8}x{ps}",
+                        slots_q8, pool_q8, "q8")
+    hbm_f32 = kv_page_pool_bytes(spec, 1, pool_f32, ps,
+                                 include_scrap=False,
+                                 cache_itemsize=base_itemsize)
+    hbm_q8 = kv_page_pool_bytes(spec, 1, pool_q8, ps,
+                                include_scrap=False, kv_quant="q8")
+    assert hbm_q8 <= hbm_f32, "equal-HBM sizing drifted (q8 over budget)"
+    row = {
+        "page_size": ps, "baseline_kv_dtype": args.kv_cache_dtype,
+        "kv_hbm_bytes_baseline": hbm_f32, "kv_hbm_bytes_q8": hbm_q8,
+        "pages_baseline": pool_f32, "pages_q8": pool_q8,
+        "page_multiplier": round(factor, 3),
+        "baseline": {"slots": slots_f32, "tok_s": st_f.tokens / dt_f,
+                     "sustained_concurrency": st_f.avg_active,
+                     "steps": st_f.steps},
+        "q8": {"slots": slots_q8, "tok_s": st_q.tokens / dt_q,
+               "sustained_concurrency": st_q.avg_active,
+               "steps": st_q.steps},
+        "concurrency_ratio": st_q.avg_active / max(st_f.avg_active, 1e-9),
+        "streams_deterministic": True,
+    }
+    print(f"equal-HBM KV quant ({hbm_f32 / 2**20:.0f} MiB "
+          f"{args.kv_cache_dtype} budget): "
+          f"{pool_f32} -> {pool_q8} pages ({factor:.2f}x), concurrency "
+          f"{st_f.avg_active:.2f} -> {st_q.avg_active:.2f} "
+          f"({row['concurrency_ratio']:.2f}x), "
+          f"{st_f.tokens / dt_f:.1f} -> {st_q.tokens / dt_q:.1f} tok/s",
+          file=sys.stderr)
+    return row
+
+
 def spec_compare(spec, params, args, dtype) -> dict:
     """The spec-on vs spec-off section at equal HBM; returns the JSON
     sub-row. Both arms run the paged cache with the SAME pool (identical
@@ -198,6 +286,13 @@ def main():
                     help="run the spec-on vs spec-off section (equal HBM, "
                          "one dispatch per iteration, streams asserted "
                          "identical)")
+    ap.add_argument("--kv-quant-compare",
+                    action=argparse.BooleanOptionalAction, default=True,
+                    help="run the equal-HBM q8-vs-f32 KV-quant section "
+                         "(ISSUE 11): the q8 arm serves the page count "
+                         "the f32 arm's KV HBM buys at the Q80 byte "
+                         "rate — sustained-concurrency and tokens/s "
+                         "columns, greedy streams asserted deterministic")
     ap.add_argument("--profile", default=None, metavar="DIR",
                     help="trace the timed pass and print the per-step "
                          "op-time split by kernel family (the VERDICT r3 "
@@ -282,6 +377,9 @@ def main():
         row["paged_equal_hbm"] = paged_compare(spec, params, args, dtype)
     if args.spec_compare:
         row["speculative"] = spec_compare(spec, params, args, dtype)
+    if args.kv_quant_compare:
+        row["kv_quant_equal_hbm"] = kv_quant_compare(spec, params, args,
+                                                     dtype)
 
     if args.profile:
         from distributed_llama_tpu.utils.it_split import bucket_ops
