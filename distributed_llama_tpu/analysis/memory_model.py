@@ -176,6 +176,64 @@ def kv_page_pool_bytes(spec: TransformerSpec, n_slices: int, n_pages: int,
                                                  cache_itemsize, kv_quant)
 
 
+# -- KV tier hierarchy (ISSUE 12) -------------------------------------------
+
+# Modeled transfer rates for the tier hierarchy's promotion/demotion
+# paths. Host<->device rides PCIe (a v5e host link — the TPU's non-ICI
+# attach point); disk is a modest NVMe read stream. Like the ICI numbers
+# in shard_sim these are MODELED planning constants, not measurements —
+# PARITY.md carries the honest-N/A measured column.
+HOST_DEVICE_GBPS = 16.0
+DISK_READ_GBPS = 1.5
+# per-page fixed cost of a promotion apply (dispatch + descriptor work)
+TIER_PROMOTE_LATENCY_US = 30.0
+
+
+def kv_page_bytes(spec: TransformerSpec, n_slices: int,
+                  page_size: int = DEFAULT_PAGE_SIZE,
+                  cache_itemsize: int = 4, kv_quant: str = "f32") -> int:
+    """Bytes of ONE physical page's planes on one device (all layers,
+    K+V, codes+deltas for q8) — the unit every tier transfer moves."""
+    return page_size * kv_position_bytes(spec, n_slices, cache_itemsize,
+                                         kv_quant)
+
+
+def kv_tier_model(spec: TransformerSpec, n_slices: int,
+                  hbm_pages: int, host_pages: int = 0,
+                  disk_bytes: int = 0,
+                  page_size: int = DEFAULT_PAGE_SIZE,
+                  cache_itemsize: int = 4,
+                  kv_quant: str = "f32") -> dict:
+    """Per-tier capacity + bandwidth model of the KV hierarchy: bytes
+    held per tier, pages the budgets buy, and the modeled per-page
+    promotion/demotion cost — the numbers that justify spilling instead
+    of recomputing. The comparison that matters: promoting one page
+    costs ~page_bytes/PCIe-bw, while re-PREFILLING its page_size
+    positions costs a full forward pass over them — at 7B shapes the
+    upload is microseconds against milliseconds of recompute, priced
+    per kv_quant (q8 pages move ~3.76x cheaper than f32). Budgets are
+    per-device for HBM (kv heads shard over tp) and per-HOST for the
+    host/disk tiers (one host feeds its local devices)."""
+    pb = kv_page_bytes(spec, n_slices, page_size, cache_itemsize, kv_quant)
+    host_ms = pb / (HOST_DEVICE_GBPS * GIB) * 1e3
+    disk_ms = pb / (DISK_READ_GBPS * GIB) * 1e3
+    lat_ms = TIER_PROMOTE_LATENCY_US / 1e3
+    return {
+        "page_size": page_size,
+        "kv_quant": kv_quant,
+        "page_bytes": pb,
+        "hbm": {"pages": hbm_pages, "bytes": hbm_pages * pb},
+        "host": {"pages": host_pages, "bytes": host_pages * pb},
+        "disk": {"bytes": disk_bytes,
+                 "pages": (disk_bytes // pb) if disk_bytes else 0},
+        # promotion = upload (+ disk read below host); demotion mirrors
+        # the upload cost (device->host readback at the same link rate)
+        "promote_host_ms_per_page": round(host_ms + lat_ms, 6),
+        "promote_disk_ms_per_page": round(host_ms + disk_ms + lat_ms, 6),
+        "demote_ms_per_page": round(host_ms + lat_ms, 6),
+    }
+
+
 def activation_bytes_analytic(spec: TransformerSpec, n_slices: int,
                               t_len: int = 1) -> int:
     """No-trace activation bound for projection columns: the residual
@@ -339,12 +397,16 @@ class MemoryReport:
     activation_bytes: int
     collective_bytes: int
     budget_bytes: int
+    # KV-tiering promotion staging (ISSUE 12): the double-buffered page
+    # upload target (2 pages of planes) a tiered engine keeps device-side.
+    # 0 (the default) for untiered configs — pinned totals unchanged.
+    tier_staging_bytes: int = 0
 
     @property
     def total_bytes(self) -> int:
         return (self.weights_bytes + self.replicated_bytes
                 + self.kv_cache_bytes + self.activation_bytes
-                + self.collective_bytes)
+                + self.collective_bytes + self.tier_staging_bytes)
 
     @property
     def headroom_bytes(self) -> int:
@@ -359,6 +421,9 @@ class MemoryReport:
                for k in ("weights_bytes", "replicated_bytes",
                          "kv_cache_bytes", "activation_bytes",
                          "collective_bytes")}
+        if self.tier_staging_bytes:
+            gib["tier_staging_bytes"] = round(
+                self.tier_staging_bytes / GIB, 3)
         return {
             "model": self.model, "tp": self.tp, "scheme": self.scheme,
             "weights_float_type": self.weights_float_type,
@@ -376,7 +441,8 @@ def device_footprint(spec: TransformerSpec, n_slices: int, scheme: str,
                      activation_bytes: int | None = None,
                      device: str = "v5e", kv_page_size: int = 0,
                      kv_pages: int | None = None,
-                     spec_k: int = 0, kv_quant: str = "f32") -> MemoryReport:
+                     spec_k: int = 0, kv_quant: str = "f32",
+                     tier_staging_pages: int = 0) -> MemoryReport:
     """Assemble the per-device report; ``activation_bytes`` overrides the
     analytic bound with a traced live-interval peak when available.
     ``kv_page_size > 0`` charges KV as the paged pool (default pool =
@@ -387,13 +453,19 @@ def device_footprint(spec: TransformerSpec, n_slices: int, scheme: str,
     activation rows through every layer — ISSUE 7); weights and KV are
     unchanged, which is exactly why the verify dispatch is nearly free in
     HBM terms. ``kv_quant='q8'`` (paged only) prices the pool at the Q80
-    codes+deltas byte rate (kv_position_bytes)."""
+    codes+deltas byte rate (kv_position_bytes). ``tier_staging_pages``
+    (ISSUE 12) charges the KV-tiering promotion staging buffer — the
+    device-side upload target a tiered engine double-buffers (2 pages is
+    the engine's shape) — priced at the pool's page byte rate."""
     from ..parallel.comm_stats import collective_staging_bytes
 
     t_len = max(1, spec_k)
     if kv_quant != "f32" and kv_page_size <= 0:
         raise ValueError(f"kv_quant={kv_quant!r} prices PAGE planes; "
                          f"pass kv_page_size > 0")
+    if tier_staging_pages and kv_page_size <= 0:
+        raise ValueError("tier_staging_pages prices PAGE planes; pass "
+                         "kv_page_size > 0")
     if activation_bytes is None:
         activation_bytes = activation_bytes_analytic(spec, n_slices,
                                                      t_len=t_len)
@@ -413,4 +485,7 @@ def device_footprint(spec: TransformerSpec, n_slices: int, scheme: str,
         activation_bytes=int(activation_bytes),
         collective_bytes=collective_staging_bytes(spec, n_slices, scheme,
                                                   t_len=t_len),
-        budget_bytes=usable_hbm_bytes(device))
+        budget_bytes=usable_hbm_bytes(device),
+        tier_staging_bytes=(tier_staging_pages * kv_page_bytes(
+            spec, n_slices, kv_page_size, kv_quant=kv_quant)
+            if tier_staging_pages else 0))

@@ -475,6 +475,39 @@ def check_paged_equivalence(spec, tp: int, config: str,
     return findings
 
 
+def check_tier_staging(spec, tp: int, config: str, report,
+                       kv_quant: str, expect_fits: bool) -> list:
+    """KV-TIER: price the tiering promotion staging buffer (the 2-page
+    double-buffered upload target a tiered engine keeps device-side,
+    ISSUE 12) in device_footprint and require that it (a) follows the
+    page-byte formula exactly and (b) fits inside a fitting config's
+    declared headroom — turning on --kv-host-pages/--kv-disk-dir must
+    never flip a support-matrix verdict."""
+    from .memory_model import (DEFAULT_PAGE_SIZE, device_footprint,
+                               kv_page_bytes)
+
+    findings = []
+    staged = device_footprint(spec, tp, report.scheme, model=report.model,
+                              kv_page_size=DEFAULT_PAGE_SIZE,
+                              kv_quant=kv_quant, tier_staging_pages=2)
+    want = 2 * kv_page_bytes(spec, tp, DEFAULT_PAGE_SIZE,
+                             kv_quant=kv_quant)
+    if staged.tier_staging_bytes != want:
+        findings.append(ShardFinding(
+            "KV-TIER", config,
+            f"tier staging priced {staged.tier_staging_bytes} B != "
+            f"{want} B (2 pages at the pool byte rate) — the "
+            f"memory_model staging formula drifted"))
+    if expect_fits and report.fits and not staged.fits:
+        findings.append(ShardFinding(
+            "KV-TIER", config,
+            f"the 2-page tiering staging buffer "
+            f"({staged.tier_staging_bytes / GIB:.3f} GiB) pushes this "
+            f"fitting config over budget — tiering cannot be enabled "
+            f"on it; shrink the page size or update the matrix"))
+    return findings
+
+
 # -- per-config driver ------------------------------------------------------
 
 
@@ -543,6 +576,11 @@ def check_config(entry: MatrixEntry, device: str = "v5e",
                                   device=device)
         findings += check_paged_equivalence(spec, entry.tp, config,
                                             report.kv_cache_bytes)
+    from .memory_model import DEFAULT_PAGE_SIZE
+
+    if spec.seq_len % DEFAULT_PAGE_SIZE == 0:
+        findings += check_tier_staging(spec, entry.tp, config, report,
+                                       kv_quant, entry.expect_fits)
     if report.fits != entry.expect_fits:
         if entry.expect_fits:
             findings.append(ShardFinding(

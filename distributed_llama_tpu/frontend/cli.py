@@ -58,6 +58,41 @@ def _apply_log_json(args) -> None:
         os.environ["DLLAMA_LOG_JSON"] = "1"
 
 
+def _add_kv_tier_flags(ap: argparse.ArgumentParser) -> None:
+    """Hierarchical KV-tiering knobs (ISSUE 12), shared by inference
+    --continuous and serve. All need --kv-page-size: tiering spills
+    PAGES."""
+    ap.add_argument("--kv-host-pages", type=int, default=0, metavar="N",
+                    help="KV tiering (needs --kv-page-size): pinned "
+                         "host-RAM pool of N pages — cold radix-tree "
+                         "prefix pages demote here (write-behind) "
+                         "instead of dropping, and promote back on a "
+                         "prefix hit via an async upload hidden behind "
+                         "decode steps (0 = no host tier)")
+    ap.add_argument("--kv-disk-dir", default=None, metavar="DIR",
+                    help="KV tiering: spill directory for the disk tier "
+                         "— host-pressure-cold pages land in append-only "
+                         "segment files with per-page read-back CRC32 "
+                         "sidecars (a damaged page re-derives via "
+                         "prefill, never serves wrong bytes)")
+    ap.add_argument("--kv-disk-gb", type=float, default=0.0, metavar="G",
+                    help="live-byte budget of the disk tier in GiB "
+                         "(needs --kv-disk-dir; 0 = uncapped)")
+
+
+def _check_kv_tier_args(args, where: str) -> str | None:
+    """Argparse-time validation (before the multi-GB model load), the
+    --spec-k/--kv-quant contract: returns an error string or None."""
+    if (args.kv_host_pages or args.kv_disk_dir) and args.kv_page_size <= 0:
+        return (f"--kv-host-pages/--kv-disk-dir spill paged KV: add "
+                f"--kv-page-size P{where}")
+    if args.kv_disk_gb and not args.kv_disk_dir:
+        return "--kv-disk-gb needs --kv-disk-dir (where else would it go?)"
+    if args.kv_host_pages < 0 or args.kv_disk_gb < 0:
+        return "--kv-host-pages/--kv-disk-gb must be >= 0"
+    return None
+
+
 def _add_common(ap: argparse.ArgumentParser) -> None:
     ap.add_argument("--nthreads", type=int, default=4,
                     help="accepted for reference-CLI compatibility; XLA "
@@ -270,6 +305,7 @@ def cmd_inference(argv: list[str], quiet: bool = False) -> int:
                          "stay deterministic; logits move to the "
                          "documented quantization tolerance (f32 = "
                          "exact parity)")
+    _add_kv_tier_flags(ap)
     ap.add_argument("--prefill-chunk", type=int, default=0, metavar="N",
                     help="process the prompt prefix in T=N chunked forward "
                          "passes instead of one token at a time (same "
@@ -315,6 +351,10 @@ def cmd_inference(argv: list[str], quiet: bool = False) -> int:
         # before the multi-GB model load
         print("--kv-quant q8 quantizes paged KV pages: add "
               "--kv-page-size P (with --continuous)", file=sys.stderr)
+        return 2
+    tier_err = _check_kv_tier_args(args, " (with --continuous)")
+    if tier_err:
+        print(tier_err, file=sys.stderr)
         return 2
     if scheme == "overlap" and args.sp > 1:
         print("--tp-scheme overlap needs --sp 1: the ring-decomposed "
@@ -457,6 +497,10 @@ def cmd_inference(argv: list[str], quiet: bool = False) -> int:
                                 spec_k=args.spec_k,
                                 spec_ngram=args.spec_ngram,
                                 kv_quant=args.kv_quant,
+                                kv_host_pages=args.kv_host_pages,
+                                kv_disk_dir=args.kv_disk_dir,
+                                kv_disk_bytes=int(args.kv_disk_gb
+                                                  * (1 << 30)),
                                 metrics=reg)
             if reg is not None:
                 print(reg.expose(), file=sys.stderr, end="")
@@ -657,6 +701,7 @@ def cmd_serve(argv: list[str]) -> int:
                          "--kv-page-size): n-gram drafts verified K "
                          "positions per dispatch, lossless; accept rate "
                          "surfaces in /health and /metrics (0 = off)")
+    _add_kv_tier_flags(ap)
     ap.add_argument("--spec-ngram", type=int, default=3, metavar="N",
                     help="longest drafter n-gram (falls back to 1)")
     ap.add_argument("--fast-prefill", action="store_true",
@@ -752,6 +797,10 @@ def cmd_serve(argv: list[str]) -> int:
         print("--kv-quant q8 quantizes paged KV pages: add "
               "--kv-page-size P", file=sys.stderr)
         return 2
+    tier_err = _check_kv_tier_args(args, "")
+    if tier_err:
+        print(tier_err, file=sys.stderr)
+        return 2
     from ..obs.slo import SLOPolicy
     from ..runtime.chaos import ChaosMonkey
 
@@ -831,7 +880,9 @@ def cmd_serve(argv: list[str]) -> int:
             spec, tp_scheme() if sharded else "single", seed_policy,
             weights_digest=weight_file_digest(args.model),
             kv_quant=args.kv_quant,
-            kv_cache_dtype=args.kv_cache_dtype))
+            kv_cache_dtype=args.kv_cache_dtype,
+            kv_host_pages=args.kv_host_pages,
+            kv_disk=bool(args.kv_disk_dir)))
     cache_dtype = jnp.bfloat16 if args.kv_cache_dtype == "bf16" else None
     try:
         server = InferenceServer(spec, params, tokenizer, args.host,
@@ -849,7 +900,11 @@ def cmd_serve(argv: list[str]) -> int:
                                  chaos=chaos, journal=journal,
                                  watchdog_s=args.watchdog_ms / 1e3,
                                  drain_s=args.drain_s,
-                                 kv_quant=args.kv_quant)
+                                 kv_quant=args.kv_quant,
+                                 kv_host_pages=args.kv_host_pages,
+                                 kv_disk_dir=args.kv_disk_dir,
+                                 kv_disk_bytes=int(args.kv_disk_gb
+                                                   * (1 << 30)))
     except Exception as e:
         from ..runtime.journal import JournalConfigMismatch
 

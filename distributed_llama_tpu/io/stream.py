@@ -266,6 +266,86 @@ def _sidecar_path(cache_path: str) -> str:
     return cache_path + ".slices"
 
 
+def write_record_sidecar(path: str, size: int, entries) -> None:
+    """Write a record-granular ``.slices`` sidecar — the weight-cache
+    format with ranges left UNMERGED so each record keeps its own CRC
+    (``_read_sidecar``/``verified_ranges`` then verify per record).
+    Atomic (temp + ``os.replace``), like ``_write_sidecar``."""
+    tmp = _sidecar_path(path) + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump({"size": size,
+                   "ranges": [list(e) for e in entries]}, fh)
+    os.replace(tmp, _sidecar_path(path))
+
+
+def append_record_verified(path: str, blob: bytes,
+                           entries=None) -> tuple[int, int, int]:
+    """Append ``blob`` to ``path`` and CRC it by READING IT BACK — the
+    weight-cache sidecar contract (the sidecar vouches for bytes that
+    actually landed on disk, not bytes a buffer once held) — then fold
+    the new range into the file's ``.slices`` sidecar WITHOUT merging
+    ranges (per-record CRCs must survive for record-granular
+    verification; the KV disk tier, runtime/paging.DiskPageStore, reads
+    one page record at a time). Returns ``(offset, length, crc)``.
+
+    ``entries``: a caller-kept list of this segment's ``[off, len,
+    crc]`` entries. When provided, the new entry is appended to it and
+    the sidecar write is DEFERRED to the caller (DiskPageStore flushes
+    at segment seal / audit) — per-append cost stays O(record) instead
+    of re-reading and rewriting a sidecar that grows with the segment.
+    Without it, the sidecar is read-modify-replaced here (small or
+    one-off appends)."""
+    with open(path, "ab") as fh:
+        off = fh.tell()
+        fh.write(blob)
+    with open(path, "rb") as fh:
+        crc = _crc_file_range(fh, off, len(blob))
+    if crc is None:
+        raise OSError(f"{path}: appended record [{off}, "
+                      f"{off + len(blob)}) did not land on disk")
+    if entries is not None:
+        entries.append([off, len(blob), crc])
+        return off, len(blob), crc
+    try:
+        with open(_sidecar_path(path)) as fh:
+            meta = json.load(fh)
+    except (FileNotFoundError, ValueError):
+        meta = {"ranges": []}
+    write_record_sidecar(path, off + len(blob),
+                         list(meta.get("ranges", []))
+                         + [[off, len(blob), crc]])
+    return off, len(blob), crc
+
+
+def read_record_verified(path: str, off: int, length: int,
+                         crc: int) -> bytes | None:
+    """One record of an append-only segment, verified against its
+    read-back CRC before a byte is trusted. ``None`` on any damage —
+    short file, IO error, or CRC mismatch — so the caller can re-derive
+    the payload instead of consuming corrupt bytes (the KV disk tier
+    re-prefills a page whose record fails here)."""
+    try:
+        with open(path, "rb") as fh:
+            fh.seek(off)
+            data = fh.read(length)
+    except OSError:
+        return None
+    if len(data) != length or zlib.crc32(data) != crc:
+        return None
+    return data
+
+
+def verified_ranges(path: str) -> list[tuple[int, int]] | None:
+    """The sidecar-recorded ranges of ``path`` that still verify against
+    their read-back CRCs (the ``_read_sidecar`` machinery, made public
+    for the KV disk tier's audit). None = no sidecar at all."""
+    try:
+        size = os.path.getsize(path)
+    except OSError:
+        return []
+    return _read_sidecar(path, size)
+
+
 def _crc_file_range(fh, off: int, ln: int) -> int | None:
     """CRC32 of ``ln`` bytes at ``off`` of an open binary file; None when
     the file is too short to cover the range."""

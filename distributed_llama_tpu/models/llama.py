@@ -622,6 +622,38 @@ def rebuild_paged_cache(planes, n_layers: int):
                    v4.reshape(L, P, ps, n_kv, hs))
 
 
+def fetch_page_planes(cache, pid: int) -> tuple:
+    """Host numpy copy of ONE physical page's planes — the KV-tiering
+    demotion read (runtime/paging.PagedAllocator.demote_cold fetches
+    through this before releasing the HBM page). The planes come back in
+    the page WIRE layout — (k, v) for f32/bf16 pools, (kq, kd, vq, vd)
+    for Q8 — so a demote→promote round trip is byte-identical: f32 pages
+    bitwise, Q8 pages code-exact (no re-quantization anywhere on the
+    path). Host-blocking by design: demotion is a scheduler-thread
+    write-behind, not hot-path work."""
+    import numpy as np
+
+    if isinstance(cache, PagedKVQ8):
+        return tuple(np.asarray(plane[:, pid]) for plane in cache)
+    return (np.asarray(cache.k[:, pid]), np.asarray(cache.v[:, pid]))
+
+
+def write_page_planes(cache, pid, planes):
+    """Write one page's planes back into the pool at physical page
+    ``pid`` — the KV-tiering promotion apply (the engine jits this with
+    the POOL cache donated, so the upload lands in place at a step
+    boundary). ``planes`` is fetch_page_planes' tuple (or the
+    PageUploader's staged device copies of it)."""
+    if isinstance(cache, PagedKVQ8):
+        kq, kd, vq, vd = planes
+        return PagedKVQ8(cache.kq.at[:, pid].set(kq),
+                         cache.kd.at[:, pid].set(kd),
+                         cache.vq.at[:, pid].set(vq),
+                         cache.vd.at[:, pid].set(vd))
+    k, v = planes
+    return KVCache(cache.k.at[:, pid].set(k), cache.v.at[:, pid].set(v))
+
+
 def paged_attention_q8(head_size: int, kv_mul: int, page_size: int,
                        n_pages: int, q: jax.Array, k: jax.Array,
                        v: jax.Array, kq_all, kd_all, vq_all, vd_all,

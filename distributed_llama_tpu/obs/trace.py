@@ -129,6 +129,36 @@ class EngineMetrics:
             "dllama_prefill_tokens_saved_total",
             "Prefill positions skipped because their pages were shared "
             "from the radix tree")
+        # KV-tiering instruments (ISSUE 12): hbm/host/disk tree-page
+        # population, promotion/demotion flow, and per-source-tier
+        # prefill savings. Pre-registered at zero like the paged series —
+        # untiered engines expose the full matrix flat, so dashboards
+        # survive the --kv-host-pages/--kv-disk-dir knobs.
+        self.tier_pages = {
+            tier: registry.labeled_gauge(
+                "dllama_kv_tier_pages", {"tier": tier},
+                "Radix-tree pages resident per tier of the KV hierarchy "
+                "(hbm = device pool, host = pinned host RAM, disk = "
+                "CRC-verified segment files)")
+            for tier in ("hbm", "host", "disk")}
+        self.tier_promotions = c(
+            "dllama_tier_promotions_total",
+            "Cold prefix pages raised back into the HBM pool on a radix "
+            "hit (async upload; the spilled copy is consumed)")
+        self.tier_demotions = c(
+            "dllama_tier_demotions_total",
+            "Cold prefix pages moved down a tier under LRU pressure "
+            "(write-behind: HBM->host on pool pressure, host->disk on "
+            "host-budget pressure)")
+        self.tier_saved = {
+            tier: registry.labeled_counter(
+                "dllama_prefill_tokens_saved_by_tier_total",
+                {"tier": tier},
+                "Prefill positions skipped via prefix sharing, by the "
+                "SOURCE tier the shared pages lived in at match time — "
+                "host/disk rows are recomputes the tier hierarchy "
+                "rescued from drop-on-evict")
+            for tier in ("hbm", "host", "disk")}
         # crash-safety instruments (ISSUE 9): journal append volume and
         # journal-replayed re-admissions. Pre-registered at zero like the
         # rest — a journal-less engine still exposes them, so dashboards

@@ -877,6 +877,26 @@ def shard_cache_paged(cache, mesh: Mesh):
         cache, spec)
 
 
+# ONE page's planes (the pool spec minus the page axis): (L, ps, n_kv, hs)
+# kv-head-sharded; Q8 delta planes (L, ps, nb) on the aligned block bands.
+# The KV-tiering promotion path stages host payloads through these so the
+# upload lands pre-sharded instead of replicating every plane onto every
+# chip and resharding inside the apply jit.
+PAGE_PLANE_SPECS = (P(None, None, "tp", None),) * 2
+PAGE_PLANE_SPECS_Q8 = (P(None, None, "tp", None), P(None, None, "tp"),
+                       P(None, None, "tp", None), P(None, None, "tp"))
+
+
+def stage_page_planes(planes, mesh: Mesh, q8: bool = False) -> tuple:
+    """Host→device staging for one demoted page's payload (KV tiering):
+    device_put each plane under its pool sharding — the sharded twin of
+    the single-chip ``jax.device_put`` stage, run by the PageUploader off
+    the scheduler thread so the transfer hides behind decode steps."""
+    specs = PAGE_PLANE_SPECS_Q8 if q8 else PAGE_PLANE_SPECS
+    return tuple(jax.device_put(a, NamedSharding(mesh, s))
+                 for a, s in zip(planes, specs))
+
+
 def validate_kv_quant(spec: TransformerSpec, n_slices: int,
                       kv_quant: str) -> None:
     """Q8 KV pages quantize each position's flattened shard-LOCAL

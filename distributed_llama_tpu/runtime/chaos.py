@@ -47,17 +47,24 @@ class ChaosMonkey:
     * ``deny_pages`` — fail the first N page allocations (transient pool
       pressure without filling the pool);
     * ``leak_on_cancel`` — drop one page from every cancelled request's
-      release (the seeded fault the invariant audit must catch).
+      release (the seeded fault the invariant audit must catch);
+    * ``drop_on_demote`` — KV tiering (ISSUE 12): every write-behind
+      demotion discards its payload instead of storing it, so the tree
+      records a host-tier page whose bytes exist nowhere — the seeded
+      fault the three-tier audit (or a promotion of the lost page) must
+      catch.
     """
 
     step_delay_every: int = 0
     step_delay_s: float = 0.0
     deny_pages: int = 0
     leak_on_cancel: bool = False
+    drop_on_demote: bool = False
     # injection counters (read by drills / surfaced in loadcheck rows)
     injected_delays: int = 0
     denied_allocs: int = 0
     leaked_pages: list = dataclasses.field(default_factory=list)
+    dropped_demotions: int = 0
     _dispatches: int = 0
 
     def on_dispatch(self) -> None:
@@ -84,11 +91,22 @@ class ChaosMonkey:
             self.leaked_pages.append(pages.pop())
         return pages
 
+    def demote_drop(self) -> bool:
+        """Allocator hook per write-behind demotion (KV tiering): True =
+        discard this demotion's payload — the page leaves HBM but its
+        bytes land in NO tier, the exactly-one-tier violation the
+        three-tier audit must flag."""
+        if self.drop_on_demote:
+            self.dropped_demotions += 1
+            return True
+        return False
+
     def injection_summary(self) -> dict:
         return {"dispatches": self._dispatches,
                 "injected_delays": self.injected_delays,
                 "denied_allocs": self.denied_allocs,
-                "leaked_pages": len(self.leaked_pages)}
+                "leaked_pages": len(self.leaked_pages),
+                "dropped_demotions": self.dropped_demotions}
 
     @classmethod
     def parse(cls, text: str) -> "ChaosMonkey":
@@ -107,12 +125,13 @@ class ChaosMonkey:
                 kw["step_delay_s"] = float(val) / 1e3
             elif key in ("step_delay_every", "deny_pages"):
                 kw[key] = int(val)
-            elif key == "leak_on_cancel":
+            elif key in ("leak_on_cancel", "drop_on_demote"):
                 kw[key] = val.strip().lower() not in ("0", "false", "")
             else:
                 raise ValueError(
                     f"unknown chaos knob {key!r} (have step_delay_every, "
-                    f"step_delay_ms, deny_pages, leak_on_cancel)")
+                    f"step_delay_ms, deny_pages, leak_on_cancel, "
+                    f"drop_on_demote)")
         return cls(**kw)
 
 
@@ -168,8 +187,14 @@ def check_invariants(eng, expect_drained: bool = True) -> list[str]:
                         f"{queued} queued requests")
     problems += [f"page audit: {p}" for p in eng.audit_pages()]
     if eng.allocator is not None:
+        from .paging import TIER_HBM
+
         alloc = eng.allocator
-        tree_held = sum(1 for _ in alloc.tree.nodes())
+        # spilled (host/disk) nodes hold no pool page — only HBM-tier
+        # nodes count against the device pool (the tier audit inside
+        # audit_pages covers the spilled copies)
+        tree_held = sum(1 for n in alloc.tree.nodes()
+                        if n.tier == TIER_HBM)
         slot_held = sum(len(s.pages) for s in eng._pool)
         # only decisive once slots drained: a shared-prefix page is held
         # by a slot AND the tree at once (the audit covers the live case)
@@ -324,6 +349,55 @@ def drill_latency_spike(make_engine) -> DrillResult:
     if eng._obs is not None and eng._obs.step_duration.count == 0:
         violations.append("step-duration histogram recorded nothing")
     return _result("latency_spike", eng, chaos, extra_violations=violations)
+
+
+def drill_tier_spill_storm(make_engine) -> DrillResult:
+    """KV-tiering churn drill (ISSUE 12): a working set several times the
+    HBM page pool cycles through twice under injected page-allocation
+    denials, forcing deterministic demote (HBM→host→disk, write-behind)
+    and promote (radix hit on a spilled prefix → async upload + PAUSE)
+    churn — then the three-tier ``PagedAllocator.audit`` must close the
+    ledger (every payload owned by exactly one tier, disk records
+    CRC-verified by read-back, promotion/demotion counters consistent),
+    the metrics exposition must still parse, and the engine must still
+    admit. Pass 2 must also actually SAVE prefill tokens from spilled
+    tiers — a hierarchy that spills but never promotes is not a cache."""
+    import tempfile
+
+    chaos = ChaosMonkey(deny_pages=4)
+    disk_dir = tempfile.mkdtemp(prefix="dllama-chaos-tier-")
+    eng = make_engine(chaos=chaos, kv_pages=8, kv_host_pages=6,
+                      kv_disk_dir=disk_dir, slots=2)
+    ps = eng.page_size
+    n_prefix = 8  # 2 full pages each = 16 prefix pages vs the 8-page pool
+    waves = []
+    for tail in (3, 9):
+        waves.append([[1] + [(7 * i + j) % 90 + 5 for j in range(2 * ps)]
+                      + [tail + i] for i in range(n_prefix)])
+    for wave in waves:
+        eng.run(wave, steps=4 * ps, quiet=True)
+    a = eng.allocator
+    violations = []
+    if sum(a.demotions.values()) == 0:
+        violations.append("no demotions under a working set several "
+                          "times the HBM pool")
+    if sum(a.promotions.values()) == 0:
+        violations.append("no promotions: spilled prefixes were never "
+                          "raised back on re-match")
+    spilled_saved = (a.tokens_saved_by_tier.get("host", 0)
+                     + a.tokens_saved_by_tier.get("disk", 0))
+    if spilled_saved == 0:
+        violations.append("no prefill tokens saved from spilled tiers — "
+                          "tiering rescued nothing from recompute")
+    if chaos.denied_allocs == 0:
+        violations.append("deny_pages pressure never fired")
+    return _result("tier_spill_storm", eng, chaos,
+                   extra_violations=violations,
+                   demotions=dict(a.demotions),
+                   promotions=dict(a.promotions),
+                   tier_pages=a.tier_page_counts(),
+                   prefill_saved_spilled=spilled_saved,
+                   crc_drops=a.crc_drops)
 
 
 def drill_profiler_under_load(make_engine) -> DrillResult:
@@ -787,6 +861,11 @@ def drill_weight_stream_disconnect(make_engine) -> DrillResult:
 RECOVERY_DRILLS = ("journal_wal", "kill_mid_decode", "hung_dispatch",
                    "weight_stream_disconnect")
 
+# drill names that make up the ISSUE 12 KV-tiering gate (same loadcheck
+# coverage contract as RECOVERY_DRILLS: the baseline band file names them,
+# and a full run that silently skips one fails the gate)
+TIERING_DRILLS = ("tier_spill_storm",)
+
 DRILLS = (
     ("pool_exhaustion", drill_pool_exhaustion),
     ("transient_starvation", drill_transient_starvation),
@@ -794,6 +873,7 @@ DRILLS = (
     ("disconnect", drill_disconnect),
     ("latency_spike", drill_latency_spike),
     ("profiler_under_load", drill_profiler_under_load),
+    ("tier_spill_storm", drill_tier_spill_storm),
     ("journal_wal", drill_journal_wal),
     ("kill_mid_decode", drill_kill_mid_decode),
     ("hung_dispatch", drill_hung_dispatch),

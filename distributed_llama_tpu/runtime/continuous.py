@@ -152,6 +152,11 @@ class _Slot:
     # this slot (decode writes start at the page-aligned share boundary)
     pages: list = dataclasses.field(default_factory=list)
     shared: int = 0
+    # KV tiering (ISSUE 12): True while the slot's shared-prefix pages
+    # await an async promotion upload — admission prefill is deferred and
+    # the slot rides dispatches masked inactive (pages-starved semantics)
+    # until the payload lands at a step boundary (_settle_promotions)
+    await_promo: bool = False
 
     @property
     def free(self) -> bool:
@@ -209,7 +214,9 @@ class ContinuousEngine:
                  page_size: int = 0, kv_pages: int = 0,
                  prefix_share: bool = True, spec_k: int = 0,
                  spec_ngram: int = 3, slo=None, chaos=None,
-                 journal=None, watchdog=None, kv_quant: str = "f32"):
+                 journal=None, watchdog=None, kv_quant: str = "f32",
+                 kv_host_pages: int = 0, kv_disk_dir: str | None = None,
+                 kv_disk_bytes: int = 0, kv_tier_async: bool = True):
         import functools
 
         import jax
@@ -268,6 +275,13 @@ class ContinuousEngine:
             _warn_q8_xla_fallback(spec, page_size,
                                   mesh.shape["tp"] if mesh is not None
                                   else 1)
+        if (kv_host_pages or kv_disk_dir) and page_size <= 0:
+            raise ValueError("KV tiering spills PAGES: pass page_size > 0 "
+                             "(--kv-page-size with --kv-host-pages/"
+                             "--kv-disk-dir)")
+        if kv_disk_bytes and not kv_disk_dir:
+            raise ValueError("kv_disk_bytes without kv_disk_dir: the disk "
+                             "tier needs a directory (--kv-disk-dir)")
         if page_size > 0:
             from .paging import PagedAllocator
 
@@ -277,7 +291,10 @@ class ContinuousEngine:
             self._max_pages = spec.seq_len // page_size
             n_pages = kv_pages or slots * self._max_pages
             self._alloc = PagedAllocator(n_pages, page_size,
-                                         prefix_share=prefix_share)
+                                         prefix_share=prefix_share,
+                                         host_pages=kv_host_pages,
+                                         disk_dir=kv_disk_dir,
+                                         disk_bytes=kv_disk_bytes)
             # persistent page-table staging row block (dlint D004): one
             # int32 (slots, max_pages) buffer, rewritten host-side per
             # step and shipped as ONE upload; free/short rows park their
@@ -423,6 +440,42 @@ class ContinuousEngine:
                 self._scatter_pages = jax.jit(
                     lambda c, s, t, sp_=sp_: sp_(c, s, t, page_size),
                     donate_argnums=0)
+        # KV tiering (ISSUE 12): bind the allocator's device I/O — the
+        # demotion read (pool page planes -> host numpy, models/llama.
+        # fetch_page_planes), the promotion stage (host payload ->
+        # device(-sharded) arrays, run by a background PageUploader so
+        # the host->device copy hides behind decode steps), and the
+        # donated apply jit the scheduler runs at step boundaries
+        # (_settle_promotions). kv_tier_async=False stages inline at
+        # promotion time — the deterministic mode the virtual-clock
+        # bench/tests drive.
+        self._uploader = None
+        self._tier_write = None
+        self._tier_seen = {"prom": 0, "dem": 0, "hbm": 0, "host": 0,
+                           "disk": 0}
+        if self._alloc is not None and self._alloc.tiered:
+            from ..models.llama import fetch_page_planes, write_page_planes
+            from .paging import PageUploader
+
+            if mesh is not None:
+                from ..parallel.tp import stage_page_planes
+
+                q8 = kv_quant == "q8"
+                stage = lambda planes: stage_page_planes(  # noqa: E731
+                    planes, mesh, q8=q8)
+            else:
+                stage = lambda planes: tuple(  # noqa: E731
+                    jax.device_put(p) for p in planes)
+            if kv_tier_async:
+                self._uploader = PageUploader(stage=stage)
+            self._alloc.bind_device_io(
+                lambda pid: fetch_page_planes(self.cache, pid),
+                stage=stage, uploader=self._uploader)
+            if chaos is not None:
+                # hook consulted per demotion; the monkey's
+                # drop_on_demote flag decides (like deny_page)
+                self._alloc.corrupt_demote = chaos.demote_drop
+            self._tier_write = jax.jit(write_page_planes, donate_argnums=0)
         # write-ahead request journal (runtime/journal.py, ISSUE 9): every
         # submit/sampled-token/retire appends a record; recover() replays
         # incomplete requests after a crash. None = zero overhead, like
@@ -505,6 +558,16 @@ class ContinuousEngine:
         """The obs.slo.SLOTracker when a policy was configured, else None
         — the server's /health "slo" block reads snapshot() here."""
         return self._slo
+
+    def close(self) -> None:
+        """Release engine-owned background resources — today the KV-tier
+        PageUploader thread (ISSUE 12). Idempotent; the engine must not
+        step after close(). Server shutdown (runtime/server.InferenceServer
+        .stop) and the bench arms call this; short-lived engines may rely
+        on the thread being a daemon instead."""
+        if self._uploader is not None:
+            self._uploader.close()
+            self._uploader = None
 
     def audit_pages(self) -> list[str]:
         """Page-accounting invariant check (paging.PagedAllocator.audit
@@ -640,6 +703,7 @@ class ContinuousEngine:
 
         self._sweep_cancelled()
         self._admit()
+        self._settle_promotions(quiet)
         pool = self._pool
         paused = self._grow_pages(pool, K, quiet)
         if all(s.free for s in pool):
@@ -769,6 +833,60 @@ class ContinuousEngine:
 
     # -- paged-KV bookkeeping (page_size > 0) -------------------------------
 
+    def _settle_promotions(self, quiet: bool = True) -> None:
+        """Step-boundary promotion apply (KV tiering, ISSUE 12): write
+        every staged promotion payload into its target pool page (ONE
+        donated jit per page — in place), then release slots that were
+        waiting on those pages: their deferred admission prefill runs now
+        (suffix-only, exactly as for an HBM-resident prefix) and they
+        dispatch on the next step. Scheduler thread only — the pool cache
+        must never be written concurrently with a dispatch."""
+        alloc = self._alloc
+        if alloc is None or not alloc.tiered:
+            return
+        jobs = alloc.take_staged_promotions()
+        for job in jobs:
+            self.cache = self._tier_write(self.cache,
+                                          self.jnp.int32(job.page),
+                                          tuple(job.staged))
+            alloc.promotion_applied(job)
+        for b, s in enumerate(self._pool):
+            if s.free or not s.await_promo:
+                continue
+            if alloc.slot_pending(s.pages):
+                continue  # still uploading: stays paused
+            s.await_promo = False
+            self._maybe_prefill_slot(b, s)
+            if s.req.cancelled:
+                self._retire(s, quiet)
+        if jobs:
+            self._update_tier_obs()
+
+    def _update_tier_obs(self) -> None:
+        """Push the allocator's tier ledger into the Prometheus series
+        (delta-tracked: obs counters only move forward)."""
+        if self._obs is None or self._alloc is None \
+                or not self._alloc.tiered:
+            return
+        a = self._alloc
+        for tier, gauge in self._obs.tier_pages.items():
+            gauge.set(a.tier_pages.get(tier, 0))
+        seen = self._tier_seen
+
+        def push(key, got, counter):
+            # cumulative < seen means allocator.reset_counters() ran (the
+            # bench warm-up boundary): re-base without incrementing, so
+            # the Prometheus counters keep moving instead of stalling
+            # until the count re-exceeds its pre-reset high-water mark
+            if got > seen[key]:
+                counter.inc(got - seen[key])
+            seen[key] = got
+
+        push("prom", sum(a.promotions.values()), self._obs.tier_promotions)
+        push("dem", sum(a.demotions.values()), self._obs.tier_demotions)
+        for tier, counter in self._obs.tier_saved.items():
+            push(tier, a.tokens_saved_by_tier.get(tier, 0), counter)
+
     def _ensure_pages(self, s: _Slot, n_positions: int) -> bool:
         """Grow a slot's page list to cover ``n_positions`` sequence
         positions, evicting idle radix leaves when the free list is dry
@@ -801,19 +919,29 @@ class ContinuousEngine:
         ROADMAP item-4 follow-up."""
         while True:
             paused = set()
+            promo = set()
             active = 0
             for b, s in enumerate(pool):
                 if s.free:
                     continue
                 active += 1
+                if s.await_promo or (
+                        self._alloc.tiered
+                        and self._alloc.slot_pending(s.pages)):
+                    # shared-prefix pages still riding a promotion upload
+                    # (KV tiering): the slot pauses like a page-starved
+                    # one, but resolves by itself when the upload lands —
+                    # never a deadlock, so the breaker must not see it
+                    promo.add(b)
+                    continue
                 if not self._ensure_pages(s, min(s.pos + k, s.budget)):
                     paused.add(b)
-            if not paused or len(paused) < active:
-                if paused:
-                    self.stats.pauses += len(paused)
+            if promo or not paused or len(paused) < active:
+                if paused or promo:
+                    self.stats.pauses += len(paused) + len(promo)
                     if self._obs is not None:
-                        self._obs.pauses.inc(len(paused))
-                return paused
+                        self._obs.pauses.inc(len(paused) + len(promo))
+                return paused | promo
             victim = max(paused, key=lambda b: pool[b].req.index)
             s = pool[victim]
             if self._obs is not None:
@@ -864,6 +992,7 @@ class ContinuousEngine:
         jnp = self.jnp
         self._sweep_cancelled()
         self._admit()
+        self._settle_promotions(quiet)
         pool = self._pool
         paused = (self._grow_pages(pool, k, quiet)
                   if self._alloc is not None else ())
@@ -1133,6 +1262,7 @@ class ContinuousEngine:
         jnp = self.jnp
         self._sweep_cancelled()
         self._admit()
+        self._settle_promotions(quiet)
         pool = self._pool
         paused = (self._grow_pages(pool, 1, quiet)
                   if self._alloc is not None else ())
@@ -1252,7 +1382,7 @@ class ContinuousEngine:
         a starved one; preemption is the ROADMAP item-4 follow-up)."""
         req = s.req
         self._alloc.release_pages(s.pages)
-        s.pages, s.shared = [], 0
+        s.pages, s.shared, s.await_promo = [], 0, False
         s.req, s.pos, s.token, s.forced, s.sampler = None, 0, 0, [], None
         req.t_admit = 0.0
         self.stats.requeues += 1
@@ -1342,6 +1472,14 @@ class ContinuousEngine:
                     if self._admit_paged(s) == "dry":
                         self._requeue_front(s)
                         return
+                    if self._alloc.tiered and self._alloc.slot_pending(
+                            s.pages):
+                        # shared prefix promoting from host/disk: defer
+                        # admission prefill until the upload lands
+                        # (_settle_promotions) — gathering now would
+                        # read junk where the payload hasn't arrived
+                        s.await_promo = True
+                        break
                 self._maybe_prefill_slot(slot_index, s)
                 if s.req.cancelled:
                     # consumer vanished during admission/prefill: free the
@@ -1463,9 +1601,10 @@ class ContinuousEngine:
                 # page from the release so the drill audit must flag it
                 s.pages = self._chaos.filter_release(s.pages)
             self._alloc.release_pages(s.pages)
-            s.pages, s.shared = [], 0
+            s.pages, s.shared, s.await_promo = [], 0, False
             if self._obs is not None:
                 self._obs.kv_pages_free.set(self._alloc.n_free)
+                self._update_tier_obs()
         s.req.t_finish = time.monotonic()
         if self._journal is not None and not self._suspending:
             # a drain-suspended request writes NO retirement: its admit +
@@ -1580,7 +1719,9 @@ def generate_continuous(spec: TransformerSpec, params: dict[str, Any],
                         fast_prefill: bool = False, metrics=None,
                         page_size: int = 0, kv_pages: int = 0,
                         spec_k: int = 0, spec_ngram: int = 3,
-                        kv_quant: str = "f32"):
+                        kv_quant: str = "f32", kv_host_pages: int = 0,
+                        kv_disk_dir: str | None = None,
+                        kv_disk_bytes: int = 0):
     """CLI entry: encode prompts, stream them through a slot pool, print
     rows in the --prompts-file format ("[i] 'text'")."""
     reqs = [tokenizer.encode(p or "", bos=True, eos=False) for p in prompts]
@@ -1593,7 +1734,9 @@ def generate_continuous(spec: TransformerSpec, params: dict[str, Any],
                            fast_prefill=fast_prefill, metrics=metrics,
                            page_size=page_size, kv_pages=kv_pages,
                            spec_k=spec_k, spec_ngram=spec_ngram,
-                           kv_quant=kv_quant)
+                           kv_quant=kv_quant, kv_host_pages=kv_host_pages,
+                           kv_disk_dir=kv_disk_dir,
+                           kv_disk_bytes=kv_disk_bytes)
     outs, stats = eng.run(reqs, steps, quiet=quiet)
     for b, (req, row) in enumerate(zip(reqs, outs)):
         if not quiet:
@@ -1611,6 +1754,15 @@ def generate_continuous(spec: TransformerSpec, params: dict[str, Any],
                   f"{a.n_free} free; prefix hit "
                   f"rate {a.hit_rate:.0%}, {a.tokens_saved} prefill "
                   f"tokens saved, {a.evictions} evictions")
+            if a.tiered:
+                counts = a.tier_page_counts()
+                saved = a.tokens_saved_by_tier
+                print(f"KV tiers:            hbm {counts['hbm']} / host "
+                      f"{counts['host']} / disk {counts['disk']} pages; "
+                      f"{sum(a.demotions.values())} demotions, "
+                      f"{sum(a.promotions.values())} promotions; "
+                      f"{saved['host'] + saved['disk']} prefill tokens "
+                      f"rescued from spilled tiers")
         if eng.spec_k:
             print(f"Speculative:         K={eng.spec_k}, "
                   f"{stats.spec_accepted}/{stats.spec_proposed} drafts "

@@ -93,7 +93,9 @@ class JournalConfigMismatch(RuntimeError):
 def config_fingerprint(spec, scheme: str, seed_policy: str,
                        weights_digest: str | None = None,
                        kv_quant: str = "f32",
-                       kv_cache_dtype: str = "f32") -> dict:
+                       kv_cache_dtype: str = "f32",
+                       kv_host_pages: int = 0,
+                       kv_disk: bool = False) -> dict:
     """The serving-config fingerprint the WAL header records: everything a
     bitwise replay depends on — model dims, weight/buffer quant types,
     the tp collective scheme (schemes are bitwise-distinct only across
@@ -131,6 +133,16 @@ def config_fingerprint(spec, scheme: str, seed_policy: str,
         fp["kv_quant"] = kv_quant
     if kv_cache_dtype != "f32":
         fp["kv_cache_dtype"] = kv_cache_dtype
+    # KV tiering (ISSUE 12): tiering never changes a stream (demote→
+    # promote round-trips are byte-exact), but the spill budgets shape
+    # which pauses/requeues a replayed schedule hits, and a restart that
+    # silently drops the disk tier orphans its segments — record the
+    # knobs so drift is explicit. Omitted when OFF, so every pre-tiering
+    # journal keeps recovering under untiered serving.
+    if kv_host_pages:
+        fp["kv_host_pages"] = int(kv_host_pages)
+    if kv_disk:
+        fp["kv_disk"] = True
     return fp
 
 

@@ -27,13 +27,39 @@ Invariants the unit tests pin (tests/test_paging.py):
 * eviction frees least-recently-used tree LEAVES whose pages no live slot
   maps — interior nodes only become evictable once their children are
   gone (a child is unreachable without its prefix chain).
+
+Hierarchical KV tiering (ISSUE 12): at fleet scale the radix tree's
+shareable working set dwarfs HBM, and dropping a cold leaf burns the exact
+prefill tokens the tree exists to save. With tiering enabled the pool
+becomes the TOP of a three-tier hierarchy — HBM pages ⇄ a pinned host-RAM
+pool (``HostPagePool``: numpy planes in the page wire layout, f32 or Q8)
+⇄ append-only disk segments (``DiskPageStore``: CRC32-sidecar'd records
+via io/stream's verified-read-back machinery) — and eviction becomes
+WRITE-BEHIND DEMOTION: LRU pressure moves a cold page's bytes down a
+tier instead of killing it (AttentionStore/Mooncake lineage; PAPER.md's
+root/worker design already treats the host as the KV home). A radix hit
+on a spilled prefix starts an ASYNC PROMOTION — payload read (disk CRC-
+verified), HBM target page allocated, host→device staging handed to a
+background ``PageUploader`` — and the engine PAUSEs the request with the
+pages-starved semantics until the upload lands at a step boundary, so
+the cold-hit cost is a page upload hidden behind decode steps, not a
+full prefill recompute. Tier invariants the audit pins: a page's payload
+is owned by EXACTLY one tier; host/disk copies map 1:1 to tree nodes;
+disk records verify against their read-back CRCs; a CRC-damaged disk
+page is dropped (with its now-unreachable subtree) and silently
+re-derives through prefill on the next miss.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import os
 
 SCRAP_PAGE = 0  # physical page 0: dead-write target for parked slots
+
+TIER_HBM = "hbm"    # payload lives in the device page pool (node.page)
+TIER_HOST = "host"  # payload lives in the pinned host pool (node.host_id)
+TIER_DISK = "disk"  # payload lives in a disk segment (node.disk_ref)
 
 
 class PagePool:
@@ -99,15 +125,341 @@ class PagePool:
         return list(self._free)
 
 
+def _pack_planes(planes) -> tuple[bytes, tuple]:
+    """Serialize a page payload (tuple of numpy plane arrays in the page
+    wire layout — (k, v) f32 planes or (kq, kd, vq, vd) Q8 planes) into
+    one blob + the shape/dtype metadata needed to rebuild it."""
+    import numpy as np
+
+    metas = tuple((tuple(a.shape), a.dtype.str) for a in planes)
+    blob = b"".join(np.ascontiguousarray(a).tobytes() for a in planes)
+    return blob, metas
+
+
+def _unpack_planes(blob: bytes, metas) -> tuple:
+    """_pack_planes' inverse. Returns read-only views over ``blob`` — the
+    consumers (device_put / .at[].set) copy anyway."""
+    import numpy as np
+
+    out, off = [], 0
+    for shape, dt in metas:
+        dtype = np.dtype(dt)
+        n = 1
+        for d in shape:
+            n *= int(d)
+        out.append(np.frombuffer(blob, dtype, count=n,
+                                 offset=off).reshape(shape))
+        off += n * dtype.itemsize
+    return tuple(out)
+
+
+class HostPagePool:
+    """The middle tier: up to ``n_pages`` page payloads pinned in host
+    RAM, with the device pool's free-list/ownership invariants — ids hand
+    out lowest-first, every live id is owned by exactly one tree node,
+    and free + live always covers the capacity (the tier audit pins it).
+    Payloads are numpy plane tuples in the page WIRE layout (f32 planes,
+    or PR 11's Q8 codes+deltas), so demote→promote round-trips are
+    byte-identical by construction."""
+
+    def __init__(self, n_pages: int):
+        if n_pages < 1:
+            raise ValueError(f"host page pool needs >= 1 page, "
+                             f"got {n_pages}")
+        self.n_pages = n_pages
+        self._free = list(range(n_pages - 1, -1, -1))  # pop() = lowest id
+        self._store: dict[int, tuple] = {}
+
+    @property
+    def n_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def n_live(self) -> int:
+        return len(self._store)
+
+    def store(self, payload) -> int | None:
+        """Adopt one payload; returns its host id, or None when the pool
+        is full (the caller spills its LRU entry to disk, or drops)."""
+        if not self._free:
+            return None
+        hid = self._free.pop()
+        self._store[hid] = payload
+        return hid
+
+    def load(self, hid: int):
+        """The payload at ``hid`` (still owned by the pool)."""
+        return self._store[hid]
+
+    def live(self, hid: int) -> bool:
+        return hid in self._store
+
+    def free(self, hid: int):
+        """Release ``hid`` and return its payload (a promotion takes the
+        bytes with it — exactly-one-tier ownership)."""
+        payload = self._store.pop(hid)
+        self._free.append(hid)
+        if len(self._free) > 1 and self._free[-1] > self._free[-2]:
+            self._free.sort(reverse=True)  # keep lowest-first handout
+        return payload
+
+    def live_ids(self) -> list[int]:
+        return sorted(self._store)
+
+    def audit(self) -> list[str]:
+        problems = []
+        if len(set(self._free)) != len(self._free):
+            problems.append("host pool free list has duplicate ids")
+        for hid in self._free:
+            if hid in self._store:
+                problems.append(f"host page {hid} is both free and live")
+        if len(self._free) + len(self._store) != self.n_pages:
+            problems.append(
+                f"host pool accounting: {len(self._free)} free + "
+                f"{len(self._store)} live != {self.n_pages} pages")
+        return problems
+
+
+class DiskPageStore:
+    """The bottom tier: page payloads appended to segment files, each
+    record CRC32'd by READ-BACK into the segment's ``.slices`` sidecar
+    (io/stream.append_record_verified — the weight-cache machinery
+    reused verbatim) and verified again on every load. A record that
+    fails its CRC loads as None: the caller drops the page and prefill
+    re-derives it — disk damage degrades to recompute, never to wrong
+    bytes. ``budget_bytes`` caps LIVE bytes (0 = uncapped); fully-dead
+    segments are unlinked, which bounds append-only growth."""
+
+    SEGMENT_BYTES = 8 << 20
+
+    def __init__(self, directory: str, budget_bytes: int = 0):
+        os.makedirs(directory, exist_ok=True)
+        self.dir = directory
+        self.budget_bytes = int(budget_bytes)
+        self._seg_path: str | None = None
+        self._seg_n = 0
+        self._seg_bytes = 0
+        self._seg_live: dict[str, int] = {}   # path -> live record count
+        self._seg_entries: dict[str, list] = {}  # path -> sidecar ranges
+        self._live: dict[tuple, int] = {}     # (path, off) -> length
+        self.live_bytes = 0
+        self.stores = 0
+        self.loads = 0
+        self.crc_failures = 0
+        # the disk tier is a CACHE: a previous process's segments are
+        # orphans (their index lived in that process's radix tree), so
+        # they are reclaimed here — without this, every restart would
+        # stack a dead budget's worth of segment files next to the live
+        # one and real disk usage would creep past --kv-disk-gb
+        for name in sorted(os.listdir(directory)):
+            if name.startswith("kvpages-"):
+                try:
+                    os.unlink(os.path.join(directory, name))
+                except OSError:
+                    pass
+
+    @property
+    def n_live(self) -> int:
+        return len(self._live)
+
+    def has_room(self, nbytes: int) -> bool:
+        return (not self.budget_bytes
+                or self.live_bytes + nbytes <= self.budget_bytes)
+
+    def _flush_sidecar(self, path: str) -> None:
+        """Write the segment's accumulated record ranges to its sidecar
+        (deferred from per-append — io/stream.append_record_verified
+        still read-back-CRCs every record at append time; this just
+        persists the entries for verified_ranges/audit)."""
+        from ..io.stream import write_record_sidecar
+
+        entries = self._seg_entries.get(path)
+        if entries:
+            write_record_sidecar(path, entries[-1][0] + entries[-1][1],
+                                 entries)
+
+    def _reclaim_if_dead(self, path: str) -> None:
+        """Unlink a SEALED segment with zero live records (free() and the
+        seal-time check both call this — a segment whose last record
+        dies while it is still the write target reclaims at rotation)."""
+        if path == self._seg_path or self._seg_live.get(path, 1) != 0:
+            return
+        from ..io.stream import _sidecar_path
+
+        self._seg_live.pop(path, None)
+        self._seg_entries.pop(path, None)
+        for victim in (path, _sidecar_path(path)):
+            try:
+                os.unlink(victim)
+            except OSError:
+                pass
+
+    def _segment(self, nbytes: int) -> str:
+        if (self._seg_path is None
+                or self._seg_bytes + nbytes > self.SEGMENT_BYTES):
+            sealed = self._seg_path
+            self._seg_n += 1
+            self._seg_path = os.path.join(
+                self.dir, f"kvpages-{self._seg_n:05d}.seg")
+            open(self._seg_path, "wb").close()
+            self._seg_bytes = 0
+            self._seg_live[self._seg_path] = 0
+            self._seg_entries[self._seg_path] = []
+            if sealed is not None:
+                self._flush_sidecar(sealed)
+                self._reclaim_if_dead(sealed)
+        return self._seg_path
+
+    def store(self, payload) -> tuple | None:
+        """Append one payload; returns an opaque record ref, or None when
+        the live-byte budget cannot take it (the caller evicts LRU disk
+        pages first, or drops)."""
+        from ..io.stream import append_record_verified
+
+        blob, metas = _pack_planes(payload)
+        if not self.has_room(len(blob)):
+            return None
+        path = self._segment(len(blob))
+        off, length, crc = append_record_verified(
+            path, blob, entries=self._seg_entries[path])
+        self._seg_bytes += length
+        self._seg_live[path] += 1
+        self._live[(path, off)] = length
+        self.live_bytes += length
+        self.stores += 1
+        return (path, off, length, crc, metas)
+
+    def live(self, ref) -> bool:
+        return ref is not None and (ref[0], ref[1]) in self._live
+
+    def load(self, ref):
+        """The payload at ``ref``, CRC-verified — None on any damage."""
+        from ..io.stream import read_record_verified
+
+        path, off, length, crc, metas = ref
+        blob = read_record_verified(path, off, length, crc)
+        if blob is None:
+            self.crc_failures += 1
+            return None
+        self.loads += 1
+        return _unpack_planes(blob, metas)
+
+    def free(self, ref) -> None:
+        path, off, length = ref[0], ref[1], ref[2]
+        if self._live.pop((path, off), None) is None:
+            return
+        self.live_bytes -= length
+        self._seg_live[path] -= 1
+        self._reclaim_if_dead(path)
+
+    def live_refs(self) -> list[tuple]:
+        return sorted(self._live)
+
+    def audit(self) -> list[str]:
+        """Verify every live record against its segment's read-back CRC
+        sidecar (io/stream.verified_ranges) — the disk half of the
+        three-tier audit."""
+        from ..io.stream import verified_ranges
+
+        problems = []
+        if self._seg_path is not None:
+            # the live segment's sidecar is write-deferred (store()
+            # appends entries in memory): persist before verifying
+            self._flush_sidecar(self._seg_path)
+        by_path: dict[str, list] = {}
+        for (path, off), length in self._live.items():
+            by_path.setdefault(path, []).append((off, length))
+        for path, records in by_path.items():
+            ok = verified_ranges(path)
+            ok_set = set(ok or ())
+            for off, length in sorted(records):
+                if (off, length) not in ok_set:
+                    problems.append(
+                        f"disk tier: record [{off}, {off + length}) of "
+                        f"{os.path.basename(path)} fails its read-back "
+                        f"CRC (or lost its sidecar entry)")
+        return problems
+
+
+@dataclasses.dataclass
+class _PromotionJob:
+    """One spilled page being raised back to HBM: ``payload`` is the host
+    numpy planes, ``staged`` the device-ready arrays the PageUploader (or
+    a lazy inline stage) produces — the engine applies staged jobs to the
+    pool cache at step boundaries. ``node.pending`` stays True until the
+    write lands; a job whose node was dropped or re-paged in the meantime
+    is dead and silently discarded."""
+
+    node: "_Node"
+    page: int
+    payload: tuple
+    staged: tuple | None = None
+
+
+class PageUploader:
+    """Background host→device staging thread: promotion payloads are
+    device_put OFF the scheduler thread (the slow host→device copy hides
+    behind decode steps; the scheduler only applies already-staged planes
+    at step boundaries). ``gate`` — when a test installs a threading
+    Event — stalls staging so admission-PAUSE semantics can be pinned
+    deterministically. Stage errors fall back to the raw numpy payload:
+    the apply-side jit transfers it anyway, so a staging hiccup degrades
+    to a synchronous upload instead of a wedged promotion."""
+
+    def __init__(self, stage=None):
+        import queue
+        import threading
+
+        self._stage = stage
+        self._q: "queue.SimpleQueue" = queue.SimpleQueue()
+        self.gate = None  # tests: threading.Event held = staging stalls
+        self.staged_jobs = 0
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="dllama-kv-uploader")
+        self._thread.start()
+
+    def submit(self, job: _PromotionJob) -> None:
+        self._q.put(job)
+
+    def _run(self) -> None:
+        while True:
+            job = self._q.get()
+            if job is None:
+                return
+            gate = self.gate
+            if gate is not None:
+                gate.wait()
+            try:
+                staged = (self._stage(job.payload) if self._stage
+                          else job.payload)
+            except Exception:  # noqa: BLE001 - degrade to sync upload
+                staged = job.payload
+            job.staged = staged
+            self.staged_jobs += 1
+
+    def close(self) -> None:
+        self._q.put(None)
+
+
 @dataclasses.dataclass
 class _Node:
     """One FULL page of the prefix tree: ``key`` is its page_size-token
-    window, ``page`` the physical id the tree retains a ref on."""
+    window. Exactly ONE of the tier fields is live at a time (the audit
+    pins it): ``page`` when tier == hbm (the tree retains a pool ref),
+    ``host_id`` when tier == host, ``disk_ref`` when tier == disk.
+    ``pending`` marks a promotion in flight: the node is back at tier
+    hbm with ``page`` allocated, but the payload has not landed in the
+    device pool yet — readers must wait (engine PAUSE semantics)."""
     key: tuple
     page: int
     parent: "_Node | None"
     children: dict = dataclasses.field(default_factory=dict)
     last_used: int = 0
+    tier: str = TIER_HBM
+    host_id: int = -1
+    disk_ref: tuple | None = None
+    pending: bool = False
+    promoted_from: str | None = None  # transient match-walk attribution
 
 
 class PrefixTree:
@@ -121,9 +473,14 @@ class PrefixTree:
     ``evict_lru`` frees idle leaves when the pool runs dry.
     """
 
-    def __init__(self, pool: PagePool, page_size: int):
+    def __init__(self, pool: PagePool, page_size: int, owner=None):
         self.pool = pool
         self.page_size = page_size
+        # ``owner`` (the PagedAllocator, when tiering can be in play)
+        # routes node-drop resource release and spilled-node re-adoption
+        # through the tier bookkeeping; a bare tree (owner None) keeps
+        # the original pool-only semantics.
+        self.owner = owner
         self._roots: dict[tuple, _Node] = {}
         self._clock = 0
         self._n_nodes = 0
@@ -140,20 +497,38 @@ class PrefixTree:
         return [tuple(tokens[i:i + ps])
                 for i in range(0, (len(tokens) // ps) * ps, ps)]
 
-    def match(self, tokens) -> list[int]:
+    def match(self, tokens, promote=None, on_match=None) -> list[int]:
         """Physical page ids of the longest stored page-aligned prefix of
         ``tokens``; each returned page carries a NEW reference the caller
-        must eventually release (slot retire)."""
-        now = self._tick()
+        must eventually release (slot retire).
+
+        Every touched node gets its OWN monotonic tick (not one shared
+        walk timestamp): LRU ordering among victims is a strict total
+        order — deterministic, wall-clock-free (dlint D005) — and a
+        parent always reads more recent than the child the same walk
+        touched before it... the walk descends, so each child's tick is
+        newer; what matters is that no two nodes ever tie.
+
+        ``promote`` (tiering): called with a node whose payload is NOT in
+        HBM; it must raise the node back to tier hbm (allocating
+        ``node.page``, possibly still promotion-pending) and return True,
+        or return False to stop the match at the spill boundary.
+        ``on_match`` observes every matched node (tier-source
+        attribution)."""
         pages: list[int] = []
         children = self._roots
         for key in self._windows(tokens):
             node = children.get(key)
             if node is None:
                 break
-            node.last_used = now
+            if node.tier != TIER_HBM:
+                if promote is None or not promote(node):
+                    break
+            node.last_used = self._tick()
             self.pool.retain(node.page)
             pages.append(node.page)
+            if on_match is not None:
+                on_match(node)
             children = node.children
         return pages
 
@@ -163,21 +538,29 @@ class PrefixTree:
         ids). The tree retains one ref per NEWLY adopted page; windows
         already present just refresh recency (their pages stay whichever
         physical id got there first — content is identical by the prefix
-        key). Returns the number of pages adopted."""
-        now = self._tick()
+        key), EXCEPT a window whose node was demoted to host/disk: the
+        inserting request just PREFILLED fresh HBM pages with that exact
+        content, so the node re-adopts the fresh page and its spilled
+        copy is freed (promotion by recompute — the natural warm-up path
+        after a CRC drop or a failed promotion). Returns the number of
+        pages adopted."""
         adopted = 0
         children, parent = self._roots, None
         for key, pid in zip(self._windows(tokens), pages):
             node = children.get(key)
             if node is None:
                 node = _Node(key=key, page=pid, parent=parent,
-                             last_used=now)
+                             last_used=self._tick())
                 children[key] = node
                 self.pool.retain(pid)
+                if self.owner is not None:
+                    self.owner._note_tier(None, TIER_HBM)
                 self._n_nodes += 1
                 adopted += 1
             else:
-                node.last_used = now
+                node.last_used = self._tick()
+                if node.tier != TIER_HBM and self.owner is not None:
+                    self.owner._readopt(node, pid)
             children, parent = node.children, node
         return adopted
 
@@ -202,11 +585,18 @@ class PrefixTree:
     def evict_lru(self, n_pages: int) -> int:
         """Drop up to ``n_pages`` least-recently-used leaf pages that no
         live slot maps (pool refcount 1 = tree-only). Walks repeatedly so
-        an interior chain unwinds leaf by leaf. Returns pages freed."""
+        an interior chain unwinds leaf by leaf. Returns pages freed.
+        Spilled (host/disk) leaves hold no pool page and are skipped —
+        with tiering on, HBM pressure goes through PagedAllocator's
+        write-behind demotion instead. The per-touch ticks of match/
+        insert make the ``min`` a strict LRU: no two nodes share a
+        ``last_used``, so eviction order is a pure function of the touch
+        history (pinned by tests/test_paging.py)."""
         freed = 0
         while freed < n_pages:
             victims = [n for n in self._leaves()
-                       if self.pool.refcount(n.page) == 1]
+                       if n.tier == TIER_HBM and not n.pending
+                       and self.pool.refcount(n.page) == 1]
             if not victims:
                 break
             node = min(victims, key=lambda n: n.last_used)
@@ -219,7 +609,10 @@ class PrefixTree:
                     else self._roots)
         del siblings[node.key]
         self._n_nodes -= 1
-        self.pool.release(node.page)
+        if self.owner is not None:
+            self.owner.release_node_storage(node)
+        else:
+            self.pool.release(node.page)
 
     def clear(self) -> int:
         """Release every tree-held page (engine shutdown / fail_all)."""
@@ -242,18 +635,57 @@ class PagedAllocator:
     """
 
     def __init__(self, n_pages: int, page_size: int,
-                 prefix_share: bool = True):
+                 prefix_share: bool = True, host_pages: int = 0,
+                 disk_dir: str | None = None, disk_bytes: int = 0):
         if page_size < 1:
             raise ValueError(f"page_size must be >= 1, got {page_size}")
         self.page_size = page_size
         self.n_pages = n_pages
         self.prefix_share = prefix_share
         self.pool = PagePool(n_pages)
-        self.tree = PrefixTree(self.pool, page_size)
+        self.tree = PrefixTree(self.pool, page_size, owner=self)
         self.prefix_hits = 0       # admissions that mapped >= 1 shared page
         self.prefix_misses = 0     # admissions that mapped none
         self.tokens_saved = 0      # prefill positions skipped via sharing
-        self.evictions = 0
+        self.evictions = 0         # tree pages DROPPED (not demoted)
+        # -- tier hierarchy (ISSUE 12) ------------------------------------
+        self.host = HostPagePool(host_pages) if host_pages > 0 else None
+        self.disk = (DiskPageStore(disk_dir, disk_bytes)
+                     if disk_dir else None)
+        self.tiered = self.host is not None or self.disk is not None
+        # tree-node population per tier, maintained incrementally at every
+        # transition; the audit recounts from the tree and flags drift
+        # ("counters consistent with the page ledger")
+        self.tier_pages = {TIER_HBM: 0, TIER_HOST: 0, TIER_DISK: 0}
+        self.demotions = {TIER_HOST: 0, TIER_DISK: 0}
+        self.promotions = {TIER_HOST: 0, TIER_DISK: 0, "reprefill": 0}
+        # prefill positions saved per SOURCE tier of the shared pages —
+        # "disk"-sourced savings are the tokens tiering rescued from the
+        # drop-on-evict recompute
+        self.tokens_saved_by_tier = {TIER_HBM: 0, TIER_HOST: 0,
+                                     TIER_DISK: 0}
+        self.crc_drops = 0  # disk pages lost to CRC damage (re-derived)
+        # device I/O, bound by the engine (bind_device_io): _fetch reads a
+        # pool page's planes to host numpy (demotion), _stage device_puts
+        # a payload (promotion; sharded under tp), _uploader stages async
+        self._fetch = None
+        self._stage = None
+        self._uploader: PageUploader | None = None
+        self.corrupt_demote = None  # chaos hook: True = drop the payload
+        self._pending: dict[int, _Node] = {}  # target pid -> node
+        self._jobs: list[_PromotionJob] = []
+        self._match_sources: list[str] = []  # last match's per-page tiers
+
+    def bind_device_io(self, fetch, stage=None, uploader=None) -> None:
+        """Attach the engine's device callbacks: ``fetch(pid)`` -> host
+        numpy planes of pool page ``pid`` (write-behind demotion reads
+        through it), ``stage(payload)`` -> device-ready arrays
+        (promotion; None = let the apply-side jit transfer raw numpy),
+        ``uploader`` a PageUploader for async staging (None = stage
+        inline at promotion time)."""
+        self._fetch = fetch
+        self._stage = stage
+        self._uploader = uploader
 
     @property
     def n_free(self) -> int:
@@ -266,7 +698,10 @@ class PagedAllocator:
     def alloc_page(self) -> int | None:
         pid = self.pool.alloc()
         if pid is None and len(self.tree):
-            self.evictions += self.tree.evict_lru(1)
+            if self.tiered and self._fetch is not None:
+                self.demote_cold(1)
+            else:
+                self.evictions += self.tree.evict_lru(1)
             pid = self.pool.alloc()
         return pid
 
@@ -275,21 +710,322 @@ class PagedAllocator:
         of ``tokens`` (refs retained for the caller). Counting is
         deferred to ``record_admission`` — an admission the pool cannot
         serve yet gets requeued and re-matches every retry, and counting
-        here would inflate the hit/saved figures by the retry count."""
+        here would inflate the hit/saved figures by the retry count.
+
+        With tiering, a matched node whose payload was spilled PROMOTES
+        on the way through (async: the caller sees its page id now, the
+        bytes land at a step boundary — pause until ``slot_pending`` is
+        clear); a promotion the pool cannot place (or a CRC-dead disk
+        page) stops the match at the spill boundary and the suffix
+        prefills as a plain miss."""
+        self._match_sources = []
         if not self.prefix_share:
             return []
-        return self.tree.match(tokens)
+        if not self.tiered:
+            return self.tree.match(tokens)
+        sources = self._match_sources
+
+        def promote(node):
+            src = node.tier
+            if not self._promote(node):
+                return False
+            node.promoted_from = src  # consumed by on_match below
+            return True
+
+        def on_match(node):
+            src = getattr(node, "promoted_from", None)
+            if src is not None:
+                node.promoted_from = None
+            sources.append(src or TIER_HBM)
+
+        return self.tree.match(tokens, promote=promote, on_match=on_match)
 
     def record_admission(self, n_shared_pages: int) -> None:
         """Count one STICKING admission that attempted prefix sharing —
         called by the engine after pages are secured, exactly once per
         admitted request, so hit_rate/tokens_saved match the Prometheus
-        series no matter how many dry-pool retries preceded it."""
+        series no matter how many dry-pool retries preceded it. Savings
+        attribute to each shared page's SOURCE tier at match time (the
+        host/disk rows are the prefill recomputes tiering avoided)."""
         if n_shared_pages > 0:
             self.prefix_hits += 1
             self.tokens_saved += n_shared_pages * self.page_size
+            sources = self._match_sources[:n_shared_pages]
+            for i in range(n_shared_pages):
+                src = sources[i] if i < len(sources) else TIER_HBM
+                self.tokens_saved_by_tier[src] = (
+                    self.tokens_saved_by_tier.get(src, 0) + self.page_size)
         else:
             self.prefix_misses += 1
+
+    # -- tier transitions (ISSUE 12) ----------------------------------------
+
+    def _note_tier(self, old: str | None, new: str | None) -> None:
+        """Incremental tier-population ledger (the audit recounts it)."""
+        if old is not None:
+            self.tier_pages[old] -= 1
+        if new is not None:
+            self.tier_pages[new] += 1
+
+    @staticmethod
+    def _chain_ids(node: _Node) -> set:
+        """id()s of ``node`` and every ancestor — the PROTECT set: while
+        a node is mid-demotion or mid-promotion, neither it nor any
+        ancestor may be dropped by a lower tier's pressure eviction (a
+        dropped ancestor takes its whole subtree — including the node
+        whose transition is in flight — with it)."""
+        out = set()
+        while node is not None:
+            out.add(id(node))
+            node = node.parent
+        return out
+
+    def demote_cold(self, n_pages: int, protect=frozenset()) -> int:
+        """Write-behind demotion: move up to ``n_pages`` coldest tree-only
+        HBM pages (pool refcount 1, not promotion-pending) down a tier —
+        payload fetched from the device pool, stored host-first (host
+        pressure spills host-LRU to disk first), HBM page released. A
+        payload no lower tier can take DROPS (legacy eviction, with its
+        now-unreachable subtree). Returns HBM pages freed."""
+        if self._fetch is None:
+            # no device reader bound (pure-host harnesses): fall back to
+            # plain LRU eviction — the legacy drop path
+            freed = self.tree.evict_lru(n_pages)
+            self.evictions += freed
+            return freed
+        freed = 0
+        while freed < n_pages:
+            victims = [nd for nd in self.tree.nodes()
+                       if nd.tier == TIER_HBM and not nd.pending
+                       and self.pool.refcount(nd.page) == 1
+                       and id(nd) not in protect]
+            if not victims:
+                break
+            node = min(victims, key=lambda nd: nd.last_used)
+            pid = node.page
+            if self.corrupt_demote is not None and self.corrupt_demote():
+                # seeded chaos mutation (drop_on_demote): the page leaves
+                # HBM but its payload is never stored — the three-tier
+                # audit must flag the host node with no live copy
+                self._note_tier(TIER_HBM, TIER_HOST)
+                node.tier, node.page, node.host_id = TIER_HOST, -1, -1
+                self.pool.release(pid)
+                freed += 1
+                continue
+            payload = self._fetch(pid)
+            dest = self._store_down(node, payload,
+                                    protect | self._chain_ids(node))
+            if dest is None:
+                self._drop_subtree(node)
+            else:
+                self._note_tier(TIER_HBM, dest)
+                node.tier, node.page = dest, -1
+                self.pool.release(pid)
+                self.demotions[dest] += 1
+            freed += 1
+        return freed
+
+    def _store_down(self, node: _Node, payload,
+                    protect=frozenset()) -> str | None:
+        """Place a demoted payload: host pool first (spilling the host
+        LRU to disk under pressure), disk second. Returns the tier it
+        landed in (node.host_id/disk_ref set), or None (nowhere — the
+        caller drops the page). ``protect`` shields the in-flight node's
+        ancestor chain from pressure drops."""
+        if self.host is not None:
+            hid = self.host.store(payload)
+            if hid is None and self._spill_host(1, protect):
+                hid = self.host.store(payload)
+            if hid is not None:
+                node.host_id = hid
+                return TIER_HOST
+        if self.disk is not None:
+            ref = self._disk_store(payload, protect)
+            if ref is not None:
+                node.disk_ref = ref
+                return TIER_DISK
+        return None
+
+    def _spill_host(self, n: int, protect=frozenset()) -> bool:
+        """Host-budget pressure: move the LRU host-tier payloads to disk
+        (write-behind, tier 2 → tier 3). Without a disk tier — or with a
+        full one — the LRU host page DROPS (bottom-of-hierarchy eviction,
+        subtree and all). True if any host slot was freed."""
+        spilled = 0
+        while spilled < n:
+            cands = [nd for nd in self.tree.nodes()
+                     if nd.tier == TIER_HOST and self.host.live(nd.host_id)
+                     and id(nd) not in protect]
+            if not cands:
+                return spilled > 0
+            node = min(cands, key=lambda nd: nd.last_used)
+            payload = self.host.free(node.host_id)
+            node.host_id = -1
+            ref = self._disk_store(payload,
+                                   protect | self._chain_ids(node))
+            if ref is None:
+                self._drop_subtree(node)
+            else:
+                self._note_tier(TIER_HOST, TIER_DISK)
+                node.tier, node.disk_ref = TIER_DISK, ref
+                self.demotions[TIER_DISK] += 1
+            spilled += 1
+        return True
+
+    def _disk_store(self, payload, protect=frozenset()):
+        """Append to the disk tier, evicting LRU disk pages when the
+        live-byte budget is tight. None = no disk tier / nothing left to
+        evict."""
+        if self.disk is None:
+            return None
+        while True:
+            ref = self.disk.store(payload)
+            if ref is not None:
+                return ref
+            cands = [nd for nd in self.tree.nodes()
+                     if nd.tier == TIER_DISK and id(nd) not in protect]
+            if not cands:
+                return None
+            self._drop_subtree(min(cands, key=lambda nd: nd.last_used))
+
+    def _drop_subtree(self, node: _Node) -> None:
+        """Drop ``node`` and every descendant (children are unreachable
+        without their prefix chain), releasing each one's tier storage.
+        Post-order so parent dicts stay consistent."""
+        for child in list(node.children.values()):
+            self._drop_subtree(child)
+        self.evictions += 1
+        self.tree._drop(node)
+
+    def _promote(self, node: _Node) -> bool:
+        """Raise a spilled node back to HBM: allocate the target page
+        (demoting colder pages if the pool is dry), load the payload
+        (disk reads CRC-verify), and queue the async upload. False =
+        could not promote (pool truly dry, or CRC-dead disk page — the
+        node and its subtree are dropped and the caller's match stops at
+        the spill boundary; prefill re-derives)."""
+        src = node.tier
+        pid = self.pool.alloc()
+        if pid is None:
+            # colder pages make room — with the promoting node's chain
+            # protected, or the pressure path could drop it mid-flight
+            self.demote_cold(1, protect=self._chain_ids(node))
+            pid = self.pool.alloc()
+        if pid is None:
+            return False
+        if src == TIER_HOST:
+            if self.host is None or not self.host.live(node.host_id):
+                self.pool.release(pid)
+                raise RuntimeError(
+                    f"kv tiering: host tier has no payload for node "
+                    f"(host_id={node.host_id}) — a demotion dropped its "
+                    f"bytes; the page ledger is corrupt")
+            payload = self.host.free(node.host_id)
+            node.host_id = -1
+        else:
+            payload = self.disk.load(node.disk_ref) if self.disk else None
+            if payload is None:
+                # CRC damage (or a lost store): this prefix chain is
+                # gone — drop it and let prefill re-derive on the miss
+                self.pool.release(pid)
+                if self.disk is not None and self.disk.live(node.disk_ref):
+                    self.disk.free(node.disk_ref)
+                node.disk_ref = None
+                self.crc_drops += 1
+                self._drop_subtree(node)
+                return False
+            self.disk.free(node.disk_ref)
+            node.disk_ref = None
+        self._note_tier(src, TIER_HBM)
+        node.tier, node.page, node.pending = TIER_HBM, pid, True
+        self.promotions[src] += 1
+        self._pending[pid] = node
+        job = _PromotionJob(node=node, page=pid, payload=payload)
+        self._jobs.append(job)
+        if self._uploader is not None:
+            self._uploader.submit(job)
+        else:
+            job.staged = (self._stage(payload) if self._stage is not None
+                          else payload)
+        return True
+
+    def _readopt(self, node: _Node, pid: int) -> None:
+        """insert() found a spilled node whose content the inserting
+        request just re-prefilled into fresh HBM pages: adopt the fresh
+        page and free the spilled copy (promotion by recompute)."""
+        self._note_tier(node.tier, TIER_HBM)
+        if node.tier == TIER_HOST and self.host is not None \
+                and self.host.live(node.host_id):
+            self.host.free(node.host_id)
+        elif node.tier == TIER_DISK and self.disk is not None \
+                and self.disk.live(node.disk_ref):
+            self.disk.free(node.disk_ref)
+        node.tier, node.host_id, node.disk_ref = TIER_HBM, -1, None
+        node.page = pid
+        self.pool.retain(pid)
+        self.promotions["reprefill"] += 1
+
+    def release_node_storage(self, node: _Node) -> None:
+        """Tree-drop hook (PrefixTree._drop): release whatever tier owns
+        this node's payload. A promotion-pending node cancels its
+        in-flight job (the engine discards dead jobs at the next drain)."""
+        self._note_tier(node.tier, None)
+        if node.tier == TIER_HBM:
+            if node.pending:
+                node.pending = False
+                if self._pending.get(node.page) is node:
+                    del self._pending[node.page]
+            self.pool.release(node.page)
+        elif node.tier == TIER_HOST:
+            if self.host is not None and self.host.live(node.host_id):
+                self.host.free(node.host_id)
+        elif node.tier == TIER_DISK:
+            if self.disk is not None and self.disk.live(node.disk_ref):
+                self.disk.free(node.disk_ref)
+
+    def take_staged_promotions(self) -> list[_PromotionJob]:
+        """Promotion jobs whose payloads are device-ready — the engine
+        applies them to the pool cache at a step boundary, then calls
+        ``promotion_applied``. Jobs whose node was dropped (or whose
+        target page was re-issued) in the meantime are dead and
+        discarded; still-uploading jobs stay queued."""
+        ready, rest = [], []
+        for job in self._jobs:
+            if (self._pending.get(job.page) is not job.node
+                    or not job.node.pending):
+                continue  # cancelled: node dropped / storage released
+            if job.staged is None:
+                rest.append(job)  # uploader still staging
+                continue
+            ready.append(job)
+        self._jobs = rest
+        return ready
+
+    def promotion_applied(self, job: _PromotionJob) -> None:
+        """The engine wrote ``job.staged`` into pool page ``job.page`` —
+        the node's payload is live in HBM; waiting slots may dispatch."""
+        job.node.pending = False
+        if self._pending.get(job.page) is job.node:
+            del self._pending[job.page]
+
+    def is_pending(self, pid: int) -> bool:
+        return pid in self._pending
+
+    def slot_pending(self, pages) -> bool:
+        """True while any of a slot's pages awaits its promotion upload —
+        the engine PAUSEs the slot (pages-starved semantics) until the
+        payload lands; dispatching earlier would attend over junk."""
+        if not self._pending:
+            return False
+        return any(p in self._pending for p in pages)
+
+    def tier_page_counts(self) -> dict:
+        """Tree-node population per tier, recounted from the tree (the
+        audit's ground truth; ``tier_pages`` is the incremental twin)."""
+        counts = {TIER_HBM: 0, TIER_HOST: 0, TIER_DISK: 0}
+        for nd in self.tree.nodes():
+            counts[nd.tier] = counts.get(nd.tier, 0) + 1
+        return counts
 
     def insert_prefix(self, tokens, pages) -> int:
         """Retire hook: publish a request's full prompt pages for reuse."""
@@ -321,9 +1057,13 @@ class PagedAllocator:
         for pages in slot_page_lists:
             for pid in pages:
                 expected[pid] = expected.get(pid, 0) + 1
-        tree_pages = [n.page for n in self.tree.nodes()]
+        # only HBM-tier nodes hold a pool ref; spilled nodes own a host/
+        # disk copy instead (verified below)
+        tree_pages = [n.page for n in self.tree.nodes()
+                      if n.tier == TIER_HBM]
         for pid in tree_pages:
             expected[pid] = expected.get(pid, 0) + 1
+        problems += self._audit_tiers()
         refs = self.pool.refcounts()
         for pid, want in sorted(expected.items()):
             if pid == SCRAP_PAGE:
@@ -353,12 +1093,86 @@ class PagedAllocator:
                 f"allocated != {self.n_pages} pages")
         return problems
 
+    def _audit_tiers(self) -> list[str]:
+        """The tier half of the invariant audit: every spilled node owns
+        exactly one live host/disk copy, no copy is shared or orphaned,
+        host-pool accounting closes, live disk records verify against
+        their read-back CRC sidecars, promotion-pending nodes have
+        in-flight jobs, and the incremental tier ledger matches a fresh
+        recount — a page is owned by EXACTLY one tier."""
+        if not self.tiered:
+            return []
+        problems: list[str] = []
+        host_owner: dict[int, _Node] = {}
+        disk_owner: dict[tuple, _Node] = {}
+        for nd in self.tree.nodes():
+            where = f"node {nd.key!r}"
+            if nd.tier == TIER_HBM:
+                if nd.host_id != -1 or nd.disk_ref is not None:
+                    problems.append(f"tier audit: hbm {where} still "
+                                    f"holds a host/disk copy (two-tier "
+                                    f"ownership)")
+                if nd.pending and nd.page not in self._pending:
+                    problems.append(f"tier audit: {where} is promotion-"
+                                    f"pending with no in-flight job")
+            elif nd.tier == TIER_HOST:
+                if self.host is None or not self.host.live(nd.host_id):
+                    problems.append(f"tier audit: host {where} has no "
+                                    f"live host-pool copy (payload "
+                                    f"dropped on demote?)")
+                elif nd.host_id in host_owner:
+                    problems.append(f"tier audit: host page "
+                                    f"{nd.host_id} owned by two nodes")
+                else:
+                    host_owner[nd.host_id] = nd
+                if nd.page != -1 or nd.disk_ref is not None:
+                    problems.append(f"tier audit: host {where} also "
+                                    f"claims an hbm/disk copy")
+            elif nd.tier == TIER_DISK:
+                if self.disk is None or not self.disk.live(nd.disk_ref):
+                    problems.append(f"tier audit: disk {where} has no "
+                                    f"live disk record")
+                elif (nd.disk_ref[0], nd.disk_ref[1]) in disk_owner:
+                    problems.append(f"tier audit: disk record "
+                                    f"{nd.disk_ref[:2]} owned by two "
+                                    f"nodes")
+                else:
+                    disk_owner[(nd.disk_ref[0], nd.disk_ref[1])] = nd
+                if nd.page != -1 or nd.host_id != -1:
+                    problems.append(f"tier audit: disk {where} also "
+                                    f"claims an hbm/host copy")
+            else:
+                problems.append(f"tier audit: {where} has unknown tier "
+                                f"{nd.tier!r}")
+        if self.host is not None:
+            problems += self.host.audit()
+            for hid in self.host.live_ids():
+                if hid not in host_owner:
+                    problems.append(f"tier audit: host page {hid} leaked "
+                                    f"(live but no node owns it)")
+        if self.disk is not None:
+            problems += self.disk.audit()  # CRC read-back of live records
+            for ref_key in self.disk.live_refs():
+                if ref_key not in disk_owner:
+                    problems.append(f"tier audit: disk record {ref_key} "
+                                    f"leaked (live but no node owns it)")
+        counts = self.tier_page_counts()
+        if counts != self.tier_pages:
+            problems.append(f"tier audit: incremental tier ledger "
+                            f"{self.tier_pages} != recount {counts}")
+        return problems
+
     def reset_counters(self) -> None:
         """Zero the admission counters WITHOUT touching pool/tree state —
         the bench's warm-up/timed-pass boundary: the timed pass then
         reports the warm-tree steady state alone, not a blend."""
         self.prefix_hits = self.prefix_misses = 0
         self.tokens_saved = self.evictions = 0
+        self.demotions = {TIER_HOST: 0, TIER_DISK: 0}
+        self.promotions = {TIER_HOST: 0, TIER_DISK: 0, "reprefill": 0}
+        self.tokens_saved_by_tier = {TIER_HBM: 0, TIER_HOST: 0,
+                                     TIER_DISK: 0}
+        self.crc_drops = 0
 
     @property
     def hit_rate(self) -> float:

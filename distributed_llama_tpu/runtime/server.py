@@ -83,7 +83,9 @@ class InferenceServer:
                  registry=None, page_size: int = 0, kv_pages: int = 0,
                  spec_k: int = 0, spec_ngram: int = 3, slo=None,
                  chaos=None, journal=None, watchdog_s: float = 0.0,
-                 drain_s: float = 10.0, kv_quant: str = "f32"):
+                 drain_s: float = 10.0, kv_quant: str = "f32",
+                 kv_host_pages: int = 0, kv_disk_dir: str | None = None,
+                 kv_disk_bytes: int = 0):
         self.spec = spec
         self.tokenizer = tokenizer
         self.default_steps = steps
@@ -127,7 +129,10 @@ class InferenceServer:
                                        spec_ngram=spec_ngram, slo=slo,
                                        chaos=chaos, journal=journal,
                                        watchdog=self._watchdog,
-                                       kv_quant=kv_quant)
+                                       kv_quant=kv_quant,
+                                       kv_host_pages=kv_host_pages,
+                                       kv_disk_dir=kv_disk_dir,
+                                       kv_disk_bytes=kv_disk_bytes)
         # replay the previous life's unfinished requests BEFORE the
         # listener opens: recovered work re-queues first, so a restarted
         # server continues exactly where the crash cut it off
@@ -219,6 +224,26 @@ class InferenceServer:
                         "prefill_tokens_saved": a.tokens_saved,
                         "evictions": a.evictions,
                     }
+                    if a.tiered:
+                        # KV-tier hierarchy surface (ISSUE 12): per-tier
+                        # page population + promotion/demotion flow +
+                        # the prefill tokens the spilled tiers rescued —
+                        # the dllama_kv_tier_pages/... series' JSON twin
+                        counts = a.tier_page_counts()
+                        payload["kv_tiers"] = {
+                            "pages": counts,
+                            "host_capacity": (a.host.n_pages
+                                              if a.host else 0),
+                            "disk_live_bytes": (a.disk.live_bytes
+                                                if a.disk else 0),
+                            "disk_budget_bytes": (a.disk.budget_bytes
+                                                  if a.disk else 0),
+                            "demotions": dict(a.demotions),
+                            "promotions": dict(a.promotions),
+                            "prefill_tokens_saved_by_tier":
+                                dict(a.tokens_saved_by_tier),
+                            "crc_drops": a.crc_drops,
+                        }
                 if server.journal is not None:
                     # recovery bookkeeping: requests replayed from the
                     # journal at startup + append volume since
@@ -634,6 +659,7 @@ class InferenceServer:
         for t in list(self._streams):
             t.join(timeout=5)
         self.httpd.server_close()
+        self.engine.close()  # KV-tier uploader thread (no-op untiered)
         if self._watchdog is not None:
             self._watchdog.close()
         if self.journal is not None:

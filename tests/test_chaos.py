@@ -172,8 +172,8 @@ def test_all_drills_pass_on_healthy_engine(make_engine):
     assert [name for name, _ in DRILLS] == [
         "pool_exhaustion", "transient_starvation", "oversized_prompt",
         "disconnect", "latency_spike", "profiler_under_load",
-        "journal_wal", "kill_mid_decode", "hung_dispatch",
-        "weight_stream_disconnect"]
+        "tier_spill_storm", "journal_wal", "kill_mid_decode",
+        "hung_dispatch", "weight_stream_disconnect"]
     # kill_mid_decode spawns a jax subprocess — its own slow-marked test
     # below; everything else runs here
     which = {name for name, _ in DRILLS} - {"kill_mid_decode"}
@@ -189,6 +189,10 @@ def test_all_drills_pass_on_healthy_engine(make_engine):
     assert by_name["disconnect"].details["pages_at_risk"] > 0
     assert by_name["hung_dispatch"].details["trips"] > 0
     assert by_name["weight_stream_disconnect"].details["drops"] > 0
+    storm = by_name["tier_spill_storm"].details
+    assert storm["prefill_saved_spilled"] > 0
+    assert sum(storm["demotions"].values()) > 0
+    assert sum(storm["promotions"].values()) > 0
 
 
 def test_kill_mid_decode_drill_recovers_bitwise(make_engine):

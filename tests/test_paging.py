@@ -110,6 +110,38 @@ def test_tree_two_level_match_and_recency_eviction():
     pool.release(p_other[0])
 
 
+def test_eviction_order_is_strict_lru_per_touch_ticks():
+    """ISSUE 12 satellite: LRU ordering is EXPLICIT — every node touch
+    takes its own monotonic tick (no wall clock, no shared walk
+    timestamp), so eviction among equal-refcount leaves is a strict
+    total order determined by touch history alone, even for leaves
+    published in the SAME insert batch."""
+    pool, tree = _tree(n_pages=8, ps=2)
+    pages = {}
+    for toks in ([1, 1], [2, 2], [3, 3]):
+        p = [pool.alloc()]
+        tree.insert(toks, p)
+        pool.release(p[0])
+        pages[toks[0]] = p[0]
+    # refresh in the order 2, 1: LRU is now 3 < 2 < 1
+    for t in (2, 1):
+        got = tree.match([t, t])
+        pool.release(got[0])
+    evicted = []
+    for _ in range(3):
+        assert tree.evict_lru(1) == 1
+        for t, p in pages.items():
+            if pool.refcount(p) == 0 and t not in evicted:
+                evicted.append(t)
+    assert evicted == [3, 2, 1]
+    # ... and ticks are strictly per-node: one insert's nodes never tie
+    pool2, tree2 = _tree(n_pages=8, ps=2)
+    ps2 = [pool2.alloc(), pool2.alloc()]
+    tree2.insert([5, 5, 6, 6], ps2)
+    ticks = sorted(n.last_used for n in tree2.nodes())
+    assert ticks[0] != ticks[1]
+
+
 def test_tree_interior_nodes_not_evicted_under_live_children():
     pool, tree = _tree()
     toks = [1, 2, 3, 4, 5, 6, 7, 8]

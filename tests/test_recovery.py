@@ -360,3 +360,96 @@ def test_compaction_preserves_recorded_config(params, tmp_path):
     j2 = RequestJournal(path)
     assert j2.header_config == _fingerprint()
     j2.close()
+
+
+# --------------------------------------------- KV tiering interop (ISSUE 12)
+
+
+def test_recovery_promotes_disk_resident_prefix_bitwise(params, tmp_path):
+    """A recovered request whose shared prefix pages sit on DISK promotes
+    them through the same async path as live admissions — and the
+    continued stream is still bitwise the uninterrupted run's. Sequence:
+    serve + publish the prefix, spill it to disk, crash mid-decode,
+    recover into the SAME engine state (tree with disk-tier nodes) —
+    recovery's forced-token replay admission must hit the spilled
+    prefix, promote it, and converge on the reference."""
+    from distributed_llama_tpu.runtime.paging import TIER_DISK
+
+    prefix = [1, 9, 17, 25, 2, 4, 6, 8]  # two full pages at ps=4
+    tiered = dict(kv_pages=8, kv_disk_dir=str(tmp_path / "kv"))
+
+    # reference: the uninterrupted run (all-HBM — tiering is invisible)
+    ref_eng = _make(params, kv_pages=24)
+    ref_req = Request(tokens=list(prefix) + [3], steps=24,
+                      temperature=0.9, topp=0.9, seed=502)
+    ref_eng.submit(ref_req)
+    _drain(ref_eng)
+
+    path = str(tmp_path / "j.journal")
+    journal = RequestJournal(path)
+    eng = _make(params, journal=journal, **tiered)
+    # publish the prefix via a first request, then spill it to disk
+    warm = Request(tokens=list(prefix) + [7], steps=24, temperature=0.0,
+                   topp=0.9, seed=501)
+    eng.submit(warm)
+    _drain(eng)
+    assert eng.allocator.demote_cold(2) == 2
+    assert eng.allocator.tier_page_counts()[TIER_DISK] > 0
+    # now the request that will crash mid-decode
+    victim = Request(tokens=list(prefix) + [3], steps=24,
+                     temperature=0.9, topp=0.9, seed=502)
+    eng.submit(victim)
+    for _ in range(6):
+        eng.step_many(eng.block_steps, quiet=True)
+    assert not victim.done.is_set() and victim.n_sampled >= 2
+    # simulated SIGKILL: abandon the engine; only the journal survives.
+    # The fresh process re-publishes the prefix (a sibling request),
+    # spills it to disk again, THEN recovers — the recovered admission
+    # must promote from disk.
+    j2 = RequestJournal(path)
+    eng2 = _make(params, journal=j2, **tiered)
+    warm2 = Request(tokens=list(prefix) + [7], steps=24, temperature=0.0,
+                    topp=0.9, seed=501)
+    eng2.submit(warm2)
+    _drain(eng2)
+    assert eng2.allocator.demote_cold(2) == 2
+    assert eng2.allocator.tier_page_counts()[TIER_DISK] > 0
+    assert eng2.recover() == 1
+    with eng2._lock:
+        (rec,) = list(eng2._queue)
+    _drain(eng2)
+    assert rec.out == ref_req.out  # bitwise through the disk promotion
+    assert eng2.allocator.promotions[TIER_DISK] > 0
+    assert eng2.audit_pages() == []
+
+
+def test_fingerprint_kv_tiers_keys_omitted_when_off(params, tmp_path):
+    """ISSUE 12 satellite: the kv_tiers fingerprint keys are omitted when
+    tiering is off — legacy journals keep recovering — and a tier-budget
+    change under live work refuses with the key named."""
+    from distributed_llama_tpu.runtime.journal import (
+        JournalConfigMismatch, config_fingerprint)
+
+    base = _fingerprint()
+    assert "kv_host_pages" not in base and "kv_disk" not in base
+
+    def tiered_cfg(host_pages):
+        return config_fingerprint(SPEC, "single", "explicit:11",
+                                  weights_digest="abcd1234deadbeef",
+                                  kv_host_pages=host_pages, kv_disk=True)
+
+    path = str(tmp_path / "j")
+    j = RequestJournal(path, config=tiered_cfg(64))
+    eng = _make(params, journal=j)
+    eng.submit(_reqs()[0])
+    eng.step_many(1, quiet=True)
+    # restart with a different host budget: refuse, naming the key
+    j2 = RequestJournal(path, config=tiered_cfg(128))
+    eng2 = _make(params, journal=j2)
+    with pytest.raises(JournalConfigMismatch, match="kv_host_pages"):
+        eng2.recover()
+    # restart under untiered serving: kv keys absent on one side -> named
+    j3 = RequestJournal(path, config=_fingerprint())
+    eng3 = _make(params, journal=j3)
+    with pytest.raises(JournalConfigMismatch, match="kv_disk"):
+        eng3.recover()

@@ -467,3 +467,38 @@ def test_server_health_and_errors(server):
         except urllib.error.HTTPError as e:
             assert e.code == 400
             assert msg in json.loads(e.read())["error"]
+
+
+def test_health_kv_tiers_block(params, tmp_path):
+    """ISSUE 12: a tiered server surfaces the tier hierarchy in /health
+    — per-tier page counts, promotion/demotion flow, and the
+    prefill-savings-by-tier attribution (the metrics series' JSON twin);
+    untiered servers omit the block."""
+    from distributed_llama_tpu.runtime.server import InferenceServer
+
+    srv = InferenceServer(SPEC, params, _IdTokenizer(), "127.0.0.1", 0,
+                          slots=2, steps=8, temperature=0.0, topp=0.9,
+                          seed=5, quiet=True, page_size=4, kv_pages=8,
+                          kv_host_pages=4,
+                          kv_disk_dir=str(tmp_path / "kv"))
+    srv.start()
+    try:
+        _post(srv.port, {"prompt": "hello tier"})
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/health", timeout=30) as r:
+            payload = json.loads(r.read())
+        tiers = payload["kv_tiers"]
+        assert set(tiers["pages"]) == {"hbm", "host", "disk"}
+        assert tiers["host_capacity"] == 4
+        assert "promotions" in tiers and "demotions" in tiers
+        assert set(tiers["prefill_tokens_saved_by_tier"]) == {
+            "hbm", "host", "disk"}
+    finally:
+        srv.stop()
+
+
+def test_health_omits_kv_tiers_when_untiered(server):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/health", timeout=30) as r:
+        payload = json.loads(r.read())
+    assert "kv_tiers" not in payload

@@ -116,6 +116,32 @@ if [ "$overlap_rc" -ne 1 ]; then
          "(exit $overlap_rc, expected 1)" >&2
     exit 1
 fi
+# KV-tiering gate (ISSUE 12): the continuous_bench tiering section on the
+# CPU smoke model — prefix-hit prefill savings at a working set 10x the
+# HBM page pool must hold within 20% of the all-HBM ceiling through the
+# HBM->host->disk spill/promote churn (drop-on-evict baseline near zero),
+# streams identical, three-tier audit clean (assertions inside the
+# section); the row is archived next to the other artifacts
+mkdir -p tools/ci_artifacts
+python tools/continuous_bench.py --small --steps 12 --requests 3 \
+    --block-steps 4 --no-paged-compare --no-spec-compare \
+    --no-kv-quant-compare > tools/ci_artifacts/tiering_bench.json
+# ... and the spill-storm chaos drill must pass healthy AND its seeded
+# mutation must fail: with drop-on-demote armed (every write-behind
+# demotion discards its payload) the drill must exit 1 EXACTLY — 2 is a
+# usage error and would pass a naive non-zero check vacuously
+python tools/loadcheck.py --drills-only --drills tier_spill_storm \
+    --json > /dev/null
+set +e
+python tools/loadcheck.py --drills-only --drills tier_spill_storm \
+    --inject drop-on-demote --json > /dev/null 2>&1
+tier_rc=$?
+set -e
+if [ "$tier_rc" -ne 1 ]; then
+    echo "ci: loadcheck did not flag the dropped tier demotion" \
+         "(exit $tier_rc, expected 1)" >&2
+    exit 1
+fi
 # SLO observatory gate (ISSUE 8) + crash-safety recovery gate (ISSUE 9):
 # a small deterministic loadcheck run — the virtual-clock offered-load
 # sweep held to the checked-in CPU goodput band

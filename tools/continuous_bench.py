@@ -262,6 +262,117 @@ def spec_compare(spec, params, args, dtype) -> dict:
     return row
 
 
+def tiering_compare(spec, params, args, dtype) -> dict:
+    """The KV-tiering section (ISSUE 12): prefix-hit prefill savings at a
+    working set ~10x the HBM page pool, three arms over the SAME
+    two-pass workload (pass 1 publishes N distinct shared prefixes, pass
+    2 revisits every one — counters are step-based and deterministic,
+    the virtual-clock property the CI gate needs):
+
+    * all-HBM — pool holds the whole working set (the savings ceiling);
+    * tiered  — HBM pool ~1/10 of the working set + host pool + disk
+      segments: cold prefixes demote write-behind, pass-2 hits promote
+      them back (async upload + admission PAUSE);
+    * drop    — the same tiny pool with drop-on-evict (pre-ISSUE-12
+      behavior): pass 2 recomputes everything.
+
+    The acceptance gate asserts IN the section: tiered pass-2 savings
+    within 20% of all-HBM, drop-arm savings below half the ceiling,
+    streams identical across arms, the three-tier audit green, and the
+    promotion/demotion counters consistent with the page ledger."""
+    import tempfile
+
+    from distributed_llama_tpu.analysis.memory_model import kv_tier_model
+    from distributed_llama_tpu.runtime.continuous import ContinuousEngine
+
+    ps = args.page_size
+    n_prefix = args.tiering_prefixes
+    prefix_pages = 2
+    working_set = n_prefix * prefix_pages
+    hbm_pages = max(8, working_set // 10)     # >= 10x oversubscription
+    host_pages = max(4, working_set // 2)
+    steps = (prefix_pages + 2) * ps
+
+    def wave(tail):
+        return [[1] + [(7 * i + j) % 90 + 5
+                       for j in range(prefix_pages * ps)] + [tail + i % 40]
+                for i in range(n_prefix)]
+
+    def run(label, **kw):
+        eng = ContinuousEngine(spec, params, slots=2, temperature=0.0,
+                               topp=0.9, seed=3, cache_dtype=dtype,
+                               prefill_chunk=ps, page_size=ps, **kw)
+        o1, _ = eng.run(wave(3), steps=steps)     # pass 1: publish
+        eng.allocator.reset_counters()
+        o2, st = eng.run(wave(9), steps=steps)    # pass 2: revisit
+        a = eng.allocator
+        print(f"{label}: pass-2 prefill saved {a.tokens_saved} "
+              f"(by tier {a.tokens_saved_by_tier}), "
+              f"{sum(a.demotions.values())} demotions, "
+              f"{sum(a.promotions.values())} promotions, "
+              f"{st.pauses} pauses", file=sys.stderr)
+        eng.close()  # the tiered arm's uploader thread
+        return eng, (o1, o2), a
+
+    _, outs_full, a_full = run(
+        f"tier all-hbm pool={working_set + 8}x{ps}",
+        kv_pages=working_set + 8)
+    disk_dir = tempfile.mkdtemp(prefix="dllama-bench-tier-")
+    eng_t, outs_t, a_t = run(
+        f"tier 3-tier  pool={hbm_pages}x{ps} host={host_pages} disk",
+        kv_pages=hbm_pages, kv_host_pages=host_pages,
+        kv_disk_dir=disk_dir)
+    _, outs_d, a_d = run(f"tier drop     pool={hbm_pages}x{ps}",
+                         kv_pages=hbm_pages)
+
+    # the acceptance gates (ISSUE 12) — assert, don't just report
+    assert outs_t == outs_full and outs_d == outs_full, \
+        "tiering changed a token stream?!"
+    ceiling = a_full.tokens_saved
+    assert ceiling > 0, "all-HBM arm saved nothing — workload broken"
+    assert a_t.tokens_saved >= 0.8 * ceiling, \
+        (f"tiered savings {a_t.tokens_saved} fell below 80% of the "
+         f"all-HBM ceiling {ceiling}")
+    assert a_d.tokens_saved <= 0.5 * ceiling, \
+        (f"drop-on-evict baseline saved {a_d.tokens_saved} of {ceiling} "
+         f"— the working set no longer exceeds the pool; enlarge it")
+    audit = eng_t.audit_pages()
+    assert audit == [], f"three-tier audit violations: {audit}"
+    # counters vs ledger: every promotion/demotion pairs with tier
+    # population movement the recount can see (audit already cross-
+    # checked the incremental ledger against the tree)
+    assert sum(a_t.promotions.values()) > 0 and \
+        sum(a_t.demotions.values()) > 0, "no tier churn at 10x HBM?!"
+    spilled_saved = (a_t.tokens_saved_by_tier["host"]
+                     + a_t.tokens_saved_by_tier["disk"])
+    model = kv_tier_model(spec, 1, hbm_pages, host_pages=host_pages,
+                          page_size=ps,
+                          cache_itemsize=2 if dtype is not None else 4)
+    row = {
+        "page_size": ps, "working_set_pages": working_set,
+        "hbm_pages": hbm_pages, "host_pages": host_pages,
+        "oversubscription": round(working_set / hbm_pages, 2),
+        "prefill_saved_ceiling": ceiling,
+        "prefill_saved_tiered": a_t.tokens_saved,
+        "prefill_saved_drop_baseline": a_d.tokens_saved,
+        "savings_vs_ceiling": round(a_t.tokens_saved / ceiling, 4),
+        "saved_by_tier": dict(a_t.tokens_saved_by_tier),
+        "demotions": dict(a_t.demotions),
+        "promotions": dict(a_t.promotions),
+        "crc_drops": a_t.crc_drops,
+        "audit_clean": True, "streams_identical": True,
+        "modeled": {k: model[k] for k in
+                    ("page_bytes", "promote_host_ms_per_page",
+                     "promote_disk_ms_per_page", "demote_ms_per_page")},
+    }
+    print(f"tiering at {row['oversubscription']:.0f}x HBM working set: "
+          f"prefill saved {a_t.tokens_saved}/{ceiling} "
+          f"({row['savings_vs_ceiling']:.0%} of all-HBM; drop baseline "
+          f"{a_d.tokens_saved}), {spilled_saved} tokens rescued from "
+          f"spilled tiers, audit clean", file=sys.stderr)
+    return row
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--slots", type=int, default=4)
@@ -293,6 +404,17 @@ def main():
                          "the f32 arm's KV HBM buys at the Q80 byte "
                          "rate — sustained-concurrency and tokens/s "
                          "columns, greedy streams asserted deterministic")
+    ap.add_argument("--tiering-compare",
+                    action=argparse.BooleanOptionalAction, default=True,
+                    help="run the KV-tiering section (ISSUE 12): prefix-"
+                         "hit prefill savings at a working set ~10x the "
+                         "HBM page pool — all-HBM ceiling vs three-tier "
+                         "(HBM+host+disk) vs drop-on-evict baseline, "
+                         "streams asserted identical, three-tier audit "
+                         "asserted clean")
+    ap.add_argument("--tiering-prefixes", type=int, default=40,
+                    help="distinct shared prefixes in the tiering "
+                         "section's working set (2 full pages each)")
     ap.add_argument("--profile", default=None, metavar="DIR",
                     help="trace the timed pass and print the per-step "
                          "op-time split by kernel family (the VERDICT r3 "
@@ -380,6 +502,8 @@ def main():
     if args.kv_quant_compare:
         row["kv_quant_equal_hbm"] = kv_quant_compare(spec, params, args,
                                                      dtype)
+    if args.tiering_compare:
+        row["kv_tiering"] = tiering_compare(spec, params, args, dtype)
 
     if args.profile:
         from distributed_llama_tpu.utils.it_split import bucket_ops

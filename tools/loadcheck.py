@@ -32,14 +32,19 @@ The recovery drills (runtime/chaos.RECOVERY_DRILLS: journal_wal,
 kill_mid_decode, hung_dispatch, weight_stream_disconnect) get dedicated
 verdict columns in the JSON row (``"recovery"``), and the baseline band
 file names them in ``"recovery_drills"`` — a drill silently missing from
-a full run fails the gate, the same way a missing sweep point would.
+a full run fails the gate, the same way a missing sweep point would. The
+KV-tiering drill (runtime/chaos.TIERING_DRILLS: tier_spill_storm, ISSUE
+12) rides the same coverage contract under ``"tiering_drills"``, with its
+verdicts in the ``"tiering"`` column. ``--inject drop-on-demote`` arms
+its mutation (every write-behind demotion discards its payload): the
+spill-storm drill MUST go red — tools/ci.sh asserts exit 1 under it.
 
 Usage:
   python tools/loadcheck.py [--sweep R1,R2,...] [--requests N] [--seed N]
       [--slots N] [--page-size P] [--kv-pages N] [--spec-k K]
       [--block-steps K] [--baseline PATH] [--write-baseline]
       [--sweep-only | --drills-only] [--drills NAMES]
-      [--inject leak-on-cancel|corrupt-journal]
+      [--inject leak-on-cancel|corrupt-journal|drop-on-demote]
       [--trace-out DIR] [--json]
 """
 
@@ -87,11 +92,14 @@ def _load_spec(rate: float, args):
         seq_len=SPEC_KW["seq_len"])
 
 
-def build_engine_factory(args, inject_leak: bool = False):
+def build_engine_factory(args, inject_leak: bool = False,
+                         inject_demote_drop: bool = False):
     """A fresh-engine factory (the chaos drill contract: every drill gets
     its own engine; faults must not bleed). With ``inject_leak`` the
     factory arms leak_on_cancel on whatever monkey the drill brings —
-    the mutation the CI gate proves catchable."""
+    the mutation the CI gate proves catchable; ``inject_demote_drop``
+    arms the KV-tiering twin (drop_on_demote — the spill-storm drill's
+    three-tier audit must flag the payload that landed in no tier)."""
     from distributed_llama_tpu.models.spec import TransformerSpec
     from distributed_llama_tpu.models.synth import synth_params
     from distributed_llama_tpu.obs.metrics import Registry
@@ -102,11 +110,12 @@ def build_engine_factory(args, inject_leak: bool = False):
     params = synth_params(spec, q40=False, seed=4, scale=0.3)
 
     def make_engine(chaos=None, **overrides):
-        if inject_leak:
+        if inject_leak or inject_demote_drop:
             if chaos is None:
-                chaos = ChaosMonkey(leak_on_cancel=True)
-            else:
-                chaos.leak_on_cancel = True
+                chaos = ChaosMonkey()
+            chaos.leak_on_cancel = chaos.leak_on_cancel or inject_leak
+            chaos.drop_on_demote = (chaos.drop_on_demote
+                                    or inject_demote_drop)
         kw = dict(slots=args.slots, temperature=0.0, topp=0.9,
                   seed=args.seed, metrics=Registry(),
                   prefill_chunk=args.page_size,
@@ -152,15 +161,18 @@ def check_baseline(rows: list[dict], path: str,
     (failures, baseline_doc). ``write`` regenerates the band at +-10%
     around the measured curve instead of checking."""
     if write:
-        from distributed_llama_tpu.runtime.chaos import RECOVERY_DRILLS
+        from distributed_llama_tpu.runtime.chaos import (RECOVERY_DRILLS,
+                                                         TIERING_DRILLS)
 
         doc = {"kind": "loadcheck-baseline",
                "note": "CPU virtual-clock goodput band; regenerate with "
                        "tools/loadcheck.py --write-baseline",
-               # recovery-drill coverage contract (ISSUE 9): a full drill
-               # run must include these, or the gate fails — a renamed or
-               # dropped drill cannot silently shrink the recovery gate
+               # drill coverage contracts (ISSUE 9 recovery, ISSUE 12
+               # tiering): a full drill run must include these, or the
+               # gate fails — a renamed or dropped drill cannot silently
+               # shrink its gate
                "recovery_drills": list(RECOVERY_DRILLS),
+               "tiering_drills": list(TIERING_DRILLS),
                "points": [{"rate": r["rate"],
                            "goodput_tps": r["goodput_tps"],
                            "band": [round(r["goodput_tps"] * 0.9, 6),
@@ -229,13 +241,16 @@ def main(argv=None) -> int:
                     help="run only these drills (comma-separated names "
                          "from runtime/chaos.DRILLS)")
     ap.add_argument("--inject", default=None,
-                    choices=("leak-on-cancel", "corrupt-journal"),
+                    choices=("leak-on-cancel", "corrupt-journal",
+                             "drop-on-demote"),
                     help="arm a seeded mutation; the drill suite MUST "
                          "go red (the CI gate's self-test): "
                          "leak-on-cancel leaks a page per cancelled "
                          "release (disconnect drill), corrupt-journal "
                          "smashes a mid-file journal byte before "
-                         "recovery (kill_mid_decode drill)")
+                         "recovery (kill_mid_decode drill), "
+                         "drop-on-demote discards every KV-tier "
+                         "demotion's payload (tier_spill_storm drill)")
     ap.add_argument("--trace-out", default=None,
                     help="also save each sweep point's trace (replayable "
                          "schedule archive)")
@@ -259,11 +274,12 @@ def main(argv=None) -> int:
 
     from distributed_llama_tpu.models.spec import TransformerSpec
     from distributed_llama_tpu.runtime.chaos import DRILLS, \
-        RECOVERY_DRILLS, render_drill_table, run_drills
+        RECOVERY_DRILLS, TIERING_DRILLS, render_drill_table, run_drills
     from distributed_llama_tpu.utils.fingerprint import run_stamp
 
     make_engine = build_engine_factory(
-        args, inject_leak=args.inject == "leak-on-cancel")
+        args, inject_leak=args.inject == "leak-on-cancel",
+        inject_demote_drop=args.inject == "drop-on-demote")
     failures: list[str] = []
     rows: list[dict] = []
     drill_rows: list[dict] = []
@@ -296,19 +312,27 @@ def main(argv=None) -> int:
         failures += [f"drill {r.name}: {'; '.join(r.violations)}"
                      for r in results if not r.passed]
         if which is None:
-            # the recovery gate must not pass VACUOUSLY: on a full drill
-            # run, every recovery drill the baseline names must have run
-            # (the band file is where the expected-coverage contract
+            # the recovery and tiering gates must not pass VACUOUSLY: on
+            # a full drill run, every drill the baseline names must have
+            # run (the band file is where the expected-coverage contract
             # lives, next to the goodput bands)
-            expected = RECOVERY_DRILLS
+            expected_recovery = RECOVERY_DRILLS
+            expected_tiering = TIERING_DRILLS
             if os.path.exists(args.baseline):
                 with open(args.baseline, encoding="utf-8") as fh:
-                    expected = json.load(fh).get("recovery_drills",
-                                                 RECOVERY_DRILLS)
+                    doc = json.load(fh)
+                expected_recovery = doc.get("recovery_drills",
+                                            RECOVERY_DRILLS)
+                expected_tiering = doc.get("tiering_drills",
+                                           TIERING_DRILLS)
             ran = {r.name for r in results}
-            for name in expected:
+            for name in expected_recovery:
                 if name not in ran:
                     failures.append(f"recovery drill {name} named in the "
+                                    f"baseline never ran")
+            for name in expected_tiering:
+                if name not in ran:
+                    failures.append(f"tiering drill {name} named in the "
                                     f"baseline never ran")
 
     policy = _policy()
@@ -332,6 +356,10 @@ def main(argv=None) -> int:
         "recovery": {r["name"]: ("OK" if r["passed"] else "FAIL")
                      for r in drill_rows
                      if r["name"] in RECOVERY_DRILLS},
+        # ... and the KV-tiering gate's (ISSUE 12)
+        "tiering": {r["name"]: ("OK" if r["passed"] else "FAIL")
+                    for r in drill_rows
+                    if r["name"] in TIERING_DRILLS},
         "gate": {"verdict": "RED" if failures else "OK",
                  "failures": failures},
     }
