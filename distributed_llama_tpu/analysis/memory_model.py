@@ -234,6 +234,52 @@ def kv_tier_model(spec: TransformerSpec, n_slices: int,
     }
 
 
+# -- prefill/decode disaggregation (ISSUE 14) -------------------------------
+
+# Modeled DCN bandwidth between the prefill and decode pools: a 25 GbE
+# data-center link's useful throughput. A planning constant like the
+# PCIe/disk numbers above — PARITY.md's measured column stays honest N/A
+# until a hardware session.
+DCN_GBPS = 3.0
+# per-handoff fixed cost (connection reuse + framing + the admit RPC)
+DCN_HANDOFF_LATENCY_US = 200.0
+
+
+def disagg_pool_model(spec: TransformerSpec, n_slices: int,
+                      prefill_pages: int, decode_pages: int,
+                      page_size: int = DEFAULT_PAGE_SIZE,
+                      cache_itemsize: int = 4, kv_quant: str = "f32",
+                      prompt_positions: int = 512) -> dict:
+    """Per-pool capacity + handoff-bandwidth model of the two-pool
+    topology: page-pool bytes per pool, and the modeled cost of shipping
+    one request's full prompt pages over the DCN — the number that
+    justifies disaggregation's trade. The comparison that matters: a
+    handoff moves pages/request x page_bytes at DCN_GBPS (milliseconds),
+    while the interference it removes is every decode step that would
+    have queued behind the prefill dispatch on a colocated chip. Priced
+    per kv_quant: q8 pages ship ~3.76x cheaper than f32 — the PR 11 wire
+    cut compounds straight into the DCN budget."""
+    from ..parallel.comm_stats import dcn_handoff_budget
+
+    pb = kv_page_bytes(spec, n_slices, page_size, cache_itemsize, kv_quant)
+    budget = dcn_handoff_budget(spec, n_slices, prompt_positions,
+                                page_size, kv_quant, cache_itemsize)
+    ship_ms = budget["bytes"] / (DCN_GBPS * GIB) * 1e3 \
+        + DCN_HANDOFF_LATENCY_US / 1e3
+    return {
+        "page_size": page_size,
+        "kv_quant": kv_quant,
+        "page_bytes": pb,
+        "prefill": {"pages": prefill_pages, "bytes": prefill_pages * pb},
+        "decode": {"pages": decode_pages, "bytes": decode_pages * pb},
+        "handoff": {**budget,
+                    "dcn_gbps": DCN_GBPS,
+                    "ship_ms_per_page": round(
+                        pb / (DCN_GBPS * GIB) * 1e3, 6),
+                    "ship_ms_per_request": round(ship_ms, 6)},
+    }
+
+
 def activation_bytes_analytic(spec: TransformerSpec, n_slices: int,
                               t_len: int = 1) -> int:
     """No-trace activation bound for projection columns: the residual

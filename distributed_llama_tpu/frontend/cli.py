@@ -761,6 +761,29 @@ def cmd_serve(argv: list[str]) -> int:
     ap.add_argument("--max-restarts", type=int, default=None, metavar="N",
                     help="(--supervise) give up after N respawns "
                          "(default: unbounded)")
+    ap.add_argument("--disagg-role", default=None,
+                    choices=("prefill", "decode"),
+                    help="prefill/decode disaggregation (ISSUE 14): "
+                         "'prefill' serves POST /prefill + the DCN page "
+                         "channel (fills KV pages, samples the first "
+                         "token, ships full prompt pages); 'decode' "
+                         "fronts clients and forwards long prompts to "
+                         "--disagg-peer, resuming the stream bitwise "
+                         "from the returned journal record. Needs "
+                         "--kv-page-size (pages are the transfer unit)")
+    ap.add_argument("--disagg-peer", default=None, metavar="HOST:PORT",
+                    help="(--disagg-role decode) the prefill server")
+    ap.add_argument("--page-channel-port", type=int, default=0,
+                    metavar="PORT",
+                    help="(--disagg-role prefill) page-channel listen "
+                         "port (0 = pick a free one; exposed in "
+                         "/health's disagg block)")
+    ap.add_argument("--handoff-min-pages", type=int, default=2,
+                    metavar="N",
+                    help="(--disagg-role decode) forward only prompts "
+                         "spanning >= N full KV pages; shorter prompts "
+                         "prefill locally — handing them off would ship "
+                         "nothing and re-derive everything")
     _obs_flags(ap)
     args = ap.parse_args(argv)
     if args.supervise:
@@ -800,6 +823,24 @@ def cmd_serve(argv: list[str]) -> int:
     tier_err = _check_kv_tier_args(args, "")
     if tier_err:
         print(tier_err, file=sys.stderr)
+        return 2
+    if args.disagg_role and args.kv_page_size <= 0:
+        # pages are the handoff transfer unit — same argparse-time gate
+        # discipline as --spec-k / --kv-quant
+        print("--disagg-role ships KV PAGES between pools: add "
+              "--kv-page-size P", file=sys.stderr)
+        return 2
+    if args.disagg_role == "decode" and not args.disagg_peer:
+        print("--disagg-role decode needs --disagg-peer HOST:PORT (the "
+              "prefill server)", file=sys.stderr)
+        return 2
+    if args.disagg_peer and args.disagg_role != "decode":
+        print("--disagg-peer only means something with --disagg-role "
+              "decode", file=sys.stderr)
+        return 2
+    if args.handoff_min_pages < 1:
+        print(f"--handoff-min-pages must be >= 1, got "
+              f"{args.handoff_min_pages}", file=sys.stderr)
         return 2
     from ..obs.slo import SLOPolicy
     from ..runtime.chaos import ChaosMonkey
@@ -904,7 +945,11 @@ def cmd_serve(argv: list[str]) -> int:
                                  kv_host_pages=args.kv_host_pages,
                                  kv_disk_dir=args.kv_disk_dir,
                                  kv_disk_bytes=int(args.kv_disk_gb
-                                                   * (1 << 30)))
+                                                   * (1 << 30)),
+                                 disagg_role=args.disagg_role,
+                                 disagg_peer=args.disagg_peer,
+                                 page_channel_port=args.page_channel_port,
+                                 handoff_min_pages=args.handoff_min_pages)
     except Exception as e:
         from ..runtime.journal import JournalConfigMismatch
 
@@ -921,6 +966,12 @@ def cmd_serve(argv: list[str]) -> int:
         if args.metrics else "")
     print(f"🌐 serving on http://{args.host}:{server.port} "
           f"({args.slots} slots, {endpoints})")
+    if args.disagg_role == "prefill":
+        print(f"🌐 disagg role: prefill (POST /prefill; page channel on "
+              f"port {server._page_channel.port})")
+    elif args.disagg_role == "decode":
+        print(f"🌐 disagg role: decode (peer {args.disagg_peer}, handoff "
+              f"at >= {args.handoff_min_pages} full pages)")
     if server.recovered:
         print(f"🌐 recovered {server.recovered} journaled requests "
               f"from {args.journal}")

@@ -201,6 +201,16 @@ def _connect_spec(host: str, port: int, timeout: float,
     return s, struct.unpack("<q", head[len(_MAGIC):])[0]
 
 
+# public names for the transfer machinery the DCN page channel
+# (runtime/page_channel.py, ISSUE 14) builds on: exact receives, the
+# transient/permanent failure split, and backoff-retried connects — the
+# page channel must resume mid-transfer with the same discipline the
+# weight stream does, not reinvent a worse copy of it
+recv_exact = _recv_exact
+is_transient = _is_transient
+connect_with_retry = _connect_with_retry
+
+
 def merge_ranges(ranges: list[tuple[int, int]]) -> list[tuple[int, int]]:
     """Sort and coalesce (offset, length) ranges (adjacent or overlapping)."""
     out: list[list[int]] = []

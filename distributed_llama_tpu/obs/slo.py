@@ -106,6 +106,14 @@ class SLOPolicy:
     def names(self) -> tuple[str, ...]:
         return tuple(c.name for c in self.classes)
 
+    def rank(self, name: str | None) -> int:
+        """Priority rank of a class: its position in the policy's class
+        order (0 = highest — the default/interactive class leads by
+        convention). The disaggregated router and the engine's
+        slo_priority admission order by this, so "routes by class" is
+        defined in exactly one place. Unknown names raise via resolve."""
+        return self.classes.index(self.resolve(name))
+
     def resolve(self, name: str | None) -> SLOClass:
         """The class for ``name`` (None -> the default class). Unknown
         names raise — misattributing a verdict to the wrong class

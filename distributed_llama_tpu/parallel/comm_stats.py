@@ -276,6 +276,47 @@ def sp_lse_bytes(spec: TransformerSpec, n_sp: int, n_tp: int = 1,
     return CommStats(moved, moved)
 
 
+def dcn_page_bytes(spec: TransformerSpec, n_slices: int, page_size: int,
+                   kv_quant: str = "f32",
+                   cache_itemsize: int = 4) -> int:
+    """Wire bytes of ONE shipped KV page (all layers, K+V, codes+deltas
+    for q8) — identical to the disk tier's record for the same page
+    (runtime/pagewire packs both), so the DCN budget and the tier model
+    price the same bytes. Delegates to the one per-position byte model
+    (analysis/memory_model.kv_position_bytes; lazy import — analysis
+    already imports this module)."""
+    from ..analysis.memory_model import kv_page_bytes
+
+    return kv_page_bytes(spec, n_slices, page_size, cache_itemsize,
+                         kv_quant)
+
+
+def dcn_handoff_budget(spec: TransformerSpec, n_slices: int,
+                       n_prompt_positions: int, page_size: int,
+                       kv_quant: str = "f32",
+                       cache_itemsize: int = 4) -> dict:
+    """The per-request DCN budget of a prefill->decode handoff (ISSUE
+    14): pages x wire bytes, priced per kv_quant. Only FULL prompt pages
+    ship (the radix tree's sharing unit — a partial tail page is private
+    to its request and re-derives via suffix prefill on the decode
+    pool), so the page count is floor(prompt positions / page_size).
+    ``skipped_positions`` is the suffix the decode pool re-prefills —
+    the honest remainder the budget does NOT cover."""
+    if page_size < 1:
+        raise ValueError(f"page_size must be >= 1, got {page_size}")
+    pages = max(0, int(n_prompt_positions)) // page_size
+    per_page = dcn_page_bytes(spec, n_slices, page_size, kv_quant,
+                              cache_itemsize)
+    return {
+        "pages": pages,
+        "page_bytes": per_page,
+        "bytes": pages * per_page,
+        "skipped_positions": max(0, int(n_prompt_positions))
+        - pages * page_size,
+        "kv_quant": kv_quant,
+    }
+
+
 def reference_star_bytes(spec: TransformerSpec, n_slices: int) -> CommStats:
     """Root-side S/R bytes/token of the reference's socket scheme.
 

@@ -243,6 +243,32 @@ def modeled_ici_ms(spec: TransformerSpec, n_slices: int,
     return bw_ms, lat_ms
 
 
+def modeled_dcn_handoff_ms(spec: TransformerSpec, n_slices: int,
+                           n_prompt_positions: int, page_size: int,
+                           kv_quant: str = "f32",
+                           gbps: float | None = None,
+                           latency_us: float | None = None) -> float:
+    """Modeled wall ms to ship one request's full prompt pages from the
+    prefill pool to the decode pool over the DCN (ISSUE 14) — the
+    handoff's whole cost, to weigh against the interference it removes
+    (every colocated decode step that would have queued behind the
+    prefill dispatch). Same shape as modeled_ici_ms: bytes from the one
+    DCN budget (comm_stats.dcn_handoff_budget), bandwidth and fixed
+    latency from planning constants (analysis/memory_model.DCN_GBPS) —
+    overridable for sensitivity bands; measured cells stay honest N/A
+    until a two-host session."""
+    from ..analysis.memory_model import (DCN_GBPS,
+                                         DCN_HANDOFF_LATENCY_US, GIB)
+    from .comm_stats import dcn_handoff_budget
+
+    budget = dcn_handoff_budget(spec, n_slices, n_prompt_positions,
+                                page_size, kv_quant)
+    gbps = DCN_GBPS if gbps is None else gbps
+    latency_us = (DCN_HANDOFF_LATENCY_US if latency_us is None
+                  else latency_us)
+    return budget["bytes"] / (gbps * GIB) * 1e3 + latency_us / 1e3
+
+
 def _weight_frac(spec: TransformerSpec, names) -> float:
     """Fraction of one decode step's weight-streaming bytes owed to the
     named per-layer matmuls — the weight-bound shard-time attribution the

@@ -60,11 +60,18 @@ class ChaosMonkey:
     deny_pages: int = 0
     leak_on_cancel: bool = False
     drop_on_demote: bool = False
+    # disaggregation (ISSUE 14): every handed-off page's payload is
+    # replaced with zeros RE-FRAMED UNDER A VALID CRC — in-flight
+    # corruption that slips past the channel's framing checks, which
+    # only the bitwise stream gate can catch (the kill_mid_handoff
+    # drill's mutation arm)
+    drop_page_in_flight: bool = False
     # injection counters (read by drills / surfaced in loadcheck rows)
     injected_delays: int = 0
     denied_allocs: int = 0
     leaked_pages: list = dataclasses.field(default_factory=list)
     dropped_demotions: int = 0
+    dropped_pages: int = 0
     _dispatches: int = 0
 
     def on_dispatch(self) -> None:
@@ -101,12 +108,22 @@ class ChaosMonkey:
             return True
         return False
 
+    def page_drop(self) -> bool:
+        """Handoff-pack hook (runtime/disagg.encode_handoff_pages): True
+        = zero this page's payload before framing — the seeded in-flight
+        corruption the bitwise handoff gate must catch."""
+        if self.drop_page_in_flight:
+            self.dropped_pages += 1
+            return True
+        return False
+
     def injection_summary(self) -> dict:
         return {"dispatches": self._dispatches,
                 "injected_delays": self.injected_delays,
                 "denied_allocs": self.denied_allocs,
                 "leaked_pages": len(self.leaked_pages),
-                "dropped_demotions": self.dropped_demotions}
+                "dropped_demotions": self.dropped_demotions,
+                "dropped_pages": self.dropped_pages}
 
     @classmethod
     def parse(cls, text: str) -> "ChaosMonkey":
@@ -125,13 +142,14 @@ class ChaosMonkey:
                 kw["step_delay_s"] = float(val) / 1e3
             elif key in ("step_delay_every", "deny_pages"):
                 kw[key] = int(val)
-            elif key in ("leak_on_cancel", "drop_on_demote"):
+            elif key in ("leak_on_cancel", "drop_on_demote",
+                         "drop_page_in_flight"):
                 kw[key] = val.strip().lower() not in ("0", "false", "")
             else:
                 raise ValueError(
                     f"unknown chaos knob {key!r} (have step_delay_every, "
                     f"step_delay_ms, deny_pages, leak_on_cancel, "
-                    f"drop_on_demote)")
+                    f"drop_on_demote, drop_page_in_flight)")
         return cls(**kw)
 
 
@@ -442,6 +460,15 @@ _RECOVERY_SPEC_KW = dict(dim=64, hidden_dim=160, n_layers=2, n_heads=4,
 _RECOVERY_REQS = (
     ([1, 9, 17, 25], 24, 0.0, 0.9, 501),
     ([1, 9, 17, 42], 24, 0.9, 0.9, 502),
+)
+
+# kill_mid_handoff's workload (ISSUE 14): prompts spanning >= 2 FULL
+# pages (page_size 4) so the handoff genuinely ships pages the cut can
+# interrupt; one greedy, one seeded-sampled — the handed-off stream must
+# replay bitwise through the decode journal's coin cursor in both modes.
+_HANDOFF_REQS = (
+    ([1, 9, 17, 25, 31, 7, 3, 44, 11], 24, 0.0, 0.9, 501),
+    ([1, 9, 17, 25, 31, 7, 3, 44, 5], 24, 0.9, 0.9, 502),
 )
 
 
@@ -856,6 +883,147 @@ def drill_weight_stream_disconnect(make_engine) -> DrillResult:
                        details=details)
 
 
+def drill_kill_mid_handoff(make_engine, inject=frozenset()) -> DrillResult:
+    """THE disaggregation acceptance drill (ISSUE 14): kill the decode
+    pool MID-PAGE-TRANSFER — after its journal durably holds the handoff
+    admit (the durability point of the hand-over protocol), while page
+    records are still crossing the TCP page channel — then restart it on
+    the same journal. Recovery must re-admit the handed-off requests,
+    the re-fetched pages must adopt, and the continued streams must be
+    BITWISE the uninterrupted single-pool run (greedy AND seeded-sampled
+    via the journal's coin cursor), with BOTH pools ending in a clean
+    ``PagedAllocator.audit``.
+
+    ``inject={"drop-page-in-flight"}`` is the gate's mutation arm: every
+    shipped page's payload is zeroed and RE-FRAMED UNDER A VALID CRC —
+    corruption the channel's framing cannot see — so the decode pool
+    attends over junk and the bitwise gate must go red (tools/ci.sh
+    asserts loadcheck exits 1 under it)."""
+    import os
+    import tempfile
+
+    from .disagg import DisaggPair, prefill_stub, stub_needs_handoff
+    from .journal import RequestJournal
+
+    from .continuous import Request
+
+    violations: list = []
+    chaos = ChaosMonkey(
+        drop_page_in_flight="drop-page-in-flight" in inject)
+    tmp = tempfile.mkdtemp(prefix="dllama-chaos-handoff-")
+    jp_path = os.path.join(tmp, "prefill.journal")
+    jd_path = os.path.join(tmp, "decode.journal")
+
+    # uninterrupted single-pool reference: same recipe, same requests
+    ref_eng = _recovery_engine()
+    ref_reqs = []
+    for tokens, steps, temp, topp, seed in _HANDOFF_REQS:
+        r = Request(tokens=list(tokens), steps=steps, temperature=temp,
+                    topp=topp, seed=seed)
+        ref_eng.submit(r)
+        ref_reqs.append(r)
+    _drain(ref_eng)
+    ref_outs = [r.out for r in ref_reqs]
+
+    prefill = _recovery_engine(journal=RequestJournal(jp_path))
+    journal_a = RequestJournal(jd_path)
+    decode_a = _disagg_decode_engine(journal_a)
+    pair = DisaggPair(prefill, decode_a, channel_host="127.0.0.1",
+                      chaos=chaos)
+    stubs = []
+    for tokens, steps, temp, topp, seed in _HANDOFF_REQS:
+        stub, _ = prefill_stub(tokens, steps, temperature=temp,
+                               topp=topp, seed=seed)
+        prefill.submit(stub)
+        stubs.append((stub, steps))
+    _drain(prefill)
+    cut = 0
+    for stub, steps in stubs:
+        if not stub_needs_handoff(stub):
+            violations.append(f"stub {stub.index} retired without a "
+                              f"continuation — nothing to hand off")
+            continue
+        try:
+            # the decode admit lands in its journal, then the transfer is
+            # CUT after one page — the kill window
+            pair.handoff(stub, steps, cut_after=1)
+            violations.append("page transfer was never cut mid-flight")
+        except OSError:
+            cut += 1
+    # "kill" the decode pool: discard engine A entirely (its journal — the
+    # durable admits — survives, exactly what a SIGKILL leaves behind;
+    # the file handle closes so the restart reads a settled file)
+    journal_a.sync(force=True)
+    decode_a.close()
+    journal_a._fh.close()
+    del decode_a
+
+    # restart: fresh decode pool on the same journal; recovery re-admits,
+    # the channel still holds the unacked page records — re-fetch + adopt
+    journal_b = RequestJournal(jd_path)
+    decode_b = _disagg_decode_engine(journal_b)
+    n_rec = decode_b.recover()
+    with decode_b._lock:
+        recovered = list(decode_b._queue)
+    for stub, steps in stubs:
+        records = pair._client.fetch(f"h{stub.index}")
+        if records:
+            decode_b.allocator.adopt_remote_pages(
+                stub.tokens[:len(stub.tokens) - 1], records)
+    _drain(decode_b)
+    if n_rec != cut:
+        violations.append(f"expected {cut} journaled handoffs to recover, "
+                          f"got {n_rec}")
+    for req in recovered:
+        # recovered ids restart from the decode journal's next_id; map to
+        # the reference by prompt (the original prompt is the replay
+        # prefix)
+        want = None
+        for i, (tokens, *_rest) in enumerate(_HANDOFF_REQS):
+            if list(req.tokens[:len(tokens)]) == list(tokens):
+                want = ref_outs[i]
+                break
+        if want is None:
+            violations.append("recovered request matches no reference "
+                              "prompt")
+        elif req.out != want:
+            violations.append(
+                "recovered handoff stream diverged from the uninterrupted "
+                "single-pool reference (first "
+                f"{min(len(req.out), len(want))} positions compared)")
+    if decode_b.allocator.remote_adopted == 0 and not violations:
+        violations.append("no pages were adopted on the restarted decode "
+                          "pool — the re-fetch path never ran")
+    for name, eng in (("prefill", prefill), ("decode", decode_b)):
+        for p in eng.audit_pages():
+            violations.append(f"{name} pool audit: {p}")
+    details = {"handoffs_cut": cut, "recovered": n_rec,
+               "pages_adopted": decode_b.allocator.remote_adopted,
+               **chaos.injection_summary()}
+    pair._server.close()
+    prefill.close()
+    decode_b.close()
+    journal_b.close()
+    return DrillResult(name="kill_mid_handoff", passed=not violations,
+                       violations=violations, details=details)
+
+
+def _disagg_decode_engine(journal=None):
+    """The kill-mid-handoff drill's decode pool: the recovery-drill
+    engine recipe with the DCN ingestion knob on."""
+    from ..models.spec import TransformerSpec
+    from ..models.synth import synth_params
+    from ..obs.metrics import Registry
+    from .continuous import ContinuousEngine
+
+    spec = TransformerSpec(**_RECOVERY_SPEC_KW)
+    params = synth_params(spec, q40=False, seed=4, scale=0.3)
+    return ContinuousEngine(spec, params, slots=2, temperature=0.8,
+                            topp=0.9, seed=11, metrics=Registry(),
+                            prefill_chunk=4, page_size=4, kv_pages=24,
+                            journal=journal, remote_pages=True)
+
+
 # drill names that make up the ISSUE 9 recovery gate (loadcheck surfaces
 # their verdicts as dedicated columns in its JSON row)
 RECOVERY_DRILLS = ("journal_wal", "kill_mid_decode", "hung_dispatch",
@@ -866,6 +1034,11 @@ RECOVERY_DRILLS = ("journal_wal", "kill_mid_decode", "hung_dispatch",
 # and a full run that silently skips one fails the gate)
 TIERING_DRILLS = ("tier_spill_storm",)
 
+# ... and the ISSUE 14 disaggregation gate (kill the decode pool mid-page-
+# transfer; recovery via its journal must be bitwise, both pools' audits
+# clean) — same coverage contract, under "disagg_drills" in the baseline
+DISAGG_DRILLS = ("kill_mid_handoff",)
+
 DRILLS = (
     ("pool_exhaustion", drill_pool_exhaustion),
     ("transient_starvation", drill_transient_starvation),
@@ -875,6 +1048,7 @@ DRILLS = (
     ("profiler_under_load", drill_profiler_under_load),
     ("tier_spill_storm", drill_tier_spill_storm),
     ("journal_wal", drill_journal_wal),
+    ("kill_mid_handoff", drill_kill_mid_handoff),
     ("kill_mid_decode", drill_kill_mid_decode),
     ("hung_dispatch", drill_hung_dispatch),
     ("weight_stream_disconnect", drill_weight_stream_disconnect),

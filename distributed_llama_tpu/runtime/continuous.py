@@ -70,6 +70,11 @@ class Request:
     # append atomically opens the new life and closes the old — a crash
     # can never leave both live. None for fresh requests.
     recovered_from: int | None = None
+    # DCN handoff durability (ISSUE 14): True when
+    # ContinuousEngine.prejournal already assigned this request's index
+    # and journaled its admit record — submit() then only queues it
+    # (appending a second admit would corrupt the journal)
+    prejournaled: bool = False
     # streaming hook: called from the scheduler thread with each token as it
     # lands in ``out`` (prompt echoes included, prefill echoes in one burst);
     # must be fast and must not raise — it runs inside the decode loop
@@ -157,6 +162,11 @@ class _Slot:
     # the slot rides dispatches masked inactive (pages-starved semantics)
     # until the payload lands at a step boundary (_settle_promotions)
     await_promo: bool = False
+    # chunk-boundary prefill preemption (ISSUE 14): True when admission
+    # prefill parked at a page-aligned chunk boundary (a higher-priority
+    # arrival preempted it) — the scheduler re-enters _maybe_prefill_slot
+    # for this slot on later iterations until the prompt is covered
+    prefill_pending: bool = False
 
     @property
     def free(self) -> bool:
@@ -181,6 +191,11 @@ class ContinuousStats:
     # metric-less engines (the loadgen driver) still see them
     pauses: int = 0
     requeues: int = 0
+    # admission-prefill forward passes executed (one per chunk window /
+    # per-token tail dispatch): the virtual-clock cost term the two-pool
+    # sweep charges prefill with (ISSUE 14) — without it a colocated
+    # engine's prefill interference would be invisible to the clock
+    prefill_chunks: int = 0
 
     @property
     def tokens_per_s(self) -> float:
@@ -216,7 +231,8 @@ class ContinuousEngine:
                  spec_ngram: int = 3, slo=None, chaos=None,
                  journal=None, watchdog=None, kv_quant: str = "f32",
                  kv_host_pages: int = 0, kv_disk_dir: str | None = None,
-                 kv_disk_bytes: int = 0, kv_tier_async: bool = True):
+                 kv_disk_bytes: int = 0, kv_tier_async: bool = True,
+                 remote_pages: bool = False, slo_priority: bool = False):
         import functools
 
         import jax
@@ -279,6 +295,16 @@ class ContinuousEngine:
             raise ValueError("KV tiering spills PAGES: pass page_size > 0 "
                              "(--kv-page-size with --kv-host-pages/"
                              "--kv-disk-dir)")
+        # DCN handoff ingestion (ISSUE 14): the decode pool of a
+        # disaggregated topology adopts remotely-prefilled KV pages — the
+        # transfer unit is the PAGE, so the paged pool is mandatory
+        if remote_pages and page_size <= 0:
+            raise ValueError("remote_pages ingests KV PAGES: pass "
+                             "page_size > 0 (--kv-page-size with "
+                             "--disagg-role decode)")
+        if slo_priority and slo is None:
+            raise ValueError("slo_priority orders admission by SLO class: "
+                             "pass an SLO policy (slo=...)")
         if kv_disk_bytes and not kv_disk_dir:
             raise ValueError("kv_disk_bytes without kv_disk_dir: the disk "
                              "tier needs a directory (--kv-disk-dir)")
@@ -453,7 +479,7 @@ class ContinuousEngine:
         self._tier_write = None
         self._tier_seen = {"prom": 0, "dem": 0, "hbm": 0, "host": 0,
                            "disk": 0}
-        if self._alloc is not None and self._alloc.tiered:
+        if self._alloc is not None and (self._alloc.tiered or remote_pages):
             from ..models.llama import fetch_page_planes, write_page_planes
             from .paging import PageUploader
 
@@ -466,15 +492,22 @@ class ContinuousEngine:
             else:
                 stage = lambda planes: tuple(  # noqa: E731
                     jax.device_put(p) for p in planes)
-            if kv_tier_async:
-                self._uploader = PageUploader(stage=stage)
-            self._alloc.bind_device_io(
-                lambda pid: fetch_page_planes(self.cache, pid),
-                stage=stage, uploader=self._uploader)
-            if chaos is not None:
-                # hook consulted per demotion; the monkey's
-                # drop_on_demote flag decides (like deny_page)
-                self._alloc.corrupt_demote = chaos.demote_drop
+            if self._alloc.tiered:
+                if kv_tier_async:
+                    self._uploader = PageUploader(stage=stage)
+                self._alloc.bind_device_io(
+                    lambda pid: fetch_page_planes(self.cache, pid),
+                    stage=stage, uploader=self._uploader)
+                if chaos is not None:
+                    # hook consulted per demotion; the monkey's
+                    # drop_on_demote flag decides (like deny_page)
+                    self._alloc.corrupt_demote = chaos.demote_drop
+            else:
+                # remote-only (DCN decode pool): no demotion reads — just
+                # the promotion stage + apply for adopted handoff pages
+                self._alloc.bind_device_io(None, stage=stage)
+            if remote_pages:
+                self._alloc.remote = True
             self._tier_write = jax.jit(write_page_planes, donate_argnums=0)
         # write-ahead request journal (runtime/journal.py, ISSUE 9): every
         # submit/sampled-token/retire appends a record; recover() replays
@@ -487,6 +520,27 @@ class ContinuousEngine:
         # armed around every device call — decode steps, fused chains,
         # verify dispatches, and admission prefill
         self._watchdog = watchdog
+        # SLO-aware admission (ISSUE 14): with slo_priority on, _pop_request
+        # takes the best-ranked class first (rank = position in the policy's
+        # class order, FIFO within a class) instead of plain FIFO — the
+        # prefill pool's routing-by-class lever. Scheduling never changes a
+        # request's own stream, so priority is stream-invisible.
+        self._prio = slo.rank if slo_priority else None
+        # chunk-boundary prefill preemption hook (ISSUE 14): a callable
+        # consulted at page-aligned chunk boundaries of admission prefill;
+        # True parks the slot there (s.prefill_pending) so a higher-priority
+        # arrival's prefill runs first. Paged engines only (the contiguous
+        # scratch-cache prefill is not resumable). None = never preempt.
+        self.prefill_hold = None
+        # DCN handoff intake (decode pool): handler threads queue
+        # (tokens, planes, request) triples here; the SCHEDULER thread
+        # adopts + submits at its next iteration — the radix tree is
+        # scheduler-owned and must never be mutated from a handler
+        self._remote_inbox: list = []
+        # ... and the prefill-pool twin: handler threads queue export
+        # requests (tokens, box) and the scheduler fulfils them with the
+        # tree-held prompt pages' wire payloads (same ownership rule)
+        self._export_inbox: list = []
         self._pool = [_Slot() for _ in range(slots)]
         # persistent host-side staging buffers (dlint D004): the per-step
         # pool scan writes rows here and each step ships ONE upload per
@@ -701,9 +755,11 @@ class ContinuousEngine:
         K = self.spec_k
         from .speculative import accept_or_resample, draft_tokens
 
+        self._drain_remote_inbox()
         self._sweep_cancelled()
         self._admit()
         self._settle_promotions(quiet)
+        self._resume_prefills()
         pool = self._pool
         paused = self._grow_pages(pool, K, quiet)
         if all(s.free for s in pool):
@@ -842,7 +898,7 @@ class ContinuousEngine:
         dispatch on the next step. Scheduler thread only — the pool cache
         must never be written concurrently with a dispatch."""
         alloc = self._alloc
-        if alloc is None or not alloc.tiered:
+        if alloc is None or not alloc.pending_capable:
             return
         jobs = alloc.take_staged_promotions()
         for job in jobs:
@@ -926,12 +982,22 @@ class ContinuousEngine:
                     continue
                 active += 1
                 if s.await_promo or (
-                        self._alloc.tiered
+                        self._alloc.pending_capable
                         and self._alloc.slot_pending(s.pages)):
                     # shared-prefix pages still riding a promotion upload
                     # (KV tiering): the slot pauses like a page-starved
                     # one, but resolves by itself when the upload lands —
                     # never a deadlock, so the breaker must not see it
+                    promo.add(b)
+                    continue
+                if s.prefill_pending:
+                    # parked (preempted) admission prefill: the slot makes
+                    # progress only through _resume_prefills — masking it
+                    # out of dispatches keeps its position clock at the
+                    # page-aligned park point (load-bearing for q8: a
+                    # forced step advancing mid-page would force the next
+                    # scatter to re-quantize a partially-written page).
+                    # Self-resolving, so the deadlock breaker skips it.
                     promo.add(b)
                     continue
                 if not self._ensure_pages(s, min(s.pos + k, s.budget)):
@@ -990,9 +1056,11 @@ class ContinuousEngine:
         if k <= 1:
             return self.step_once(quiet=quiet)
         jnp = self.jnp
+        self._drain_remote_inbox()
         self._sweep_cancelled()
         self._admit()
         self._settle_promotions(quiet)
+        self._resume_prefills()
         pool = self._pool
         paused = (self._grow_pages(pool, k, quiet)
                   if self._alloc is not None else ())
@@ -1107,11 +1175,63 @@ class ContinuousEngine:
         self._journal.sync()
         self._journal.maybe_compact()
 
+    def prejournal(self, req: Request) -> Request:
+        """Assign a request's index and journal its admit record NOW
+        without queueing it — the decode pool's durability point BEFORE
+        a DCN page transfer (ISSUE 14): a crash between here and
+        submit() recovers the request from the journal exactly like a
+        crash mid-decode would. The caller must eventually submit() (the
+        flag makes that append-free) or retire the journaled life
+        (``abandon_prejournaled``) — leaving it dangling re-admits it on
+        the next recovery, which is the safe failure mode, not the
+        intended one."""
+        if not req.tokens:
+            raise ValueError("request has no prompt tokens")
+        if self._journal is None:
+            raise ValueError("prejournal() without a journal has no "
+                             "durability to offer; call submit()")
+        req.t_enqueue = time.monotonic()
+        with self._lock:
+            req.index = self._submitted
+            self._submitted += 1
+        self._journal_admit(req)
+        self._journal.sync(force=True)  # durable BEFORE any page moves
+        req.prejournaled = True
+        return req
+
+    def abandon_prejournaled(self, req: Request) -> None:
+        """Retire a prejournaled life that will never be submitted (the
+        handoff fell back to local serving): without this, the next
+        recovery would replay the request AND the fallback would serve
+        it — twice the work, twice the stream."""
+        if self._journal is not None and req.prejournaled:
+            self._journal.retire(req.index, "cancelled")
+            self._journal.sync(force=True)
+
+    def _journal_admit(self, req: Request) -> None:
+        """The one admit-record append (submit/prejournal share it)."""
+        self._journal.admit(
+            req.index, req.tokens, steps=req.steps,
+            temperature=(req.temperature if req.temperature is not None
+                         else self.temperature),
+            topp=req.topp if req.topp is not None else self.topp,
+            seed=(req.seed if req.seed is not None
+                  else self.seed + req.index),
+            slo=req.slo_class, cursor=req.coin_cursor,
+            recovers=req.recovered_from)
+
     def submit(self, req: Request) -> Request:
         """Queue a request (thread-safe; HTTP handler threads call this while
         the scheduler thread steps). ``req.done`` fires when it retires."""
         if not req.tokens:
             raise ValueError("request has no prompt tokens")
+        if req.prejournaled:
+            # index + admit record already durable (prejournal): queue
+            with self._lock:
+                self._queue.append(req)
+                if self._obs is not None:
+                    self._obs.set_queue_depth(len(self._queue))
+            return req
         req.t_enqueue = time.monotonic()
         with self._lock:
             req.index = self._submitted
@@ -1126,15 +1246,7 @@ class ContinuousEngine:
             # never admitted. Outside the engine lock: fsync=always
             # blocks on disk here, and the id counter above already
             # reserved our index.
-            self._journal.admit(
-                req.index, req.tokens, steps=req.steps,
-                temperature=(req.temperature if req.temperature is not None
-                             else self.temperature),
-                topp=req.topp if req.topp is not None else self.topp,
-                seed=(req.seed if req.seed is not None
-                      else self.seed + req.index),
-                slo=req.slo_class, cursor=req.coin_cursor,
-                recovers=req.recovered_from)
+            self._journal_admit(req)
         with self._lock:
             self._queue.append(req)
             if self._obs is not None:
@@ -1234,6 +1346,55 @@ class ContinuousEngine:
         self._journal.sync(force=True)
         return n
 
+    def ingest_remote(self, tokens, planes, req: Request) -> None:
+        """Thread-safe DCN handoff intake (ISSUE 14, decode pool): queue
+        shipped page payloads plus the re-admission request for the
+        scheduler thread to adopt at its next iteration. ``planes`` is
+        the CRC-verified plane tuples in full-prompt-page window order
+        (None entries mark pages that never arrived — adoption stops at
+        the gap and prefill re-derives)."""
+        if self._alloc is None or not self._alloc.remote:
+            raise ValueError("ingest_remote needs a remote_pages=True "
+                             "paged engine (the decode pool role)")
+        with self._lock:
+            self._remote_inbox.append((tokens, planes, req))
+
+    def export_prefix_sync(self, tokens, timeout: float = 30.0) -> list:
+        """Thread-safe prefill-pool page export (ISSUE 14): ask the
+        scheduler thread for the wire payloads of the tree-held full
+        prompt pages of ``tokens`` and wait for the answer (the server's
+        POST /prefill handler calls this — it must never walk the tree
+        itself). [] when nothing is shared (or the scheduler never
+        answered inside ``timeout``) — the handoff then ships nothing
+        and the decode pool re-derives via prefill."""
+        box = {"ev": threading.Event(), "planes": None}
+        with self._lock:
+            self._export_inbox.append((list(tokens), box))
+        box["ev"].wait(timeout)
+        return box["planes"] or []
+
+    def _drain_remote_inbox(self) -> None:
+        """Scheduler-thread half of ingest_remote/export_prefix_sync:
+        adopt shipped pages into the radix tree (promotion-pending) and
+        submit their requests so admission finds the prefix already
+        published; fulfil pending page exports from the tree."""
+        with self._lock:
+            if not (self._remote_inbox or self._export_inbox):
+                return
+            items, self._remote_inbox = self._remote_inbox, []
+            exports, self._export_inbox = self._export_inbox, []
+        for tokens, planes, req in items:
+            self._alloc.adopt_remote_pages(tokens, planes)
+            self.submit(req)
+        if exports:
+            from .disagg import export_prefix_pages
+
+            for tokens, box in exports:
+                try:
+                    box["planes"] = export_prefix_pages(self, tokens)
+                finally:
+                    box["ev"].set()
+
     def _sweep_cancelled(self) -> None:
         """Retire every cancelled in-flight request BEFORE the next
         dispatch (scheduler thread only): pages and slots free at the
@@ -1251,7 +1412,7 @@ class ContinuousEngine:
         loop (run(), the server scheduler) would stop with work still
         waiting."""
         with self._lock:
-            queued = len(self._queue)
+            queued = len(self._queue) + len(self._remote_inbox)
         return sum(not s.free for s in self._pool) + queued
 
     def step_once(self, quiet: bool = True) -> int:
@@ -1260,9 +1421,11 @@ class ContinuousEngine:
         step (0 = idle: nothing queued, nothing in flight). Must be called
         from a single scheduler thread; submit() may race freely."""
         jnp = self.jnp
+        self._drain_remote_inbox()
         self._sweep_cancelled()
         self._admit()
         self._settle_promotions(quiet)
+        self._resume_prefills()
         pool = self._pool
         paused = (self._grow_pages(pool, 1, quiet)
                   if self._alloc is not None else ())
@@ -1361,12 +1524,20 @@ class ContinuousEngine:
 
     def _pop_request(self) -> Request | None:
         """Next live queued request (cancelled-before-admission ones are
-        completed and skipped), or None when the queue is empty."""
+        completed and skipped), or None when the queue is empty. With
+        slo_priority, the best-ranked SLO class pops first (FIFO within a
+        class — stable, so batch work still drains in order)."""
         while True:
             with self._lock:
                 if not self._queue:
                     return None
-                req = self._queue.pop(0)
+                at = 0
+                if self._prio is not None and len(self._queue) > 1:
+                    rank = self._prio
+                    at = min(range(len(self._queue)),
+                             key=lambda i: (rank(self._queue[i].slo_class),
+                                            i))
+                req = self._queue.pop(at)
                 if self._obs is not None:
                     self._obs.set_queue_depth(len(self._queue))
             if not req.cancelled:
@@ -1383,6 +1554,7 @@ class ContinuousEngine:
         req = s.req
         self._alloc.release_pages(s.pages)
         s.pages, s.shared, s.await_promo = [], 0, False
+        s.prefill_pending = False
         s.req, s.pos, s.token, s.forced, s.sampler = None, 0, 0, [], None
         req.t_admit = 0.0
         self.stats.requeues += 1
@@ -1472,9 +1644,10 @@ class ContinuousEngine:
                     if self._admit_paged(s) == "dry":
                         self._requeue_front(s)
                         return
-                    if self._alloc.tiered and self._alloc.slot_pending(
-                            s.pages):
-                        # shared prefix promoting from host/disk: defer
+                    if self._alloc.pending_capable \
+                            and self._alloc.slot_pending(s.pages):
+                        # shared prefix promoting from host/disk (or
+                        # riding a DCN handoff upload): defer
                         # admission prefill until the upload lands
                         # (_settle_promotions) — gathering now would
                         # read junk where the payload hasn't arrived
@@ -1503,16 +1676,28 @@ class ContinuousEngine:
         chunk = self.prefill_chunk
         tokens = s.req.tokens
         n_pre = len(tokens) - 1
-        start = s.pos  # 0, or the page-aligned prefix-share boundary
+        start = s.pos  # 0, the page-aligned prefix-share boundary, or a
+        #                preemption park point (s.prefill_pending resume)
         if (getattr(self, "_prefill_fwd", None) is None or chunk <= 1
                 or n_pre - start < 2 or n_pre >= s.budget
                 or BOS in tokens[1:]):
+            s.prefill_pending = False
             return
         from .generate import run_chunked_prefill
 
         t0 = time.monotonic() if self._obs is not None else 0.0
         jnp = self.jnp
         paged = self._alloc is not None
+        # chunk-boundary preemption (ISSUE 14): paged f32 pools only —
+        # the contiguous path's fresh scratch cache cannot resume
+        # mid-prompt, and a q8 pool quantizes at every scatter, so a
+        # resumed prompt would attend over DEQUANTIZED earlier positions
+        # where the single-pass run attends f32: accumulated rounding
+        # breaks the bitwise single-pool contract. q8 pools keep the
+        # SLO-priority admission order; they just never park mid-prompt.
+        hold = (self.prefill_hold
+                if paged and self.kv_quant == "f32" else None)
+        end = n_pre
         with self._span("prefill", "prefill", slot=slot_index,
                         tokens=n_pre - start):
             if paged:
@@ -1526,52 +1711,87 @@ class ContinuousEngine:
                 tbl = np.full((self._max_pages,), SCRAP_PAGE, np.int32)
                 tbl[:len(s.pages)] = s.pages
                 tbl_dev = jnp.asarray(tbl)
-                if self.kv_quant == "q8":
-                    # q8 scatter must NOT re-quantize shared prefix pages
-                    # (quantize∘dequantize moves bytes; a shared page
-                    # keeps its first publisher's encoding) — their
-                    # scatter entries park on the scrap page. The gather
-                    # above still reads them: suffix chunks attend over
-                    # the dequantized shared prefix.
-                    tbl_sc = tbl.copy()
-                    tbl_sc[:s.shared] = SCRAP_PAGE
-                    tbl_scatter = jnp.asarray(tbl_sc)
-                else:
-                    tbl_scatter = tbl_dev
                 cache_box = [self._gather_pages(self.cache, tbl_dev)]
             else:
                 cache_box = [self._scratch_cache()]
 
             def fwd(part, start_pos):
+                self.stats.prefill_chunks += 1
                 _, cache_box[0] = self._prefill_fwd(
                     self.params, cache_box[0], jnp.asarray(part, jnp.int32),
                     jnp.int32(start_pos))
 
-            run_chunked_prefill(fwd, tokens[start:n_pre], start, chunk,
-                                self.spec.seq_len)
+            if hold is None:
+                run_chunked_prefill(fwd, tokens[start:n_pre], start, chunk,
+                                    self.spec.seq_len)
+            else:
+                # the same window schedule, one chunk at a time, yielding
+                # at PAGE-ALIGNED chunk boundaries when hold(s) says a
+                # higher-priority arrival should prefill first. Page
+                # alignment is load-bearing for q8 pools: a park inside a
+                # page would re-quantize that page's earlier positions on
+                # resume (quantize∘dequantize moves bytes)
+                lo = start
+                while lo < n_pre:
+                    hi = min(lo + chunk, n_pre)
+                    run_chunked_prefill(fwd, tokens[lo:hi], lo, chunk,
+                                        self.spec.seq_len)
+                    lo = hi
+                    if (lo < n_pre and lo % self.page_size == 0
+                            and hold(s)):
+                        end = lo
+                        break
             if paged:
+                if self.kv_quant == "q8":
+                    # q8 scatter must NOT re-quantize pages whose bytes
+                    # were published by an EARLIER encode (quantize∘
+                    # dequantize moves bytes): the shared prefix keeps
+                    # its first publisher's encoding, and a preemption
+                    # resume keeps the pages its previous rounds already
+                    # wrote — their scatter entries park on the scrap
+                    # page. The gather above still reads them: suffix
+                    # chunks attend over the dequantized prefix.
+                    tbl_sc = tbl.copy()
+                    tbl_sc[:max(s.shared, start // self.page_size)] = \
+                        SCRAP_PAGE
+                    tbl_scatter = jnp.asarray(tbl_sc)
+                else:
+                    tbl_scatter = tbl_dev
                 self.cache = self._scatter_pages(self.cache, cache_box[0],
                                                  tbl_scatter)
                 # publish the freshly prefilled full prompt pages NOW (not
                 # just at retire): a same-system-prompt request admitted
                 # into the next slot this very round already shares them
-                self._alloc.insert_prefix(tokens[:n_pre], s.pages)
+                self._alloc.insert_prefix(tokens[:end], s.pages)
             else:
                 self.cache = self._insert(self.cache, cache_box[0],
                                           jnp.int32(slot_index))
         # echo the prefilled prompt tokens into the output AND the token
         # count (the step loop both appends forced tokens and counts them —
         # "Generated tokens" must not change meaning with the toggle)
-        s.req.out.extend(tokens[start + 1:n_pre + 1])
-        for t in tokens[start + 1:n_pre + 1]:
+        s.req.out.extend(tokens[start + 1:end + 1])
+        for t in tokens[start + 1:end + 1]:
             self._notify(s.req, t)
-        self.stats.tokens += n_pre - start
+        self.stats.tokens += end - start
         if self._obs is not None:
-            self._obs.generated.inc(n_pre - start)
+            self._obs.generated.inc(end - start)
             self._obs.prefill.observe(time.monotonic() - t0)
-        s.pos = n_pre
-        s.token = tokens[n_pre]
-        s.forced = []
+        s.pos = end
+        s.token = tokens[end]
+        s.forced = list(tokens[end + 1:]) if end < n_pre else []
+        s.prefill_pending = end < n_pre
+
+    def _resume_prefills(self) -> None:
+        """Continue chunk-preempted admission prefills (ISSUE 14): every
+        slot parked at a page-aligned boundary re-enters
+        _maybe_prefill_slot — which may park it again if the hold still
+        fires — so a preempted batch prompt keeps making chunk progress
+        instead of crawling through per-token forced steps."""
+        for b, s in enumerate(self._pool):
+            if s.free or not s.prefill_pending or s.await_promo \
+                    or s.req.cancelled:
+                continue
+            self._maybe_prefill_slot(b, s)
 
     @staticmethod
     def _notify(req: Request, token: int):
@@ -1605,6 +1825,7 @@ class ContinuousEngine:
             if self._obs is not None:
                 self._obs.kv_pages_free.set(self._alloc.n_free)
                 self._update_tier_obs()
+        s.prefill_pending = False
         s.req.t_finish = time.monotonic()
         if self._journal is not None and not self._suspending:
             # a drain-suspended request writes NO retirement: its admit +

@@ -317,6 +317,23 @@ class RequestJournal:
             return sorted((e for e in self._entries.values()
                            if e.status is None), key=lambda e: e.rid)
 
+    def entry(self, rid: int) -> JournalEntry | None:
+        """One request's journaled state by id, retired or live — the
+        DCN handoff reads the retired prefill stub's entry here (prompt
+        ids, sampled tokens, coin cursor: the exact resumable state the
+        decode pool re-admits; runtime/disagg.py). A deep copy, so the
+        caller can rewrite ``steps`` for the handoff without touching
+        the journal's in-memory state."""
+        with self._lock:
+            e = self._entries.get(rid)
+            if e is None:
+                return None
+            return JournalEntry(rid=e.rid, tokens=list(e.tokens),
+                                steps=e.steps, temperature=e.temperature,
+                                topp=e.topp, seed=e.seed, slo=e.slo,
+                                cursor=e.cursor, sampled=list(e.sampled),
+                                status=e.status)
+
     @property
     def next_id(self) -> int:
         """One past the highest journaled request id — a fresh engine
@@ -566,6 +583,40 @@ def _load_file(path: str) -> tuple[dict[int, JournalEntry], int,
     # truncate to zero and start fresh rather than refusing a journal
     # that never recorded anything
     return entries, offset, header_cfg
+
+
+def entry_to_wire(entry: JournalEntry) -> dict:
+    """The handoff wire form of a journal entry (ISSUE 14): the plain
+    JSON-able dict a prefill pool ships to a decode pool — exactly the
+    fields ``ContinuousEngine.recover`` replays from, so a handed-off
+    request and a crash-recovered one re-admit through ONE code path.
+    ``sampled`` stays separate from ``tokens`` (the receiver composes
+    ``replay_tokens`` itself) so the record is honest about what was
+    prompt and what was generated."""
+    return {"id": entry.rid, "tokens": list(entry.tokens),
+            "sampled": list(entry.sampled), "cursor": entry.cursor,
+            "steps": entry.steps, "temperature": entry.temperature,
+            "topp": entry.topp, "seed": entry.seed, "slo": entry.slo}
+
+
+def entry_from_wire(rec: dict) -> JournalEntry:
+    """entry_to_wire's inverse, with the same strictness as journal
+    loading: a malformed handoff record raises ValueError (the decode
+    pool refuses it — admitting a half-parsed request would serve wrong
+    bytes with a straight face)."""
+    try:
+        tokens = [int(t) for t in rec["tokens"]]
+        if not tokens:
+            raise ValueError("handoff record has no prompt tokens")
+        return JournalEntry(
+            rid=int(rec["id"]), tokens=tokens,
+            steps=int(rec["steps"]),
+            temperature=float(rec["temperature"]),
+            topp=float(rec["topp"]), seed=int(rec["seed"]),
+            slo=rec.get("slo"), cursor=int(rec.get("cursor", 0)),
+            sampled=[int(t) for t in rec.get("sampled", ())])
+    except (KeyError, TypeError, ValueError) as exc:
+        raise ValueError(f"malformed handoff record: {exc}") from exc
 
 
 def load_journal(path: str) -> list[JournalEntry]:

@@ -1,0 +1,126 @@
+"""The ONE page wire codec: pack/unpack + CRC framing for KV page payloads.
+
+A KV page leaves the device pool in exactly one byte layout — the plane
+tuple ``models/llama.fetch_page_planes`` reads back: ``(k, v)`` f32/bf16
+planes or ``(kq, kd, vq, vd)`` Q8 codes+deltas, serialized contiguously
+in tuple order. PR 12's disk tier stores that blob; ISSUE 14's DCN page
+channel ships it between pools. Before this module each consumer carried
+its own copy of the pack/unpack pair (the disk tier's private
+``_pack_planes``), which is exactly how two "identical" layouts drift —
+so the codec lives HERE and both tiers import it (the byte-identity of
+the refactor is pinned by tests/test_disagg.py against a raw disk
+record).
+
+Two granularities:
+
+* ``pack_planes``/``unpack_planes`` — the bare payload blob + the
+  shape/dtype metadata needed to rebuild it. The disk tier stores the
+  blob and carries the metadata in its record ref; the CRC travels in
+  the segment's ``.slices`` sidecar (io/stream.append_record_verified).
+* ``encode_record``/``decode_record`` — a SELF-DESCRIBING framed record
+  (metadata + CRC32 + blob in one byte string) for transports with no
+  sidecar: the DCN page channel ships these, and ``decode_record``
+  returns None on ANY damage — short frame, garbled metadata, CRC
+  mismatch — so a dropped or corrupted in-flight page degrades to a
+  re-fetch (or a prefill re-derive), never to wrong attention bytes.
+
+Frame layout (little-endian):
+
+    u32 meta_len | meta json (shapes + dtype strs) | u32 crc32(blob)
+    | u64 blob_len | blob
+
+The blob bytes inside a frame are ``pack_planes``' output VERBATIM — the
+disk tier's on-disk record and the channel's in-flight payload are the
+same bytes for the same page, which is what lets PARITY.md price both
+with one number.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+import zlib
+
+_HEAD = struct.Struct("<I")   # meta_len / crc32
+_LEN = struct.Struct("<Q")    # blob_len
+
+
+def pack_planes(planes) -> tuple[bytes, tuple]:
+    """Serialize a page payload (tuple of numpy plane arrays in the page
+    wire layout — (k, v) f32 planes or (kq, kd, vq, vd) Q8 codes+deltas)
+    into one blob + the shape/dtype metadata needed to rebuild it."""
+    import numpy as np
+
+    metas = tuple((tuple(a.shape), a.dtype.str) for a in planes)
+    blob = b"".join(np.ascontiguousarray(a).tobytes() for a in planes)
+    return blob, metas
+
+
+def unpack_planes(blob: bytes, metas) -> tuple:
+    """pack_planes' inverse. Returns read-only views over ``blob`` — the
+    consumers (device_put / .at[].set) copy anyway."""
+    import numpy as np
+
+    out, off = [], 0
+    for shape, dt in metas:
+        dtype = np.dtype(dt)
+        n = 1
+        for d in shape:
+            n *= int(d)
+        out.append(np.frombuffer(blob, dtype, count=n,
+                                 offset=off).reshape(shape))
+        off += n * dtype.itemsize
+    return tuple(out)
+
+
+def encode_record(planes) -> bytes:
+    """One self-describing framed page record (module docstring layout):
+    the DCN channel's wire unit. The payload blob is byte-identical to
+    the disk tier's record for the same planes."""
+    blob, metas = pack_planes(planes)
+    meta = json.dumps([[list(s), d] for s, d in metas],
+                      separators=(",", ":")).encode()
+    return (_HEAD.pack(len(meta)) + meta
+            + _HEAD.pack(zlib.crc32(blob)) + _LEN.pack(len(blob)) + blob)
+
+
+def decode_record(data: bytes):
+    """Planes of one framed record, CRC-verified — None on ANY damage
+    (truncation, garbled metadata, checksum mismatch). The caller treats
+    None as "this page never arrived": re-fetch it, or let prefill
+    re-derive the positions it covered."""
+    try:
+        if len(data) < _HEAD.size:
+            return None
+        (meta_len,) = _HEAD.unpack_from(data, 0)
+        off = _HEAD.size
+        meta_raw = data[off:off + meta_len]
+        if len(meta_raw) != meta_len:
+            return None
+        off += meta_len
+        (crc,) = _HEAD.unpack_from(data, off)
+        off += _HEAD.size
+        (blob_len,) = _LEN.unpack_from(data, off)
+        off += _LEN.size
+        blob = data[off:off + blob_len]
+        if len(blob) != blob_len or off + blob_len != len(data):
+            return None
+        if zlib.crc32(blob) != crc:
+            return None
+        metas = tuple((tuple(int(d) for d in s), dt)
+                      for s, dt in json.loads(meta_raw))
+        return unpack_planes(blob, metas)
+    except (ValueError, KeyError, TypeError, struct.error):
+        return None
+
+
+def record_payload_bytes(planes_or_record) -> int:
+    """Payload (blob) bytes of a page — the number the DCN budget term
+    (parallel/comm_stats.dcn_handoff_budget) prices; framing overhead is
+    the small constant on top."""
+    if isinstance(planes_or_record, (bytes, bytearray)):
+        (meta_len,) = _HEAD.unpack_from(planes_or_record, 0)
+        off = _HEAD.size + meta_len + _HEAD.size
+        (blob_len,) = _LEN.unpack_from(planes_or_record, off)
+        return int(blob_len)
+    return len(pack_planes(planes_or_record)[0])

@@ -125,32 +125,12 @@ class PagePool:
         return list(self._free)
 
 
-def _pack_planes(planes) -> tuple[bytes, tuple]:
-    """Serialize a page payload (tuple of numpy plane arrays in the page
-    wire layout — (k, v) f32 planes or (kq, kd, vq, vd) Q8 planes) into
-    one blob + the shape/dtype metadata needed to rebuild it."""
-    import numpy as np
-
-    metas = tuple((tuple(a.shape), a.dtype.str) for a in planes)
-    blob = b"".join(np.ascontiguousarray(a).tobytes() for a in planes)
-    return blob, metas
-
-
-def _unpack_planes(blob: bytes, metas) -> tuple:
-    """_pack_planes' inverse. Returns read-only views over ``blob`` — the
-    consumers (device_put / .at[].set) copy anyway."""
-    import numpy as np
-
-    out, off = [], 0
-    for shape, dt in metas:
-        dtype = np.dtype(dt)
-        n = 1
-        for d in shape:
-            n *= int(d)
-        out.append(np.frombuffer(blob, dtype, count=n,
-                                 offset=off).reshape(shape))
-        off += n * dtype.itemsize
-    return tuple(out)
+# the page wire codec lives in runtime/pagewire.py (ISSUE 14): the disk
+# tier's on-disk records and the DCN page channel's in-flight frames are
+# the SAME bytes for the same page, produced by the one shared pack —
+# two private copies of this pair is exactly how wire layouts drift
+from .pagewire import pack_planes as _pack_planes
+from .pagewire import unpack_planes as _unpack_planes
 
 
 class HostPagePool:
@@ -653,6 +633,15 @@ class PagedAllocator:
         self.disk = (DiskPageStore(disk_dir, disk_bytes)
                      if disk_dir else None)
         self.tiered = self.host is not None or self.disk is not None
+        # DCN handoff ingestion (ISSUE 14): the decode pool of a
+        # disaggregated topology adopts remotely-prefilled page payloads
+        # through the same promotion-pending machinery the tier hierarchy
+        # uses; ``remote`` is set by the engine's remote_pages knob and
+        # only widens the pending gates — untiered local engines keep the
+        # zero-overhead path
+        self.remote = False
+        self.remote_adopted = 0   # pages adopted from a DCN handoff
+        self.remote_rejected = 0  # shipped pages the pool could not place
         # tree-node population per tier, maintained incrementally at every
         # transition; the audit recounts from the tree and flags drift
         # ("counters consistent with the page ledger")
@@ -690,6 +679,14 @@ class PagedAllocator:
     @property
     def n_free(self) -> int:
         return self.pool.n_free
+
+    @property
+    def pending_capable(self) -> bool:
+        """True when pages can be promotion-PENDING (payload not yet in
+        the device pool): the tier hierarchy is on, or remote (DCN
+        handoff) adoption is — the engine's pause/settle gates consult
+        this instead of ``tiered`` so both sources share one machinery."""
+        return self.tiered or self.remote
 
     def pages_for(self, n_positions: int) -> int:
         """Pages needed to cover ``n_positions`` sequence positions."""
@@ -965,6 +962,72 @@ class PagedAllocator:
         self.pool.retain(pid)
         self.promotions["reprefill"] += 1
 
+    def adopt_remote_pages(self, tokens, payloads) -> list:
+        """DCN handoff ingestion (ISSUE 14): adopt shipped page payloads
+        under their full-page token-window keys as promotion-PENDING
+        tree nodes — the decode pool's twin of a disk promotion, minus
+        the disk. Each adopted window allocates its HBM target page now
+        (evicting cold leaves under pressure), stages the payload
+        (``bind_device_io``'s stage, or raw numpy for the apply jit to
+        transfer), and queues the job for the engine's step-boundary
+        apply (``take_staged_promotions``); a request matching the
+        prefix meanwhile PAUSEs with the pages-starved semantics until
+        the payload lands. ``payloads[i]`` covers window i of ``tokens``
+        (wire-layout plane tuples, or None for a page that never arrived
+        — the adoption stops at the gap and the suffix re-derives via
+        prefill). Returns the adopted nodes (the handoff's cancel path
+        drops them — mid-transfer cancel must free pages on this pool,
+        not leave junk pending)."""
+        adopted: list = []
+        children, parent = self.tree._roots, None
+        windows = self.tree._windows(tokens)
+        for consumed, (key, payload) in enumerate(zip(windows, payloads)):
+            if payload is None:
+                break  # dropped/damaged in flight: prefill re-derives
+            node = children.get(key)
+            if node is None:
+                pid = self.alloc_page()
+                if pid is None:
+                    # count only the pages actually left unplaced (windows
+                    # already resident locally were consumed, not rejected)
+                    self.remote_rejected += len(payloads) - consumed
+                    break  # pool dry even after eviction: suffix re-derives
+                node = _Node(key=key, page=pid, parent=parent,
+                             last_used=self.tree._tick(), pending=True)
+                children[key] = node
+                self.tree._n_nodes += 1
+                self._note_tier(None, TIER_HBM)
+                self._pending[pid] = node
+                job = _PromotionJob(node=node, page=pid, payload=payload)
+                job.staged = (self._stage(payload)
+                              if self._stage is not None else payload)
+                self._jobs.append(job)
+                self.remote_adopted += 1
+                adopted.append(node)
+            else:
+                # window already stored locally (an earlier handoff or a
+                # local prefill published it): the local copy wins — the
+                # content is identical by the prefix key, and spilled
+                # copies promote through the tier path on match
+                node.last_used = self.tree._tick()
+            children, parent = node.children, node
+        return adopted
+
+    def drop_adopted(self, nodes) -> int:
+        """Cancel-path cleanup for ``adopt_remote_pages``: drop adopted
+        nodes that are STILL promotion-pending (their payload never
+        applied — nothing can be attending over them) so a cancelled
+        mid-transfer handoff frees its pages on this pool immediately.
+        Nodes whose payload already landed stay — they are ordinary
+        tree-held prefix pages now, reusable by the next request."""
+        dropped = 0
+        for node in reversed(nodes):  # leaf-first: the chain unwinds
+            if node.pending and self._pending.get(node.page) is node \
+                    and not node.children:
+                self.tree._drop(node)
+                dropped += 1
+        return dropped
+
     def release_node_storage(self, node: _Node) -> None:
         """Tree-drop hook (PrefixTree._drop): release whatever tier owns
         this node's payload. A promotion-pending node cancels its
@@ -1173,6 +1236,7 @@ class PagedAllocator:
         self.tokens_saved_by_tier = {TIER_HBM: 0, TIER_HOST: 0,
                                      TIER_DISK: 0}
         self.crc_drops = 0
+        self.remote_adopted = self.remote_rejected = 0
 
     @property
     def hit_rate(self) -> float:

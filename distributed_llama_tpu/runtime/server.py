@@ -29,6 +29,13 @@ Endpoints:
   POST /profile  {"seconds"?: float, "dir"?: str} -> starts a jax.profiler
                capture into dir for N seconds WHILE SERVING (409 if one is
                already running) — profile under real load
+  POST /prefill  (--disagg-role prefill only, ISSUE 14) the decode pool's
+               internal handoff RPC: {"tokens": [ids], "steps": N, ...}
+               -> the request's journal-record state + page-channel
+               coordinates (or {"final": true} when the stream ended
+               inside the prefill cut); /health gains a "disagg" block
+               (role, peer, page channel, handoff queue depth) on both
+               roles
 
 Threading model: http.server's ThreadingHTTPServer handles each connection
 on its own thread; handlers only encode, submit (thread-safe), and wait on
@@ -85,12 +92,34 @@ class InferenceServer:
                  chaos=None, journal=None, watchdog_s: float = 0.0,
                  drain_s: float = 10.0, kv_quant: str = "f32",
                  kv_host_pages: int = 0, kv_disk_dir: str | None = None,
-                 kv_disk_bytes: int = 0):
+                 kv_disk_bytes: int = 0, disagg_role: str | None = None,
+                 disagg_peer: str | None = None,
+                 page_channel_port: int = 0, handoff_min_pages: int = 2):
         self.spec = spec
         self.tokenizer = tokenizer
         self.default_steps = steps
         self.quiet = quiet
         self.drain_s = drain_s
+        # prefill/decode disaggregation (ISSUE 14): "prefill" serves
+        # POST /prefill + the page channel; "decode" fronts clients and
+        # forwards long prompts to ``disagg_peer`` (host:port of the
+        # prefill server), ingesting the returned journal record + the
+        # shipped pages. None = plain single-pool serving.
+        if disagg_role not in (None, "prefill", "decode"):
+            raise ValueError(f"disagg_role {disagg_role!r}: expected "
+                             f"prefill|decode|None")
+        if disagg_role is not None and page_size <= 0:
+            raise ValueError("disaggregation ships KV PAGES: pass "
+                             "page_size > 0 (--kv-page-size)")
+        if disagg_role == "decode" and not disagg_peer:
+            raise ValueError("--disagg-role decode needs --disagg-peer "
+                             "HOST:PORT (the prefill server)")
+        self.disagg_role = disagg_role
+        self.disagg_peer = disagg_peer
+        self.handoff_min_pages = max(1, handoff_min_pages)
+        self._page_channel = None
+        self._disagg_obs = None
+        self._handoff_seq = 0
         # SLO policy (obs/slo.SLOPolicy) — verdicts per priority class in
         # /health + /metrics; ``chaos`` (runtime/chaos.ChaosMonkey) arms
         # deterministic fault injection for operator drills (--chaos)
@@ -132,7 +161,32 @@ class InferenceServer:
                                        kv_quant=kv_quant,
                                        kv_host_pages=kv_host_pages,
                                        kv_disk_dir=kv_disk_dir,
-                                       kv_disk_bytes=kv_disk_bytes)
+                                       kv_disk_bytes=kv_disk_bytes,
+                                       remote_pages=(
+                                           disagg_role == "decode"),
+                                       slo_priority=(
+                                           disagg_role == "prefill"
+                                           and slo is not None))
+        if disagg_role == "prefill":
+            from .disagg import make_priority_hold
+            from .page_channel import PageChannelServer
+
+            # bind the channel on the same interface as the HTTP listener:
+            # a 0.0.0.0 serve host means remote decode pools connect, and
+            # the page channel must be reachable from exactly as far
+            self._page_channel = PageChannelServer(
+                host=host if host else "0.0.0.0",
+                port=page_channel_port)
+            if slo is not None:
+                # SLO-aware admission: interactive prefills jump the
+                # queue AND preempt batch prefills at page-aligned
+                # chunk boundaries
+                self.engine.prefill_hold = make_priority_hold(
+                    self.engine, slo)
+        if disagg_role is not None and self.registry is not None:
+            from .disagg import DisaggMetrics
+
+            self._disagg_obs = DisaggMetrics(self.registry)
         # replay the previous life's unfinished requests BEFORE the
         # listener opens: recovered work re-queues first, so a restarted
         # server continues exactly where the crash cut it off
@@ -244,6 +298,23 @@ class InferenceServer:
                                 dict(a.tokens_saved_by_tier),
                             "crc_drops": a.crc_drops,
                         }
+                if server.disagg_role is not None:
+                    # disaggregated-topology surface (ISSUE 14): this
+                    # pool's role, its peer, and the handoff backlog —
+                    # the dllama_handoff_*/dllama_dcn_* series' JSON twin
+                    payload["disagg"] = {
+                        "role": server.disagg_role,
+                        "peer": server.disagg_peer,
+                        "page_channel_port": (
+                            server._page_channel.port
+                            if server._page_channel is not None else None),
+                        "handoff_queue_depth": (
+                            server._page_channel.queue_depth
+                            if server._page_channel is not None else 0),
+                    }
+                    if eng.allocator is not None:
+                        payload["disagg"]["pages_adopted"] = \
+                            eng.allocator.remote_adopted
                 if server.journal is not None:
                     # recovery bookkeeping: requests replayed from the
                     # journal at startup + append volume since
@@ -312,6 +383,8 @@ class InferenceServer:
             def do_POST(self):
                 if self.path == "/profile":
                     return self._profile()
+                if self.path == "/prefill":
+                    return self._prefill_handoff()
                 if self.path != "/generate":
                     return self._json(404, {"error": "unknown path"})
                 if server.health.state in ("draining", "stopped"):
@@ -333,15 +406,97 @@ class InferenceServer:
                 except (ValueError, KeyError, TypeError) as e:
                     server.count_reject("bad_request")
                     return self._json(400, {"error": str(e)})
+                if server.disagg_role == "decode":
+                    req, submit = server.remote_prefill(req)
+                else:
+                    submit = lambda r=req: server.engine.submit(r)  # noqa: E731
                 if stream:
-                    return self._stream(req)
-                server.engine.submit(req)
+                    return self._stream(req, submit)
+                if submit is not None:
+                    submit()
                 req.done.wait()
                 if req.error is not None:
                     return self._json(500, {"error": req.error})
                 text = server.decode(req)
                 self._json(200, {"text": text, "tokens": req.out,
                                  "steps": len(req.out)})
+
+            def _prefill_handoff(self):
+                """POST /prefill (prefill role, ISSUE 14): the decode
+                pool's internal RPC. Body: {"tokens": [ids], "steps":
+                N, "temperature"?, "topp"?, "seed"?, "class"?}. Runs
+                prompt prefill + samples the FIRST token, publishes the
+                full prompt pages on the page channel, and returns the
+                request's journal-record state for the decode pool to
+                re-admit — or {"final": true, ...} when the stream ended
+                inside the prefill cut."""
+                from .disagg import (encode_handoff_pages, entry_for_stub,
+                                     prefill_stub, stub_needs_handoff)
+                from .journal import entry_to_wire
+
+                if server.disagg_role != "prefill":
+                    return self._json(404, {"error": "not a prefill pool"})
+                if server.health.state in ("draining", "stopped"):
+                    server.count_reject("draining")
+                    return self._json(503, {"error": "draining"})
+                try:
+                    n = int(self.headers.get("Content-Length", "0"))
+                    payload = json.loads(self.rfile.read(n) or b"{}")
+                    tokens = [int(t) for t in payload["tokens"]]
+                    steps = int(payload["steps"])
+                    if not tokens or steps < 1 \
+                            or len(tokens) > server.spec.seq_len:
+                        raise ValueError(
+                            f"bad handoff prompt/steps ({len(tokens)} "
+                            f"tokens, {steps} steps)")
+                    temp = payload.get("temperature")
+                    topp = payload.get("topp")
+                    seed = payload.get("seed")
+                    slo_class = payload.get("class")
+                except (ValueError, KeyError, TypeError) as e:
+                    server.count_reject("bad_request")
+                    return self._json(400, {"error": str(e)})
+                stub, _ = prefill_stub(
+                    tokens, steps,
+                    temperature=None if temp is None else float(temp),
+                    topp=None if topp is None else float(topp),
+                    seed=None if seed is None else int(seed),
+                    slo_class=slo_class)
+                server.engine.submit(stub)
+                stub.done.wait()
+                if stub.error is not None:
+                    return self._json(500, {"error": stub.error})
+                if not stub_needs_handoff(stub):
+                    if server._disagg_obs is not None:
+                        server._disagg_obs.handoffs["local"].inc()
+                    return self._json(200, {"final": True,
+                                            "out": stub.out})
+                try:
+                    entry = entry_for_stub(server.engine, stub)
+                except ValueError as e:  # sampled stream, no journal
+                    return self._json(500, {"error": str(e)})
+                payloads = server.engine.export_prefix_sync(tokens)
+                records = encode_handoff_pages(payloads)
+                hid = f"h{stub.index}"
+                server._page_channel.publish(hid, records)
+                if server._disagg_obs is not None:
+                    from .pagewire import record_payload_bytes
+
+                    obs = server._disagg_obs
+                    obs.handoffs["shipped"].inc()
+                    if records:
+                        # PAYLOAD bytes (the DCN budget's unit — frame
+                        # overhead excluded), the same accounting as
+                        # DisaggPair: the series stays reconcilable
+                        # against dcn_handoff_budget
+                        obs.pages_shipped.inc(len(records))
+                        obs.bytes_shipped.inc(sum(
+                            record_payload_bytes(r) for r in records))
+                    obs.queue_depth.set(server._page_channel.queue_depth)
+                self._json(200, {
+                    "record": entry_to_wire(entry),
+                    "hid": hid, "n_pages": len(records),
+                    "channel_port": server._page_channel.port})
 
             def _profile(self):
                 """POST /profile: capture a jax.profiler trace for N
@@ -373,13 +528,16 @@ class InferenceServer:
                     return self._json(400, {"error": str(e)})
                 self._json(200, {"dir": trace_dir, "seconds": seconds})
 
-            def _stream(self, req):
+            def _stream(self, req, submit=None):
                 """Chunked newline-delimited JSON, one line per token.
 
                 The scheduler thread only enqueues (on_token must never
                 block the decode loop on a slow client socket); THIS
                 handler thread drains the queue and does the blocking
-                writes.
+                writes. ``submit`` hands the request to the engine AFTER
+                the hook is registered (the disagg decode path passes an
+                ingest closure; None with ``done`` already set means the
+                request completed remotely — replay its tokens).
                 """
                 import queue
 
@@ -396,11 +554,38 @@ class InferenceServer:
                                      + b"\r\n")
                     self.wfile.flush()
 
+                if submit is None and req.done.is_set():
+                    # completed inside the peer's prefill cut: replay the
+                    # finished stream as one burst
+                    try:
+                        prev = req.tokens[0]
+                        for tok in req.out:
+                            piece = server.tokenizer.decode_piece(prev,
+                                                                  tok)
+                            prev = tok
+                            chunk({"token": tok,
+                                   "piece": piece.decode(
+                                       "utf-8", errors="replace")})
+                        if req.error is not None:
+                            chunk({"done": True, "error": req.error})
+                        else:
+                            chunk({"done": True,
+                                   "text": server.decode(req),
+                                   "steps": len(req.out)})
+                        self.wfile.write(b"0\r\n\r\n")
+                        self.wfile.flush()
+                    except OSError:
+                        pass
+                    return
+
                 # register with the server so stop() can join this thread
                 # once the request is woken — without the registry a
                 # handler blocked in q.get outlives the server silently
                 server._streams.add(threading.current_thread())
-                server.engine.submit(req)
+                if submit is not None:
+                    submit()
+                else:
+                    server.engine.submit(req)
                 prev = req.tokens[0]
                 sent = 0
                 try:
@@ -486,6 +671,93 @@ class InferenceServer:
         from .continuous import decode_stream
 
         return decode_stream(self.tokenizer, req.tokens[0], req.out)
+
+    def remote_prefill(self, req: Request):
+        """Decode-role routing (ISSUE 14): prompts spanning >=
+        ``handoff_min_pages`` full pages forward to the prefill peer
+        (POST /prefill), whose reply is either the finished stream (it
+        ended inside the prefill cut) or a journal record + page-channel
+        coordinates; shipped pages are fetched, CRC-verified, and handed
+        to the scheduler with the re-admission request. Shorter prompts
+        — and ANY peer failure — run locally: disaggregation degrades to
+        single-pool serving, never to a dropped request.
+
+        Returns ``(request, submit_fn)``: the request to track (the
+        original, or the peer-built re-admission) and a thunk that hands
+        it to the engine — None when it is already complete. Callers
+        register streaming hooks BEFORE invoking the thunk."""
+        import urllib.request
+
+        from .disagg import decode_request
+        from .journal import entry_from_wire
+        from .page_channel import PageChannelClient
+
+        local = (req, lambda: self.engine.submit(req))
+        n_full = (len(req.tokens) - 1) // max(self.engine.page_size, 1)
+        if n_full < self.handoff_min_pages:
+            if self._disagg_obs is not None:
+                self._disagg_obs.handoffs["local"].inc()
+            return local
+        t0 = time.monotonic()
+        dreq = None
+        resp = None
+        try:
+            body = json.dumps({
+                "tokens": req.tokens, "steps": req.steps,
+                "temperature": req.temperature, "topp": req.topp,
+                "seed": req.seed, "class": req.slo_class}).encode()
+            rq = urllib.request.Request(
+                f"http://{self.disagg_peer}/prefill", data=body,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(rq, timeout=120) as r:
+                resp = json.loads(r.read())
+            if resp.get("final"):
+                req.out.extend(int(t) for t in resp["out"])
+                req.done.set()
+                return req, None
+            entry = entry_from_wire(resp["record"])
+            dreq = decode_request(entry, req.steps)
+            if self.engine._journal is not None:
+                # the durability point: the admit record lands BEFORE
+                # any page moves, so a crash mid-transfer recovers the
+                # request from this journal (the kill_mid_handoff
+                # contract, honored on the HTTP path too)
+                self.engine.prejournal(dreq)
+            host = self.disagg_peer.rsplit(":", 1)[0]
+            client = PageChannelClient(
+                f"{host}:{resp['channel_port']}")
+            planes = client.fetch(resp["hid"], int(resp["n_pages"]))
+            prompt = list(req.tokens)
+            if self._disagg_obs is not None:
+                obs = self._disagg_obs
+                obs.handoffs["shipped"].inc()
+                obs.handoff_latency.observe(time.monotonic() - t0)
+            return dreq, (lambda: self.engine.ingest_remote(
+                prompt, planes, dreq))
+        except (OSError, ValueError, KeyError, TypeError) as e:
+            log_event("disagg.handoff_failed",
+                      f"🔶 handoff to {self.disagg_peer} failed "
+                      f"({type(e).__name__}: {e}); serving locally",
+                      file=sys.stderr,
+                      error=f"{type(e).__name__}: {e}")
+            if dreq is not None:
+                # the fallback serves the ORIGINAL request — retire the
+                # prejournaled life, or the next recovery would replay
+                # it on top of the fallback's stream
+                self.engine.abandon_prejournaled(dreq)
+            if resp is not None and resp.get("hid"):
+                # best-effort: tell the prefill pool to drop the
+                # published pages (nothing will fetch them now)
+                try:
+                    host = self.disagg_peer.rsplit(":", 1)[0]
+                    PageChannelClient(
+                        f"{host}:{resp['channel_port']}",
+                        connect_window=2.0).ack(resp["hid"])
+                except (OSError, ValueError, KeyError):
+                    pass  # the channel's retention cap bounds the leak
+            if self._disagg_obs is not None:
+                self._disagg_obs.handoffs["failed"].inc()
+            return local
 
     def _on_hang(self, elapsed_s: float):
         """Watchdog trip (monitor thread): a dispatch overran its deadline.
@@ -659,6 +931,8 @@ class InferenceServer:
         for t in list(self._streams):
             t.join(timeout=5)
         self.httpd.server_close()
+        if self._page_channel is not None:
+            self._page_channel.close()
         self.engine.close()  # KV-tier uploader thread (no-op untiered)
         if self._watchdog is not None:
             self._watchdog.close()

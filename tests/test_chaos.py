@@ -172,11 +172,13 @@ def test_all_drills_pass_on_healthy_engine(make_engine):
     assert [name for name, _ in DRILLS] == [
         "pool_exhaustion", "transient_starvation", "oversized_prompt",
         "disconnect", "latency_spike", "profiler_under_load",
-        "tier_spill_storm", "journal_wal", "kill_mid_decode",
-        "hung_dispatch", "weight_stream_disconnect"]
-    # kill_mid_decode spawns a jax subprocess — its own slow-marked test
-    # below; everything else runs here
-    which = {name for name, _ in DRILLS} - {"kill_mid_decode"}
+        "tier_spill_storm", "journal_wal", "kill_mid_handoff",
+        "kill_mid_decode", "hung_dispatch", "weight_stream_disconnect"]
+    # kill_mid_decode spawns a jax subprocess and kill_mid_handoff
+    # drives full two-pool engines — each has its own slow-marked test
+    # (here + tests/test_disagg.py); everything else runs here
+    which = {name for name, _ in DRILLS} - {"kill_mid_decode",
+                                            "kill_mid_handoff"}
     results = run_drills(make_engine, which=which)
     assert len(results) == len(which)
     assert all(r.passed for r in results), [

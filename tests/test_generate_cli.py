@@ -299,3 +299,27 @@ def test_cli_batch_prompts_file(model_files, tmp_path, capsys):
     # lockstep batch can't prefill (shared position clock)
     assert main(["inference", *base[:-2], "--tp", "1", "--prefill-chunk",
                  "4", "--prompts-file", str(pf)]) == 2
+
+
+def test_cli_disagg_flags_validate_at_argparse_time(model_files, capsys):
+    """ISSUE 14: the disaggregation knobs fail BEFORE the model load —
+    role without --kv-page-size, decode without a peer, a peer without
+    the decode role, and a nonsense handoff threshold."""
+    from distributed_llama_tpu.frontend.cli import main
+
+    model, tokp = model_files
+    assert main(["serve", "--model", model, "--tokenizer", tokp,
+                 "--disagg-role", "prefill"]) == 2
+    assert "--kv-page-size" in capsys.readouterr().err
+    assert main(["serve", "--model", model, "--tokenizer", tokp,
+                 "--disagg-role", "decode", "--kv-page-size", "4"]) == 2
+    assert "--disagg-peer" in capsys.readouterr().err
+    assert main(["serve", "--model", model, "--tokenizer", tokp,
+                 "--kv-page-size", "4",
+                 "--disagg-peer", "127.0.0.1:1"]) == 2
+    assert "--disagg-role decode" in capsys.readouterr().err
+    assert main(["serve", "--model", model, "--tokenizer", tokp,
+                 "--disagg-role", "decode", "--kv-page-size", "4",
+                 "--disagg-peer", "127.0.0.1:1",
+                 "--handoff-min-pages", "0"]) == 2
+    assert "--handoff-min-pages" in capsys.readouterr().err

@@ -813,3 +813,79 @@ def test_server_profile_409_and_500_paths(server, tmp_path):
                 {"seconds": 0.2, "dir": str(tmp_path / "after")})
     assert out["seconds"] == 0.2
     assert profiler.wait_capture(30)
+
+
+# ------------------------------------------------- disaggregation (ISSUE 14)
+
+
+def test_disagg_series_preregistered_at_zero():
+    """ISSUE 14 satellite: DisaggMetrics pre-registers the whole handoff
+    matrix at zero — a fresh prefill/decode pool scrapes the full
+    surface before any request moves."""
+    from distributed_llama_tpu.runtime.disagg import DisaggMetrics
+
+    reg = Registry()
+    DisaggMetrics(reg)
+    text = reg.expose()
+    for verdict in ("shipped", "local", "failed"):
+        assert (f'dllama_handoff_requests_total{{verdict="{verdict}"}} 0'
+                in text)
+    assert "dllama_dcn_pages_shipped_total 0" in text
+    assert "dllama_dcn_bytes_total 0" in text
+    assert "dllama_handoff_queue_depth 0" in text
+    assert "dllama_handoff_seconds_count 0" in text
+    for family, kind in (
+            ("dllama_handoff_requests_total", "counter"),
+            ("dllama_dcn_pages_shipped_total", "counter"),
+            ("dllama_dcn_bytes_total", "counter"),
+            ("dllama_handoff_queue_depth", "gauge"),
+            ("dllama_handoff_seconds", "histogram")):
+        assert f"# TYPE {family} {kind}" in text
+        assert f"# HELP {family} " in text
+
+
+def test_disagg_handoff_moves_series_and_health_block(params):
+    """A real two-pool handoff moves the dllama_dcn_* series (pages AND
+    payload bytes pinned to the DCN budget's numbers), and /health on a
+    disaggregated server carries the "disagg" block."""
+    import json
+    import urllib.request
+
+    from distributed_llama_tpu.parallel.comm_stats import \
+        dcn_handoff_budget
+    from distributed_llama_tpu.runtime.continuous import ContinuousEngine
+    from distributed_llama_tpu.runtime.disagg import DisaggPair
+    from distributed_llama_tpu.runtime.server import InferenceServer
+
+    reg = Registry()
+    make = lambda remote=False: ContinuousEngine(  # noqa: E731
+        SPEC, params, slots=2, temperature=0.0, topp=0.9, seed=11,
+        prefill_chunk=4, page_size=4, kv_pages=16, remote_pages=remote)
+    pair = DisaggPair(make(), make(remote=True), registry=reg)
+    prompt = [1, 9, 17, 25, 31, 7, 3, 44, 11]
+    pair.run([prompt], steps=14)
+    text = reg.expose()
+    budget = dcn_handoff_budget(SPEC, 1, len(prompt) - 1, 4)
+    assert f"dllama_dcn_pages_shipped_total {budget['pages']}" in text
+    assert f"dllama_dcn_bytes_total {budget['bytes']}" in text
+    assert 'dllama_handoff_requests_total{verdict="shipped"} 1' in text
+    pair.close()
+
+    server = InferenceServer(SPEC, params, _IdTokenizer(),
+                             host="127.0.0.1", port=0, slots=2, steps=8,
+                             temperature=0.0, topp=0.9, seed=3,
+                             page_size=4, kv_pages=16,
+                             disagg_role="prefill", quiet=True)
+    server.start()
+    try:
+        health = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/health", timeout=10).read())
+        assert health["disagg"]["role"] == "prefill"
+        assert health["disagg"]["handoff_queue_depth"] == 0
+        assert health["disagg"]["page_channel_port"] > 0
+        metrics = urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/metrics",
+            timeout=10).read().decode()
+        assert "dllama_dcn_pages_shipped_total 0" in metrics
+    finally:
+        server.stop()

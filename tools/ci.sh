@@ -142,6 +142,32 @@ if [ "$tier_rc" -ne 1 ]; then
          "(exit $tier_rc, expected 1)" >&2
     exit 1
 fi
+# Disaggregation gate (ISSUE 14): the virtual-clock two-pool sweep must
+# show the disaggregated topology BEATING the colocated baseline on
+# interactive-class SLO attainment at equal simulated hardware under the
+# mixed interactive/batch trace (the fingerprinted row is archived), and
+# the kill-mid-handoff drill must pass: decode pool killed mid-page-
+# transfer, recovery via its journal bitwise vs the uninterrupted run,
+# both pools' page audits clean
+python tools/loadcheck.py --two-pool --sweep-only --json \
+    > tools/ci_artifacts/two_pool.json
+python tools/loadcheck.py --drills-only --drills kill_mid_handoff \
+    --json > /dev/null
+# ... and the gate must still CATCH wrong bytes on the wire: with
+# drop-page-in-flight armed (every shipped page zeroed under a VALID
+# CRC — corruption framing cannot see), the bitwise stream gate must
+# exit 1 EXACTLY — 2 is a usage error and would pass a naive non-zero
+# check vacuously
+set +e
+python tools/loadcheck.py --drills-only --drills kill_mid_handoff \
+    --inject drop-page-in-flight --json > /dev/null 2>&1
+disagg_rc=$?
+set -e
+if [ "$disagg_rc" -ne 1 ]; then
+    echo "ci: loadcheck did not flag the dropped in-flight handoff page" \
+         "(exit $disagg_rc, expected 1)" >&2
+    exit 1
+fi
 # SLO observatory gate (ISSUE 8) + crash-safety recovery gate (ISSUE 9):
 # a small deterministic loadcheck run — the virtual-clock offered-load
 # sweep held to the checked-in CPU goodput band

@@ -39,12 +39,24 @@ verdicts in the ``"tiering"`` column. ``--inject drop-on-demote`` arms
 its mutation (every write-behind demotion discards its payload): the
 spill-storm drill MUST go red — tools/ci.sh asserts exit 1 under it.
 
+Disaggregation (ISSUE 14): ``--two-pool`` replays a mixed interactive/
+batch trace against (a) two colocated engines and (b) a prefill pool +
+decode pool at equal simulated hardware, gating on the disaggregated
+topology BEATING colocated interactive-class attainment; the
+kill_mid_handoff drill (runtime/chaos.DISAGG_DRILLS, coverage key
+``"disagg_drills"``, verdict column ``"disagg"``) kills the decode pool
+mid-page-transfer and requires bitwise journal recovery. ``--inject
+drop-page-in-flight`` zeroes every shipped page under a VALID CRC — the
+bitwise gate must go red (ci.sh asserts exit 1).
+
 Usage:
   python tools/loadcheck.py [--sweep R1,R2,...] [--requests N] [--seed N]
       [--slots N] [--page-size P] [--kv-pages N] [--spec-k K]
       [--block-steps K] [--baseline PATH] [--write-baseline]
       [--sweep-only | --drills-only] [--drills NAMES]
-      [--inject leak-on-cancel|corrupt-journal|drop-on-demote]
+      [--two-pool] [--two-pool-rate R]
+      [--inject leak-on-cancel|corrupt-journal|drop-on-demote|
+               drop-page-in-flight]
       [--trace-out DIR] [--json]
 """
 
@@ -128,6 +140,96 @@ def build_engine_factory(args, inject_leak: bool = False,
     return make_engine
 
 
+def _two_pool_policy():
+    """The two-pool gate's SLO policy: the interactive TOKEN budget is
+    the discriminating one — 1.75 virtual steps/token sits between the
+    decode pool's clean cadence (~1.0-1.3: no long prefill ever runs
+    there) and a colocated engine's cadence under batch-prefill stalls
+    (a 7-chunk admission freezes every in-flight decode for 7 steps).
+    TTFT stays at the main gate's 12."""
+    from distributed_llama_tpu.obs.slo import SLOClass, SLOPolicy
+
+    return SLOPolicy((SLOClass("interactive", 12.0, 1.75),
+                      SLOClass("batch", 120.0, 30.0)))
+
+
+def _two_pool_spec(args):
+    """The two-pool comparison's MIXED trace: short interactive prompts
+    with LONG outputs (chat — decode-heavy, TPOT-sensitive), long batch
+    prompts (28 positions = 7 prefill chunks: the interference source),
+    some shared-prefix traffic so the decode pool's radix publish
+    matters."""
+    from loadgen import LoadSpec
+
+    return LoadSpec(
+        rate=args.two_pool_rate, n_requests=args.requests,
+        arrivals=args.arrivals, prompt_lens=(4, 6),
+        out_lens=(12, 16), shared_prefix_rate=0.25,
+        shared_prefix_len=args.page_size, n_shared_prefixes=2,
+        classes=("interactive", "batch"), class_weights=(4, 1),
+        class_prompt_lens=((4, 6), (28,)),
+        vocab=SPEC_KW["vocab_size"], seq_len=SPEC_KW["seq_len"])
+
+
+def run_two_pool(args, make_engine) -> tuple[dict, list[str]]:
+    """Colocated vs disaggregated at EQUAL simulated hardware (ISSUE
+    14): the same mixed trace replayed against (a) two full engines,
+    arrivals round-robin, and (b) a prefill pool (SLO-priority admission
+    + chunk-boundary preemption) handing off to a decode pool over the
+    wire codec with modeled DCN latency. Both run the same virtual cost
+    model (1 step = 1, 1 prefill chunk = 1). The gate: disaggregation
+    must BEAT the colocated baseline on interactive-class attainment —
+    the TTFT/TPOT interference win is the topology's whole claim."""
+    from distributed_llama_tpu.runtime.disagg import make_priority_hold
+    from loadgen import drive_pools, generate_trace
+
+    policy = _two_pool_policy()
+    trace = generate_trace(_two_pool_spec(args), args.seed)
+    # per-pool resources: 8 slots and a NON-oversubscribed page pool
+    # (slots x max pages) per pool, IDENTICAL across both topologies
+    # (equal simulated hardware) — page thrash is ISSUE 8's gate, not
+    # this one's
+    slots = 2 * args.slots
+    pages = slots * (SPEC_KW["seq_len"] // args.page_size)
+    coloc = [make_engine(slo=policy, slo_priority=True, slots=slots,
+                         kv_pages=pages)
+             for _ in range(2)]
+    res_c = drive_pools(coloc, trace, policy, mode="colocated",
+                        step_cost_s=args.step_cost,
+                        chunk_cost_s=args.step_cost)
+    prefill = make_engine(slo=policy, slo_priority=True, slots=slots,
+                          kv_pages=pages)
+    prefill.prefill_hold = make_priority_hold(prefill, policy)
+    decode = make_engine(remote_pages=True, slots=slots, kv_pages=pages)
+    res_d = drive_pools([prefill, decode], trace, policy, mode="disagg",
+                        step_cost_s=args.step_cost,
+                        chunk_cost_s=args.step_cost,
+                        handoff_latency_s=args.step_cost,
+                        handoff_page_cost_s=args.step_cost / 4)
+    failures = []
+    att_c = res_c.attainment.get("interactive", 1.0)
+    att_d = res_d.attainment.get("interactive", 1.0)
+    if not att_d > att_c:
+        failures.append(
+            f"two-pool gate: disaggregated interactive attainment "
+            f"{att_d:.4f} does not beat colocated {att_c:.4f} at equal "
+            f"simulated hardware (rate {args.two_pool_rate})")
+    for name, eng in (("prefill", prefill), ("decode", decode),
+                      ("colocated-0", coloc[0]),
+                      ("colocated-1", coloc[1])):
+        for p in eng.audit_pages():
+            failures.append(f"two-pool {name} audit: {p}")
+    row = {"rate": args.two_pool_rate,
+           "colocated": res_c.to_json(), "disagg": res_d.to_json(),
+           "interactive_attainment": {"colocated": att_c, "disagg": att_d}}
+    if not args.json:
+        print(f"two-pool rate {args.two_pool_rate:g}: interactive "
+              f"attainment colocated {att_c:.2f} -> disagg {att_d:.2f}; "
+              f"goodput {res_c.goodput_tps:.3f} -> "
+              f"{res_d.goodput_tps:.3f} tok/step")
+    return row, failures
+
+
 def run_sweep(args, make_engine) -> list[dict]:
     """One LoadResult row per offered rate (fresh engine + fresh trace
     per point, same seed — points differ only in arrival rate)."""
@@ -161,18 +263,20 @@ def check_baseline(rows: list[dict], path: str,
     (failures, baseline_doc). ``write`` regenerates the band at +-10%
     around the measured curve instead of checking."""
     if write:
-        from distributed_llama_tpu.runtime.chaos import (RECOVERY_DRILLS,
+        from distributed_llama_tpu.runtime.chaos import (DISAGG_DRILLS,
+                                                         RECOVERY_DRILLS,
                                                          TIERING_DRILLS)
 
         doc = {"kind": "loadcheck-baseline",
                "note": "CPU virtual-clock goodput band; regenerate with "
                        "tools/loadcheck.py --write-baseline",
                # drill coverage contracts (ISSUE 9 recovery, ISSUE 12
-               # tiering): a full drill run must include these, or the
-               # gate fails — a renamed or dropped drill cannot silently
-               # shrink its gate
+               # tiering, ISSUE 14 disaggregation): a full drill run must
+               # include these, or the gate fails — a renamed or dropped
+               # drill cannot silently shrink its gate
                "recovery_drills": list(RECOVERY_DRILLS),
                "tiering_drills": list(TIERING_DRILLS),
+               "disagg_drills": list(DISAGG_DRILLS),
                "points": [{"rate": r["rate"],
                            "goodput_tps": r["goodput_tps"],
                            "band": [round(r["goodput_tps"] * 0.9, 6),
@@ -242,7 +346,7 @@ def main(argv=None) -> int:
                          "from runtime/chaos.DRILLS)")
     ap.add_argument("--inject", default=None,
                     choices=("leak-on-cancel", "corrupt-journal",
-                             "drop-on-demote"),
+                             "drop-on-demote", "drop-page-in-flight"),
                     help="arm a seeded mutation; the drill suite MUST "
                          "go red (the CI gate's self-test): "
                          "leak-on-cancel leaks a page per cancelled "
@@ -250,7 +354,18 @@ def main(argv=None) -> int:
                          "smashes a mid-file journal byte before "
                          "recovery (kill_mid_decode drill), "
                          "drop-on-demote discards every KV-tier "
-                         "demotion's payload (tier_spill_storm drill)")
+                         "demotion's payload (tier_spill_storm drill), "
+                         "drop-page-in-flight zeroes every handed-off "
+                         "page under a valid CRC (kill_mid_handoff "
+                         "drill — only the bitwise gate can catch it)")
+    ap.add_argument("--two-pool", action="store_true",
+                    help="run the colocated-vs-disaggregated comparison "
+                         "(ISSUE 14) on the mixed interactive/batch "
+                         "trace; gates on disagg beating colocated "
+                         "interactive attainment at equal simulated "
+                         "hardware")
+    ap.add_argument("--two-pool-rate", type=float, default=0.25,
+                    help="offered rate of the two-pool comparison trace")
     ap.add_argument("--trace-out", default=None,
                     help="also save each sweep point's trace (replayable "
                          "schedule archive)")
@@ -273,8 +388,9 @@ def main(argv=None) -> int:
         return 2
 
     from distributed_llama_tpu.models.spec import TransformerSpec
-    from distributed_llama_tpu.runtime.chaos import DRILLS, \
-        RECOVERY_DRILLS, TIERING_DRILLS, render_drill_table, run_drills
+    from distributed_llama_tpu.runtime.chaos import DISAGG_DRILLS, \
+        DRILLS, RECOVERY_DRILLS, TIERING_DRILLS, render_drill_table, \
+        run_drills
     from distributed_llama_tpu.utils.fingerprint import run_stamp
 
     make_engine = build_engine_factory(
@@ -284,7 +400,11 @@ def main(argv=None) -> int:
     rows: list[dict] = []
     drill_rows: list[dict] = []
 
-    if not args.drills_only:
+    two_pool_row = None
+    if args.two_pool:
+        two_pool_row, tp_failures = run_two_pool(args, make_engine)
+        failures += tp_failures
+    elif not args.drills_only:
         rows = run_sweep(args, make_engine)
         base_failures, _ = check_baseline(rows, args.baseline,
                                           args.write_baseline)
@@ -304,7 +424,8 @@ def main(argv=None) -> int:
                 return 2
         results = run_drills(
             make_engine, which=which,
-            inject={args.inject} if args.inject == "corrupt-journal"
+            inject={args.inject} if args.inject in ("corrupt-journal",
+                                                    "drop-page-in-flight")
             else None)
         drill_rows = [r.to_json() for r in results]
         if not args.json:
@@ -318,6 +439,7 @@ def main(argv=None) -> int:
             # lives, next to the goodput bands)
             expected_recovery = RECOVERY_DRILLS
             expected_tiering = TIERING_DRILLS
+            expected_disagg = DISAGG_DRILLS
             if os.path.exists(args.baseline):
                 with open(args.baseline, encoding="utf-8") as fh:
                     doc = json.load(fh)
@@ -325,6 +447,7 @@ def main(argv=None) -> int:
                                             RECOVERY_DRILLS)
                 expected_tiering = doc.get("tiering_drills",
                                            TIERING_DRILLS)
+                expected_disagg = doc.get("disagg_drills", DISAGG_DRILLS)
             ran = {r.name for r in results}
             for name in expected_recovery:
                 if name not in ran:
@@ -333,6 +456,10 @@ def main(argv=None) -> int:
             for name in expected_tiering:
                 if name not in ran:
                     failures.append(f"tiering drill {name} named in the "
+                                    f"baseline never ran")
+            for name in expected_disagg:
+                if name not in ran:
+                    failures.append(f"disagg drill {name} named in the "
                                     f"baseline never ran")
 
     policy = _policy()
@@ -350,6 +477,7 @@ def main(argv=None) -> int:
                  "token_budget_s": c.token_budget_s}
                 for c in policy.classes],
         "sweep": rows,
+        "two_pool": two_pool_row,
         "drills": drill_rows,
         # dedicated recovery-gate verdict columns (ISSUE 9): the crash-
         # safety drills' pass/fail at a glance, joinable across rows
@@ -360,6 +488,10 @@ def main(argv=None) -> int:
         "tiering": {r["name"]: ("OK" if r["passed"] else "FAIL")
                     for r in drill_rows
                     if r["name"] in TIERING_DRILLS},
+        # ... and the disaggregation gate's (ISSUE 14)
+        "disagg": {r["name"]: ("OK" if r["passed"] else "FAIL")
+                   for r in drill_rows
+                   if r["name"] in DISAGG_DRILLS},
         "gate": {"verdict": "RED" if failures else "OK",
                  "failures": failures},
     }

@@ -78,6 +78,13 @@ class LoadSpec:
     n_shared_prefixes: int = 1
     classes: tuple = ("interactive",)    # SLO class mix
     class_weights: tuple = ()
+    # per-class prompt-length override (ISSUE 14): one lens tuple per
+    # class (empty tuple = that class uses ``prompt_lens``). The
+    # class-specific draw comes from a DERIVED rng stream, so setting
+    # this never reshuffles a default trace — the loadcheck baseline's
+    # traces stay bit-identical. This is how the two-pool sweep gets its
+    # mixed trace: short interactive prompts, long batch prompts.
+    class_prompt_lens: tuple = ()
     vocab: int = 128                     # body ids in [3, vocab)
     seq_len: int = 0                     # >0: clamp prompt+out to this
 
@@ -88,6 +95,12 @@ class LoadSpec:
             raise ValueError("rate must be > 0 and n_requests >= 1")
         if self.shared_prefix_rate > 0 and self.shared_prefix_len < 1:
             raise ValueError("shared_prefix_rate needs shared_prefix_len")
+        if self.class_prompt_lens \
+                and len(self.class_prompt_lens) != len(self.classes):
+            raise ValueError(
+                f"class_prompt_lens needs one entry per class "
+                f"({len(self.classes)}), got "
+                f"{len(self.class_prompt_lens)}")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -127,6 +140,10 @@ def generate_trace(spec: LoadSpec, seed: int) -> Trace:
     # fixed shared system prompts from a DERIVED stream, so toggling the
     # mix rate does not reshuffle every other draw
     prefix_rng = random.Random(seed ^ 0x5EED)
+    # class-specific prompt lengths likewise ride their own stream: a
+    # spec without class_prompt_lens generates the exact bytes it always
+    # did (the loadcheck baseline's determinism contract)
+    len_rng = random.Random(seed ^ 0xC1A55)
     prefixes = [tuple(prefix_rng.randrange(_ID_LO, spec.vocab)
                       for _ in range(spec.shared_prefix_len))
                 for _ in range(max(1, spec.n_shared_prefixes))]
@@ -147,6 +164,10 @@ def generate_trace(spec: LoadSpec, seed: int) -> Trace:
         o_len = int(_choice(rng, spec.out_lens, spec.out_len_weights))
         body: list = []
         slo_class = str(_choice(rng, spec.classes, spec.class_weights))
+        if spec.class_prompt_lens:
+            lens = spec.class_prompt_lens[spec.classes.index(slo_class)]
+            if lens:
+                p_len = int(_choice(len_rng, tuple(lens), ()))
         if (spec.shared_prefix_rate > 0
                 and rng.random() < spec.shared_prefix_rate):
             body += list(prefixes[rng.randrange(len(prefixes))])
@@ -351,6 +372,182 @@ def drive_engine(engine, trace: Trace, policy, step_cost_s: float = 1.0,
                              prefix_hit_rate=round(a.hit_rate, 4),
                              prefill_tokens_saved=a.tokens_saved,
                              evictions=a.evictions)
+    return result
+
+
+def drive_pools(engines, trace: Trace, policy, mode: str = "colocated",
+                step_cost_s: float = 1.0, chunk_cost_s: float | None = None,
+                handoff_latency_s: float = 1.0,
+                handoff_page_cost_s: float = 0.25,
+                route_min_pages: int = 2,
+                max_iters: int = 1_000_000) -> LoadResult:
+    """Deterministic TWO-POOL virtual-clock replay (ISSUE 14): each pool
+    owns its own clock (they are separate hardware), one scheduler
+    iteration costs ``step_cost_s`` per device step PLUS ``chunk_cost_s``
+    per admission-prefill chunk — charging prefill is the whole point:
+    without it, a colocated engine's prefill interference is invisible
+    to the clock. Discrete-event stepping: the pool with the smaller
+    clock that has work steps next; idle pools jump to their next event.
+
+    ``mode="colocated"``: two independent full engines, arrivals
+    round-robin by index — the equal-hardware baseline.
+    ``mode="disagg"``: engines = (prefill, decode) — every arrival
+    prefills on pool 0 (cut to prompt+1 positions), hands off as its
+    journal-record state, ships its full prompt pages through the wire
+    codec, and lands on pool 1 after ``handoff_latency_s +
+    pages * handoff_page_cost_s`` of modeled DCN time (the decode pool
+    adopts them promotion-pending and PAUSEs the request until they
+    apply). Greedy traces only (a sampled handoff needs a journal for
+    the coin cursor; the CI sweep is greedy).
+
+    TTFT anchors on the pool that sampled the first token (the prefill
+    pool under disagg — the DistServe split); finish stamps on the pool
+    that retired the request."""
+    import sys as _sys
+
+    _sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from distributed_llama_tpu.runtime.continuous import Request
+    from distributed_llama_tpu.runtime.disagg import (
+        decode_request, encode_handoff_pages, entry_for_stub,
+        export_prefix_pages, prefill_stub, stub_needs_handoff)
+    from distributed_llama_tpu.runtime.pagewire import decode_record
+
+    if mode not in ("colocated", "disagg"):
+        raise ValueError(f"unknown two-pool mode {mode!r}")
+    if len(engines) != 2:
+        raise ValueError(f"drive_pools takes exactly 2 engines, "
+                         f"got {len(engines)}")
+    chunk_cost = step_cost_s if chunk_cost_s is None else chunk_cost_s
+    events = sorted(trace.events, key=lambda e: e.t)
+    records = [RequestRecord(index=i, slo_class=e.slo_class, arrival=e.t)
+               for i, e in enumerate(events)]
+    v = [0.0, 0.0]
+    # per-pool arrival queues. Colocated: round-robin by event index.
+    # Disagg: the ROUTING decision — prompts spanning >= route_min_pages
+    # FULL pages take the prefill pool (their long prefill is the
+    # interference source worth quarantining + the handoff ships real
+    # pages); shorter prompts go STRAIGHT to the decode pool, where
+    # their sub-threshold prefill is one cheap inline chunk — handing
+    # those off would ship nothing and re-derive everything.
+    waiting: list = [[], []]
+    page_size = max(engines[0].page_size, 1)
+    for i, e in enumerate(events):
+        if mode == "disagg":
+            n_full = (len(e.tokens) - 1) // page_size
+            pool = 0 if n_full >= route_min_pages else 1
+        else:
+            pool = i % 2
+        waiting[pool].append((e, records[i]))
+    # live work per pool: (req, rec, sampled_before) — sampled_before is
+    # the prefill stub's sampled count a disagg decode req adds to
+    live: list = [[], []]
+    pending: list = []  # disagg: (t_ready, entry, planes, tokens, steps,
+    #                     rec, stub_sampled)
+
+    def outstanding(k: int) -> bool:
+        return engines[k]._n_outstanding() > 0
+
+    def submit_arrivals(k: int) -> None:
+        while waiting[k] and waiting[k][0][0].t <= v[k]:
+            e, rec = waiting[k].pop(0)
+            if mode == "disagg" and k == 0:
+                req, _ = prefill_stub(list(e.tokens), e.steps,
+                                      slo_class=e.slo_class)
+            else:
+                req = Request(tokens=list(e.tokens), steps=e.steps,
+                              slo_class=e.slo_class)
+            engines[k].submit(req)
+            live[k].append((req, rec, 0))
+
+    def ingest_handoffs() -> None:
+        nonlocal pending
+        still = []
+        for item in pending:
+            t_ready, entry, planes, tokens, steps, rec, n0 = item
+            if t_ready > v[1]:
+                still.append(item)
+                continue
+            engines[1].allocator.adopt_remote_pages(
+                tokens[:len(tokens) - 1], planes)
+            req = decode_request(entry, steps)
+            engines[1].submit(req)
+            live[1].append((req, rec, n0))
+        pending = still
+
+    def scan(k: int) -> None:
+        still = []
+        for req, rec, n0 in live[k]:
+            if rec.v_first is None and req.t_first_token:
+                rec.v_first = v[k]
+            if not req.done.is_set():
+                still.append((req, rec, n0))
+                continue
+            if mode == "disagg" and k == 0 and stub_needs_handoff(req):
+                tokens = list(req.tokens)
+                steps = next(e.steps for e, r in
+                             zip(events, records) if r is rec)
+                entry = entry_for_stub(engines[0], req)
+                payloads = export_prefix_pages(engines[0], tokens)
+                planes = [decode_record(r) for r in
+                          encode_handoff_pages(payloads)]
+                t_ready = (v[0] + handoff_latency_s
+                           + len(planes) * handoff_page_cost_s)
+                pending.append((t_ready, entry, planes, tokens, steps,
+                                rec, req.n_sampled))
+                continue
+            rec.v_finish = v[k]
+            rec.n_sampled = n0 + req.n_sampled
+            rec.tokens_out = len(req.out)
+            rec.error = req.error
+        live[k] = still
+
+    for _ in range(max_iters):
+        if mode == "disagg":
+            ingest_handoffs()
+        for k in (0, 1):
+            submit_arrivals(k)
+        todo = [k for k in (0, 1) if outstanding(k)]
+        if todo:
+            k = min(todo, key=lambda p: v[p])
+            eng = engines[k]
+            s0, c0 = eng.stats.steps, eng.stats.prefill_chunks
+            eng.step_many(eng.block_steps, quiet=True)
+            v[k] += (step_cost_s * (eng.stats.steps - s0)
+                     + chunk_cost * (eng.stats.prefill_chunks - c0))
+            scan(k)
+            continue
+        # both pools idle: jump clocks to the next event, or stop
+        jumps = []
+        for k in (0, 1):
+            if waiting[k]:
+                jumps.append((waiting[k][0][0].t, k))
+        if mode == "disagg" and pending:
+            jumps.append((min(p[0] for p in pending), 1))
+        if not jumps:
+            if not (live[0] or live[1] or pending):
+                break
+            raise RuntimeError("drive_pools: live work but no pool has "
+                               "anything to step — scheduler wedged")
+        t_next, k = min(jumps)
+        v[k] = max(v[k], t_next)
+    else:
+        raise RuntimeError(
+            f"drive_pools: work still live after {max_iters} iterations")
+    result = _finalize(records, policy, duration=max(max(v), 1e-9),
+                       offered=trace.offered_rate)
+    pools = []
+    for k, eng in enumerate(engines):
+        st = eng.stats
+        pools.append({"steps": st.steps,
+                      "prefill_chunks": st.prefill_chunks,
+                      "pauses": st.pauses, "requeues": st.requeues,
+                      "max_active": st.max_active,
+                      "virtual_s": round(v[k], 4)})
+    result.engine = {"mode": mode, "pools": pools}
+    if mode == "disagg" and engines[1].allocator is not None:
+        a = engines[1].allocator
+        result.engine.update(pages_adopted=a.remote_adopted,
+                             decode_prefix_hits=a.prefix_hits)
     return result
 
 
