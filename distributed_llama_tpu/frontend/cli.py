@@ -784,6 +784,16 @@ def cmd_serve(argv: list[str]) -> int:
                          "spanning >= N full KV pages; shorter prompts "
                          "prefill locally — handing them off would ship "
                          "nothing and re-derive everything")
+    ap.add_argument("--flightrec", default=None, metavar="DIR",
+                    help="crash-forensics flight recorder (ISSUE 15, "
+                         "obs/flightrec.py): drop a postmortem bundle "
+                         "(recent spans + metrics snapshot + journal "
+                         "tail + config fingerprint) into DIR when the "
+                         "step watchdog fires, on the SIGTERM drain, "
+                         "and on each --supervise crash-loop respawn; "
+                         "validate bundles with tools/tracecheck.py "
+                         "(the ring records either way; DIR enables "
+                         "the files)")
     _obs_flags(ap)
     args = ap.parse_args(argv)
     if args.supervise:
@@ -793,7 +803,8 @@ def cmd_serve(argv: list[str]) -> int:
         from ..runtime.supervisor import serve_child_cmd, supervise
 
         return supervise(serve_child_cmd(argv),
-                         max_restarts=args.max_restarts)
+                         max_restarts=args.max_restarts,
+                         flightrec_dir=args.flightrec)
     _apply_log_json(args)
     if args.kv_quant:
         os.environ["DLLAMA_KV_QUANT"] = args.kv_quant
@@ -949,7 +960,8 @@ def cmd_serve(argv: list[str]) -> int:
                                  disagg_role=args.disagg_role,
                                  disagg_peer=args.disagg_peer,
                                  page_channel_port=args.page_channel_port,
-                                 handoff_min_pages=args.handoff_min_pages)
+                                 handoff_min_pages=args.handoff_min_pages,
+                                 flightrec_dir=args.flightrec)
     except Exception as e:
         from ..runtime.journal import JournalConfigMismatch
 

@@ -22,7 +22,19 @@ instrument is one end-of-run benchmark line, tokenizer.cpp:381):
 * ``obs.slo`` — declarative SLO policies (priority classes with TTFT +
   per-token budgets) and the per-request verdict tracker behind
   ``dllama_slo_requests_total{class,verdict}`` / goodput accounting and
-  the /health "slo" block (tools/loadcheck.py's gate).
+  the /health "slo" block (tools/loadcheck.py's gate);
+* ``obs.tracectx`` — the W3C-traceparent-style distributed trace
+  context (one id producer; minted at request ingress, carried through
+  journal records, the disagg handoff, and the page channel so a
+  recovered/handed-off request continues the SAME trace —
+  ``tools/tracejoin.py`` stitches two pools' exports on it);
+* ``obs.flightrec`` — the crash-forensics flight recorder: always-on
+  event ring dumped as a postmortem bundle (spans + metrics + journal
+  tail + config fingerprint) on watchdog trips, SIGTERM drains, and
+  crash-loop respawns, validated by ``tools/tracecheck.py``;
+* ``obs.fleet`` — the fleet signal plane: per-replica /health+/metrics
+  rows + count-summed rollups (``tools/fleetcheck.py``; the signal
+  surface the multi-replica router consumes).
 
 Collection is opt-in: hot paths hold a None handle when disabled and make
 zero registry calls (tests/test_obs.py pins this).

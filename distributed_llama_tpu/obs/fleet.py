@@ -1,0 +1,241 @@
+"""Fleet signal plane: scrape N replicas' /health + /metrics, roll up
+(ISSUE 15).
+
+Everything the observability stack exports is per-engine; ROADMAP item 3
+(the cache-aware multi-replica router) needs the FLEET view — live
+per-replica rows (``kv_pages_free``, ``queue_depth``, goodput, prefix-
+tree occupancy) plus fleet rollups (attainment, goodput, pages free,
+prefix-tree hit rates). This module is that aggregation layer, shaped so
+the router can consume it directly:
+
+* ``ReplicaSignals`` — one replica's row, built from the server's
+  /health JSON (``signals_from_health``) or a live scrape
+  (``scrape_replica``, which also parses /metrics through
+  ``parse_metrics`` and cross-fills counter-backed fields);
+* ``rollup`` — the fleet aggregate. Ratios are recomputed from summed
+  COUNTS (fleet attainment = Σmet/Σattempted, fleet hit rate =
+  Σhits/Σattempts), never averaged from per-replica ratios — a drained
+  replica's 1.0 attainment must not launder a loaded replica's 0.5;
+* ``tools/fleetcheck.py`` drives it two ways: a wall-clock scrape of
+  real servers, and the CI-gated VIRTUAL-CLOCK multi-replica loadgen
+  sim — deterministic rows on CPU today (same seed ⇒ identical row),
+  which is what makes the rollup math gateable before any multi-host
+  session exists.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import urllib.request
+
+# the row fields a scheduling router reads hot (documented as ONE list so
+# the router and the aggregator cannot drift on what "the signals" are)
+ROUTER_SIGNALS = ("kv_pages_free", "queue_depth", "active", "occupancy",
+                  "goodput_tokens", "prefix_hit_rate")
+
+
+@dataclasses.dataclass
+class ReplicaSignals:
+    """One replica's live signal row. ``healthy`` False (with ``error``
+    set) marks a replica the scrape could not read — its numeric fields
+    are zeros and the rollup counts it unhealthy instead of treating a
+    dead box as an idle one."""
+
+    name: str
+    healthy: bool = True
+    error: str | None = None
+    state: str = ""
+    uptime_s: float = 0.0
+    slots: int = 0
+    active: int = 0
+    queue_depth: int = 0
+    occupancy: float = 0.0
+    steps: int = 0
+    generated_tokens: int = 0
+    kv_pages: int = 0
+    kv_pages_free: int = 0
+    prefix_hits: int = 0
+    prefix_misses: int = 0
+    prefill_tokens_saved: int = 0
+    goodput_tokens: int = 0
+    # class -> {"attempted", "met", "violated", "failed",
+    #           "goodput_tokens"} (the /health slo block's counts)
+    slo: dict = dataclasses.field(default_factory=dict)
+
+    @property
+    def prefix_hit_rate(self) -> float:
+        n = self.prefix_hits + self.prefix_misses
+        return self.prefix_hits / n if n else 0.0
+
+    def to_json(self) -> dict:
+        out = dataclasses.asdict(self)
+        out["prefix_hit_rate"] = round(self.prefix_hit_rate, 6)
+        out["occupancy"] = round(self.occupancy, 6)
+        out["uptime_s"] = round(self.uptime_s, 3)
+        return out
+
+
+@dataclasses.dataclass
+class FleetRollup:
+    """The fleet aggregate row — sums of counts, ratios recomputed from
+    the sums (class docstring of this module)."""
+
+    replicas: int = 0
+    healthy: int = 0
+    slots: int = 0
+    active: int = 0
+    queue_depth: int = 0
+    steps: int = 0
+    generated_tokens: int = 0
+    kv_pages: int = 0
+    kv_pages_free: int = 0
+    prefix_hits: int = 0
+    prefix_misses: int = 0
+    prefill_tokens_saved: int = 0
+    goodput_tokens: int = 0
+    slo: dict = dataclasses.field(default_factory=dict)
+
+    @property
+    def occupancy(self) -> float:
+        return self.active / self.slots if self.slots else 0.0
+
+    @property
+    def prefix_hit_rate(self) -> float:
+        n = self.prefix_hits + self.prefix_misses
+        return self.prefix_hits / n if n else 0.0
+
+    @property
+    def attainment(self) -> dict:
+        out = {}
+        for cls, counts in sorted(self.slo.items()):
+            attempted = counts.get("attempted", 0)
+            out[cls] = (round(counts.get("met", 0) / attempted, 6)
+                        if attempted else 1.0)
+        return out
+
+    def to_json(self) -> dict:
+        out = dataclasses.asdict(self)
+        out["occupancy"] = round(self.occupancy, 6)
+        out["prefix_hit_rate"] = round(self.prefix_hit_rate, 6)
+        out["attainment"] = self.attainment
+        return out
+
+
+def rollup(rows: list) -> FleetRollup:
+    """Aggregate replica rows into the fleet row. Unhealthy replicas
+    contribute only to the replica/healthy counts — their zeroed
+    signals must not dilute occupancy or hit rates."""
+    agg = FleetRollup(replicas=len(rows))
+    for r in rows:
+        if not r.healthy:
+            continue
+        agg.healthy += 1
+        agg.slots += r.slots
+        agg.active += r.active
+        agg.queue_depth += r.queue_depth
+        agg.steps += r.steps
+        agg.generated_tokens += r.generated_tokens
+        agg.kv_pages += r.kv_pages
+        agg.kv_pages_free += r.kv_pages_free
+        agg.prefix_hits += r.prefix_hits
+        agg.prefix_misses += r.prefix_misses
+        agg.prefill_tokens_saved += r.prefill_tokens_saved
+        agg.goodput_tokens += r.goodput_tokens
+        for cls, counts in r.slo.items():
+            cell = agg.slo.setdefault(cls, {})
+            for key, v in counts.items():
+                if isinstance(v, (int, float)) and not key.endswith("_s"):
+                    cell[key] = cell.get(key, 0) + v
+    return agg
+
+
+def signals_from_health(name: str, payload: dict) -> ReplicaSignals:
+    """Build a replica row from the server's /health JSON (the shape
+    runtime/server.py emits — pinned by tests against a live server so
+    a /health rename breaks HERE, not silently in a router)."""
+    row = ReplicaSignals(name=name)
+    row.state = str(payload.get("state", ""))
+    row.healthy = row.state in ("starting", "serving", "degraded")
+    row.uptime_s = float(payload.get("uptime_s", 0.0))
+    row.slots = int(payload.get("slots", 0))
+    row.active = int(payload.get("active", 0))
+    row.queue_depth = int(payload.get("queue_depth",
+                                      payload.get("queued", 0)))
+    row.occupancy = float(payload.get("occupancy", 0.0))
+    row.steps = int(payload.get("steps", 0))
+    row.generated_tokens = int(payload.get("generated_tokens", 0))
+    paged = payload.get("paged_kv") or {}
+    row.kv_pages = int(paged.get("pages", 0))
+    row.kv_pages_free = int(paged.get("pages_free", 0))
+    row.prefix_hits = int(paged.get("prefix_hits", 0))
+    row.prefix_misses = int(paged.get("prefix_misses", 0))
+    row.prefill_tokens_saved = int(paged.get("prefill_tokens_saved", 0))
+    slo = payload.get("slo") or {}
+    for cls, cell in (slo.get("classes") or {}).items():
+        row.slo[cls] = {k: int(cell.get(k, 0))
+                        for k in ("attempted", "met", "violated",
+                                  "failed", "goodput_tokens")}
+        row.goodput_tokens += row.slo[cls]["goodput_tokens"]
+    return row
+
+
+def parse_metrics(text: str) -> dict:
+    """Prometheus text exposition -> {series_key: float} (series key =
+    ``name{labels}`` exactly as exposed). Tolerant of HELP/TYPE lines;
+    raises ValueError on an unparseable sample — a half-read scrape
+    feeding a router is worse than a failed one."""
+    out: dict = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        key, _, value = line.rpartition(" ")
+        if not key:
+            raise ValueError(f"sample line without a name: {line!r}")
+        try:
+            out[key] = float(value)
+        except ValueError as e:
+            raise ValueError(f"unparseable sample {line!r}") from e
+    return out
+
+
+def apply_metrics(row: ReplicaSignals, samples: dict) -> ReplicaSignals:
+    """Cross-fill counter-backed fields from a parsed /metrics scrape —
+    the counters a /health snapshot doesn't carry (spans_dropped and
+    friends stay available to callers via ``samples`` itself; this
+    fills only the router-facing row)."""
+    if "dllama_prefix_hits_total" in samples:
+        row.prefix_hits = int(samples["dllama_prefix_hits_total"])
+    if "dllama_kv_pages_free" in samples:
+        row.kv_pages_free = int(samples["dllama_kv_pages_free"])
+    if "dllama_queue_depth" in samples:
+        row.queue_depth = int(samples["dllama_queue_depth"])
+    goodput = sum(v for k, v in samples.items()
+                  if k.startswith("dllama_goodput_tokens_total"))
+    if goodput:
+        row.goodput_tokens = int(goodput)
+    return row
+
+
+def scrape_replica(name: str, base_url: str,
+                   timeout: float = 5.0) -> ReplicaSignals:
+    """One replica's row from a live server: GET /health (+ /metrics
+    when served). Any failure yields an UNHEALTHY row with ``error``
+    set — the fleet plane reports dead replicas, it never hides them."""
+    base = base_url.rstrip("/")
+    try:
+        with urllib.request.urlopen(f"{base}/health",
+                                    timeout=timeout) as r:
+            health = json.loads(r.read())
+        row = signals_from_health(name, health)
+    except (OSError, ValueError) as e:
+        return ReplicaSignals(name=name, healthy=False,
+                              error=f"{type(e).__name__}: {e}")
+    try:
+        with urllib.request.urlopen(f"{base}/metrics",
+                                    timeout=timeout) as r:
+            apply_metrics(row, parse_metrics(r.read().decode()))
+    except (OSError, ValueError):
+        pass  # metrics disabled (--no-metrics) — /health alone suffices
+    return row

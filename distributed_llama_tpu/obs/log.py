@@ -31,17 +31,24 @@ def json_mode() -> bool:
 
 
 def log_event(event: str, text: str | None = None, *, file=None,
-              **fields) -> None:
+              trace=None, **fields) -> None:
     """Emit one log line: NDJSON in json_mode(), else the human text.
 
     ``file`` defaults to stdout (the emoji sites' stream); pass
-    ``sys.stderr`` for diagnostics. Non-JSON-serializable field values
-    degrade to ``repr`` rather than raising — a log line must never take
-    down the loop that emits it.
+    ``sys.stderr`` for diagnostics. ``trace`` (an obs/tracectx
+    TraceContext) stamps the record with ``trace_id``/``span_id`` from
+    the ONE id producer, so NDJSON logs join span timelines and journal
+    records by id (ISSUE 15 satellite). Non-JSON-serializable field
+    values degrade to ``repr`` rather than raising — a log line must
+    never take down the loop that emits it.
     """
     out = sys.stdout if file is None else file
     if json_mode():
         rec = {"ts": round(time.time(), 6), "event": event}
+        if trace is not None:
+            from .tracectx import span_fields
+
+            rec.update(span_fields(trace))
         try:
             from ..utils.fingerprint import run_stamp
 

@@ -78,14 +78,21 @@ class SpanTracer:
     records the completed span on exit (exceptions included — a failed
     step still shows up in the timeline, with ``error`` in its meta).
     Each thread keeps its own nesting stack; the buffer is a deque so a
-    long-lived server holds the most recent ``capacity`` spans only.
+    long-lived server holds the most recent ``capacity`` spans only —
+    and overflow is COUNTED, not silent (ISSUE 15 satellite): every
+    span the ring evicted bumps ``dropped`` (mirrored into
+    ``dllama_spans_dropped_total`` via ``on_drop``) and every export
+    carries the count, so a truncated timeline reads as truncated
+    instead of quietly misleading.
     """
 
-    def __init__(self, capacity: int = 4096):
+    def __init__(self, capacity: int = 4096, on_drop=None):
         self._spans: deque = deque(maxlen=capacity)
         self._lock = threading.Lock()
         self._local = threading.local()
         self.epoch = time.perf_counter()
+        self.dropped = 0       # spans evicted by the ring bound
+        self.on_drop = on_drop  # e.g. the dllama_spans_dropped_total .inc
 
     def _stack(self) -> list:
         st = getattr(self._local, "stack", None)
@@ -115,34 +122,59 @@ class SpanTracer:
         window derived from its lifecycle timestamps at retirement)."""
         sp = Span(name, cat, t_start, max(dur_s, 0.0),
                   threading.get_ident(), depth, meta)
+        overflowed = False
         with self._lock:
+            if (self._spans.maxlen is not None
+                    and len(self._spans) == self._spans.maxlen):
+                # the append below evicts the oldest span: the ring
+                # overflow the exports must report
+                self.dropped += 1
+                overflowed = True
             self._spans.append(sp)
+        if overflowed and self.on_drop is not None:
+            self.on_drop()
 
-    def snapshot(self) -> list:
+    def snapshot(self, trace_id: str | None = None) -> list:
+        """Recorded spans, oldest first; ``trace_id`` filters to one
+        trace's spans (the ``/debug/timeline?trace=<id>`` view)."""
         with self._lock:
-            return list(self._spans)
+            spans = list(self._spans)
+        if trace_id is not None:
+            spans = [s for s in spans
+                     if s.meta.get("trace_id") == trace_id]
+        return spans
 
     def clear(self) -> None:
         with self._lock:
             self._spans.clear()
+            self.dropped = 0
 
     # -- exports -----------------------------------------------------------
 
-    def export_chrome(self) -> dict:
+    def export_chrome(self, trace_id: str | None = None) -> dict:
         """Chrome-trace (Perfetto-loadable) JSON object: complete ('X')
-        events, ts/dur in microseconds relative to the tracer epoch."""
-        return spans_to_chrome(self.snapshot(), self.epoch)
+        events, ts/dur in microseconds relative to the tracer epoch. The
+        top-level ``dropped`` field counts ring-evicted spans — a viewer
+        (or CI) can tell a short timeline from a truncated one."""
+        doc = spans_to_chrome(self.snapshot(trace_id), self.epoch)
+        doc["dropped"] = self.dropped
+        return doc
 
-    def export_ndjson(self) -> str:
-        """One JSON object per span per line — the log-shipper export."""
+    def export_ndjson(self, trace_id: str | None = None) -> str:
+        """One JSON object per span per line — the log-shipper export
+        (and tools/tracejoin.py's input). A final ``_meta`` record
+        reports ring overflow whenever any span was dropped."""
         out = []
-        for s in self.snapshot():
+        for s in self.snapshot(trace_id):
             rec = {"span": s.name, "cat": s.cat,
                    "t_start_s": round(s.t_start - self.epoch, 6),
                    "dur_ms": round(s.dur_s * 1e3, 3),
                    "tid": s.tid, "depth": s.depth}
             rec.update(s.meta)
             out.append(json.dumps(rec))
+        if self.dropped:
+            out.append(json.dumps({"span": "_meta", "cat": "meta",
+                                   "dropped": self.dropped}))
         return "\n".join(out) + ("\n" if out else "")
 
 

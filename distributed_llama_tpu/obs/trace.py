@@ -159,6 +159,14 @@ class EngineMetrics:
                 "host/disk rows are recomputes the tier hierarchy "
                 "rescued from drop-on-evict")
             for tier in ("hbm", "host", "disk")}
+        # span-ring overflow (ISSUE 15 satellite): spans the bounded
+        # timeline ring evicted — a /debug/timeline scrape that shows N
+        # spans with this counter moving is a TRUNCATED window, not the
+        # whole story (the exports carry the same count inline)
+        self.spans_dropped = c(
+            "dllama_spans_dropped_total",
+            "Timeline spans evicted by the SpanTracer ring bound "
+            "(exports also carry the count as a 'dropped' field)")
         # crash-safety instruments (ISSUE 9): journal append volume and
         # journal-replayed re-admissions. Pre-registered at zero like the
         # rest — a journal-less engine still exposes them, so dashboards

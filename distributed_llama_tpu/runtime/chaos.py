@@ -66,12 +66,18 @@ class ChaosMonkey:
     # only the bitwise stream gate can catch (the kill_mid_handoff
     # drill's mutation arm)
     drop_page_in_flight: bool = False
+    # distributed tracing (ISSUE 15): strip the traceparent header at
+    # the handoff seam — the decode pool's spans then cannot join the
+    # prefill pool's, which tools/tracejoin.py must report as orphan
+    # spans (the trace-propagation gate's mutation arm)
+    drop_traceparent: bool = False
     # injection counters (read by drills / surfaced in loadcheck rows)
     injected_delays: int = 0
     denied_allocs: int = 0
     leaked_pages: list = dataclasses.field(default_factory=list)
     dropped_demotions: int = 0
     dropped_pages: int = 0
+    dropped_traceparents: int = 0
     _dispatches: int = 0
 
     def on_dispatch(self) -> None:
@@ -117,13 +123,24 @@ class ChaosMonkey:
             return True
         return False
 
+    def trace_drop(self) -> bool:
+        """Handoff-seam hook (runtime/disagg.DisaggPair.handoff, the
+        server's POST /prefill): True = this hand-over loses its
+        traceparent header — the trace-continuity break the tracejoin
+        orphan gate (ISSUE 15) must catch."""
+        if self.drop_traceparent:
+            self.dropped_traceparents += 1
+            return True
+        return False
+
     def injection_summary(self) -> dict:
         return {"dispatches": self._dispatches,
                 "injected_delays": self.injected_delays,
                 "denied_allocs": self.denied_allocs,
                 "leaked_pages": len(self.leaked_pages),
                 "dropped_demotions": self.dropped_demotions,
-                "dropped_pages": self.dropped_pages}
+                "dropped_pages": self.dropped_pages,
+                "dropped_traceparents": self.dropped_traceparents}
 
     @classmethod
     def parse(cls, text: str) -> "ChaosMonkey":
@@ -143,13 +160,14 @@ class ChaosMonkey:
             elif key in ("step_delay_every", "deny_pages"):
                 kw[key] = int(val)
             elif key in ("leak_on_cancel", "drop_on_demote",
-                         "drop_page_in_flight"):
+                         "drop_page_in_flight", "drop_traceparent"):
                 kw[key] = val.strip().lower() not in ("0", "false", "")
             else:
                 raise ValueError(
                     f"unknown chaos knob {key!r} (have step_delay_every, "
                     f"step_delay_ms, deny_pages, leak_on_cancel, "
-                    f"drop_on_demote, drop_page_in_flight)")
+                    f"drop_on_demote, drop_page_in_flight, "
+                    f"drop_traceparent)")
         return cls(**kw)
 
 
@@ -595,7 +613,8 @@ def drill_kill_mid_decode(make_engine, inject=frozenset()) -> DrillResult:
     # recovery: reopen the journal (torn-tail repair happens here; any
     # deeper corruption raises and the gate goes red), re-admit, drain
     journal = RequestJournal(jpath)
-    replayed = sum(len(e.sampled) for e in journal.incomplete())
+    pre_entries = journal.incomplete()
+    replayed = sum(len(e.sampled) for e in pre_entries)
     eng = _recovery_engine(journal=journal)
     n_recovered = eng.recover()
     with eng._lock:
@@ -610,11 +629,58 @@ def drill_kill_mid_decode(make_engine, inject=frozenset()) -> DrillResult:
                 f"recovered stream {i} diverged from the uninterrupted "
                 f"reference (first {min(len(req.out), len(ref_outs[i]))} "
                 f"positions compared)")
+    # trace continuity across the SIGKILL seam (ISSUE 15): the continued
+    # life must keep the trace_id the killed process journaled, in a new
+    # span linked 'recovers' — the cross-process join depends on it
+    violations += _trace_continuity_violations(recovered, pre_entries,
+                                               "recovers")
+    if eng._spans is not None and recovered:
+        links = [s for s in eng._spans.snapshot() if s.cat == "link"
+                 and s.name == "recovers"]
+        if len(links) != len(recovered):
+            violations.append(
+                f"expected {len(recovered)} 'recovers' link spans, "
+                f"got {len(links)}")
     res = _result("kill_mid_decode", eng, None,
                   extra_violations=violations,
                   recovered=n_recovered, replayed_tokens=replayed)
     journal.close()
     return res
+
+
+def _trace_continuity_violations(recovered, entries, link: str) -> list:
+    """Shared seam check (ISSUE 15): each recovered/handed-off request
+    must continue its journaled trace_id in a new span carrying the
+    expected continuation link."""
+    from ..obs import tracectx
+
+    violations = []
+    by_trace = {}
+    for e in entries:
+        if e.trace is None:
+            violations.append(f"journaled request {e.rid} carries no "
+                              f"trace header")
+            continue
+        try:
+            by_trace[tracectx.parse_header(e.trace).trace_id] = e.rid
+        except ValueError as exc:
+            # recover() tolerates a damaged header (it never blocks
+            # recovery); the drill must report it red, not crash
+            violations.append(f"journaled request {e.rid} carries a "
+                              f"malformed trace header: {exc}")
+    for req in recovered:
+        if req.trace is None:
+            violations.append("recovered request carries no trace context")
+        elif req.trace.trace_id not in by_trace:
+            violations.append(
+                f"recovered request's trace {req.trace.trace_id} matches "
+                f"no journaled trace — the continuation re-minted instead "
+                f"of continuing")
+        elif req.trace.link != link:
+            violations.append(
+                f"recovered request's trace link is {req.trace.link!r}, "
+                f"expected {link!r}")
+    return violations
 
 
 def drill_journal_wal(make_engine) -> DrillResult:
@@ -961,11 +1027,28 @@ def drill_kill_mid_handoff(make_engine, inject=frozenset()) -> DrillResult:
     # restart: fresh decode pool on the same journal; recovery re-admits,
     # the channel still holds the unacked page records — re-fetch + adopt
     journal_b = RequestJournal(jd_path)
+    pre_entries = journal_b.incomplete()
     decode_b = _disagg_decode_engine(journal_b)
     n_rec = decode_b.recover()
     with decode_b._lock:
         recovered = list(decode_b._queue)
+    from ..obs import tracectx as _tracectx
+
     for stub, steps in stubs:
+        # the channel serves the handoff's trace identity NEXT TO its
+        # pages (the TRACE command) — the restarted pool cross-checks it
+        # against the trace the prefill stub opened before adopting
+        # (fetch first: a completed fetch ACKs and retires the record)
+        hdr = pair._client.trace(f"h{stub.index}")
+        if hdr is None:
+            violations.append(f"page channel lost the trace header for "
+                              f"handoff h{stub.index}")
+        elif _tracectx.parse_header(hdr).trace_id \
+                != stub.trace.trace_id:
+            violations.append(
+                f"page channel trace for h{stub.index} does not match "
+                f"the prefill stub's trace — the shipped pages would "
+                f"join the wrong trace")
         records = pair._client.fetch(f"h{stub.index}")
         if records:
             decode_b.allocator.adopt_remote_pages(
@@ -994,6 +1077,12 @@ def drill_kill_mid_handoff(make_engine, inject=frozenset()) -> DrillResult:
     if decode_b.allocator.remote_adopted == 0 and not violations:
         violations.append("no pages were adopted on the restarted decode "
                           "pool — the re-fetch path never ran")
+    # trace continuity across kill-mid-handoff (ISSUE 15): the decode
+    # journal's admits carried the trace the PREFILL pool opened (same
+    # trace_id, handoff-linked); the restarted pool's recovery must
+    # continue it again (now 'recovers'-linked — the second seam)
+    violations += _trace_continuity_violations(recovered, pre_entries,
+                                               "recovers")
     for name, eng in (("prefill", prefill), ("decode", decode_b)):
         for p in eng.audit_pages():
             violations.append(f"{name} pool audit: {p}")

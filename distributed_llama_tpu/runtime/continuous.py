@@ -33,6 +33,7 @@ import numpy as np
 
 from ..io.tokenizer import BOS
 from ..models.spec import TransformerSpec
+from ..obs import tracectx
 from .sampling import Sampler
 
 
@@ -75,6 +76,12 @@ class Request:
     # and journaled its admit record — submit() then only queues it
     # (appending a second admit would corrupt the journal)
     prejournaled: bool = False
+    # distributed-trace identity (ISSUE 15, obs/tracectx.TraceContext):
+    # minted at request ingress (runtime/server.py) or by submit() when
+    # absent; carried into every span this request produces, the journal
+    # admit record, and the handoff wire form — a recovered/handed-off
+    # continuation keeps the SAME trace_id with a recovers/handoff link
+    trace: Any = None
     # streaming hook: called from the scheduler thread with each token as it
     # lands in ``out`` (prompt echoes included, prefill echoes in one burst);
     # must be fast and must not raise — it runs inside the decode loop
@@ -578,8 +585,9 @@ class ContinuousEngine:
                 self._obs.bind_kv_pool(kv_quant, pool_bytes,
                                        self._alloc.n_pages + 1)
             # the span timeline (GET /debug/timeline) rides the same
-            # opt-in: a disabled engine records nothing
-            self._spans = SpanTracer()
+            # opt-in: a disabled engine records nothing. Ring overflow
+            # feeds dllama_spans_dropped_total (ISSUE 15 satellite).
+            self._spans = SpanTracer(on_drop=self._obs.spans_dropped.inc)
             if mesh is not None and mesh.shape["tp"] > 1:
                 # export the analytic collective schedule as labeled
                 # /metrics series — the budget the drift gate (obs/drift)
@@ -1194,6 +1202,10 @@ class ContinuousEngine:
         with self._lock:
             req.index = self._submitted
             self._submitted += 1
+        if req.trace is None:
+            # mint BEFORE the admit lands: the durable record must carry
+            # the trace identity a post-crash recovery continues
+            req.trace = tracectx.mint()
         self._journal_admit(req)
         self._journal.sync(force=True)  # durable BEFORE any page moves
         req.prejournaled = True
@@ -1218,7 +1230,23 @@ class ContinuousEngine:
             seed=(req.seed if req.seed is not None
                   else self.seed + req.index),
             slo=req.slo_class, cursor=req.coin_cursor,
-            recovers=req.recovered_from)
+            recovers=req.recovered_from,
+            trace=(req.trace.to_header() if req.trace is not None
+                   else None))
+
+    def _trace_admit(self, req: Request) -> None:
+        """Trace bookkeeping at the one request entry point (ISSUE 15):
+        mint a root context for requests that arrived without one (the
+        server minted at HTTP ingress; offline/test paths mint here),
+        and materialize a continuation LINK span — zero-duration, cat
+        'link' — when this life crossed a seam (recovers/handoff), so
+        the joined timeline shows WHERE the trace changed processes."""
+        if req.trace is None:
+            req.trace = tracectx.mint()
+        if self._spans is not None and req.trace.link:
+            self._spans.add(req.trace.link, "link", time.perf_counter(),
+                            0.0, index=req.index,
+                            **tracectx.span_fields(req.trace))
 
     def submit(self, req: Request) -> Request:
         """Queue a request (thread-safe; HTTP handler threads call this while
@@ -1226,6 +1254,7 @@ class ContinuousEngine:
         if not req.tokens:
             raise ValueError("request has no prompt tokens")
         if req.prejournaled:
+            self._trace_admit(req)
             # index + admit record already durable (prejournal): queue
             with self._lock:
                 self._queue.append(req)
@@ -1236,6 +1265,8 @@ class ContinuousEngine:
         with self._lock:
             req.index = self._submitted
             self._submitted += 1
+        self._trace_admit(req)  # before the journal admit: the durable
+        #                         record carries the trace identity
         if self._journal is not None:
             # write-AHEAD means ahead of the SCHEDULER ever seeing the
             # request: the admit record (with the RESOLVED sampler config
@@ -1310,10 +1341,20 @@ class ContinuousEngine:
         else:
             journal.adopt_config()
         for e in entries:
+            trace = None
+            if e.trace:
+                try:
+                    # continue the SAME trace: new span parented on the
+                    # journaled one, linked 'recovers' (ISSUE 15)
+                    trace = tracectx.from_header(
+                        e.trace, link=tracectx.LINK_RECOVERS)
+                except ValueError:
+                    trace = None  # a damaged header never blocks recovery
             req = Request(tokens=e.replay_tokens, steps=e.steps,
                           temperature=e.temperature, topp=e.topp,
                           seed=e.seed, slo_class=e.slo,
-                          coin_cursor=e.cursor, recovered_from=e.rid)
+                          coin_cursor=e.cursor, recovered_from=e.rid,
+                          trace=trace)
             self.submit(req)
             if self._obs is not None:
                 self._obs.recoveries.inc()
@@ -1699,7 +1740,8 @@ class ContinuousEngine:
                 if paged and self.kv_quant == "f32" else None)
         end = n_pre
         with self._span("prefill", "prefill", slot=slot_index,
-                        tokens=n_pre - start):
+                        tokens=n_pre - start,
+                        **tracectx.span_fields(s.req.trace)):
             if paged:
                 # seed a virtual contiguous sequence cache from the slot's
                 # pages: the unshared-suffix chunks attend over the shared
@@ -1850,7 +1892,8 @@ class ContinuousEngine:
             self._spans.add("request", "request", start, dur,
                             index=s.req.index, tokens=len(s.req.out),
                             sampled=s.req.n_sampled,
-                            cancelled=s.req.cancelled)
+                            cancelled=s.req.cancelled,
+                            **tracectx.span_fields(s.req.trace))
         s.req.done.set()
         s.req = None
         # park the freed slot at pos 0: a retired row's clock can equal

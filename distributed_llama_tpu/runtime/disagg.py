@@ -47,10 +47,19 @@ from __future__ import annotations
 import dataclasses
 import time
 
+from ..obs import tracectx
 from .continuous import ContinuousEngine, Request
 from .journal import JournalEntry
 
 HANDOFF_VERDICTS = ("shipped", "local", "failed")
+
+# the cross-pool clock-skew anchor pair (ISSUE 15): the initiating pool
+# records SPAN_HANDOFF_SEND around the transfer, the serving pool records
+# SPAN_HANDOFF_RECV parented on it — tools/tracejoin.py aligns the two
+# pools' clocks on exactly this pair and refuses when it is missing
+SPAN_HANDOFF_SEND = "handoff"
+SPAN_HANDOFF_RECV = "prefill_handoff"
+HANDOFF_CAT = "handoff"
 
 
 class DisaggMetrics:
@@ -155,18 +164,29 @@ def entry_for_stub(engine: ContinuousEngine, stub: Request) -> JournalEntry:
         topp=stub.topp if stub.topp is not None else engine.topp,
         seed=(stub.seed if stub.seed is not None
               else engine.seed + stub.index),
-        slo=stub.slo_class, cursor=0, sampled=list(stub.out[n_pre:]))
+        slo=stub.slo_class, cursor=0, sampled=list(stub.out[n_pre:]),
+        trace=(stub.trace.to_header() if stub.trace is not None
+               else None))
 
 
 def decode_request(entry: JournalEntry, steps: int) -> Request:
     """The decode pool's re-admission request: the recovery replay shape
     (already-sampled tokens ride the forced window, the sampler
     fast-forwards by the coin cursor) with the ORIGINAL step budget —
-    the stub's budget was the prefill cut, not the request's."""
+    the stub's budget was the prefill cut, not the request's. The
+    entry's traceparent (when the prefill pool propagated one) continues
+    the SAME trace with a ``handoff`` link span (ISSUE 15)."""
+    trace = None
+    if entry.trace:
+        try:
+            trace = tracectx.from_header(entry.trace,
+                                         link=tracectx.LINK_HANDOFF)
+        except ValueError:
+            trace = None  # a damaged header never blocks the handoff
     return Request(tokens=entry.replay_tokens, steps=steps,
                    temperature=entry.temperature, topp=entry.topp,
                    seed=entry.seed, slo_class=entry.slo,
-                   coin_cursor=entry.cursor)
+                   coin_cursor=entry.cursor, trace=trace)
 
 
 def make_priority_hold(engine: ContinuousEngine, policy):
@@ -298,8 +318,27 @@ class DisaggPair:
             return None
         t0 = time.monotonic()
         entry = entry_for_stub(self.prefill, stub)
+        # trace propagation across the hand-over (ISSUE 15): the page
+        # transfer rides its own RPC span — the send/recv anchor pair
+        # tools/tracejoin.py aligns the two pools' clocks on. The
+        # drop-traceparent mutation (ChaosMonkey.trace_drop) strips the
+        # header at exactly this seam, so the decode pool's spans can no
+        # longer join the prefill pool's — the orphan the join gate must
+        # catch.
+        parent = None
+        if entry.trace:
+            try:
+                parent = tracectx.parse_header(entry.trace)
+            except ValueError:
+                parent = None
+        dropped = self._chaos is not None and self._chaos.trace_drop()
+        if dropped:
+            entry.trace = None
+        rpc = parent.child() if parent is not None else tracectx.mint()
+        t_send0 = time.perf_counter()
         req = decode_request(entry, steps)
         self.decode.submit(req)  # journal admit lands FIRST (durability)
+        t_recv0 = time.perf_counter()
         payloads = export_prefix_pages(self.prefill, stub.tokens)
         records = encode_handoff_pages(
             payloads, corrupt=(self._chaos.page_drop
@@ -310,7 +349,9 @@ class DisaggPair:
             self.obs.bytes_shipped.inc(nbytes)
         if self._server is not None:
             hid = f"h{stub.index}"
-            self._server.publish(hid, records)
+            self._server.publish(hid, records,
+                                 trace=(None if dropped
+                                        else rpc.to_header()))
             if self.obs is not None:
                 self.obs.queue_depth.set(self._server.queue_depth)
             planes = self._client.fetch(hid, len(records),
@@ -319,8 +360,23 @@ class DisaggPair:
             if cut_after is not None:
                 records = records[:cut_after]
             planes = [decode_record(r) for r in records]
+        if self.prefill._spans is not None:
+            # the recv half of the anchor pair, on the prefill pool's
+            # clock; a dropped header leaves it unparented — the
+            # unjoined state the gate exists to surface
+            recv = (tracectx.mint() if dropped or parent is None
+                    else rpc.child())
+            self.prefill._spans.add(
+                SPAN_HANDOFF_RECV, HANDOFF_CAT, t_recv0,
+                time.perf_counter() - t_recv0, pages=len(records),
+                **tracectx.span_fields(recv))
         adopted = self.decode.allocator.adopt_remote_pages(
             stub.tokens[:len(stub.tokens) - 1], planes)
+        if self.decode._spans is not None:
+            self.decode._spans.add(
+                SPAN_HANDOFF_SEND, HANDOFF_CAT, t_send0,
+                time.perf_counter() - t_send0, pages=len(records),
+                bytes=nbytes, **tracectx.span_fields(rpc))
         self._count("shipped")
         if self.obs is not None:
             self.obs.handoff_latency.observe(time.monotonic() - t0)

@@ -180,6 +180,13 @@ class JournalEntry:
     cursor: int = 0
     sampled: list = dataclasses.field(default_factory=list)
     status: str | None = None  # None = incomplete (needs recovery)
+    # distributed-trace identity (ISSUE 15): the request's traceparent
+    # header (obs/tracectx) at admit time. Recovery and the disagg
+    # handoff continue the SAME trace from it — the continuation opens a
+    # new span parented on this one with a recovers/handoff link, so a
+    # request's whole multi-process life joins on one trace_id. None on
+    # legacy records (pre-trace journals recover fine, just unjoined).
+    trace: str | None = None
 
     @property
     def replay_tokens(self) -> list:
@@ -332,7 +339,7 @@ class RequestJournal:
                                 steps=e.steps, temperature=e.temperature,
                                 topp=e.topp, seed=e.seed, slo=e.slo,
                                 cursor=e.cursor, sampled=list(e.sampled),
-                                status=e.status)
+                                status=e.status, trace=e.trace)
 
     @property
     def next_id(self) -> int:
@@ -359,19 +366,23 @@ class RequestJournal:
 
     def admit(self, rid: int, tokens, steps: int, temperature: float,
               topp: float, seed: int, slo: str | None = None,
-              cursor: int = 0, recovers: int | None = None) -> None:
+              cursor: int = 0, recovers: int | None = None,
+              trace: str | None = None) -> None:
         """Journal a request's admission. ``recovers`` names the PREVIOUS
         life's rid when this admit is a recovery re-admission: the one
         appended record atomically opens the new life AND retires the old
         (status ``recovered``) — a crash on either side of a two-record
         handoff would otherwise leave zero or two live entries for the
-        same request."""
+        same request. ``trace`` is the request's traceparent header
+        (ISSUE 15) — the id a later life continues the trace from."""
         entry = JournalEntry(rid=rid, tokens=list(tokens), steps=steps,
                              temperature=temperature, topp=topp, seed=seed,
-                             slo=slo, cursor=cursor)
+                             slo=slo, cursor=cursor, trace=trace)
         rec = {"t": "admit", "id": rid, "tokens": entry.tokens,
                "steps": steps, "temperature": temperature,
                "topp": topp, "seed": seed, "slo": slo, "cursor": cursor}
+        if trace is not None:
+            rec["trace"] = str(trace)
         if recovers is not None:
             rec["recovers"] = int(recovers)
         with self._lock:
@@ -442,13 +453,15 @@ class RequestJournal:
                 fh.write((json.dumps(head, separators=(",", ":"))
                           + "\n").encode())
                 for e in live:
-                    fh.write((json.dumps(
-                        {"t": "admit", "id": e.rid,
-                         "tokens": e.replay_tokens, "steps": e.steps,
-                         "temperature": e.temperature, "topp": e.topp,
-                         "seed": e.seed, "slo": e.slo,
-                         "cursor": e.cursor},
-                        separators=(",", ":")) + "\n").encode())
+                    rec = {"t": "admit", "id": e.rid,
+                           "tokens": e.replay_tokens, "steps": e.steps,
+                           "temperature": e.temperature, "topp": e.topp,
+                           "seed": e.seed, "slo": e.slo,
+                           "cursor": e.cursor}
+                    if e.trace is not None:
+                        rec["trace"] = e.trace
+                    fh.write((json.dumps(rec, separators=(",", ":"))
+                              + "\n").encode())
                 fh.flush()
                 os.fsync(fh.fileno())
             self._fh.close()
@@ -458,7 +471,7 @@ class RequestJournal:
                 e.rid: JournalEntry(
                     rid=e.rid, tokens=e.replay_tokens, steps=e.steps,
                     temperature=e.temperature, topp=e.topp, seed=e.seed,
-                    slo=e.slo, cursor=e.cursor)
+                    slo=e.slo, cursor=e.cursor, trace=e.trace)
                 for e in live}
             self._n_retired = 0
             self._dirty = False
@@ -489,12 +502,17 @@ def _parse_record(obj, entries: dict[int, JournalEntry],
             if not isinstance(tokens, list) or not tokens:
                 raise JournalCorruption(
                     f"line {lineno}: admit {rid} has no prompt tokens")
+            trace = obj.get("trace")
+            if trace is not None and not isinstance(trace, str):
+                raise JournalCorruption(
+                    f"line {lineno}: admit {rid} trace is not a string")
             entries[rid] = JournalEntry(
                 rid=rid, tokens=[int(x) for x in tokens],
                 steps=int(obj["steps"]),
                 temperature=float(obj["temperature"]),
                 topp=float(obj["topp"]), seed=int(obj["seed"]),
-                slo=obj.get("slo"), cursor=int(obj.get("cursor", 0)))
+                slo=obj.get("slo"), cursor=int(obj.get("cursor", 0)),
+                trace=trace)
             if obj.get("recovers") is not None:
                 # recovery re-admission: this one record also closes the
                 # previous life (see RequestJournal.admit)
@@ -592,11 +610,14 @@ def entry_to_wire(entry: JournalEntry) -> dict:
     request and a crash-recovered one re-admit through ONE code path.
     ``sampled`` stays separate from ``tokens`` (the receiver composes
     ``replay_tokens`` itself) so the record is honest about what was
-    prompt and what was generated."""
+    prompt and what was generated. ``trace`` carries the traceparent
+    header (ISSUE 15): the decode pool continues the SAME trace the
+    prefill pool opened."""
     return {"id": entry.rid, "tokens": list(entry.tokens),
             "sampled": list(entry.sampled), "cursor": entry.cursor,
             "steps": entry.steps, "temperature": entry.temperature,
-            "topp": entry.topp, "seed": entry.seed, "slo": entry.slo}
+            "topp": entry.topp, "seed": entry.seed, "slo": entry.slo,
+            "trace": entry.trace}
 
 
 def entry_from_wire(rec: dict) -> JournalEntry:
@@ -608,13 +629,17 @@ def entry_from_wire(rec: dict) -> JournalEntry:
         tokens = [int(t) for t in rec["tokens"]]
         if not tokens:
             raise ValueError("handoff record has no prompt tokens")
+        trace = rec.get("trace")
+        if trace is not None and not isinstance(trace, str):
+            raise ValueError("handoff record trace is not a string")
         return JournalEntry(
             rid=int(rec["id"]), tokens=tokens,
             steps=int(rec["steps"]),
             temperature=float(rec["temperature"]),
             topp=float(rec["topp"]), seed=int(rec["seed"]),
             slo=rec.get("slo"), cursor=int(rec.get("cursor", 0)),
-            sampled=[int(t) for t in rec.get("sampled", ())])
+            sampled=[int(t) for t in rec.get("sampled", ())],
+            trace=trace)
     except (KeyError, TypeError, ValueError) as exc:
         raise ValueError(f"malformed handoff record: {exc}") from exc
 

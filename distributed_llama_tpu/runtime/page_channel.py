@@ -25,6 +25,15 @@ Protocol (line-framed requests, binary responses):
 * ``COUNT <hid>`` -> ``<q`` page count (-1 = unknown handoff);
 * ``PAGE <hid> <idx>`` -> ``<q`` record length + the framed record
   bytes (-1 = unknown handoff/index);
+* ``TRACE <hid>`` -> ``<q`` length + the handoff's traceparent header
+  bytes (ISSUE 15: the distributed-trace identity rides the channel
+  next to the pages it describes, for consumers that fetch pages
+  WITHOUT the journal wire record — operator tooling, and the
+  kill-mid-handoff restart path, which cross-checks it against the
+  journaled identity before adopting; the normal decode path gets the
+  same header from ``entry_from_wire``. -1 = unknown handoff or no
+  trace published — a trace-less fetch still works, it just doesn't
+  join);
 * ``ACK <hid>`` -> ``<q`` 0; the server drops the handoff's records
   (the decode pool holds them now — the publish buffer is a relay, not
   a cache);
@@ -59,6 +68,7 @@ class PageChannelServer:
                  retain_max: int = 256):
         self._lock = threading.Lock()
         self._store: dict[str, list[bytes]] = {}  # insertion-ordered
+        self._traces: dict[str, str] = {}  # hid -> traceparent header
         self.retain_max = max(1, retain_max)
         self.published_pages = 0
         self.served_pages = 0
@@ -96,6 +106,15 @@ class PageChannelServer:
                             self.request.sendall(_I64.pack(len(rec)) + rec)
                             with outer._lock:
                                 outer.served_pages += 1
+                    elif parts[0] == b"TRACE" and len(parts) == 2:
+                        hid = parts[1].decode("ascii", "replace")
+                        with outer._lock:
+                            hdr = outer._traces.get(hid)
+                        if hdr is None:
+                            self.request.sendall(_I64.pack(-1))
+                        else:
+                            raw = hdr.encode("ascii", "replace")
+                            self.request.sendall(_I64.pack(len(raw)) + raw)
                     elif parts[0] == b"ACK" and len(parts) == 2:
                         outer.retire(parts[1].decode("ascii", "replace"))
                         self.request.sendall(_I64.pack(0))
@@ -112,20 +131,29 @@ class PageChannelServer:
                                         daemon=True)
         self._thread.start()
 
-    def publish(self, hid: str, records: list[bytes]) -> None:
+    def publish(self, hid: str, records: list[bytes],
+                trace: str | None = None) -> None:
+        """Stage a handoff's framed records (+ optionally its traceparent
+        header, ISSUE 15 — served by the TRACE command so the fetching
+        pool joins the shipped pages to the sending pool's trace)."""
         with self._lock:
             self._store[hid] = list(records)
+            if trace is not None:
+                self._traces[hid] = str(trace)
             self.published_pages += len(records)
             while len(self._store) > self.retain_max:
                 # dicts iterate in insertion order: drop the OLDEST
                 # unacked handoff (its fetch, if it ever comes, returns
                 # nothing and the decode pool prefills instead)
-                self._store.pop(next(iter(self._store)))
+                gone = next(iter(self._store))
+                self._store.pop(gone)
+                self._traces.pop(gone, None)
                 self.evicted_handoffs += 1
 
     def retire(self, hid: str) -> None:
         with self._lock:
             self._store.pop(hid, None)
+            self._traces.pop(hid, None)
 
     @property
     def queue_depth(self) -> int:
@@ -177,6 +205,24 @@ class PageChannelClient:
         if n < 0:
             return None
         return recv_exact(s, n)
+
+    def trace(self, hid: str) -> str | None:
+        """The traceparent header published with handoff ``hid`` (ISSUE
+        15), or None when the server holds none — a trace-less handoff
+        still fetches; its spans just don't join."""
+        s = self._connect()
+        try:
+            s.sendall(f"TRACE {hid}\n".encode())
+            (n,) = _I64.unpack(recv_exact(s, _I64.size))
+            hdr = (recv_exact(s, n).decode("ascii", "replace")
+                   if n >= 0 else None)
+            s.sendall(b"DONE\n")
+            return hdr
+        finally:
+            try:
+                s.close()
+            except OSError:
+                pass
 
     def ack(self, hid: str) -> None:
         """Explicitly retire a handoff server-side (the decode pool's

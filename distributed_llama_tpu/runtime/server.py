@@ -65,6 +65,7 @@ from typing import Any
 
 from ..io.tokenizer import Tokenizer
 from ..models.spec import TransformerSpec
+from ..obs import tracectx
 from ..obs.log import log_event
 from .continuous import ContinuousEngine, Request
 from .supervisor import HealthMonitor, StepWatchdog
@@ -94,7 +95,8 @@ class InferenceServer:
                  kv_host_pages: int = 0, kv_disk_dir: str | None = None,
                  kv_disk_bytes: int = 0, disagg_role: str | None = None,
                  disagg_peer: str | None = None,
-                 page_channel_port: int = 0, handoff_min_pages: int = 2):
+                 page_channel_port: int = 0, handoff_min_pages: int = 2,
+                 flightrec_dir: str | None = None):
         self.spec = spec
         self.tokenizer = tokenizer
         self.default_steps = steps
@@ -140,6 +142,17 @@ class InferenceServer:
         # draining/stopped); the watchdog and journal are opt-in knobs
         self.health = HealthMonitor(self.registry)
         self.journal = journal
+        # crash-forensics flight recorder (ISSUE 15): the ring is ALWAYS
+        # recording (cheap); bundle files land in flightrec_dir when the
+        # watchdog fires or the SIGTERM drain runs (None = ring only)
+        from ..obs.flightrec import FlightRecorder
+
+        self.flightrec_dir = flightrec_dir
+        self.flightrec = FlightRecorder(
+            registry=self.registry,
+            journal_path=journal.path if journal is not None else None,
+            config=(dict(journal.config)
+                    if journal is not None and journal.config else {}))
         self._watchdog = (StepWatchdog(watchdog_s, on_hang=self._on_hang)
                           if watchdog_s > 0 else None)
         self._drain_hist = (self.registry.histogram(
@@ -187,11 +200,18 @@ class InferenceServer:
             from .disagg import DisaggMetrics
 
             self._disagg_obs = DisaggMetrics(self.registry)
+        # the engine's span tracer feeds the flight recorder's bundle
+        # (None when metrics are off — the ring of notes still records)
+        self.flightrec.bind(spans=self.engine._spans)
+        self.flightrec.note("server.start", role=disagg_role or "single",
+                            slots=slots, page_size=page_size)
         # replay the previous life's unfinished requests BEFORE the
         # listener opens: recovered work re-queues first, so a restarted
         # server continues exactly where the crash cut it off
         self.recovered = (self.engine.recover(quiet=quiet)
                           if journal is not None else 0)
+        if self.recovered:
+            self.flightrec.note("server.recovered", n=self.recovered)
         self._shutdown = threading.Event()
         self._stopped = threading.Event()  # stop() ran to completion
         # live streaming-handler threads (the _stream loop): stop() joins
@@ -275,6 +295,11 @@ class InferenceServer:
                         "pool_bytes": sum(int(x.nbytes)
                                           for x in eng.cache),
                         "prefix_hit_rate": round(a.hit_rate, 4),
+                        # raw hit/miss COUNTS (ISSUE 15): the fleet
+                        # plane recomputes aggregate hit rates from
+                        # summed counts, never from averaged ratios
+                        "prefix_hits": a.prefix_hits,
+                        "prefix_misses": a.prefix_misses,
                         "prefill_tokens_saved": a.tokens_saved,
                         "evictions": a.evictions,
                     }
@@ -363,16 +388,22 @@ class InferenceServer:
                 (request → prefill/decode windows, obs/spans.py).
                 Default: Chrome-trace JSON — save it and load it straight
                 into Perfetto / chrome://tracing; ?format=ndjson streams
-                one span object per line for log shippers."""
+                one span object per line for log shippers;
+                ?trace=<trace_id> filters to ONE distributed trace's
+                spans (the cross-pool join view, ISSUE 15)."""
+                from urllib.parse import parse_qs, urlparse
+
                 spans = server.engine._spans
                 if spans is None:
                     return self._json(404, {"error": "timeline disabled "
                                             "(--no-metrics)"})
-                if "format=ndjson" in self.path:
-                    body = spans.export_ndjson().encode()
+                q = parse_qs(urlparse(self.path).query)
+                trace_id = (q.get("trace") or [None])[0]
+                if (q.get("format") or [None])[0] == "ndjson":
+                    body = spans.export_ndjson(trace_id).encode()
                     ctype = "application/x-ndjson"
                 else:
-                    body = json.dumps(spans.export_chrome()).encode()
+                    body = json.dumps(spans.export_chrome(trace_id)).encode()
                     ctype = "application/json"
                 self.send_response(200)
                 self.send_header("Content-Type", ctype)
@@ -456,19 +487,49 @@ class InferenceServer:
                 except (ValueError, KeyError, TypeError) as e:
                     server.count_reject("bad_request")
                     return self._json(400, {"error": str(e)})
+                # trace propagation (ISSUE 15): continue the decode
+                # pool's trace from the RPC's traceparent — the recv
+                # half of the clock-skew anchor pair tracejoin aligns
+                # on. The drop-traceparent mutation severs it HERE.
+                trace_hdr = payload.get("trace")
+                chaos = server.engine._chaos
+                if trace_hdr is not None and chaos is not None \
+                        and chaos.trace_drop():
+                    trace_hdr = None
+                recv_parent = None
+                if trace_hdr:
+                    try:
+                        recv_parent = tracectx.parse_header(str(trace_hdr))
+                    except ValueError:
+                        recv_parent = None
+                recv = (recv_parent.child() if recv_parent is not None
+                        else tracectx.mint())
+                t_recv0 = time.perf_counter()
                 stub, _ = prefill_stub(
                     tokens, steps,
                     temperature=None if temp is None else float(temp),
                     topp=None if topp is None else float(topp),
                     seed=None if seed is None else int(seed),
                     slo_class=slo_class)
+                stub.trace = recv.child()
                 server.engine.submit(stub)
                 stub.done.wait()
+
+                def recv_span(pages: int) -> None:
+                    if server.engine._spans is not None:
+                        from .disagg import HANDOFF_CAT, SPAN_HANDOFF_RECV
+
+                        server.engine._spans.add(
+                            SPAN_HANDOFF_RECV, HANDOFF_CAT, t_recv0,
+                            time.perf_counter() - t_recv0, pages=pages,
+                            **tracectx.span_fields(recv))
+
                 if stub.error is not None:
                     return self._json(500, {"error": stub.error})
                 if not stub_needs_handoff(stub):
                     if server._disagg_obs is not None:
                         server._disagg_obs.handoffs["local"].inc()
+                    recv_span(0)
                     return self._json(200, {"final": True,
                                             "out": stub.out})
                 try:
@@ -478,7 +539,9 @@ class InferenceServer:
                 payloads = server.engine.export_prefix_sync(tokens)
                 records = encode_handoff_pages(payloads)
                 hid = f"h{stub.index}"
-                server._page_channel.publish(hid, records)
+                server._page_channel.publish(hid, records,
+                                             trace=entry.trace)
+                recv_span(len(records))
                 if server._disagg_obs is not None:
                     from .pagewire import record_payload_bytes
 
@@ -665,7 +728,11 @@ class InferenceServer:
                        temperature=None if temp is None else float(temp),
                        topp=None if topp is None else float(topp),
                        seed=None if seed is None else int(seed),
-                       slo_class=slo_class)
+                       slo_class=slo_class,
+                       # trace minted at INGRESS (ISSUE 15): the id every
+                       # span, journal record, and handoff hop of this
+                       # request's life carries from here on
+                       trace=tracectx.mint())
 
     def decode(self, req: Request) -> str:
         from .continuous import decode_stream
@@ -688,7 +755,7 @@ class InferenceServer:
         register streaming hooks BEFORE invoking the thunk."""
         import urllib.request
 
-        from .disagg import decode_request
+        from .disagg import HANDOFF_CAT, SPAN_HANDOFF_SEND, decode_request
         from .journal import entry_from_wire
         from .page_channel import PageChannelClient
 
@@ -699,13 +766,28 @@ class InferenceServer:
                 self._disagg_obs.handoffs["local"].inc()
             return local
         t0 = time.monotonic()
+        # the RPC span (ISSUE 15): the send half of the clock-skew
+        # anchor pair — its traceparent rides the POST body, so the
+        # prefill pool's spans become this span's descendants
+        rpc = (req.trace.child() if req.trace is not None
+               else tracectx.mint())
+        t_send0 = time.perf_counter()
+
+        def send_span(pages: int) -> None:
+            if self.engine._spans is not None:
+                self.engine._spans.add(
+                    SPAN_HANDOFF_SEND, HANDOFF_CAT, t_send0,
+                    time.perf_counter() - t_send0, pages=pages,
+                    **tracectx.span_fields(rpc))
+
         dreq = None
         resp = None
         try:
             body = json.dumps({
                 "tokens": req.tokens, "steps": req.steps,
                 "temperature": req.temperature, "topp": req.topp,
-                "seed": req.seed, "class": req.slo_class}).encode()
+                "seed": req.seed, "class": req.slo_class,
+                "trace": rpc.to_header()}).encode()
             rq = urllib.request.Request(
                 f"http://{self.disagg_peer}/prefill", data=body,
                 headers={"Content-Type": "application/json"})
@@ -714,6 +796,7 @@ class InferenceServer:
             if resp.get("final"):
                 req.out.extend(int(t) for t in resp["out"])
                 req.done.set()
+                send_span(0)
                 return req, None
             entry = entry_from_wire(resp["record"])
             dreq = decode_request(entry, req.steps)
@@ -732,13 +815,16 @@ class InferenceServer:
                 obs = self._disagg_obs
                 obs.handoffs["shipped"].inc()
                 obs.handoff_latency.observe(time.monotonic() - t0)
+            send_span(int(resp["n_pages"]))
+            log_event("disagg.handoff_shipped", None, trace=rpc,
+                      peer=self.disagg_peer, pages=int(resp["n_pages"]))
             return dreq, (lambda: self.engine.ingest_remote(
                 prompt, planes, dreq))
         except (OSError, ValueError, KeyError, TypeError) as e:
             log_event("disagg.handoff_failed",
                       f"🔶 handoff to {self.disagg_peer} failed "
                       f"({type(e).__name__}: {e}); serving locally",
-                      file=sys.stderr,
+                      file=sys.stderr, trace=rpc,
                       error=f"{type(e).__name__}: {e}")
             if dreq is not None:
                 # the fallback serves the ORIGINAL request — retire the
@@ -759,14 +845,35 @@ class InferenceServer:
                 self._disagg_obs.handoffs["failed"].inc()
             return local
 
+    def _flightrec_dump(self, reason: str) -> None:
+        """One postmortem bundle (obs/flightrec): note the trigger into
+        the ring, then write a bundle file when a directory is
+        configured. Never raises — this runs on fault paths."""
+        self.flightrec.note(reason, state=self.health.state,
+                            outstanding=self._outstanding())
+        if not self.flightrec_dir:
+            return
+        try:
+            path = self.flightrec.dump(self.flightrec_dir, reason)
+            log_event("flightrec.dump",
+                      f"🔶 flight recorder: {reason} bundle -> {path}",
+                      file=sys.stderr, path=path, reason=reason)
+        except OSError as e:
+            log_event("flightrec.failed",
+                      f"🔶 flight recorder dump failed: {e}",
+                      file=sys.stderr, error=f"{type(e).__name__}: {e}")
+
     def _on_hang(self, elapsed_s: float):
         """Watchdog trip (monitor thread): a dispatch overran its deadline.
-        Detection only — mark the server degraded; the scheduler flips it
-        back to serving once dispatches complete on time again."""
+        Detection only — mark the server degraded (and drop a flight-
+        recorder bundle: the hung state IS the postmortem moment); the
+        scheduler flips it back to serving once dispatches complete on
+        time again."""
         try:
             self.health.to("degraded")
         except ValueError:
             pass  # already draining/stopped: the drain verdict wins
+        self._flightrec_dump("watchdog")
 
     def _scheduler(self):
         while not self._shutdown.is_set():
@@ -865,6 +972,9 @@ class InferenceServer:
             self.health.to("draining")
         except ValueError:
             return 0  # already stopped
+        # the SIGTERM postmortem bundle: state AS THE DRAIN BEGINS —
+        # in-flight work, queue depth, journal tail, recent spans
+        self._flightrec_dump("sigterm_drain")
         log_event("server.drain",
                   f"🌐 draining: admission stopped, "
                   f"{self._outstanding()} requests in flight, "

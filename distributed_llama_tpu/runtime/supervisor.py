@@ -217,16 +217,26 @@ class CrashLoopBackoff:
 def supervise(child_cmd: list[str], max_restarts: int | None = None,
               backoff: CrashLoopBackoff | None = None,
               sleep=time.sleep, popen=subprocess.Popen,
-              install_signals: bool = True) -> int:
+              install_signals: bool = True,
+              flightrec_dir: str | None = None) -> int:
     """Run ``child_cmd`` under crash-loop supervision (``serve
     --supervise``). Restarts on non-zero exits with ``backoff`` delays;
     exits with the child's code on a clean 0 or once ``max_restarts``
     respawns are spent (None = unbounded). SIGTERM/SIGINT forward to the
     child — its graceful drain runs, it exits 0, and the supervisor
-    exits 0 without respawning."""
+    exits 0 without respawning. With ``flightrec_dir`` set, every
+    crash-loop respawn drops a flight-recorder bundle (ISSUE 15) from
+    the SUPERVISOR's vantage — exit code, uptime, restart count, the
+    spawn history ring — next to whatever bundles the child's own
+    recorder managed to write before dying."""
     backoff = backoff or CrashLoopBackoff()
     terminating = {"flag": False}
     child_box: dict = {"proc": None}
+    recorder = None
+    if flightrec_dir is not None:
+        from ..obs.flightrec import FlightRecorder
+
+        recorder = FlightRecorder()
 
     def _forward(signum, frame):
         terminating["flag"] = True
@@ -246,8 +256,21 @@ def supervise(child_cmd: list[str], max_restarts: int | None = None,
         log_event("supervisor.spawn",
                   f"🌐 supervisor: child pid {proc.pid} started",
                   file=sys.stderr, pid=proc.pid, restarts=restarts)
+        if recorder is not None:
+            recorder.note("supervisor.spawn", pid=proc.pid,
+                          restarts=restarts)
         rc = proc.wait()
         uptime = time.monotonic() - t0
+        if recorder is not None and rc != 0 and not terminating["flag"]:
+            # the crash-loop postmortem bundle: written BEFORE the
+            # respawn, so an operator paging in mid-loop finds the
+            # history even while the loop is still spinning
+            recorder.note("supervisor.crash", rc=rc,
+                          uptime_s=round(uptime, 3), restarts=restarts)
+            try:
+                recorder.dump(flightrec_dir, "crash_loop")
+            except OSError:
+                pass  # a failed dump must never block the respawn
         if rc == 0 or terminating["flag"]:
             log_event("supervisor.exit",
                       f"🌐 supervisor: child exited {rc} "

@@ -1,0 +1,199 @@
+"""Fleet signal plane (ISSUE 15): /health+/metrics parsing, rollup math,
+the deterministic multi-replica sim."""
+
+import argparse
+import json
+import os
+import sys
+import urllib.request
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))), "tools"))
+
+from distributed_llama_tpu.models.spec import TransformerSpec  # noqa: E402
+from distributed_llama_tpu.models.synth import synth_params  # noqa: E402
+from distributed_llama_tpu.obs.fleet import (ReplicaSignals,  # noqa: E402
+                                             apply_metrics, parse_metrics,
+                                             rollup, scrape_replica,
+                                             signals_from_health)
+
+SPEC = TransformerSpec(dim=64, hidden_dim=160, n_layers=2, n_heads=4,
+                       n_kv_heads=2, vocab_size=128, seq_len=16)
+
+
+class _IdTokenizer:
+    def encode(self, text, bos=True, eos=False):
+        return [1] + [3 + b for b in text.encode()]
+
+    def decode_piece(self, prev, tok):
+        return b"<%d>" % tok
+
+
+@pytest.fixture(scope="module")
+def params():
+    return synth_params(SPEC, q40=False, seed=4, scale=0.3)
+
+
+def _row(name, **kw):
+    defaults = dict(slots=4, active=2, queue_depth=1, kv_pages=20,
+                    kv_pages_free=8, prefix_hits=4, prefix_misses=4,
+                    generated_tokens=100, steps=50,
+                    prefill_tokens_saved=16, goodput_tokens=60,
+                    slo={"interactive": {"attempted": 10, "met": 8,
+                                         "violated": 2, "failed": 0,
+                                         "goodput_tokens": 60}})
+    defaults.update(kw)
+    return ReplicaSignals(name=name, **defaults)
+
+
+def test_rollup_sums_counts_and_recomputes_ratios():
+    rows = [_row("a"),
+            _row("b", active=4, kv_pages_free=0, prefix_hits=12,
+                 prefix_misses=0, goodput_tokens=0,
+                 slo={"interactive": {"attempted": 10, "met": 2,
+                                      "violated": 8, "failed": 0,
+                                      "goodput_tokens": 0},
+                      "batch": {"attempted": 5, "met": 5, "violated": 0,
+                                "failed": 0, "goodput_tokens": 40}})]
+    agg = rollup(rows)
+    assert agg.replicas == 2 and agg.healthy == 2
+    assert agg.slots == 8 and agg.active == 6
+    assert agg.kv_pages_free == 8 and agg.queue_depth == 2
+    # fleet hit rate from SUMMED counts: (4+12)/(4+12+4+0)
+    assert agg.prefix_hit_rate == pytest.approx(16 / 20)
+    # fleet attainment from SUMMED counts, not averaged ratios:
+    # interactive (8+2)/(10+10) = 0.5 — the mean of ratios (0.8, 0.2)
+    # happens to agree here, so pin a case where it would not
+    assert agg.attainment["interactive"] == pytest.approx(0.5)
+    assert agg.attainment["batch"] == 1.0
+    assert agg.goodput_tokens == 60
+    j = agg.to_json()
+    assert j["attainment"]["interactive"] == 0.5
+
+
+def test_rollup_unhealthy_rows_do_not_dilute():
+    rows = [_row("up"), ReplicaSignals(name="down", healthy=False,
+                                       error="ConnectionRefusedError")]
+    agg = rollup(rows)
+    assert agg.replicas == 2 and agg.healthy == 1
+    assert agg.slots == 4  # the dead box contributed nothing
+    assert agg.occupancy == pytest.approx(0.5)
+
+
+def test_rollup_attainment_weighs_by_attempts_not_replicas():
+    """The averaging trap the module refuses: a drained replica at 1.0
+    must not launder a loaded replica's misses."""
+    idle = _row("idle", slo={"interactive": {
+        "attempted": 1, "met": 1, "violated": 0, "failed": 0,
+        "goodput_tokens": 5}})
+    loaded = _row("loaded", slo={"interactive": {
+        "attempted": 99, "met": 0, "violated": 99, "failed": 0,
+        "goodput_tokens": 0}})
+    agg = rollup([idle, loaded])
+    assert agg.attainment["interactive"] == pytest.approx(1 / 100)
+    # the mean-of-ratios answer would have been 0.505 — visibly wrong
+
+
+def test_parse_metrics_and_apply():
+    text = ("# HELP dllama_prefix_hits_total x\n"
+            "# TYPE dllama_prefix_hits_total counter\n"
+            "dllama_prefix_hits_total 7\n"
+            "dllama_kv_pages_free 3\n"
+            "dllama_queue_depth 2\n"
+            'dllama_goodput_tokens_total{class="interactive"} 11\n'
+            'dllama_goodput_tokens_total{class="batch"} 4\n')
+    samples = parse_metrics(text)
+    assert samples["dllama_prefix_hits_total"] == 7.0
+    row = apply_metrics(ReplicaSignals(name="r"), samples)
+    assert row.prefix_hits == 7
+    assert row.kv_pages_free == 3
+    assert row.queue_depth == 2
+    assert row.goodput_tokens == 15
+    with pytest.raises(ValueError):
+        parse_metrics("dllama_bad not-a-number\n")
+
+
+def test_signals_from_health_parses_server_shape(params):
+    """Schema lock against the REAL /health payload: a field rename in
+    runtime/server.py must break here, not silently in a router."""
+    from distributed_llama_tpu.runtime.server import InferenceServer
+
+    srv = InferenceServer(SPEC, params, _IdTokenizer(), "127.0.0.1", 0,
+                          slots=2, steps=8, temperature=0.0, topp=0.9,
+                          seed=5, quiet=True, prefill_chunk=4,
+                          page_size=4, kv_pages=16)
+    srv.start()
+    try:
+        body = json.dumps({"prompt": "abcdef", "steps": 8}).encode()
+        rq = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/generate", data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(rq, timeout=60) as r:
+            assert json.loads(r.read())["steps"] > 0
+        row = scrape_replica("r0", f"http://127.0.0.1:{srv.port}")
+        assert row.healthy and row.state == "serving"
+        assert row.slots == 2
+        assert row.kv_pages == 16 and row.kv_pages_free > 0
+        assert row.generated_tokens > 0 and row.steps > 0
+        assert row.prefix_hits + row.prefix_misses >= 1
+        # the dead-replica path: a closed port reports unhealthy
+        dead = scrape_replica("r1", "http://127.0.0.1:1")
+        assert not dead.healthy and dead.error
+        agg = rollup([row, dead])
+        assert agg.healthy == 1 and agg.replicas == 2
+    finally:
+        srv.stop()
+
+
+# ------------------------------------------------------ fleetcheck (sim)
+
+
+def _sim_args(n=2, seed=7, requests=12):
+    return argparse.Namespace(sim=n, seed=seed, rate=0.4,
+                              requests=requests, arrivals="bursty",
+                              slots=2, page_size=4, kv_pages=12,
+                              spec_k=0, block_steps=1, replicas=None,
+                              json=True)
+
+
+def test_fleetcheck_sim_deterministic_and_consistent():
+    """The CI property: same seed ⇒ identical rows + rollup; the rollup
+    is the recomputed sum of its rows (run_sim's own self-check passes
+    clean)."""
+    import fleetcheck
+
+    results = []
+    for _ in range(2):
+        rows, agg, failures = fleetcheck.run_sim(_sim_args())
+        assert failures == []
+        results.append(([r.to_json() for r in rows], agg.to_json()))
+    assert results[0] == results[1]
+    rows_json, agg_json = results[0]
+    assert len(rows_json) == 2
+    assert agg_json["generated_tokens"] == sum(
+        r["generated_tokens"] for r in rows_json)
+    assert agg_json["kv_pages_free"] == sum(
+        r["kv_pages_free"] for r in rows_json)
+    # a different seed genuinely changes the row (the gate is not
+    # vacuously comparing constants)
+    rows2, agg2, _ = fleetcheck.run_sim(_sim_args(seed=8))
+    assert agg2.to_json() != agg_json
+
+
+def test_fleetcheck_cli_exit_codes(tmp_path, capsys):
+    import fleetcheck
+
+    assert fleetcheck.main([]) == 2  # neither mode picked
+    assert fleetcheck.main(["--sim", "2", "--replicas", "x"]) == 2
+    rc = fleetcheck.main(["--sim", "2", "--seed", "7", "--requests",
+                          "8", "--json"])
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    row = json.loads(out)
+    assert rc == 0
+    assert row["kind"] == "fleetcheck"
+    assert row["gate"]["verdict"] == "OK"
+    assert row["rollup"]["healthy"] == 2
+    assert len(row["rows"]) == 2
+    assert "env_fingerprint" in row  # joinable with BENCH_* rows

@@ -1,0 +1,206 @@
+"""Flight recorder: ring, bundle schema, dump triggers, tracecheck load
+(ISSUE 15)."""
+
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))), "tools"))
+
+from distributed_llama_tpu.models.spec import TransformerSpec  # noqa: E402
+from distributed_llama_tpu.models.synth import synth_params  # noqa: E402
+from distributed_llama_tpu.obs.flightrec import (FlightRecorder,  # noqa: E402
+                                                 is_bundle_file,
+                                                 load_bundle,
+                                                 validate_bundle)
+from distributed_llama_tpu.obs.metrics import Registry  # noqa: E402
+from distributed_llama_tpu.obs.spans import SpanTracer  # noqa: E402
+
+SPEC = TransformerSpec(dim=64, hidden_dim=160, n_layers=2, n_heads=4,
+                       n_kv_heads=2, vocab_size=128, seq_len=16)
+
+
+class _IdTokenizer:
+    def encode(self, text, bos=True, eos=False):
+        return [1] + [3 + b for b in text.encode()]
+
+    def decode_piece(self, prev, tok):
+        return b"<%d>" % tok
+
+
+@pytest.fixture(scope="module")
+def params():
+    return synth_params(SPEC, q40=False, seed=4, scale=0.3)
+
+
+def test_ring_bounds_and_bundle_roundtrip(tmp_path):
+    reg = Registry()
+    reg.counter("dllama_demo_total", "demo").inc(3)
+    spans = SpanTracer()
+    with spans.span("step", cat="decode", active=1):
+        pass
+    jpath = tmp_path / "j.ndjson"
+    jpath.write_text('{"t":"journal","v":1}\n'
+                     '{"t":"admit","id":0,"tokens":[1],"steps":2,'
+                     '"temperature":0.0,"topp":0.9,"seed":1,"slo":null,'
+                     '"cursor":0}\n')
+    rec = FlightRecorder(capacity=4, registry=reg, spans=spans,
+                         journal_path=str(jpath),
+                         config={"dim": 64}, tail_lines=8)
+    for i in range(10):
+        rec.note(f"event{i}", n=i)
+    path = rec.dump(str(tmp_path / "out"), "watchdog")
+    bundle = load_bundle(path)  # load validates
+    # the ring kept only the last 4 events
+    assert [e["event"] for e in bundle["events"]] == \
+        ["event6", "event7", "event8", "event9"]
+    assert bundle["reason"] == "watchdog"
+    assert bundle["config"] == {"dim": 64}
+    assert "dllama_demo_total 3" in bundle["metrics"]
+    assert bundle["spans"][0]["span"] == "step"
+    assert bundle["spans_dropped"] == 0
+    assert len(bundle["journal_tail"]) == 2
+    assert json.loads(bundle["journal_tail"][0])["t"] == "journal"
+    assert is_bundle_file(path)
+    # repeated dumps never clobber (sequence-named)
+    path2 = rec.dump(str(tmp_path / "out"), "watchdog")
+    assert path2 != path and os.path.exists(path) and os.path.exists(path2)
+    # explicit .json target is honored verbatim
+    explicit = str(tmp_path / "bundle.json")
+    assert rec.dump(explicit, "sigterm_drain") == explicit
+    assert load_bundle(explicit)["reason"] == "sigterm_drain"
+
+
+def test_bundle_without_bindings_still_valid(tmp_path):
+    """The supervisor's vantage: no registry, no spans, no journal — the
+    bundle is still schema-clean (empty sections, never missing ones)."""
+    rec = FlightRecorder()
+    rec.note("supervisor.crash", rc=1)
+    path = rec.dump(str(tmp_path), "crash_loop")
+    bundle = load_bundle(path)
+    assert bundle["spans"] == [] and bundle["journal_tail"] == []
+    assert bundle["metrics"] == ""
+    assert bundle["events"][0]["event"] == "supervisor.crash"
+
+
+@pytest.mark.parametrize("mutate", [
+    lambda b: b.pop("reason"),
+    lambda b: b.update(kind="nope"),
+    lambda b: b.update(version=99),
+    lambda b: b.update(events="not-a-list"),
+    lambda b: b.update(spans=[{"nope": 1}]),
+    lambda b: b.update(metrics=None),
+    lambda b: b.pop("spans_dropped"),
+])
+def test_validate_rejects_damage(tmp_path, mutate):
+    rec = FlightRecorder()
+    bundle = rec.snapshot_bundle("watchdog")
+    validate_bundle(bundle)  # sane before mutation
+    mutate(bundle)
+    with pytest.raises(ValueError):
+        validate_bundle(bundle)
+
+
+def test_tracecheck_validates_and_rejects_bundles(tmp_path):
+    """The CI hook: tools/tracecheck.py accepts a good bundle (exit 0)
+    and flags a damaged one (exit 1 — not the usage-error 2 a naive
+    non-zero check would vacuously pass on)."""
+    import tracecheck
+
+    rec = FlightRecorder()
+    rec.note("watchdog", elapsed_s=0.5)
+    path = rec.dump(str(tmp_path), "watchdog")
+    assert tracecheck.main([path, "--json"]) == 0
+    bundle = json.load(open(path))
+    del bundle["events"]
+    broken = str(tmp_path / "broken.json")
+    with open(broken, "w") as fh:
+        json.dump(bundle, fh)
+    assert tracecheck.main([broken]) == 1
+
+
+def test_watchdog_trip_dumps_bundle_from_server(params, tmp_path):
+    """The wired trigger: a server whose watchdog fires writes a bundle
+    into --flightrec DIR; the SIGTERM drain writes another."""
+    import time
+
+    from distributed_llama_tpu.runtime.server import InferenceServer
+
+    frdir = str(tmp_path / "fr")
+    srv = InferenceServer(SPEC, params, _IdTokenizer(), "127.0.0.1", 0,
+                          slots=1, steps=4, temperature=0.0, topp=0.9,
+                          seed=5, quiet=True, watchdog_s=0.01,
+                          flightrec_dir=frdir)
+    srv.start()
+    try:
+        # a hung "dispatch": arm the watchdog well past its deadline
+        with srv._watchdog:
+            time.sleep(0.1)
+        deadline = time.time() + 5
+        while time.time() < deadline and not os.listdir(frdir):
+            time.sleep(0.01)
+        bundles = [os.path.join(frdir, f) for f in os.listdir(frdir)]
+        assert bundles, "watchdog trip produced no bundle"
+        b = load_bundle(bundles[0])
+        assert b["reason"] == "watchdog"
+        assert any(e["event"] == "watchdog" for e in b["events"])
+        assert any(e["event"] == "server.start" for e in b["events"])
+    finally:
+        srv.stop()
+    # the drain trigger, on a fresh server (start/drain lifecycle)
+    srv2 = InferenceServer(SPEC, params, _IdTokenizer(), "127.0.0.1", 0,
+                           slots=1, steps=4, temperature=0.0, topp=0.9,
+                           seed=5, quiet=True, flightrec_dir=frdir)
+    srv2.start()
+    n_before = len(os.listdir(frdir))
+    srv2.drain(budget_s=0.5)
+    dumps = [os.path.join(frdir, f) for f in os.listdir(frdir)
+             if "sigterm_drain" in f]
+    assert len(os.listdir(frdir)) == n_before + 1 and dumps
+    assert load_bundle(dumps[0])["reason"] == "sigterm_drain"
+
+
+def test_supervisor_crash_loop_dumps_bundle(tmp_path):
+    """The crash-loop trigger: supervise() drops a bundle before each
+    respawn of a crashing child."""
+    from distributed_llama_tpu.runtime.supervisor import supervise
+
+    frdir = str(tmp_path / "fr")
+    rcs = iter([3, 0])
+
+    class _Proc:
+        def __init__(self):
+            self.pid = 4242
+            self._rc = next(rcs)
+
+        def wait(self):
+            return self._rc
+
+        def poll(self):
+            return self._rc
+
+    rc = supervise(["child"], popen=lambda cmd: _Proc(),
+                   sleep=lambda s: None, install_signals=False,
+                   flightrec_dir=frdir)
+    assert rc == 0
+    bundles = [f for f in os.listdir(frdir) if "crash_loop" in f]
+    assert len(bundles) == 1
+    b = load_bundle(os.path.join(frdir, bundles[0]))
+    events = [e["event"] for e in b["events"]]
+    assert "supervisor.spawn" in events and "supervisor.crash" in events
+    crash = [e for e in b["events"]
+             if e["event"] == "supervisor.crash"][0]
+    assert crash["rc"] == 3
+
+
+def test_is_bundle_file_sniffs(tmp_path):
+    not_bundle = tmp_path / "x.json"
+    not_bundle.write_text('{"kind": "dllama-trace"}')
+    assert not is_bundle_file(str(not_bundle))
+    assert not is_bundle_file(str(tmp_path / "missing.json"))
+    garbage = tmp_path / "g.json"
+    garbage.write_text("{{{")
+    assert not is_bundle_file(str(garbage))

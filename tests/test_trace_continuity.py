@@ -1,0 +1,287 @@
+"""Trace continuity across the three process seams (ISSUE 15).
+
+The acceptance contract: a request keeps ONE trace_id across (a) the
+prefill->decode disaggregation handoff over two REAL HTTP servers —
+whose stitched exports tracejoin must join with zero orphans and the
+handoff span bridging both pools — (b) journal recovery after a crash,
+and (c) the kill-mid-handoff combination, with the continuation link
+span present at every seam. The subprocess-SIGKILL variants of (b)/(c)
+live in runtime/chaos.py's drills (slow-marked + ci.sh); here the crash
+is simulated by abandoning the first engine on a settled journal — the
+journal bytes are identical to what a SIGKILL leaves."""
+
+import json
+import os
+import sys
+import urllib.request
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))), "tools"))
+
+from distributed_llama_tpu.models.spec import TransformerSpec  # noqa: E402
+from distributed_llama_tpu.models.synth import synth_params  # noqa: E402
+from distributed_llama_tpu.obs import tracectx  # noqa: E402
+from distributed_llama_tpu.obs.metrics import Registry  # noqa: E402
+from distributed_llama_tpu.runtime.continuous import (  # noqa: E402
+    ContinuousEngine, Request)
+from distributed_llama_tpu.runtime.journal import RequestJournal  # noqa: E402
+
+SPEC = TransformerSpec(dim=64, hidden_dim=160, n_layers=2, n_heads=4,
+                       n_kv_heads=2, vocab_size=128, seq_len=32)
+
+
+class _IdTokenizer:
+    def encode(self, text, bos=True, eos=False):
+        return [1] + [3 + b for b in text.encode()]
+
+    def decode_piece(self, prev, tok):
+        return b"<%d>" % tok
+
+
+@pytest.fixture(scope="module")
+def params():
+    return synth_params(SPEC, q40=False, seed=4, scale=0.3)
+
+
+def make_engine(params, journal=None, remote=False, **kw):
+    base = dict(slots=2, temperature=0.0, topp=0.9, seed=11,
+                prefill_chunk=4, page_size=4, kv_pages=24,
+                metrics=Registry())
+    base.update(kw)
+    return ContinuousEngine(SPEC, params, journal=journal,
+                            remote_pages=remote, **base)
+
+
+def _drain(eng):
+    while eng.step_many(eng.block_steps, quiet=True):
+        pass
+
+
+# ------------------------------------------------ seam 1: journal recovery
+
+
+def test_recovery_continues_trace_with_link(params, tmp_path):
+    """A recovered request keeps its journaled trace_id, its new span
+    parents on the journaled span, the 'recovers' link span lands in
+    the timeline, and the NEW admit record re-journals the continued
+    identity (a second crash continues the same trace again)."""
+    jpath = str(tmp_path / "requests.journal")
+    eng = ContinuousEngine(SPEC, params, slots=1, temperature=0.8,
+                           topp=0.9, seed=11, prefill_chunk=4,
+                           page_size=4, kv_pages=24, metrics=Registry(),
+                           journal=RequestJournal(jpath))
+    req = Request(tokens=[1, 9, 17, 25], steps=20, temperature=0.9,
+                  seed=501)
+    eng.submit(req)
+    root = req.trace
+    assert root is not None and root.link is None
+    for _ in range(4):  # mid-decode, tokens journaled
+        eng.step_many(1, quiet=True)
+    eng._journal.sync(force=True)
+    eng._journal._fh.close()  # the simulated SIGKILL
+
+    journal2 = RequestJournal(jpath)
+    (entry,) = journal2.incomplete()
+    assert entry.trace == root.to_header()
+    eng2 = ContinuousEngine(SPEC, params, slots=1, temperature=0.8,
+                            topp=0.9, seed=11, prefill_chunk=4,
+                            page_size=4, kv_pages=24, metrics=Registry(),
+                            journal=journal2)
+    assert eng2.recover() == 1
+    with eng2._lock:
+        (rec_req,) = list(eng2._queue)
+    assert rec_req.trace.trace_id == root.trace_id
+    assert rec_req.trace.parent_id == root.span_id
+    assert rec_req.trace.link == "recovers"
+    links = [s for s in eng2._spans.snapshot() if s.cat == "link"]
+    assert len(links) == 1 and links[0].name == "recovers"
+    assert links[0].meta["trace_id"] == root.trace_id
+    # the re-admission's OWN admit record carries the continued header
+    (new_entry,) = journal2.incomplete()
+    assert new_entry.trace == rec_req.trace.to_header()
+    _drain(eng2)
+    # the retired request span carries the same trace id
+    reqs = [s for s in eng2._spans.snapshot() if s.name == "request"]
+    assert reqs and reqs[-1].meta["trace_id"] == root.trace_id
+    journal2.close()
+
+
+def test_legacy_journal_without_trace_recovers(params, tmp_path):
+    """Pre-trace journals (no 'trace' key) recover unchanged: a fresh
+    root is minted, no link span claims a continuity that never was."""
+    jpath = str(tmp_path / "legacy.journal")
+    with open(jpath, "w", encoding="utf-8") as fh:
+        fh.write('{"t":"journal","v":1}\n'
+                 '{"t":"admit","id":0,"tokens":[1,9,17],"steps":8,'
+                 '"temperature":0.0,"topp":0.9,"seed":7,"slo":null,'
+                 '"cursor":0}\n')
+    journal = RequestJournal(jpath)
+    eng = make_engine(params, journal=journal)
+    assert eng.recover() == 1
+    with eng._lock:
+        (req,) = list(eng._queue)
+    assert req.trace is not None and req.trace.link is None
+    assert [s for s in eng._spans.snapshot() if s.cat == "link"] == []
+    _drain(eng)
+    journal.close()
+
+
+# --------------------------------------------- seam 2: two-server handoff
+
+
+@pytest.mark.slow
+def test_two_server_handoff_one_trace_tracejoin_clean(params):
+    """THE tracejoin acceptance gate: a real two-server disagg run —
+    prefill pool + decode pool over HTTP + the TCP page channel — keeps
+    one trace_id end to end; the two /debug/timeline NDJSON exports
+    stitch into ONE valid Chrome trace with zero orphans and the
+    handoff send/recv pair bridging the pools."""
+    import tracejoin
+
+    from distributed_llama_tpu.obs.spans import validate_chrome_trace
+    from distributed_llama_tpu.runtime.server import InferenceServer
+
+    tok = _IdTokenizer()
+    prefill_srv = InferenceServer(
+        SPEC, params, tok, "127.0.0.1", 0, slots=2, steps=16,
+        temperature=0.0, topp=0.9, seed=5, quiet=True, prefill_chunk=4,
+        page_size=4, kv_pages=24, disagg_role="prefill")
+    prefill_srv.start()
+    decode_srv = InferenceServer(
+        SPEC, params, tok, "127.0.0.1", 0, slots=2, steps=16,
+        temperature=0.0, topp=0.9, seed=5, quiet=True, prefill_chunk=4,
+        page_size=4, kv_pages=24, disagg_role="decode",
+        disagg_peer=f"127.0.0.1:{prefill_srv.port}")
+    decode_srv.start()
+    try:
+        body = json.dumps({"prompt": "abcdefgh", "steps": 14}).encode()
+        rq = urllib.request.Request(
+            f"http://127.0.0.1:{decode_srv.port}/generate", data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(rq, timeout=120) as r:
+            out = json.loads(r.read())
+        assert out["steps"] > 0
+
+        def export(srv):
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/debug/timeline"
+                    f"?format=ndjson", timeout=30) as r:
+                return [json.loads(ln) for ln in
+                        r.read().decode().strip().splitlines()
+                        if json.loads(ln).get("span") != "_meta"]
+
+        spans_d = export(decode_srv)
+        spans_p = export(prefill_srv)
+        doc, report = tracejoin.join_pools(spans_d, spans_p, "decode",
+                                           "prefill")
+        assert report["orphans"] == [], report["orphans"]
+        assert report["pairs"] >= 1
+        assert report["traces_joined"], "no trace spans both pools"
+        validate_chrome_trace(doc)
+        tid = report["traces_joined"][0]
+        # the handoff pair bridges the pools under ONE trace id
+        sends = [s for s in spans_d if s.get("span") == "handoff"
+                 and s.get("cat") == "handoff"]
+        recvs = [s for s in spans_p if s.get("span") == "prefill_handoff"]
+        assert sends and recvs
+        assert sends[0]["trace_id"] == recvs[0]["trace_id"] == tid
+        # the decode pool's continuation carries the handoff link
+        links = [s for s in spans_d if s.get("cat") == "link"]
+        assert links and links[0]["link"] == "handoff"
+        assert links[0]["trace_id"] == tid
+        # ?trace= filters the timeline to that one trace on both pools
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{decode_srv.port}/debug/timeline"
+                f"?trace={tid}", timeout=30) as r:
+            filtered = json.loads(r.read())
+        assert filtered["traceEvents"]
+        assert all(ev["args"].get("trace_id") == tid
+                   for ev in filtered["traceEvents"])
+    finally:
+        decode_srv.stop()
+        prefill_srv.stop()
+
+
+# --------------------------------------- seam 3: kill mid-handoff (pair)
+
+
+def test_handoff_then_crash_recovery_keeps_one_trace(params, tmp_path):
+    """Seams chained: prefill->decode handoff (one trace, handoff link),
+    then a decode-pool crash + recovery (same trace again, recovers
+    link) — the request's whole three-process life joins on one id."""
+    from distributed_llama_tpu.runtime.disagg import DisaggPair
+
+    prefill = make_engine(
+        params, journal=RequestJournal(str(tmp_path / "p.journal")))
+    jd_path = str(tmp_path / "d.journal")
+    decode_a = make_engine(params, journal=RequestJournal(jd_path),
+                           remote=True)
+    pair = DisaggPair(prefill, decode_a, channel_host="127.0.0.1")
+    from distributed_llama_tpu.runtime.disagg import prefill_stub
+
+    tokens = [1, 9, 17, 25, 31, 7, 3, 44, 11]
+    stub, _ = prefill_stub(tokens, 20)
+    prefill.submit(stub)
+    root_tid = stub.trace.trace_id
+    while prefill.step_many(1, quiet=True):
+        pass
+    h = pair.handoff(stub, 20)
+    assert h is not None
+    assert h.req.trace.trace_id == root_tid
+    assert h.req.trace.link == "handoff"
+    # crash the decode pool mid-handoff: journal survives, engine dies
+    decode_a._journal.sync(force=True)
+    decode_a._journal._fh.close()
+    decode_a.close()
+
+    journal_b = RequestJournal(jd_path)
+    (entry,) = journal_b.incomplete()
+    assert tracectx.parse_header(entry.trace).trace_id == root_tid
+    decode_b = make_engine(params, journal=journal_b, remote=True)
+    assert decode_b.recover() == 1
+    with decode_b._lock:
+        (rec_req,) = list(decode_b._queue)
+    assert rec_req.trace.trace_id == root_tid
+    assert rec_req.trace.link == "recovers"
+    _drain(decode_b)
+    # the whole life is queryable by the ONE id on the final pool
+    spans = decode_b._spans.snapshot(trace_id=root_tid)
+    assert {s.name for s in spans} >= {"recovers", "request"}
+    pair._server.close()
+    prefill.close()
+    decode_b.close()
+    journal_b.close()
+
+
+def test_handoff_wire_record_carries_trace(params, tmp_path):
+    """entry_to_wire/entry_from_wire round-trip the traceparent, and the
+    page channel serves it next to the pages (the TRACE command)."""
+    from distributed_llama_tpu.runtime.journal import (entry_from_wire,
+                                                       entry_to_wire)
+    from distributed_llama_tpu.runtime.page_channel import (
+        PageChannelClient, PageChannelServer)
+
+    ctx = tracectx.mint()
+    rec = entry_to_wire(
+        __import__("distributed_llama_tpu.runtime.journal",
+                   fromlist=["JournalEntry"]).JournalEntry(
+            rid=3, tokens=[1, 5], steps=8, temperature=0.0, topp=0.9,
+            seed=7, trace=ctx.to_header()))
+    back = entry_from_wire(rec)
+    assert back.trace == ctx.to_header()
+    with pytest.raises(ValueError, match="trace"):
+        entry_from_wire({**rec, "trace": 7})
+    server = PageChannelServer()
+    try:
+        client = PageChannelClient(f"127.0.0.1:{server.port}")
+        server.publish("h1", [], trace=ctx.to_header())
+        assert client.trace("h1") == ctx.to_header()
+        assert client.trace("nope") is None
+        server.publish("h2", [])  # trace-less publish still serves
+        assert client.trace("h2") is None
+        server.retire("h1")
+        assert client.trace("h1") is None
+    finally:
+        server.close()

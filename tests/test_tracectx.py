@@ -1,0 +1,178 @@
+"""Trace-context identity layer + span/log trace plumbing (ISSUE 15).
+
+Covers obs/tracectx.py (the one id producer, header round-trips,
+child/link derivation, malformed-header refusal), the SpanTracer ring-
+overflow accounting (dllama_spans_dropped_total + the ``dropped`` export
+fields — the silent-truncation satellite), trace filtering of exports,
+and the --log-json trace stamping satellite."""
+
+import json
+
+import pytest
+
+from distributed_llama_tpu.obs import tracectx
+from distributed_llama_tpu.obs.spans import SpanTracer, validate_chrome_trace
+
+
+# ----------------------------------------------------------- id producer
+
+
+def test_ids_are_hex_and_unique():
+    tids = {tracectx.new_trace_id() for _ in range(200)}
+    sids = {tracectx.new_span_id() for _ in range(200)}
+    assert len(tids) == 200 and len(sids) == 200
+    assert all(len(t) == 32 and int(t, 16) >= 0 for t in tids)
+    assert all(len(s) == 16 and int(s, 16) >= 0 for s in sids)
+
+
+def test_seeded_ids_reproduce_and_reset():
+    tracectx.seed_ids(42)
+    try:
+        a = tracectx.new_trace_id()
+        tracectx.seed_ids(42)
+        b = tracectx.new_trace_id()
+        assert a == b
+    finally:
+        tracectx.seed_ids(None)
+    # back on urandom: practically never equal
+    assert tracectx.new_trace_id() != tracectx.new_trace_id()
+
+
+def test_mint_child_and_links():
+    root = tracectx.mint()
+    assert root.parent_id is None and root.link is None
+    child = root.child()
+    assert child.trace_id == root.trace_id
+    assert child.parent_id == root.span_id
+    assert child.span_id != root.span_id
+    linked = root.child(link=tracectx.LINK_RECOVERS)
+    assert linked.link == "recovers"
+    with pytest.raises(ValueError, match="link"):
+        root.child(link="teleport")
+
+
+def test_header_roundtrip_and_from_header():
+    root = tracectx.mint()
+    hdr = root.to_header()
+    assert hdr == f"00-{root.trace_id}-{root.span_id}-01"
+    back = tracectx.parse_header(hdr)
+    assert (back.trace_id, back.span_id) == (root.trace_id, root.span_id)
+    cont = tracectx.from_header(hdr, link=tracectx.LINK_HANDOFF)
+    assert cont.trace_id == root.trace_id
+    assert cont.parent_id == root.span_id
+    assert cont.link == "handoff"
+
+
+@pytest.mark.parametrize("bad", [
+    "", "nonsense", "01-" + "a" * 32 + "-" + "b" * 16 + "-01",
+    "00-short-span-01", "00-" + "g" * 32 + "-" + "b" * 16 + "-01",
+    None, 7])
+def test_malformed_headers_refuse(bad):
+    with pytest.raises(ValueError):
+        tracectx.parse_header(bad)
+
+
+def test_span_fields_shapes():
+    root = tracectx.mint()
+    assert tracectx.span_fields(None) == {}
+    assert tracectx.span_fields(root) == {"trace_id": root.trace_id,
+                                          "span_id": root.span_id}
+    child = root.child(link="handoff")
+    fields = tracectx.span_fields(child)
+    assert fields["parent_span_id"] == root.span_id
+    assert fields["link"] == "handoff"
+
+
+# ------------------------------------------------- span-ring overflow fix
+
+
+def test_span_ring_overflow_counted_and_exported():
+    """The silent-truncation satellite: an overflowing ring counts every
+    eviction, fires on_drop (the metric hook), and both exports carry
+    the count."""
+    drops = []
+    tr = SpanTracer(capacity=4, on_drop=lambda: drops.append(1))
+    for i in range(10):
+        tr.add(f"s{i}", "phase", float(i), 0.001)
+    assert tr.dropped == 6 and len(drops) == 6
+    doc = tr.export_chrome()
+    validate_chrome_trace(doc)
+    assert doc["dropped"] == 6
+    lines = tr.export_ndjson().strip().splitlines()
+    meta = json.loads(lines[-1])
+    assert meta["span"] == "_meta" and meta["dropped"] == 6
+    assert len(lines) == 5  # 4 spans + the meta record
+    # an un-overflowed tracer exports no meta line and dropped == 0
+    quiet = SpanTracer(capacity=4)
+    quiet.add("a", "phase", 0.0, 0.001)
+    assert quiet.export_chrome()["dropped"] == 0
+    assert all(json.loads(ln)["span"] != "_meta"
+               for ln in quiet.export_ndjson().strip().splitlines())
+
+
+def test_engine_overflow_moves_spans_dropped_metric():
+    import numpy as np  # noqa: F401  (jax import below needs the env)
+
+    from distributed_llama_tpu.models.spec import TransformerSpec
+    from distributed_llama_tpu.models.synth import synth_params
+    from distributed_llama_tpu.obs.metrics import Registry
+    from distributed_llama_tpu.runtime.continuous import ContinuousEngine
+
+    spec = TransformerSpec(dim=64, hidden_dim=160, n_layers=2, n_heads=4,
+                           n_kv_heads=2, vocab_size=128, seq_len=16)
+    reg = Registry()
+    eng = ContinuousEngine(spec, synth_params(spec, q40=False, seed=4,
+                                              scale=0.3),
+                           slots=1, temperature=0.0, topp=0.9, seed=5,
+                           metrics=reg)
+    assert "dllama_spans_dropped_total 0" in reg.expose()
+    # shrink the ring so the run overflows it
+    eng._spans._spans = type(eng._spans._spans)(maxlen=2)
+    eng.run([[1, 5, 9], [1, 7]], steps=6)
+    counter = reg.get("dllama_spans_dropped_total")
+    assert counter.value == eng._spans.dropped > 0
+
+
+def test_span_trace_filter():
+    a, b = tracectx.mint(), tracectx.mint()
+    tr = SpanTracer()
+    tr.add("request", "request", 0.0, 0.1, **tracectx.span_fields(a))
+    tr.add("request", "request", 0.2, 0.1, **tracectx.span_fields(b))
+    tr.add("step", "decode", 0.0, 0.05)  # no trace: engine-wide span
+    assert len(tr.snapshot()) == 3
+    only_a = tr.snapshot(trace_id=a.trace_id)
+    assert len(only_a) == 1 and only_a[0].meta["trace_id"] == a.trace_id
+    doc = tr.export_chrome(trace_id=b.trace_id)
+    assert len(doc["traceEvents"]) == 1
+    assert doc["traceEvents"][0]["args"]["trace_id"] == b.trace_id
+    nd = [json.loads(ln) for ln in
+          tr.export_ndjson(trace_id=a.trace_id).strip().splitlines()]
+    assert [r["trace_id"] for r in nd] == [a.trace_id]
+
+
+# ------------------------------------------------- --log-json trace ids
+
+
+def test_log_event_stamps_trace_ids(monkeypatch, capsys):
+    """The logs-join-traces satellite: a --log-json record emitted with a
+    TraceContext carries trace_id/span_id from the SAME producer the
+    spans use."""
+    from distributed_llama_tpu.obs.log import log_event
+
+    monkeypatch.setenv("DLLAMA_LOG_JSON", "1")
+    ctx = tracectx.mint().child(link="handoff")
+    log_event("disagg.handoff_shipped", None, trace=ctx, pages=2)
+    rec = json.loads(capsys.readouterr().out.strip())
+    assert rec["event"] == "disagg.handoff_shipped"
+    assert rec["trace_id"] == ctx.trace_id
+    assert rec["span_id"] == ctx.span_id
+    assert rec["parent_span_id"] == ctx.parent_id
+    assert rec["link"] == "handoff" and rec["pages"] == 2
+    # without a context the record carries no trace fields
+    log_event("plain.event", None, n=1)
+    rec2 = json.loads(capsys.readouterr().out.strip())
+    assert "trace_id" not in rec2
+    # text mode ignores the context entirely
+    monkeypatch.setenv("DLLAMA_LOG_JSON", "0")
+    log_event("x", "human line", trace=ctx)
+    assert capsys.readouterr().out == "human line\n"

@@ -1,0 +1,147 @@
+"""tools/tracejoin.py: stitch, skew-align, refuse on orphans (ISSUE 15).
+
+Unit-level gates on synthetic NDJSON exports (known skew, known orphan
+shapes) plus the CLI file mode's exit codes. The full two-pool drill
+(real engines + TCP page channel) runs in tools/ci.sh and the slow-
+marked continuity suite."""
+
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))), "tools"))
+
+import tracejoin  # noqa: E402
+
+from distributed_llama_tpu.obs import tracectx  # noqa: E402
+from distributed_llama_tpu.obs.spans import validate_chrome_trace  # noqa: E402
+
+
+def _span(name, cat, t0, dur_ms, ctx=None, **extra):
+    rec = {"span": name, "cat": cat, "t_start_s": t0, "dur_ms": dur_ms,
+           "tid": 1, "depth": 0}
+    if ctx is not None:
+        rec.update(tracectx.span_fields(ctx))
+    rec.update(extra)
+    return rec
+
+
+def _two_pool_exports(skew_s=5.0):
+    """A minimal well-formed pair of exports: decode pool holds the root
+    request + handoff send; prefill pool (clock shifted by ``skew_s``)
+    holds the recv + the stub's request span; the decode continuation
+    links on the stub span."""
+    root = tracectx.mint()
+    rpc = root.child()
+    recv = rpc.child()
+    stub = recv.child()
+    cont = stub.child(link="handoff")
+    decode = [
+        _span("request", "request", 1.0, 400.0, root),
+        _span("handoff", "handoff", 1.05, 200.0, rpc),
+        _span("handoff", "link", 1.3, 0.0, cont),
+        _span("request", "request", 1.3, 90.0, cont),
+    ]
+    prefill = [
+        # prefill's clock: its epoch differs by skew_s
+        _span("prefill_handoff", "handoff", 1.10 - skew_s, 80.0, recv),
+        _span("request", "request", 1.06 - skew_s, 30.0, stub),
+    ]
+    return decode, prefill, root
+
+
+def test_join_aligns_skew_and_reports_pair():
+    decode, prefill, root = _two_pool_exports(skew_s=5.0)
+    doc, report = tracejoin.join_pools(decode, prefill, "decode",
+                                       "prefill")
+    assert report["orphans"] == []
+    assert report["pairs"] == 1
+    # recovered offset = the injected skew (midpoint alignment is exact
+    # here because the synthetic recv is centered where it was recorded)
+    send_mid = 1.05 + 0.1
+    recv_mid = (1.10 - 5.0) + 0.04
+    assert report["offset_s"] == pytest.approx(send_mid - recv_mid,
+                                               abs=1e-6)
+    assert root.trace_id in report["traces_joined"]
+    validate_chrome_trace(doc)
+    # both pools present as distinct pid lanes, recv inside send after
+    # the shift
+    by_name = {}
+    for ev in doc["traceEvents"]:
+        if ev["ph"] == "X":
+            by_name.setdefault(ev["name"], []).append(ev)
+    (send,) = [e for e in by_name["handoff"]
+               if e["args"].get("link") is None and e["cat"] == "handoff"]
+    (recv,) = by_name["prefill_handoff"]
+    assert send["pid"] != recv["pid"]
+    assert send["ts"] <= recv["ts"]
+    assert recv["ts"] + recv["dur"] <= send["ts"] + send["dur"] + 1e-3
+
+
+def test_orphan_unmatched_send_and_recv():
+    decode, prefill, _ = _two_pool_exports()
+    # drop the recv: the send is unmatched
+    _, report = tracejoin.join_pools(decode, [prefill[1]], "d", "p")
+    assert any("no recv span" in o for o in report["orphans"])
+    assert report["pairs"] == 0
+    # a recv whose parent never shipped (fresh root) is sender-less
+    alien = tracectx.mint()
+    prefill2 = [_span("prefill_handoff", "handoff", 0.0, 10.0, alien),
+                prefill[1]]
+    _, report2 = tracejoin.join_pools([decode[0]], prefill2, "d", "p")
+    assert any("no matching send" in o for o in report2["orphans"])
+
+
+def test_orphan_link_without_parent():
+    decode, prefill, _ = _two_pool_exports()
+    # strip the stub's request span: the continuation link's parent is
+    # gone from the joined set
+    _, report = tracejoin.join_pools(decode, [prefill[0]], "d", "p")
+    assert any("link span" in o and "absent" in o
+               for o in report["orphans"])
+    # a 'recovers' link is EXEMPT: its parent span died with the
+    # crashed process's tracer — expected-missing, not a break
+    ghost = tracectx.mint().child(link="recovers")
+    decode2 = decode + [_span("recovers", "link", 2.0, 0.0, ghost)]
+    _, report2 = tracejoin.join_pools(decode2, prefill, "d", "p")
+    assert report2["orphans"] == []
+
+
+def test_load_ndjson_consumes_meta_and_rejects_garbage(tmp_path):
+    p = tmp_path / "a.ndjson"
+    p.write_text(json.dumps({"span": "x", "cat": "phase",
+                             "t_start_s": 0.0, "dur_ms": 1.0}) + "\n"
+                 + json.dumps({"span": "_meta", "cat": "meta",
+                               "dropped": 3}) + "\n")
+    spans, dropped = tracejoin.load_ndjson_spans(str(p))
+    assert len(spans) == 1 and dropped == 3
+    bad = tmp_path / "b.ndjson"
+    bad.write_text("not json\n")
+    with pytest.raises(ValueError):
+        tracejoin.load_ndjson_spans(str(bad))
+
+
+def test_cli_exit_codes(tmp_path):
+    decode, prefill, _ = _two_pool_exports()
+    pa, pb = tmp_path / "a.ndjson", tmp_path / "b.ndjson"
+    pa.write_text("\n".join(json.dumps(s) for s in decode) + "\n")
+    pb.write_text("\n".join(json.dumps(s) for s in prefill) + "\n")
+    out = tmp_path / "joined.json"
+    assert tracejoin.main([str(pa), str(pb), "--chrome-out", str(out),
+                           "--json"]) == 0
+    validate_chrome_trace(json.loads(out.read_text()))
+    # orphaned input -> exit 1, and no artifact is written
+    pb_orphan = tmp_path / "b2.ndjson"
+    pb_orphan.write_text(json.dumps(prefill[1]) + "\n")
+    out2 = tmp_path / "joined2.json"
+    assert tracejoin.main([str(pa), str(pb_orphan), "--chrome-out",
+                           str(out2), "--json"]) == 1
+    assert not out2.exists()
+    # usage errors are 2, never a vacuous 0/1
+    assert tracejoin.main([str(pa)]) == 2
+    assert tracejoin.main([str(pa), str(pb), "--inject",
+                           "drop-traceparent"]) == 2
+    assert tracejoin.main([str(pa), "missing.ndjson"]) == 2

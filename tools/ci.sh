@@ -168,6 +168,46 @@ if [ "$disagg_rc" -ne 1 ]; then
          "(exit $disagg_rc, expected 1)" >&2
     exit 1
 fi
+# Distributed-tracing gate (ISSUE 15): the two-pool tracejoin drill —
+# real DisaggPair over the TCP page channel — must stitch both pools'
+# NDJSON exports into ONE valid Chrome trace (zero orphans, the handoff
+# send/recv anchor pair present, >= 1 trace spanning both pools), and
+# the watchdog leg must produce a flight-recorder bundle that
+# tools/tracecheck.py validates (the crash-forensics artifact must never
+# be discovered malformed mid-incident)
+mkdir -p tools/ci_artifacts
+python tools/tracejoin.py --drill \
+    --chrome-out tools/ci_artifacts/twopool_trace.json \
+    --flightrec-out tools/ci_artifacts/flightrec_bundle.json --json \
+    > tools/ci_artifacts/tracejoin_drill.json
+python tools/tracecheck.py tools/ci_artifacts/flightrec_bundle.json
+# ... and the join gate must still CATCH a propagation break: with the
+# seeded drop-traceparent mutation armed (the handoff loses its header
+# at the seam), tracejoin must report orphan spans and exit 1 EXACTLY —
+# 2 is a usage error and would pass a naive non-zero check vacuously
+set +e
+python tools/tracejoin.py --drill --inject drop-traceparent \
+    > /dev/null 2>&1
+tracejoin_rc=$?
+set -e
+if [ "$tracejoin_rc" -ne 1 ]; then
+    echo "ci: tracejoin did not flag the dropped traceparent" \
+         "(exit $tracejoin_rc, expected 1)" >&2
+    exit 1
+fi
+# Fleet signal plane gate (ISSUE 15): the virtual-clock multi-replica
+# rollup must be DETERMINISTIC — same seed => byte-identical row — and
+# internally consistent (fleetcheck's own sum checks exit 1 on drift)
+python tools/fleetcheck.py --sim 4 --seed 7 --json \
+    > tools/ci_artifacts/fleetcheck_a.json
+python tools/fleetcheck.py --sim 4 --seed 7 --json \
+    > tools/ci_artifacts/fleetcheck_b.json
+if ! cmp -s tools/ci_artifacts/fleetcheck_a.json \
+        tools/ci_artifacts/fleetcheck_b.json; then
+    echo "ci: fleetcheck --sim rows differ across identical seeds —" \
+         "the rollup is not deterministic" >&2
+    exit 1
+fi
 # SLO observatory gate (ISSUE 8) + crash-safety recovery gate (ISSUE 9):
 # a small deterministic loadcheck run — the virtual-clock offered-load
 # sweep held to the checked-in CPU goodput band

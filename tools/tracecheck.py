@@ -60,6 +60,42 @@ def attribution_chrome_trace(att, report) -> dict:
     return doc
 
 
+def _check_bundle(path: str, emit_json: bool = False) -> int:
+    """Validate + summarize a flight-recorder bundle (obs/flightrec).
+    Exit 0 = loadable and schema-clean, 1 = damaged, 2 = unreadable."""
+    from distributed_llama_tpu.obs.flightrec import load_bundle
+
+    try:
+        bundle = load_bundle(path)
+    except OSError as e:
+        print(f"tracecheck: {e}", file=sys.stderr)
+        return 2
+    except ValueError as e:
+        print(f"tracecheck: flight-recorder bundle {path} is invalid: "
+              f"{e}", file=sys.stderr)
+        return 1
+    metric_lines = sum(1 for ln in bundle["metrics"].splitlines()
+                       if ln and not ln.startswith("#"))
+    summary = {
+        "kind": bundle["kind"], "reason": bundle["reason"],
+        "ts": bundle["ts"], "pid": bundle.get("pid"),
+        "events": len(bundle["events"]), "spans": len(bundle["spans"]),
+        "spans_dropped": bundle["spans_dropped"],
+        "metric_samples": metric_lines,
+        "journal_tail_records": len(bundle["journal_tail"]),
+        "config_keys": sorted(bundle["config"]),
+    }
+    if emit_json:
+        print(json.dumps(summary))
+    else:
+        print(f"flight-recorder bundle OK: reason={summary['reason']} "
+              f"events={summary['events']} spans={summary['spans']} "
+              f"(+{summary['spans_dropped']} dropped) "
+              f"metrics={summary['metric_samples']} samples "
+              f"journal_tail={summary['journal_tail_records']} records")
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="tracecheck",
@@ -82,6 +118,14 @@ def main(argv=None) -> int:
                     help="emit one machine-readable JSON object instead "
                          "of the table")
     args = ap.parse_args(argv)
+
+    from distributed_llama_tpu.obs.flightrec import is_bundle_file
+
+    if is_bundle_file(args.capture):
+        # a crash-forensics flight-recorder bundle (ISSUE 15): validate
+        # it and summarize — exit 1 on schema damage (a postmortem
+        # artifact discovered malformed mid-incident is worse than none)
+        return _check_bundle(args.capture, emit_json=args.json)
 
     from distributed_llama_tpu.obs.drift import reconcile_capture
     from distributed_llama_tpu.obs.spans import validate_chrome_trace
