@@ -62,6 +62,13 @@ class ReplicaSignals:
     # class -> {"attempted", "met", "violated", "failed",
     #           "goodput_tokens"} (the /health slo block's counts)
     slo: dict = dataclasses.field(default_factory=dict)
+    # cost-accounting columns (ISSUE 16, the /health "sched" block):
+    # Σ KV page-seconds billed, stall seconds by cause, and per-class
+    # SUMMABLE cost counts (tokens/requests/compute_s/page_s/stall_s —
+    # ratios are recomputed at rollup, never carried)
+    page_seconds: float = 0.0
+    stall_seconds: dict = dataclasses.field(default_factory=dict)
+    cost_classes: dict = dataclasses.field(default_factory=dict)
 
     @property
     def prefix_hit_rate(self) -> float:
@@ -73,6 +80,7 @@ class ReplicaSignals:
         out["prefix_hit_rate"] = round(self.prefix_hit_rate, 6)
         out["occupancy"] = round(self.occupancy, 6)
         out["uptime_s"] = round(self.uptime_s, 3)
+        out["page_seconds"] = round(self.page_seconds, 9)
         return out
 
 
@@ -95,6 +103,9 @@ class FleetRollup:
     prefill_tokens_saved: int = 0
     goodput_tokens: int = 0
     slo: dict = dataclasses.field(default_factory=dict)
+    page_seconds: float = 0.0
+    stall_seconds: dict = dataclasses.field(default_factory=dict)
+    cost_classes: dict = dataclasses.field(default_factory=dict)
 
     @property
     def occupancy(self) -> float:
@@ -114,11 +125,47 @@ class FleetRollup:
                         if attempted else 1.0)
         return out
 
+    @property
+    def cost_per_goodput_token(self) -> float:
+        """Fleet compute seconds per GOODPUT token — Σ per-class compute
+        seconds / Σ goodput tokens, the attribution headline: what a
+        deadline-met token actually cost the fleet."""
+        compute = sum(c.get("compute_s", 0.0)
+                      for c in self.cost_classes.values())
+        return compute / self.goodput_tokens if self.goodput_tokens else 0.0
+
+    @property
+    def cost(self) -> dict:
+        """Per-class cost columns RECOMPUTED from the summed counts (the
+        module-docstring pin: never average per-replica ratios)."""
+        out = {}
+        for cls, c in sorted(self.cost_classes.items()):
+            toks = c.get("tokens", 0)
+            out[cls] = {
+                "tokens": toks,
+                "requests": c.get("requests", 0),
+                "page_seconds": round(c.get("page_s", 0.0), 9),
+                "stall_seconds": round(c.get("stall_s_total", 0.0), 9),
+                "cost_per_token_s": (
+                    round(c.get("compute_s", 0.0) / toks, 9)
+                    if toks else 0.0),
+                "page_s_per_token": (
+                    round(c.get("page_s", 0.0) / toks, 9)
+                    if toks else 0.0),
+            }
+        return out
+
     def to_json(self) -> dict:
         out = dataclasses.asdict(self)
         out["occupancy"] = round(self.occupancy, 6)
         out["prefix_hit_rate"] = round(self.prefix_hit_rate, 6)
         out["attainment"] = self.attainment
+        out["page_seconds"] = round(self.page_seconds, 9)
+        out["stall_seconds"] = {k: round(v, 9) for k, v
+                                in sorted(self.stall_seconds.items())}
+        out["cost"] = self.cost
+        out["cost_per_goodput_token"] = round(
+            self.cost_per_goodput_token, 9)
         return out
 
 
@@ -146,6 +193,17 @@ def rollup(rows: list) -> FleetRollup:
             cell = agg.slo.setdefault(cls, {})
             for key, v in counts.items():
                 if isinstance(v, (int, float)) and not key.endswith("_s"):
+                    cell[key] = cell.get(key, 0) + v
+        agg.page_seconds += r.page_seconds
+        for cause, s in r.stall_seconds.items():
+            agg.stall_seconds[cause] = agg.stall_seconds.get(cause, 0.0) + s
+        # cost cells: sum EVERY numeric count (tokens AND seconds — cost
+        # ratios are recomputed from these sums in FleetRollup.cost, so
+        # unlike the slo block the _s fields must survive the merge)
+        for cls, counts in r.cost_classes.items():
+            cell = agg.cost_classes.setdefault(cls, {})
+            for key, v in counts.items():
+                if isinstance(v, (int, float)):
                     cell[key] = cell.get(key, 0) + v
     return agg
 
@@ -177,6 +235,22 @@ def signals_from_health(name: str, payload: dict) -> ReplicaSignals:
                         for k in ("attempted", "met", "violated",
                                   "failed", "goodput_tokens")}
         row.goodput_tokens += row.slo[cls]["goodput_tokens"]
+    # the accounting plane's /health "sched" block (ISSUE 16): absent on
+    # pre-ledger servers — the row simply carries zero cost columns
+    sched = payload.get("sched") or {}
+    totals = sched.get("cost_totals") or {}
+    row.page_seconds = float(totals.get("page_s", 0.0))
+    for cause, s in (totals.get("stall_s") or {}).items():
+        row.stall_seconds[str(cause)] = float(s)
+    for cls, cell in (sched.get("cost_by_class") or {}).items():
+        row.cost_classes[cls] = {
+            "tokens": int(cell.get("tokens", 0)),
+            "requests": int(cell.get("requests", 0)),
+            "compute_s": float(cell.get("compute_s", 0.0)),
+            "page_s": float(cell.get("page_s", 0.0)),
+            "stall_s_total": float(cell.get("stall_s_total", 0.0)),
+            "page_steps": int(cell.get("page_steps", 0)),
+        }
     return row
 
 
@@ -215,7 +289,34 @@ def apply_metrics(row: ReplicaSignals, samples: dict) -> ReplicaSignals:
                   if k.startswith("dllama_goodput_tokens_total"))
     if goodput:
         row.goodput_tokens = int(goodput)
+    # ISSUE 16 labeled series: cross-fill the cost columns from the
+    # counters when /health came from a pre-ledger build (or was pruned)
+    page_s = 0.0
+    seen_page = False
+    for k, v in samples.items():
+        if k.startswith("dllama_page_seconds_total{"):
+            page_s += v
+            seen_page = True
+        elif k.startswith("dllama_stall_seconds_total{"):
+            cause = _series_label(k, "cause")
+            if cause and cause not in row.stall_seconds:
+                row.stall_seconds[cause] = v
+    if seen_page and not row.page_seconds:
+        row.page_seconds = page_s
     return row
+
+
+def _series_label(series_key: str, label: str) -> str | None:
+    """Pull one label value out of a ``name{a="x",b="y"}`` series key
+    (parse_metrics keys series by the exposed line verbatim)."""
+    lo = series_key.find("{")
+    if lo < 0 or not series_key.endswith("}"):
+        return None
+    for part in series_key[lo + 1:-1].split(","):
+        k, _, v = part.partition("=")
+        if k.strip() == label:
+            return v.strip().strip('"')
+    return None
 
 
 def scrape_replica(name: str, base_url: str,

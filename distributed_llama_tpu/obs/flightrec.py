@@ -58,6 +58,8 @@ class FlightRecorder:
         self._events: deque = deque(maxlen=max(capacity, 1))
         self._registry = registry
         self._spans = spans
+        self._census = None
+        self._ledgers = None
         self.journal_path = journal_path
         self.config = dict(config) if config else {}
         self.tail_lines = tail_lines
@@ -66,10 +68,14 @@ class FlightRecorder:
 
     def bind(self, registry=None, spans=None,
              journal_path: str | None = None,
-             config: dict | None = None) -> None:
+             config: dict | None = None, census=None,
+             ledgers=None) -> None:
         """Late attachment: the server builds the recorder before the
         engine exists (notes from construction must not be lost) and
-        binds the span tracer / journal path once they do."""
+        binds the span tracer / journal path once they do. ``census``
+        (obs/ledger.CensusRing) and ``ledgers`` (obs/ledger.LedgerBook)
+        put the scheduler's dispatch tail and the mid-flight requests'
+        bills into the postmortem (ISSUE 16)."""
         if registry is not None:
             self._registry = registry
         if spans is not None:
@@ -78,6 +84,10 @@ class FlightRecorder:
             self.journal_path = journal_path
         if config:
             self.config.update(config)
+        if census is not None:
+            self._census = census
+        if ledgers is not None:
+            self._ledgers = ledgers
 
     def note(self, event: str, **fields) -> None:
         """Record one operational event into the ring (wall-clock
@@ -138,7 +148,7 @@ class FlightRecorder:
             stamp = run_stamp()
         except Exception:  # noqa: BLE001 - the stamp must never kill a dump
             stamp = {}
-        return {
+        bundle = {
             "kind": BUNDLE_KIND, "version": BUNDLE_VERSION,
             "reason": str(reason), "ts": round(time.time(), 6),
             "pid": os.getpid(),
@@ -150,6 +160,21 @@ class FlightRecorder:
             "metrics": metrics,
             "journal_tail": self._journal_tail(),
         }
+        # scheduler forensics (ISSUE 16): the census ring tail (what was
+        # the engine dispatching when it died) and the OPEN ledgers (who
+        # was mid-flight, holding what). Best-effort like the journal
+        # tail — the dump path must survive a broken engine.
+        if self._census is not None:
+            try:
+                bundle["census_tail"] = self._census.tail(self.tail_lines)
+            except Exception:  # noqa: BLE001 - never kill a dump
+                bundle["census_tail"] = []
+        if self._ledgers is not None:
+            try:
+                bundle["open_ledgers"] = self._ledgers.open_snapshots()
+            except Exception:  # noqa: BLE001 - never kill a dump
+                bundle["open_ledgers"] = []
+        return bundle
 
     def dump(self, target: str, reason: str) -> str:
         """Write one bundle file and return its path. ``target`` is a
@@ -209,6 +234,15 @@ def validate_bundle(obj) -> None:
         raise ValueError("bundle 'config' must be an object")
     if not isinstance(obj.get("spans_dropped"), int):
         raise ValueError("bundle missing integer 'spans_dropped'")
+    # scheduler-forensics sections (ISSUE 16): validate-if-present so
+    # bundles from builds without them stay loadable (same version)
+    for key in ("census_tail", "open_ledgers"):
+        if key in obj:
+            if not isinstance(obj[key], list):
+                raise ValueError(f"bundle '{key}' must be an array")
+            for i, rec in enumerate(obj[key]):
+                if not isinstance(rec, dict):
+                    raise ValueError(f"{key}[{i}]: not an object")
 
 
 def load_bundle(path: str) -> dict:

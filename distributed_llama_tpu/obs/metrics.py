@@ -131,16 +131,17 @@ class Histogram:
     """
 
     kind = "histogram"
-    __slots__ = ("name", "help", "buckets", "_lock", "_counts", "_sum",
-                 "_count")
+    __slots__ = ("name", "help", "labels", "buckets", "_lock", "_counts",
+                 "_sum", "_count")
 
     def __init__(self, name: str, help: str = "",
-                 buckets: tuple = LATENCY_BUCKETS, lock=None):
+                 buckets: tuple = LATENCY_BUCKETS, lock=None, labels=()):
         if not buckets or list(buckets) != sorted(set(buckets)):
             raise ValueError(f"histogram {name}: buckets must be sorted "
                              f"unique upper bounds, got {buckets!r}")
         self.name = name
         self.help = help
+        self.labels = tuple(labels)
         self.buckets = tuple(float(b) for b in buckets)
         self._lock = lock or threading.Lock()
         self._counts = [0] * (len(self.buckets) + 1)  # + the +Inf bucket
@@ -203,14 +204,19 @@ class Histogram:
 
     def expose(self) -> list[str]:
         counts, s, total = self.snapshot()
+        suffix = _render_labels(self.labels)
         out, cum = [], 0
         for b, c in zip(self.buckets, counts):
             cum += c
-            out.append(f'{self.name}_bucket{{le="{_fmt(b)}"}} {cum}')
+            out.append(f'{self.name}_bucket'
+                       f'{_render_labels(self.labels + (("le", _fmt(b)),))}'
+                       f' {cum}')
         cum += counts[-1]
-        out.append(f'{self.name}_bucket{{le="+Inf"}} {cum}')
-        out.append(f"{self.name}_sum {_fmt(s)}")
-        out.append(f"{self.name}_count {total}")
+        out.append(f'{self.name}_bucket'
+                   f'{_render_labels(self.labels + (("le", "+Inf"),))}'
+                   f' {cum}')
+        out.append(f"{self.name}_sum{suffix} {_fmt(s)}")
+        out.append(f"{self.name}_count{suffix} {total}")
         return out
 
 
@@ -287,6 +293,15 @@ class Registry:
     def histogram(self, name: str, help: str = "",
                   buckets: tuple = LATENCY_BUCKETS) -> Histogram:
         return self._get_or_create(Histogram, name, help, buckets=buckets)
+
+    def labeled_histogram(self, name: str, labels: dict, help: str = "",
+                          buckets: tuple = LATENCY_BUCKETS) -> Histogram:
+        """One labeled series of the histogram family ``name`` (e.g.
+        dllama_request_queue_wait_by_class_seconds{class="batch"});
+        the ``le`` bucket label merges into the series label set at
+        exposition."""
+        return self._get_or_create(Histogram, name, help, buckets=buckets,
+                                   labels=tuple(labels.items()))
 
     def get(self, name: str):
         """Look up a series by its key: the bare name, or

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import os
 
+from .ledger import STALL_CAUSES, TOKEN_KINDS
 from .metrics import (COUNT_BUCKETS, LATENCY_BUCKETS, RATE_BUCKETS, Registry)
 
 # Finer low end than LATENCY_BUCKETS: a fused decode step is sub-ms on a
@@ -190,10 +191,42 @@ class EngineMetrics:
             "dllama_spec_accepted_total",
             "Draft tokens the verify forward accepted (greedy exact "
             "match, or the rejection-sampling accept at temperature > 0)")
+        # cost-ledger / scheduler-census series (ISSUE 16). The closed
+        # vocabularies (token kinds, stall causes) pre-register so a
+        # fresh scrape shows the full matrix at zero; per-class series
+        # auto-create on first sight of a class, with "default" seeded
+        # so the family exists from the start (the reject(reason) idiom)
+        self.dispatch_tokens = {
+            kind: registry.labeled_counter(
+                "dllama_dispatch_tokens_total", {"kind": kind},
+                "Tokens accounted by the dispatch census, by kind "
+                "(decode = sampled, prefill = prompt positions "
+                "filled/echoed, spec = draft tokens proposed)")
+            for kind in TOKEN_KINDS}
+        self.stall_seconds = {
+            cause: registry.labeled_counter(
+                "dllama_stall_seconds_total", {"cause": cause},
+                "Request-attributed stall wall time by cause (pool_dry "
+                "= page-starved park, promo_pending = tier promotion "
+                "in flight, prefill_hold = admission hold park, "
+                "queue_wait = waiting for a slot, handoff_wait = DCN "
+                "page shipping)")
+            for cause in STALL_CAUSES}
+        self._page_seconds: dict = {}
+        self.add_page_seconds("default", 0.0)
+        self._cost_hists: dict = {}
+        self._cost_hist("default")
+        self._queue_by_class: dict = {}
+        self.set_class_queue_depth({"default": 0})
+        self._queue_wait_by_class: dict = {}
+        self._class_queue_wait("default")
         # per-scheme collective series, bound by bind_collectives() when
         # the engine runs sharded: [(launch counter, byte counter,
         # launches/step, bytes/step)] — empty (and never touched) at tp=1
         self._collectives: list = []
+        # Σ bytes/chip/step of the bound collective schedule — the
+        # ledger's ICI pro-ration numerator (0.0 until bind_collectives)
+        self.ici_bytes_per_step = 0.0
 
     def bind_kv_pool(self, kv_quant: str, pool_bytes: int,
                      n_pages: int) -> None:
@@ -244,6 +277,92 @@ class EngineMetrics:
         return {reason: int(c.value)
                 for reason, c in sorted(self._rejected.items())}
 
+    def count_dispatch_tokens(self, kind: str, n: int = 1) -> None:
+        self.dispatch_tokens[kind].inc(n)
+
+    def add_stall_seconds(self, cause: str, dt_s: float) -> None:
+        if dt_s > 0:
+            self.stall_seconds[cause].inc(dt_s)
+
+    def add_page_seconds(self, cls: str, s: float) -> None:
+        """Per-SLO-class KV page-seconds counter; classes auto-create
+        on first sight (reject(reason) idiom, "default" pre-seeded)."""
+        c = self._page_seconds.get(cls)
+        if c is None:
+            c = self.registry.labeled_counter(
+                "dllama_page_seconds_total", {"class": cls},
+                "KV page-seconds held, attributed to the owning "
+                "request's SLO class (pages x dispatch wall time, "
+                "integrated at step granularity)")
+            self._page_seconds[cls] = c
+        if s > 0:
+            c.inc(s)
+
+    def _cost_hist(self, cls: str) -> dict:
+        """The per-class request-cost histogram triple (created on
+        first sight of the class)."""
+        hs = self._cost_hists.get(cls)
+        if hs is None:
+            lh = self.registry.labeled_histogram
+            hs = {
+                "dispatch": lh(
+                    "dllama_request_cost_dispatch_seconds",
+                    {"class": cls},
+                    "Per-request share of dispatch wall time (decode "
+                    "rows + prefill chunks), observed at close"),
+                "page": lh(
+                    "dllama_request_cost_page_seconds", {"class": cls},
+                    "Per-request KV page-seconds held, observed at "
+                    "close"),
+                "stall": lh(
+                    "dllama_request_cost_stall_seconds", {"class": cls},
+                    "Per-request stall wall time summed over causes, "
+                    "observed at close"),
+            }
+            self._cost_hists[cls] = hs
+        return hs
+
+    def _class_queue_wait(self, cls: str):
+        h = self._queue_wait_by_class.get(cls)
+        if h is None:
+            h = self.registry.labeled_histogram(
+                "dllama_request_queue_wait_by_class_seconds",
+                {"class": cls},
+                "Time from submit() to slot admission, by SLO class "
+                "(head-of-line blocking across classes is visible "
+                "here, not in the class-blind aggregate)")
+            self._queue_wait_by_class[cls] = h
+        return h
+
+    def set_class_queue_depth(self, counts: dict) -> None:
+        """Write dllama_queue_depth_by_class{class=...}: every class in
+        ``counts`` gets its depth; previously-seen classes absent from
+        this snapshot drop to zero (a drained class must read 0, not
+        its stale last value)."""
+        for cls in self._queue_by_class:
+            if cls not in counts:
+                self._queue_by_class[cls].set(0)
+        for cls, n in counts.items():
+            g = self._queue_by_class.get(cls)
+            if g is None:
+                g = self.registry.labeled_gauge(
+                    "dllama_queue_depth_by_class", {"class": cls},
+                    "Requests waiting for a slot, by SLO class "
+                    "(dllama_queue_depth is the class-blind sum)")
+                self._queue_by_class[cls] = g
+            g.set(n)
+
+    def observe_request_cost(self, snap: dict) -> None:
+        """Fold one CLOSED ledger snapshot into the per-class cost
+        histograms + the page-seconds counter."""
+        cls = snap.get("class") or "default"
+        hs = self._cost_hist(cls)
+        hs["dispatch"].observe(snap.get("dispatch_s", 0.0)
+                               + snap.get("prefill_s", 0.0))
+        hs["page"].observe(snap.get("page_s", 0.0))
+        hs["stall"].observe(sum((snap.get("stall_s") or {}).values()))
+        self.add_page_seconds(cls, 0.0)  # ensure the class series exists
+
     def bind_collectives(self, budget, scheme: str, rows: int = 1) -> None:
         """Register the analytic collective budget as labeled series so
         /metrics shows the exact schedule the drift gate checks against
@@ -264,6 +383,10 @@ class EngineMetrics:
                 "(ring-accounted, comm_stats)"),
              count, moved_bytes * rows)
             for kind, count, moved_bytes in budget.entries]
+        # the ledger pro-rates ICI per active row from this (bytes/chip
+        # per device step, whole-batch)
+        self.ici_bytes_per_step = float(
+            sum(moved_bytes * rows for _, _, moved_bytes in budget.entries))
 
     def record_step(self, dt_s: float, active: int, steps: int = 1) -> None:
         """One scheduler iteration: ``steps`` device steps (1 for
@@ -289,6 +412,12 @@ class EngineMetrics:
         self.completed.inc()
         if req.t_admit and req.t_enqueue:
             self.queue_wait.observe(req.t_admit - req.t_enqueue)
+            # the ledger already resolved the billing class through the
+            # SLO policy default; fall back only for ledger-less engines
+            cls = (getattr(getattr(req, "ledger", None), "slo_class", None)
+                   or getattr(req, "slo_class", "") or "default")
+            self._class_queue_wait(cls).observe(
+                req.t_admit - req.t_enqueue)
         if req.t_first_token and req.t_enqueue:
             self.ttft.observe(req.t_first_token - req.t_enqueue)
         if req.n_sampled > 0 and req.t_first_token:

@@ -71,6 +71,16 @@ class ChaosMonkey:
     # prefill pool's, which tools/tracejoin.py must report as orphan
     # spans (the trace-propagation gate's mutation arm)
     drop_traceparent: bool = False
+    # cost ledger (ISSUE 16): charge every decode/spec dispatch TWICE
+    # into the per-request ledgers while the census counts it once —
+    # breaks the Σ-ledger == engine-totals conservation equalities,
+    # which tools/costcheck.py must catch (the accounting gate's
+    # mutation arm)
+    double_count_dispatch: bool = False
+    # cost ledger (ISSUE 16): retire requests WITHOUT closing their
+    # ledger — the zero-open-ledgers-after-drain check must flag the
+    # orphans
+    leak_ledger: bool = False
     # injection counters (read by drills / surfaced in loadcheck rows)
     injected_delays: int = 0
     denied_allocs: int = 0
@@ -78,6 +88,8 @@ class ChaosMonkey:
     dropped_demotions: int = 0
     dropped_pages: int = 0
     dropped_traceparents: int = 0
+    double_counted: int = 0
+    leaked_ledgers: int = 0
     _dispatches: int = 0
 
     def on_dispatch(self) -> None:
@@ -133,6 +145,23 @@ class ChaosMonkey:
             return True
         return False
 
+    def dispatch_double(self) -> bool:
+        """Ledger hook per decode/spec dispatch charge pass: True =
+        multiply this dispatch's LEDGER charges by two while the census
+        counts it once — the conservation break costcheck must catch."""
+        if self.double_count_dispatch:
+            self.double_counted += 1
+            return True
+        return False
+
+    def ledger_leak(self) -> bool:
+        """Retire hook: True = skip closing this request's ledger — the
+        orphan the zero-open-after-drain check must flag."""
+        if self.leak_ledger:
+            self.leaked_ledgers += 1
+            return True
+        return False
+
     def injection_summary(self) -> dict:
         return {"dispatches": self._dispatches,
                 "injected_delays": self.injected_delays,
@@ -140,7 +169,9 @@ class ChaosMonkey:
                 "leaked_pages": len(self.leaked_pages),
                 "dropped_demotions": self.dropped_demotions,
                 "dropped_pages": self.dropped_pages,
-                "dropped_traceparents": self.dropped_traceparents}
+                "dropped_traceparents": self.dropped_traceparents,
+                "double_counted": self.double_counted,
+                "leaked_ledgers": self.leaked_ledgers}
 
     @classmethod
     def parse(cls, text: str) -> "ChaosMonkey":
@@ -160,14 +191,16 @@ class ChaosMonkey:
             elif key in ("step_delay_every", "deny_pages"):
                 kw[key] = int(val)
             elif key in ("leak_on_cancel", "drop_on_demote",
-                         "drop_page_in_flight", "drop_traceparent"):
+                         "drop_page_in_flight", "drop_traceparent",
+                         "double_count_dispatch", "leak_ledger"):
                 kw[key] = val.strip().lower() not in ("0", "false", "")
             else:
                 raise ValueError(
                     f"unknown chaos knob {key!r} (have step_delay_every, "
                     f"step_delay_ms, deny_pages, leak_on_cancel, "
                     f"drop_on_demote, drop_page_in_flight, "
-                    f"drop_traceparent)")
+                    f"drop_traceparent, double_count_dispatch, "
+                    f"leak_ledger)")
         return cls(**kw)
 
 

@@ -23,6 +23,7 @@ output (the parity gate of tests/test_continuous.py).
 
 from __future__ import annotations
 
+import collections
 import contextlib
 import dataclasses
 import threading
@@ -34,6 +35,7 @@ import numpy as np
 from ..io.tokenizer import BOS
 from ..models.spec import TransformerSpec
 from ..obs import tracectx
+from ..obs.ledger import CensusRing, LedgerBook
 from .sampling import Sampler
 
 
@@ -95,6 +97,14 @@ class Request:
     t_first_token: float = 0.0
     t_finish: float = 0.0
     n_sampled: int = 0  # sampled (non-forced) tokens emitted
+    # cost accounting (ISSUE 16, obs/ledger.py): the live RequestLedger
+    # handle submit() opens (seam code — the DCN handoff — charges
+    # through it without a book lookup), and the snapshot a previous
+    # life carried across a recovery/handoff seam (the journal record's
+    # ``ledger`` field) — merged into this life's snapshot so the bill
+    # stays whole across seams
+    ledger: Any = None
+    carried_cost: dict | None = None
 
 
 def _maybe_bf16(fn, enable: bool, jax_mod, jit: bool = False):
@@ -563,6 +573,13 @@ class ContinuousEngine:
         self._submitted = 0 if journal is None else journal.next_id
         self._chains: dict = {}  # (k, greedy_only) -> fused chain program
         self.stats = ContinuousStats()
+        # request-cost accounting + dispatch census (ISSUE 16, obs/
+        # ledger.py): always on like stats and the SLOTracker — pure
+        # host bookkeeping charged once per DISPATCH, not per token; the
+        # Prometheus pushes stay behind the self._obs guard below
+        self._book = LedgerBook()
+        self._census = CensusRing(slots)
+        self._ici_row_bytes = 0.0  # per-row ICI bytes per device step
         # telemetry is opt-in: ``metrics`` is an obs.metrics.Registry; when
         # None (the default) self._obs stays None and every guarded call
         # site below is skipped — the hot path makes ZERO registry calls
@@ -598,6 +615,10 @@ class ContinuousEngine:
                 self._obs.bind_collectives(
                     tp_collective_budget(spec, mesh.shape["tp"], scheme),
                     scheme, rows=slots)
+                # per-row share of the budget's per-step bytes — the
+                # ledger's pro-rated ICI attribution (ISSUE 16)
+                self._ici_row_bytes = (self._obs.ici_bytes_per_step
+                                       / max(slots, 1))
         else:
             self._obs = None
             self._spans = None
@@ -620,6 +641,18 @@ class ContinuousEngine:
         """The obs.slo.SLOTracker when a policy was configured, else None
         — the server's /health "slo" block reads snapshot() here."""
         return self._slo
+
+    @property
+    def ledger_book(self):
+        """The obs.ledger.LedgerBook (always constructed) — the server's
+        /health "sched" block and GET /debug/sched read it."""
+        return self._book
+
+    @property
+    def sched_census(self):
+        """The obs.ledger.CensusRing of per-dispatch composition records
+        (always constructed) — exported at GET /debug/sched."""
+        return self._census
 
     def close(self) -> None:
         """Release engine-owned background resources — today the KV-tier
@@ -798,8 +831,15 @@ class ContinuousEngine:
                 history = [s.req.tokens[0]] + s.req.out + window
                 drafts = draft_tokens(history, room, max_n=self.spec_ngram)
                 self.stats.spec_proposed += len(drafts)
+                if drafts:
+                    self._census.count_tokens("spec", len(drafts))
+                    if s.req.ledger is not None:
+                        s.req.ledger.charge_spec(len(drafts), 0)
                 if self._obs is not None:
                     self._obs.spec_proposed.inc(len(drafts))
+                    if drafts:
+                        self._obs.count_dispatch_tokens("spec",
+                                                        len(drafts))
                 window += [int(t) for t in drafts]
                 row_kinds += ["d"] * len(drafts)
             for i, t in enumerate(window):
@@ -808,7 +848,8 @@ class ContinuousEngine:
         n_active0 = int(active0.sum())
         table = self._stage_tables()
         run = self._verify_program(greedy_only)
-        t0 = time.monotonic() if self._obs is not None else 0.0
+        t0 = time.monotonic()  # census/ledger wall charges need it even
+        #                        when the engine runs metrics-dark
         with self._span("verify", "decode", k=K, active=n_active0), \
                 self._watch():
             if self._chaos is not None:
@@ -832,6 +873,8 @@ class ContinuousEngine:
         self.stats.steps += 1
         self.stats.sum_active += n_active0
         self.stats.max_active = max(self.stats.max_active, n_active0)
+        self._census_dispatch("spec", 1, paused, n_active0,
+                              time.monotonic() - t0)
         # host replay: exactly step_once's per-position bookkeeping over
         # the accepted prefix of each row's window
         for b, s in enumerate(pool):
@@ -864,6 +907,8 @@ class ContinuousEngine:
                     nxt, sampled = int(s.sampler.sample(out[b, i])), True
                 if accepted_draft:
                     self.stats.spec_accepted += 1
+                    if s.req.ledger is not None:
+                        s.req.ledger.charge_spec(0, 1)
                     if self._obs is not None:
                         self._obs.spec_accepted.inc()
                 if self._advance(s, nxt, quiet, sampled=sampled):
@@ -1106,7 +1151,8 @@ class ContinuousEngine:
         table = (self._stage_tables() if self._alloc is not None
                  else jnp.zeros((B, 0), jnp.int32))
         run = self._chain(k, greedy_only=not st_f32[0].any())
-        t0 = time.monotonic() if self._obs is not None else 0.0
+        t0 = time.monotonic()  # census/ledger wall charges need it even
+        #                        when the engine runs metrics-dark
         with self._span("chain", "decode", steps=k, active=n_active0), \
                 self._watch():
             if self._chaos is not None:
@@ -1135,6 +1181,8 @@ class ContinuousEngine:
         self.stats.steps += k
         self.stats.sum_active += n_active0 * k
         self.stats.max_active = max(self.stats.max_active, n_active0)
+        self._census_dispatch("decode", k, paused, n_active0,
+                              time.monotonic() - t0)
         # host replay: apply the recorded per-step outcomes with exactly
         # step_once's bookkeeping (forced pops, RNG draws, BOS/budget stops)
         for b, s in enumerate(pool):
@@ -1182,6 +1230,88 @@ class ContinuousEngine:
             return
         self._journal.sync()
         self._journal.maybe_compact()
+
+    # -- cost accounting (ISSUE 16) -----------------------------------------
+
+    def _census_dispatch(self, kind: str, k: int, paused, active: int,
+                         dt_s: float) -> None:
+        """Charge BOTH accounting halves from one pool walk after a
+        decode/spec dispatch: per-slot ledger charges (row steps, page
+        steps, stalls by cause, pro-rated ICI bytes) and the whole-
+        dispatch census record. The two sides take independent
+        arithmetic paths — tools/costcheck.py verifies they agree
+        EXACTLY, and the chaos ``double_count_dispatch`` mutation
+        multiplies only the ledger side (``reps``) so that check must
+        catch it. The census stays mutation-clean by construction."""
+        reps = 2 if (self._chaos is not None
+                     and self._chaos.dispatch_double()) else 1
+        alloc = self._alloc
+        dt_share = dt_s / max(active, 1)
+        pages_held = 0
+        parked: dict = {}
+        class_page_s: dict = {}
+        for b, s in enumerate(self._pool):
+            if s.free:
+                continue
+            led = s.req.ledger
+            npages = len(s.pages)
+            if npages:
+                pages_held += npages
+                if led is not None:
+                    led.charge_pages(npages, k, dt_s, reps)
+                cls = self._bill_class(s.req.slo_class)
+                class_page_s[cls] = (class_page_s.get(cls, 0.0)
+                                     + npages * dt_s)
+            if b in paused:
+                # re-distinguish what _grow_pages lumped into one set:
+                # promo/prefill parks are self-resolving; pool_dry waits
+                # on a retirement to free pages
+                if s.await_promo or (alloc is not None
+                                     and alloc.pending_capable
+                                     and alloc.slot_pending(s.pages)):
+                    cause = "promo_pending"
+                elif s.prefill_pending:
+                    cause = "prefill_hold"
+                else:
+                    cause = "pool_dry"
+                parked[cause] = parked.get(cause, 0) + 1
+                if led is not None:
+                    led.charge_stall(cause, k, dt_s, reps)
+            elif led is not None:
+                led.charge_rows(k, dt_share, reps)
+                if self._ici_row_bytes:
+                    led.charge_ici(self._ici_row_bytes * k, reps)
+        with self._lock:
+            queued = list(self._queue)
+        for req in queued:
+            if req.ledger is not None:
+                req.ledger.charge_stall("queue_wait", k, dt_s, reps)
+        tier = (alloc.tier_page_counts()
+                if alloc is not None and alloc.tiered else None)
+        self._census.record(kind, k, active, parked, len(queued),
+                            pages_held, tier_pages=tier)
+        if self._obs is not None:
+            for cause, n in parked.items():
+                self._obs.add_stall_seconds(cause, n * dt_s)
+            if queued:
+                self._obs.add_stall_seconds("queue_wait",
+                                            len(queued) * dt_s)
+            for cls, page_s in class_page_s.items():
+                self._obs.add_page_seconds(cls, page_s)
+            self._obs.set_class_queue_depth(
+                collections.Counter(self._bill_class(r.slo_class)
+                                    for r in queued))
+
+    def _close_ledger(self, rid: int, status: str) -> None:
+        """Close a request's cost ledger at its terminal event and export
+        the per-class cost histograms. The chaos ``leak_ledger`` mutation
+        skips the close — tools/costcheck.py's orphaned-ledger check must
+        flag it."""
+        if self._chaos is not None and self._chaos.ledger_leak():
+            return
+        snap = self._book.close_request(rid, status)
+        if snap is not None and self._obs is not None:
+            self._obs.observe_request_cost(snap)
 
     def prejournal(self, req: Request) -> Request:
         """Assign a request's index and journal its admit record NOW
@@ -1232,7 +1362,8 @@ class ContinuousEngine:
             slo=req.slo_class, cursor=req.coin_cursor,
             recovers=req.recovered_from,
             trace=(req.trace.to_header() if req.trace is not None
-                   else None))
+                   else None),
+            ledger=req.carried_cost)
 
     def _trace_admit(self, req: Request) -> None:
         """Trace bookkeeping at the one request entry point (ISSUE 15):
@@ -1248,6 +1379,16 @@ class ContinuousEngine:
                             0.0, index=req.index,
                             **tracectx.span_fields(req.trace))
 
+    def _bill_class(self, name: str | None) -> str:
+        """The accounting class for a request: None resolves through the
+        SLO policy's default class (so ``cost_by_class`` joins the
+        ``slo`` block 1:1 — an unlabeled request must not bill under a
+        phantom "default" row while its verdict lands on "interactive");
+        the literal "default" only exists when no policy is configured."""
+        if self._slo is not None:
+            return name or self._slo.policy.default_class
+        return name or "default"
+
     def submit(self, req: Request) -> Request:
         """Queue a request (thread-safe; HTTP handler threads call this while
         the scheduler thread steps). ``req.done`` fires when it retires."""
@@ -1255,6 +1396,10 @@ class ContinuousEngine:
             raise ValueError("request has no prompt tokens")
         if req.prejournaled:
             self._trace_admit(req)
+            if req.ledger is None:
+                req.ledger = self._book.open_request(
+                    req.index, self._bill_class(req.slo_class),
+                    carried=req.carried_cost)
             # index + admit record already durable (prejournal): queue
             with self._lock:
                 self._queue.append(req)
@@ -1265,6 +1410,12 @@ class ContinuousEngine:
         with self._lock:
             req.index = self._submitted
             self._submitted += 1
+        # open the cost ledger at the id assignment (ISSUE 16): every
+        # charge from here to the terminal close lands on this handle; a
+        # recovered/handed-off life seeds its previous bill as `carried`
+        req.ledger = self._book.open_request(req.index,
+                                             self._bill_class(req.slo_class),
+                                             carried=req.carried_cost)
         self._trace_admit(req)  # before the journal admit: the durable
         #                         record carries the trace identity
         if self._journal is not None:
@@ -1305,6 +1456,7 @@ class ContinuousEngine:
             self._journal.retire(req.index, "cancelled")
         if self._obs is not None:
             self._obs.cancelled.inc()
+        self._close_ledger(req.index, "cancelled")
         req.done.set()
 
     def recover(self, quiet: bool = True) -> int:
@@ -1354,7 +1506,7 @@ class ContinuousEngine:
                           temperature=e.temperature, topp=e.topp,
                           seed=e.seed, slo_class=e.slo,
                           coin_cursor=e.cursor, recovered_from=e.rid,
-                          trace=trace)
+                          trace=trace, carried_cost=e.ledger)
             self.submit(req)
             if self._obs is not None:
                 self._obs.recoveries.inc()
@@ -1477,7 +1629,8 @@ class ContinuousEngine:
         # them from occupancy exactly as step_many's active mask does
         active0 = sum(not s.free and b not in paused
                       for b, s in enumerate(pool))
-        t0 = time.monotonic() if self._obs is not None else 0.0
+        t0 = time.monotonic()  # census/ledger wall charges need it even
+        #                        when the engine runs metrics-dark
         st = self._stage_i32
         for b, s in enumerate(pool):
             st[0, b] = s.token
@@ -1511,6 +1664,8 @@ class ContinuousEngine:
         self.stats.steps += 1
         self.stats.sum_active += active0
         self.stats.max_active = max(self.stats.max_active, active0)
+        self._census_dispatch("decode", 1, paused, active0,
+                              time.monotonic() - t0)
         for i, s in enumerate(pool):
             if s.free:
                 continue
@@ -1555,8 +1710,12 @@ class ContinuousEngine:
             self._journal.token(s.req.index, nxt, s.sampler.rng.draws)
         self._notify(s.req, nxt)
         self.stats.tokens += 1
+        self._census.count_tokens("decode")
+        if s.req.ledger is not None:
+            s.req.ledger.charge_tokens()
         if self._obs is not None:
             self._obs.generated.inc()
+            self._obs.count_dispatch_tokens("decode")
         s.token = nxt
         if s.pos >= s.budget:
             self._retire(s, quiet)
@@ -1585,6 +1744,7 @@ class ContinuousEngine:
                 return req
             if self._journal is not None:
                 self._journal.retire(req.index, "cancelled")
+            self._close_ledger(req.index, "cancelled")
             req.done.set()  # consumer gone before admission
 
     def _requeue_front(self, s: _Slot) -> None:
@@ -1649,10 +1809,17 @@ class ContinuousEngine:
             for t in tokens[1:m + 1]:
                 self._notify(req, t)
             self.stats.tokens += m
+            # the shared-prefix echo is prefill-kind work: positions the
+            # radix tree covered instead of a forward pass
+            self._census.count_tokens("prefill", m)
+            if req.ledger is not None:
+                req.ledger.charge_tokens(m)
+                req.ledger.charge_prefill(0, m, 0.0)
             if self._obs is not None:
                 self._obs.generated.inc(m)
                 self._obs.prefix_hits.inc()
                 self._obs.prefill_saved.inc(m)
+                self._obs.count_dispatch_tokens("prefill", m)
         return "ok"
 
     def _admit(self):
@@ -1726,7 +1893,9 @@ class ContinuousEngine:
             return
         from .generate import run_chunked_prefill
 
-        t0 = time.monotonic() if self._obs is not None else 0.0
+        t0 = time.monotonic()  # census/ledger wall charges need it even
+        #                        when the engine runs metrics-dark
+        chunks0 = self.stats.prefill_chunks
         jnp = self.jnp
         paged = self._alloc is not None
         # chunk-boundary preemption (ISSUE 14): paged f32 pools only —
@@ -1814,10 +1983,23 @@ class ContinuousEngine:
         s.req.out.extend(tokens[start + 1:end + 1])
         for t in tokens[start + 1:end + 1]:
             self._notify(s.req, t)
+        dt_prefill = time.monotonic() - t0
         self.stats.tokens += end - start
+        # prefill census record: steps=0 so the step/stall/page-step
+        # conservation totals (decode/spec currency) are untouched — the
+        # record documents the dispatch's token composition only
+        self._census.count_tokens("prefill", end - start)
+        self._census.record("prefill", 0, 0, {}, 0, 0,
+                            prefill_tokens=end - start)
+        if s.req.ledger is not None:
+            s.req.ledger.charge_tokens(end - start)
+            s.req.ledger.charge_prefill(
+                self.stats.prefill_chunks - chunks0, end - start,
+                dt_prefill)
         if self._obs is not None:
             self._obs.generated.inc(end - start)
-            self._obs.prefill.observe(time.monotonic() - t0)
+            self._obs.prefill.observe(dt_prefill)
+            self._obs.count_dispatch_tokens("prefill", end - start)
         s.pos = end
         s.token = tokens[end]
         s.forced = list(tokens[end + 1:]) if end < n_pre else []
@@ -1894,6 +2076,10 @@ class ContinuousEngine:
                             sampled=s.req.n_sampled,
                             cancelled=s.req.cancelled,
                             **tracectx.span_fields(s.req.trace))
+        self._close_ledger(
+            s.req.index,
+            "cancelled" if s.req.cancelled
+            else "failed" if s.req.error is not None else "done")
         s.req.done.set()
         s.req = None
         # park the freed slot at pos 0: a retired row's clock can equal
@@ -1922,6 +2108,7 @@ class ContinuousEngine:
                 # class (queue-killed work is an SLO event)
                 self._slo.observe(req.slo_class, None, None, 0,
                                   failed=True)
+            self._close_ledger(req.index, "failed")
             req.done.set()
         for s in self._pool:
             if not s.free:

@@ -149,6 +149,11 @@ def entry_for_stub(engine: ContinuousEngine, stub: Request) -> JournalEntry:
     if engine._journal is not None:
         e = engine._journal.entry(stub.index)
         if e is not None:
+            if stub.ledger is not None:
+                # the stub's closed bill (carried merged in) rides the
+                # handoff record — the decode pool's ledger seeds from it
+                # so the request's cost stays whole across pools
+                e.ledger = stub.ledger.snapshot()
             return e
     temp = (stub.temperature if stub.temperature is not None
             else engine.temperature)
@@ -166,7 +171,9 @@ def entry_for_stub(engine: ContinuousEngine, stub: Request) -> JournalEntry:
               else engine.seed + stub.index),
         slo=stub.slo_class, cursor=0, sampled=list(stub.out[n_pre:]),
         trace=(stub.trace.to_header() if stub.trace is not None
-               else None))
+               else None),
+        ledger=(stub.ledger.snapshot() if stub.ledger is not None
+                else None))
 
 
 def decode_request(entry: JournalEntry, steps: int) -> Request:
@@ -186,7 +193,8 @@ def decode_request(entry: JournalEntry, steps: int) -> Request:
     return Request(tokens=entry.replay_tokens, steps=steps,
                    temperature=entry.temperature, topp=entry.topp,
                    seed=entry.seed, slo_class=entry.slo,
-                   coin_cursor=entry.cursor, trace=trace)
+                   coin_cursor=entry.cursor, trace=trace,
+                   carried_cost=entry.ledger)
 
 
 def make_priority_hold(engine: ContinuousEngine, policy):
@@ -377,6 +385,12 @@ class DisaggPair:
                 SPAN_HANDOFF_SEND, HANDOFF_CAT, t_send0,
                 time.perf_counter() - t_send0, pages=len(records),
                 bytes=nbytes, **tracectx.span_fields(rpc))
+        if req.ledger is not None:
+            # the DCN bill + the seconds this request spent crossing
+            # pools (seconds-only stall: no engine dispatch rode it)
+            req.ledger.charge_dcn(len(records), nbytes)
+            req.ledger.charge_stall_s("handoff_wait",
+                                      time.monotonic() - t0)
         self._count("shipped")
         if self.obs is not None:
             self.obs.handoff_latency.observe(time.monotonic() - t0)

@@ -187,6 +187,11 @@ class JournalEntry:
     # request's whole multi-process life joins on one trace_id. None on
     # legacy records (pre-trace journals recover fine, just unjoined).
     trace: str | None = None
+    # carried cost-ledger snapshot (ISSUE 16): the resource bill this
+    # request accumulated in a PREVIOUS life (prefill pool / pre-crash
+    # process), attached at admit so a migrated request's ledger is
+    # whole. None on legacy records and on first lives.
+    ledger: dict | None = None
 
     @property
     def replay_tokens(self) -> list:
@@ -339,7 +344,9 @@ class RequestJournal:
                                 steps=e.steps, temperature=e.temperature,
                                 topp=e.topp, seed=e.seed, slo=e.slo,
                                 cursor=e.cursor, sampled=list(e.sampled),
-                                status=e.status, trace=e.trace)
+                                status=e.status, trace=e.trace,
+                                ledger=dict(e.ledger)
+                                if e.ledger is not None else None)
 
     @property
     def next_id(self) -> int:
@@ -367,22 +374,30 @@ class RequestJournal:
     def admit(self, rid: int, tokens, steps: int, temperature: float,
               topp: float, seed: int, slo: str | None = None,
               cursor: int = 0, recovers: int | None = None,
-              trace: str | None = None) -> None:
+              trace: str | None = None,
+              ledger: dict | None = None) -> None:
         """Journal a request's admission. ``recovers`` names the PREVIOUS
         life's rid when this admit is a recovery re-admission: the one
         appended record atomically opens the new life AND retires the old
         (status ``recovered``) — a crash on either side of a two-record
         handoff would otherwise leave zero or two live entries for the
         same request. ``trace`` is the request's traceparent header
-        (ISSUE 15) — the id a later life continues the trace from."""
+        (ISSUE 15) — the id a later life continues the trace from.
+        ``ledger`` is the carried cost-ledger snapshot (ISSUE 16): the
+        bill a handed-off/recovered request brought from its previous
+        life."""
         entry = JournalEntry(rid=rid, tokens=list(tokens), steps=steps,
                              temperature=temperature, topp=topp, seed=seed,
-                             slo=slo, cursor=cursor, trace=trace)
+                             slo=slo, cursor=cursor, trace=trace,
+                             ledger=dict(ledger)
+                             if ledger is not None else None)
         rec = {"t": "admit", "id": rid, "tokens": entry.tokens,
                "steps": steps, "temperature": temperature,
                "topp": topp, "seed": seed, "slo": slo, "cursor": cursor}
         if trace is not None:
             rec["trace"] = str(trace)
+        if entry.ledger is not None:
+            rec["ledger"] = entry.ledger
         if recovers is not None:
             rec["recovers"] = int(recovers)
         with self._lock:
@@ -460,6 +475,8 @@ class RequestJournal:
                            "cursor": e.cursor}
                     if e.trace is not None:
                         rec["trace"] = e.trace
+                    if e.ledger is not None:
+                        rec["ledger"] = e.ledger
                     fh.write((json.dumps(rec, separators=(",", ":"))
                               + "\n").encode())
                 fh.flush()
@@ -471,7 +488,8 @@ class RequestJournal:
                 e.rid: JournalEntry(
                     rid=e.rid, tokens=e.replay_tokens, steps=e.steps,
                     temperature=e.temperature, topp=e.topp, seed=e.seed,
-                    slo=e.slo, cursor=e.cursor, trace=e.trace)
+                    slo=e.slo, cursor=e.cursor, trace=e.trace,
+                    ledger=e.ledger)
                 for e in live}
             self._n_retired = 0
             self._dirty = False
@@ -506,13 +524,17 @@ def _parse_record(obj, entries: dict[int, JournalEntry],
             if trace is not None and not isinstance(trace, str):
                 raise JournalCorruption(
                     f"line {lineno}: admit {rid} trace is not a string")
+            ledger = obj.get("ledger")
+            if ledger is not None and not isinstance(ledger, dict):
+                raise JournalCorruption(
+                    f"line {lineno}: admit {rid} ledger is not an object")
             entries[rid] = JournalEntry(
                 rid=rid, tokens=[int(x) for x in tokens],
                 steps=int(obj["steps"]),
                 temperature=float(obj["temperature"]),
                 topp=float(obj["topp"]), seed=int(obj["seed"]),
                 slo=obj.get("slo"), cursor=int(obj.get("cursor", 0)),
-                trace=trace)
+                trace=trace, ledger=ledger)
             if obj.get("recovers") is not None:
                 # recovery re-admission: this one record also closes the
                 # previous life (see RequestJournal.admit)
@@ -613,11 +635,14 @@ def entry_to_wire(entry: JournalEntry) -> dict:
     prompt and what was generated. ``trace`` carries the traceparent
     header (ISSUE 15): the decode pool continues the SAME trace the
     prefill pool opened."""
-    return {"id": entry.rid, "tokens": list(entry.tokens),
-            "sampled": list(entry.sampled), "cursor": entry.cursor,
-            "steps": entry.steps, "temperature": entry.temperature,
-            "topp": entry.topp, "seed": entry.seed, "slo": entry.slo,
-            "trace": entry.trace}
+    rec = {"id": entry.rid, "tokens": list(entry.tokens),
+           "sampled": list(entry.sampled), "cursor": entry.cursor,
+           "steps": entry.steps, "temperature": entry.temperature,
+           "topp": entry.topp, "seed": entry.seed, "slo": entry.slo,
+           "trace": entry.trace}
+    if entry.ledger is not None:
+        rec["ledger"] = dict(entry.ledger)
+    return rec
 
 
 def entry_from_wire(rec: dict) -> JournalEntry:
@@ -632,6 +657,9 @@ def entry_from_wire(rec: dict) -> JournalEntry:
         trace = rec.get("trace")
         if trace is not None and not isinstance(trace, str):
             raise ValueError("handoff record trace is not a string")
+        ledger = rec.get("ledger")
+        if ledger is not None and not isinstance(ledger, dict):
+            raise ValueError("handoff record ledger is not an object")
         return JournalEntry(
             rid=int(rec["id"]), tokens=tokens,
             steps=int(rec["steps"]),
@@ -639,7 +667,7 @@ def entry_from_wire(rec: dict) -> JournalEntry:
             topp=float(rec["topp"]), seed=int(rec["seed"]),
             slo=rec.get("slo"), cursor=int(rec.get("cursor", 0)),
             sampled=[int(t) for t in rec.get("sampled", ())],
-            trace=trace)
+            trace=trace, ledger=ledger)
     except (KeyError, TypeError, ValueError) as exc:
         raise ValueError(f"malformed handoff record: {exc}") from exc
 

@@ -201,8 +201,13 @@ class InferenceServer:
 
             self._disagg_obs = DisaggMetrics(self.registry)
         # the engine's span tracer feeds the flight recorder's bundle
-        # (None when metrics are off — the ring of notes still records)
-        self.flightrec.bind(spans=self.engine._spans)
+        # (None when metrics are off — the ring of notes still records);
+        # the census ring + ledger book (always on) ride along so a
+        # crash bundle shows WHAT the scheduler was dispatching and
+        # WHOSE requests were mid-flight (ISSUE 16)
+        self.flightrec.bind(spans=self.engine._spans,
+                            census=self.engine.sched_census,
+                            ledgers=self.engine.ledger_book)
         self.flightrec.note("server.start", role=disagg_role or "single",
                             slots=slots, page_size=page_size)
         # replay the previous life's unfinished requests BEFORE the
@@ -245,6 +250,8 @@ class InferenceServer:
             def do_GET(self):
                 if self.path.split("?")[0] == "/debug/timeline":
                     return self._timeline()
+                if self.path.split("?")[0] == "/debug/sched":
+                    return self._sched()
                 if self.path == "/metrics":
                     if server.registry is None:
                         return self._json(404, {"error": "metrics disabled "
@@ -361,6 +368,20 @@ class InferenceServer:
                 if eng._obs is not None:
                     payload["admission_rejected"] = \
                         eng._obs.rejected_total()
+                # cost-accounting surface (ISSUE 16): census dispatch
+                # totals + ledger book counts and per-class cost columns
+                # — GET /debug/sched's summary twin, the block the fleet
+                # plane (obs/fleet.signals_from_health) sums across
+                # replicas
+                book = eng.ledger_book
+                payload["sched"] = {
+                    "census": eng.sched_census.totals(),
+                    "ledgers": {"opened": book.opened_n,
+                                "closed": book.closed_n,
+                                "open": book.n_open},
+                    "cost_totals": book.grand_totals(),
+                    "cost_by_class": book.class_rollup(),
+                }
                 if eng.spec_k:
                     # speculative decoding health (ISSUE 7): proposal
                     # volume + accept rate of the n-gram self-drafter
@@ -404,6 +425,42 @@ class InferenceServer:
                     ctype = "application/x-ndjson"
                 else:
                     body = json.dumps(spans.export_chrome(trace_id)).encode()
+                    ctype = "application/json"
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _sched(self):
+                """GET /debug/sched: the per-dispatch scheduler census
+                ring + the cost-ledger state (ISSUE 16). Default: one
+                JSON document (census totals + ring tail, open-ledger
+                snapshots, closed tail, grand/per-class cost columns);
+                ?format=ndjson streams one census record per line for
+                log shippers; ?n=<k> bounds both tails (default 64)."""
+                from urllib.parse import parse_qs, urlparse
+
+                eng = server.engine
+                q = parse_qs(urlparse(self.path).query)
+                try:
+                    n = int((q.get("n") or ["64"])[0])
+                except ValueError:
+                    return self._json(400, {"error": "n must be an "
+                                            "integer"})
+                census, book = eng.sched_census, eng.ledger_book
+                if (q.get("format") or [None])[0] == "ndjson":
+                    body = "".join(
+                        json.dumps(r, sort_keys=True) + "\n"
+                        for r in census.tail(n)).encode()
+                    ctype = "application/x-ndjson"
+                else:
+                    doc = census.to_json(tail=n)
+                    doc["open_ledgers"] = book.open_snapshots()
+                    doc["closed_tail"] = book.closed_tail(n)
+                    doc["cost_totals"] = book.grand_totals()
+                    doc["cost_by_class"] = book.class_rollup()
+                    body = json.dumps(doc).encode()
                     ctype = "application/json"
                 self.send_response(200)
                 self.send_header("Content-Type", ctype)

@@ -197,3 +197,46 @@ def test_fleetcheck_cli_exit_codes(tmp_path, capsys):
     assert row["rollup"]["healthy"] == 2
     assert len(row["rows"]) == 2
     assert "env_fingerprint" in row  # joinable with BENCH_* rows
+
+
+def test_rollup_cost_columns_recomputed_from_sums():
+    """ISSUE 16: per-class cost-per-token comes from Σ compute seconds /
+    Σ tokens across replicas — never the mean of per-replica ratios."""
+    cheap = _row("cheap", cost_classes={"interactive": {
+        "tokens": 90, "requests": 9, "compute_s": 9.0, "page_s": 18.0,
+        "stall_s_total": 0.0}}, page_seconds=18.0,
+        stall_seconds={"queue_wait": 1.0})
+    dear = _row("dear", cost_classes={"interactive": {
+        "tokens": 10, "requests": 1, "compute_s": 11.0, "page_s": 2.0,
+        "stall_s_total": 3.0}}, page_seconds=2.0,
+        stall_seconds={"queue_wait": 0.5, "pool_dry": 2.0})
+    agg = rollup([cheap, dear])
+    cell = agg.cost["interactive"]
+    # (9+11)/(90+10) = 0.2 — mean-of-ratios would say (0.1+1.1)/2 = 0.6
+    assert cell["cost_per_token_s"] == pytest.approx(0.2)
+    assert cell["page_s_per_token"] == pytest.approx(0.2)
+    assert cell["stall_seconds"] == pytest.approx(3.0)
+    assert agg.page_seconds == pytest.approx(20.0)
+    assert agg.stall_seconds == {"queue_wait": 1.5, "pool_dry": 2.0}
+    # cost per GOODPUT token: Σ compute / Σ goodput (60 + 60 from _row)
+    assert agg.cost_per_goodput_token == pytest.approx(20.0 / 120.0)
+    j = agg.to_json()
+    assert j["cost"]["interactive"]["cost_per_token_s"] == 0.2
+    assert j["cost_per_goodput_token"] == pytest.approx(1 / 6)
+
+
+def test_signals_from_health_parses_sched_block():
+    payload = {"state": "serving", "slots": 4,
+               "sched": {"cost_totals": {"page_s": 2.5,
+                                         "stall_s": {"pool_dry": 0.25}},
+                         "cost_by_class": {"batch": {
+                             "tokens": 40, "requests": 4,
+                             "compute_s": 1.5, "page_s": 2.5,
+                             "stall_s_total": 0.25, "page_steps": 50}}}}
+    row = signals_from_health("r", payload)
+    assert row.page_seconds == 2.5
+    assert row.stall_seconds == {"pool_dry": 0.25}
+    assert row.cost_classes["batch"]["compute_s"] == 1.5
+    # pre-ledger servers: no block, zero cost columns, no crash
+    bare = signals_from_health("old", {"state": "serving"})
+    assert bare.page_seconds == 0.0 and bare.cost_classes == {}

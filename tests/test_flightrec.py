@@ -204,3 +204,38 @@ def test_is_bundle_file_sniffs(tmp_path):
     garbage = tmp_path / "g.json"
     garbage.write_text("{{{")
     assert not is_bundle_file(str(garbage))
+
+
+def test_bundle_scheduler_forensics_sections(tmp_path):
+    """ISSUE 16: a recorder bound to the census ring and ledger book
+    puts the dispatch tail + the OPEN bills into the bundle; bundles
+    without the sections (older builds) stay loadable, and damaged
+    sections are rejected by name."""
+    from distributed_llama_tpu.obs.ledger import CensusRing, LedgerBook
+
+    ring = CensusRing(slots=4)
+    ring.record("decode", steps=2, active=3, parked={"pool_dry": 1},
+                queue_depth=1, pages_held=9)
+    book = LedgerBook()
+    book.open_request(5, "interactive").charge_tokens(3)
+    fr = FlightRecorder()
+    fr.bind(census=ring, ledgers=book)
+    bundle = fr.snapshot_bundle("watchdog")
+    validate_bundle(bundle)
+    assert bundle["census_tail"][0]["kind"] == "decode"
+    assert bundle["open_ledgers"][0]["tokens"] == 3
+
+    path = tmp_path / "b.json"
+    path.write_text(json.dumps(bundle))
+    assert load_bundle(str(path))["open_ledgers"][0]["rid"] == 5
+
+    legacy = dict(bundle)
+    del legacy["census_tail"], legacy["open_ledgers"]
+    validate_bundle(legacy)  # validate-if-present: old bundles load
+
+    broken = dict(bundle, census_tail=["not-a-record"])
+    with pytest.raises(ValueError, match="census_tail"):
+        validate_bundle(broken)
+    broken = dict(bundle, open_ledgers={"rid": 5})
+    with pytest.raises(ValueError, match="open_ledgers"):
+        validate_bundle(broken)

@@ -1,0 +1,185 @@
+"""Request-level cost ledger (ISSUE 16): unit accounting on
+obs/ledger.py, and the ledger-vs-census conservation equalities under
+chaos — cancel storm, kill-mid-decode recovery, the two-pool handoff
+seam — with zero orphaned or duplicated bills."""
+
+import argparse
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))), "tools"))
+
+from distributed_llama_tpu.obs.ledger import (LedgerBook,  # noqa: E402
+                                              STALL_CAUSES)
+
+
+def _args(**kw):
+    """The costcheck CLI's engine/trace knobs as a namespace (the legs
+    are shared with tools/costcheck.py — one conservation harness)."""
+    base = dict(slots=4, seed=7, page_size=4, kv_pages=20, block_steps=2,
+                spec_k=0, requests=16, rate=0.5, arrivals="bursty",
+                two_pool_rate=0.25)
+    base.update(kw)
+    return argparse.Namespace(**base)
+
+
+@pytest.fixture(scope="module")
+def make_engine():
+    from loadcheck import build_engine_factory
+
+    return build_engine_factory(_args())
+
+
+# ---------------------------------------------------------------- unit
+
+def test_ledger_charges_accumulate():
+    book = LedgerBook()
+    led = book.open_request(1, "interactive")
+    led.charge_rows(3, 0.25)
+    led.charge_tokens(2)
+    led.charge_prefill(chunks=2, tokens=8, dt_s=0.5)
+    led.charge_pages(4, 3, 0.1)
+    led.charge_stall("queue_wait", 2, 0.05)
+    led.charge_ici(1024.0)
+    led.charge_dcn(2, 8192)
+    led.charge_spec(4, 1)
+    snap = led.snapshot()
+    assert snap["decode_row_steps"] == 3
+    assert snap["tokens"] == 2  # prefill echo tokens bill separately
+    assert snap["prefill_tokens"] == 8
+    assert snap["prefill_chunks"] == 2
+    assert snap["page_steps"] == 4 * 3
+    assert snap["stall_steps"] == {"queue_wait": 2}
+    assert snap["ici_bytes"] == 1024.0
+    assert snap["dcn_pages"] == 2 and snap["dcn_bytes"] == 8192
+    assert snap["spec_proposed"] == 4 and snap["spec_accepted"] == 1
+    assert snap["spec_wasted"] == 3
+
+
+def test_ledger_reps_doubles_only_the_ledger_side():
+    """The double-count-dispatch mutation's lever: reps multiplies the
+    ledger charge (the census side counts once, independently)."""
+    book = LedgerBook()
+    led = book.open_request(1)
+    led.charge_rows(5, 0.1, reps=2)
+    led.charge_pages(3, 5, 0.1, reps=2)
+    snap = led.snapshot()
+    assert snap["decode_row_steps"] == 10
+    assert snap["page_steps"] == 30
+
+
+def test_snapshot_merges_carried_bill():
+    book = LedgerBook()
+    led = book.open_request(7, "batch",
+                            carried={"tokens": 10, "page_steps": 40,
+                                     "stall_steps": {"pool_dry": 3},
+                                     "dcn_bytes": 512})
+    led.charge_tokens(5)
+    led.charge_stall("pool_dry", 2, 0.1)
+    snap = led.snapshot()
+    assert snap["tokens"] == 15
+    assert snap["page_steps"] == 40
+    assert snap["stall_steps"]["pool_dry"] == 5
+    assert snap["dcn_bytes"] == 512
+
+
+def test_open_and_close_are_idempotent_no_duplicate_folds():
+    book = LedgerBook()
+    led = book.open_request(3, "interactive")
+    assert book.open_request(3) is led  # re-open returns the same bill
+    led.charge_tokens(4)
+    first = book.close_request(3, "done")
+    assert first is not None and first["tokens"] == 4
+    assert book.close_request(3, "done") is None  # second close: no-op
+    assert book.grand_totals()["tokens"] == 4  # folded exactly once
+    assert book.opened_n == 1 and book.closed_n == 1 and book.n_open == 0
+
+
+def test_grand_totals_span_open_and_closed():
+    book = LedgerBook()
+    book.open_request(1).charge_tokens(3)
+    book.open_request(2).charge_tokens(5)
+    book.close_request(1, "done")
+    assert book.grand_totals(include_open=True)["tokens"] == 8
+    assert book.grand_totals(include_open=False)["tokens"] == 3
+    assert book.n_open == 1
+
+
+def test_class_rollup_recomputes_ratios_from_sums():
+    book = LedgerBook()
+    a = book.open_request(1, "interactive")
+    a.charge_tokens(10)
+    a.charge_rows(10, 2.0)
+    b = book.open_request(2, "interactive")
+    b.charge_tokens(30)
+    b.charge_rows(30, 2.0)
+    book.close_request(1, "done")
+    book.close_request(2, "done")
+    cell = book.class_rollup()["interactive"]
+    # Σ compute / Σ tokens = 4.0/40, not the mean of per-request ratios
+    assert cell["cost_per_token_s"] == pytest.approx(0.1)
+    assert cell["requests"] == 2 and cell["tokens"] == 40
+
+
+def test_stall_causes_cover_the_scheduler_parks():
+    assert set(STALL_CAUSES) == {"pool_dry", "promo_pending",
+                                 "prefill_hold", "queue_wait",
+                                 "handoff_wait"}
+
+
+# -------------------------------------- conservation under chaos drills
+
+def test_conservation_healthy_replay(make_engine):
+    from costcheck import leg_healthy
+
+    _, fails = leg_healthy(_args(), make_engine)
+    assert fails == []
+
+
+def test_conservation_cancel_storm(make_engine):
+    """Cancels land mid-prefill, mid-decode and still-queued; every
+    cancelled bill must close exactly once and the books still balance
+    (zero orphaned, zero duplicated entries)."""
+    from costcheck import leg_cancel
+
+    row, fails = leg_cancel(_args(), make_engine)
+    assert fails == []
+    assert row["cancelled"] > 0
+
+
+def test_conservation_kill_mid_decode_recovery(make_engine, tmp_path):
+    from costcheck import leg_recovery
+
+    row, fails = leg_recovery(_args(), make_engine, str(tmp_path))
+    assert fails == []
+    assert row["recovered"] > 0 and row["open_at_kill"] > 0
+
+
+def test_conservation_two_pool_handoff(make_engine):
+    """The cross-seam equality: the decode pool's book folds the carried
+    prefill-side bills, so decode-book minus prefill-book totals must
+    equal the decode engine's own census — and the DCN seam is billed."""
+    from costcheck import leg_disagg
+
+    row, fails = leg_disagg(_args(requests=24), make_engine)
+    assert fails == []
+    assert row["handed_off"] > 0
+    assert row["dcn_bytes"] > 0 and row["handoff_wait_s"] > 0
+
+
+def test_double_count_mutation_breaks_conservation(make_engine):
+    from costcheck import leg_healthy
+
+    _, fails = leg_healthy(_args(), make_engine,
+                           inject="double-count-dispatch")
+    assert any("row-steps" in f for f in fails)
+
+
+def test_leak_ledger_mutation_trips_open_audit(make_engine):
+    from costcheck import leg_healthy
+
+    _, fails = leg_healthy(_args(), make_engine, inject="leak-ledger")
+    assert any("still open" in f for f in fails)

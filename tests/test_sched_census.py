@@ -1,0 +1,183 @@
+"""Per-dispatch scheduler census (ISSUE 16): ring accounting, the
+no-wall-clock determinism contract on the virtual clock, and the
+accounting plane's Prometheus surface (pre-registered series)."""
+
+import argparse
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))), "tools"))
+
+from distributed_llama_tpu.obs.ledger import CensusRing  # noqa: E402
+
+
+def _args(**kw):
+    base = dict(slots=4, seed=7, page_size=4, kv_pages=20, block_steps=2,
+                spec_k=0, requests=16, rate=0.5, arrivals="bursty")
+    base.update(kw)
+    return argparse.Namespace(**base)
+
+
+@pytest.fixture(scope="module")
+def make_engine():
+    from loadcheck import build_engine_factory
+
+    return build_engine_factory(_args())
+
+
+def _drive(make_engine, **overrides):
+    from loadcheck import _load_spec, _policy
+    from loadgen import drive_engine, generate_trace
+
+    args = _args()
+    trace = generate_trace(_load_spec(args.rate, args), args.seed)
+    eng = make_engine(**overrides)
+    drive_engine(eng, trace, _policy())
+    return eng
+
+
+# ---------------------------------------------------------------- ring
+
+def test_census_record_accumulates_totals():
+    ring = CensusRing(slots=4)
+    ring.record("decode", steps=2, active=3, parked={"pool_dry": 1},
+                queue_depth=2, pages_held=10)
+    ring.record("decode", steps=1, active=4, parked={}, queue_depth=0,
+                pages_held=12)
+    t = ring.totals()
+    assert t["dispatches"] == 2
+    assert t["steps"] == 3
+    assert t["row_steps"] == 3 * 2 + 4 * 1
+    assert t["stall_steps"] == (1 + 2) * 2  # (parked + queued) x steps
+    assert t["page_steps"] == 10 * 2 + 12 * 1
+    ring.count_tokens("decode", 5)
+    ring.count_tokens("prefill", 8)
+    assert ring.totals()["tokens"] == {"decode": 5, "prefill": 8,
+                                       "spec": 0}
+
+
+def test_census_ring_bounds_tail_but_keeps_totals():
+    ring = CensusRing(slots=2, keep=8)
+    for _ in range(20):
+        ring.record("decode", steps=1, active=1, parked={},
+                    queue_depth=0, pages_held=1)
+    assert len(ring.tail(64)) == 8  # ring capped
+    assert ring.totals()["dispatches"] == 20  # totals are not
+    assert len(ring.tail(3)) == 3
+
+
+def test_census_records_carry_no_wall_clock():
+    """The determinism contract: a census record must serialize without
+    any wall-time field — rings from identical virtual-clock runs are
+    compared byte-for-byte."""
+    ring = CensusRing(slots=4)
+    ring.record("decode", steps=2, active=1, parked={"pool_dry": 1},
+                queue_depth=1, pages_held=4, tier_pages={"hbm": 4})
+    ring.record("prefill", steps=0, active=0, parked={}, queue_depth=0,
+                pages_held=0, prefill_tokens=8)
+    for rec in ring.tail(2):
+        assert not {"ts", "t", "dt_s", "wall_s"} & set(rec)
+    decode, prefill = ring.tail(2)
+    assert decode["tier_pages"] == {"hbm": 4}
+    assert prefill["prefill_tokens"] == 8
+    assert prefill["steps"] == 0  # prefill never rides step conservation
+
+
+def test_census_to_json_shape():
+    ring = CensusRing(slots=4)
+    ring.record("decode", steps=1, active=2, parked={}, queue_depth=0,
+                pages_held=6)
+    doc = ring.to_json(tail=16)
+    assert doc["kind"] == "dllama-sched-census"
+    assert doc["version"] == 1
+    assert doc["slots"] == 4
+    assert doc["totals"]["row_steps"] == 2
+    assert len(doc["ring"]) == 1
+
+
+# ------------------------------------------------- engine determinism
+
+def test_census_deterministic_on_virtual_clock(make_engine):
+    """Same seed, same trace, two fresh engines: the census rings must
+    be BYTE-identical — the fleetcheck/ci determinism property."""
+    a = _drive(make_engine)
+    b = _drive(make_engine)
+    ja = json.dumps(a.sched_census.to_json(tail=256), sort_keys=True)
+    jb = json.dumps(b.sched_census.to_json(tail=256), sort_keys=True)
+    assert ja == jb
+    assert a.sched_census.totals()["dispatches"] > 0
+
+
+def test_census_matches_engine_stats(make_engine):
+    eng = _drive(make_engine)
+    t = eng.sched_census.totals()
+    assert t["steps"] == eng.stats.steps
+    assert t["row_steps"] == eng.stats.sum_active
+    assert (t["tokens"]["decode"] + t["tokens"]["prefill"]
+            == eng.stats.tokens)
+
+
+def test_spec_dispatches_counted(make_engine):
+    eng = _drive(make_engine, spec_k=2)
+    t = eng.sched_census.totals()
+    assert t["tokens"]["spec"] > 0
+    assert any(r["kind"] == "spec" for r in eng.sched_census.tail(256))
+
+
+# ------------------------------------------------ prometheus surface
+
+def test_accounting_series_preregistered_at_zero(make_engine):
+    """Every accounting series must exist in the exposition from step
+    zero (a dashboard must see 0, not an absent series), including the
+    per-class queue gauge and the request-cost histograms."""
+    eng = make_engine()
+    text = eng._obs.registry.expose()
+    for kind in ("decode", "prefill", "spec"):
+        assert f'dllama_dispatch_tokens_total{{kind="{kind}"}} 0' in text
+    for cause in ("pool_dry", "promo_pending", "prefill_hold",
+                  "queue_wait", "handoff_wait"):
+        assert (f'dllama_stall_seconds_total{{cause="{cause}"}} 0'
+                in text)
+    assert 'dllama_page_seconds_total{class="default"} 0' in text
+    assert 'dllama_queue_depth_by_class{class="default"} 0' in text
+    assert "dllama_request_cost_dispatch_seconds" in text
+    assert "dllama_request_cost_page_seconds" in text
+    assert "dllama_request_cost_stall_seconds" in text
+    assert "dllama_request_queue_wait_by_class_seconds" in text
+
+
+def test_accounting_series_move_under_load(make_engine):
+    eng = _drive(make_engine)
+    from distributed_llama_tpu.obs.fleet import parse_metrics
+
+    samples = parse_metrics(eng._obs.registry.expose())
+    decode = samples.get('dllama_dispatch_tokens_total{kind="decode"}', 0)
+    prefill = samples.get(
+        'dllama_dispatch_tokens_total{kind="prefill"}', 0)
+    assert decode + prefill == eng.stats.tokens
+    page_s = sum(v for k, v in samples.items()
+                 if k.startswith("dllama_page_seconds_total{"))
+    assert page_s > 0.0
+    # request-cost histograms observed once per retired request
+    closes = sum(v for k, v in samples.items() if k.startswith(
+        "dllama_request_cost_dispatch_seconds_count{"))
+    assert closes == eng.ledger_book.closed_n
+
+
+def test_class_queue_depth_zeroes_absent_classes():
+    from distributed_llama_tpu.obs.metrics import Registry
+    from distributed_llama_tpu.obs.trace import EngineMetrics
+
+    m = EngineMetrics(Registry())
+    m.set_class_queue_depth({"interactive": 3, "batch": 1})
+    text = m.registry.expose()
+    assert 'dllama_queue_depth_by_class{class="interactive"} 3' in text
+    m.set_class_queue_depth({"batch": 2})
+    text = m.registry.expose()
+    # a drained class must read 0, not its stale last value
+    assert 'dllama_queue_depth_by_class{class="interactive"} 0' in text
+    assert 'dllama_queue_depth_by_class{class="batch"} 2' in text

@@ -502,3 +502,51 @@ def test_health_omits_kv_tiers_when_untiered(server):
             f"http://127.0.0.1:{server.port}/health", timeout=30) as r:
         payload = json.loads(r.read())
     assert "kv_tiers" not in payload
+
+
+def test_health_sched_block_and_debug_sched(server):
+    """ISSUE 16: after served traffic, /health carries the accounting
+    plane's "sched" block (census totals + ledger counts + cost columns)
+    and GET /debug/sched exports the dispatch census ring as JSON and
+    NDJSON, conservation holding between the two surfaces."""
+    _post(server.port, {"prompt": "bill me", "steps": 6})
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/health", timeout=30) as r:
+        health = json.loads(r.read())
+    sched = health["sched"]
+    census = sched["census"]
+    assert census["dispatches"] > 0
+    assert census["tokens"]["decode"] > 0
+    assert sched["ledgers"]["open"] == 0
+    assert sched["ledgers"]["closed"] >= 1
+    totals = sched["cost_totals"]
+    assert totals["tokens"] == (census["tokens"]["decode"]
+                                + census["tokens"]["prefill"])
+    assert totals["decode_row_steps"] == census["row_steps"]
+    assert "default" in sched["cost_by_class"]
+    assert sched["cost_by_class"]["default"]["cost_per_token_s"] > 0.0
+
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/debug/sched?n=8",
+            timeout=30) as r:
+        doc = json.loads(r.read())
+    assert doc["kind"] == "dllama-sched-census"
+    assert doc["totals"] == census
+    assert 0 < len(doc["ring"]) <= 8
+    assert doc["cost_totals"]["tokens"] == totals["tokens"]
+    assert doc["open_ledgers"] == []
+
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/debug/sched?format=ndjson",
+            timeout=30) as r:
+        assert r.headers["Content-Type"] == "application/x-ndjson"
+        lines = [json.loads(ln) for ln in r if ln.strip()]
+    assert lines and all("kind" in ln for ln in lines)
+
+    try:
+        urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/debug/sched?n=zap",
+            timeout=30)
+        assert False, "expected 400 for a non-integer tail"
+    except urllib.error.HTTPError as e:
+        assert e.code == 400

@@ -208,6 +208,38 @@ if ! cmp -s tools/ci_artifacts/fleetcheck_a.json \
          "the rollup is not deterministic" >&2
     exit 1
 fi
+# Accounting-plane gate (ISSUE 16): the request-ledger vs scheduler-
+# census conservation equalities must hold EXACTLY on the virtual clock
+# across every leg — healthy, speculative, cancel storm, kill-mid-decode
+# recovery, and the two-pool handoff seam (the fingerprinted row with
+# per-class cost-per-token is archived next to the others)
+python tools/costcheck.py --json > tools/ci_artifacts/costcheck.json
+# ... and the gate must still CATCH cooked books: with the seeded
+# double-count-dispatch mutation armed (every ledger charge billed twice
+# while the census counts once), conservation must exit 1 EXACTLY — 2 is
+# a usage error and would pass a naive non-zero check vacuously
+set +e
+python tools/costcheck.py --legs healthy --inject double-count-dispatch \
+    --json > /dev/null 2>&1
+costcheck_rc=$?
+set -e
+if [ "$costcheck_rc" -ne 1 ]; then
+    echo "ci: costcheck did not flag the double-counted dispatch" \
+         "(exit $costcheck_rc, expected 1)" >&2
+    exit 1
+fi
+# ... and a swallowed ledger close (leak-ledger) must trip the
+# open-ledger audit the same way
+set +e
+python tools/costcheck.py --legs healthy --inject leak-ledger \
+    --json > /dev/null 2>&1
+ledgerleak_rc=$?
+set -e
+if [ "$ledgerleak_rc" -ne 1 ]; then
+    echo "ci: costcheck did not flag the leaked request ledger" \
+         "(exit $ledgerleak_rc, expected 1)" >&2
+    exit 1
+fi
 # SLO observatory gate (ISSUE 8) + crash-safety recovery gate (ISSUE 9):
 # a small deterministic loadcheck run — the virtual-clock offered-load
 # sweep held to the checked-in CPU goodput band

@@ -66,7 +66,55 @@ def _sim_health_payload(eng, duration: float) -> dict:
             "prefill_tokens_saved": a.tokens_saved,
             "evictions": a.evictions,
         }
+    payload["sched"] = _sim_sched_block(eng)
     return payload
+
+
+# the sim's deterministic seconds-per-step: the ledger's wall-clock
+# float charges vary run to run (the CI gate byte-compares two runs), so
+# the sim recomputes every cost second from the INTEGER step counts at a
+# fixed rate. The rollup math under test — sums, recomputed ratios — is
+# rate-invariant.
+SIM_SEC_PER_STEP = 1e-3
+
+
+def _sim_sched_block(eng, sec_per_step: float = SIM_SEC_PER_STEP) -> dict:
+    """The /health "sched" block (runtime/server.py shape) rebuilt from
+    the engine's ledger/census INTEGER counts on the virtual clock —
+    same parse path as a live scrape, byte-stable across runs."""
+    book, census = eng.ledger_book, eng.sched_census
+    by_class = {}
+    for cls, cell in book.class_rollup().items():
+        toks = cell.get("tokens", 0)
+        compute_steps = (cell.get("decode_row_steps", 0)
+                         + cell.get("prefill_chunks", 0))
+        by_class[cls] = {
+            "tokens": toks,
+            "requests": cell.get("requests", 0),
+            "page_steps": cell.get("page_steps", 0),
+            "compute_s": round(compute_steps * sec_per_step, 9),
+            "page_s": round(cell.get("page_steps", 0) * sec_per_step, 9),
+            "stall_s_total": round(
+                sum(cell.get("stall_steps", {}).values()) * sec_per_step,
+                9),
+        }
+    totals = book.grand_totals()
+    cost_totals = {
+        "requests": totals["requests"],
+        "tokens": totals["tokens"],
+        "page_steps": totals["page_steps"],
+        "page_s": round(totals["page_steps"] * sec_per_step, 9),
+        "stall_steps_total": totals["stall_steps_total"],
+        "stall_s": {c: round(k * sec_per_step, 9) for c, k
+                    in sorted(totals["stall_steps"].items())},
+    }
+    return {
+        "census": census.totals(),
+        "ledgers": {"opened": book.opened_n, "closed": book.closed_n,
+                    "open": book.n_open},
+        "cost_totals": cost_totals,
+        "cost_by_class": by_class,
+    }
 
 
 def run_sim(args) -> tuple[list, "object", list[str]]:
@@ -124,6 +172,19 @@ def run_sim(args) -> tuple[list, "object", list[str]]:
          agg.goodput_tokens),
         ("prefix_hits", sum(r.prefix_hits for r in healthy),
          agg.prefix_hits),
+        # cost columns (ISSUE 16): the rollup's cost cells must be the
+        # recomputed sums of the healthy rows' cells — same order of
+        # addition, so floats compare EXACTLY
+        ("page_seconds", sum(r.page_seconds for r in healthy),
+         agg.page_seconds),
+        ("cost_tokens",
+         sum(c.get("tokens", 0) for r in healthy
+             for c in r.cost_classes.values()),
+         sum(c.get("tokens", 0) for c in agg.cost_classes.values())),
+        ("stall_seconds",
+         round(sum(s for r in healthy
+                   for s in r.stall_seconds.values()), 9),
+         round(sum(agg.stall_seconds.values()), 9)),
     )
     for name, want, got in checks:
         if want != got:
@@ -207,6 +268,12 @@ def main(argv=None) -> int:
               f"queue {agg.queue_depth}, hit rate "
               f"{agg.prefix_hit_rate:.2f}, goodput "
               f"{agg.goodput_tokens} tok, attainment {att}")
+        cost = " ".join(
+            f"{c}={cell['cost_per_token_s'] * 1e3:.3f}ms/tok"
+            for c, cell in agg.cost.items())
+        print(f"cost:  page_s {agg.page_seconds:.3f}, "
+              f"{agg.cost_per_goodput_token * 1e3:.3f} ms/goodput-tok, "
+              f"per-class {cost or '(no ledgers)'}")
         for f in failures:
             print(f"fleetcheck: {f}", file=sys.stderr)
 

@@ -410,7 +410,8 @@ def drive_pools(engines, trace: Trace, policy, mode: str = "colocated",
     from distributed_llama_tpu.runtime.disagg import (
         decode_request, encode_handoff_pages, entry_for_stub,
         export_prefix_pages, prefill_stub, stub_needs_handoff)
-    from distributed_llama_tpu.runtime.pagewire import decode_record
+    from distributed_llama_tpu.runtime.pagewire import (
+        decode_record, record_payload_bytes)
 
     if mode not in ("colocated", "disagg"):
         raise ValueError(f"unknown two-pool mode {mode!r}")
@@ -442,7 +443,7 @@ def drive_pools(engines, trace: Trace, policy, mode: str = "colocated",
     # the prefill stub's sampled count a disagg decode req adds to
     live: list = [[], []]
     pending: list = []  # disagg: (t_ready, entry, planes, tokens, steps,
-    #                     rec, stub_sampled)
+    #                     rec, stub_sampled, payload_bytes, t_queued)
 
     def outstanding(k: int) -> bool:
         return engines[k]._n_outstanding() > 0
@@ -463,7 +464,8 @@ def drive_pools(engines, trace: Trace, policy, mode: str = "colocated",
         nonlocal pending
         still = []
         for item in pending:
-            t_ready, entry, planes, tokens, steps, rec, n0 = item
+            t_ready, entry, planes, tokens, steps, rec, n0, nbytes, \
+                t_q0 = item
             if t_ready > v[1]:
                 still.append(item)
                 continue
@@ -471,6 +473,14 @@ def drive_pools(engines, trace: Trace, policy, mode: str = "colocated",
                 tokens[:len(tokens) - 1], planes)
             req = decode_request(entry, steps)
             engines[1].submit(req)
+            if req.ledger is not None:
+                # the DCN bill + the VIRTUAL seconds this request spent
+                # crossing pools (handoff initiation on the prefill clock
+                # to decode admission on the decode clock — the clocks
+                # share the trace's arrival epoch)
+                req.ledger.charge_dcn(len(planes), nbytes)
+                req.ledger.charge_stall_s("handoff_wait",
+                                          max(v[1] - t_q0, 0.0))
             live[1].append((req, rec, n0))
         pending = still
 
@@ -488,12 +498,13 @@ def drive_pools(engines, trace: Trace, policy, mode: str = "colocated",
                              zip(events, records) if r is rec)
                 entry = entry_for_stub(engines[0], req)
                 payloads = export_prefix_pages(engines[0], tokens)
-                planes = [decode_record(r) for r in
-                          encode_handoff_pages(payloads)]
+                wire = encode_handoff_pages(payloads)
+                nbytes = sum(record_payload_bytes(r) for r in wire)
+                planes = [decode_record(r) for r in wire]
                 t_ready = (v[0] + handoff_latency_s
                            + len(planes) * handoff_page_cost_s)
                 pending.append((t_ready, entry, planes, tokens, steps,
-                                rec, req.n_sampled))
+                                rec, req.n_sampled, nbytes, v[0]))
                 continue
             rec.v_finish = v[k]
             rec.n_sampled = n0 + req.n_sampled

@@ -83,6 +83,10 @@ def _check_bundle(path: str, emit_json: bool = False) -> int:
         "spans_dropped": bundle["spans_dropped"],
         "metric_samples": metric_lines,
         "journal_tail_records": len(bundle["journal_tail"]),
+        # scheduler forensics (ISSUE 16) — optional sections, so .get():
+        # bundles from older builds simply report 0
+        "census_records": len(bundle.get("census_tail", [])),
+        "open_ledgers": len(bundle.get("open_ledgers", [])),
         "config_keys": sorted(bundle["config"]),
     }
     if emit_json:
@@ -92,7 +96,9 @@ def _check_bundle(path: str, emit_json: bool = False) -> int:
               f"events={summary['events']} spans={summary['spans']} "
               f"(+{summary['spans_dropped']} dropped) "
               f"metrics={summary['metric_samples']} samples "
-              f"journal_tail={summary['journal_tail_records']} records")
+              f"journal_tail={summary['journal_tail_records']} records "
+              f"census={summary['census_records']} "
+              f"open_ledgers={summary['open_ledgers']}")
     return 0
 
 
