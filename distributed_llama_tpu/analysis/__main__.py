@@ -6,16 +6,23 @@
                       matrix (J004/J005/J006 + budget; tools/shardcheck.py
                       emits the same run as JSON)
     --shardcheck-matrix PATH  JSON support-matrix override for --shardcheck
-    --all             all three heads
+    --threadcheck     thread-ownership lint over runtime/ + obs/ (T-rules
+                      against the analysis/threadmodel.py registry;
+                      tools/threadcheck.py is the alias)
+    --all             all four heads
     --baseline PATH   grandfathered-findings file
                       (default tools/dlint_baseline.txt)
     --write-baseline  rewrite the baseline from current findings and exit 0
-    --no-baseline     report every finding, baseline ignored
+    --threadcheck-baseline PATH  threadcheck's grandfathered findings
+                      (default tools/threadcheck_baseline.txt)
+    --write-threadcheck-baseline rewrite it from current findings, exit 0
+    --no-baseline     report every finding, baselines ignored
 
 Exit status: 0 = no new findings and all contracts/configs hold; 1 =
 findings; 2 = usage error. The contract and shardcheck heads force
 JAX_PLATFORMS=cpu and an 8-way virtual host mesh BEFORE jax initializes,
-so they are safe (and fast) on a box with a TPU attached.
+so they are safe (and fast) on a box with a TPU attached; the lint and
+threadcheck heads never import the checked code at all.
 """
 
 from __future__ import annotations
@@ -28,6 +35,7 @@ from pathlib import Path
 REPO_ROOT = Path(__file__).resolve().parents[2]
 PACKAGE_DIR = Path(__file__).resolve().parents[1]
 DEFAULT_BASELINE = REPO_ROOT / "tools" / "dlint_baseline.txt"
+DEFAULT_THREAD_BASELINE = REPO_ROOT / "tools" / "threadcheck_baseline.txt"
 
 
 def main(argv=None) -> int:
@@ -43,13 +51,23 @@ def main(argv=None) -> int:
                          "matrix (imports jax, CPU-only)")
     ap.add_argument("--shardcheck-matrix", type=Path, default=None,
                     help="JSON support-matrix override for --shardcheck")
-    ap.add_argument("--all", action="store_true", help="all three heads")
+    ap.add_argument("--threadcheck", action="store_true",
+                    help="run the thread-ownership lint over runtime/ + "
+                         "obs/ (pure AST, imports nothing)")
+    ap.add_argument("--all", action="store_true", help="all four heads")
     ap.add_argument("--baseline", type=Path, default=DEFAULT_BASELINE,
                     help=f"baseline file (default {DEFAULT_BASELINE})")
     ap.add_argument("--write-baseline", action="store_true",
                     help="rewrite the baseline from current lint findings")
+    ap.add_argument("--threadcheck-baseline", type=Path,
+                    default=DEFAULT_THREAD_BASELINE,
+                    help=f"threadcheck baseline file "
+                         f"(default {DEFAULT_THREAD_BASELINE})")
+    ap.add_argument("--write-threadcheck-baseline", action="store_true",
+                    help="rewrite the threadcheck baseline from current "
+                         "findings")
     ap.add_argument("--no-baseline", action="store_true",
-                    help="ignore the baseline (report everything)")
+                    help="ignore the baselines (report everything)")
     ap.add_argument("paths", nargs="*", type=Path,
                     help="files to lint (default: the whole package)")
     args = ap.parse_args(argv)
@@ -58,13 +76,19 @@ def main(argv=None) -> int:
     # `--contracts --write-baseline` can't silently skip the rewrite
     do_lint = (args.lint or args.all or args.write_baseline
                or not (args.contracts or args.shardcheck
-                       or args.shardcheck_matrix is not None))
+                       or args.shardcheck_matrix is not None
+                       or args.threadcheck
+                       or args.write_threadcheck_baseline))
     do_contracts = args.contracts or args.all
     # a matrix override implies the head that consumes it (same rule as
     # --write-baseline implying --lint): a forgotten --shardcheck must not
     # silently skip the drift gate the matrix encodes
     do_shardcheck = (args.shardcheck or args.all
                      or args.shardcheck_matrix is not None)
+    # same implication rule: rewriting threadcheck's baseline IS running
+    # the threadcheck head
+    do_threadcheck = (args.threadcheck or args.all
+                      or args.write_threadcheck_baseline)
     if args.write_baseline and args.paths:
         # the baseline is global: rewriting it from a partial scan would
         # silently drop every grandfathered entry for unscanned files
@@ -112,6 +136,54 @@ def main(argv=None) -> int:
         print(f"dlint: {len(new)} new finding(s), {suppressed} "
               f"baseline-suppressed, {len(files)} file(s)")
         if new:
+            status = 1
+
+    if do_threadcheck:
+        from .lint import (apply_baseline, load_baseline, package_files,
+                           write_baseline)
+        from .threadcheck import run_threadcheck, thread_scope
+
+        if args.paths:
+            missing = [p for p in args.paths if not p.exists()]
+            if missing:
+                print(f"threadcheck: no such file: {missing[0]}",
+                      file=sys.stderr)
+                return 2
+            tfiles = [f for p in args.paths
+                      for f in (package_files(p) if p.is_dir() else [p])]
+        else:
+            tfiles = package_files(PACKAGE_DIR)
+        if args.write_threadcheck_baseline and args.paths:
+            print("threadcheck: --write-threadcheck-baseline requires a "
+                  "full-package scan (no explicit paths)",
+                  file=sys.stderr)
+            return 2
+        tfindings = run_threadcheck(tfiles, REPO_ROOT)
+        if args.write_threadcheck_baseline:
+            write_baseline(args.threadcheck_baseline, tfindings)
+            print(f"threadcheck: baseline rewritten with "
+                  f"{len(tfindings)} finding(s) -> "
+                  f"{args.threadcheck_baseline}")
+            return 0
+        tbaseline = (load_baseline(args.threadcheck_baseline)
+                     if not args.no_baseline else None)
+        if tbaseline is not None:
+            tnew, tsupp, tstale = apply_baseline(tfindings, tbaseline)
+            if args.paths:
+                tstale = []  # partial scan: unscanned files aren't stale
+        else:
+            tnew, tsupp, tstale = tfindings, 0, []
+        for f in tnew:
+            print(f.render())
+        for key in tstale:
+            print(f"threadcheck: stale baseline entry (finding fixed — "
+                  f"prune with --write-threadcheck-baseline): {key}",
+                  file=sys.stderr)
+        n_scoped = sum(1 for f in tfiles
+                       if thread_scope(f.as_posix()))
+        print(f"threadcheck: {len(tnew)} new finding(s), {tsupp} "
+              f"baseline-suppressed, {n_scoped} file(s) in scope")
+        if tnew:
             status = 1
 
     if do_contracts or do_shardcheck:

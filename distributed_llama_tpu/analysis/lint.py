@@ -55,7 +55,13 @@ class Finding:
                 f"  [fix: {self.hint}]")
 
 
-_PRAGMA_RE = re.compile(r"#\s*dlint:\s*allow\[([A-Z0-9,\s]+)\]")
+# one pragma grammar for every head that reuses this engine: the tag
+# names the head a human greps for (`dlint:` for the D-rules,
+# `threadcheck:` for the T-rules) but the suppression semantics are
+# identical — rule-id sets are disjoint, so a tag can never bless a
+# foreign head's finding by accident
+_PRAGMA_RE = re.compile(
+    r"#\s*(?:dlint|threadcheck):\s*allow\[([A-Z0-9,\s]+)\]")
 
 
 def parse_pragmas(lines: list[str]) -> tuple[dict[int, set[str]],
@@ -69,10 +75,12 @@ def parse_pragmas(lines: list[str]) -> tuple[dict[int, set[str]],
     same: dict[int, set[str]] = {}
     below: dict[int, set[str]] = {}
     for i, text in enumerate(lines, start=1):
-        m = _PRAGMA_RE.search(text)
-        if not m:
+        rules: set[str] = set()
+        for m in _PRAGMA_RE.finditer(text):
+            rules |= {r.strip() for r in m.group(1).split(",")
+                      if r.strip()}
+        if not rules:
             continue
-        rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
         same[i] = rules
         if text.strip().startswith("#"):  # comment-only pragma line
             below[i + 1] = rules

@@ -1,0 +1,380 @@
+"""The host runtime's declared thread-ownership model (ISSUE 17).
+
+The reference llama2.c loop is single-threaded; this runtime is not. At
+least six host thread domains touch shared state — the scheduler loop,
+the HTTP streaming handlers, the KV PageUploader, the DCN page-channel
+server, the watchdog/supervisor plane, and the chaos drills' relay
+threads — and until now the locking discipline between them lived in
+docstrings and reviewers' heads. This module writes the contract DOWN as
+data, so ``analysis/threadcheck.py`` can enforce it statically the same
+way dlint enforces the host/device discipline:
+
+* **domains** — who runs: every thread entrypoint is registered with the
+  domain it executes and the join/stop path that bounds its lifetime
+  (rule T004 rejects unregistered ``threading.Thread`` targets).
+* **attribute families** — who owns what: each mutable attribute family
+  on the shared runtime objects is assigned an owning domain plus the
+  lock (if any) that sanctions access from the others (rule T001 rejects
+  a cross-domain write outside that lock; T005 rejects returning the raw
+  mutable object across a domain boundary).
+* **crossing points** — how state legally moves between domains: the
+  engine lock around the queue/inboxes, ``export_prefix_sync``-style
+  scheduler marshalling (post a box, wait on its Event, the scheduler
+  fulfils), SimpleQueue hand-off to the uploader, and immutable
+  snapshots (``refcounts()``/``free_ids()`` return copies). METHOD_
+  DOMAINS declares exactly which methods are callable from which
+  domains — the registry of crossing points rules are checked against.
+
+The model errs toward declaring MORE methods cross-domain than strictly
+true today: a method declared ``{handler, scheduler}`` is checked under
+the strictest reading, and a future caller from either domain needs no
+registry edit. Quiesced teardown paths (``drain``/``stop``/``suspend``/
+``recover``/``close`` — they run only after the scheduler thread parked,
+see runtime/server.py) are declared MAIN: the ``main`` domain is exempt
+from cross-domain write checks, which is the model's honest statement
+that single-threaded setup/teardown is trusted. The burn-down for that
+exemption is tracked in tools/threadcheck_baseline.txt's header.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+# -- domains ---------------------------------------------------------------
+
+SCHEDULER = "scheduler"    # the engine step loop (InferenceServer._scheduler)
+HANDLER = "handler"        # ThreadingHTTPServer per-connection threads
+UPLOADER = "uploader"      # PageUploader._run (dllama-kv-uploader)
+CHANNEL = "channel"        # PageChannelServer serve_forever + its handlers
+SUPERVISOR = "supervisor"  # StepWatchdog._monitor, supervise(), health plane
+MAIN = "main"              # construction + quiesced teardown (trusted)
+DRILL = "drill"            # chaos-drill helper threads (FlakyRelay)
+
+DOMAINS = (SCHEDULER, HANDLER, UPLOADER, CHANNEL, SUPERVISOR, MAIN, DRILL)
+
+# domains exempt from the cross-domain write rules: ``main`` runs before
+# the threads start or after they joined (quiesced teardown); ``drill``
+# threads only touch drill-local sockets, never runtime families
+EXEMPT_DOMAINS = frozenset({MAIN, DRILL})
+
+
+# -- thread entrypoints (rule T004) ---------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class Entrypoint:
+    """One registered ``threading.Thread`` target: the domain its thread
+    executes and the join/stop path that bounds its lifetime (T004's
+    registry test asserts ``joined_by`` is never empty — a thread with
+    no documented stop path is the finding)."""
+
+    key: str        # "Class.method" for self-targets, bare name otherwise
+    domain: str
+    spawned_by: str  # where the Thread() call lives (documentation)
+    joined_by: str   # the stop path that joins/bounds the thread
+
+
+ENTRYPOINTS: dict[str, Entrypoint] = {e.key: e for e in (
+    Entrypoint("InferenceServer._scheduler", SCHEDULER,
+               "InferenceServer.start",
+               "InferenceServer._scheduler_stopped (join, 30s, wedge-"
+               "detected)"),
+    # ThreadingHTTPServer's accept loop; its per-connection handler
+    # threads are registered via HANDLER_CLASSES below (the stdlib
+    # spawns them, not our code)
+    Entrypoint("serve_forever", CHANNEL,
+               "InferenceServer.start / PageChannelServer.__init__",
+               "httpd.shutdown() + thread join in stop()/close()"),
+    Entrypoint("PageUploader._run", UPLOADER, "PageUploader.__init__",
+               "PageUploader.close() sentinel (daemon backstop)"),
+    Entrypoint("StepWatchdog._monitor", SUPERVISOR,
+               "StepWatchdog.__init__",
+               "StepWatchdog.close() (_closed flag + join)"),
+    # chaos-drill relay threads: drill-local sockets only
+    Entrypoint("_FlakyProxy._accept_loop", DRILL,
+               "_FlakyProxy.__init__",
+               "_FlakyProxy.close() closes the listener (daemon)"),
+    Entrypoint("_FlakyProxy._relay", DRILL, "_FlakyProxy._accept_loop",
+               "socket close unblocks; daemon backstop"),
+    Entrypoint("pump_requests", DRILL, "_FlakyProxy._relay",
+               "upstream close unblocks; daemon backstop"),
+    # obs/profiler.py: the timed auto-stop helper
+    Entrypoint("_stop", SUPERVISOR, "obs/profiler.start_trace",
+               "self-terminating timer (daemon)"),
+)}
+
+
+# -- attribute families (rules T001/T005) ----------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class AttrFamily:
+    """A family of mutable attributes with one owner domain and (when
+    cross-domain access is sanctioned at all) the lock that guards it.
+    ``lock=None`` means the family is domain-private: ANY write reachable
+    from a foreign domain is a finding — there is no lock to take."""
+
+    owner_class: str
+    attrs: tuple
+    domain: str
+    lock: str | None  # attribute name of the guarding lock, or None
+
+
+FAMILIES: tuple[AttrFamily, ...] = (
+    # the engine's cross-thread intake surface: handlers submit/cancel
+    # and the DCN ingest path posts, the scheduler drains — everything
+    # under the one engine lock
+    AttrFamily("ContinuousEngine",
+               ("_queue", "_remote_inbox", "_export_inbox", "_submitted"),
+               SCHEDULER, "_lock"),
+    # scheduler-private engine state: no lock exists, so no foreign
+    # domain may ever write it (the radix tree and KV accounting are
+    # scheduler-owned by construction)
+    AttrFamily("ContinuousEngine", ("cache", "_pool"), SCHEDULER, None),
+    AttrFamily("PagePool", ("_free", "_ref"), SCHEDULER, None),
+    AttrFamily("PrefixTree", ("_roots", "_n_nodes"), SCHEDULER, None),
+    AttrFamily("PagedAllocator", ("_pending", "_jobs", "tier_pages"),
+               SCHEDULER, None),
+    # the promotion job's staged planes: uploader-owned; the scheduler's
+    # inline-stage path writes it only at job construction (pragma'd as
+    # a documented crossing — the job is not yet visible to the uploader)
+    AttrFamily("_PromotionJob", ("staged",), UPLOADER, None),
+    AttrFamily("PageUploader", ("staged_jobs",), UPLOADER, None),
+    # the WAL: handler admits and scheduler tokens/retires serialize on
+    # the journal's RLock
+    AttrFamily("RequestJournal", ("_entries",), SCHEDULER, "_lock"),
+    # request cost plane: handlers open (submit) and read (/health),
+    # the scheduler charges and closes — all under the book's lock
+    AttrFamily("LedgerBook", ("_open", "_closed", "_totals",
+                              "opened_n", "closed_n"),
+               SCHEDULER, "_lock"),
+    AttrFamily("CensusRing", ("_ring", "dispatches", "total_steps",
+                              "total_row_steps", "total_stall_steps",
+                              "total_page_steps"),
+               SCHEDULER, "_lock"),
+    # the flight recorder: every domain notes, the supervisor plane dumps
+    AttrFamily("FlightRecorder", ("_events", "dumps"), SUPERVISOR,
+               "_lock"),
+    # streaming-handler registry on the server: handlers register/
+    # deregister themselves, stop() joins — the TOCTOU fix (ISSUE 17)
+    # put it under its own lock
+    AttrFamily("InferenceServer", ("_streams",), HANDLER,
+               "_streams_lock"),
+    AttrFamily("StepWatchdog",
+               ("trips", "_deadline", "_armed_at", "_fired", "_closed"),
+               SUPERVISOR, "_cond"),
+    AttrFamily("PageChannelServer",
+               ("_store", "_traces", "published_pages", "served_pages",
+                "evicted_handoffs"),
+               CHANNEL, "_lock"),
+    # Prometheus instruments: every domain increments, under each
+    # instrument's own lock
+    AttrFamily("Counter", ("_value",), SCHEDULER, "_lock"),
+    AttrFamily("Gauge", ("_value",), SCHEDULER, "_lock"),
+    AttrFamily("Histogram", ("_counts", "_sum", "_count"), SCHEDULER,
+               "_lock"),
+)
+
+# attr -> family (fallback lookup for bases whose class can't be
+# resolved). Attr names MAY collide across classes (LedgerBook._closed
+# vs StepWatchdog._closed) — the class-aware map below disambiguates
+# whenever the writer's class is known.
+FAMILY_BY_ATTR: dict[str, AttrFamily] = {}
+FAMILY_BY_CLASS_ATTR: dict[tuple[str, str], AttrFamily] = {}
+for _fam in FAMILIES:
+    for _a in _fam.attrs:
+        FAMILY_BY_ATTR.setdefault(_a, _fam)
+        FAMILY_BY_CLASS_ATTR[(_fam.owner_class, _a)] = _fam
+
+
+def family_for(cls, attr: str):
+    """Class-aware family lookup. When the base's class is known it
+    disambiguates colliding attr names; a registered class's same-named
+    attr that is NOT in its own family is that class's private state,
+    not a foreign family. Unknown class falls back to the attr map."""
+    if cls is not None:
+        fam = FAMILY_BY_CLASS_ATTR.get((cls, attr))
+        if fam is not None:
+            return fam
+        if cls in CLASS_OWNER:
+            return None
+    return FAMILY_BY_ATTR.get(attr)
+
+
+# -- per-class default owners and cross-domain method table ---------------
+
+# a registered class's methods default to its owner domain unless listed
+# in METHOD_DOMAINS or reached (via self-calls) from a listed method
+CLASS_OWNER: dict[str, str] = {
+    "ContinuousEngine": SCHEDULER,
+    "PagePool": SCHEDULER,
+    "HostPagePool": SCHEDULER,
+    "DiskPageStore": SCHEDULER,
+    "PrefixTree": SCHEDULER,
+    "PagedAllocator": SCHEDULER,
+    "_PromotionJob": UPLOADER,
+    "PageUploader": UPLOADER,
+    "RequestJournal": SCHEDULER,
+    "LedgerBook": SCHEDULER,
+    "CensusRing": SCHEDULER,
+    "RequestLedger": SCHEDULER,   # single-writer by module contract
+    "FlightRecorder": SUPERVISOR,
+    "InferenceServer": MAIN,
+    "Handler": HANDLER,           # nested HTTP handler class (server.py)
+    "StepWatchdog": SUPERVISOR,
+    "HealthMonitor": SUPERVISOR,
+    "PageChannelServer": CHANNEL,
+    "Counter": SCHEDULER,
+    "Gauge": SCHEDULER,
+    "Histogram": SCHEDULER,
+    "Registry": SCHEDULER,
+    "EngineMetrics": SCHEDULER,
+}
+
+# the sanctioned crossing points: methods callable from domains beyond
+# their class's owner. This IS the registry of legal seams — a new
+# cross-thread caller means a new row here, and threadcheck then holds
+# the method to the strictest listed domain.
+METHOD_DOMAINS: dict[str, frozenset] = {k: frozenset(v) for k, v in {
+    # engine intake (HTTP handler threads + the scheduler's own
+    # recovery/drain-remote re-submission path)
+    "ContinuousEngine.submit": (HANDLER, SCHEDULER),
+    "ContinuousEngine.cancel": (HANDLER,),
+    "ContinuousEngine.prejournal": (HANDLER,),
+    "ContinuousEngine.abandon_prejournaled": (HANDLER,),
+    "ContinuousEngine.ingest_remote": (HANDLER,),
+    "ContinuousEngine.export_prefix_sync": (HANDLER,),
+    "ContinuousEngine._n_outstanding": (HANDLER, SCHEDULER),
+    # quiesced teardown/recovery (scheduler parked first — see
+    # InferenceServer._scheduler_stopped)
+    "ContinuousEngine.suspend": (MAIN,),
+    "ContinuousEngine.recover": (MAIN,),
+    "ContinuousEngine.fail_all": (MAIN, SCHEDULER),
+    "ContinuousEngine.close": (MAIN,),
+    # uploader intake rides a SimpleQueue (its own crossing point);
+    # close() posts the sentinel from teardown
+    "PageUploader.submit": (SCHEDULER,),
+    "PageUploader.close": (MAIN,),
+    # WAL: admit lands on handler threads (write-AHEAD of the queue
+    # insert), tokens/retire on the scheduler
+    "RequestJournal.admit": (HANDLER, SCHEDULER),
+    "RequestJournal.sync": (SCHEDULER, MAIN),
+    "RequestJournal.close": (MAIN,),
+    # cost plane: handler opens at submit, /health snapshots; the
+    # scheduler closes at retire
+    "LedgerBook.open_request": (HANDLER, SCHEDULER),
+    "LedgerBook.close_request": (SCHEDULER,),
+    "LedgerBook.grand_totals": (HANDLER, SCHEDULER),
+    "LedgerBook.class_rollup": (HANDLER, SCHEDULER),
+    "LedgerBook.open_snapshots": (HANDLER, SUPERVISOR),
+    "CensusRing.record": (SCHEDULER,),
+    "CensusRing.count_tokens": (SCHEDULER,),
+    "CensusRing.tail": (HANDLER, SUPERVISOR),
+    "CensusRing.totals": (HANDLER, SCHEDULER),
+    # flight recorder: notes arrive from every plane; dumps fire from
+    # the watchdog (supervisor) and the SIGTERM drain (main)
+    "FlightRecorder.note": (HANDLER, SCHEDULER, SUPERVISOR, CHANNEL),
+    "FlightRecorder.dump": (SUPERVISOR, MAIN),
+    "FlightRecorder.snapshot_bundle": (SUPERVISOR, MAIN),
+    "FlightRecorder.bind": (MAIN,),
+    # server: handler threads register/deregister their streams; stop/
+    # drain are quiesced teardown except the join loop, which must hold
+    # the registry lock only to SNAPSHOT (T003 keeps joins outside it)
+    "InferenceServer.stop": (MAIN, SUPERVISOR),
+    "InferenceServer.drain": (MAIN, SUPERVISOR),
+    "InferenceServer._outstanding": (HANDLER, MAIN, SUPERVISOR),
+    "InferenceServer.count_reject": (HANDLER,),
+    # watchdog: the scheduler arms/disarms around each dispatch, the
+    # monitor thread fires, /health reads
+    "StepWatchdog.arm": (SCHEDULER,),
+    "StepWatchdog.disarm": (SCHEDULER,),
+    "StepWatchdog.__enter__": (SCHEDULER,),
+    "StepWatchdog.__exit__": (SCHEDULER,),
+    "StepWatchdog.overdue": (HANDLER, SCHEDULER, SUPERVISOR),
+    "StepWatchdog.close": (MAIN,),
+    "HealthMonitor.to": (HANDLER, SCHEDULER, SUPERVISOR, MAIN),
+    # page channel: its own handler threads serve; the prefill server's
+    # HTTP handlers publish
+    "PageChannelServer.publish": (HANDLER,),
+    "PageChannelServer.close": (MAIN,),
+    # metrics: instruments are incremented from everywhere
+    "Counter.inc": (HANDLER, SCHEDULER, SUPERVISOR, CHANNEL, UPLOADER),
+    "Gauge.set": (HANDLER, SCHEDULER, SUPERVISOR, CHANNEL, UPLOADER),
+    "Histogram.observe": (HANDLER, SCHEDULER, SUPERVISOR, CHANNEL,
+                          UPLOADER),
+    "Registry.expose": (HANDLER, SUPERVISOR, MAIN),
+}.items()}
+
+# methods exempt from domain propagation/checks entirely: object
+# construction runs before any thread can alias the instance
+CONSTRUCTION_METHODS = frozenset({"__init__", "__new__",
+                                  "__post_init__"})
+
+
+# -- lock identity hints (rule T002/T003) ----------------------------------
+
+# attribute names that denote locks when seen as ``with self.<name>:`` /
+# ``with obj.<name>:`` — the declared set plus anything lock-shaped
+LOCK_ATTRS = frozenset({"_lock", "_cond", "_streams_lock"})
+
+# second-to-last component of a dotted lock expression -> owning class,
+# so ``self.engine._lock`` keys the SAME graph node as the engine's own
+# ``self._lock`` (lock identity must survive the attribute path used to
+# reach it, or the order graph falls apart into aliases)
+INSTANCE_HINTS: dict[str, str] = {
+    "engine": "ContinuousEngine",
+    "eng": "ContinuousEngine",
+    "_book": "LedgerBook",
+    "_census": "CensusRing",
+    "_journal": "RequestJournal",
+    "journal": "RequestJournal",
+    "flightrec": "FlightRecorder",
+    "_watchdog": "StepWatchdog",
+    "health": "HealthMonitor",
+    "_page_channel": "PageChannelServer",
+    "_obs": "EngineMetrics",
+    "server": "InferenceServer",
+}
+
+
+def validate() -> list[str]:
+    """Registry self-consistency (tests/test_threadcheck_rules.py gates
+    on [] — a malformed model must fail loudly, not silently weaken the
+    rules). Checks: every domain reference is a declared domain, every
+    entrypoint documents a join path, family attrs are unique, and
+    every METHOD_DOMAINS class has a declared owner."""
+    problems: list[str] = []
+    for e in ENTRYPOINTS.values():
+        if e.domain not in DOMAINS:
+            problems.append(f"entrypoint {e.key}: unknown domain "
+                            f"{e.domain!r}")
+        if not e.joined_by.strip():
+            problems.append(f"entrypoint {e.key}: no join/stop path "
+                            f"declared")
+    seen_attrs: set[tuple[str, str]] = set()
+    for fam in FAMILIES:
+        if fam.domain not in DOMAINS:
+            problems.append(f"family {fam.owner_class}.{fam.attrs}: "
+                            f"unknown domain {fam.domain!r}")
+        if fam.owner_class not in CLASS_OWNER:
+            problems.append(f"family class {fam.owner_class}: no "
+                            f"CLASS_OWNER entry")
+        for a in fam.attrs:
+            key = (fam.owner_class, a)
+            if key in seen_attrs:
+                problems.append(f"attr {a!r} declared twice on "
+                                f"{fam.owner_class}")
+            seen_attrs.add(key)
+    for qual, domains in METHOD_DOMAINS.items():
+        cls = qual.split(".")[0]
+        if cls not in CLASS_OWNER:
+            problems.append(f"METHOD_DOMAINS {qual}: class {cls} has no "
+                            f"CLASS_OWNER entry")
+        for d in domains:
+            if d not in DOMAINS:
+                problems.append(f"METHOD_DOMAINS {qual}: unknown domain "
+                                f"{d!r}")
+    for cls, d in CLASS_OWNER.items():
+        if d not in DOMAINS:
+            problems.append(f"CLASS_OWNER {cls}: unknown domain {d!r}")
+    return problems

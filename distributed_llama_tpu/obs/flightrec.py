@@ -182,7 +182,12 @@ class FlightRecorder:
         dumps never clobber each other) or an explicit .json path.
         Write-then-rename so a crash mid-dump never leaves a torn
         bundle wearing a valid name."""
-        self.dumps += 1
+        # claim the sequence number under the lock: concurrent dumps
+        # (watchdog trip racing an operator SIGUSR2) must not collide on
+        # a filename or lose a count (threadcheck T001)
+        with self._lock:
+            self.dumps += 1
+            seq = self.dumps
         if target.endswith(".json"):
             path = target
             parent = os.path.dirname(os.path.abspath(path))
@@ -190,7 +195,7 @@ class FlightRecorder:
             parent = target
             path = os.path.join(
                 target,
-                f"flightrec-{reason}-{os.getpid()}-{self.dumps}.json")
+                f"flightrec-{reason}-{os.getpid()}-{seq}.json")
         os.makedirs(parent, exist_ok=True)
         bundle = self.snapshot_bundle(reason)
         tmp = path + ".tmp"

@@ -365,7 +365,10 @@ class RequestJournal:
             self.records_total += 1
             if self.fsync == "always":
                 self._fh.flush()
-                os.fsync(self._fh.fileno())
+                # WAL durability point: the fsync must serialize with
+                # appends or a concurrent write could land after the
+                # sync yet claim its durability
+                os.fsync(self._fh.fileno())  # threadcheck: allow[T003]
             else:
                 self._dirty = True
         if self._metric is not None:
@@ -435,7 +438,9 @@ class RequestJournal:
                 return
             self._fh.flush()
             if self.fsync != "off" or force:
-                os.fsync(self._fh.fileno())
+                # batch durability point: same WAL contract as _append —
+                # the fsync covers exactly the records under this lock
+                os.fsync(self._fh.fileno())  # threadcheck: allow[T003]
             self._dirty = False
 
     def close(self) -> None:
@@ -480,7 +485,10 @@ class RequestJournal:
                     fh.write((json.dumps(rec, separators=(",", ":"))
                               + "\n").encode())
                 fh.flush()
-                os.fsync(fh.fileno())
+                # compaction writes the replacement file atomically;
+                # appends must stall until the rename lands or they'd
+                # hit the about-to-be-replaced fd
+                os.fsync(fh.fileno())  # threadcheck: allow[T003]
             self._fh.close()
             os.replace(tmp, self.path)
             self._fh = open(self.path, "ab")

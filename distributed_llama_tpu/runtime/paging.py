@@ -942,8 +942,11 @@ class PagedAllocator:
         if self._uploader is not None:
             self._uploader.submit(job)
         else:
-            job.staged = (self._stage(payload) if self._stage is not None
-                          else payload)
+            # synchronous-staging path: no uploader thread exists, so the
+            # job never crosses a domain — staged is written before the
+            # job is visible to anyone else
+            job.staged = (self._stage(payload)  # threadcheck: allow[T001]
+                          if self._stage is not None else payload)
         return True
 
     def _readopt(self, node: _Node, pid: int) -> None:
@@ -999,7 +1002,10 @@ class PagedAllocator:
                 self._note_tier(None, TIER_HBM)
                 self._pending[pid] = node
                 job = _PromotionJob(node=node, page=pid, payload=payload)
-                job.staged = (self._stage(payload)
+                # adopted remote pages stage inline on the scheduler: the
+                # job is constructed and staged here, before it is ever
+                # published to the uploader's queue — no concurrent reader
+                job.staged = (self._stage(payload)  # threadcheck: allow[T001]
                               if self._stage is not None else payload)
                 self._jobs.append(job)
                 self.remote_adopted += 1

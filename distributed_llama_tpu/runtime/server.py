@@ -223,6 +223,7 @@ class InferenceServer:
         # these AFTER waking their requests — a blocked q.get/done.wait
         # must not outlive the server (the thread-leak satellite)
         self._streams: set = set()
+        self._streams_lock = threading.Lock()
         server = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -701,7 +702,8 @@ class InferenceServer:
                 # register with the server so stop() can join this thread
                 # once the request is woken — without the registry a
                 # handler blocked in q.get outlives the server silently
-                server._streams.add(threading.current_thread())
+                with server._streams_lock:
+                    server._streams.add(threading.current_thread())
                 if submit is not None:
                     submit()
                 else:
@@ -737,7 +739,9 @@ class InferenceServer:
                     # the budget (or another whole fused chain) for nobody
                     server.engine.cancel(req)
                 finally:
-                    server._streams.discard(threading.current_thread())
+                    with server._streams_lock:
+                        server._streams.discard(
+                            threading.current_thread())
 
         self.httpd = ThreadingHTTPServer((host, port), Handler)
         self._threads: list[threading.Thread] = []
@@ -1095,8 +1099,23 @@ class InferenceServer:
                 self.engine.suspend()
             else:
                 self.engine.fail_all("server stopped")
-        for t in list(self._streams):
-            t.join(timeout=5)
+        # join streaming handlers until the registry drains. A single
+        # snapshot has a TOCTOU hole: a handler that registers AFTER the
+        # snapshot (its request raced the shutdown) would never be
+        # joined. Re-snapshot under the lock each pass — joins happen
+        # OUTSIDE the lock so a handler's deregister (finally block)
+        # can't deadlock against us.
+        deadline = time.monotonic() + 5.0
+        while True:
+            with self._streams_lock:
+                pending = [t for t in self._streams if t.is_alive()]
+            if not pending:
+                break
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            for t in pending:
+                t.join(timeout=max(0.05, remaining))
         self.httpd.server_close()
         if self._page_channel is not None:
             self._page_channel.close()

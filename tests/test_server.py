@@ -550,3 +550,59 @@ def test_health_sched_block_and_debug_sched(server):
         assert False, "expected 400 for a non-integer tail"
     except urllib.error.HTTPError as e:
         assert e.code == 400
+
+
+def test_stream_registering_during_stop_is_still_joined(params):
+    """The _streams register/join TOCTOU (ISSUE 17 satellite): a handler
+    thread that registers AFTER stop() snapshots the registry must still
+    be joined before stop() returns. An early handler (registered before
+    stop) spawns and registers a late one only once stop() is already
+    inside its join loop — with a single-snapshot join the late thread
+    would outlive the server."""
+    import time as _time
+
+    from distributed_llama_tpu.runtime.server import InferenceServer
+
+    srv = InferenceServer(SPEC, params, _IdTokenizer(), "127.0.0.1", 0,
+                          slots=2, steps=4, temperature=0.0, topp=0.9,
+                          seed=5, quiet=True)
+    srv.start()
+    state = {}
+
+    def late_handler():
+        with srv._streams_lock:
+            srv._streams.add(threading.current_thread())
+        try:
+            _time.sleep(0.25)  # outlive a single-snapshot stop()
+        finally:
+            with srv._streams_lock:
+                srv._streams.discard(threading.current_thread())
+
+    def early_handler():
+        with srv._streams_lock:
+            srv._streams.add(threading.current_thread())
+        try:
+            # wait until stop() is underway: it must join THIS thread,
+            # so everything below happens inside its join loop
+            assert srv._stopped.wait(10)
+            _time.sleep(0.05)
+            late = threading.Thread(target=late_handler, daemon=True)
+            late.start()
+            state["late"] = late
+        finally:
+            with srv._streams_lock:
+                srv._streams.discard(threading.current_thread())
+
+    early = threading.Thread(target=early_handler, daemon=True)
+    early.start()
+    deadline = _time.time() + 5
+    while _time.time() < deadline and early not in srv._streams:
+        _time.sleep(0.005)
+    assert early in srv._streams, "early handler never registered"
+
+    srv.stop()
+    assert not early.is_alive(), "early stream handler was not joined"
+    assert "late" in state, "late handler never spawned"
+    assert not state["late"].is_alive(), \
+        "handler registering during stop()'s join was NOT joined — " \
+        "the register/join TOCTOU is back"

@@ -1,0 +1,83 @@
+"""Tier-1 repo gate (ISSUE 17): threadcheck over the real runtime/+obs/
+surface must report ZERO findings beyond the checked-in baseline — a new
+thread-ownership violation fails `pytest tests/` directly. The baseline
+itself is pinned EMPTY: the first run's findings were all fixed or
+pragma'd at the site (the burn-down contract in
+tools/threadcheck_baseline.txt), so new debt must be too."""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+
+from distributed_llama_tpu.analysis.__main__ import (
+    DEFAULT_THREAD_BASELINE, PACKAGE_DIR, REPO_ROOT)
+from distributed_llama_tpu.analysis.lint import (apply_baseline,
+                                                 load_baseline,
+                                                 package_files)
+from distributed_llama_tpu.analysis.threadcheck import (run_threadcheck,
+                                                        thread_scope)
+
+
+def test_package_has_no_new_threadcheck_findings():
+    findings = run_threadcheck(package_files(PACKAGE_DIR), REPO_ROOT)
+    baseline = load_baseline(DEFAULT_THREAD_BASELINE)
+    new, _, stale = apply_baseline(findings, baseline)
+    assert not new, "new threadcheck findings (fix, or pragma with a " \
+        "reason at the site):\n" + "\n".join(f.render() for f in new)
+    assert not stale, "stale threadcheck baseline entries:\n" \
+        + "\n".join(stale)
+
+
+def test_baseline_is_empty_per_the_burn_down_contract():
+    # tools/threadcheck_baseline.txt documents WHY it is empty; this pin
+    # keeps it that way — grandfathering is a deliberate decision that
+    # must show up in a diff of this test, not just the baseline file
+    assert not load_baseline(DEFAULT_THREAD_BASELINE), \
+        "threadcheck baseline grew an entry: fix or pragma at the site"
+
+
+def test_scope_covers_runtime_and_obs():
+    scoped = [p for p in package_files(PACKAGE_DIR)
+              if thread_scope(p.as_posix())]
+    names = {p.as_posix() for p in scoped}
+    assert any(n.endswith("runtime/continuous.py") for n in names)
+    assert any(n.endswith("runtime/server.py") for n in names)
+    assert any(n.endswith("obs/ledger.py") for n in names)
+    assert not any("/models/" in n for n in names)
+    assert len(scoped) >= 20  # the host runtime is the whole surface
+
+
+def test_cli_threadcheck_exits_zero_on_repo():
+    # the acceptance-criteria invocation, end to end in a fresh process
+    proc = subprocess.run(
+        [sys.executable, "-m", "distributed_llama_tpu.analysis",
+         "--threadcheck"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=600,
+        env={"PATH": "/usr/bin:/bin", "HOME": "/tmp",
+             "PYTHONPATH": str(REPO_ROOT)})
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "threadcheck: 0 new finding(s)" in proc.stdout
+
+
+def test_threadcheck_only_invocation_skips_the_lint_head(capsys):
+    # --threadcheck alone must not drag in the default lint head (the
+    # do_lint default-head rule), and --all must include threadcheck
+    from distributed_llama_tpu.analysis.__main__ import main
+
+    rc = main(["--threadcheck"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "threadcheck:" in out
+    assert "dlint:" not in out
+
+
+def test_write_threadcheck_baseline_refuses_partial_scans(tmp_path):
+    from distributed_llama_tpu.analysis.__main__ import main
+
+    target = PACKAGE_DIR / "runtime" / "continuous.py"
+    rc = main(["--threadcheck", "--write-threadcheck-baseline",
+               "--threadcheck-baseline", str(tmp_path / "tb.txt"),
+               str(target)])
+    assert rc == 2
+    assert not (tmp_path / "tb.txt").exists()

@@ -1,0 +1,313 @@
+"""threadcheck rule fixtures (ISSUE 17): every T-rule gets a firing, a
+non-firing, and a pragma-suppressed snippet, plus the registry
+self-check and the baseline round-trip on threadcheck findings.
+
+Fixture modules are written under a fake package layout (tmp/runtime/...)
+so the runtime/+obs/ scoping is exercised exactly as on the real tree,
+and they name REAL registered classes (ContinuousEngine, RequestJournal,
+PageUploader) so domain propagation and family lookup run against the
+production threadmodel registry. The checker is pure AST — none of these
+snippets is ever imported or executed."""
+
+from __future__ import annotations
+
+import textwrap
+from pathlib import Path
+
+from distributed_llama_tpu.analysis.lint import (apply_baseline,
+                                                 load_baseline,
+                                                 write_baseline)
+from distributed_llama_tpu.analysis.threadcheck import (THREAD_RULES,
+                                                        run_threadcheck,
+                                                        thread_scope)
+from distributed_llama_tpu.analysis.threadmodel import (ENTRYPOINTS,
+                                                        FAMILIES, validate)
+
+
+def run_on(tmp_path: Path, rel: str, source: str):
+    path = tmp_path / rel
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(source), encoding="utf-8")
+    return run_threadcheck([path], tmp_path)
+
+
+def rules_fired(findings):
+    return {f.rule for f in findings}
+
+
+# -- registry self-consistency ---------------------------------------------
+
+
+def test_threadmodel_registry_validates():
+    assert validate() == []
+
+
+def test_registry_covers_the_core_surfaces():
+    classes = {f.owner_class for f in FAMILIES}
+    for cls in ("ContinuousEngine", "PagedAllocator", "RequestJournal",
+                "LedgerBook", "InferenceServer", "FlightRecorder"):
+        assert cls in classes, f"{cls} has no declared attr family"
+    assert "InferenceServer._scheduler" in ENTRYPOINTS
+    assert "PageUploader._run" in ENTRYPOINTS
+
+
+def test_scope_is_runtime_and_obs_only():
+    assert thread_scope("distributed_llama_tpu/runtime/continuous.py")
+    assert thread_scope("distributed_llama_tpu/obs/ledger.py")
+    assert not thread_scope("distributed_llama_tpu/models/llama.py")
+    assert not thread_scope("tools/racecheck.py")
+
+
+# -- T001: cross-domain write without the declared lock --------------------
+
+
+def test_t001_fires_on_unlocked_family_write(tmp_path):
+    findings = run_on(tmp_path, "runtime/eng.py", """
+        class ContinuousEngine:
+            def poke(self, req):
+                self._queue.append(req)
+    """)
+    assert [f.rule for f in findings] == ["T001"]
+    assert "_lock" in findings[0].message
+
+
+def test_t001_quiet_under_the_declared_lock_and_in_init(tmp_path):
+    assert run_on(tmp_path, "runtime/eng.py", """
+        class ContinuousEngine:
+            def __init__(self):
+                self._queue = []
+
+            def poke(self, req):
+                with self._lock:
+                    self._queue.append(req)
+    """) == []
+
+
+def test_t001_pragma_suppresses_with_reason(tmp_path):
+    assert run_on(tmp_path, "runtime/eng.py", """
+        class ContinuousEngine:
+            def poke(self, req):
+                self._queue.append(req)  # threadcheck: allow[T001] quiesced
+    """) == []
+
+
+def test_t001_out_of_scope_module_is_ignored(tmp_path):
+    # the same hazard outside runtime/+obs/ is not threadcheck's beat
+    assert run_on(tmp_path, "models/eng.py", """
+        class ContinuousEngine:
+            def poke(self, req):
+                self._queue.append(req)
+    """) == []
+
+
+# -- T002: lock-order inversion --------------------------------------------
+
+
+def test_t002_fires_on_inverted_acquisition_order(tmp_path):
+    findings = run_on(tmp_path, "runtime/eng.py", """
+        class ContinuousEngine:
+            def fwd(self):
+                with self._lock:
+                    with self._book._lock:
+                        pass
+
+            def rev(self):
+                with self._book._lock:
+                    with self._lock:
+                        pass
+    """)
+    assert "T002" in rules_fired(findings)
+
+
+def test_t002_quiet_on_consistent_order(tmp_path):
+    findings = run_on(tmp_path, "runtime/eng.py", """
+        class ContinuousEngine:
+            def fwd(self):
+                with self._lock:
+                    with self._book._lock:
+                        pass
+
+            def also_fwd(self):
+                with self._lock:
+                    with self._book._lock:
+                        pass
+    """)
+    assert "T002" not in rules_fired(findings)
+
+
+def test_t002_pragma_suppresses(tmp_path):
+    findings = run_on(tmp_path, "runtime/eng.py", """
+        class ContinuousEngine:
+            def fwd(self):
+                with self._lock:
+                    with self._book._lock:
+                        pass
+
+            def rev(self):
+                with self._book._lock:
+                    # threadcheck: allow[T002] teardown-only path
+                    with self._lock:
+                        pass
+    """)
+    assert "T002" not in rules_fired(findings)
+
+
+# -- T003: blocking call while holding a lock ------------------------------
+
+
+def test_t003_fires_on_fsync_under_lock(tmp_path):
+    findings = run_on(tmp_path, "runtime/j.py", """
+        import os
+
+        class RequestJournal:
+            def flush(self):
+                with self._lock:
+                    os.fsync(self._fh.fileno())
+    """)
+    assert "T003" in rules_fired(findings)
+
+
+def test_t003_quiet_outside_lock_and_for_str_join(tmp_path):
+    assert run_on(tmp_path, "runtime/j.py", """
+        import os
+
+        class RequestJournal:
+            def flush(self):
+                with self._lock:
+                    names = ", ".join(self._names)
+                    path = os.path.join("a", "b")
+                os.fsync(self._fh.fileno())
+                return names, path
+    """) == []
+
+
+def test_t003_pragma_suppresses_with_reason(tmp_path):
+    assert run_on(tmp_path, "runtime/j.py", """
+        import os
+
+        class RequestJournal:
+            def flush(self):
+                with self._lock:
+                    os.fsync(self._fh.fileno())  # threadcheck: allow[T003] WAL durability point
+    """) == []
+
+
+# -- T004: thread started outside the entrypoint registry ------------------
+
+
+def test_t004_fires_on_unregistered_thread_target(tmp_path):
+    findings = run_on(tmp_path, "runtime/spawn.py", """
+        import threading
+
+        def kick(worker):
+            t = threading.Thread(target=worker_loop, daemon=True)
+            t.start()
+            return t
+    """)
+    assert "T004" in rules_fired(findings)
+
+
+def test_t004_quiet_on_registered_targets_incl_loop_bound(tmp_path):
+    # both direct method targets and the `for target in (...)` idiom
+    # the server's start() uses must resolve through the registry
+    assert run_on(tmp_path, "runtime/spawn.py", """
+        import threading
+
+        class InferenceServer:
+            def start(self):
+                for target in (self._scheduler, self.httpd.serve_forever):
+                    t = threading.Thread(target=target, daemon=True)
+                    t.start()
+    """) == []
+
+
+def test_t004_pragma_suppresses(tmp_path):
+    assert run_on(tmp_path, "runtime/spawn.py", """
+        import threading
+
+        def kick():
+            t = threading.Thread(target=worker_loop)  # threadcheck: allow[T004] drill-local
+            t.start()
+    """) == []
+
+
+# -- T005: mutable family state escaping its domain ------------------------
+
+
+def test_t005_fires_on_raw_return_to_foreign_domain(tmp_path):
+    # submit is a declared cross-domain crossing point: handing the raw
+    # queue back to a handler thread escapes scheduler-owned state
+    findings = run_on(tmp_path, "runtime/eng.py", """
+        class ContinuousEngine:
+            def submit(self, req):
+                return self._queue
+    """)
+    assert "T005" in rules_fired(findings)
+
+
+def test_t005_quiet_on_snapshot_return(tmp_path):
+    findings = run_on(tmp_path, "runtime/eng.py", """
+        class ContinuousEngine:
+            def submit(self, req):
+                with self._lock:
+                    return list(self._queue)
+    """)
+    assert "T005" not in rules_fired(findings)
+
+
+def test_t005_pragma_suppresses(tmp_path):
+    findings = run_on(tmp_path, "runtime/eng.py", """
+        class ContinuousEngine:
+            def submit(self, req):
+                return self._queue  # threadcheck: allow[T005] caller holds _lock
+    """)
+    assert "T005" not in rules_fired(findings)
+
+
+# -- T000 + baseline machinery ---------------------------------------------
+
+
+def test_unreadable_in_scope_file_is_a_finding(tmp_path):
+    bad = tmp_path / "runtime" / "broken.py"
+    bad.parent.mkdir(parents=True)
+    bad.write_text("def broken(:\n", encoding="utf-8")
+    findings = run_threadcheck([bad], tmp_path)
+    assert [f.rule for f in findings] == ["T000"]
+
+
+def test_every_rule_has_a_title_and_hint():
+    for rule, (title, hint) in THREAD_RULES.items():
+        assert title and hint, rule
+
+
+def test_baseline_round_trip_on_threadcheck_findings(tmp_path):
+    findings = run_on(tmp_path, "runtime/eng.py", """
+        class ContinuousEngine:
+            def poke(self, req):
+                self._queue.append(req)
+    """)
+    assert findings
+    baseline_path = tmp_path / "tb.txt"
+    write_baseline(baseline_path, findings)
+    baseline = load_baseline(baseline_path)
+    new, suppressed, stale = apply_baseline(findings, baseline)
+    assert (new, suppressed, stale) == ([], len(findings), [])
+    # fixing the finding turns the entry stale (line-number independent)
+    fixed = run_on(tmp_path, "runtime/eng.py", """
+        class ContinuousEngine:
+            def poke(self, req):
+                with self._lock:
+                    self._queue.append(req)
+    """)
+    new, suppressed, stale = apply_baseline(fixed, baseline)
+    assert new == [] and suppressed == 0 and len(stale) == len(findings)
+
+
+def test_dlint_and_threadcheck_pragmas_coexist_on_one_line(tmp_path):
+    # the shared pragma parser: either tag may carry either head's rule
+    # ids (namespaces are disjoint), and one line can carry both tags
+    assert run_on(tmp_path, "runtime/eng.py", """
+        class ContinuousEngine:
+            def poke(self, req):
+                self._queue.append(req)  # dlint: allow[D007] x  # threadcheck: allow[T001] y
+    """) == []

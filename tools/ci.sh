@@ -33,6 +33,41 @@ cd "$(dirname "$0")/.."
 # contiguous stripe at equal capacity — the KV-PAGED check — and the q8
 # KV-quant column's byte formula + 2x capacity floor — KV-QUANT)
 python -m distributed_llama_tpu.analysis --all
+# Thread-safety gate (ISSUE 17): the --all run above already includes
+# the threadcheck ownership lint (zero findings beyond the empty
+# baseline); racecheck is its dynamic twin — the REAL cross-thread seam
+# code (pool vs DCN adoption, uploader settle, ingest vs cancel sweep,
+# ledger drain) driven through >= 100 deterministic interleavings per
+# seam with the allocator-audit + ledger-conservation oracles after
+# every schedule. The JSON row is archived next to the other artifacts.
+mkdir -p tools/ci_artifacts
+python tools/racecheck.py > tools/ci_artifacts/racecheck.json
+# ... and the race gate must still CATCH a race: with drop-a-lock armed
+# (page allocation split into the read/claim half-ops a dropped pool
+# lock admits) the allocator audit must flag a schedule and exit 1
+# EXACTLY — 2 is a usage error and would pass a naive non-zero check
+set +e
+python tools/racecheck.py --seam pool_adopt --inject drop-a-lock \
+    > /dev/null 2>&1
+droplock_rc=$?
+set -e
+if [ "$droplock_rc" -ne 1 ]; then
+    echo "ci: racecheck did not flag the dropped pool lock" \
+         "(exit $droplock_rc, expected 1)" >&2
+    exit 1
+fi
+# ... and with reorder-inbox armed (the ingest inbox drained in reversed
+# order) the FIFO admission-order oracle must flag it the same way
+set +e
+python tools/racecheck.py --seam ingest_sweep --inject reorder-inbox \
+    > /dev/null 2>&1
+reorder_rc=$?
+set -e
+if [ "$reorder_rc" -ne 1 ]; then
+    echo "ci: racecheck did not flag the reordered ingest inbox" \
+         "(exit $reorder_rc, expected 1)" >&2
+    exit 1
+fi
 # paged-vs-contiguous equivalence gate (ISSUE 6): paged decode must stay
 # BITWISE equal to the contiguous cache and stream-invisible in the
 # engine, and the shared-prompt radix path must actually share — fail

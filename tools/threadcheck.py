@@ -1,0 +1,17 @@
+#!/usr/bin/env python
+"""Alias for ``python -m distributed_llama_tpu.analysis --threadcheck``
+— the thread-ownership lint over runtime/ + obs/ (T-rules against the
+analysis/threadmodel.py registry). Extra argv is passed through, so
+`tools/threadcheck.py --no-baseline` and
+`tools/threadcheck.py --write-threadcheck-baseline` work as expected."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir))
+
+from distributed_llama_tpu.analysis.__main__ import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main(["--threadcheck", *sys.argv[1:]]))
