@@ -455,6 +455,102 @@ def contract_verify_collectives(spec=None, tp: int = 4,
         f"{moved} B = {k}x per-token (tp={tp}, scheme={scheme})", hint)
 
 
+def contract_mixed_collectives(spec=None, tp: int = 4,
+                               scheme: str | None = None, budget: int = 4,
+                               page_size: int = 16) -> ContractResult:
+    """J001 for the token-budget MIXED dispatch (ISSUE 18): trace
+    tp.make_sharded_mixed and pin its collective census to the decode
+    step's — same per-kind COUNTS as one token (decode rows and the
+    prefill slice share ONE fused forward, ONE collective schedule) with
+    payload bytes scaled by exactly the token budget
+    (comm_stats.tp_collective_budget(t_len=budget)). The whole point of
+    mixed batching is that a prefill slice piggybacks on the decode
+    dispatch it already had to make; a mixed forward that issued extra
+    collectives would pay the per-layer latency floor twice and quietly
+    void the attainment win loadcheck --budget measures."""
+    import collections
+
+    import jax
+    import jax.numpy as jnp
+
+    from ..models.llama import init_cache_paged
+    from ..parallel import make_mesh, make_sharded_mixed
+    from ..parallel.comm_stats import tp_collective_budget, tp_scheme
+
+    scheme = scheme or tp_scheme()
+    name = f"mixed_collectives[{scheme}]"
+    hint = ("the mixed token-budget dispatch must issue EXACTLY one decode "
+            "step's collective schedule with budget-row payloads — a "
+            "collective or payload change must land together with "
+            "parallel/comm_stats.py (tp_collective_budget t_len scaling)")
+    spec = spec or _contract_spec()
+    if len(jax.devices()) < tp:
+        return ContractResult(
+            "J001", name, False,
+            f"needs {tp} devices, have {len(jax.devices())} — set "
+            f"--xla_force_host_platform_device_count", hint)
+    mesh = make_mesh(tp=tp, devices=jax.devices()[:tp])
+    fwd = make_sharded_mixed(spec, mesh, page_size, scheme=scheme)
+    params = abstract_params(spec)
+    max_pages = spec.seq_len // page_size
+    cache = jax.eval_shape(lambda: init_cache_paged(
+        spec, max_pages + 1, page_size, jnp.float32))
+    tokens = jax.ShapeDtypeStruct((1, budget), jnp.int32)
+    pos = jax.ShapeDtypeStruct((1,), jnp.int32)
+    span = jax.ShapeDtypeStruct((1,), jnp.int32)
+    table = jax.ShapeDtypeStruct((1, max_pages), jnp.int32)
+    jaxpr = jax.make_jaxpr(fwd)(params, cache, tokens, pos, span,
+                                table).jaxpr
+    colls = collect_collectives(jaxpr)
+    if not colls:
+        return ContractResult("J001", name, False,
+                              "no collectives found — jaxpr walk or "
+                              "shard_map internals changed?", hint)
+    budget_1 = tp_collective_budget(spec, tp, scheme)
+    budget_t = tp_collective_budget(spec, tp, scheme, t_len=budget)
+    got_counts = collections.Counter()
+    for prim, _, m in colls:
+        got_counts[_collective_kind(prim)] += m
+    unmodeled = sorted(set(got_counts) - set(budget_1.kind_counts()))
+    if unmodeled:
+        return ContractResult(
+            "J001", name, False,
+            f"collective kind(s) {unmodeled} in the mixed forward have "
+            f"no comm_stats term for scheme {scheme!r}", hint)
+    if dict(got_counts) != budget_1.kind_counts():
+        return ContractResult(
+            "J001", name, False,
+            f"mixed dispatch collective counts {dict(got_counts)} != one "
+            f"decode step's {budget_1.kind_counts()} — the piggyback "
+            f"amortization is broken", hint)
+    moved = sum(_moved_bytes(_collective_kind(prim), a, tp) * m
+                for prim, a, m in colls)
+    if moved != budget_t.moved_bytes:
+        return ContractResult(
+            "J001", name, False,
+            f"traced mixed payload {moved} B/dispatch != analytic "
+            f"{budget_t.moved_bytes} B (= {budget} x the per-token "
+            f"budget)", hint)
+    return ContractResult(
+        "J001", name, True,
+        f"{sum(got_counts.values())} collectives ({dict(got_counts)}) — "
+        f"one decode step's schedule for a {budget}-token mixed window, "
+        f"payload {moved} B = {budget}x per-token (tp={tp}, "
+        f"scheme={scheme})", hint)
+
+
+def contract_mixed_collectives_ref(spec=None) -> ContractResult:
+    return contract_mixed_collectives(spec, scheme="ref")
+
+
+def contract_mixed_collectives_fused(spec=None) -> ContractResult:
+    return contract_mixed_collectives(spec, scheme="fused")
+
+
+def contract_mixed_collectives_overlap(spec=None) -> ContractResult:
+    return contract_mixed_collectives(spec, scheme="overlap")
+
+
 def contract_verify_collectives_ref(spec=None) -> ContractResult:
     return contract_verify_collectives(spec, scheme="ref")
 
@@ -487,19 +583,27 @@ contract_verify_collectives.contract_id = "J001"
 contract_verify_collectives_ref.contract_id = "J001"
 contract_verify_collectives_fused.contract_id = "J001"
 contract_verify_collectives_overlap.contract_id = "J001"
+contract_mixed_collectives.contract_id = "J001"
+contract_mixed_collectives_ref.contract_id = "J001"
+contract_mixed_collectives_fused.contract_id = "J001"
+contract_mixed_collectives_overlap.contract_id = "J001"
 contract_decode_donation.contract_id = "J002"
 contract_decode_donation_paged.contract_id = "J002"
 contract_decode_shape_stability.contract_id = "J003"
 
 # J001 runs once per scheme: ALL schedules stay pinned regardless of which
 # DLLAMA_TP_SCHEME the current process happens to run under — for the
-# decode forward AND the speculative K-query verify dispatch; J002 runs
+# decode forward, the speculative K-query verify dispatch, AND the
+# token-budget mixed dispatch (ISSUE 18); J002 runs
 # once per cache layout (contiguous + paged), for the same reason
 CONTRACTS = (contract_tp_collectives_ref, contract_tp_collectives_fused,
              contract_tp_collectives_overlap,
              contract_verify_collectives_ref,
              contract_verify_collectives_fused,
              contract_verify_collectives_overlap,
+             contract_mixed_collectives_ref,
+             contract_mixed_collectives_fused,
+             contract_mixed_collectives_overlap,
              contract_decode_donation, contract_decode_donation_paged,
              contract_decode_shape_stability)
 

@@ -488,7 +488,8 @@ def device_footprint(spec: TransformerSpec, n_slices: int, scheme: str,
                      device: str = "v5e", kv_page_size: int = 0,
                      kv_pages: int | None = None,
                      spec_k: int = 0, kv_quant: str = "f32",
-                     tier_staging_pages: int = 0) -> MemoryReport:
+                     tier_staging_pages: int = 0,
+                     mixed_budget: int = 0) -> MemoryReport:
     """Assemble the per-device report; ``activation_bytes`` overrides the
     analytic bound with a traced live-interval peak when available.
     ``kv_page_size > 0`` charges KV as the paged pool (default pool =
@@ -502,10 +503,20 @@ def device_footprint(spec: TransformerSpec, n_slices: int, scheme: str,
     codes+deltas byte rate (kv_position_bytes). ``tier_staging_pages``
     (ISSUE 12) charges the KV-tiering promotion staging buffer — the
     device-side upload target a tiered engine double-buffers (2 pages is
-    the engine's shape) — priced at the pool's page byte rate."""
+    the engine's shape) — priced at the pool's page byte rate.
+    ``mixed_budget > 0`` (ISSUE 18) charges activations and collective
+    staging at the token-budget dispatch width — the mixed forward runs
+    batch * budget activation rows through every layer, same shape math
+    as the verify window; mutually exclusive with ``spec_k`` (the engine
+    rejects the pairing, so a report pricing both would describe a
+    config that cannot exist)."""
     from ..parallel.comm_stats import collective_staging_bytes
 
-    t_len = max(1, spec_k)
+    if spec_k and mixed_budget:
+        raise ValueError("spec_k and mixed_budget are mutually exclusive "
+                         "(the engine rejects --spec-k with "
+                         "--dispatch-tokens; price one dispatch shape)")
+    t_len = max(1, spec_k, mixed_budget)
     if kv_quant != "f32" and kv_page_size <= 0:
         raise ValueError(f"kv_quant={kv_quant!r} prices PAGE planes; "
                          f"pass kv_page_size > 0")

@@ -508,6 +508,42 @@ def check_tier_staging(spec, tp: int, config: str, report,
     return findings
 
 
+def check_mixed_budget(spec, tp: int, config: str, report,
+                       kv_quant: str, expect_fits: bool,
+                       budget: int = 16) -> list:
+    """MIXED-HBM: price the token-budget mixed dispatch (ISSUE 18) in
+    device_footprint and require that (a) the activation/staging width
+    follows the same t_len shape math as the K-query verify dispatch
+    (pricing spec_k=budget and mixed_budget=budget must agree exactly —
+    one formula, two knobs) and (b) a fitting config still fits with the
+    default budget window enabled — turning on --dispatch-tokens must
+    never flip a support-matrix verdict. Weights and KV are unchanged by
+    construction; only the per-dispatch activation rows widen."""
+    from .memory_model import DEFAULT_PAGE_SIZE, device_footprint
+
+    findings = []
+    mixed = device_footprint(spec, tp, report.scheme, model=report.model,
+                             kv_page_size=DEFAULT_PAGE_SIZE,
+                             kv_quant=kv_quant, mixed_budget=budget)
+    twin = device_footprint(spec, tp, report.scheme, model=report.model,
+                            kv_page_size=DEFAULT_PAGE_SIZE,
+                            kv_quant=kv_quant, spec_k=budget)
+    if mixed.total_bytes != twin.total_bytes:
+        findings.append(ShardFinding(
+            "MIXED-HBM", config,
+            f"mixed_budget={budget} prices {mixed.total_bytes} B but "
+            f"spec_k={budget} prices {twin.total_bytes} B — the two "
+            f"t_len knobs drifted apart in memory_model"))
+    if expect_fits and report.fits and not mixed.fits:
+        findings.append(ShardFinding(
+            "MIXED-HBM", config,
+            f"the {budget}-token mixed dispatch window "
+            f"({mixed.total_bytes / GIB:.3f} GiB) pushes this fitting "
+            f"config over budget — --dispatch-tokens cannot be enabled "
+            f"on it; shrink the budget or update the matrix"))
+    return findings
+
+
 # -- per-config driver ------------------------------------------------------
 
 
@@ -580,6 +616,8 @@ def check_config(entry: MatrixEntry, device: str = "v5e",
 
     if spec.seq_len % DEFAULT_PAGE_SIZE == 0:
         findings += check_tier_staging(spec, entry.tp, config, report,
+                                       kv_quant, entry.expect_fits)
+        findings += check_mixed_budget(spec, entry.tp, config, report,
                                        kv_quant, entry.expect_fits)
     if report.fits != entry.expect_fits:
         if entry.expect_fits:

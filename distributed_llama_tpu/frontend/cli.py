@@ -290,6 +290,16 @@ def cmd_inference(argv: list[str], quiet: bool = False) -> int:
                     help="longest n-gram the speculative drafter matches "
                          "against the emitted stream (falls back to "
                          "shorter n-grams down to 1)")
+    ap.add_argument("--dispatch-tokens", type=int, default=0, metavar="T",
+                    help="with --continuous and --kv-page-size: "
+                         "token-budget mixed dispatches — every device "
+                         "step carries all active decode rows (1 token "
+                         "each) plus ONE prefill slice cut to the "
+                         "remaining budget of T tokens, in a single "
+                         "fused forward (prefill no longer stalls "
+                         "in-flight decodes behind a separate chunk "
+                         "dispatch). -1 sizes from --prefill-chunk; "
+                         "0 = off. Incompatible with --spec-k")
     ap.add_argument("--kv-cache-dtype", default="f32",
                     choices=("f32", "bf16"),
                     help="KV cache precision: f32 = reference parity "
@@ -344,6 +354,17 @@ def cmd_inference(argv: list[str], quiet: bool = False) -> int:
         # multi-GB model load: rollback truncates page tables
         print("--spec-k needs the paged KV cache: add --kv-page-size P "
               "(with --continuous)", file=sys.stderr)
+        return 2
+    if args.spec_k and args.dispatch_tokens:
+        # the verify window and the prefill slice both claim the per-row
+        # span; unifying them is follow-up work — refuse at argparse time
+        print("--spec-k is incompatible with --dispatch-tokens: the "
+              "verify window and the prefill slice both claim the "
+              "per-row span (drop one)", file=sys.stderr)
+        return 2
+    if args.dispatch_tokens and args.kv_page_size <= 0:
+        print("--dispatch-tokens needs the paged KV cache: add "
+              "--kv-page-size P (with --continuous)", file=sys.stderr)
         return 2
     if args.kv_quant == "q8" and args.kv_page_size <= 0:
         # same argparse-time contract as --spec-k: q8 quantizes PAGE
@@ -496,6 +517,7 @@ def cmd_inference(argv: list[str], quiet: bool = False) -> int:
                                 kv_pages=args.kv_pages,
                                 spec_k=args.spec_k,
                                 spec_ngram=args.spec_ngram,
+                                dispatch_tokens=args.dispatch_tokens,
                                 kv_quant=args.kv_quant,
                                 kv_host_pages=args.kv_host_pages,
                                 kv_disk_dir=args.kv_disk_dir,
@@ -704,6 +726,13 @@ def cmd_serve(argv: list[str]) -> int:
     _add_kv_tier_flags(ap)
     ap.add_argument("--spec-ngram", type=int, default=3, metavar="N",
                     help="longest drafter n-gram (falls back to 1)")
+    ap.add_argument("--dispatch-tokens", type=int, default=0, metavar="T",
+                    help="token-budget mixed dispatches (needs "
+                         "--kv-page-size): decode rows + ONE prefill "
+                         "slice share each fused dispatch under a T-token "
+                         "budget — single-pool serving without prefill "
+                         "stalls (-1 sizes from --prefill-chunk; 0 = "
+                         "off; incompatible with --spec-k)")
     ap.add_argument("--fast-prefill", action="store_true",
                     help="bf16 matmul precision for admission prefill "
                          "(documented tolerance; decode untouched)")
@@ -824,6 +853,17 @@ def cmd_serve(argv: list[str]) -> int:
         # engine construction after the model load
         print("--spec-k needs the paged KV cache: add --kv-page-size P",
               file=sys.stderr)
+        return 2
+    if args.spec_k and args.dispatch_tokens:
+        # same argparse-time gate as inference mode: the verify window
+        # and the prefill slice both claim the per-row span
+        print("--spec-k is incompatible with --dispatch-tokens: the "
+              "verify window and the prefill slice both claim the "
+              "per-row span (drop one)", file=sys.stderr)
+        return 2
+    if args.dispatch_tokens and args.kv_page_size <= 0:
+        print("--dispatch-tokens needs the paged KV cache: add "
+              "--kv-page-size P", file=sys.stderr)
         return 2
     if args.kv_quant == "q8" and args.kv_page_size <= 0:
         # q8 quantizes PAGE planes — meaningless without the pool; fail
@@ -948,7 +988,9 @@ def cmd_serve(argv: list[str]) -> int:
                                  page_size=args.kv_page_size,
                                  kv_pages=args.kv_pages,
                                  spec_k=args.spec_k,
-                                 spec_ngram=args.spec_ngram, slo=slo,
+                                 spec_ngram=args.spec_ngram,
+                                 dispatch_tokens=args.dispatch_tokens,
+                                 slo=slo,
                                  chaos=chaos, journal=journal,
                                  watchdog_s=args.watchdog_ms / 1e3,
                                  drain_s=args.drain_s,

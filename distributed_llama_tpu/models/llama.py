@@ -657,11 +657,16 @@ def write_page_planes(cache, pid, planes):
 def paged_attention_q8(head_size: int, kv_mul: int, page_size: int,
                        n_pages: int, q: jax.Array, k: jax.Array,
                        v: jax.Array, kq_all, kd_all, vq_all, vd_all,
-                       idx, pos: jax.Array, table: jax.Array):
+                       idx, pos: jax.Array, table: jax.Array,
+                       span: jax.Array | None = None):
     """Q8-page twin of paged_decode_attention AND spec_verify_attention in
     one function: T=1 is the decode step, T=K the speculative-verify
     window (the location/mask math is spec_verify_attention's, which
-    reduces to the decode case at T=1).
+    reduces to the decode case at T=1). ``span`` (B,) int32, when given,
+    is the mixed-batch write gate: window offsets at or past a row's span
+    route their dead quantized writes to the scrap page exactly like
+    budget-edge positions (mixed_attention's contract) — None preserves
+    the decode/verify behavior where every offset is live.
 
     Quantize-on-write: each (row, window-offset) position Q80-encodes its
     flattened (n_kv*hs) k/v row — int8 codes into the code plane at the
@@ -686,6 +691,8 @@ def paged_attention_q8(head_size: int, kv_mul: int, page_size: int,
     v_qs, v_d = quantize_q80_jax(v)
     k_codes = k_qs.reshape(B, t_len, n_kv, head_size)
     v_codes = v_qs.reshape(B, t_len, n_kv, head_size)
+    span_b = (None if span is None
+              else jnp.broadcast_to(jnp.asarray(span, jnp.int32), (B,)))
     # per-(row, window-offset) writes, in place on the carries — the same
     # B-updates-not-scatter rationale (and the same scrap-page overflow
     # routing) as spec_verify_attention
@@ -693,7 +700,10 @@ def paged_attention_q8(head_size: int, kv_mul: int, page_size: int,
         for i in range(t_len):
             p = pos_b[b] + i
             logical = jnp.minimum(p // page_size, max_pages - 1)
-            page = jnp.where(p < s_virt,
+            live = p < s_virt
+            if span_b is not None:
+                live = live & (i < span_b[b])
+            page = jnp.where(live,
                              jnp.take(table[b], logical), SCRAP_PAGE)
             row = idx * n_pages + page
             off = p % page_size
@@ -988,6 +998,140 @@ def forward_batch_spec_paged(spec: TransformerSpec, page_size: int,
     x = rmsnorm(x, params["rms_final"])
     logits = matmul(params["wcls"], x)                     # (B*K, vocab)
     return logits.reshape(B, K, -1), rebuild_paged_cache(tuple(kv), L)
+
+
+def mixed_attention(head_size: int, kv_mul: int, page_size: int,
+                    n_pages: int, q: jax.Array, k: jax.Array,
+                    v: jax.Array, k_all: jax.Array, v_all: jax.Array,
+                    idx, pos: jax.Array, table: jax.Array,
+                    span: jax.Array):
+    """spec_verify_attention generalized to per-row ARBITRARY spans — the
+    mixed prefill+decode attention (ISSUE 18): row b contributes
+    ``span[b]`` live query positions starting at pos_b (a decode row has
+    span 1, the prefill-slice row has span up to the remaining token
+    budget, a padded/idle row has span 0), all in ONE (B, T) dispatch
+    where T is the dispatch token budget.
+
+    The location math is spec_verify_attention's; the only change is the
+    write gate: a window offset at or past a row's span routes its dead
+    K/V write to the scrap page (the same junk-is-invisible contract as
+    budget-edge positions), so padded offsets never touch live pages.
+    The causal masks are untouched — padded queries attend whatever the
+    virtual plane holds and produce junk logit rows the engine discards
+    host-side (never an empty mask, so softmax stays finite). Live query
+    i of row b therefore sees EXACTLY the virtual window sequential
+    decode/prefill would have seen at that position, which is what makes
+    mixed-dispatch streams bitwise equal to the separate-dispatch engine.
+    Returns (ao (B, T, n_q*hs), k_all, v_all)."""
+    B, t_len = q.shape[0], q.shape[1]
+    n_kv = k_all.shape[-2]
+    n_q = q.shape[-1] // head_size
+    dt = k_all.dtype
+    k_new = k.reshape(B, t_len, n_kv, head_size).astype(dt)
+    v_new = v.reshape(B, t_len, n_kv, head_size).astype(dt)
+    pos_b = jnp.broadcast_to(jnp.asarray(pos, jnp.int32), (B,))
+    span_b = jnp.broadcast_to(jnp.asarray(span, jnp.int32), (B,))
+    max_pages = table.shape[1]
+    s_virt = max_pages * page_size
+    from ..runtime.paging import SCRAP_PAGE
+
+    # per-(row, window-offset) writes, each in place on the carry — the
+    # same trace-time-unrolled B-updates-not-scatter loop as
+    # spec_verify_attention, with the span gate added to the routing
+    for b in range(B):
+        for i in range(t_len):
+            p = pos_b[b] + i
+            logical = jnp.minimum(p // page_size, max_pages - 1)
+            page = jnp.where((p < s_virt) & (i < span_b[b]),
+                             jnp.take(table[b], logical), SCRAP_PAGE)
+            row = idx * n_pages + page
+            k_all = jax.lax.dynamic_update_slice(
+                k_all, k_new[b, i][None, None], (row, p % page_size, 0, 0))
+            v_all = jax.lax.dynamic_update_slice(
+                v_all, v_new[b, i][None, None], (row, p % page_size, 0, 0))
+    from ..ops.pallas_paged_attention import maybe_paged_flash_decode
+
+    # the (B, T) window rides the SAME paged flash kernel (stacked causal
+    # windows) through the same routing gate as decode/verify
+    ao = maybe_paged_flash_decode(
+        q, (k_all, v_all), idx, pos_b, table, page_size=page_size,
+        n_pages=n_pages, head_size=head_size, t_len=t_len, n_kv=n_kv,
+        kv_mul=kv_mul)
+    if ao is not None:
+        return ao, k_all, v_all
+    rows = (idx * n_pages + table).reshape(-1)            # (B * max_pages,)
+    k_c = jnp.take(k_all, rows, axis=0).reshape(B, s_virt, n_kv, head_size)
+    v_c = jnp.take(v_all, rows, axis=0).reshape(B, s_virt, n_kv, head_size)
+    # (B, T, S): query i of row b sees virtual positions 0..pos_b+i — the
+    # per-step causal windows of sequential decode, stacked; offsets past
+    # span[b] compute junk the engine never reads
+    q_pos = pos_b[:, None] + jnp.arange(t_len)[None, :]   # (B, T)
+    mask = jnp.arange(s_virt)[None, None, :] <= q_pos[:, :, None]
+    ao = attention_core(head_size, kv_mul,
+                        q.reshape(B, t_len, n_q, head_size), k_c, v_c, mask)
+    return ao, k_all, v_all
+
+
+def forward_batch_mixed_paged(spec: TransformerSpec, page_size: int,
+                              params: dict[str, Any], cache,
+                              tokens: jax.Array, pos_vec: jax.Array,
+                              span: jax.Array, table: jax.Array, *,
+                              kv_quant: str = "f32"):
+    """The token-budget MIXED dispatch over the paged pool cache
+    (ISSUE 18): one fused forward scores all active decode rows (span 1)
+    plus ONE prefill slice (span up to the remaining budget) in a single
+    (B, T) window — prefill no longer stalls in-flight decodes behind a
+    separate chunk dispatch, and the per-layer collective schedule is
+    paid once per budget of tokens (comm_stats.tp_collective_budget at
+    t_len=budget models it; contract_mixed_collectives pins it).
+
+    forward_batch_spec_paged's sibling: tokens (B, T) int32 with row b
+    live in columns 0..span[b]-1 (junk beyond — embedded and computed but
+    write-gated off live pages and discarded host-side); pos_vec (B,);
+    span (B,) int32. Returns (logits (B, T, vocab), cache). Everything
+    except attention treats the B*T rows as a flat batch through the SAME
+    _qkv_proj/_post_attention blocks as decode, so live logit rows are
+    bitwise the single-token decode logits given the same history — the
+    parity anchor of tests/test_mixed_batch.py. jit with
+    (spec, page_size) static and the cache donated (J002 holds: the
+    rank-4 page-plane view rides the scan carry in place).
+    """
+    B, T = tokens.shape
+    x = params["tok_embedding"][tokens.reshape(-1)].astype(jnp.float32)
+    pos_b = jnp.broadcast_to(jnp.asarray(pos_vec, jnp.int32), (B,))
+    span_b = jnp.broadcast_to(jnp.asarray(span, jnp.int32), (B,))
+    positions = (pos_b[:, None]
+                 + jnp.arange(T, dtype=jnp.int32)[None, :]).reshape(-1)
+    hs, kv_mul = spec.head_size, spec.kv_mul
+    q8 = kv_quant == "q8"
+    L = spec.n_layers
+    planes, P = paged_cache_planes(cache)
+
+    stacked, scanned = split_layer_weights(params)
+
+    def scan_body(carry, per_layer):
+        x, *kv = carry
+        idx, lw_slice = per_layer
+        lw = layer_view(stacked, lw_slice, idx)
+        q, k, v = _qkv_proj(spec, lw, x, positions)        # (B*T, ...)
+        if q8:
+            ao, *kv = paged_attention_q8(
+                hs, kv_mul, page_size, P, q.reshape(B, T, -1),
+                k.reshape(B, T, -1), v.reshape(B, T, -1), *kv, idx,
+                pos_b, table, span=span_b)
+        else:
+            ao, *kv = mixed_attention(
+                hs, kv_mul, page_size, P, q.reshape(B, T, -1),
+                k.reshape(B, T, -1), v.reshape(B, T, -1), *kv, idx,
+                pos_b, table, span_b)
+        x = _post_attention(spec, lw, x, ao.reshape(B * T, -1))
+        return (x, *kv), None
+
+    idxs = jnp.arange(L, dtype=jnp.int32)
+    (x, *kv), _ = jax.lax.scan(scan_body, (x, *planes), (idxs, scanned))
+    x = rmsnorm(x, params["rms_final"])
+    logits = matmul(params["wcls"], x)                     # (B*T, vocab)
+    return logits.reshape(B, T, -1), rebuild_paged_cache(tuple(kv), L)
 
 
 def gather_pages(cache: KVCache, table: jax.Array,

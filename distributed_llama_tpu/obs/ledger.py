@@ -46,9 +46,12 @@ import collections
 import threading
 
 # the closed stall-cause vocabulary (pre-registered at zero in
-# Prometheus; an unknown cause is a bug, not a new series)
+# Prometheus; an unknown cause is a bug, not a new series).
+# budget_wait (ISSUE 18): a mixed-dispatch engine had more active decode
+# rows than the token budget holds, so the row rode one dispatch deferred
+# (span 0) and retries next dispatch under the rotating fairness cursor.
 STALL_CAUSES = ("pool_dry", "promo_pending", "prefill_hold",
-                "queue_wait", "handoff_wait")
+                "queue_wait", "handoff_wait", "budget_wait")
 # dispatch-token kinds: decode = sampled via _advance, prefill = prompt
 # positions filled/echoed at admission, spec = draft tokens proposed
 TOKEN_KINDS = ("decode", "prefill", "spec")

@@ -1,5 +1,6 @@
 from .mesh import make_mesh  # noqa: F401
 from .tp import (make_sharded_forward, make_sharded_forward_batch,  # noqa: F401
-                 make_sharded_forward_batch_paged, make_sharded_verify,
+                 make_sharded_forward_batch_paged, make_sharded_mixed,
+                 make_sharded_verify,
                  shard_params, shard_cache, shard_cache_batch,
                  shard_cache_paged, validate_sharding)

@@ -150,6 +150,12 @@ def tp_collective_budget(spec: TransformerSpec, n_slices: int,
     make_sharded_verify): every cut moves a (t_len, width) block through
     the same per-layer collectives one decode step issues, so bytes scale
     by exactly t_len (the logits gather included) and launches do not.
+    The token-budget MIXED dispatch (ISSUE 18, tp.make_sharded_mixed)
+    reuses the same scaling with t_len = the dispatch token budget: decode
+    rows plus one prefill slice fill a (budget, width) block per cut,
+    paying the per-collective launch floor ONCE for the whole window —
+    the analytic half of jaxpr_contracts.contract_mixed_collectives
+    and shard_sim.FullSystemProjection.mixed.
     That launches-don't-scale property IS the speculative amortization
     (shard_sim.FullSystemProjection.speculative), and J001's verify
     census (analysis/jaxpr_contracts.contract_verify_collectives) pins

@@ -364,6 +364,41 @@ class SpeculativeProjection:
 
 
 @dataclasses.dataclass(frozen=True)
+class MixedProjection:
+    """Modeled economics of one token-budget MIXED dispatch (ISSUE 18).
+
+    Batch-1 accounting, like SpeculativeProjection: per dispatch the
+    stream emits ONE decode token and a prefill slice advances by
+    ``budget - 1`` prompt positions, all through one fused forward. Shard
+    compute is charged weight-bound-unchanged (the budget rows reuse the
+    decode step's weight traffic — same economics as the K-query verify),
+    the ICI bandwidth term scales by the budget (comm_stats t_len), and
+    the per-collective latency floor is paid ONCE for the whole window.
+    The alternative — a separate chunk-prefill dispatch of the same
+    ``budget - 1`` tokens — pays shard compute and the latency floor a
+    SECOND time and stalls the decode stream behind it for a full
+    dispatch. ``prefill_speedup`` (separate / piggybacked marginal cost)
+    is the modeled half of the attainment gap tools/loadcheck.py
+    --budget measures empirically."""
+    budget: int              # tokens per dispatch (--dispatch-tokens)
+    slice_tokens: int        # budget - 1 piggybacked prefill positions
+    dispatch_ms: float       # shard_ms + budget*bw_ms + lat_ms - hidden
+    # marginal cost of the piggybacked slice: what the dispatch costs
+    # BEYOND the decode step it was making anyway, per slice token
+    prefill_ms_per_token: float
+    # the same slice as its own chunk-prefill dispatch, per token
+    separate_prefill_ms_per_token: float
+    baseline_ms_per_token: float  # the plain decode projection (total_ms)
+
+    @property
+    def prefill_speedup(self) -> float:
+        """Separate-dispatch vs piggybacked marginal slice cost (> 1
+        whenever shard compute or the latency floor is non-zero)."""
+        return (self.separate_prefill_ms_per_token
+                / self.prefill_ms_per_token)
+
+
+@dataclasses.dataclass(frozen=True)
 class FullSystemProjection:
     """Measured shard compute + modeled ICI = projected full-system ms/token,
     with the per-layer collective budget itemized (VERDICT r1 #1) and the
@@ -411,6 +446,34 @@ class FullSystemProjection:
             k=k, alpha=alpha, expected_tokens=round(e, 3),
             dispatch_ms=round(dispatch_ms, 3),
             ms_per_accepted_token=round(dispatch_ms / e, 3),
+            baseline_ms_per_token=round(self.total_ms, 3))
+
+    def mixed(self, budget: int) -> MixedProjection:
+        """The token-budget term (ISSUE 18): modeled dispatch cost when
+        every decode step also carries a ``budget - 1``-token prefill
+        slice. Composes this projection's own components — bandwidth
+        scales by the budget (comm_stats t_len), latency is paid once
+        per dispatch, shard compute is charged weight-bound-unchanged —
+        so the loadcheck --budget gate and the headline projection lean
+        on ONE accounting. The marginal slice cost is the dispatch's
+        excess over the decode step the stream was paying anyway; the
+        separate-dispatch comparison re-charges shard compute and the
+        latency floor for a standalone chunk of the same size."""
+        if budget < 2:
+            raise ValueError(f"mixed budget={budget} must be >= 2 "
+                             f"(1 decode token + a non-empty slice)")
+        slice_tokens = budget - 1
+        dispatch_ms = (self.shard_ms + budget * self.ici_bandwidth_ms
+                       + self.ici_latency_ms - self.ici_hidden_ms)
+        marginal_ms = (dispatch_ms - self.total_ms) / slice_tokens
+        separate_ms = (self.shard_ms + slice_tokens * self.ici_bandwidth_ms
+                       + self.ici_latency_ms
+                       - self.ici_hidden_ms) / slice_tokens
+        return MixedProjection(
+            budget=budget, slice_tokens=slice_tokens,
+            dispatch_ms=round(dispatch_ms, 3),
+            prefill_ms_per_token=round(marginal_ms, 6),
+            separate_prefill_ms_per_token=round(separate_ms, 6),
             baseline_ms_per_token=round(self.total_ms, 3))
 
 

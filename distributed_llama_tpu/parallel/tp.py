@@ -111,7 +111,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..io.loader import Q40Kernel, Q40Weight
 from ..models.llama import (KVCache, PagedKVQ8, attention_core,
                             batch_decode_attention, causal_cache_mask,
-                            layer_view, paged_attention_q8,
+                            layer_view, mixed_attention, paged_attention_q8,
                             paged_cache_planes, paged_decode_attention,
                             rebuild_paged_cache, rope_rotate,
                             spec_verify_attention, split_layer_weights)
@@ -1116,6 +1116,114 @@ def make_sharded_verify(spec: TransformerSpec, mesh: Mesh, page_size: int,
         fn = _shard_map(local_step, mesh=mesh, in_specs=in_specs,
                         out_specs=out_specs)
         return fn(params, cache, tokens, pos, table)
+
+    return jax.jit(wrap, donate_argnums=1)
+
+
+def make_sharded_mixed(spec: TransformerSpec, mesh: Mesh, page_size: int,
+                       scheme: str | None = None,
+                       kv_quant: str = "f32"):
+    """Tensor-parallel token-budget MIXED dispatch (ISSUE 18):
+    make_sharded_verify's sibling for per-row ARBITRARY spans
+    (models/llama.forward_batch_mixed_paged semantics, per-shard over the
+    LOCAL kv heads) — all active decode rows (span 1) plus one prefill
+    slice (span up to the remaining budget) in ONE fused forward.
+
+    Returns fn(params, cache, tokens (B, T), pos (B,), span (B,),
+    table (B, S/ps)) -> (logits (B, T, vocab), cache). Works under all
+    three collective schemes: the B*T query rows ride the layer tail as a
+    flat activation batch, so the dispatch issues EXACTLY one decode
+    step's per-layer collective schedule (contract_mixed_collectives;
+    comm_stats.tp_collective_budget at t_len=budget) with T-times the
+    activation payload — per-collective launch latency, the dominant
+    multi-chip term, is paid once per token budget. sp > 1 is rejected as
+    in the paged decode factory.
+    """
+    n_slices = mesh.shape["tp"]
+    n_sp = mesh.shape.get("sp", 1)
+    if n_sp > 1:
+        raise ValueError(f"mixed dispatch requires sp=1, got sp={n_sp} "
+                         f"(page tables break contiguous sequence chunks)")
+    scheme = _effective_scheme(scheme, n_slices)
+    validate_sharding(spec, mesh, scheme)
+    validate_kv_quant(spec, n_slices, kv_quant)
+    if spec.seq_len % page_size:
+        raise ValueError(f"page_size={page_size} must divide "
+                         f"seq_len={spec.seq_len}")
+    L, hs = spec.n_layers, spec.head_size
+    overlap = scheme == "overlap"
+    q8 = kv_quant == "q8"
+    cache_spec = CACHE_SPEC_PAGED_Q8 if q8 else CACHE_SPEC_PAGED
+
+    def local_step(params, cache, tokens, pos, span, table):
+        B, T = tokens.shape
+        with jax.named_scope(SCOPE_EMBED):
+            x = params["tok_embedding"][
+                tokens.reshape(-1)].astype(jnp.float32)       # (B*T, d)
+        pos_b = jnp.broadcast_to(jnp.asarray(pos, jnp.int32), (B,))
+        span_b = jnp.broadcast_to(jnp.asarray(span, jnp.int32), (B,))
+        positions = (pos_b[:, None]
+                     + jnp.arange(T, dtype=jnp.int32)[None, :]).reshape(-1)
+        planes, n_pages = paged_cache_planes(cache)
+        stacked, scanned = split_layer_weights(params)
+
+        def body(carry, per_layer):
+            if overlap:
+                x, *kv, pending = carry
+            else:
+                (x, *kv), pending = carry, None
+            idx, lw_slice = per_layer
+            with jax.named_scope(SCOPE_LAYER):
+                if overlap:
+                    x = _consume_deferred(spec, x, pending, idx)
+                lw = layer_view(stacked, lw_slice, idx)
+                with jax.named_scope(SCOPE_ATTN):
+                    q, k, v = _tp_qkv(spec, n_slices, lw, x, positions)
+                    if q8:
+                        ao, *kv = paged_attention_q8(
+                            hs, spec.kv_mul, page_size, n_pages,
+                            q.reshape(B, T, -1), k.reshape(B, T, -1),
+                            v.reshape(B, T, -1), *kv, idx, pos_b, table,
+                            span=span_b)
+                    else:
+                        ao, *kv = mixed_attention(
+                            hs, spec.kv_mul, page_size, n_pages,
+                            q.reshape(B, T, -1), k.reshape(B, T, -1),
+                            v.reshape(B, T, -1), *kv, idx, pos_b, table,
+                            span_b)
+                if overlap:
+                    x, pending = _tp_tail(spec, x, lw,
+                                          ao.reshape(B * T, -1),
+                                          scheme=scheme, n_slices=n_slices)
+                    return (x, *kv, pending), None
+                x = _tp_tail(spec, x, lw, ao.reshape(B * T, -1),
+                             scheme=scheme)
+            return (x, *kv), None
+
+        idxs = jnp.arange(L, dtype=jnp.int32)
+        init = (x, *planes)
+        if overlap:
+            init += (_deferred_init(spec, B * T),)
+        carry, _ = jax.lax.scan(body, init, (idxs, scanned))
+        if overlap:
+            x, *kv, pending = carry
+            with jax.named_scope(SCOPE_FFN):
+                x = x + _wire_unpack(spec, pending)
+        else:
+            x, *kv = carry
+        with jax.named_scope(SCOPE_LOGITS):
+            x = rmsnorm(x, params["rms_final"])
+            logits = _gather(matmul(params["wcls"], x))       # (B*T, V)
+        return (logits.reshape(B, T, -1),
+                rebuild_paged_cache(tuple(kv), L))
+
+    def wrap(params, cache, tokens, pos, span, table):
+        in_specs = (param_specs(params, scheme), cache_spec, P(), P(),
+                    P(), P())
+        out_specs = (P(), cache_spec)
+        fn = _shard_map(local_step, mesh=mesh, in_specs=in_specs,
+                        out_specs=out_specs)
+        return fn(params, cache, tokens, pos, span, table)
 
     return jax.jit(wrap, donate_argnums=1)
 

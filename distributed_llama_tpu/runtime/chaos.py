@@ -81,6 +81,12 @@ class ChaosMonkey:
     # ledger — the zero-open-ledgers-after-drain check must flag the
     # orphans
     leak_ledger: bool = False
+    # token-budget scheduler (ISSUE 18): the mixed dispatch's prefill
+    # slice ignores the remaining budget and takes the whole staging
+    # width — sum(span) then exceeds the budget, the virtual clock
+    # charges the overrun as extra step time, and loadcheck's budget
+    # gate must exit 1 (the budget sweep's mutation arm)
+    overrun_budget: bool = False
     # injection counters (read by drills / surfaced in loadcheck rows)
     injected_delays: int = 0
     denied_allocs: int = 0
@@ -90,6 +96,7 @@ class ChaosMonkey:
     dropped_traceparents: int = 0
     double_counted: int = 0
     leaked_ledgers: int = 0
+    overran_budgets: int = 0
     _dispatches: int = 0
 
     def on_dispatch(self) -> None:
@@ -162,6 +169,16 @@ class ChaosMonkey:
             return True
         return False
 
+    def budget_overrun(self) -> bool:
+        """Mixed-dispatch hook per prefill-slice cut (ISSUE 18): True =
+        the slice ignores the remaining token budget and takes the whole
+        staging width — the seeded overrun the loadcheck budget gate's
+        virtual clock must catch as inflated decode latency."""
+        if self.overrun_budget:
+            self.overran_budgets += 1
+            return True
+        return False
+
     def injection_summary(self) -> dict:
         return {"dispatches": self._dispatches,
                 "injected_delays": self.injected_delays,
@@ -171,7 +188,8 @@ class ChaosMonkey:
                 "dropped_pages": self.dropped_pages,
                 "dropped_traceparents": self.dropped_traceparents,
                 "double_counted": self.double_counted,
-                "leaked_ledgers": self.leaked_ledgers}
+                "leaked_ledgers": self.leaked_ledgers,
+                "overran_budgets": self.overran_budgets}
 
     @classmethod
     def parse(cls, text: str) -> "ChaosMonkey":
@@ -192,7 +210,8 @@ class ChaosMonkey:
                 kw[key] = int(val)
             elif key in ("leak_on_cancel", "drop_on_demote",
                          "drop_page_in_flight", "drop_traceparent",
-                         "double_count_dispatch", "leak_ledger"):
+                         "double_count_dispatch", "leak_ledger",
+                         "overrun_budget"):
                 kw[key] = val.strip().lower() not in ("0", "false", "")
             else:
                 raise ValueError(
@@ -200,7 +219,7 @@ class ChaosMonkey:
                     f"step_delay_ms, deny_pages, leak_on_cancel, "
                     f"drop_on_demote, drop_page_in_flight, "
                     f"drop_traceparent, double_count_dispatch, "
-                    f"leak_ledger)")
+                    f"leak_ledger, overrun_budget)")
         return cls(**kw)
 
 

@@ -107,6 +107,31 @@ class Request:
     carried_cost: dict | None = None
 
 
+_PROGRAM_MEMO: dict = {}
+
+
+def _shared_program(key: tuple, build):
+    """Process-wide memo for the engine's jitted step programs.
+
+    Every engine build used to re-jit its own ``functools.partial`` /
+    sharded-builder closure, so two engines with EQUAL (spec, mesh,
+    scheme, page_size, kv_quant, ...) each paid a full XLA compile for
+    byte-identical programs — the dominant cost of multi-engine
+    processes (the disagg two-pool topology, loadgen sweeps, every
+    stream-parity test). Sharing the jitted callable itself is
+    deterministic by construction: callers get the SAME executable
+    object, not a deserialized copy, so bitwise pins only get stronger.
+    (jax's persistent disk cache is NOT a substitute — measured on the
+    test suite, deserialized executables are not always bit-identical
+    to fresh compiles of the same HLO.) Donation is per-call state, so
+    sharing across engines is safe; nothing here is ever evicted — keys
+    are bounded by the distinct engine configurations of the process."""
+    fn = _PROGRAM_MEMO.get(key)
+    if fn is None:
+        fn = _PROGRAM_MEMO[key] = build()
+    return fn
+
+
 def _maybe_bf16(fn, enable: bool, jax_mod, jit: bool = False):
     """Route a prefill forward through the shared fast-prefill wrapper
     (ops/linear.bf16_prefill) when enabled. Unlike Engine.prefill's T>8
@@ -211,8 +236,18 @@ class ContinuousStats:
     # admission-prefill forward passes executed (one per chunk window /
     # per-token tail dispatch): the virtual-clock cost term the two-pool
     # sweep charges prefill with (ISSUE 14) — without it a colocated
-    # engine's prefill interference would be invisible to the clock
+    # engine's prefill interference would be invisible to the clock.
+    # Counted at DISPATCH (inside the per-window fwd closure), so a chunk
+    # that parks at a boundary and resumes there is charged exactly once.
     prefill_chunks: int = 0
+    # token-budget mixed dispatches (ISSUE 18): virtual EXTRA device
+    # steps a dispatch would have cost had its total span honored the
+    # budget — ceil(sum(span) / budget) - 1 per dispatch, 0 in healthy
+    # runs. Nonzero only under the overrun-budget chaos mutation (the
+    # prefill slice ignores the remaining budget); the virtual clock
+    # charges it as real step time so loadcheck's gate catches the
+    # overrun as inflated decode latency.
+    overrun_steps: int = 0
 
     @property
     def tokens_per_s(self) -> float:
@@ -245,7 +280,8 @@ class ContinuousEngine:
                  fast_prefill: bool = False, metrics=None,
                  page_size: int = 0, kv_pages: int = 0,
                  prefix_share: bool = True, spec_k: int = 0,
-                 spec_ngram: int = 3, slo=None, chaos=None,
+                 spec_ngram: int = 3, dispatch_tokens: int = 0,
+                 slo=None, chaos=None,
                  journal=None, watchdog=None, kv_quant: str = "f32",
                  kv_host_pages: int = 0, kv_disk_dir: str | None = None,
                  kv_disk_bytes: int = 0, kv_tier_async: bool = True,
@@ -255,7 +291,8 @@ class ContinuousEngine:
         import jax
         import jax.numpy as jnp
 
-        from ..models.llama import (forward_batch_paged,
+        from ..models.llama import (forward_batch_mixed_paged,
+                                    forward_batch_paged,
                                     forward_batch_ragged,
                                     forward_batch_spec_paged, gather_pages,
                                     gather_pages_q8, init_cache_batch,
@@ -365,6 +402,40 @@ class ContinuousEngine:
             # input tokens per row ride ONE int32 upload per dispatch
             # (dlint D004), exactly like the chain's staged_i32 rows
             self._stage_spec = np.zeros((slots, spec_k), np.int32)
+        # token-budget mixed dispatches (ISSUE 18): every dispatch carries
+        # a fixed budget of ``dispatch_tokens`` query positions filled
+        # with all active decode rows (1 token each) plus ONE prefill
+        # slice cut to the remaining budget, in a single fused forward
+        # (models/llama.forward_batch_mixed_paged). -1 = auto: sized from
+        # the chunk knob — room for every slot's decode token plus a
+        # chunk-wide slice.
+        if dispatch_tokens == -1:
+            dispatch_tokens = slots - 1 + max(prefill_chunk, 2)
+        self.dispatch_tokens = dispatch_tokens
+        if dispatch_tokens:
+            if dispatch_tokens < 2:
+                raise ValueError(
+                    f"dispatch_tokens={dispatch_tokens}: the budget holds "
+                    f"decode rows plus a prefill slice, so it must be "
+                    f">= 2 (0 disables, -1 sizes from the chunk knob)")
+            if page_size <= 0:
+                raise ValueError(
+                    "dispatch_tokens requires the paged KV cache (pass "
+                    "--kv-page-size with --dispatch-tokens): the mixed "
+                    "window writes through per-row page tables")
+            if spec_k:
+                raise ValueError(
+                    "dispatch_tokens is incompatible with spec_k: the "
+                    "verify window and the prefill slice both claim the "
+                    "per-row span (unifying them is follow-up work)")
+            # persistent (slots, budget + 2) mixed staging block: per row
+            # [span, pos, token window...] — ONE int32 upload per dispatch
+            # (dlint D004); the jitted program splits device-side
+            self._stage_mixed = np.zeros((slots, dispatch_tokens + 2),
+                                         np.int32)
+            # rotating fairness cursor: when active decode rows exceed the
+            # budget, deferral rotates so no row starves (budget_wait)
+            self._mixed_rr = 0
         # multi-host SPMD runs MUST pin the numpy sampler: native and numpy
         # can differ by float ulps across libm builds (sampling.Sampler
         # docstring), and divergent hosts feed different tokens into the
@@ -394,6 +465,7 @@ class ContinuousEngine:
             from ..parallel import (make_sharded_forward,
                                     make_sharded_forward_batch,
                                     make_sharded_forward_batch_paged,
+                                    make_sharded_mixed,
                                     make_sharded_verify, shard_cache,
                                     shard_cache_batch, shard_cache_paged,
                                     shard_params, validate_sharding)
@@ -405,13 +477,26 @@ class ContinuousEngine:
             self.params = shard_params(params, mesh, scheme=scheme)
             if self._alloc is not None:
                 # +1 physical page: the reserved scrap page 0
-                self._step = make_sharded_forward_batch_paged(
-                    spec, mesh, page_size, scheme=scheme,
-                    kv_quant=kv_quant)  # rejects sp>1
-                if spec_k:
-                    self._verify_base = make_sharded_verify(
+                self._step = _shared_program(
+                    ("sh_step_paged", spec, mesh, page_size, scheme,
+                     kv_quant),
+                    lambda: make_sharded_forward_batch_paged(
                         spec, mesh, page_size, scheme=scheme,
-                        kv_quant=kv_quant)
+                        kv_quant=kv_quant))  # rejects sp>1
+                if spec_k:
+                    self._verify_base = _shared_program(
+                        ("sh_verify", spec, mesh, page_size, scheme,
+                         kv_quant),
+                        lambda: make_sharded_verify(
+                            spec, mesh, page_size, scheme=scheme,
+                            kv_quant=kv_quant))
+                if dispatch_tokens:
+                    self._mixed_base = _shared_program(
+                        ("sh_mixed", spec, mesh, page_size, scheme,
+                         kv_quant),
+                        lambda: make_sharded_mixed(
+                            spec, mesh, page_size, scheme=scheme,
+                            kv_quant=kv_quant))
                 self.cache = shard_cache_paged(
                     init_cache_paged_q8(spec, self._alloc.n_pages + 1,
                                         page_size)
@@ -421,14 +506,18 @@ class ContinuousEngine:
             else:
                 self.cache = shard_cache_batch(
                     init_cache_batch(spec, slots, dtype), mesh)
-                self._step = make_sharded_forward_batch(spec, mesh,
-                                                        scheme=scheme)
+                self._step = _shared_program(
+                    ("sh_step_batch", spec, mesh, scheme),
+                    lambda: make_sharded_forward_batch(spec, mesh,
+                                                       scheme=scheme))
             if prefill_chunk > 1:
                 # admission prefill: the sharded single-sequence forward
                 # (T=chunk under sp/tp) fills a sharded scratch cache
-                self._prefill_fwd = _maybe_bf16(
-                    make_sharded_forward(spec, mesh, scheme=scheme),
-                    fast_prefill, jax)
+                self._prefill_fwd = _shared_program(
+                    ("sh_prefill", spec, mesh, scheme, fast_prefill),
+                    lambda: _maybe_bf16(
+                        make_sharded_forward(spec, mesh, scheme=scheme),
+                        fast_prefill, jax))
                 self._scratch_cache = lambda: shard_cache(
                     init_cache(spec, dtype), mesh)
         else:
@@ -440,31 +529,49 @@ class ContinuousEngine:
                     if kv_quant == "q8" else
                     init_cache_paged(spec, self._alloc.n_pages + 1,
                                      page_size, dtype))
-                self._step = jax.jit(
-                    functools.partial(forward_batch_paged, spec, page_size,
-                                      kv_quant=kv_quant),
-                    donate_argnums=1)
-                if spec_k:
-                    self._verify_base = jax.jit(
-                        functools.partial(forward_batch_spec_paged, spec,
+                self._step = _shared_program(
+                    ("step_paged", spec, page_size, kv_quant),
+                    lambda: jax.jit(
+                        functools.partial(forward_batch_paged, spec,
                                           page_size, kv_quant=kv_quant),
-                        donate_argnums=1)
+                        donate_argnums=1))
+                if spec_k:
+                    self._verify_base = _shared_program(
+                        ("verify", spec, page_size, kv_quant),
+                        lambda: jax.jit(
+                            functools.partial(forward_batch_spec_paged,
+                                              spec, page_size,
+                                              kv_quant=kv_quant),
+                            donate_argnums=1))
+                if dispatch_tokens:
+                    self._mixed_base = _shared_program(
+                        ("mixed", spec, page_size, kv_quant),
+                        lambda: jax.jit(
+                            functools.partial(forward_batch_mixed_paged,
+                                              spec, page_size,
+                                              kv_quant=kv_quant),
+                            donate_argnums=1))
             else:
                 self.cache = init_cache_batch(spec, slots, dtype)
-                self._step = jax.jit(
-                    functools.partial(forward_batch_ragged, spec),
-                    donate_argnums=1)
+                self._step = _shared_program(
+                    ("step_ragged", spec),
+                    lambda: jax.jit(
+                        functools.partial(forward_batch_ragged, spec),
+                        donate_argnums=1))
             if prefill_chunk > 1:
                 # admission prefill: single-sequence T=chunk forward into a
                 # scratch cache + plane insert
-                self._prefill_fwd = _maybe_bf16(
-                    functools.partial(forward, spec), fast_prefill, jax,
-                    jit=True)
+                self._prefill_fwd = _shared_program(
+                    ("prefill", spec, fast_prefill),
+                    lambda: _maybe_bf16(
+                        functools.partial(forward, spec), fast_prefill,
+                        jax, jit=True))
                 self._scratch_cache = lambda: init_cache(spec, dtype)
         if prefill_chunk > 1:
             # donate only the batched cache (updated in place); the scratch
             # sequence cache can't alias the rank-5 output
-            self._insert = jax.jit(_insert, donate_argnums=0)
+            self._insert = _shared_program(
+                ("insert",), lambda: jax.jit(_insert, donate_argnums=0))
             if self._alloc is not None:
                 # paged prefill plumbing: gather the slot's pages into a
                 # virtual contiguous sequence cache (shared prefix k/v
@@ -478,11 +585,15 @@ class ContinuousEngine:
                 gp = gather_pages_q8 if kv_quant == "q8" else gather_pages
                 sp_ = (scatter_pages_q8 if kv_quant == "q8"
                        else scatter_pages)
-                self._gather_pages = jax.jit(
-                    lambda c, t, gp=gp: gp(c, t, page_size))
-                self._scatter_pages = jax.jit(
-                    lambda c, s, t, sp_=sp_: sp_(c, s, t, page_size),
-                    donate_argnums=0)
+                self._gather_pages = _shared_program(
+                    ("gather", kv_quant, page_size),
+                    lambda: jax.jit(lambda c, t, gp=gp: gp(c, t,
+                                                           page_size)))
+                self._scatter_pages = _shared_program(
+                    ("scatter", kv_quant, page_size),
+                    lambda: jax.jit(
+                        lambda c, s, t, sp_=sp_: sp_(c, s, t, page_size),
+                        donate_argnums=0))
         # KV tiering (ISSUE 12): bind the allocator's device I/O — the
         # demotion read (pool page planes -> host numpy, models/llama.
         # fetch_page_planes), the promotion stage (host payload ->
@@ -525,7 +636,9 @@ class ContinuousEngine:
                 self._alloc.bind_device_io(None, stage=stage)
             if remote_pages:
                 self._alloc.remote = True
-            self._tier_write = jax.jit(write_page_planes, donate_argnums=0)
+            self._tier_write = _shared_program(
+                ("tier_write",),
+                lambda: jax.jit(write_page_planes, donate_argnums=0))
         # write-ahead request journal (runtime/journal.py, ISSUE 9): every
         # submit/sampled-token/retire appends a record; recover() replays
         # incomplete requests after a crash. None = zero overhead, like
@@ -737,7 +850,12 @@ class ContinuousEngine:
                 body, (tokens, pos, active, cache), (forced, coins))
             return cache, toks, acts                       # ys: (K, B)
 
-        self._chains[key] = jax.jit(chain, donate_argnums=1)
+        # keyed on the step program OBJECT (identity): equal-config
+        # engines share a memoized step, so their chains collapse to one
+        # compile; a patched step (chaos proxies) gets its own chain
+        self._chains[key] = _shared_program(
+            ("chain", step, k, greedy_only, paged),
+            lambda: jax.jit(chain, donate_argnums=1))
         return self._chains[key]
 
     # -- speculative decoding (spec_k > 0) ----------------------------------
@@ -767,8 +885,184 @@ class ContinuousEngine:
             out = greedy_verify_tokens(logits) if greedy_only else logits
             return out, cache
 
-        self._chains[key] = jax.jit(run, donate_argnums=1)
+        self._chains[key] = _shared_program(
+            ("verify_prog", base, greedy_only),
+            lambda: jax.jit(run, donate_argnums=1))
         return self._chains[key]
+
+    def _mixed_program(self, greedy_only: bool):
+        """The jitted token-budget mixed dispatch (built once per
+        variant). The staged (slots, budget + 2) block splits DEVICE-side
+        into [span | pos | token window] so the host ships ONE int32
+        upload per dispatch (dlint D004, _verify_program's transfer
+        shape). All-greedy pools argmax on device and ship a (B, T) int32
+        block instead of the f32 logit cube (decode.greedy_verify_tokens
+        — the same cut as the verify program); sampled pools ship full
+        logits for the host Sampler's exact semantics."""
+        import jax
+
+        key = ("mixed", greedy_only)
+        if key in self._chains:
+            return self._chains[key]
+        if self._obs is not None:  # mixed-shape cache miss: a new trace
+            self._obs.compile_events.inc()
+        base = self._mixed_base
+
+        from .decode import greedy_verify_tokens
+
+        def run(params, cache, blk, table):
+            span, pos, tokens = blk[:, 0], blk[:, 1], blk[:, 2:]
+            logits, cache = base(params, cache, tokens, pos, span, table)
+            out = greedy_verify_tokens(logits) if greedy_only else logits
+            return out, cache
+
+        self._chains[key] = _shared_program(
+            ("mixed_prog", base, greedy_only),
+            lambda: jax.jit(run, donate_argnums=1))
+        return self._chains[key]
+
+    def step_mixed(self, quiet: bool = True) -> int:
+        """One token-budget mixed dispatch over the pool (ISSUE 18):
+        every active decode row contributes its 1 pending token and ONE
+        row with forced prompt tokens left (the prefill slice — the
+        best-SLO-ranked such row, FIFO within a class) contributes up to
+        the remaining budget, all in a single fused forward
+        (forward_batch_mixed_paged). Prefill therefore never stalls
+        in-flight decodes behind a separate chunk dispatch, and PR 14's
+        chunk-boundary preemption collapses into slice selection: a
+        higher-priority arrival simply wins the next dispatch's slice
+        (no parked-slot bookkeeping on this path — _maybe_prefill_slot
+        is gated off entirely).
+
+        When active decode rows exceed the budget, the overflow rides
+        this dispatch deferred (span 0, masked junk, ledger/census cause
+        ``budget_wait``) under a rotating fairness cursor. The host
+        replay applies exactly step_once's per-token bookkeeping (forced
+        pops, sampler/argmax, BOS + budget stops via _advance), and
+        window construction guarantees a row's sampler is consulted only
+        at its LAST window position (span <= 1 + len(forced)), so the
+        emitted stream is token-for-token the separate-dispatch engine's
+        (greedy and seeded-sampled — the tests/test_mixed_batch.py
+        parity gates). Returns active slots after the iteration."""
+        jnp = self.jnp
+        T = self.dispatch_tokens
+        self._drain_remote_inbox()
+        self._sweep_cancelled()
+        self._admit()
+        self._settle_promotions(quiet)
+        pool = self._pool
+        # span assignment BEFORE page growth: every candidate decode row
+        # wants 1 position; the slice row wants its span. Deferral
+        # (budget_wait) happens here too — a deferred row needs no pages.
+        candidates = [b for b, s in enumerate(pool) if not s.free]
+        spans: dict[int, int] = {}
+        deferred: set = set()
+        if len(candidates) > T:
+            order = sorted(candidates,
+                           key=lambda b: (b - self._mixed_rr) % self.slots)
+            deferred = set(order[T:])
+            self._mixed_rr = (self._mixed_rr + T) % self.slots
+            for b in order[:T]:
+                spans[b] = 1
+        else:
+            for b in candidates:
+                spans[b] = 1
+            room = T - len(candidates)
+            # ONE prefill slice: among rows with forced tokens pending,
+            # the best SLO rank wins (FIFO within a class) — arrival
+            # priority replaces the parked-slot preemption machinery
+            slice_rows = [b for b in candidates if pool[b].forced]
+            if room > 0 and slice_rows:
+                rank = self._prio or (lambda cls: 0)
+                win = min(slice_rows,
+                          key=lambda b: (rank(pool[b].req.slo_class),
+                                         pool[b].req.index))
+                s = pool[win]
+                extra = min(len(s.forced), room)
+                if (self._chaos is not None
+                        and self._chaos.budget_overrun()):
+                    # mutation arm: the slice ignores the remaining
+                    # budget and takes the whole staging width
+                    extra = min(len(s.forced), T - 1)
+                spans[win] = 1 + extra
+        paused = self._grow_pages(pool, 1, quiet, spans=spans)
+        if all(s.free for s in pool):
+            self._journal_sync()  # cover sweep/admit records this iteration
+            return self._n_outstanding()
+        blk = self._stage_mixed
+        greedy_only = True
+        for b, s in enumerate(pool):
+            span = 0 if (s.free or b in paused or b in deferred) \
+                else spans.get(b, 0)
+            spans[b] = span
+            blk[b, 0] = span
+            blk[b, 1] = s.pos
+            blk[b, 2:] = 0
+            if span <= 0:
+                continue
+            if s.sampler.temperature != 0.0:
+                greedy_only = False
+            blk[b, 2] = s.token
+            for i, t in enumerate(s.forced[:span - 1]):
+                blk[b, 3 + i] = t
+        n_active0 = sum(1 for v in spans.values() if v > 0)
+        total_span = sum(spans.values())
+        # virtual overrun charge: a healthy dispatch fits the budget
+        # (sum(span) <= T); the overrun-budget mutation does not, and the
+        # virtual clock must see the extra device time it would cost
+        self.stats.overrun_steps += max(0, -(-total_span // T) - 1)
+        table = self._stage_tables()
+        run = self._mixed_program(greedy_only)
+        t0 = time.monotonic()  # census/ledger wall charges need it even
+        #                        when the engine runs metrics-dark
+        with self._span("mixed", "decode", budget=T, tokens=total_span,
+                        active=n_active0), self._watch():
+            if self._chaos is not None:
+                self._chaos.on_dispatch()  # inside the armed window (the
+                #   injected stall IS the hang the watchdog must detect)
+            out, cache = run(self.params, self.cache, jnp.asarray(blk),
+                             table)
+            self.cache = cache
+            out = np.asarray(out)  # dlint: allow[D001] host replay reads ids/logits
+            if self._obs is not None:
+                # the sync flag additionally drains the donated cache
+                # write (obs/trace.sync_device_timing)
+                if self._obs.sync:
+                    import jax
+
+                    jax.block_until_ready(self.cache)  # dlint: allow[D001] opt-in timing drain
+                self._obs.record_step(time.monotonic() - t0, n_active0)
+                if self._alloc is not None:
+                    self._obs.kv_pages_free.set(self._alloc.n_free)
+        self.stats.steps += 1
+        self.stats.sum_active += n_active0
+        self.stats.max_active = max(self.stats.max_active, n_active0)
+        self._census_dispatch("mixed", 1, paused, n_active0,
+                              time.monotonic() - t0, deferred=deferred)
+        # host replay: exactly step_once's per-token bookkeeping over each
+        # row's live window (forced pops first; the sampler is consulted
+        # only at the last position, where the fed inputs ran out)
+        for b, s in enumerate(pool):
+            if s.free:
+                continue
+            if s.req.cancelled:  # consumer vanished during the dispatch
+                self._retire(s, quiet)
+                continue
+            span = spans.get(b, 0)
+            if span <= 0:
+                continue
+            for i in range(span):
+                if s.forced:
+                    nxt, sampled = s.forced.pop(0), False
+                elif greedy_only:
+                    nxt, sampled = int(out[b, i]), True
+                else:
+                    nxt, sampled = int(s.sampler.sample(out[b, i])), True
+                if self._advance(s, nxt, quiet, sampled=sampled):
+                    break
+        self._admit()
+        self._journal_sync()
+        return self._n_outstanding()
 
     def step_spec(self, quiet: bool = True) -> int:
         """One draft → verify → accept iteration over the pool (ISSUE 7).
@@ -1014,10 +1308,13 @@ class ContinuousEngine:
             s.pages.append(pid)
         return True
 
-    def _grow_pages(self, pool, k: int, quiet: bool) -> set:
+    def _grow_pages(self, pool, k: int, quiet: bool,
+                    spans: dict | None = None) -> set:
         """Pre-chain page coverage: every active slot gets pages for the
         next ``k`` positions (ONE host round per chain — mid-chain writes
-        can then never cross into an unmapped page). A slot the pool
+        can then never cross into an unmapped page). ``spans`` (the mixed
+        path) overrides k per slot — a deferred row (span 0) needs no new
+        pages this dispatch. A slot the pool
         cannot serve yet is PAUSED for this chain (returned in the paused
         set): it rides through the device step masked inactive — its dead
         rewrite lands on the scrap page, its replay is skipped, and its
@@ -1053,7 +1350,8 @@ class ContinuousEngine:
                     # Self-resolving, so the deadlock breaker skips it.
                     promo.add(b)
                     continue
-                if not self._ensure_pages(s, min(s.pos + k, s.budget)):
+                need = k if spans is None else spans.get(b, 0)
+                if not self._ensure_pages(s, min(s.pos + need, s.budget)):
                     paused.add(b)
             if promo or not paused or len(paused) < active:
                 if paused or promo:
@@ -1100,6 +1398,11 @@ class ContinuousEngine:
         shipped configs, but an XLA or libm change could flip a
         knife-edge coin. temperature == 0 (argmax) is exact by
         construction."""
+        if self.dispatch_tokens:
+            # token-budget mode (ISSUE 18): every scheduler iteration IS
+            # a mixed dispatch (decode rows + one prefill slice under one
+            # budget), superseding both per-step and block-step chaining
+            return self.step_mixed(quiet=quiet)
         if self.spec_k:
             # speculative mode: every scheduler iteration IS a fused
             # multi-position dispatch (draft → one K-query verify), so the
@@ -1234,7 +1537,7 @@ class ContinuousEngine:
     # -- cost accounting (ISSUE 16) -----------------------------------------
 
     def _census_dispatch(self, kind: str, k: int, paused, active: int,
-                         dt_s: float) -> None:
+                         dt_s: float, deferred=()) -> None:
         """Charge BOTH accounting halves from one pool walk after a
         decode/spec dispatch: per-slot ledger charges (row steps, page
         steps, stalls by cause, pro-rated ICI bytes) and the whole-
@@ -1277,6 +1580,13 @@ class ContinuousEngine:
                 parked[cause] = parked.get(cause, 0) + 1
                 if led is not None:
                     led.charge_stall(cause, k, dt_s, reps)
+            elif b in deferred:
+                # mixed path (ISSUE 18): more active rows than the token
+                # budget holds — this row rode the dispatch deferred
+                # (span 0) and retries under the rotating cursor
+                parked["budget_wait"] = parked.get("budget_wait", 0) + 1
+                if led is not None:
+                    led.charge_stall("budget_wait", k, dt_s, reps)
             elif led is not None:
                 led.charge_rows(k, dt_share, reps)
                 if self._ici_row_bytes:
@@ -1886,6 +2196,12 @@ class ContinuousEngine:
         n_pre = len(tokens) - 1
         start = s.pos  # 0, the page-aligned prefix-share boundary, or a
         #                preemption park point (s.prefill_pending resume)
+        if self.dispatch_tokens:
+            # token-budget mode (ISSUE 18): the prompt rides mixed
+            # dispatches as the per-dispatch prefill slice (step_mixed) —
+            # no separate chunk dispatches, no parked-slot bookkeeping
+            s.prefill_pending = False
+            return
         if (getattr(self, "_prefill_fwd", None) is None or chunk <= 1
                 or n_pre - start < 2 or n_pre >= s.budget
                 or BOS in tokens[1:]):
@@ -2170,6 +2486,7 @@ def generate_continuous(spec: TransformerSpec, params: dict[str, Any],
                         fast_prefill: bool = False, metrics=None,
                         page_size: int = 0, kv_pages: int = 0,
                         spec_k: int = 0, spec_ngram: int = 3,
+                        dispatch_tokens: int = 0,
                         kv_quant: str = "f32", kv_host_pages: int = 0,
                         kv_disk_dir: str | None = None,
                         kv_disk_bytes: int = 0):
@@ -2185,6 +2502,7 @@ def generate_continuous(spec: TransformerSpec, params: dict[str, Any],
                            fast_prefill=fast_prefill, metrics=metrics,
                            page_size=page_size, kv_pages=kv_pages,
                            spec_k=spec_k, spec_ngram=spec_ngram,
+                           dispatch_tokens=dispatch_tokens,
                            kv_quant=kv_quant, kv_host_pages=kv_host_pages,
                            kv_disk_dir=kv_disk_dir,
                            kv_disk_bytes=kv_disk_bytes)
@@ -2214,6 +2532,9 @@ def generate_continuous(spec: TransformerSpec, params: dict[str, Any],
                       f"{sum(a.promotions.values())} promotions; "
                       f"{saved['host'] + saved['disk']} prefill tokens "
                       f"rescued from spilled tiers")
+        if eng.dispatch_tokens:
+            print(f"Token budget:        {eng.dispatch_tokens} "
+                  f"tokens/dispatch over {stats.steps} mixed dispatches")
         if eng.spec_k:
             print(f"Speculative:         K={eng.spec_k}, "
                   f"{stats.spec_accepted}/{stats.spec_proposed} drafts "

@@ -89,7 +89,8 @@ class InferenceServer:
                  block_steps: int = 1, quiet: bool = False,
                  fast_prefill: bool = False, metrics: bool = True,
                  registry=None, page_size: int = 0, kv_pages: int = 0,
-                 spec_k: int = 0, spec_ngram: int = 3, slo=None,
+                 spec_k: int = 0, spec_ngram: int = 3,
+                 dispatch_tokens: int = 0, slo=None,
                  chaos=None, journal=None, watchdog_s: float = 0.0,
                  drain_s: float = 10.0, kv_quant: str = "f32",
                  kv_host_pages: int = 0, kv_disk_dir: str | None = None,
@@ -168,7 +169,9 @@ class InferenceServer:
                                        metrics=self.registry,
                                        page_size=page_size,
                                        kv_pages=kv_pages, spec_k=spec_k,
-                                       spec_ngram=spec_ngram, slo=slo,
+                                       spec_ngram=spec_ngram,
+                                       dispatch_tokens=dispatch_tokens,
+                                       slo=slo,
                                        chaos=chaos, journal=journal,
                                        watchdog=self._watchdog,
                                        kv_quant=kv_quant,

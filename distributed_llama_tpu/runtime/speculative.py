@@ -35,6 +35,18 @@ Losslessness contract (the tier-1 gate of tests/test_speculative.py):
 Everything here is host-side numpy over one row's logits — the device half
 is the K-query verify forward; the engine half (draft window assembly,
 replay, page-table rollback) lives in runtime/continuous.step_spec.
+
+Interaction with token-budget scheduling (ISSUE 18): ``--spec-k`` and
+``--dispatch-tokens`` are MUTUALLY EXCLUSIVE, rejected at argparse time
+(exit 2) and again in ContinuousEngine.__init__. Both features spend the
+same resource — the per-row span of the fused dispatch window. Speculative
+decoding fills each row's extra columns with draft guesses to verify;
+mixed batching gives every decode row span 1 and spends the remainder on
+one prefill slice. A combined mode would have to arbitrate the window
+between drafts and the slice per dispatch; until someone builds that,
+pick one: --spec-k when decode latency dominates (collective-floor
+amortization), --dispatch-tokens when prefill/decode interference
+dominates (attainment under mixed load, tools/loadcheck.py --budget).
 """
 
 from __future__ import annotations

@@ -273,6 +273,45 @@ def test_d004_quiet_on_persistent_spec_staging_block(tmp_path):
     assert [f for f in findings if f.rule == "D004"] == []
 
 
+def test_d004_fires_on_mixed_dispatch_list_comps(tmp_path):
+    """ISSUE 18: a token-budget scheduler that boxes each mixed row's
+    token window, start position and span into fresh Python lists fed to
+    jnp.asarray inside the step loop is exactly the D004 hazard — three
+    list uploads per mixed dispatch."""
+    findings = run_on(tmp_path, "runtime/mixed.py", """
+        import jax.numpy as jnp
+
+        class Engine:
+            def step_mixed(self, rows):
+                toks = jnp.asarray([r.window for r in rows])
+                pos = jnp.asarray([r.pos for r in rows])
+                span = jnp.asarray([r.span for r in rows])
+                return toks, pos, span
+    """)
+    d004 = [f for f in findings if f.rule == "D004"]
+    assert len(d004) == 3, findings
+
+
+def test_d004_quiet_on_persistent_mixed_staging_block(tmp_path):
+    """The shipped pattern (continuous.step_mixed): per-row windows,
+    positions and spans written into the persistent int32 staging block,
+    ONE ndarray upload per mixed dispatch — no finding."""
+    findings = run_on(tmp_path, "runtime/mixed.py", """
+        import numpy as np
+        import jax.numpy as jnp
+
+        class Engine:
+            def step_mixed(self, rows):
+                st = self._stage_mixed
+                for b, r in enumerate(rows):
+                    n = len(r.window)
+                    st[b, :n] = r.window
+                    st[b, n:] = 0
+                return jnp.asarray(st)
+    """)
+    assert [f for f in findings if f.rule == "D004"] == []
+
+
 def test_d004_quiet_on_persistent_page_table_staging(tmp_path):
     """The shipped pattern (continuous._stage_tables): rows written into
     one persistent numpy block, ONE ndarray upload per step — no finding."""

@@ -301,6 +301,42 @@ def test_cli_batch_prompts_file(model_files, tmp_path, capsys):
                  "4", "--prompts-file", str(pf)]) == 2
 
 
+def test_cli_dispatch_tokens_validates_at_argparse_time(model_files,
+                                                        tmp_path, capsys):
+    """ISSUE 18: --dispatch-tokens fails BEFORE the model load when
+    paired with --spec-k (both widen the per-row span; the engine prices
+    ONE dispatch shape) or used without --kv-page-size (mixed spans need
+    the paged pool), on BOTH inference and serve."""
+    from distributed_llama_tpu.frontend.cli import main
+
+    model, tokp = model_files
+    empty = tmp_path / "prompts.txt"
+    empty.write_text("")
+    assert main(["inference", "--model", model, "--tokenizer", tokp,
+                 "--prompts-file", str(empty), "--continuous",
+                 "--kv-page-size", "4", "--spec-k", "4",
+                 "--dispatch-tokens", "16"]) == 2
+    assert "--spec-k" in capsys.readouterr().err
+    assert main(["serve", "--model", model, "--tokenizer", tokp,
+                 "--kv-page-size", "4", "--spec-k", "4",
+                 "--dispatch-tokens", "16"]) == 2
+    assert "--spec-k" in capsys.readouterr().err
+    assert main(["inference", "--model", model, "--tokenizer", tokp,
+                 "--prompts-file", str(empty), "--continuous",
+                 "--dispatch-tokens", "16"]) == 2
+    assert "--kv-page-size" in capsys.readouterr().err
+    assert main(["serve", "--model", model, "--tokenizer", tokp,
+                 "--dispatch-tokens", "16"]) == 2
+    assert "--kv-page-size" in capsys.readouterr().err
+    # the valid pairing proceeds past the gate and fails later, on the
+    # empty prompts file — proving the gate ran (and passed) first
+    rc = main(["inference", "--model", model, "--tokenizer", tokp,
+               "--prompts-file", str(empty), "--continuous",
+               "--dispatch-tokens", "16", "--kv-page-size", "4"])
+    err = capsys.readouterr().err
+    assert rc == 2 and "empty" in err and "--kv-page-size" not in err
+
+
 def test_cli_disagg_flags_validate_at_argparse_time(model_files, capsys):
     """ISSUE 14: the disaggregation knobs fail BEFORE the model load —
     role without --kv-page-size, decode without a peer, a peer without

@@ -60,18 +60,20 @@ def test_decode_step_shape_stability_holds():
 
 def test_run_contracts_reports_all_and_passes():
     results = run_contracts(_spec())
-    # J001 runs once per scheme (ref + fused + overlap) for BOTH the
-    # decode forward and the speculative K-query verify dispatch
-    # (ISSUE 7/10), J002 once per cache layout (contiguous + paged) —
-    # every schedule/layout stays pinned
-    assert [r.contract for r in results] == ["J001"] * 6 + ["J002",
+    # J001 runs once per scheme (ref + fused + overlap) for the decode
+    # forward, the speculative K-query verify dispatch (ISSUE 7/10), AND
+    # the token-budget mixed dispatch (ISSUE 18), J002 once per cache
+    # layout (contiguous + paged) — every schedule/layout stays pinned
+    assert [r.contract for r in results] == ["J001"] * 9 + ["J002",
                                                             "J002",
                                                             "J003"]
     assert {r.name for r in results if r.contract == "J001"} == {
         "tp_collectives[ref]", "tp_collectives[fused]",
         "tp_collectives[overlap]",
         "verify_collectives[ref]", "verify_collectives[fused]",
-        "verify_collectives[overlap]"}
+        "verify_collectives[overlap]",
+        "mixed_collectives[ref]", "mixed_collectives[fused]",
+        "mixed_collectives[overlap]"}
     assert all(r.ok for r in results), [r.detail for r in results]
 
 
@@ -84,7 +86,7 @@ def test_contract_failure_becomes_finding_not_crash():
     assert any(not r.ok for r in results)
     # even on a raised error, results keep the documented J-ids (the CLI
     # and contract_findings key on them)
-    assert [r.contract for r in results] == ["J001"] * 6 + ["J002",
+    assert [r.contract for r in results] == ["J001"] * 9 + ["J002",
                                                             "J002",
                                                             "J003"]
 

@@ -127,7 +127,7 @@ def test_class_rollup_recomputes_ratios_from_sums():
 def test_stall_causes_cover_the_scheduler_parks():
     assert set(STALL_CAUSES) == {"pool_dry", "promo_pending",
                                  "prefill_hold", "queue_wait",
-                                 "handoff_wait"}
+                                 "handoff_wait", "budget_wait"}
 
 
 # -------------------------------------- conservation under chaos drills

@@ -203,6 +203,28 @@ if [ "$disagg_rc" -ne 1 ]; then
          "(exit $disagg_rc, expected 1)" >&2
     exit 1
 fi
+# Token-budget scheduling gate (ISSUE 18): the virtual-clock budget
+# comparison must show colocated engines with --dispatch-tokens closing
+# the prefill-interference gap — best budget point reaching interactive
+# attainment >= 0.90 at equal simulated hardware WITHOUT losing goodput
+# to the separate-dispatch colocated baseline (the fingerprinted row is
+# archived next to the two-pool one)
+python tools/loadcheck.py --budget 8,12,16 --sweep-only --json \
+    > tools/ci_artifacts/budget_sweep.json
+# ... and the budget must be LOAD-BEARING: with overrun-budget armed
+# (mixed prefill slices packed past the token budget), the overrun gate
+# must exit 1 EXACTLY — 2 is a usage error and would pass a naive
+# non-zero check vacuously
+set +e
+python tools/loadcheck.py --budget 8,12,16 --sweep-only \
+    --inject overrun-budget --json > /dev/null 2>&1
+budget_rc=$?
+set -e
+if [ "$budget_rc" -ne 1 ]; then
+    echo "ci: loadcheck did not flag the overrun token budget" \
+         "(exit $budget_rc, expected 1)" >&2
+    exit 1
+fi
 # Distributed-tracing gate (ISSUE 15): the two-pool tracejoin drill —
 # real DisaggPair over the TCP page channel — must stitch both pools'
 # NDJSON exports into ONE valid Chrome trace (zero orphans, the handoff
@@ -246,7 +268,8 @@ fi
 # Accounting-plane gate (ISSUE 16): the request-ledger vs scheduler-
 # census conservation equalities must hold EXACTLY on the virtual clock
 # across every leg — healthy, speculative, cancel storm, kill-mid-decode
-# recovery, and the two-pool handoff seam (the fingerprinted row with
+# recovery, the token-budget mixed engine (kind=mixed census rows,
+# zero overruns), and the two-pool handoff seam (the fingerprinted row with
 # per-class cost-per-token is archived next to the others)
 python tools/costcheck.py --json > tools/ci_artifacts/costcheck.json
 # ... and the gate must still CATCH cooked books: with the seeded

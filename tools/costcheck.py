@@ -27,6 +27,11 @@ Legs (each a fresh engine, loadcheck's synthetic-weight config):
   drained) and recovers into a fresh engine on the same journal: every
   re-admitted life opens exactly one new ledger, carries the journaled
   bill, and the recovered engine's books balance after drain.
+* ``mixed``    — token-budget scheduling on (dispatch_tokens=8, ISSUE
+  18): every dispatch is a ``kind="mixed"`` census row carrying decode
+  rows + one prefill slice; the SAME equalities must hold (mixed decode
+  rows bill as row-steps, slice tokens as prefill tokens, deferred rows
+  as budget_wait stalls), and zero budget overruns.
 * ``disagg``   — the two-pool handoff (runtime/disagg.py): per-engine
   conservation on the prefill pool, and the CROSS-SEAM equality on the
   decode pool — its ledgers fold the carried prefill-side bills, so
@@ -48,7 +53,7 @@ joinable with loadcheck/fleetcheck rows. Exit 0 = every leg conserves;
 Usage:
   python tools/costcheck.py [--seed N] [--requests N] [--rate R]
       [--slots N] [--page-size P] [--kv-pages N] [--block-steps K]
-      [--legs healthy,spec,cancel,recovery,disagg]
+      [--legs healthy,spec,cancel,recovery,disagg,mixed]
       [--inject double-count-dispatch|leak-ledger] [--json]
 """
 
@@ -63,7 +68,7 @@ import tempfile
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-LEGS = ("healthy", "spec", "cancel", "recovery", "disagg")
+LEGS = ("healthy", "spec", "cancel", "recovery", "disagg", "mixed")
 
 # the integer fields a carried (cross-seam) bill offsets in the decode-
 # side comparison — the float wall-clock fields are never gated (they
@@ -153,6 +158,34 @@ def leg_healthy(args, make_engine, inject=None,
                      "— the leg gates nothing")
     return {"engine": eng, "totals": eng.ledger_book.grand_totals(),
             "by_class": eng.ledger_book.class_rollup()}, fails
+
+
+def leg_mixed(args, make_engine) -> tuple[dict, list[str]]:
+    """Token-budget engine (ISSUE 18): same replay, every dispatch a
+    kind="mixed" census row. Conservation is the point — mixed decode
+    rows bill as plain row-steps, the piggybacked slice's tokens as
+    prefill tokens, budget-deferred rows as budget_wait stalls — plus
+    the budget's own invariant: zero overrun steps."""
+    from loadcheck import _load_spec, _policy
+    from loadgen import drive_engine, generate_trace
+
+    trace = generate_trace(_load_spec(args.rate, args), args.seed)
+    eng = make_engine(dispatch_tokens=8)
+    drive_engine(eng, trace, _policy())
+    fails = _conservation_failures("mixed", eng,
+                                   expect_requests=len(trace.events))
+    mixed_rows = sum(1 for e in eng.sched_census.tail(10_000)
+                     if e["kind"] == "mixed")
+    if mixed_rows == 0:
+        fails.append("mixed: zero kind=mixed census rows — the engine "
+                     "never took the token-budget path; the leg gates "
+                     "nothing")
+    if eng.stats.overrun_steps:
+        fails.append(f"mixed: {eng.stats.overrun_steps} overrun step(s) "
+                     f"on a healthy replay — the scheduler packed past "
+                     f"its own budget")
+    return {"mixed_dispatches": mixed_rows,
+            "overrun_steps": eng.stats.overrun_steps}, fails
 
 
 def leg_cancel(args, make_engine) -> tuple[dict, list[str]]:
@@ -356,6 +389,8 @@ def main(argv=None) -> int:
                 row, fails = leg_cancel(args, make_engine)
             elif name == "recovery":
                 row, fails = leg_recovery(args, make_engine, tmpdir)
+            elif name == "mixed":
+                row, fails = leg_mixed(args, make_engine)
             else:
                 row, fails = leg_disagg(args, make_engine)
             leg_rows[name] = {"verdict": "RED" if fails else "OK",

@@ -230,6 +230,108 @@ def run_two_pool(args, make_engine) -> tuple[dict, list[str]]:
     return row, failures
 
 
+def run_budget(args, make_engine) -> tuple[dict, list[str]]:
+    """Token-budget colocated vs separate-dispatch colocated at EQUAL
+    simulated hardware (ISSUE 18): the two-pool comparison's mixed trace
+    replayed against (a) two plain colocated engines (decode dispatches
+    + chunk-prefill dispatches — every 28-position batch admission
+    freezes in-flight decodes for 7 chunk dispatches) and (b) the same
+    two engines with ``dispatch_tokens=budget``: every dispatch carries
+    all active decode rows plus one prefill slice cut to the remaining
+    budget, so prefill rides the dispatches decode was already paying
+    for. Same virtual cost model (1 fused dispatch = 1 step, 1 chunk
+    dispatch = 1 step, budget overruns charge their extra step
+    equivalents — loadgen.drive_pools). The gate: the best budget point
+    must close most of the interference gap — interactive attainment
+    >= 0.90 — WITHOUT giving up goodput vs the separate-dispatch
+    baseline. ``--inject overrun-budget`` arms the chaos mutation that
+    packs slices past the budget; overruns are a hard gate (any
+    overrun voids the 1-dispatch-per-step cost model) on top of the
+    extra virtual-clock charge, so the mutation must go red (exit 1) —
+    proving the budget is load-bearing and not a free knob."""
+    from distributed_llama_tpu.runtime.chaos import ChaosMonkey
+    from loadgen import drive_pools, generate_trace
+
+    policy = _two_pool_policy()
+    trace = generate_trace(_two_pool_spec(args), args.seed)
+    slots = 2 * args.slots
+    pages = slots * (SPEC_KW["seq_len"] // args.page_size)
+    failures: list[str] = []
+
+    base = [make_engine(slo=policy, slo_priority=True, slots=slots,
+                        kv_pages=pages) for _ in range(2)]
+    res_base = drive_pools(base, trace, policy, mode="colocated",
+                           step_cost_s=args.step_cost,
+                           chunk_cost_s=args.step_cost)
+    att_base = res_base.attainment.get("interactive", 1.0)
+    for i, eng in enumerate(base):
+        for problem in eng.audit_pages():
+            failures.append(f"budget baseline-{i} audit: {problem}")
+
+    points = []
+    best = None
+    for budget in args.budget:
+        engines = []
+        for _ in range(2):
+            chaos = (ChaosMonkey(overrun_budget=True)
+                     if args.inject == "overrun-budget" else None)
+            engines.append(make_engine(chaos=chaos, slo=policy,
+                                       slo_priority=True, slots=slots,
+                                       kv_pages=pages,
+                                       dispatch_tokens=budget))
+        res_b = drive_pools(engines, trace, policy, mode="colocated",
+                            step_cost_s=args.step_cost,
+                            chunk_cost_s=args.step_cost)
+        att = res_b.attainment.get("interactive", 1.0)
+        overruns = sum(e.stats.overrun_steps for e in engines)
+        for i, eng in enumerate(engines):
+            for problem in eng.audit_pages():
+                failures.append(f"budget={budget} engine-{i} audit: "
+                                f"{problem}")
+        if overruns:
+            failures.append(
+                f"budget={budget}: {overruns} overrun step(s) — the "
+                f"scheduler packed dispatches past their token budget, "
+                f"so the single-dispatch cost model (and every "
+                f"attainment number above) is void")
+        point = {"budget": budget, "interactive_attainment": att,
+                 "goodput_tps": res_b.goodput_tps,
+                 "overrun_steps": overruns, "result": res_b.to_json()}
+        points.append(point)
+        if best is None or att > best["interactive_attainment"]:
+            best = point
+        if not args.json:
+            print(f"budget {budget:<3d}: interactive attainment "
+                  f"{att_base:.2f} -> {att:.2f}; goodput "
+                  f"{res_base.goodput_tps:.3f} -> "
+                  f"{res_b.goodput_tps:.3f} tok/step; overruns "
+                  f"{overruns}")
+
+    if best["interactive_attainment"] < 0.90:
+        failures.append(
+            f"budget gate: best interactive attainment "
+            f"{best['interactive_attainment']:.4f} (budget "
+            f"{best['budget']}) below the 0.90 floor — token-budget "
+            f"scheduling is not closing the prefill-interference gap "
+            f"(separate-dispatch baseline {att_base:.4f})")
+    elif best["goodput_tps"] < res_base.goodput_tps:
+        failures.append(
+            f"budget gate: best point (budget {best['budget']}) trades "
+            f"goodput away — {best['goodput_tps']:.4f} tok/step below "
+            f"the separate-dispatch baseline "
+            f"{res_base.goodput_tps:.4f}")
+    row = {"rate": args.two_pool_rate, "budgets": list(args.budget),
+           "baseline": {"interactive_attainment": att_base,
+                        "goodput_tps": res_base.goodput_tps,
+                        "result": res_base.to_json()},
+           "points": points,
+           "best": {"budget": best["budget"],
+                    "interactive_attainment":
+                        best["interactive_attainment"],
+                    "goodput_tps": best["goodput_tps"]}}
+    return row, failures
+
+
 def run_sweep(args, make_engine) -> list[dict]:
     """One LoadResult row per offered rate (fresh engine + fresh trace
     per point, same seed — points differ only in arrival rate)."""
@@ -346,7 +448,8 @@ def main(argv=None) -> int:
                          "from runtime/chaos.DRILLS)")
     ap.add_argument("--inject", default=None,
                     choices=("leak-on-cancel", "corrupt-journal",
-                             "drop-on-demote", "drop-page-in-flight"),
+                             "drop-on-demote", "drop-page-in-flight",
+                             "overrun-budget"),
                     help="arm a seeded mutation; the drill suite MUST "
                          "go red (the CI gate's self-test): "
                          "leak-on-cancel leaks a page per cancelled "
@@ -357,7 +460,11 @@ def main(argv=None) -> int:
                          "demotion's payload (tier_spill_storm drill), "
                          "drop-page-in-flight zeroes every handed-off "
                          "page under a valid CRC (kill_mid_handoff "
-                         "drill — only the bitwise gate can catch it)")
+                         "drill — only the bitwise gate can catch it), "
+                         "overrun-budget packs mixed prefill slices "
+                         "past the token budget (--budget comparison "
+                         "must go red: the overrun step charge drags "
+                         "attainment below the gate)")
     ap.add_argument("--two-pool", action="store_true",
                     help="run the colocated-vs-disaggregated comparison "
                          "(ISSUE 14) on the mixed interactive/batch "
@@ -366,6 +473,14 @@ def main(argv=None) -> int:
                          "hardware")
     ap.add_argument("--two-pool-rate", type=float, default=0.25,
                     help="offered rate of the two-pool comparison trace")
+    ap.add_argument("--budget", default=None, metavar="T1,T2,...",
+                    help="run the token-budget comparison (ISSUE 18): "
+                         "the two-pool mixed trace against colocated "
+                         "engines with --dispatch-tokens at each budget "
+                         "vs separate-dispatch colocated at equal "
+                         "simulated hardware; gates on the best point "
+                         "reaching interactive attainment >= 0.90 "
+                         "without losing goodput")
     ap.add_argument("--trace-out", default=None,
                     help="also save each sweep point's trace (replayable "
                          "schedule archive)")
@@ -386,6 +501,22 @@ def main(argv=None) -> int:
         print("loadcheck: --sweep-only and --drills-only are exclusive",
               file=sys.stderr)
         return 2
+    if args.budget is not None:
+        try:
+            args.budget = [int(b) for b in str(args.budget).split(",")
+                           if b]
+        except ValueError as e:
+            print(f"loadcheck: bad --budget: {e}", file=sys.stderr)
+            return 2
+        if not args.budget or min(args.budget) < 2:
+            print("loadcheck: --budget needs integers >= 2 (one decode "
+                  "token + a non-empty slice)", file=sys.stderr)
+            return 2
+        if args.spec_k:
+            print("loadcheck: --budget is incompatible with --spec-k "
+                  "(the engine rejects the pairing — see "
+                  "runtime/speculative.py)", file=sys.stderr)
+            return 2
 
     from distributed_llama_tpu.models.spec import TransformerSpec
     from distributed_llama_tpu.runtime.chaos import DISAGG_DRILLS, \
@@ -401,9 +532,13 @@ def main(argv=None) -> int:
     drill_rows: list[dict] = []
 
     two_pool_row = None
+    budget_row = None
     if args.two_pool:
         two_pool_row, tp_failures = run_two_pool(args, make_engine)
         failures += tp_failures
+    elif args.budget is not None:
+        budget_row, b_failures = run_budget(args, make_engine)
+        failures += b_failures
     elif not args.drills_only:
         rows = run_sweep(args, make_engine)
         base_failures, _ = check_baseline(rows, args.baseline,
@@ -478,6 +613,7 @@ def main(argv=None) -> int:
                 for c in policy.classes],
         "sweep": rows,
         "two_pool": two_pool_row,
+        "budget": budget_row,
         "drills": drill_rows,
         # dedicated recovery-gate verdict columns (ISSUE 9): the crash-
         # safety drills' pass/fail at a glance, joinable across rows

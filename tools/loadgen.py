@@ -339,8 +339,14 @@ def drive_engine(engine, trace: Trace, policy, step_cost_s: float = 1.0,
             live.append((req, records[i]))
             i += 1
         before = engine.stats.steps
+        o0 = engine.stats.overrun_steps
         engine.step_many(engine.block_steps, quiet=True)
-        v += step_cost_s * (engine.stats.steps - before)
+        # an overrun dispatch (mixed window packed past the token budget,
+        # ISSUE 18) costs its extra device-step equivalents: the virtual
+        # clock charges ceil(span/budget)-1 on top, so a scheduler that
+        # cheats the budget LOSES latency instead of gaming the gate
+        v += step_cost_s * ((engine.stats.steps - before)
+                            + (engine.stats.overrun_steps - o0))
         still = []
         for req, rec in live:
             if rec.v_first is None and req.t_first_token:
@@ -365,7 +371,8 @@ def drive_engine(engine, trace: Trace, policy, step_cost_s: float = 1.0,
     result.engine = {"steps": st.steps, "pauses": st.pauses,
                      "requeues": st.requeues,
                      "max_active": st.max_active,
-                     "avg_active": round(st.avg_active, 4)}
+                     "avg_active": round(st.avg_active, 4),
+                     "overrun_steps": st.overrun_steps}
     if engine.allocator is not None:
         a = engine.allocator
         result.engine.update(prefix_hits=a.prefix_hits,
@@ -522,8 +529,12 @@ def drive_pools(engines, trace: Trace, policy, mode: str = "colocated",
             k = min(todo, key=lambda p: v[p])
             eng = engines[k]
             s0, c0 = eng.stats.steps, eng.stats.prefill_chunks
+            o0 = eng.stats.overrun_steps
             eng.step_many(eng.block_steps, quiet=True)
-            v[k] += (step_cost_s * (eng.stats.steps - s0)
+            # budget overruns (ISSUE 18) cost extra step equivalents,
+            # same charge as drive_engine — see the comment there
+            v[k] += (step_cost_s * (eng.stats.steps - s0
+                                    + eng.stats.overrun_steps - o0)
                      + chunk_cost * (eng.stats.prefill_chunks - c0))
             scan(k)
             continue
@@ -553,6 +564,7 @@ def drive_pools(engines, trace: Trace, policy, mode: str = "colocated",
                       "prefill_chunks": st.prefill_chunks,
                       "pauses": st.pauses, "requeues": st.requeues,
                       "max_active": st.max_active,
+                      "overrun_steps": st.overrun_steps,
                       "virtual_s": round(v[k], 4)})
     result.engine = {"mode": mode, "pools": pools}
     if mode == "disagg" and engines[1].allocator is not None:
