@@ -9,20 +9,27 @@
     --threadcheck     thread-ownership lint over runtime/ + obs/ (T-rules
                       against the analysis/threadmodel.py registry;
                       tools/threadcheck.py is the alias)
-    --all             all four heads
+    --wirecheck       wire/persistence schema drift lint over runtime/ +
+                      obs/ + tools/ (W-rules against the
+                      analysis/wiremodel.py registry; tools/wirecheck.py
+                      is the dynamic twin — the golden-corpus skew matrix)
+    --all             all five heads
     --baseline PATH   grandfathered-findings file
                       (default tools/dlint_baseline.txt)
     --write-baseline  rewrite the baseline from current findings and exit 0
     --threadcheck-baseline PATH  threadcheck's grandfathered findings
                       (default tools/threadcheck_baseline.txt)
     --write-threadcheck-baseline rewrite it from current findings, exit 0
+    --wirecheck-baseline PATH  wirecheck's grandfathered findings
+                      (default tools/wirecheck_baseline.txt)
+    --write-wirecheck-baseline rewrite it from current findings, exit 0
     --no-baseline     report every finding, baselines ignored
 
 Exit status: 0 = no new findings and all contracts/configs hold; 1 =
 findings; 2 = usage error. The contract and shardcheck heads force
 JAX_PLATFORMS=cpu and an 8-way virtual host mesh BEFORE jax initializes,
-so they are safe (and fast) on a box with a TPU attached; the lint and
-threadcheck heads never import the checked code at all.
+so they are safe (and fast) on a box with a TPU attached; the lint,
+threadcheck, and wirecheck heads never import the checked code at all.
 """
 
 from __future__ import annotations
@@ -36,6 +43,7 @@ REPO_ROOT = Path(__file__).resolve().parents[2]
 PACKAGE_DIR = Path(__file__).resolve().parents[1]
 DEFAULT_BASELINE = REPO_ROOT / "tools" / "dlint_baseline.txt"
 DEFAULT_THREAD_BASELINE = REPO_ROOT / "tools" / "threadcheck_baseline.txt"
+DEFAULT_WIRE_BASELINE = REPO_ROOT / "tools" / "wirecheck_baseline.txt"
 
 
 def main(argv=None) -> int:
@@ -54,7 +62,10 @@ def main(argv=None) -> int:
     ap.add_argument("--threadcheck", action="store_true",
                     help="run the thread-ownership lint over runtime/ + "
                          "obs/ (pure AST, imports nothing)")
-    ap.add_argument("--all", action="store_true", help="all four heads")
+    ap.add_argument("--wirecheck", action="store_true",
+                    help="run the wire-schema drift lint over runtime/ + "
+                         "obs/ + tools/ (pure AST, imports nothing)")
+    ap.add_argument("--all", action="store_true", help="all five heads")
     ap.add_argument("--baseline", type=Path, default=DEFAULT_BASELINE,
                     help=f"baseline file (default {DEFAULT_BASELINE})")
     ap.add_argument("--write-baseline", action="store_true",
@@ -65,6 +76,13 @@ def main(argv=None) -> int:
                          f"(default {DEFAULT_THREAD_BASELINE})")
     ap.add_argument("--write-threadcheck-baseline", action="store_true",
                     help="rewrite the threadcheck baseline from current "
+                         "findings")
+    ap.add_argument("--wirecheck-baseline", type=Path,
+                    default=DEFAULT_WIRE_BASELINE,
+                    help=f"wirecheck baseline file "
+                         f"(default {DEFAULT_WIRE_BASELINE})")
+    ap.add_argument("--write-wirecheck-baseline", action="store_true",
+                    help="rewrite the wirecheck baseline from current "
                          "findings")
     ap.add_argument("--no-baseline", action="store_true",
                     help="ignore the baselines (report everything)")
@@ -78,7 +96,9 @@ def main(argv=None) -> int:
                or not (args.contracts or args.shardcheck
                        or args.shardcheck_matrix is not None
                        or args.threadcheck
-                       or args.write_threadcheck_baseline))
+                       or args.write_threadcheck_baseline
+                       or args.wirecheck
+                       or args.write_wirecheck_baseline))
     do_contracts = args.contracts or args.all
     # a matrix override implies the head that consumes it (same rule as
     # --write-baseline implying --lint): a forgotten --shardcheck must not
@@ -89,6 +109,8 @@ def main(argv=None) -> int:
     # the threadcheck head
     do_threadcheck = (args.threadcheck or args.all
                       or args.write_threadcheck_baseline)
+    do_wirecheck = (args.wirecheck or args.all
+                    or args.write_wirecheck_baseline)
     if args.write_baseline and args.paths:
         # the baseline is global: rewriting it from a partial scan would
         # silently drop every grandfathered entry for unscanned files
@@ -184,6 +206,61 @@ def main(argv=None) -> int:
         print(f"threadcheck: {len(tnew)} new finding(s), {tsupp} "
               f"baseline-suppressed, {n_scoped} file(s) in scope")
         if tnew:
+            status = 1
+
+    if do_wirecheck:
+        from .lint import (apply_baseline, load_baseline, package_files,
+                           write_baseline)
+        from .wirecheck import run_wirecheck, wire_files, wire_scope
+
+        if args.paths:
+            missing = [p for p in args.paths if not p.exists()]
+            if missing:
+                print(f"wirecheck: no such file: {missing[0]}",
+                      file=sys.stderr)
+                return 2
+            wfiles = [f for p in args.paths
+                      for f in (package_files(p) if p.is_dir() else [p])]
+        else:
+            # unlike the other heads, the scan set includes tools/*.py:
+            # the fleet scraper and the corpus CLIs consume these
+            # formats from outside the package
+            wfiles = wire_files(PACKAGE_DIR, REPO_ROOT)
+        if args.write_wirecheck_baseline and args.paths:
+            print("wirecheck: --write-wirecheck-baseline requires a "
+                  "full-package scan (no explicit paths)",
+                  file=sys.stderr)
+            return 2
+        # registry-consistency and site-resolution checks only make
+        # sense against the whole tree — a partial scan would report
+        # every unscanned site as unresolved
+        wfindings = run_wirecheck(wfiles, REPO_ROOT,
+                                  full_scan=not args.paths)
+        if args.write_wirecheck_baseline:
+            write_baseline(args.wirecheck_baseline, wfindings)
+            print(f"wirecheck: baseline rewritten with "
+                  f"{len(wfindings)} finding(s) -> "
+                  f"{args.wirecheck_baseline}")
+            return 0
+        wbaseline = (load_baseline(args.wirecheck_baseline)
+                     if not args.no_baseline else None)
+        if wbaseline is not None:
+            wnew, wsupp, wstale = apply_baseline(wfindings, wbaseline)
+            if args.paths:
+                wstale = []  # partial scan: unscanned files aren't stale
+        else:
+            wnew, wsupp, wstale = wfindings, 0, []
+        for f in wnew:
+            print(f.render())
+        for key in wstale:
+            print(f"wirecheck: stale baseline entry (finding fixed — "
+                  f"prune with --write-wirecheck-baseline): {key}",
+                  file=sys.stderr)
+        n_wscoped = sum(1 for f in wfiles
+                        if wire_scope(f.as_posix()))
+        print(f"wirecheck: {len(wnew)} new finding(s), {wsupp} "
+              f"baseline-suppressed, {n_wscoped} file(s) in scope")
+        if wnew:
             status = 1
 
     if do_contracts or do_shardcheck:

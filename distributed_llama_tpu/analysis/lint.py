@@ -57,11 +57,11 @@ class Finding:
 
 # one pragma grammar for every head that reuses this engine: the tag
 # names the head a human greps for (`dlint:` for the D-rules,
-# `threadcheck:` for the T-rules) but the suppression semantics are
-# identical — rule-id sets are disjoint, so a tag can never bless a
-# foreign head's finding by accident
+# `threadcheck:` for the T-rules, `wirecheck:` for the W-rules) but
+# the suppression semantics are identical — rule-id sets are disjoint,
+# so a tag can never bless a foreign head's finding by accident
 _PRAGMA_RE = re.compile(
-    r"#\s*(?:dlint|threadcheck):\s*allow\[([A-Z0-9,\s]+)\]")
+    r"#\s*(?:dlint|threadcheck|wirecheck):\s*allow\[([A-Z0-9,\s]+)\]")
 
 
 def parse_pragmas(lines: list[str]) -> tuple[dict[int, set[str]],

@@ -34,6 +34,12 @@ import urllib.request
 ROUTER_SIGNALS = ("kv_pages_free", "queue_depth", "active", "occupancy",
                   "goodput_tokens", "prefix_hit_rate")
 
+# the feature-gated /health blocks (wiremodel's "health" format): a
+# replica only emits the blocks its features enable, so per-row presence
+# must ride beside the values — absent is NOT zero (ISSUE 19 satellite)
+HEALTH_BLOCKS = ("paged_kv", "kv_tiers", "disagg", "journal", "watchdog",
+                 "slo", "sched", "speculative")
+
 
 @dataclasses.dataclass
 class ReplicaSignals:
@@ -45,6 +51,16 @@ class ReplicaSignals:
     name: str
     healthy: bool = True
     error: str | None = None
+    # the replica's /health schema version (the payload's "schema" key;
+    # 0 = pre-schema replica) — rollups surface min/max so version skew
+    # across a fleet mid-rolling-upgrade is visible, not inferred
+    schema: int = 0
+    # which HEALTH_BLOCKS the scrape actually carried. None means the
+    # row was built directly (tests, sims) and presence is unknown —
+    # every block counts, the pre-ISSUE-19 behavior. A set means only
+    # these blocks' cells feed the rollup: an absent block (older
+    # replica, feature off) is SKIPPED, not summed as phantom zeros.
+    present: set | None = None
     state: str = ""
     uptime_s: float = 0.0
     slots: int = 0
@@ -75,8 +91,15 @@ class ReplicaSignals:
         n = self.prefix_hits + self.prefix_misses
         return self.prefix_hits / n if n else 0.0
 
+    def reports(self, block: str) -> bool:
+        """Did this row's scrape carry the given /health block? True
+        when presence is unknown (directly-built rows)."""
+        return self.present is None or block in self.present
+
     def to_json(self) -> dict:
         out = dataclasses.asdict(self)
+        out["present"] = (sorted(self.present)
+                          if self.present is not None else None)
         out["prefix_hit_rate"] = round(self.prefix_hit_rate, 6)
         out["occupancy"] = round(self.occupancy, 6)
         out["uptime_s"] = round(self.uptime_s, 3)
@@ -91,6 +114,15 @@ class FleetRollup:
 
     replicas: int = 0
     healthy: int = 0
+    # /health schema versions seen across HEALTHY replicas: min != max
+    # is a fleet mid-rolling-upgrade (0 = at least one pre-schema box)
+    schema_min: int = 0
+    schema_max: int = 0
+    # block -> number of healthy replicas whose scrape carried it: the
+    # denominator for every block-derived sum below ("3 replicas, 1
+    # reporting paged_kv, 40 pages free" reads very differently from
+    # "3 reporting, 40 free")
+    reporting: dict = dataclasses.field(default_factory=dict)
     slots: int = 0
     active: int = 0
     queue_depth: int = 0
@@ -174,37 +206,54 @@ def rollup(rows: list) -> FleetRollup:
     contribute only to the replica/healthy counts — their zeroed
     signals must not dilute occupancy or hit rates."""
     agg = FleetRollup(replicas=len(rows))
+    schemas: list[int] = []
     for r in rows:
         if not r.healthy:
             continue
         agg.healthy += 1
+        schemas.append(r.schema)
+        for block in HEALTH_BLOCKS:
+            if r.reports(block):
+                agg.reporting[block] = agg.reporting.get(block, 0) + 1
         agg.slots += r.slots
         agg.active += r.active
         agg.queue_depth += r.queue_depth
         agg.steps += r.steps
         agg.generated_tokens += r.generated_tokens
-        agg.kv_pages += r.kv_pages
-        agg.kv_pages_free += r.kv_pages_free
-        agg.prefix_hits += r.prefix_hits
-        agg.prefix_misses += r.prefix_misses
-        agg.prefill_tokens_saved += r.prefill_tokens_saved
-        agg.goodput_tokens += r.goodput_tokens
-        for cls, counts in r.slo.items():
-            cell = agg.slo.setdefault(cls, {})
-            for key, v in counts.items():
-                if isinstance(v, (int, float)) and not key.endswith("_s"):
-                    cell[key] = cell.get(key, 0) + v
-        agg.page_seconds += r.page_seconds
-        for cause, s in r.stall_seconds.items():
-            agg.stall_seconds[cause] = agg.stall_seconds.get(cause, 0.0) + s
-        # cost cells: sum EVERY numeric count (tokens AND seconds — cost
-        # ratios are recomputed from these sums in FleetRollup.cost, so
-        # unlike the slo block the _s fields must survive the merge)
-        for cls, counts in r.cost_classes.items():
-            cell = agg.cost_classes.setdefault(cls, {})
-            for key, v in counts.items():
-                if isinstance(v, (int, float)):
-                    cell[key] = cell.get(key, 0) + v
+        # block-derived cells only count when the replica's scrape
+        # actually carried the block: an older replica (or one with the
+        # feature off) is skipped, not averaged in as zeros — its
+        # absence shows in `reporting`, where a router can see it
+        if r.reports("paged_kv"):
+            agg.kv_pages += r.kv_pages
+            agg.kv_pages_free += r.kv_pages_free
+            agg.prefix_hits += r.prefix_hits
+            agg.prefix_misses += r.prefix_misses
+            agg.prefill_tokens_saved += r.prefill_tokens_saved
+        if r.reports("slo"):
+            agg.goodput_tokens += r.goodput_tokens
+            for cls, counts in r.slo.items():
+                cell = agg.slo.setdefault(cls, {})
+                for key, v in counts.items():
+                    if isinstance(v, (int, float)) \
+                            and not key.endswith("_s"):
+                        cell[key] = cell.get(key, 0) + v
+        if r.reports("sched"):
+            agg.page_seconds += r.page_seconds
+            for cause, s in r.stall_seconds.items():
+                agg.stall_seconds[cause] = (
+                    agg.stall_seconds.get(cause, 0.0) + s)
+            # cost cells: sum EVERY numeric count (tokens AND seconds —
+            # cost ratios are recomputed from these sums in
+            # FleetRollup.cost, so unlike the slo block the _s fields
+            # must survive the merge)
+            for cls, counts in r.cost_classes.items():
+                cell = agg.cost_classes.setdefault(cls, {})
+                for key, v in counts.items():
+                    if isinstance(v, (int, float)):
+                        cell[key] = cell.get(key, 0) + v
+    if schemas:
+        agg.schema_min, agg.schema_max = min(schemas), max(schemas)
     return agg
 
 
@@ -213,6 +262,9 @@ def signals_from_health(name: str, payload: dict) -> ReplicaSignals:
     runtime/server.py emits — pinned by tests against a live server so
     a /health rename breaks HERE, not silently in a router)."""
     row = ReplicaSignals(name=name)
+    row.schema = int(payload.get("schema", 0))
+    row.present = {b for b in HEALTH_BLOCKS
+                   if isinstance(payload.get(b), dict)}
     row.state = str(payload.get("state", ""))
     row.healthy = row.state in ("starting", "serving", "degraded")
     row.uptime_s = float(payload.get("uptime_s", 0.0))
@@ -281,14 +333,17 @@ def apply_metrics(row: ReplicaSignals, samples: dict) -> ReplicaSignals:
     fills only the router-facing row)."""
     if "dllama_prefix_hits_total" in samples:
         row.prefix_hits = int(samples["dllama_prefix_hits_total"])
+        _mark_present(row, "paged_kv")
     if "dllama_kv_pages_free" in samples:
         row.kv_pages_free = int(samples["dllama_kv_pages_free"])
+        _mark_present(row, "paged_kv")
     if "dllama_queue_depth" in samples:
         row.queue_depth = int(samples["dllama_queue_depth"])
     goodput = sum(v for k, v in samples.items()
                   if k.startswith("dllama_goodput_tokens_total"))
     if goodput:
         row.goodput_tokens = int(goodput)
+        _mark_present(row, "slo")
     # ISSUE 16 labeled series: cross-fill the cost columns from the
     # counters when /health came from a pre-ledger build (or was pruned)
     page_s = 0.0
@@ -303,7 +358,17 @@ def apply_metrics(row: ReplicaSignals, samples: dict) -> ReplicaSignals:
                 row.stall_seconds[cause] = v
     if seen_page and not row.page_seconds:
         row.page_seconds = page_s
+    if seen_page or row.stall_seconds:
+        _mark_present(row, "sched")
     return row
+
+
+def _mark_present(row: ReplicaSignals, block: str) -> None:
+    """A /metrics cross-fill IS evidence the replica reports the block's
+    signal — without this, a row whose /health predates the block but
+    whose counters carry it would be skipped by the rollup guards."""
+    if row.present is not None:
+        row.present.add(block)
 
 
 def _series_label(series_key: str, label: str) -> str | None:

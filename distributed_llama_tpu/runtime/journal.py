@@ -543,10 +543,11 @@ def _parse_record(obj, entries: dict[int, JournalEntry],
                 topp=float(obj["topp"]), seed=int(obj["seed"]),
                 slo=obj.get("slo"), cursor=int(obj.get("cursor", 0)),
                 trace=trace, ledger=ledger)
-            if obj.get("recovers") is not None:
+            recovers = obj.get("recovers")
+            if recovers is not None:
                 # recovery re-admission: this one record also closes the
                 # previous life (see RequestJournal.admit)
-                old = entries.get(int(obj["recovers"]))
+                old = entries.get(int(recovers))
                 if old is not None and old.status is None:
                     old.status = "recovered"
         elif t == "tok":

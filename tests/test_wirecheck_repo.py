@@ -1,0 +1,242 @@
+"""Tier-1 repo gates (ISSUE 19): the static wirecheck head over the
+real runtime/+obs/+tools/ surface must report ZERO findings beyond the
+(empty) baseline, the golden wire corpus must regenerate byte-exactly
+and pass the version-skew matrix, and the legacy-era (v1) journal
+fixture must recover through a real ContinuousEngine — the N−1
+compatibility contract, end to end."""
+
+from __future__ import annotations
+
+import json
+import shutil
+import subprocess
+import sys
+from distributed_llama_tpu.analysis import wiremodel as wm
+from distributed_llama_tpu.analysis.__main__ import (
+    DEFAULT_WIRE_BASELINE, PACKAGE_DIR, REPO_ROOT)
+from distributed_llama_tpu.analysis.lint import (apply_baseline,
+                                                 load_baseline)
+from distributed_llama_tpu.analysis.wirecheck import (run_wirecheck,
+                                                      wire_files,
+                                                      wire_scope)
+from distributed_llama_tpu.obs.fleet import (HEALTH_BLOCKS,
+                                             ReplicaSignals, rollup,
+                                             signals_from_health)
+
+CORPUS = REPO_ROOT / "tests" / "fixtures" / "wire"
+
+_ENV = {"PATH": "/usr/bin:/bin", "HOME": "/tmp",
+        "PYTHONPATH": str(REPO_ROOT), "JAX_PLATFORMS": "cpu"}
+
+
+def _cli(*argv, timeout=600):
+    return subprocess.run([sys.executable, *argv], cwd=REPO_ROOT,
+                          capture_output=True, text=True,
+                          timeout=timeout, env=_ENV)
+
+
+# -- the static head over the real tree ------------------------------------
+
+
+def test_package_has_no_new_wirecheck_findings():
+    findings = run_wirecheck(wire_files(PACKAGE_DIR, REPO_ROOT),
+                             REPO_ROOT)
+    baseline = load_baseline(DEFAULT_WIRE_BASELINE)
+    new, _, stale = apply_baseline(findings, baseline)
+    assert not new, "new wirecheck findings (register the field, fix " \
+        "the site, or pragma with a reason):\n" \
+        + "\n".join(f.render() for f in new)
+    assert not stale, "stale wirecheck baseline entries:\n" \
+        + "\n".join(stale)
+
+
+def test_baseline_is_empty_per_the_burn_down_contract():
+    # tools/wirecheck_baseline.txt documents WHY it is empty; this pin
+    # keeps it that way — grandfathering wire drift is a deliberate
+    # decision that must show up in a diff of this test
+    assert not load_baseline(DEFAULT_WIRE_BASELINE), \
+        "wirecheck baseline grew an entry: fix or pragma at the site"
+
+
+def test_scope_covers_runtime_obs_and_tools():
+    scoped = [p for p in wire_files(PACKAGE_DIR, REPO_ROOT)
+              if wire_scope(p.as_posix())]
+    names = {p.as_posix() for p in scoped}
+    assert any(n.endswith("runtime/journal.py") for n in names)
+    assert any(n.endswith("obs/fleet.py") for n in names)
+    assert any(n.endswith("tools/wirecheck.py") for n in names)
+    assert any(n.endswith("tools/make_wire_corpus.py") for n in names)
+    assert not any("/models/" in n for n in names)
+    assert len(scoped) >= 30  # the whole cross-process surface
+
+
+def test_cli_wirecheck_exits_zero_on_repo():
+    proc = _cli("-m", "distributed_llama_tpu.analysis", "--wirecheck")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "wirecheck: 0 new finding(s)" in proc.stdout
+
+
+def test_wirecheck_only_invocation_skips_the_lint_head(capsys):
+    from distributed_llama_tpu.analysis.__main__ import main
+
+    rc = main(["--wirecheck"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "wirecheck:" in out
+    assert "dlint:" not in out
+
+
+def test_write_wirecheck_baseline_refuses_partial_scans(tmp_path):
+    from distributed_llama_tpu.analysis.__main__ import main
+
+    target = PACKAGE_DIR / "runtime" / "journal.py"
+    rc = main(["--wirecheck", "--write-wirecheck-baseline",
+               "--wirecheck-baseline", str(tmp_path / "wb.txt"),
+               str(target)])
+    assert rc == 2
+    assert not (tmp_path / "wb.txt").exists()
+
+
+# -- the health schema stamp (satellite: /health versioning) ---------------
+
+
+def test_health_schema_constant_matches_the_registry():
+    from distributed_llama_tpu.runtime.server import HEALTH_SCHEMA
+
+    assert HEALTH_SCHEMA == wm.HEALTH_SCHEMA_VERSION
+    assert wm.FORMATS_BY_NAME["health"].version == HEALTH_SCHEMA
+
+
+# -- the golden corpus + skew matrix ---------------------------------------
+
+
+def test_corpus_regenerates_byte_identically(tmp_path):
+    proc = _cli("tools/make_wire_corpus.py", "--out",
+                str(tmp_path / "wire"))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    fresh = {p.relative_to(tmp_path / "wire").as_posix(): p
+             for p in (tmp_path / "wire").rglob("*") if p.is_file()}
+    checked_in = {p.relative_to(CORPUS).as_posix(): p
+                  for p in CORPUS.rglob("*") if p.is_file()}
+    assert set(fresh) == set(checked_in), \
+        "corpus file set drifted — regenerate and commit"
+    for rel, path in sorted(fresh.items()):
+        assert path.read_bytes() == checked_in[rel].read_bytes(), \
+            f"corpus file {rel} is not byte-deterministic (or the " \
+            f"checked-in copy is stale): rerun tools/make_wire_corpus.py"
+
+
+def test_skew_matrix_passes_and_stamps_its_row():
+    proc = _cli("tools/wirecheck.py", "--json")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    row = json.loads(proc.stdout)
+    assert row["tool"] == "wirecheck" and row["ok"]
+    assert row["failed"] == 0 and row["checks"] >= 20
+    assert {"tp_scheme", "env_fingerprint"} <= set(row["stamp"])
+    eras = {(r["format"], r["era"]) for r in row["matrix"]}
+    # every versioned format proves BOTH eras readable
+    for fmt in ("journal", "handoff", "health", "metrics", "bundle",
+                "fingerprint"):
+        assert (fmt, "v1") in eras and (fmt, "v2") in eras
+
+
+def test_skew_reader_injection_exits_exactly_one():
+    proc = _cli("tools/wirecheck.py", "--inject", "skew-reader")
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "FAIL" in proc.stdout
+
+
+def test_drop_registry_field_injection_exits_exactly_one():
+    proc = _cli("tools/wirecheck.py", "--inject", "drop-registry-field")
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "W001" in proc.stdout
+
+
+# -- the N−1 journal recovers through a REAL engine ------------------------
+
+
+def test_legacy_journal_recovers_through_the_engine(tmp_path):
+    """Satellite 2: the v1 corpus WAL (no trace, no ledger, one admit
+    without slo/cursor keys at all) must re-admit through
+    ContinuousEngine.recover and drain to completion — the version-skew
+    contract at the engine level, not just the parser level."""
+    from distributed_llama_tpu.models.spec import TransformerSpec
+    from distributed_llama_tpu.models.synth import synth_params
+    from distributed_llama_tpu.obs.metrics import Registry
+    from distributed_llama_tpu.runtime.continuous import ContinuousEngine
+    from distributed_llama_tpu.runtime.journal import (RequestJournal,
+                                                       load_journal)
+
+    spec = TransformerSpec(dim=64, hidden_dim=160, n_layers=2,
+                           n_heads=4, n_kv_heads=2, vocab_size=128,
+                           seq_len=32)
+    params = synth_params(spec, q40=False, seed=4, scale=0.3)
+    wal = tmp_path / "journal.wal"
+    shutil.copy(CORPUS / "journal" / "v1" / "journal.wal", wal)
+
+    eng = ContinuousEngine(
+        spec, params, journal=RequestJournal(str(wal)), slots=2,
+        temperature=0.8, topp=0.9, seed=11, metrics=Registry(),
+        prefill_chunk=4, page_size=4, kv_pages=24)
+    assert eng.recover() == 2  # rids 1 and 2 were live
+
+    entries = {e.rid: e for e in load_journal(str(wal))}
+    assert entries[1].status == "recovered"
+    assert entries[2].status == "recovered"
+    live = sorted(e.rid for e in entries.values() if e.status is None)
+    assert len(live) == 2
+    # rid 1's successor replays prompt + both sampled tokens from
+    # coin-cursor 2; rid 2's successor replays the bare prompt
+    replays = sorted(entries[r].tokens for r in live)
+    assert replays == [[1, 5, 9, 17, 23], [2, 4]]
+    assert sorted(entries[r].cursor for r in live) == [0, 2]
+
+    while eng.step_many(eng.block_steps, quiet=True):
+        pass
+    entries = {e.rid: e for e in load_journal(str(wal))}
+    assert all(e.status is not None for e in entries.values())
+
+
+# -- fleet presence semantics (satellite: absent-cell rollups) -------------
+
+
+def _corpus_row(name: str, era: str) -> ReplicaSignals:
+    payload = json.loads((CORPUS / "health" / era
+                          / "health.json").read_text())
+    return signals_from_health(name, payload)
+
+
+def test_rollup_skips_absent_blocks_instead_of_zero_filling():
+    old = _corpus_row("old", "v1")   # schema 0: paged_kv + slo only
+    new = _corpus_row("new", "v2")   # schema 2: every block
+    agg = rollup([old, new])
+    assert (agg.schema_min, agg.schema_max) == (0, 2)
+    # both replicas report the kv + slo planes; only the new build
+    # reports the cost plane — the rollup must say so, not dilute
+    assert agg.reporting["paged_kv"] == 2
+    assert agg.reporting["slo"] == 2
+    assert agg.reporting["sched"] == 1
+    assert agg.goodput_tokens == 40 + 70
+    assert agg.page_seconds == 0.25     # old replica: absent, not 0.0
+    assert agg.stall_seconds == {"page_wait": 0.125}
+    assert agg.kv_pages == 48 and agg.kv_pages_free == 34
+
+
+def test_directly_built_rows_keep_counting_everywhere():
+    # present=None (a row built in code, not parsed from /health) means
+    # presence is unknown: every block counts, the pre-ISSUE-19 behavior
+    row = ReplicaSignals(name="direct", healthy=True, state="serving",
+                         goodput_tokens=5, page_seconds=0.5)
+    assert row.present is None
+    assert all(row.reports(b) for b in HEALTH_BLOCKS)
+    agg = rollup([row, _corpus_row("old", "v1")])
+    assert agg.goodput_tokens == 45
+    assert agg.page_seconds == 0.5
+    assert agg.reporting["sched"] == 1  # the direct row only
+
+
+def test_present_set_serializes_into_the_fleet_row_json():
+    row = _corpus_row("old", "v1")
+    out = row.to_json()
+    assert out["present"] == ["paged_kv", "slo"]
+    assert out["schema"] == 0

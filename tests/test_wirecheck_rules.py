@@ -1,0 +1,370 @@
+"""wirecheck rule fixtures (ISSUE 19): every W-rule gets a firing, a
+non-firing, and a pragma-suppressed snippet, plus the wiremodel
+registry self-check and the baseline round-trip on wirecheck findings.
+
+Fixture modules are written under a fake package layout (tmp/runtime/…)
+so the runtime/+obs/+tools/ scoping is exercised exactly as on the real
+tree. Fixtures run against MINI registries (the ``formats``/``families``
+overrides run_wirecheck exposes for exactly this) so each rule is
+isolated from the production wiremodel; the production registry gets
+its own validate() pin. The checker is pure AST — none of these
+snippets is ever imported or executed."""
+
+from __future__ import annotations
+
+import textwrap
+from pathlib import Path
+
+from distributed_llama_tpu.analysis import wiremodel as wm
+from distributed_llama_tpu.analysis.lint import (apply_baseline,
+                                                 load_baseline,
+                                                 write_baseline)
+from distributed_llama_tpu.analysis.wirecheck import (WIRE_RULES,
+                                                      run_wirecheck,
+                                                      wire_scope)
+
+
+def run_on(tmp_path: Path, rel: str, source: str, formats=(),
+           families=None):
+    path = tmp_path / rel
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(source), encoding="utf-8")
+    return run_wirecheck([path], tmp_path, formats=tuple(formats),
+                         families={} if families is None else families,
+                         full_scan=False)
+
+
+def rules_fired(findings):
+    return {f.rule for f in findings}
+
+
+def _fmt(**kw):
+    base = dict(name="fix.rec", version=2, persistent=False,
+                fields=(wm.WireField("a", "int"),
+                        wm.WireField("opt", "int", required=False,
+                                     default=0, since=1),
+                        wm.WireField("maybe", "str", required=False,
+                                     default=None, since=1)),
+                producers=("runtime/wire.py:make",),
+                consumers=("runtime/wire.py:read",))
+    base.update(kw)
+    return wm.WireFormat(**base)
+
+
+# -- registry self-consistency ---------------------------------------------
+
+
+def test_wiremodel_registry_validates():
+    assert wm.validate() == []
+
+
+def test_registry_covers_the_core_formats():
+    names = set(wm.FORMATS_BY_NAME)
+    for fmt in ("journal.header", "journal.admit", "journal.tok",
+                "journal.retire", "journal.handoff",
+                "config.fingerprint", "pagewire.frame",
+                "page_channel.protocol", "prefill.request",
+                "traceparent", "health", "flightrec.bundle"):
+        assert fmt in names, f"{fmt} has no declared wire schema"
+    for fam in ("dllama_prefix_hits_total", "dllama_goodput_tokens_total",
+                "dllama_page_seconds_total", "dllama_kv_pages_free"):
+        assert fam in wm.METRIC_FAMILIES
+
+
+def test_validate_flags_an_inconsistent_registry():
+    bad = _fmt(fields=(wm.WireField("a", "int"),
+                       wm.WireField("a", "int")))  # duplicate field
+    assert wm.validate((bad,), {})
+
+
+def test_scope_covers_runtime_obs_and_tools():
+    assert wire_scope("distributed_llama_tpu/runtime/journal.py")
+    assert wire_scope("distributed_llama_tpu/obs/fleet.py")
+    assert wire_scope("tools/wirecheck.py")
+    assert not wire_scope("distributed_llama_tpu/models/llama.py")
+
+
+# -- W001: unregistered key at a producer site -----------------------------
+
+
+def test_w001_fires_on_unregistered_producer_key(tmp_path):
+    findings = run_on(tmp_path, "runtime/wire.py", """
+        def make(x):
+            return {"a": x, "zzz": 1}
+
+        def read(rec):
+            return rec["a"]
+    """, formats=(_fmt(),))
+    assert [f.rule for f in findings] == ["W001"]
+    assert "'zzz'" in findings[0].message
+
+
+def test_w001_quiet_on_registered_keys_and_kwarg_dicts(tmp_path):
+    assert not run_on(tmp_path, "runtime/wire.py", """
+        def make(x, post):
+            post(json={"unrelated": 1})  # kwarg dict: not the payload
+            rec = {"a": x}
+            rec["opt"] = 2
+            return rec
+
+        def read(rec):
+            return rec["a"]
+    """, formats=(_fmt(),))
+
+
+def test_w001_pragma_suppresses_with_reason(tmp_path):
+    assert not run_on(tmp_path, "runtime/wire.py", """
+        def make(x):
+            # wirecheck: allow[W001] scratch key, stripped before send
+            return {"a": x, "zzz": 1}
+
+        def read(rec):
+            return rec["a"]
+    """, formats=(_fmt(),))
+
+
+# -- W002: consumer read disagrees with the registry -----------------------
+
+
+def test_w002_fires_on_unregistered_read(tmp_path):
+    findings = run_on(tmp_path, "runtime/wire.py", """
+        def make(x):
+            return {"a": x}
+
+        def read(rec):
+            return rec["nope"]
+    """, formats=(_fmt(),))
+    assert [f.rule for f in findings] == ["W002"]
+    assert "'nope'" in findings[0].message
+
+
+def test_w002_fires_on_subscript_of_optional(tmp_path):
+    findings = run_on(tmp_path, "runtime/wire.py", """
+        def make(x):
+            return {"a": x}
+
+        def read(rec):
+            return rec["opt"]
+    """, formats=(_fmt(),))
+    assert [f.rule for f in findings] == ["W002"]
+    assert "N-1 producer legally omits" in findings[0].message
+
+
+def test_w002_fires_on_contradicting_get_default(tmp_path):
+    findings = run_on(tmp_path, "runtime/wire.py", """
+        def make(x):
+            return {"a": x}
+
+        def read(rec):
+            return rec.get("opt", 7)
+    """, formats=(_fmt(),))
+    assert [f.rule for f in findings] == ["W002"]
+    assert "contradicts the declared default" in findings[0].message
+
+
+def test_w002_fires_on_bare_get_when_default_is_not_none(tmp_path):
+    findings = run_on(tmp_path, "runtime/wire.py", """
+        def make(x):
+            return {"a": x}
+
+        def read(rec):
+            return rec.get("opt")
+    """, formats=(_fmt(),))
+    assert [f.rule for f in findings] == ["W002"]
+    assert "absent parses as None" in findings[0].message
+
+
+def test_w002_quiet_on_declared_reads(tmp_path):
+    assert not run_on(tmp_path, "runtime/wire.py", """
+        def make(x):
+            return {"a": x}
+
+        def read(rec):
+            a = rec["a"]              # required: [] is fine
+            o = rec.get("opt", 0)     # optional: declared default
+            m = rec.get("maybe")      # optional: declared default None
+            return a, o, m
+    """, formats=(_fmt(),))
+
+
+def test_w002_pragma_suppresses_with_reason(tmp_path):
+    assert not run_on(tmp_path, "runtime/wire.py", """
+        def make(x):
+            return {"a": x}
+
+        def read(rec):
+            return rec["opt"]  # wirecheck: allow[W002] presence checked
+    """, formats=(_fmt(),))
+
+
+# -- W003: pack/unpack asymmetry in a codec pair ---------------------------
+
+_CODEC = _fmt(codec_pairs=(("runtime/wire.py:pack",
+                            "runtime/wire.py:unpack"),),
+              producers=(), consumers=())
+
+
+def test_w003_fires_on_packed_but_never_unpacked(tmp_path):
+    findings = run_on(tmp_path, "runtime/wire.py", """
+        def pack(e):
+            return {"a": e.a, "opt": e.opt}
+
+        def unpack(rec):
+            return rec["a"]
+    """, formats=(_CODEC,))
+    assert rules_fired(findings) == {"W003"}
+    assert "never unpacked" in findings[0].message
+
+
+def test_w003_fires_on_unpacked_but_never_packed(tmp_path):
+    findings = run_on(tmp_path, "runtime/wire.py", """
+        def pack(e):
+            return {"a": e.a}
+
+        def unpack(rec):
+            return rec["a"], rec.get("opt", 0)
+    """, formats=(_CODEC,))
+    assert rules_fired(findings) == {"W003"}
+    assert "never packed" in findings[0].message
+
+
+def test_w003_quiet_on_symmetric_and_binary_codecs(tmp_path):
+    assert not run_on(tmp_path, "runtime/wire.py", """
+        def pack(e):
+            return {"a": e.a, "opt": e.opt}
+
+        def unpack(rec):
+            return rec["a"], rec.get("opt", 0)
+
+        def bin_pack(planes):
+            return bytes(planes)      # no string keys: out of reach
+
+        def bin_unpack(blob):
+            return blob
+    """, formats=(
+        _CODEC,
+        _fmt(name="fix.bin", producers=(), consumers=(),
+             codec_pairs=(("runtime/wire.py:bin_pack",
+                           "runtime/wire.py:bin_unpack"),)),
+    ))
+
+
+# -- W004: unregistered Prometheus family ----------------------------------
+
+_FAMS = {"dllama_known_total": wm.MetricFamily("dllama_known_total")}
+
+
+def test_w004_fires_on_unregistered_family(tmp_path):
+    findings = run_on(tmp_path, "obs/met.py", """
+        NAME = "dllama_bogus_total"
+    """, families=_FAMS)
+    assert [f.rule for f in findings] == ["W004"]
+    assert "dllama_bogus_total" in findings[0].message
+
+
+def test_w004_quiet_on_registered_and_exposition_suffixes(tmp_path):
+    assert not run_on(tmp_path, "obs/met.py", """
+        A = "dllama_known_total"
+        B = "dllama_known_total_bucket"   # exposition suffix
+        C = "dllama_known_total_sum 3.5"  # embedded in a sample line
+    """, families=_FAMS)
+
+
+def test_w004_pragma_suppresses_with_reason(tmp_path):
+    assert not run_on(tmp_path, "obs/met.py", """
+        # wirecheck: allow[W004] negative fixture for the family gate
+        NAME = "dllama_bogus_total"
+    """, families=_FAMS)
+
+
+# -- W005: persistent format without an upgrade path -----------------------
+
+
+def test_w005_fires_on_missing_since(tmp_path):
+    findings = run_on(tmp_path, "runtime/wire.py", """
+        def make(x):
+            return {"a": x}
+
+        def read(rec):
+            return rec["a"]
+    """, formats=(_fmt(persistent=True,
+                       fields=(wm.WireField("a", "int"),)),))
+    assert rules_fired(findings) == {"W005"}
+    assert "no since version" in findings[0].message
+
+
+def test_w005_fires_on_late_required_field(tmp_path):
+    findings = run_on(tmp_path, "runtime/wire.py", """
+        def make(x):
+            return {"a": x, "b": 1}
+
+        def read(rec):
+            return rec["a"], rec["b"]
+    """, formats=(_fmt(persistent=True,
+                       fields=(wm.WireField("a", "int", since=1),
+                               wm.WireField("b", "int", since=2)),),))
+    assert rules_fired(findings) == {"W005"}
+    assert "as REQUIRED" in findings[0].message
+
+
+def test_w005_quiet_on_versioned_optional_growth(tmp_path):
+    assert not run_on(tmp_path, "runtime/wire.py", """
+        def make(x):
+            return {"a": x, "b": 1}
+
+        def read(rec):
+            return rec["a"], rec.get("b")
+    """, formats=(_fmt(persistent=True,
+                       fields=(wm.WireField("a", "int", since=1),
+                               wm.WireField("b", "int", required=False,
+                                            default=None, since=2)),),))
+
+
+# -- W000: full-scan surface checks ----------------------------------------
+
+
+def test_w000_reports_unresolvable_registered_site(tmp_path):
+    path = tmp_path / "runtime" / "wire.py"
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text("def make(x):\n    return {'a': x}\n",
+                    encoding="utf-8")
+    findings = run_wirecheck(
+        [path], tmp_path,
+        formats=(_fmt(consumers=("runtime/wire.py:vanished",)),),
+        families={}, full_scan=True)
+    assert any(f.rule == "W000" and "vanished" in f.message
+               for f in findings)
+
+
+def test_w000_reports_unparseable_in_scope_file(tmp_path):
+    findings = run_on(tmp_path, "runtime/broken.py", """
+        def make(:
+    """)
+    assert [f.rule for f in findings] == ["W000"]
+
+
+# -- baseline machinery on W findings --------------------------------------
+
+
+def test_baseline_round_trip_suppresses_wirecheck_findings(tmp_path):
+    findings = run_on(tmp_path, "runtime/wire.py", """
+        def make(x):
+            return {"a": x, "zzz": 1}
+
+        def read(rec):
+            return rec["nope"]
+    """, formats=(_fmt(),))
+    assert rules_fired(findings) == {"W001", "W002"}
+    baseline_path = tmp_path / "wb.txt"
+    write_baseline(baseline_path, findings)
+    new, suppressed, stale = apply_baseline(
+        findings, load_baseline(baseline_path))
+    assert not new and not stale
+    assert suppressed == len(findings)
+
+
+def test_every_rule_has_a_catalogue_entry():
+    assert set(WIRE_RULES) == {"W000", "W001", "W002", "W003",
+                               "W004", "W005"}
+    for rule, (desc, hint) in WIRE_RULES.items():
+        assert desc and hint, f"{rule} missing description or hint"

@@ -68,6 +68,55 @@ if [ "$reorder_rc" -ne 1 ]; then
          "(exit $reorder_rc, expected 1)" >&2
     exit 1
 fi
+# Wire-contract gate (ISSUE 19): the --all run above already includes
+# the wirecheck schema-drift head (zero findings beyond the empty
+# baseline in tools/wirecheck_baseline.txt); the skew matrix is its
+# dynamic twin — current code must round-trip its own golden corpus
+# (tests/fixtures/wire/) byte-exactly AND read every legacy-era (N-1)
+# sample: journal recovery, disagg handoff, pagewire CRC frames, fleet
+# /health + /metrics parsing, flight-recorder bundles. The
+# fingerprint-stamped JSON row is archived next to the other artifacts.
+mkdir -p tools/ci_artifacts
+python tools/wirecheck.py --json > tools/ci_artifacts/wirecheck.json
+# ... and the corpus must REGENERATE byte-identically: a producer whose
+# bytes drifted from the checked-in samples is a silent wire break
+rm -rf tools/ci_artifacts/wire_regen
+python tools/make_wire_corpus.py --out tools/ci_artifacts/wire_regen \
+    > /dev/null
+if ! diff -r tests/fixtures/wire tools/ci_artifacts/wire_regen \
+        > /dev/null 2>&1; then
+    echo "ci: wire corpus regeneration is not byte-identical —" \
+         "a wire producer drifted (rerun tools/make_wire_corpus.py" \
+         "and review the diff)" >&2
+    exit 1
+fi
+rm -rf tools/ci_artifacts/wire_regen
+# ... and the gate must still CATCH drift: with skew-reader armed (two
+# legacy samples corrupted in memory before the real readers run) the
+# matrix must exit 1 EXACTLY — 2 is a usage error and would pass a
+# naive non-zero check vacuously
+set +e
+python tools/wirecheck.py --inject skew-reader > /dev/null 2>&1
+skewreader_rc=$?
+set -e
+if [ "$skewreader_rc" -ne 1 ]; then
+    echo "ci: wirecheck did not flag the corrupted legacy samples" \
+         "(exit $skewreader_rc, expected 1)" >&2
+    exit 1
+fi
+# ... and the STATIC head must catch a registry hole the same way: with
+# journal.admit's 'cursor' field deleted from an in-memory copy of the
+# wiremodel, the producer sites become unregistered-key writers and the
+# lint must exit 1 EXACTLY
+set +e
+python tools/wirecheck.py --inject drop-registry-field > /dev/null 2>&1
+dropfield_rc=$?
+set -e
+if [ "$dropfield_rc" -ne 1 ]; then
+    echo "ci: wirecheck did not flag the deleted registry field" \
+         "(exit $dropfield_rc, expected 1)" >&2
+    exit 1
+fi
 # paged-vs-contiguous equivalence gate (ISSUE 6): paged decode must stay
 # BITWISE equal to the contiguous cache and stream-invisible in the
 # engine, and the shared-prompt radix path must actually share — fail

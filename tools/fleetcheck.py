@@ -153,6 +153,11 @@ def run_sim(args) -> tuple[list, "object", list[str]]:
                 "goodput_tokens": 0,
             }
         row.goodput_tokens = res.goodput_tokens
+        if row.present is not None:
+            # the virtual clock IS this row's SLO tracker: the verdicts
+            # injected above make the block present, or the rollup's
+            # absent-block guard (ISSUE 19) would skip the sim's goodput
+            row.present.add("slo")
         audit = eng.audit_pages()
         if audit:
             failures += [f"replica-{k} audit: {p}" for p in audit]
