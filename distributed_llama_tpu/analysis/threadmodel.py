@@ -101,6 +101,11 @@ ENTRYPOINTS: dict[str, Entrypoint] = {e.key: e for e in (
     # obs/profiler.py: the timed auto-stop helper
     Entrypoint("_stop", SUPERVISOR, "obs/profiler.start_trace",
                "self-terminating timer (daemon)"),
+    # the watch plane's periodic self-scrape (ISSUE 20): one tick every
+    # watch_interval_s, detectors + incident forensics ride on it
+    Entrypoint("InferenceServer._watch_loop", SUPERVISOR,
+               "InferenceServer.start (watch_interval_s > 0)",
+               "InferenceServer.stop (_watch_stop event + thread join)"),
 )}
 
 
@@ -155,6 +160,14 @@ FAMILIES: tuple[AttrFamily, ...] = (
     # the flight recorder: every domain notes, the supervisor plane dumps
     AttrFamily("FlightRecorder", ("_events", "dumps"), SUPERVISOR,
                "_lock"),
+    # the incident-detection plane (ISSUE 20): the watch loop observes
+    # (supervisor), handlers read snapshots/tails — all under each
+    # object's own lock
+    AttrFamily("Watchtower", ("_states", "_incidents", "incidents_total",
+                              "_by_kind"),
+               SUPERVISOR, "_lock"),
+    AttrFamily("SignalRing", ("_rows", "_last", "_ticks", "rows_total"),
+               SUPERVISOR, "_lock"),
     # streaming-handler registry on the server: handlers register/
     # deregister themselves, stop() joins — the TOCTOU fix (ISSUE 17)
     # put it under its own lock
@@ -219,6 +232,8 @@ CLASS_OWNER: dict[str, str] = {
     "CensusRing": SCHEDULER,
     "RequestLedger": SCHEDULER,   # single-writer by module contract
     "FlightRecorder": SUPERVISOR,
+    "Watchtower": SUPERVISOR,
+    "SignalRing": SUPERVISOR,
     "InferenceServer": MAIN,
     "Handler": HANDLER,           # nested HTTP handler class (server.py)
     "StepWatchdog": SUPERVISOR,
@@ -284,6 +299,23 @@ METHOD_DOMAINS: dict[str, frozenset] = {k: frozenset(v) for k, v in {
     "InferenceServer.drain": (MAIN, SUPERVISOR),
     "InferenceServer._outstanding": (HANDLER, MAIN, SUPERVISOR),
     "InferenceServer.count_reject": (HANDLER,),
+    # the watch plane (ISSUE 20): /health handlers and the supervisor
+    # watch loop both assemble the payload; ticks run on the supervisor
+    # thread (tests and sim drivers tick from main)
+    "InferenceServer._health_payload": (HANDLER, SUPERVISOR, MAIN),
+    "InferenceServer.watch_tick": (SUPERVISOR, MAIN),
+    "InferenceServer._on_incident": (SUPERVISOR, MAIN),
+    "Watchtower.observe": (SUPERVISOR, MAIN),
+    "Watchtower.snapshot": (HANDLER, SUPERVISOR, MAIN),
+    "Watchtower.states": (HANDLER, SUPERVISOR, MAIN),
+    "Watchtower.incidents": (HANDLER, SUPERVISOR, MAIN),
+    "Watchtower.by_kind": (HANDLER, SUPERVISOR, MAIN),
+    "Watchtower.to_json": (HANDLER, SUPERVISOR, MAIN),
+    "SignalRing.observe": (SUPERVISOR, MAIN),
+    "SignalRing.window": (HANDLER, SUPERVISOR, MAIN),
+    "SignalRing.ticks": (HANDLER, SUPERVISOR, MAIN),
+    "SignalRing.replicas": (HANDLER, SUPERVISOR, MAIN),
+    "SignalRing.to_json": (HANDLER, SUPERVISOR, MAIN),
     # watchdog: the scheduler arms/disarms around each dispatch, the
     # monitor thread fires, /health reads
     "StepWatchdog.arm": (SCHEDULER,),
@@ -334,6 +366,9 @@ INSTANCE_HINTS: dict[str, str] = {
     "_page_channel": "PageChannelServer",
     "_obs": "EngineMetrics",
     "server": "InferenceServer",
+    "_watch": "Watchtower",
+    "watch": "Watchtower",
+    "ring": "SignalRing",
 }
 
 

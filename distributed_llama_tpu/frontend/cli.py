@@ -813,6 +813,18 @@ def cmd_serve(argv: list[str]) -> int:
                          "spanning >= N full KV pages; shorter prompts "
                          "prefill locally — handing them off would ship "
                          "nothing and re-derive everything")
+    ap.add_argument("--watch-interval", type=float, default=0.0,
+                    metavar="S",
+                    help="watchtower incident detection (ISSUE 20, "
+                         "obs/watch.py): sample the engine's signal "
+                         "plane every S seconds and run the detector "
+                         "suite (SLO burn rate, page leak, stall shift, "
+                         "goodput/spec collapse, recovery storm, "
+                         "handoff spike); incidents surface on "
+                         "/debug/incidents + /health's watch block and "
+                         "dump a flight-recorder bundle when --flightrec "
+                         "is set (0 = off; detectors still run on "
+                         "manual watch_tick() calls)")
     ap.add_argument("--flightrec", default=None, metavar="DIR",
                     help="crash-forensics flight recorder (ISSUE 15, "
                          "obs/flightrec.py): drop a postmortem bundle "
@@ -1003,7 +1015,8 @@ def cmd_serve(argv: list[str]) -> int:
                                  disagg_peer=args.disagg_peer,
                                  page_channel_port=args.page_channel_port,
                                  handoff_min_pages=args.handoff_min_pages,
-                                 flightrec_dir=args.flightrec)
+                                 flightrec_dir=args.flightrec,
+                                 watch_interval_s=args.watch_interval)
     except Exception as e:
         from ..runtime.journal import JournalConfigMismatch
 

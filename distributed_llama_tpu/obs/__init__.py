@@ -33,8 +33,15 @@ instrument is one end-of-run benchmark line, tokenizer.cpp:381):
   tail + config fingerprint) on watchdog trips, SIGTERM drains, and
   crash-loop respawns, validated by ``tools/tracecheck.py``;
 * ``obs.fleet`` — the fleet signal plane: per-replica /health+/metrics
-  rows + count-summed rollups (``tools/fleetcheck.py``; the signal
-  surface the multi-replica router consumes).
+  rows + count-summed rollups with scrape-age staleness accounting
+  (``tools/fleetcheck.py``; the signal surface the multi-replica router
+  consumes);
+* ``obs.watch`` — the watchtower (ISSUE 20): per-replica signal ring of
+  integer snapshot deltas, seven pure detectors with pinned thresholds
+  + hysteresis, incidents with evidence rows + trace ids, auto-dumped
+  flight-recorder forensics (``GET /debug/incidents``,
+  ``dllama_incidents_total{kind}``; ``tools/watchcheck.py`` holds the
+  detection matrix in CI).
 
 Collection is opt-in: hot paths hold a None handle when disabled and make
 zero registry calls (tests/test_obs.py pins this).

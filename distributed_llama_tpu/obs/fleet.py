@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import time
 import urllib.request
 
 # the row fields a scheduling router reads hot (documented as ONE list so
@@ -61,6 +62,12 @@ class ReplicaSignals:
     # these blocks' cells feed the rollup: an absent block (older
     # replica, feature off) is SKIPPED, not summed as phantom zeros.
     present: set | None = None
+    # monotonic stamp of when the scrape that built this row finished
+    # (ISSUE 20 satellite). None = directly-built row (tests, sims),
+    # never stale. ``rollup(stale_after=...)`` compares against it so a
+    # router polling a cached row table can tell "this replica looked
+    # fine 10 minutes ago" from "this replica looks fine".
+    scraped_at: float | None = None
     state: str = ""
     uptime_s: float = 0.0
     slots: int = 0
@@ -75,6 +82,11 @@ class ReplicaSignals:
     prefix_misses: int = 0
     prefill_tokens_saved: int = 0
     goodput_tokens: int = 0
+    # span-ring overflow counter (dllama_spans_dropped_total) — a row
+    # whose tracer is shedding spans has forensic blind spots, and the
+    # fleet total says whether the FLEET can be trusted to reconstruct
+    # an incident timeline (ISSUE 20 satellite)
+    spans_dropped: int = 0
     # class -> {"attempted", "met", "violated", "failed",
     #           "goodput_tokens"} (the /health slo block's counts)
     slo: dict = dataclasses.field(default_factory=dict)
@@ -104,6 +116,8 @@ class ReplicaSignals:
         out["occupancy"] = round(self.occupancy, 6)
         out["uptime_s"] = round(self.uptime_s, 3)
         out["page_seconds"] = round(self.page_seconds, 9)
+        out["scraped_at"] = (round(self.scraped_at, 6)
+                             if self.scraped_at is not None else None)
         return out
 
 
@@ -114,6 +128,11 @@ class FleetRollup:
 
     replicas: int = 0
     healthy: int = 0
+    # healthy-but-STALE rows (scrape older than rollup's stale_after):
+    # counted here, excluded from `healthy` and every sum below — a row
+    # that was fine ten minutes ago is evidence of nothing now, but it
+    # is not a dead box either, so it gets its own column (ISSUE 20)
+    stale: int = 0
     # /health schema versions seen across HEALTHY replicas: min != max
     # is a fleet mid-rolling-upgrade (0 = at least one pre-schema box)
     schema_min: int = 0
@@ -134,6 +153,9 @@ class FleetRollup:
     prefix_misses: int = 0
     prefill_tokens_saved: int = 0
     goodput_tokens: int = 0
+    # fleet-wide span-ring overflow (Σ dllama_spans_dropped_total):
+    # non-zero means some replica's incident timeline has holes
+    spans_dropped: int = 0
     slo: dict = dataclasses.field(default_factory=dict)
     page_seconds: float = 0.0
     stall_seconds: dict = dataclasses.field(default_factory=dict)
@@ -201,14 +223,30 @@ class FleetRollup:
         return out
 
 
-def rollup(rows: list) -> FleetRollup:
+def rollup(rows: list, stale_after: float | None = None,
+           now: float | None = None) -> FleetRollup:
     """Aggregate replica rows into the fleet row. Unhealthy replicas
     contribute only to the replica/healthy counts — their zeroed
-    signals must not dilute occupancy or hit rates."""
+    signals must not dilute occupancy or hit rates.
+
+    ``stale_after`` (seconds) marks a healthy row STALE when its
+    ``scraped_at`` stamp is older than that against ``now`` (defaults
+    to ``time.monotonic()``; pass it explicitly in gates for
+    determinism). Stale rows count only in ``FleetRollup.stale`` —
+    their last-known numbers are excluded from every sum, because a
+    router steering on a ten-minute-old pages_free reading is steering
+    blind. Rows without a stamp (direct-built: tests, sims) are never
+    stale."""
+    if now is None:
+        now = time.monotonic()
     agg = FleetRollup(replicas=len(rows))
     schemas: list[int] = []
     for r in rows:
         if not r.healthy:
+            continue
+        if (stale_after is not None and r.scraped_at is not None
+                and now - r.scraped_at > stale_after):
+            agg.stale += 1
             continue
         agg.healthy += 1
         schemas.append(r.schema)
@@ -220,6 +258,10 @@ def rollup(rows: list) -> FleetRollup:
         agg.queue_depth += r.queue_depth
         agg.steps += r.steps
         agg.generated_tokens += r.generated_tokens
+        # spans_dropped is obs-plane, not block-gated: every replica
+        # with a span tracer exports it, and zero from one without is
+        # an honest zero (no spans -> none dropped)
+        agg.spans_dropped += r.spans_dropped
         # block-derived cells only count when the replica's scrape
         # actually carried the block: an older replica (or one with the
         # feature off) is skipped, not averaged in as zeros — its
@@ -339,6 +381,8 @@ def apply_metrics(row: ReplicaSignals, samples: dict) -> ReplicaSignals:
         _mark_present(row, "paged_kv")
     if "dllama_queue_depth" in samples:
         row.queue_depth = int(samples["dllama_queue_depth"])
+    if "dllama_spans_dropped_total" in samples:
+        row.spans_dropped = int(samples["dllama_spans_dropped_total"])
     goodput = sum(v for k, v in samples.items()
                   if k.startswith("dllama_goodput_tokens_total"))
     if goodput:
@@ -388,7 +432,10 @@ def scrape_replica(name: str, base_url: str,
                    timeout: float = 5.0) -> ReplicaSignals:
     """One replica's row from a live server: GET /health (+ /metrics
     when served). Any failure yields an UNHEALTHY row with ``error``
-    set — the fleet plane reports dead replicas, it never hides them."""
+    set — the fleet plane reports dead replicas, it never hides them.
+    Every returned row (error rows included) carries a monotonic
+    ``scraped_at`` stamp so ``rollup(stale_after=...)`` can age out
+    rows a polling loop stopped refreshing."""
     base = base_url.rstrip("/")
     try:
         with urllib.request.urlopen(f"{base}/health",
@@ -397,11 +444,13 @@ def scrape_replica(name: str, base_url: str,
         row = signals_from_health(name, health)
     except (OSError, ValueError) as e:
         return ReplicaSignals(name=name, healthy=False,
-                              error=f"{type(e).__name__}: {e}")
+                              error=f"{type(e).__name__}: {e}",
+                              scraped_at=time.monotonic())
     try:
         with urllib.request.urlopen(f"{base}/metrics",
                                     timeout=timeout) as r:
             apply_metrics(row, parse_metrics(r.read().decode()))
     except (OSError, ValueError):
         pass  # metrics disabled (--no-metrics) — /health alone suffices
+    row.scraped_at = time.monotonic()
     return row

@@ -35,11 +35,12 @@ from collections import deque
 BUNDLE_KIND = "dllama-flightrec"
 BUNDLE_VERSION = 1
 # dump reasons the server/supervisor use; free-form reasons are legal
-# (the bundle is a diagnostic, not a schema prison) but these three are
+# (the bundle is a diagnostic, not a schema prison) but these four are
 # the wired triggers
 REASON_WATCHDOG = "watchdog"
 REASON_SIGTERM = "sigterm_drain"
 REASON_CRASH_LOOP = "crash_loop"
+REASON_INCIDENT = "incident"  # watchtower detector fired (ISSUE 20)
 
 
 class FlightRecorder:
@@ -121,8 +122,12 @@ class FlightRecorder:
                 for ln in lines if ln.strip()][-self.tail_lines:]
         return tail
 
-    def snapshot_bundle(self, reason: str) -> dict:
-        """Assemble the postmortem bundle object (dump() writes it)."""
+    def snapshot_bundle(self, reason: str,
+                        incident_kind: str | None = None) -> dict:
+        """Assemble the postmortem bundle object (dump() writes it).
+        ``incident_kind`` stamps the watchtower detector that triggered
+        an ISSUE-20 incident dump into the header (absent on every
+        other trigger — old bundles stay valid)."""
         from ..utils.fingerprint import run_stamp
 
         with self._lock:
@@ -152,6 +157,8 @@ class FlightRecorder:
             "kind": BUNDLE_KIND, "version": BUNDLE_VERSION,
             "reason": str(reason), "ts": round(time.time(), 6),
             "pid": os.getpid(),
+            **({"incident_kind": str(incident_kind)}
+               if incident_kind is not None else {}),
             "config": dict(self.config),
             "stamp": stamp,
             "events": events,
@@ -176,7 +183,8 @@ class FlightRecorder:
                 bundle["open_ledgers"] = []
         return bundle
 
-    def dump(self, target: str, reason: str) -> str:
+    def dump(self, target: str, reason: str,
+             incident_kind: str | None = None) -> str:
         """Write one bundle file and return its path. ``target`` is a
         directory (bundles get a reason/pid/sequence name so repeated
         dumps never clobber each other) or an explicit .json path.
@@ -197,7 +205,7 @@ class FlightRecorder:
                 target,
                 f"flightrec-{reason}-{os.getpid()}-{seq}.json")
         os.makedirs(parent, exist_ok=True)
-        bundle = self.snapshot_bundle(reason)
+        bundle = self.snapshot_bundle(reason, incident_kind=incident_kind)
         tmp = path + ".tmp"
         with open(tmp, "w", encoding="utf-8") as fh:
             json.dump(bundle, fh)
@@ -239,6 +247,12 @@ def validate_bundle(obj) -> None:
         raise ValueError("bundle 'config' must be an object")
     if not isinstance(obj.get("spans_dropped"), int):
         raise ValueError("bundle missing integer 'spans_dropped'")
+    # the incident header stamp (ISSUE 20): validate-if-present so
+    # bundles from pre-watchtower builds stay loadable (same version)
+    kind = obj.get("incident_kind")
+    if "incident_kind" in obj and (not isinstance(kind, str) or not kind):
+        raise ValueError("bundle 'incident_kind' must be a non-empty "
+                         "string when present")
     # scheduler-forensics sections (ISSUE 16): validate-if-present so
     # bundles from builds without them stay loadable (same version)
     for key in ("census_tail", "open_ledgers"):

@@ -26,6 +26,12 @@ Endpoints:
   GET  /debug/timeline -> Chrome-trace/Perfetto JSON of the engine's recent
                spans (request → prefill/decode windows, obs/spans.py);
                ``?format=ndjson`` emits one span object per line instead
+  GET  /debug/incidents -> the watchtower plane (obs/watch.py, ISSUE 20):
+               detector states + incident log with evidence rows + the
+               signal-ring tail; ``?kind=`` filters, ``?n=`` bounds the
+               tails, ``?format=ndjson`` streams one incident per line;
+               /health carries the compact "watch" heartbeat block and an
+               incident dumps a reason="incident" flight-recorder bundle
   POST /profile  {"seconds"?: float, "dir"?: str} -> starts a jax.profiler
                capture into dir for N seconds WHILE SERVING (409 if one is
                already running) — profile under real load
@@ -78,7 +84,7 @@ _IDLE_SLEEP_S = 0.002
 # analysis/wiremodel.HEALTH_SCHEMA_VERSION (the registry cannot import
 # the runtime; tests/test_wirecheck_repo.py pins the two equal) and
 # bump BOTH when the payload gains or renames a key.
-HEALTH_SCHEMA = 2
+HEALTH_SCHEMA = 3
 
 
 class OversizedRequest(ValueError):
@@ -105,7 +111,8 @@ class InferenceServer:
                  kv_disk_bytes: int = 0, disagg_role: str | None = None,
                  disagg_peer: str | None = None,
                  page_channel_port: int = 0, handoff_min_pages: int = 2,
-                 flightrec_dir: str | None = None):
+                 flightrec_dir: str | None = None,
+                 watch_interval_s: float = 0.0):
         self.spec = spec
         self.tokenizer = tokenizer
         self.default_steps = steps
@@ -221,6 +228,18 @@ class InferenceServer:
                             ledgers=self.engine.ledger_book)
         self.flightrec.note("server.start", role=disagg_role or "single",
                             slots=slots, page_size=page_size)
+        # incident-detection plane (ISSUE 20): always constructed — the
+        # detectors run on every watch_tick() whether the periodic
+        # supervisor loop is on (watch_interval_s > 0) or a test/sim
+        # drives ticks by hand. A firing detector dumps a flight-
+        # recorder bundle with reason="incident" + the detector kind.
+        from ..obs.watch import Watchtower
+
+        self.watch_interval_s = watch_interval_s
+        self._watch = Watchtower(registry=self.registry,
+                                 spans=self.engine._spans,
+                                 on_incident=self._on_incident)
+        self._watch_stop = threading.Event()
         # replay the previous life's unfinished requests BEFORE the
         # listener opens: recovered work re-queues first, so a restarted
         # server continues exactly where the crash cut it off
@@ -264,6 +283,8 @@ class InferenceServer:
                     return self._timeline()
                 if self.path.split("?")[0] == "/debug/sched":
                     return self._sched()
+                if self.path.split("?")[0] == "/debug/incidents":
+                    return self._incidents()
                 if self.path == "/metrics":
                     if server.registry is None:
                         return self._json(404, {"error": "metrics disabled "
@@ -279,143 +300,7 @@ class InferenceServer:
                     return
                 if self.path != "/health":
                     return self._json(404, {"error": "unknown path"})
-                eng = server.engine
-                with eng._lock:
-                    queued = len(eng._queue)
-                active = sum(not s.free for s in eng._pool)
-                payload = {
-                    "schema": HEALTH_SCHEMA,
-                    "state": server.health.state,
-                    "active": active,
-                    "queued": queued,
-                    "queue_depth": queued,
-                    "slots": eng.slots,
-                    "steps": eng.stats.steps,
-                    "generated_tokens": eng.stats.tokens,
-                    "uptime_s": round(time.monotonic() - server._t_start, 3),
-                    "occupancy": round(active / eng.slots, 4),
-                    # admission-pressure counters (ISSUE 8): page-starved
-                    # slot pauses and dry-pool head-of-queue requeues
-                    "pauses": eng.stats.pauses,
-                    "requeues": eng.stats.requeues,
-                }
-                if eng.allocator is not None:
-                    # paged-KV capacity surface (ISSUE 11): pool shape,
-                    # occupancy, the KV quantization in play, and the
-                    # pool planes' GLOBAL logical bytes (whole pool
-                    # across tp shards; per-device is /tp) — the
-                    # /metrics dllama_kv_quant_info / page-pool gauges'
-                    # JSON twin
-                    a = eng.allocator
-                    payload["paged_kv"] = {
-                        "page_size": a.page_size,
-                        "pages": a.n_pages,
-                        "pages_free": a.n_free,
-                        "kv_quant": eng.kv_quant,
-                        "pool_bytes": sum(int(x.nbytes)
-                                          for x in eng.cache),
-                        "prefix_hit_rate": round(a.hit_rate, 4),
-                        # raw hit/miss COUNTS (ISSUE 15): the fleet
-                        # plane recomputes aggregate hit rates from
-                        # summed counts, never from averaged ratios
-                        "prefix_hits": a.prefix_hits,
-                        "prefix_misses": a.prefix_misses,
-                        "prefill_tokens_saved": a.tokens_saved,
-                        "evictions": a.evictions,
-                    }
-                    if a.tiered:
-                        # KV-tier hierarchy surface (ISSUE 12): per-tier
-                        # page population + promotion/demotion flow +
-                        # the prefill tokens the spilled tiers rescued —
-                        # the dllama_kv_tier_pages/... series' JSON twin
-                        counts = a.tier_page_counts()
-                        payload["kv_tiers"] = {
-                            "pages": counts,
-                            "host_capacity": (a.host.n_pages
-                                              if a.host else 0),
-                            "disk_live_bytes": (a.disk.live_bytes
-                                                if a.disk else 0),
-                            "disk_budget_bytes": (a.disk.budget_bytes
-                                                  if a.disk else 0),
-                            "demotions": dict(a.demotions),
-                            "promotions": dict(a.promotions),
-                            "prefill_tokens_saved_by_tier":
-                                dict(a.tokens_saved_by_tier),
-                            "crc_drops": a.crc_drops,
-                        }
-                if server.disagg_role is not None:
-                    # disaggregated-topology surface (ISSUE 14): this
-                    # pool's role, its peer, and the handoff backlog —
-                    # the dllama_handoff_*/dllama_dcn_* series' JSON twin
-                    payload["disagg"] = {
-                        "role": server.disagg_role,
-                        "peer": server.disagg_peer,
-                        "page_channel_port": (
-                            server._page_channel.port
-                            if server._page_channel is not None else None),
-                        "handoff_queue_depth": (
-                            server._page_channel.queue_depth
-                            if server._page_channel is not None else 0),
-                    }
-                    if eng.allocator is not None:
-                        payload["disagg"]["pages_adopted"] = \
-                            eng.allocator.remote_adopted
-                if server.journal is not None:
-                    # recovery bookkeeping: requests replayed from the
-                    # journal at startup + append volume since
-                    payload["journal"] = {
-                        "path": server.journal.path,
-                        "fsync": server.journal.fsync,
-                        "recovered": server.recovered,
-                        "records": server.journal.records_total,
-                    }
-                if server._watchdog is not None:
-                    payload["watchdog"] = {
-                        "timeout_s": server._watchdog.timeout_s,
-                        "trips": server._watchdog.trips,
-                    }
-                if eng.slo_tracker is not None:
-                    # per-class attempted/met/violated/failed + attainment
-                    # + goodput (obs/slo.SLOTracker.snapshot)
-                    payload["slo"] = eng.slo_tracker.snapshot()
-                if eng._obs is not None:
-                    payload["admission_rejected"] = \
-                        eng._obs.rejected_total()
-                # cost-accounting surface (ISSUE 16): census dispatch
-                # totals + ledger book counts and per-class cost columns
-                # — GET /debug/sched's summary twin, the block the fleet
-                # plane (obs/fleet.signals_from_health) sums across
-                # replicas
-                book = eng.ledger_book
-                payload["sched"] = {
-                    "census": eng.sched_census.totals(),
-                    "ledgers": {"opened": book.opened_n,
-                                "closed": book.closed_n,
-                                "open": book.n_open},
-                    "cost_totals": book.grand_totals(),
-                    "cost_by_class": book.class_rollup(),
-                }
-                if eng.spec_k:
-                    # speculative decoding health (ISSUE 7): proposal
-                    # volume + accept rate of the n-gram self-drafter
-                    payload["speculative"] = {
-                        "k": eng.spec_k,
-                        "proposed": eng.stats.spec_proposed,
-                        "accepted": eng.stats.spec_accepted,
-                        "accept_rate": round(eng.stats.spec_accept_rate, 4),
-                    }
-                if server.registry is not None:
-                    for key, name in (
-                            ("ttft_s", "dllama_request_ttft_seconds"),
-                            ("token_latency_s",
-                             "dllama_request_decode_token_seconds"),
-                            ("queue_wait_s",
-                             "dllama_request_queue_wait_seconds")):
-                        h = server.registry.get(name)
-                        s = h.summary()
-                        payload[key] = {k: round(v, 6) if k != "count"
-                                        else v for k, v in s.items()}
-                self._json(200, payload)
+                self._json(200, server._health_payload())
 
             def _timeline(self):
                 """GET /debug/timeline: the engine's recent span timeline
@@ -473,6 +358,41 @@ class InferenceServer:
                     doc["closed_tail"] = book.closed_tail(n)
                     doc["cost_totals"] = book.grand_totals()
                     doc["cost_by_class"] = book.class_rollup()
+                    body = json.dumps(doc).encode()
+                    ctype = "application/json"
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _incidents(self):
+                """GET /debug/incidents: the watchtower's incident log
+                + detector states + the signal-ring tail (ISSUE 20).
+                Default: one JSON document (Watchtower.to_json);
+                ``?format=ndjson`` streams one incident per line for
+                log shippers; ``?n=<k>`` bounds the incident tail and
+                the ring tail (default 64); ``?kind=<detector>``
+                filters the ndjson stream to one detector kind."""
+                from urllib.parse import parse_qs, urlparse
+
+                q = parse_qs(urlparse(self.path).query)
+                try:
+                    n = int((q.get("n") or ["64"])[0])
+                except ValueError:
+                    return self._json(400, {"error": "n must be an "
+                                            "integer"})
+                kind = (q.get("kind") or [None])[0]
+                watch = server._watch
+                if (q.get("format") or [None])[0] == "ndjson":
+                    body = "".join(
+                        json.dumps(inc.to_json(), sort_keys=True) + "\n"
+                        for inc in watch.incidents(n, kind)).encode()
+                    ctype = "application/x-ndjson"
+                else:
+                    doc = watch.to_json(tail=n)
+                    doc["incident_log"] = [
+                        inc.to_json() for inc in watch.incidents(n, kind)]
                     body = json.dumps(doc).encode()
                     ctype = "application/json"
                 self.send_response(200)
@@ -923,16 +843,215 @@ class InferenceServer:
                 self._disagg_obs.handoffs["failed"].inc()
             return local
 
-    def _flightrec_dump(self, reason: str) -> None:
+    def _health_payload(self) -> dict:
+        """Assemble the GET /health JSON (the fleet plane's primary
+        scrape surface — the registered producer of wiremodel's
+        "health" format). Shared by the HTTP handler and the watch
+        plane's self-scrape (watch_tick), so the detectors see exactly
+        the payload a remote scraper would."""
+        eng = self.engine
+        with eng._lock:
+            queued = len(eng._queue)
+        active = sum(not s.free for s in eng._pool)
+        payload = {
+            "schema": HEALTH_SCHEMA,
+            "state": self.health.state,
+            "active": active,
+            "queued": queued,
+            "queue_depth": queued,
+            "slots": eng.slots,
+            "steps": eng.stats.steps,
+            "generated_tokens": eng.stats.tokens,
+            "uptime_s": round(time.monotonic() - self._t_start, 3),
+            "occupancy": round(active / eng.slots, 4),
+            # admission-pressure counters (ISSUE 8): page-starved
+            # slot pauses and dry-pool head-of-queue requeues
+            "pauses": eng.stats.pauses,
+            "requeues": eng.stats.requeues,
+        }
+        if eng.allocator is not None:
+            # paged-KV capacity surface (ISSUE 11): pool shape,
+            # occupancy, the KV quantization in play, and the
+            # pool planes' GLOBAL logical bytes (whole pool
+            # across tp shards; per-device is /tp) — the
+            # /metrics dllama_kv_quant_info / page-pool gauges'
+            # JSON twin
+            a = eng.allocator
+            payload["paged_kv"] = {
+                "page_size": a.page_size,
+                "pages": a.n_pages,
+                "pages_free": a.n_free,
+                "kv_quant": eng.kv_quant,
+                "pool_bytes": sum(int(x.nbytes)
+                                  for x in eng.cache),
+                "prefix_hit_rate": round(a.hit_rate, 4),
+                # raw hit/miss COUNTS (ISSUE 15): the fleet
+                # plane recomputes aggregate hit rates from
+                # summed counts, never from averaged ratios
+                "prefix_hits": a.prefix_hits,
+                "prefix_misses": a.prefix_misses,
+                "prefill_tokens_saved": a.tokens_saved,
+                "evictions": a.evictions,
+            }
+            if a.tiered:
+                # KV-tier hierarchy surface (ISSUE 12): per-tier
+                # page population + promotion/demotion flow +
+                # the prefill tokens the spilled tiers rescued —
+                # the dllama_kv_tier_pages/... series' JSON twin
+                counts = a.tier_page_counts()
+                payload["kv_tiers"] = {
+                    "pages": counts,
+                    "host_capacity": (a.host.n_pages
+                                      if a.host else 0),
+                    "disk_live_bytes": (a.disk.live_bytes
+                                        if a.disk else 0),
+                    "disk_budget_bytes": (a.disk.budget_bytes
+                                          if a.disk else 0),
+                    "demotions": dict(a.demotions),
+                    "promotions": dict(a.promotions),
+                    "prefill_tokens_saved_by_tier":
+                        dict(a.tokens_saved_by_tier),
+                    "crc_drops": a.crc_drops,
+                }
+        if self.disagg_role is not None:
+            # disaggregated-topology surface (ISSUE 14): this
+            # pool's role, its peer, and the handoff backlog —
+            # the dllama_handoff_*/dllama_dcn_* series' JSON twin
+            payload["disagg"] = {
+                "role": self.disagg_role,
+                "peer": self.disagg_peer,
+                "page_channel_port": (
+                    self._page_channel.port
+                    if self._page_channel is not None else None),
+                "handoff_queue_depth": (
+                    self._page_channel.queue_depth
+                    if self._page_channel is not None else 0),
+            }
+            if eng.allocator is not None:
+                payload["disagg"]["pages_adopted"] = \
+                    eng.allocator.remote_adopted
+        if self.journal is not None:
+            # recovery bookkeeping: requests replayed from the
+            # journal at startup + append volume since
+            payload["journal"] = {
+                "path": self.journal.path,
+                "fsync": self.journal.fsync,
+                "recovered": self.recovered,
+                "records": self.journal.records_total,
+            }
+        if self._watchdog is not None:
+            payload["watchdog"] = {
+                "timeout_s": self._watchdog.timeout_s,
+                "trips": self._watchdog.trips,
+            }
+        if eng.slo_tracker is not None:
+            # per-class attempted/met/violated/failed + attainment
+            # + goodput (obs/slo.SLOTracker.snapshot)
+            payload["slo"] = eng.slo_tracker.snapshot()
+        if eng._obs is not None:
+            payload["admission_rejected"] = \
+                eng._obs.rejected_total()
+        # cost-accounting surface (ISSUE 16): census dispatch
+        # totals + ledger book counts and per-class cost columns
+        # — GET /debug/sched's summary twin, the block the fleet
+        # plane (obs/fleet.signals_from_health) sums across
+        # replicas
+        book = eng.ledger_book
+        payload["sched"] = {
+            "census": eng.sched_census.totals(),
+            "ledgers": {"opened": book.opened_n,
+                        "closed": book.closed_n,
+                        "open": book.n_open},
+            "cost_totals": book.grand_totals(),
+            "cost_by_class": book.class_rollup(),
+        }
+        if eng.spec_k:
+            # speculative decoding health (ISSUE 7): proposal
+            # volume + accept rate of the n-gram self-drafter
+            payload["speculative"] = {
+                "k": eng.spec_k,
+                "proposed": eng.stats.spec_proposed,
+                "accepted": eng.stats.spec_accepted,
+                "accept_rate": round(eng.stats.spec_accept_rate, 4),
+            }
+        # incident-detection heartbeat (ISSUE 20): detection-plane
+        # tick count + per-kind incident totals and hysteresis states
+        # (evidence stays on /debug/incidents — health is a heartbeat,
+        # not a forensics dump)
+        payload["watch"] = self._watch.snapshot()
+        if self.registry is not None:
+            for key, name in (
+                    ("ttft_s", "dllama_request_ttft_seconds"),
+                    ("token_latency_s",
+                     "dllama_request_decode_token_seconds"),
+                    ("queue_wait_s",
+                     "dllama_request_queue_wait_seconds")):
+                h = self.registry.get(name)
+                s = h.summary()
+                payload[key] = {k: round(v, 6) if k != "count"
+                                else v for k, v in s.items()}
+        return payload
+
+    def watch_tick(self) -> list:
+        """One detection-plane scrape of THIS process: assemble the
+        /health payload, fold it (plus the parsed /metrics exposition)
+        into a fleet row, and feed the watchtower — exactly what a
+        remote scraper's tick would see. Returns the NEW incidents
+        (transitions into firing). Called by the ``_watch_loop``
+        supervisor thread when ``watch_interval_s > 0``; tests and sim
+        drivers call it directly on their own clock."""
+        from ..obs.fleet import parse_metrics, signals_from_health
+        from ..obs.watch import sample_from_signals
+
+        row = signals_from_health("self", self._health_payload())
+        samples = (parse_metrics(self.registry.expose())
+                   if self.registry is not None else None)
+        return self._watch.observe("self", sample_from_signals(row,
+                                                               samples))
+
+    def _watch_loop(self):
+        """Supervisor thread (threadmodel ENTRYPOINTS): periodic
+        watch_tick every ``watch_interval_s`` seconds until stop() sets
+        the event. Detector exceptions are logged, never fatal — a
+        broken detector must not take the watch plane down."""
+        while not self._watch_stop.wait(self.watch_interval_s):
+            try:
+                self.watch_tick()
+            except Exception as e:  # noqa: BLE001 - keep the loop alive
+                log_event("watch.error",
+                          f"🔶 watch tick failed: {e!r}",
+                          file=sys.stderr,
+                          error=f"{type(e).__name__}: {e}")
+
+    def _on_incident(self, inc) -> None:
+        """Watchtower firing hook (obs/watch.Incident): auto-forensics.
+        Note the incident into the flight-recorder ring and dump a
+        bundle with reason="incident" + the detector kind — the
+        postmortem snapshot taken AT detection time, not at the
+        operator's later convenience."""
+        from ..obs.flightrec import REASON_INCIDENT
+
+        log_event("watch.incident",
+                  f"🔶 incident #{inc.seq} {inc.kind} on {inc.replica} "
+                  f"tick {inc.tick}: {inc.note}",
+                  file=sys.stderr, kind=inc.kind, replica=inc.replica,
+                  tick=inc.tick, note=inc.note)
+        self._flightrec_dump(REASON_INCIDENT, incident_kind=inc.kind)
+
+    def _flightrec_dump(self, reason: str,
+                        incident_kind: str | None = None) -> None:
         """One postmortem bundle (obs/flightrec): note the trigger into
         the ring, then write a bundle file when a directory is
         configured. Never raises — this runs on fault paths."""
         self.flightrec.note(reason, state=self.health.state,
-                            outstanding=self._outstanding())
+                            outstanding=self._outstanding(),
+                            **({"incident_kind": incident_kind}
+                               if incident_kind else {}))
         if not self.flightrec_dir:
             return
         try:
-            path = self.flightrec.dump(self.flightrec_dir, reason)
+            path = self.flightrec.dump(self.flightrec_dir, reason,
+                                       incident_kind=incident_kind)
             log_event("flightrec.dump",
                       f"🔶 flight recorder: {reason} bundle -> {path}",
                       file=sys.stderr, path=path, reason=reason)
@@ -1009,8 +1128,14 @@ class InferenceServer:
         return True
 
     def start(self):
-        """Start the scheduler + HTTP threads and return (non-blocking)."""
-        for target in (self._scheduler, self.httpd.serve_forever):
+        """Start the scheduler + HTTP threads and return (non-blocking).
+        With ``watch_interval_s > 0`` the watch-plane supervisor thread
+        rides along (incident detection over the process's own signal
+        plane, ISSUE 20)."""
+        for target in (self._scheduler, self.httpd.serve_forever,
+                       self._watch_loop):
+            if target == self._watch_loop and self.watch_interval_s <= 0:
+                continue  # detectors still run on manual watch_tick()
             t = threading.Thread(target=target, daemon=True)
             t.start()
             self._threads.append(t)
@@ -1100,6 +1225,10 @@ class InferenceServer:
             return
         self._stopped.set()
         self._shutdown.set()
+        # park the watch loop FIRST: a watch tick mid-teardown would
+        # scrape a half-closed engine (the event also bounds the
+        # _watch_loop thread's lifetime — threadmodel's joined_by)
+        self._watch_stop.set()
         self.httpd.shutdown()
         sched_ok = self._scheduler_stopped(30)
         for t in self._threads[1:]:

@@ -155,6 +155,7 @@ def _sim_args(n=2, seed=7, requests=12):
                               requests=requests, arrivals="bursty",
                               slots=2, page_size=4, kv_pages=12,
                               spec_k=0, block_steps=1, replicas=None,
+                              timeout=5.0, stale_after=None,
                               json=True)
 
 
@@ -166,19 +167,24 @@ def test_fleetcheck_sim_deterministic_and_consistent():
 
     results = []
     for _ in range(2):
-        rows, agg, failures = fleetcheck.run_sim(_sim_args())
+        rows, agg, failures, tower = fleetcheck.run_sim(_sim_args())
         assert failures == []
-        results.append(([r.to_json() for r in rows], agg.to_json()))
+        results.append(([r.to_json() for r in rows], agg.to_json(),
+                        tower.to_json(tail=0)))
     assert results[0] == results[1]
-    rows_json, agg_json = results[0]
+    rows_json, agg_json, watch_json = results[0]
     assert len(rows_json) == 2
     assert agg_json["generated_tokens"] == sum(
         r["generated_tokens"] for r in rows_json)
     assert agg_json["kv_pages_free"] == sum(
         r["kv_pages_free"] for r in rows_json)
+    # the shared watchtower saw every replica tick, and a clean sim
+    # raises no incidents (the detection matrix is watchcheck's gate)
+    assert watch_json["ticks"] > 0
+    assert watch_json["incidents_total"] == 0
     # a different seed genuinely changes the row (the gate is not
     # vacuously comparing constants)
-    rows2, agg2, _ = fleetcheck.run_sim(_sim_args(seed=8))
+    rows2, agg2, _, _ = fleetcheck.run_sim(_sim_args(seed=8))
     assert agg2.to_json() != agg_json
 
 
@@ -240,3 +246,67 @@ def test_signals_from_health_parses_sched_block():
     # pre-ledger servers: no block, zero cost columns, no crash
     bare = signals_from_health("old", {"state": "serving"})
     assert bare.page_seconds == 0.0 and bare.cost_classes == {}
+
+
+# ------------------------------------------- staleness + spans_dropped
+
+
+def test_rollup_stale_rows_excluded_from_sums():
+    """ISSUE 20 satellite: a healthy row whose scrape stamp aged past
+    stale_after counts in `stale` only — its last-known numbers feed
+    nothing, but it is not reported as a dead box either."""
+    fresh = _row("fresh", scraped_at=100.0)
+    old = _row("old", scraped_at=10.0)
+    agg = rollup([fresh, old], stale_after=30.0, now=105.0)
+    assert agg.replicas == 2
+    assert agg.healthy == 1 and agg.stale == 1
+    assert agg.slots == 4  # only the fresh row summed
+    assert agg.goodput_tokens == 60
+    # without a stale_after the same rows all count (opt-in knob)
+    assert rollup([fresh, old], now=105.0).healthy == 2
+    # unstamped rows (tests, sims) are never stale
+    agg2 = rollup([_row("direct")], stale_after=1.0, now=1e9)
+    assert agg2.healthy == 1 and agg2.stale == 0
+    # an unhealthy stale row stays counted as unhealthy, not stale
+    dead = ReplicaSignals(name="dead", healthy=False, error="refused",
+                          scraped_at=10.0)
+    agg3 = rollup([fresh, dead], stale_after=30.0, now=105.0)
+    assert agg3.healthy == 1 and agg3.stale == 0
+
+
+def test_spans_dropped_cross_fill_and_fleet_sum():
+    """ISSUE 20 satellite: dllama_spans_dropped_total cross-fills the
+    row from /metrics and sums fleet-wide — the 'can the fleet's
+    incident timelines be trusted' column."""
+    row = apply_metrics(ReplicaSignals(name="a"),
+                        parse_metrics("dllama_spans_dropped_total 5\n"))
+    assert row.spans_dropped == 5
+    agg = rollup([row, _row("b", spans_dropped=2)])
+    assert agg.spans_dropped == 7
+    assert agg.to_json()["spans_dropped"] == 7
+    # a stale row's drops are excluded like every other sum
+    stale = _row("c", spans_dropped=100, scraped_at=0.0)
+    agg2 = rollup([row, stale], stale_after=1.0, now=100.0)
+    assert agg2.spans_dropped == 5
+
+
+def test_scrape_replica_stamps_scraped_at(params):
+    from distributed_llama_tpu.runtime.server import InferenceServer
+
+    srv = InferenceServer(SPEC, params, _IdTokenizer(), "127.0.0.1", 0,
+                          slots=2, steps=8, temperature=0.0, topp=0.9,
+                          seed=5, quiet=True)
+    srv.start()
+    try:
+        row = scrape_replica("r0", f"http://127.0.0.1:{srv.port}",
+                             timeout=10.0)
+        assert row.healthy and row.scraped_at is not None
+        # the stamp rides to_json (None for direct-built rows)
+        assert row.to_json()["scraped_at"] == pytest.approx(
+            row.scraped_at, abs=1e-3)
+        assert ReplicaSignals(name="x").to_json()["scraped_at"] is None
+        # error rows are stamped too — age and death are orthogonal
+        dead = scrape_replica("r1", "http://127.0.0.1:1", timeout=2.0)
+        assert not dead.healthy and dead.scraped_at is not None
+    finally:
+        srv.stop()

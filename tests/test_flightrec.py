@@ -239,3 +239,36 @@ def test_bundle_scheduler_forensics_sections(tmp_path):
     broken = dict(bundle, open_ledgers={"rid": 5})
     with pytest.raises(ValueError, match="open_ledgers"):
         validate_bundle(broken)
+
+
+def test_bundle_incident_kind_stamp(tmp_path):
+    """ISSUE 20: an incident-triggered dump stamps the detector kind in
+    the bundle header; other triggers omit it; bundles from pre-
+    watchtower builds (no key) stay loadable; a malformed stamp is
+    rejected by name."""
+    from distributed_llama_tpu.obs.flightrec import REASON_INCIDENT
+
+    fr = FlightRecorder()
+    fr.note("watch.incident", kind="page_leak")
+    path = fr.dump(str(tmp_path), REASON_INCIDENT,
+                   incident_kind="page_leak")
+    bundle = load_bundle(path)
+    assert bundle["reason"] == "incident"
+    assert bundle["incident_kind"] == "page_leak"
+    # non-incident triggers carry NO stamp (absent, not null)
+    plain = fr.snapshot_bundle("watchdog")
+    assert "incident_kind" not in plain
+    validate_bundle(plain)
+    for bad in ("", 7):
+        with pytest.raises(ValueError, match="incident_kind"):
+            validate_bundle(dict(bundle, incident_kind=bad))
+    # tracecheck surfaces the stamp in its summary line
+    import subprocess
+
+    proc = subprocess.run(
+        [sys.executable, os.path.join(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))), "tools",
+            "tracecheck.py"), path],
+        capture_output=True, text=True)
+    assert proc.returncode == 0
+    assert "incident_kind=page_leak" in proc.stdout

@@ -606,3 +606,122 @@ def test_stream_registering_during_stop_is_still_joined(params):
     assert not state["late"].is_alive(), \
         "handler registering during stop()'s join was NOT joined — " \
         "the register/join TOCTOU is back"
+
+
+# ------------------------------------------------------- watchtower plane
+
+
+def _get_json(port, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=30) as r:
+        return json.loads(r.read())
+
+
+def test_health_watch_block_and_debug_incidents(server):
+    """ISSUE 20: /health carries the watchtower heartbeat, and
+    /debug/incidents serves the detection plane — even before any
+    periodic loop ran a tick (watch_interval_s=0: manual ticks)."""
+    h = _get_json(server.port, "/health")
+    assert h["schema"] == 3
+    watch = h["watch"]
+    assert watch["incidents_total"] == 0
+    assert watch["last_incident"] is None
+    assert set(watch["detectors"]) == set(
+        __import__("distributed_llama_tpu.obs.watch",
+                   fromlist=["KINDS"]).KINDS)
+    # a manual tick scrapes the server's OWN health payload + registry
+    assert server.watch_tick() == []
+    assert _get_json(server.port, "/health")["watch"]["ticks"] == 1
+
+    doc = _get_json(server.port, "/debug/incidents")
+    assert doc["incidents_total"] == 0
+    assert doc["incident_log"] == []
+    assert doc["ring"]["replicas"]["self"]["ticks"] == 1
+    row = doc["ring"]["replicas"]["self"]["rows"][0]
+    assert row["tick"] == 0 and row["kv_pages_free"] >= 0
+
+    # ndjson stream: one line per incident (none yet — empty body)
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/debug/incidents"
+            f"?format=ndjson", timeout=30) as r:
+        assert r.headers["Content-Type"].startswith(
+            "application/x-ndjson")
+        assert r.read() == b""
+
+    # junk ?n is a 400, not a 500
+    try:
+        _get_json(server.port, "/debug/incidents?n=junk")
+        assert False, "expected 400"
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
+
+    # detector states ride /metrics
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/metrics", timeout=30) as r:
+        text = r.read().decode()
+    assert 'dllama_detector_state{kind="slo_burn"} 0' in text
+    assert 'dllama_incidents_total{kind="page_leak"} 0' in text
+
+
+def test_server_incident_dumps_flightrec_bundle(params, tmp_path):
+    """A detector transitioning into firing must leave a flight-recorder
+    bundle behind with reason="incident" and the detector kind stamped
+    in the header — the auto-forensics half of the tentpole."""
+    from distributed_llama_tpu.obs.flightrec import load_bundle
+    from distributed_llama_tpu.runtime.server import InferenceServer
+
+    srv = InferenceServer(SPEC, params, _IdTokenizer(), "127.0.0.1", 0,
+                          slots=2, steps=8, temperature=0.0, topp=0.9,
+                          seed=5, quiet=True,
+                          flightrec_dir=str(tmp_path))
+    srv.start()
+    try:
+        # hair-trigger the recovery detector and feed it a storm by
+        # hand — the wiring under test is observe -> _on_incident ->
+        # _flightrec_dump, not the detector math (test_watch owns that)
+        srv._watch.thresholds["recovery_storm_min"] = 1
+        from distributed_llama_tpu.obs.watch import blank_sample
+
+        fired = []
+        for n in (1, 2):
+            s = blank_sample()
+            s["recoveries"] = n
+            fired += srv._watch.observe("self", s)
+        assert [i.kind for i in fired] == ["recovery_storm"]
+        bundles = [p.name for p in tmp_path.iterdir()
+                   if p.name.startswith("flightrec-incident-")]
+        assert len(bundles) == 1
+        bundle = load_bundle(str(tmp_path / bundles[0]))
+        assert bundle["reason"] == "incident"
+        assert bundle["incident_kind"] == "recovery_storm"
+        # the incident is on /debug/incidents and in /health
+        doc = _get_json(srv.port, "/debug/incidents?kind=recovery_storm")
+        assert doc["incident_log"][0]["kind"] == "recovery_storm"
+        assert doc["incident_log"][0]["evidence"]
+        h = _get_json(srv.port, "/health")
+        assert h["watch"]["incidents_total"] == 1
+        assert h["watch"]["last_incident"]["kind"] == "recovery_storm"
+    finally:
+        srv.stop()
+
+
+def test_server_watch_loop_ticks_periodically(params):
+    """watch_interval_s > 0 starts the supervisor loop; ticks accrue
+    without any client traffic, and stop() parks the loop."""
+    import time as _time
+
+    from distributed_llama_tpu.runtime.server import InferenceServer
+
+    srv = InferenceServer(SPEC, params, _IdTokenizer(), "127.0.0.1", 0,
+                          slots=2, steps=8, temperature=0.0, topp=0.9,
+                          seed=5, quiet=True, watch_interval_s=0.05)
+    srv.start()
+    try:
+        deadline = _time.time() + 10
+        while _time.time() < deadline \
+                and srv._watch.ring.rows_total < 2:
+            _time.sleep(0.02)
+        assert srv._watch.ring.rows_total >= 2
+    finally:
+        srv.stop()
+    assert srv._watch_stop.is_set()

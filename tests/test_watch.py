@@ -1,0 +1,299 @@
+"""Watchtower detection plane (ISSUE 20): ring delta math, the detector
+suite's golden windows, hysteresis, and incident forensics."""
+
+import json
+
+import pytest
+
+from distributed_llama_tpu.obs.watch import (COLUMNS, DETECTORS, KINDS,
+                                             THRESHOLDS, Incident,
+                                             SignalRing, Watchtower,
+                                             _DetectorState, blank_sample,
+                                             detect_goodput_collapse,
+                                             detect_handoff_spike,
+                                             detect_page_leak,
+                                             detect_recovery_storm,
+                                             detect_slo_burn,
+                                             detect_spec_collapse,
+                                             detect_stall_shift,
+                                             sample_from_signals)
+
+
+def _rows(n, **kw):
+    """n golden ring rows: every column zero except the overrides
+    (a scalar sets every row; a list/tuple sets row-by-row)."""
+    out = []
+    for i in range(n):
+        row = {c: 0 for c in COLUMNS}
+        row["tick"] = i
+        for col, v in kw.items():
+            assert col in COLUMNS, col
+            row[col] = v[i] if isinstance(v, (list, tuple)) else v
+        out.append(row)
+    return out
+
+
+# ---------------------------------------------------------------- the ring
+
+
+def test_signal_ring_deltas_gauges_and_reset_clamp():
+    ring = SignalRing(keep=8)
+    s = blank_sample()
+    s.update(kv_pages_free=10, met=3, goodput_tokens=20)
+    r0 = ring.observe("a", s)
+    # first tick: gauges copied, counter deltas ARE the absolutes
+    assert r0["tick"] == 0 and r0["kv_pages_free"] == 10
+    assert r0["met"] == 3 and r0["goodput_tokens"] == 20
+    s.update(kv_pages_free=7, met=5, goodput_tokens=20)
+    r1 = ring.observe("a", s)
+    assert r1["tick"] == 1 and r1["kv_pages_free"] == 7
+    assert r1["met"] == 2 and r1["goodput_tokens"] == 0
+    # a counter moving BACKWARDS is a replica restart: the delta clamps
+    # at zero (Prometheus reset semantics), it never goes negative
+    s.update(met=1)
+    assert ring.observe("a", s)["met"] == 0
+    assert ring.ticks("a") == 3 and ring.rows_total == 3
+    # replicas are independent streams
+    ring.observe("b", blank_sample())
+    assert ring.replicas() == ["a", "b"]
+    assert ring.ticks("b") == 1
+    assert len(ring.window("a")) == 3
+
+
+def test_signal_ring_bounded_and_byte_identical():
+    def feed(ring):
+        for i in range(20):
+            s = blank_sample()
+            s.update(met=i, kv_pages_free=20 - i, queue_depth=i % 3)
+            ring.observe("r", s)
+        return ring
+
+    a = feed(SignalRing(keep=8))
+    b = feed(SignalRing(keep=8))
+    assert len(a.window("r")) == 8  # bounded
+    assert (json.dumps(a.to_json(), sort_keys=True)
+            == json.dumps(b.to_json(), sort_keys=True))
+
+
+# ----------------------------------------------- detector golden windows
+
+
+def test_slo_burn_needs_both_windows():
+    t = THRESHOLDS
+    # both windows burning -> hot
+    hot, note = detect_slo_burn(_rows(10, violated=1, met=1), t)
+    assert hot and "bad" in note
+    # fast-only burn (ancient history clean) -> quiet: the slow window
+    # dilutes below its fraction
+    rows = _rows(60, met=1) + _rows(5, violated=2, met=0)
+    assert not detect_slo_burn(rows, t)[0]
+    # slow-window burn but a clean fast window -> quiet (recovered)
+    rows = _rows(55, violated=1) + _rows(5, met=2)
+    assert not detect_slo_burn(rows, t)[0]
+    # too few verdicts to mean anything -> quiet
+    assert not detect_slo_burn(_rows(3, violated=1), t)[0]
+
+
+def test_page_leak_wants_monotone_idle_decline_without_demotions():
+    t = THRESHOLDS
+    frees = [20, 19, 19, 18, 17, 17, 16, 16, 15, 15, 14, 14]
+    hot, note = detect_page_leak(_rows(12, kv_pages_free=frees), t)
+    assert hot and "idle pages_free 20->14" in note
+    # demotions in the window explain the decline -> quiet
+    assert not detect_page_leak(
+        _rows(12, kv_pages_free=frees, demotions=1), t)[0]
+    # non-monotone (pages come back) -> churn, not a leak
+    bouncy = [20, 18, 20, 17, 20, 16, 20, 15, 20, 14, 20, 13]
+    assert not detect_page_leak(_rows(12, kv_pages_free=bouncy), t)[0]
+    # busy rows are not evidence (in-flight requests hold pages)
+    assert not detect_page_leak(
+        _rows(12, kv_pages_free=frees, active=1), t)[0]
+
+
+def test_stall_shift_fires_on_dominant_cause_change():
+    t = THRESHOLDS
+    rows = (_rows(15, stall_queue_wait=2)
+            + _rows(5, stall_pool_dry=3))
+    hot, note = detect_stall_shift(rows, t)
+    assert hot and "queue_wait" in note and "pool_dry" in note
+    # same dominant cause throughout -> quiet
+    assert not detect_stall_shift(_rows(20, stall_queue_wait=2), t)[0]
+    # mass under the floor -> quiet (noise, not a regime)
+    tiny = _rows(15, stall_queue_wait=1) + _rows(5, stall_pool_dry=1)
+    assert not detect_stall_shift(tiny, t)[0]
+    assert not detect_stall_shift(_rows(4), t)[0]  # window not filled
+
+
+def test_goodput_collapse_needs_completions_not_mere_demand():
+    t = THRESHOLDS
+    rows = (_rows(12, goodput_tokens=2, met=1)
+            + _rows(6, violated=1, queue_depth=2))
+    assert detect_goodput_collapse(rows, t)[0]
+    # demand with NO completions is a long decode stretch, not collapse
+    rows = (_rows(12, goodput_tokens=2, met=1)
+            + _rows(6, queue_depth=2, active=2))
+    assert not detect_goodput_collapse(rows, t)[0]
+    # a base window that never produced proves nothing
+    rows = _rows(12) + _rows(6, violated=1)
+    assert not detect_goodput_collapse(rows, t)[0]
+
+
+def test_spec_recovery_handoff_detectors():
+    t = THRESHOLDS
+    assert detect_spec_collapse(
+        _rows(8, spec_proposed=3, spec_accepted=0), t)[0]
+    assert not detect_spec_collapse(
+        _rows(8, spec_proposed=3, spec_accepted=2), t)[0]
+    assert detect_recovery_storm(_rows(10, recoveries=[1, 0, 1, 0, 1,
+                                                       0, 0, 0, 0, 0]),
+                                 t)[0]
+    assert not detect_recovery_storm(
+        _rows(10, recoveries=[1, 0, 0, 0, 0, 0, 0, 0, 0, 1]), t)[0]
+    assert detect_handoff_spike(
+        _rows(10, handoff_total=1, handoff_failed=[0, 1, 1, 0, 1, 1,
+                                                   0, 1, 0, 0]), t)[0]
+    assert not detect_handoff_spike(
+        _rows(10, handoff_total=1, handoff_failed=[0, 0, 1, 0, 0, 0,
+                                                   0, 0, 0, 0]), t)[0]
+
+
+# -------------------------------------------------------------- hysteresis
+
+
+def test_hysteresis_state_machine():
+    st = _DetectorState()
+    # one hot tick only warms (warm=2): no incident yet
+    assert st.advance(True, 2, 3, tick=0) is False
+    assert st.state == "warming"
+    # a quiet tick resets warming — a single noisy tick never fires
+    assert st.advance(False, 2, 3, tick=1) is False
+    assert st.state == "ok"
+    # two consecutive hot ticks fire EXACTLY once
+    assert st.advance(True, 2, 3, tick=2) is False
+    assert st.advance(True, 2, 3, tick=3) is True
+    assert st.state == "firing"
+    assert st.advance(True, 2, 3, tick=4) is False  # still firing
+    # quiet ticks cool; re-heating mid-cool returns to firing WITHOUT
+    # a new incident
+    assert st.advance(False, 2, 3, tick=5) is False
+    assert st.state == "cooling"
+    assert st.advance(True, 2, 3, tick=6) is False
+    assert st.state == "firing"
+    # cool ticks in a row close it out
+    for tick in (7, 8, 9):
+        assert st.advance(False, 2, 3, tick=tick) is False
+    assert st.state == "ok"
+
+
+# ------------------------------------------------------------- watchtower
+
+
+def _storm_sample(recoveries):
+    s = blank_sample()
+    s["recoveries"] = recoveries
+    return s
+
+
+def test_watchtower_fires_once_with_evidence_and_metrics():
+    from distributed_llama_tpu.obs.metrics import Registry
+
+    seen = []
+    reg = Registry()
+    tower = Watchtower(registry=reg, on_incident=seen.append)
+    total = 0
+    for _ in range(6):
+        total += 1
+        tower.observe("r0", _storm_sample(total))
+    assert tower.incidents_total == 1  # firing is an edge, not a level
+    assert seen and seen[0].kind == "recovery_storm"
+    inc = tower.incidents(kind="recovery_storm")[-1]
+    assert isinstance(inc, Incident) and inc.replica == "r0"
+    assert inc.evidence and inc.evidence[-1]["recoveries"] == 1
+    assert tower.by_kind()["recovery_storm"] == 1
+    snap = tower.snapshot()
+    assert snap["incidents_total"] == 1
+    assert snap["last_incident"]["kind"] == "recovery_storm"
+    assert snap["detectors"]["recovery_storm"] == "firing"
+    assert set(snap["detectors"]) == set(KINDS)
+    text = reg.expose()
+    assert 'dllama_incidents_total{kind="recovery_storm"} 1' in text
+    assert 'dllama_detector_state{kind="recovery_storm"} 2' in text
+    full = tower.to_json(tail=4)
+    assert full["incidents_by_replica"] == {"r0": 1}
+    assert len(full["ring"]["replicas"]["r0"]["rows"]) == 4
+
+
+def test_watchtower_mute_and_threshold_overrides():
+    muted = Watchtower(mute=("recovery_storm",))
+    eager = Watchtower(thresholds={"recovery_storm_min": 1})
+    for t in range(1, 7):
+        muted.observe("r", _storm_sample(t))
+        eager.observe("r", _storm_sample(t))
+    assert muted.incidents_total == 0
+    assert eager.incidents_total == 1
+    assert eager.thresholds["recovery_storm_min"] == 1
+    assert THRESHOLDS["recovery_storm_min"] == 3  # base table untouched
+
+
+def test_watchtower_byte_identical_across_runs():
+    def run():
+        tower = Watchtower()
+        total = 0
+        for i in range(30):
+            total += (1 if i % 3 == 0 else 0)
+            s = _storm_sample(total)
+            s["kv_pages_free"] = 20 - i % 5
+            tower.observe("a", s)
+            tower.observe("b", blank_sample())
+        return json.dumps(tower.to_json(), sort_keys=True)
+
+    assert run() == run()
+
+
+def test_detector_registry_is_consistent():
+    assert KINDS == tuple(d.kind for d in DETECTORS)
+    assert len(set(KINDS)) == len(KINDS)
+    for det in DETECTORS:
+        assert det.warm >= 1 and det.cool >= 1 and det.window >= 1
+
+
+# ---------------------------------------------------------- live sampling
+
+
+def test_sample_from_signals_maps_row_and_metrics():
+    from distributed_llama_tpu.obs.fleet import ReplicaSignals
+
+    row = ReplicaSignals(name="r", kv_pages_free=5, queue_depth=2,
+                         active=1, generated_tokens=40,
+                         goodput_tokens=30,
+                         slo={"interactive": {"met": 3, "violated": 1,
+                                              "failed": 0,
+                                              "goodput_tokens": 30}},
+                         stall_seconds={"pool_dry": 0.25})
+    samples = {
+        "dllama_recoveries_total": 2.0,
+        'dllama_handoff_requests_total{verdict="ok"} ': 0,  # ignored
+        'dllama_handoff_requests_total{verdict="ok"}': 3.0,
+        'dllama_handoff_requests_total{verdict="failed"}': 1.0,
+        'dllama_tier_demotions_total{dir="down"}': 4.0,
+    }
+    s = sample_from_signals(row, samples)
+    assert s["kv_pages_free"] == 5 and s["queue_depth"] == 2
+    assert s["met"] == 3 and s["violated"] == 1
+    assert s["goodput_tokens"] == 30 and s["generated_tokens"] == 40
+    assert s["stall_pool_dry"] == 250  # seconds -> integer ms
+    assert s["recoveries"] == 2
+    assert s["handoff_total"] == 4 and s["handoff_failed"] == 1
+    assert s["demotions"] == 4
+    # a bare row + no scrape degrades to zeros, not a crash
+    zeros = sample_from_signals(ReplicaSignals(name="x"))
+    assert all(v == 0 for v in zeros.values())
+
+
+def test_sample_column_contract():
+    """Every sample builder emits exactly the ring's columns — a new
+    detector column must be added to COLUMNS or it silently reads 0."""
+    s = blank_sample()
+    assert set(s) | {"tick"} == set(COLUMNS)
+    with pytest.raises(AssertionError):
+        _rows(1, not_a_column=1)

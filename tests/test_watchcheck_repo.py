@@ -1,0 +1,85 @@
+"""watchcheck gate (ISSUE 20): the detection matrix holds against THIS
+repo — each chaos fault raises exactly its incident kind, the healthy
+sweep raises none, the row is byte-deterministic, and both mutation
+arms turn the gate red."""
+
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))), "tools"))
+
+import watchcheck  # noqa: E402
+
+MATRIX = {
+    "healthy": None,
+    "leak-on-cancel": "page_leak",
+    "deny-pages-storm": "stall_shift",
+    "kill-mid-decode-loop": "recovery_storm",
+    "drop-page-in-flight": "handoff_spike",
+}
+
+
+@pytest.fixture(scope="module")
+def row(tmp_path_factory):
+    """One clean watchcheck run, shared across tests (the scenarios
+    replay whole engine workloads — run them once)."""
+    import argparse
+
+    args = argparse.Namespace(seed=7, json=True, inject=None)
+    return watchcheck.run(args)
+
+
+def test_detection_matrix_green(row):
+    assert row["gate"]["ok"] is True
+    assert row["gate"]["failures"] == []
+    by_name = {s["name"]: s for s in row["scenarios"]}
+    assert set(by_name) == set(MATRIX)
+    for name, expect in MATRIX.items():
+        s = by_name[name]
+        assert s["ok"], s
+        assert s["expect"] == expect
+        if expect is None:
+            # the false-positive gate: a healthy sweep raises NOTHING
+            assert s["incidents"] == []
+        else:
+            kinds = {i["kind"] for i in s["incidents"]}
+            assert kinds == {expect}, (name, kinds)
+            assert s["fired_tick"] is not None
+            assert s["fired_tick"] <= s["detect_by"]
+
+
+def test_row_is_fingerprint_stamped(row):
+    assert row["kind"] == "watchcheck"
+    assert "env_fingerprint" in row  # joinable with BENCH_* rows
+    assert row["config"]["seed"] == 7
+    assert row["thresholds"] == dict(
+        __import__("distributed_llama_tpu.obs.watch",
+                   fromlist=["THRESHOLDS"]).THRESHOLDS)
+
+
+def test_two_runs_byte_identical(row):
+    import argparse
+
+    again = watchcheck.run(argparse.Namespace(seed=7, json=True,
+                                              inject=None))
+    assert (json.dumps(again, sort_keys=True)
+            == json.dumps(row, sort_keys=True))
+
+
+def test_mutation_arms_turn_the_gate_red(capsys):
+    """mute-detector blinds each fault scenario's expected kind (faults
+    go undetected); jitter-thresholds makes the healthy sweep page.
+    Both must exit exactly 1 — the gate can actually fail."""
+    for inject in ("mute-detector", "jitter-thresholds"):
+        rc = watchcheck.main(["--seed", "7", "--json",
+                              "--inject", inject])
+        out = capsys.readouterr().out.strip().splitlines()[-1]
+        red = json.loads(out)
+        assert rc == 1, inject
+        assert red["gate"]["ok"] is False
+        assert red["gate"]["failures"], inject
+    assert watchcheck.main(["--inject", "nonsense"]) == 2

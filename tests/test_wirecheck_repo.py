@@ -208,9 +208,9 @@ def _corpus_row(name: str, era: str) -> ReplicaSignals:
 
 def test_rollup_skips_absent_blocks_instead_of_zero_filling():
     old = _corpus_row("old", "v1")   # schema 0: paged_kv + slo only
-    new = _corpus_row("new", "v2")   # schema 2: every block
+    new = _corpus_row("new", "v2")   # schema 3: every block
     agg = rollup([old, new])
-    assert (agg.schema_min, agg.schema_max) == (0, 2)
+    assert (agg.schema_min, agg.schema_max) == (0, 3)
     # both replicas report the kv + slo planes; only the new build
     # reports the cost plane — the rollup must say so, not dilute
     assert agg.reporting["paged_kv"] == 2

@@ -314,6 +314,46 @@ if ! cmp -s tools/ci_artifacts/fleetcheck_a.json \
          "the rollup is not deterministic" >&2
     exit 1
 fi
+# Incident-detection gate (ISSUE 20): watchcheck replays the chaos
+# faults on the virtual clock and holds the detection matrix — each
+# fault raises EXACTLY its incident kind within the pinned tick budget,
+# the healthy sweep raises none — and the fingerprint-stamped row
+# (thresholds included, so a threshold drift shows in the artifact
+# diff) must be byte-identical across runs of the same seed
+python tools/watchcheck.py --json > tools/ci_artifacts/watchcheck.json
+python tools/watchcheck.py --json > tools/ci_artifacts/watchcheck_b.json
+if ! cmp -s tools/ci_artifacts/watchcheck.json \
+        tools/ci_artifacts/watchcheck_b.json; then
+    echo "ci: watchcheck rows differ across identical seeds —" \
+         "incident detection is not deterministic" >&2
+    exit 1
+fi
+rm -f tools/ci_artifacts/watchcheck_b.json
+# ... and the gate must still CATCH a blind tower: with mute-detector
+# armed (each fault scenario's expected detector muted), the faults go
+# undetected and watchcheck must exit 1 EXACTLY — 2 is a usage error
+# and would pass a naive non-zero check vacuously
+set +e
+python tools/watchcheck.py --inject mute-detector > /dev/null 2>&1
+mute_rc=$?
+set -e
+if [ "$mute_rc" -ne 1 ]; then
+    echo "ci: watchcheck did not flag the muted detectors" \
+         "(exit $mute_rc, expected 1)" >&2
+    exit 1
+fi
+# ... and a paging tower the same way: with jitter-thresholds armed
+# (thresholds tightened to hair triggers) the healthy sweep must raise
+# false incidents and exit 1 EXACTLY
+set +e
+python tools/watchcheck.py --inject jitter-thresholds > /dev/null 2>&1
+jitter_rc=$?
+set -e
+if [ "$jitter_rc" -ne 1 ]; then
+    echo "ci: watchcheck did not flag the jittered thresholds" \
+         "(exit $jitter_rc, expected 1)" >&2
+    exit 1
+fi
 # Accounting-plane gate (ISSUE 16): the request-ledger vs scheduler-
 # census conservation equalities must hold EXACTLY on the virtual clock
 # across every leg — healthy, speculative, cancel storm, kill-mid-decode

@@ -117,26 +117,41 @@ def _sim_sched_block(eng, sec_per_step: float = SIM_SEC_PER_STEP) -> dict:
     }
 
 
-def run_sim(args) -> tuple[list, "object", list[str]]:
+def run_sim(args) -> tuple[list, "object", list[str], "object"]:
     """N replicas, one trace, round-robin routing, virtual clocks."""
     from loadcheck import _load_spec, _policy, build_engine_factory
     from loadgen import Trace, drive_engine, generate_trace
+    from watchcheck import _Feed
 
     from distributed_llama_tpu.obs.fleet import (apply_metrics,
                                                  parse_metrics, rollup,
                                                  signals_from_health)
+    from distributed_llama_tpu.obs.watch import Watchtower
 
     make_engine = build_engine_factory(args)
     policy = _policy()
     trace = generate_trace(_load_spec(args.rate, args), args.seed)
     failures: list[str] = []
     rows = []
+    # ONE shared watchtower over the whole sim fleet — each replica's
+    # drive feeds it per-scheduler-tick through the same
+    # sample_from_engine path watchcheck gates, so the fleet row carries
+    # deterministic incident columns (surfaced, not gated: the
+    # detection matrix itself is watchcheck's job)
+    tower = Watchtower(spans=None)
     for k in range(args.sim):
         events = [e for i, e in enumerate(trace.events)
                   if i % args.sim == k]
         sub = Trace(seed=trace.seed, spec=trace.spec, events=events)
         eng = make_engine()
-        res = drive_engine(eng, sub, policy)
+        feed = _Feed(tower, replica=f"replica-{k}")
+
+        def on_tick(v, finished, feed=feed, eng=eng):
+            for rec in finished:
+                feed.settle(rec, policy)
+            feed.tick(eng)
+
+        res = drive_engine(eng, sub, policy, on_tick=on_tick)
         row = signals_from_health(f"replica-{k}",
                                   _sim_health_payload(eng, res.duration))
         # the /metrics half of the scrape path, against the engine's own
@@ -198,20 +213,24 @@ def run_sim(args) -> tuple[list, "object", list[str]]:
     if agg.healthy != len(healthy):
         failures.append(f"rollup healthy = {agg.healthy}, expected "
                         f"{len(healthy)}")
-    return rows, agg, failures
+    if agg.spans_dropped != sum(r.spans_dropped for r in healthy):
+        failures.append(
+            f"rollup spans_dropped = {agg.spans_dropped}, expected "
+            f"{sum(r.spans_dropped for r in healthy)}")
+    return rows, agg, failures, tower
 
 
-def run_scrape(args) -> tuple[list, "object", list[str]]:
+def run_scrape(args) -> tuple[list, "object", list[str], None]:
     from distributed_llama_tpu.obs.fleet import rollup, scrape_replica
 
     urls = [u for u in args.replicas.split(",") if u]
-    rows = [scrape_replica(f"replica-{i}", url)
+    rows = [scrape_replica(f"replica-{i}", url, timeout=args.timeout)
             for i, url in enumerate(urls)]
-    agg = rollup(rows)
+    agg = rollup(rows, stale_after=args.stale_after)
     failures = []
     if agg.healthy == 0:
         failures.append("no healthy replica answered the scrape")
-    return rows, agg, failures
+    return rows, agg, failures, None
 
 
 def main(argv=None) -> int:
@@ -222,6 +241,14 @@ def main(argv=None) -> int:
                     "virtual-clock sim)")
     ap.add_argument("--replicas", default=None,
                     help="comma-separated base URLs of live servers")
+    ap.add_argument("--timeout", type=float, default=5.0,
+                    help="(--replicas) per-request scrape timeout, "
+                         "seconds")
+    ap.add_argument("--stale-after", type=float, default=None,
+                    metavar="S",
+                    help="(--replicas) count a row STALE (excluded "
+                         "from sums) when its scrape stamp is older "
+                         "than S seconds")
     ap.add_argument("--sim", type=int, default=0, metavar="N",
                     help="simulate an N-replica fleet on the virtual "
                          "clock (deterministic; the CI mode)")
@@ -254,9 +281,9 @@ def main(argv=None) -> int:
     from distributed_llama_tpu.utils.fingerprint import run_stamp
 
     if args.sim:
-        rows, agg, failures = run_sim(args)
+        rows, agg, failures, tower = run_sim(args)
     else:
-        rows, agg, failures = run_scrape(args)
+        rows, agg, failures, tower = run_scrape(args)
 
     if not args.json:
         print(f"{'replica':<12} {'ok':<3} {'state':<9} {'act':>3} "
@@ -279,6 +306,15 @@ def main(argv=None) -> int:
         print(f"cost:  page_s {agg.page_seconds:.3f}, "
               f"{agg.cost_per_goodput_token * 1e3:.3f} ms/goodput-tok, "
               f"per-class {cost or '(no ledgers)'}")
+        if agg.stale or agg.spans_dropped:
+            print(f"aging: {agg.stale} stale row(s), "
+                  f"{agg.spans_dropped} span(s) dropped fleet-wide")
+        if tower is not None:
+            kinds = " ".join(f"{k}={n}" for k, n
+                             in sorted(tower.by_kind().items()))
+            print(f"watch: {tower.incidents_total} incident(s) over "
+                  f"{tower.ring.rows_total} tick(s)"
+                  + (f" [{kinds}]" if kinds else ""))
         for f in failures:
             print(f"fleetcheck: {f}", file=sys.stderr)
 
@@ -286,13 +322,18 @@ def main(argv=None) -> int:
                 "replicas": args.sim or len(rows), "seed": args.seed,
                 "rate": args.rate, "requests": args.requests,
                 "arrivals": args.arrivals, "slots": args.slots,
-                "page_size": args.page_size, "kv_pages": args.kv_pages}
+                "page_size": args.page_size, "kv_pages": args.kv_pages,
+                "timeout": args.timeout, "stale_after": args.stale_after}
     row = {
         "kind": "fleetcheck",
         **run_stamp(),
         "config": mode_cfg,
         "rows": [r.to_json() for r in rows],
         "rollup": agg.to_json(),
+        # the sim fleet's incident plane (ISSUE 20): deterministic —
+        # virtual clocks + integer ring columns, so ci.sh's double-run
+        # byte-compare covers these cells too
+        "watch": tower.to_json(tail=0) if tower is not None else None,
         "gate": {"verdict": "RED" if failures else "OK",
                  "failures": failures},
     }

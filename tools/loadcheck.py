@@ -334,8 +334,16 @@ def run_budget(args, make_engine) -> tuple[dict, list[str]]:
 
 def run_sweep(args, make_engine) -> list[dict]:
     """One LoadResult row per offered rate (fresh engine + fresh trace
-    per point, same seed — points differ only in arrival rate)."""
+    per point, same seed — points differ only in arrival rate). Each
+    point also runs its own watchtower (ISSUE 20) fed per scheduler
+    tick; the point's ``watch`` verdict — quiet or firing, with the
+    per-kind counts — rides the row and is pinned by the baseline band
+    file, so a detector that starts paging on a clean low-rate point
+    (or goes blind at saturation) is a gate failure, not a surprise."""
     from loadgen import drive_engine, generate_trace, save_trace
+    from watchcheck import _Feed
+
+    from distributed_llama_tpu.obs.watch import Watchtower
 
     policy = _policy()
     rows = []
@@ -346,16 +354,31 @@ def run_sweep(args, make_engine) -> list[dict]:
             save_trace(trace, os.path.join(
                 args.trace_out, f"trace_rate{rate:g}.json"))
         eng = make_engine()
+        tower = Watchtower(spans=None)
+        feed = _Feed(tower, replica=f"rate-{rate:g}")
+
+        def on_tick(v, finished, feed=feed, eng=eng):
+            for rec in finished:
+                feed.settle(rec, policy)
+            feed.tick(eng)
+
         res = drive_engine(eng, trace, policy,
-                           step_cost_s=args.step_cost)
-        row = {"rate": rate, **res.to_json()}
+                           step_cost_s=args.step_cost, on_tick=on_tick)
+        watch = {
+            "verdict": "quiet" if not tower.incidents_total else "firing",
+            "incidents_total": tower.incidents_total,
+            "incidents": {k: n for k, n in sorted(tower.by_kind().items())
+                          if n},
+        }
+        row = {"rate": rate, **res.to_json(), "watch": watch}
         rows.append(row)
         if not args.json:
             att = " ".join(f"{c}={a:.2f}"
                            for c, a in res.attainment.items())
             print(f"rate {rate:<6g} goodput {res.goodput_tps:7.3f} "
                   f"tok/step  attainment {att}  pauses "
-                  f"{res.engine.get('pauses', 0)}")
+                  f"{res.engine.get('pauses', 0)}  watch "
+                  f"{watch['verdict']}")
     return rows
 
 
@@ -382,7 +405,11 @@ def check_baseline(rows: list[dict], path: str,
                "points": [{"rate": r["rate"],
                            "goodput_tps": r["goodput_tps"],
                            "band": [round(r["goodput_tps"] * 0.9, 6),
-                                    round(r["goodput_tps"] * 1.1, 6)]}
+                                    round(r["goodput_tps"] * 1.1, 6)],
+                           # the point's expected watchtower verdict
+                           # (ISSUE 20): quiet points must stay quiet,
+                           # firing points must keep firing
+                           "watch": r.get("watch", {}).get("verdict")}
                           for r in rows]}
         with open(path, "w", encoding="utf-8") as fh:
             json.dump(doc, fh, indent=1)
@@ -413,6 +440,15 @@ def check_baseline(rows: list[dict], path: str,
             print(f"loadcheck: rate {row['rate']}: goodput {got:.3f} "
                   f"ABOVE band [{lo:.3f}, {hi:.3f}] — consider "
                   f"--write-baseline", file=sys.stderr)
+        # watchtower verdict pin (ISSUE 20). Tolerate a baseline from
+        # before the column existed — absent means unpinned, not quiet.
+        want_watch = point.get("watch")
+        got_watch = row.get("watch", {}).get("verdict")
+        if want_watch is not None and got_watch != want_watch:
+            failures.append(
+                f"rate {row['rate']}: watchtower verdict {got_watch!r}, "
+                f"baseline pins {want_watch!r} — detector behavior "
+                f"drifted on this point")
     return failures, doc
 
 

@@ -306,7 +306,7 @@ def _finalize(records, policy, duration, offered) -> LoadResult:
 
 
 def drive_engine(engine, trace: Trace, policy, step_cost_s: float = 1.0,
-                 max_iters: int = 1_000_000) -> LoadResult:
+                 max_iters: int = 1_000_000, on_tick=None) -> LoadResult:
     """Replay ``trace`` against an in-process engine on a VIRTUAL clock.
 
     Each scheduler iteration advances virtual time by ``step_cost_s`` per
@@ -319,7 +319,13 @@ def drive_engine(engine, trace: Trace, policy, step_cost_s: float = 1.0,
     First-token resolution is one scheduler iteration (the driver sees
     ``t_first_token`` after the step that produced it) — identical across
     runs, which is what the determinism gate pins. Call on a FRESH
-    engine; the driver owns the scheduler loop (no server thread)."""
+    engine; the driver owns the scheduler loop (no server thread).
+
+    ``on_tick(v, finished)`` — when given — is called once per scheduler
+    iteration after the live-scan with the virtual time and the records
+    that finished THIS iteration (verdicts still pending: callers that
+    need them evaluate incrementally via ``policy.resolve(...)``, the
+    watchtower feed in fleetcheck/watchcheck does exactly this)."""
     from distributed_llama_tpu.runtime.continuous import Request
 
     events = sorted(trace.events, key=lambda e: e.t)
@@ -348,6 +354,7 @@ def drive_engine(engine, trace: Trace, policy, step_cost_s: float = 1.0,
         v += step_cost_s * ((engine.stats.steps - before)
                             + (engine.stats.overrun_steps - o0))
         still = []
+        finished = []
         for req, rec in live:
             if rec.v_first is None and req.t_first_token:
                 rec.v_first = v
@@ -356,9 +363,12 @@ def drive_engine(engine, trace: Trace, policy, step_cost_s: float = 1.0,
                 rec.n_sampled = req.n_sampled
                 rec.tokens_out = len(req.out)
                 rec.error = req.error
+                finished.append(rec)
             else:
                 still.append((req, rec))
         live = still
+        if on_tick is not None:
+            on_tick(v, finished)
         if not live and i >= len(events):
             break
     else:

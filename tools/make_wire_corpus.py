@@ -239,7 +239,7 @@ def build_health_v2() -> dict:
     block present, so the fleet row's presence set is exercised end to
     end."""
     return {
-        "schema": 2,
+        "schema": 3,
         "state": "serving", "active": 1, "queued": 2, "queue_depth": 2,
         "slots": 4, "steps": 100, "generated_tokens": 64,
         "uptime_s": 12.5, "occupancy": 0.25, "pauses": 0,
@@ -268,6 +268,13 @@ def build_health_v2() -> dict:
                 "page_s": 0.25, "stall_s_total": 0.125,
                 "page_steps": 6}}},
         "speculative": {"draft_len": 0, "accepted": 0, "rejected": 0},
+        "watch": {"ticks": 12, "incidents_total": 1,
+                  "incidents": {"page_leak": 1},
+                  "detectors": {"page_leak": "firing",
+                                "slo_burn": "ok"},
+                  "last_incident": {"seq": 0, "kind": "page_leak",
+                                    "replica": "self", "tick": 9,
+                                    "note": "idle pages_free 20->18"}},
     }
 
 
@@ -280,7 +287,7 @@ HEALTH_V1_EXPECT = {
 }
 
 HEALTH_V2_EXPECT = {
-    "schema": 2,
+    "schema": 3,
     "present": ["disagg", "journal", "kv_tiers", "paged_kv", "sched",
                 "slo", "speculative", "watchdog"],
     "healthy": True, "kv_pages": 24, "kv_pages_free": 17,
@@ -365,13 +372,16 @@ def build_bundle_v1() -> dict:
 
 
 def build_bundle_v2() -> dict:
-    """A current bundle: v1 sections plus the ISSUE-16 tails."""
+    """A current bundle: v1 sections plus the ISSUE-16 tails and the
+    ISSUE-20 incident header stamp."""
     out = build_bundle_v1()
     out["config"] = build_fingerprint_v2()
     out["metrics"] = build_metrics_v2()
     out["census_tail"] = [{"step": 100, "prefill": 1, "decode": 2,
                            "stalled": 0}]
     out["open_ledgers"] = [{"id": 3, "tokens": 1, "page_steps": 4}]
+    out["reason"] = "incident"
+    out["incident_kind"] = "page_leak"
     return out
 
 

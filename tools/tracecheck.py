@@ -78,6 +78,9 @@ def _check_bundle(path: str, emit_json: bool = False) -> int:
                        if ln and not ln.startswith("#"))
     summary = {
         "kind": bundle["kind"], "reason": bundle["reason"],
+        # the watchtower detector that triggered an incident dump
+        # (ISSUE 20) — absent on watchdog/sigterm/crash-loop bundles
+        "incident_kind": bundle.get("incident_kind"),
         "ts": bundle["ts"], "pid": bundle.get("pid"),
         "events": len(bundle["events"]), "spans": len(bundle["spans"]),
         "spans_dropped": bundle["spans_dropped"],
@@ -92,7 +95,10 @@ def _check_bundle(path: str, emit_json: bool = False) -> int:
     if emit_json:
         print(json.dumps(summary))
     else:
-        print(f"flight-recorder bundle OK: reason={summary['reason']} "
+        kind = (f" incident_kind={summary['incident_kind']}"
+                if summary["incident_kind"] else "")
+        print(f"flight-recorder bundle OK: reason={summary['reason']}"
+              f"{kind} "
               f"events={summary['events']} spans={summary['spans']} "
               f"(+{summary['spans_dropped']} dropped) "
               f"metrics={summary['metric_samples']} samples "
